@@ -1,0 +1,48 @@
+"""mxnet_tpu: a TPU-native framework with MXNet's capability surface.
+
+A from-scratch rebuild of Apache MXNet (reference: xiezhq-hermann/
+incubator-mxnet @1.5, mounted read-only at /root/reference) designed
+TPU-first on JAX/XLA/Pallas:
+
+- `mx.nd` — imperative NDArray on jax.Array (async via XLA dispatch)
+- `mx.autograd` — tape of jax.vjp closures
+- `mx.gluon` — Block/HybridBlock; hybridize == jax.jit
+- `mx.sym` + Module — symbolic graphs lowered to one XLA computation
+- `mx.kvstore` / parallel — ICI/DCN collectives via jax.sharding Mesh
+- optimizers/metrics/io/model_zoo — API parity with the reference
+
+Conventional import: ``import mxnet_tpu as mx``.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import metric
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import gluon
+from . import parallel
+from . import utils  # noqa: F401
+
+# keep reference-style aliases
+Context = Context
+
+
+def test_utils():  # pragma: no cover
+    from . import test_utils as tu
+
+    return tu

@@ -1,0 +1,295 @@
+"""Autograd: imperative tape over JAX VJPs.
+
+TPU-native redesign of the reference's imperative autograd
+(reference: src/imperative/imperative.cc Imperative::{RecordOp,Backward},
+python/mxnet/autograd.py). Instead of hanging AGInfo nodes on an NNVM graph
+and replaying FGradient registrations, every recorded op eagerly computes a
+``jax.vjp`` closure; ``backward()`` walks the tape in reverse calling the
+(XLA-compiled, for hybridized subgraphs) transpose functions and accumulates
+gradients into NDArrays marked with ``attach_grad`` — MXNet's
+``kAddTo``/``write`` grad_req semantics without a mutable graph IR.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+
+class _TapeNode:
+    """One recorded op: a vjp closure linking input/output NDArrays."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "n_arrays")
+
+    def __init__(self, vjp_fn, inputs, outputs):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[NDArray] (array inputs only)
+        self.outputs = outputs  # list[NDArray]
+
+
+class _AutogradState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_STATE = _AutogradState()
+
+
+def is_recording():
+    """Reference: python/mxnet/autograd.py is_recording / MXAutogradIsRecording."""
+    return _STATE.recording
+
+
+def is_training():
+    """Reference: python/mxnet/autograd.py is_training."""
+    return _STATE.training
+
+
+def set_recording(is_record):
+    prev = _STATE.recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _STATE.training
+    _STATE.training = bool(train_mode_)
+    return prev
+
+
+@contextmanager
+def _scope(recording=None, training=None):
+    prev_r, prev_t = _STATE.recording, _STATE.training
+    if recording is not None:
+        if recording and not prev_r:
+            # entering a fresh top-level record scope: drop any stale tape
+            # left by a forward pass whose backward never ran (keeps memory
+            # bounded, like the reference dropping the graph on re-record)
+            _STATE.tape = []
+        _STATE.recording = recording
+    if training is not None:
+        _STATE.training = training
+    try:
+        yield
+    finally:
+        _STATE.recording, _STATE.training = prev_r, prev_t
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for backward.
+
+    Reference: python/mxnet/autograd.py:122 record().
+    """
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    """Reference: python/mxnet/autograd.py:141 pause()."""
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    """Reference: python/mxnet/autograd.py:163."""
+    return _scope(training=True)
+
+
+def predict_mode():
+    """Reference: python/mxnet/autograd.py:181."""
+    return _scope(training=False)
+
+
+def _record_op(vjp_fn, array_inputs, outputs):
+    """Append a tape node (called by the op-dispatch layer)."""
+    _STATE.tape.append(_TapeNode(vjp_fn, list(array_inputs), list(outputs)))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as autograd leaves with supplied gradient buffers.
+
+    Reference: Imperative::MarkVariables (src/imperative/imperative.cc:123),
+    python/mxnet/autograd.py mark_variables.
+    """
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._ag_marked = True
+
+
+def _zeros_like_data(data):
+    return jnp.zeros(data.shape, data.dtype)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. all marked variables on the tape.
+
+    Reference: Imperative::Backward (src/imperative/imperative.cc:280-517),
+    python/mxnet/autograd.py:246. Walks the tape in reverse; each node's
+    ``jax.vjp`` closure is the transpose XLA computation.
+    """
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+
+    tape = _STATE.tape
+    # grad accumulator keyed by NDArray object identity
+    grads = {}
+    for i, h in enumerate(heads):
+        hg = None if head_grads is None else head_grads[i]
+        if hg is None:
+            g = jnp.ones(h.shape, h.dtype)
+        else:
+            g = hg.data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        grads[id(h)] = grads.get(id(h), 0) + g
+
+    for node in reversed(tape):
+        out_grads = []
+        any_grad = False
+        for o in node.outputs:
+            g = grads.get(id(o))
+            if g is None:
+                out_grads.append(_zeros_like_data(o.data))
+            else:
+                any_grad = True
+                out_grads.append(g)
+        if not any_grad:
+            continue
+        cot = out_grads[0] if len(node.outputs) == 1 else tuple(out_grads)
+        in_grads = node.vjp_fn(cot)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            prev = grads.get(id(inp))
+            grads[id(inp)] = g if prev is None else prev + g
+
+    # write into marked variables honoring grad_req
+    seen = set()
+    for node in tape:
+        for arr in node.inputs + node.outputs:
+            if id(arr) in seen:
+                continue
+            seen.add(id(arr))
+            if getattr(arr, "_ag_marked", False) and id(arr) in grads:
+                req = getattr(arr, "_grad_req", "write")
+                if req == "null" or arr._grad is None:
+                    continue
+                if req == "add":
+                    arr._grad._data = arr._grad._data + grads[id(arr)]
+                else:
+                    arr._grad._data = jnp.asarray(grads[id(arr)], arr._grad.dtype)
+    # heads may themselves be marked leaves that never appear on the tape
+    for h in heads:
+        if getattr(h, "_ag_marked", False) and id(h) not in seen and h._grad is not None:
+            h._grad._data = jnp.asarray(grads[id(h)], h._grad.dtype)
+
+    if not retain_graph:
+        _STATE.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient: returns grads of heads w.r.t. variables.
+
+    Reference: python/mxnet/autograd.py:273. ``create_graph`` (higher-order
+    grad) is supported by recomputing with ``jax.grad`` composition.
+    """
+    from .ndarray import NDArray, array
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    # temporarily attach fresh grad buffers (restore marks AND grad_req)
+    saved = [(v._grad if hasattr(v, "_grad") else None,
+              getattr(v, "_ag_marked", False),
+              getattr(v, "_grad_req", "null")) for v in variables]
+    from . import ndarray as nd
+
+    bufs = [nd.zeros(v.shape, dtype=v.dtype) for v in variables]
+    mark_variables(variables, bufs)
+    backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if not retain_graph:
+        _STATE.tape = []
+    for v, (g, m, req) in zip(variables, saved):
+        v._grad = g
+        v._ag_marked = m
+        v._grad_req = req
+    return bufs[0] if single else bufs
+
+
+def get_symbol(x):  # pragma: no cover - legacy API
+    """Reference returns the recorded symbolic graph; here tape has no Symbol
+    form — use HybridBlock.export for graph capture."""
+    raise NotImplementedError(
+        "get_symbol is not supported on the TPU tape; hybridize instead")
+
+
+class Function:
+    """User-defined differentiable function (custom VJP).
+
+    Reference: python/mxnet/autograd.py:368 Function with forward/backward
+    overrides, backed by c_api_function.cc. Here the backward override is
+    installed as the tape node's vjp closure directly.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *output_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _wrap
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            array_inputs = [a for a in inputs if isinstance(a, NDArray)]
+
+            def vjp_fn(cotangents, _self=self, _single=single):
+                cots = (cotangents,) if _single else tuple(cotangents)
+                with pause():
+                    igrads = _self.backward(*[_wrap(c) for c in cots])
+                if isinstance(igrads, NDArray):
+                    igrads = [igrads]
+                return [g.data if isinstance(g, NDArray) else g for g in igrads]
+
+            _record_op(vjp_fn, array_inputs, outs)
+        return outs[0] if single else outs

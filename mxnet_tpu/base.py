@@ -1,0 +1,63 @@
+"""Base utilities for mxnet_tpu.
+
+TPU-native rebuild of MXNet's base layer. The reference exposes a C ABI with
+per-thread error strings (reference: python/mxnet/base.py, src/c_api/); here
+errors are plain Python exceptions and the "registry" (reference:
+3rdparty/tvm/nnvm op registry consumed via include/mxnet/base.h:35) is a
+Python-level op table that autogenerates the `mx.nd.*` namespaces
+(reference: python/mxnet/base.py:581 `_init_op_module`).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["MXNetError", "numeric_types", "integer_types", "string_types"]
+
+
+class MXNetError(RuntimeError):
+    """Default error thrown by mxnet_tpu functions.
+
+    Mirrors mxnet.base.MXNetError (reference: python/mxnet/base.py:87).
+    """
+
+
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+string_types = (str,)
+
+
+def check_call(ret):  # pragma: no cover - compat shim, no C ABI here
+    """Compat shim for reference code written against the C ABI."""
+    if ret:
+        raise MXNetError(str(ret))
+
+
+_registry = {}
+
+
+def registry(kind):
+    """Get (creating if needed) a named registry dict.
+
+    The reference uses dmlc::Registry for ops/iterators/optimizers
+    (reference: include/mxnet/base.h:28-36 via dmlc-core); here a dict.
+    """
+    return _registry.setdefault(kind, {})
+
+
+def register_entry(kind, name, obj, override=False):
+    reg = registry(kind)
+    key = name.lower()
+    if key in reg and not override:
+        raise ValueError(f"{kind} '{name}' already registered")
+    reg[key] = obj
+    return obj
+
+
+def lookup_entry(kind, name):
+    reg = registry(kind)
+    key = name.lower()
+    if key not in reg:
+        raise ValueError(
+            f"{kind} '{name}' not registered. Registered: {sorted(reg)}"
+        )
+    return reg[key]

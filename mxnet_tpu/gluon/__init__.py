@@ -1,0 +1,16 @@
+"""Gluon imperative API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError", "Block", "HybridBlock",
+           "SymbolBlock", "CachedOp", "nn", "loss", "Trainer", "utils",
+           "data", "rnn", "model_zoo"]

@@ -1,0 +1,453 @@
+"""Gluon Block / HybridBlock / CachedOp.
+
+TPU-native redesign of python/mxnet/gluon/block.py (reference: Block:228
+child registry + collect_params:372; HybridBlock:838 deferred symbolic
+trace, _build_cache:932 → CachedOp:969, hybridize:1039, export:1077) and
+src/imperative/cached_op.{h,cc}.
+
+Design: because every registered op body is traceable JAX, hybridization
+does NOT need a separate symbolic language — ``hybridize()`` wraps the
+block's imperative ``forward`` into a pure function over (param values,
+PRNG key, inputs) and compiles it with ``jax.jit``. Parameter mutation
+during forward (BatchNorm running stats) is detected at trace time and
+returned as extra outputs, then written back — giving MXNet's stateful
+semantics on a functional runtime. Under ``autograd.record`` the CachedOp
+contributes ONE tape node whose vjp is the XLA-compiled transpose, exactly
+like the reference records one node for the whole cached graph
+(cached_op.cc Forward with recording).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import autograd
+from .. import random as mxrandom
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self.current = None
+        self.counters = {}
+
+
+_SCOPE = _BlockScope()
+
+
+def _gen_prefix(hint):
+    if _SCOPE.current is None:
+        counters = _SCOPE.counters
+        base = ""
+    else:
+        counters = _SCOPE.current._counters
+        base = _SCOPE.current.prefix
+    idx = counters.get(hint, 0)
+    counters[hint] = idx + 1
+    return f"{base}{hint}{idx}_"
+
+
+class _NameScope:
+    def __init__(self, block):
+        self._block = block
+        self._old = None
+
+    def __enter__(self):
+        self._old = _SCOPE.current
+        _SCOPE.current = self._block
+        return self
+
+    def __exit__(self, *exc):
+        _SCOPE.current = self._old
+
+
+class Block:
+    """Base building block (reference: gluon/block.py:228)."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = type(self).__name__.lower()
+        self._prefix = prefix if prefix is not None else _gen_prefix(hint)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._counters = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(f"  ({key}): {block!r}"
+                           for key, block in self._children.items())
+        return s.format(name=type(self).__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                self._children[name] = value
+        elif isinstance(value, Parameter):
+            if hasattr(self, "_reg_params"):
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    def name_scope(self):
+        """Reference: gluon/block.py name_scope."""
+        return _NameScope(self)
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """Reference: gluon/block.py:372 collect_params with regex select."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer
+
+        self.collect_params().initialize(init or initializer.Uniform(), ctx,
+                                         verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structure-based parameter names ("0.weight", "body.1.bias") so
+        checkpoints are independent of name-counter state
+        (reference: gluon/block.py _collect_params_with_prefix)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference: gluon/block.py:416."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val.data() for key, val in params.items()
+                    if val._ndarray is not None}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """Reference: gluon/block.py:472."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise IOError(f"Parameter '{name}' is missing in file "
+                                  f"'{filename}'")
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise IOError(f"Parameter '{name}' loaded from file "
+                                  f"'{filename}' is not present in Block")
+                continue
+            params[name]._load_init_from(loaded[name])
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a parameter/shape summary (reference: gluon/block.py
+        summary)."""
+        rows = []
+
+        def walk(block, indent=0):
+            n_params = sum(p.data().size for p in block._reg_params.values()
+                           if p._ndarray is not None)
+            rows.append("  " * indent + f"{type(block).__name__}"
+                        f" ({block.name}): {n_params} params")
+            for c in block._children.values():
+                walk(c, indent + 1)
+
+        walk(self)
+        print("\n".join(rows))
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class CachedOp:
+    """jit-compiled replay of a block's forward
+    (reference: src/imperative/cached_op.{h,cc}; flags static_alloc etc. map
+    to XLA donation/caching which jit already provides)."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 inline_limit=2, forward_bulk_size=None,
+                 backward_bulk_size=None):
+        self._block = block
+        self._param_list = None  # list[Parameter], fixed order
+        self._out_treedefs = {}
+        self._jitted = jax.jit(self._pure, static_argnums=(0,))
+
+    def _ensure_params(self):
+        if self._param_list is None:
+            self._param_list = [p for _, p in
+                                sorted(self._block.collect_params().items())]
+        return self._param_list
+
+    def _pure(self, train, param_vals, key, input_datas):
+        params = self._ensure_params()
+        pnds = [p._ndarray for p in params]
+        saved = [p._data for p in pnds]
+        try:
+            for p, v in zip(pnds, param_vals):
+                p._data = v
+            with autograd.pause(train_mode=train), mxrandom.key_provider(key):
+                args = [NDArray(d) for d in input_datas]
+                outs = self._block.forward(*args)
+            flat, treedef = _flatten_outputs(outs)
+            self._out_treedefs[bool(train)] = treedef
+            mutated = {str(i): p._data for i, (p, v) in
+                       enumerate(zip(pnds, param_vals)) if p._data is not v}
+            return tuple(o.data for o in flat), mutated
+        finally:
+            for p, v in zip(pnds, saved):
+                p._data = v
+
+    def __call__(self, *args):
+        params = self._ensure_params()
+        # finish any deferred init with one throwaway eager pass
+        if any(p._ndarray is None for p in params):
+            with autograd.pause(train_mode=autograd.is_training()):
+                self._block.forward(*args)
+            self._param_list = None
+            params = self._ensure_params()
+        pnds = [p._ndarray for p in params]
+        param_vals = [p._data for p in pnds]
+        input_datas = [a.data for a in args]
+        key = mxrandom.next_key()
+        train = autograd.is_training()
+
+        if autograd.is_recording():
+            (out_datas, mutated), vjp_fn, = _vjp2(
+                lambda pv, iv: self._jitted(train, pv, key, iv),
+                param_vals, input_datas)
+            outs = [NDArray(d) for d in out_datas]
+
+            def tape_vjp(cotangents, _vjp=vjp_fn, _n=len(out_datas)):
+                cots = (cotangents,) if _n == 1 else tuple(cotangents)
+                pv_grads, iv_grads = _vjp(cots)
+                return list(pv_grads) + list(iv_grads)
+
+            autograd._record_op(tape_vjp, pnds + list(args), outs)
+        else:
+            out_datas, mutated = self._jitted(train, param_vals, key,
+                                              input_datas)
+            outs = [NDArray(d) for d in out_datas]
+        for i_str, val in mutated.items():
+            pnds[int(i_str)]._data = val
+        treedef = self._out_treedefs.get(bool(train))
+        return _unflatten_outputs(outs, treedef)
+
+
+def _vjp2(fn, pv, iv):
+    out, vjp_fn, aux = jax.vjp(fn, pv, iv, has_aux=True)
+    return (out, aux), vjp_fn
+
+
+def _flatten_outputs(outs):
+    if isinstance(outs, NDArray):
+        return [outs], "single"
+    if isinstance(outs, (list, tuple)):
+        flat = []
+        spec = []
+        for o in outs:
+            if isinstance(o, NDArray):
+                flat.append(o)
+                spec.append(1)
+            else:
+                sub = list(o)
+                flat.extend(sub)
+                spec.append(len(sub))
+        return flat, ("seq", type(outs).__name__, spec)
+    raise MXNetError(f"unsupported forward output type {type(outs)}")
+
+
+def _unflatten_outputs(flat, treedef):
+    if treedef == "single" or treedef is None:
+        return flat[0] if len(flat) == 1 else tuple(flat)
+    _, typ, spec = treedef
+    out = []
+    i = 0
+    for n in spec:
+        if n == 1:
+            out.append(flat[i])
+        else:
+            out.append(tuple(flat[i:i + n]))
+        i += n
+    return tuple(out) if typ == "tuple" else out
+
+
+class HybridBlock(Block):
+    """Block that can be compiled (reference: gluon/block.py:838)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._cached_op_args = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Reference: gluon/block.py:1039. Compilation == jax.jit."""
+        self._active = active
+        self._cached_op = None
+        self._cached_op_args = dict(static_alloc=static_alloc,
+                                    static_shape=static_shape, **kwargs)
+        super().hybridize(active=False)  # only the outermost block compiles
+
+    def _build_cache(self):
+        self._cached_op = CachedOp(self, **self._cached_op_args)
+
+    def infer_shape(self, *args):
+        """Finish deferred param init from example inputs."""
+        with autograd.pause():
+            self.forward(*args)
+
+    def cast(self, dtype):
+        super().cast(dtype)
+        self._cached_op = None
+
+    def __call__(self, *args, **kwargs):
+        if self._active and not kwargs:
+            if all(isinstance(a, NDArray) for a in args):
+                if self._cached_op is None:
+                    self._build_cache()
+                for hook in self._forward_pre_hooks:
+                    hook(self, args)
+                out = self._cached_op(*args)
+                for hook in self._forward_hooks:
+                    hook(self, args, out)
+                return out
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, x, *args):
+        """Dispatch to hybrid_forward with params as kwargs
+        (reference: gluon/block.py:1127)."""
+        params = {}
+        for name, param in self._reg_params.items():
+            try:
+                params[name] = param.data()
+            except DeferredInitializationError:
+                self._infer_param_shapes(x, *args)
+                params[name] = param.data()
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _infer_param_shapes(self, x, *args):
+        """Layers override `infer_param_shapes(x)`; generic fallback errors."""
+        infer = getattr(self, "infer_param_shapes", None)
+        if infer is None:
+            raise DeferredInitializationError(
+                f"{type(self).__name__} has deferred parameters and no "
+                "shape-inference hook; call initialize() with known shapes")
+        infer(x, *args)
+        for p in self._reg_params.values():
+            if p._ndarray is None and p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Reference: gluon/block.py:1077 export → symbol json + params.
+        Here: params file + a jax-jittable forward; symbol json export comes
+        with the symbolic layer."""
+        fname = f"{path}-{epoch:04d}.params"
+        self.save_parameters(fname)
+        return fname
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize()
+        return self(x, *args)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a symbol graph (reference: gluon/block.py:1190).
+    Implemented with the symbolic layer (mxnet_tpu.symbol)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym
+
+        outputs = sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym.var(n) for n in input_names]
+        ret = SymbolBlock(outputs, inputs)
+        if param_file is not None:
+            ret.load_parameters(param_file)
+        return ret
+
+    def forward(self, *args):
+        from .. import symbol as sym
+
+        feed = {i.name: a for i, a in zip(self._inputs, args)}
+        for name, p in self.collect_params().items():
+            feed[name] = p.data()
+        return self._outputs.eval_with(feed)

@@ -1,0 +1,94 @@
+"""DataLoader.
+
+TPU-native equivalent of python/mxnet/gluon/data/dataloader.py (reference:
+DataLoader with multiprocessing workers + shared-memory NDArray pickling
+:28-156, worker_loop :207). On TPU hosts the loader uses a thread pool:
+decode/augment releases the GIL inside numpy/PIL, and batches transfer to
+HBM asynchronously, which fills the same role as the reference's fork-based
+workers + CPUSharedStorageManager without cross-process NDArray plumbing.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as onp
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py
+    default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    """Reference: dataloader.py DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=True, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * max(num_workers, 1))
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = ThreadPoolExecutor(max_workers=max(num_workers, 1)) \
+            if num_workers > 0 else None
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_indices in self._batch_sampler:
+                yield self._make_batch(batch_indices)
+            return
+        # pipelined prefetch through the thread pool
+        futures = []
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch):
+                futures.append(self._pool.submit(self._make_batch, next(it)))
+        except StopIteration:
+            pass
+        while futures:
+            batch = futures.pop(0).result()
+            try:
+                futures.append(self._pool.submit(self._make_batch, next(it)))
+            except StopIteration:
+                pass
+            yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,202 @@
+"""Vision datasets.
+
+Reference: python/mxnet/gluon/data/vision/datasets.py (MNIST/FashionMNIST/
+CIFAR10/CIFAR100/ImageRecordDataset/ImageFolderDataset). This environment
+has no network egress: datasets read standard local files when present
+(idx-ubyte for MNIST, python pickles for CIFAR) and otherwise synthesize
+deterministic random data of the right shape so pipelines/tests run
+hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset", "ImageRecordDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        ndim = magic[2]
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(shape)
+
+
+class MNIST(_DownloadedDataset):
+    """Reference: datasets.py MNIST."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+    _synth_n = 512
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_f, lbl_f = self._train_files if self._train else self._test_files
+        img_path = os.path.join(self._root, img_f)
+        lbl_path = os.path.join(self._root, lbl_f)
+        found = None
+        for suffix in ("", ".gz"):
+            if os.path.exists(img_path + suffix):
+                found = suffix
+                break
+        if found is not None:
+            data = _read_idx(img_path + found).reshape(-1, 28, 28, 1)
+            label = _read_idx(lbl_path + found).astype(onp.int32)
+        else:
+            rng = onp.random.RandomState(42 if self._train else 7)
+            data = (rng.rand(self._synth_n, 28, 28, 1) * 255).astype(onp.uint8)
+            label = rng.randint(0, 10, self._synth_n).astype(onp.int32)
+        self._data = nd.array(data, dtype=onp.uint8)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"), train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """Reference: datasets.py CIFAR10 (python pickle batches)."""
+
+    _synth_n = 512
+    _nclass = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        import pickle
+
+        files = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        paths = [os.path.join(self._root, "cifar-10-batches-py", f)
+                 for f in files]
+        if all(os.path.exists(p) for p in paths):
+            datas, labels = [], []
+            for p in paths:
+                with open(p, "rb") as f:
+                    batch = pickle.load(f, encoding="latin1")
+                datas.append(onp.asarray(batch["data"]).reshape(
+                    -1, 3, 32, 32).transpose(0, 2, 3, 1))
+                labels.extend(batch["labels" if "labels" in batch
+                                    else "fine_labels"])
+            data = onp.concatenate(datas).astype(onp.uint8)
+            label = onp.asarray(labels, dtype=onp.int32)
+        else:
+            rng = onp.random.RandomState(13 if self._train else 31)
+            data = (rng.rand(self._synth_n, 32, 32, 3) * 255).astype(onp.uint8)
+            label = rng.randint(0, self._nclass, self._synth_n).astype(onp.int32)
+        self._data = nd.array(data, dtype=onp.uint8)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    _nclass = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"), fine_label=False,
+                 train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+
+class ImageFolderDataset(Dataset):
+    """Reference: datasets.py ImageFolderDataset (one folder per class)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from .... import image
+
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """Reference: datasets.py ImageRecordDataset over .rec files."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from .... import recordio
+
+        self._flag = flag
+        self._transform = transform
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        from .... import image, recordio
+
+        record = self._record.read_idx(self._record.keys[idx])
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record.keys)

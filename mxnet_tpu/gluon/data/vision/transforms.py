@@ -1,0 +1,194 @@
+"""Vision transforms (reference:
+python/mxnet/gluon/data/vision/transforms.py). Operate on HWC uint8/float
+NDArrays like the reference; heavy augmentation runs as registered image
+ops so it can execute on device when fused into the input pipeline.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Reference: transforms.py Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: image/to_tensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32).reshape(-1, 1, 1)
+        self._std = onp.asarray(std, dtype=onp.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        return (x - nd.array(self._mean)) / nd.array(self._std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        import jax.image
+
+        h, w = self._size[1], self._size[0]
+        if x.ndim == 3:
+            out = jax.image.resize(x.data.astype("float32"),
+                                   (h, w, x.shape[2]), method="linear")
+        else:
+            out = jax.image.resize(x.data.astype("float32"),
+                                   (x.shape[0], h, w, x.shape[3]),
+                                   method="linear")
+        return nd.from_jax(out.astype(x.data.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    """Reference: transforms.py RandomResizedCrop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import math
+        import random as pyrandom
+
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = pyrandom.uniform(*self._scale) * area
+            log_ratio = (math.log(self._ratio[0]), math.log(self._ratio[1]))
+            aspect = math.exp(pyrandom.uniform(*log_ratio))
+            w = int(round(math.sqrt(target_area * aspect)))
+            h = int(round(math.sqrt(target_area / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                x0 = pyrandom.randint(0, W - w)
+                y0 = pyrandom.randint(0, H - h)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return Resize(self._size)(crop)
+        return Resize(self._size)(CenterCrop(min(H, W))(x))
+
+
+class _RandomFlip(Block):
+    _axis = 1
+
+    def forward(self, x):
+        import random as pyrandom
+
+        if pyrandom.random() < 0.5:
+            return nd.flip(x, axis=self._axis)
+        return x
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    _axis = 1
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    _axis = 0
+
+
+class _RandomJitter(Block):
+    def __init__(self, val):
+        super().__init__()
+        self._val = val
+
+    def _alpha(self):
+        import random as pyrandom
+
+        return 1.0 + pyrandom.uniform(-self._val, self._val)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        return nd.clip(x.astype("float32") * self._alpha(), 0, 255).astype(
+            x.dtype) if x.dtype == onp.uint8 else x * self._alpha()
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        alpha = self._alpha()
+        f = x.astype("float32")
+        gray = nd.mean(f)
+        out = f * alpha + gray * (1 - alpha)
+        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
+            else out
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        alpha = self._alpha()
+        f = x.astype("float32")
+        coef = nd.array(onp.array([0.299, 0.587, 0.114], dtype=onp.float32))
+        gray = nd.sum(f * coef.reshape((1, 1, 3)), axis=2, keepdims=True)
+        out = f * alpha + gray * (1 - alpha)
+        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
+            else out
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: transforms.py RandomLighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], dtype=onp.float32)
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=onp.float32)
+
+    def __init__(self, alpha_std=0.05):
+        super().__init__()
+        self._alpha_std = alpha_std
+
+    def forward(self, x):
+        alpha = onp.random.normal(0, self._alpha_std, 3).astype(onp.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        f = x.astype("float32") + nd.array(rgb.reshape(1, 1, 3))
+        return nd.clip(f, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
+            else f

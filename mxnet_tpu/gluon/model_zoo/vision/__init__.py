@@ -1,0 +1,23 @@
+"""Vision model zoo (reference:
+python/mxnet/gluon/model_zoo/vision/__init__.py get_model:91)."""
+from . import resnet as _resnet
+from . import alexnet as _alexnet
+
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+
+_models = {}
+for _mod in (_resnet, _alexnet):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower():
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Reference: vision/__init__.py:91."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"Model {name} is not supported. Available: {sorted(_models)}")
+    return _models[name](**kwargs)

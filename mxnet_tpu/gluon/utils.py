@@ -1,0 +1,6 @@
+"""Gluon utils (reference: python/mxnet/gluon/utils.py)."""
+from ..utils import (split_data, split_and_load, clip_global_norm, check_sha1,
+                     download)
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]

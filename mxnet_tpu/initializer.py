@@ -1,0 +1,264 @@
+"""Weight initializers.
+
+TPU-native equivalent of python/mxnet/initializer.py (reference: Uniform,
+Normal, Xavier, MSRAPrelu, Orthogonal, Bilinear, One, Zero, Constant,
+LSTMBias; registry + InitDesc pattern-matching by name).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as onp
+
+from .base import register_entry, lookup_entry
+
+__all__ = ["Initializer", "Uniform", "Normal", "Xavier", "MSRAPrelu", "One",
+           "Zero", "Constant", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register", "create"]
+
+
+class InitDesc(str):
+    """Name (+attrs) describing the parameter being initialized
+    (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+def register(klass):
+    register_entry("initializer", klass.__name__, klass, override=True)
+    return klass
+
+
+_ALIASES = {"zeros": "zero", "ones": "one"}
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return lookup_entry("initializer", _ALIASES.get(name, name))(**kwargs)
+
+
+class Initializer:
+    """Base init; dispatches on parameter-name suffix like the reference
+    (reference: initializer.py Initializer.__call__:155-200)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            desc = InitDesc(str(desc))
+        init_attr = getattr(desc, "attrs", {}).get("__init__", "")
+        if init_attr:
+            create(init_attr)._init_impl(desc, arr)
+        elif desc.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif desc.endswith("running_mean") or desc.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("running_var") or desc.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif desc.endswith("min") or desc.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_impl(self, desc, arr):
+        self.__call__(desc, arr)
+
+    def _set(self, arr, value):
+        from . import ndarray as nd
+
+        arr._data = nd.array(value, dtype=arr.dtype).data
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError("virtual _init_weight")
+
+    def _init_bias(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_gamma(self, desc, arr):
+        self._init_one(desc, arr)
+
+    def _init_beta(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, onp.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, onp.ones(arr.shape))
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+def _np_rng():
+    from . import random as mxrandom
+    import jax
+
+    key = mxrandom.next_key()
+    seed = int(jax.random.randint(key, (), 0, 2 ** 31 - 1))
+    return onp.random.RandomState(seed)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np_rng().uniform(-self.scale, self.scale, arr.shape))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np_rng().normal(0, self.sigma, arr.shape))
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(desc, arr)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(desc, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, onp.full(arr.shape, self.value))
+
+
+@register
+class Xavier(Initializer):
+    """Reference: initializer.py Xavier (rnd_type/factor_type/magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {desc}")
+        if len(shape) > 2:
+            hw_scale = onp.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, _np_rng().uniform(-scale, scale, shape))
+        else:
+            self._set(arr, _np_rng().normal(0, scale, shape))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        rng = _np_rng()
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.normal(0.0, 1.0, (nout, nin))
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = onp.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = onp.zeros(arr.shape)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError(f"parameter {desc} did not match any pattern")

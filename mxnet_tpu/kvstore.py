@@ -1,0 +1,191 @@
+"""KVStore: parameter aggregation / broadcast.
+
+TPU-native redesign of src/kvstore/ (reference: kvstore.cc:40-73 Create,
+kvstore_local.h PushImpl:206-226, comm.h CommCPU/CommDevice, kvstore_nccl.h,
+kvstore_dist.h). The reference moves gradients through explicit reduce
+machinery (CPU tree / GPU P2P / NCCL / ps-lite). On TPU the same user API
+is kept but aggregation is executed by XLA:
+
+- ``local`` / ``device`` — single-process aggregation: the summed reduce is
+  one fused XLA add chain on device (the analog of CommDevice's NCCL-free
+  reduce). With a sharded mesh, `mxnet_tpu.parallel` lowers the same
+  push/pull semantics to psum over ICI inside the compiled step.
+- ``dist_sync`` / ``dist_device_sync`` — multi-host: collectives over
+  ICI/DCN via jax.distributed + `parallel.all_reduce` replace ps-lite
+  workers/servers; `set_optimizer` (server-side update,
+  kvstore_dist_server.h:346 ApplyUpdates) runs the optimizer on the
+  aggregated value exactly once per key, preserving update_on_kvstore
+  semantics.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """Reference: include/mxnet/kvstore.h:59-438."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._update_on_kvstore = True
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Worker rank (reference kvstore.h:365). Multi-host: process index."""
+        if self._type.startswith("dist"):
+            try:
+                import jax
+
+                return jax.process_index()
+            except Exception:
+                return 0
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._type.startswith("dist"):
+            try:
+                import jax
+
+                return jax.process_count()
+            except Exception:
+                return 1
+        return 1
+
+    def _normalize(self, key, value):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        if single:
+            values = [value]
+        else:
+            values = list(value)
+        return keys, values, single
+
+    def init(self, key, value):
+        keys, values, _ = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k in self._store:
+                continue
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate (sum over the device group) then apply updater if set
+        (reference: kvstore_local.h:206 PushImpl → Comm reduce → updater_)."""
+        keys, values, _ = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if isinstance(v, (list, tuple)):
+                agg = v[0]
+                for x in v[1:]:
+                    agg = agg + x
+            else:
+                agg = v
+            if self._type.startswith("dist"):
+                from . import parallel
+
+                agg = parallel.all_reduce(agg)
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not initialized")
+            if self._updater is not None:
+                self._updater(_key_to_int(k), agg, self._store[k])
+            else:
+                self._store[k]._data = (self._store[k] + agg).data
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs, _ = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not initialized")
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = src.data.astype(t.data.dtype)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference: kvstore.h PushPull)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        keys, outs, _ = self._normalize(key, out)
+        rids, _, _ = self._normalize(key, row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            k = str(k)
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            rows = r if isinstance(r, (list, tuple)) else [r] * len(targets)
+            for t, rid in zip(targets, rows):
+                t._data = nd.take(src, rid, axis=0).data
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Server-side optimizer (reference: kvstore.py set_optimizer pickles
+        to servers; here the updater runs on the aggregated value in-process,
+        sharded across hosts by the parallel layer)."""
+        from . import optimizer as opt
+
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def barrier(self):
+        """Reference: kvstore.h:391 Barrier. Multi-host: a psum sync."""
+        if self._type.startswith("dist") and self.num_workers > 1:
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("kvstore_barrier")
+            except Exception:
+                pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer is set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer is set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_to_int(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+_VALID = ("local", "device", "nccl", "dist_sync", "dist_async",
+          "dist_device_sync")
+
+
+def create(name="local"):
+    """Reference: src/kvstore/kvstore.cc:40-73 KVStore::Create."""
+    if name not in _VALID:
+        raise MXNetError(f"unknown kvstore type {name}")
+    return KVStore(name)

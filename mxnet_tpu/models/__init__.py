@@ -1,0 +1,8 @@
+"""Model families (flagships of the TPU build).
+
+Re-exports the Gluon model zoo (reference:
+python/mxnet/gluon/model_zoo/vision/) plus TPU-first training entry points.
+"""
+from ..gluon.model_zoo import vision, get_model
+
+__all__ = ["vision", "get_model"]

@@ -1,0 +1,532 @@
+"""NDArray: MXNet's imperative array on the JAX/XLA runtime.
+
+TPU-native redesign of the reference NDArray (reference:
+include/mxnet/ndarray.h, src/ndarray/ndarray.cc, python/mxnet/ndarray/
+ndarray.py). Where the reference pairs a Storage chunk with a dependency-
+engine variable for async ordering, here the payload is a ``jax.Array``:
+XLA's async dispatch already gives the "lazy op, sync on read" semantics
+(``WaitToRead`` == ``block_until_ready``, reference ndarray.h:368).
+Mutation (``+=``, ``__setitem__``) is functional under the hood — the handle
+swaps to a new jax.Array (``x.at[idx].set``) — which preserves MXNet's
+user-visible in-place semantics while staying traceable under ``jax.jit``
+(so hybridized blocks can mutate BatchNorm running stats during trace).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, numeric_types
+from ..context import Context, current_context
+from . import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "save", "load", "concatenate",
+           "waitall", "from_jax", "moveaxis"]
+
+_DTYPE_ALIASES = {
+    "float16": jnp.float16, "float32": jnp.float32, "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+}
+
+
+def _canon_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPE_ALIASES.get(dtype, onp.dtype(dtype))
+    return dtype
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """An n-dimensional array with MXNet semantics, backed by jax.Array."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_ag_marked", "__weakref__")
+
+    def __init__(self, data):
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_marked = False
+
+    # ---- core properties -------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def stype(self):
+        """Storage type; dense only for now (reference ndarray.h:61-65 adds
+        row_sparse/csr — see mxnet_tpu.ndarray.sparse)."""
+        return "default"
+
+    @property
+    def context(self):
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # ---- sync / host transfer -------------------------------------------
+    def wait_to_read(self):
+        """Block until value ready (reference ndarray.h:368 WaitToRead)."""
+        if not _is_tracer(self._data):
+            jax.block_until_ready(self._data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        if _is_tracer(self._data):
+            raise MXNetError("asnumpy() inside a traced (hybridized) region")
+        return onp.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray-tracer {self.shape} @{self._data}>"
+        return "\n%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(d) for d in self.shape), self.context)
+
+    # ---- conversions ------------------------------------------------------
+    def astype(self, dtype, copy=True):
+        dtype = _canon_dtype(dtype)
+        if not copy and self._data.dtype == dtype:
+            return self
+        return _invoke1("cast", self, dtype=dtype)
+
+    def copyto(self, other):
+        """Reference: ndarray.py copyto / CopyFromTo (src/ndarray/ndarray.cc)."""
+        if isinstance(other, NDArray):
+            other._data = jnp.asarray(self._data, other._data.dtype)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device))
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return NDArray(jnp.array(self._data, copy=True))
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+
+    def asnative(self):
+        return self._data
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # ---- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Attach a gradient buffer (reference: ndarray.py attach_grad)."""
+        from .. import autograd
+
+        grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        autograd.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ---- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        key = _unwrap_index(key)
+        return _invoke1("_slice_take", self, key=key) if _index_has_array(key) \
+            else _invoke1("_static_slice", self, key=key)
+
+    def __setitem__(self, key, value):
+        from .. import autograd
+
+        if autograd.is_recording():
+            raise MXNetError(
+                "NDArray.__setitem__ is not supported when recording with "
+                "autograd (in-place writes cannot be taped)")
+        key = _unwrap_index(key)
+        if isinstance(value, NDArray):
+            value = value.data
+        self._data = self._data.at[key].set(value)
+
+    # ---- operators (dispatch through the op registry for tape support) ---
+    def _binop(self, name, other, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke1(name, a, b)
+        if isinstance(other, numeric_types):
+            # keep python ints intact (exact jnp.power for integer exponents)
+            return _invoke1(name + "_scalar", self, scalar=other,
+                            reverse=reverse)
+        if isinstance(other, (onp.ndarray, list, tuple)):
+            other = array(other, dtype=self._data.dtype)
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke1(name, a, b)
+        return NotImplemented
+
+    def __add__(self, o): return self._binop("broadcast_add", o)
+    def __radd__(self, o): return self._binop("broadcast_add", o, True)
+    def __sub__(self, o): return self._binop("broadcast_sub", o)
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o)
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, True)
+    def __truediv__(self, o): return self._binop("broadcast_div", o)
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, True)
+    def __mod__(self, o): return self._binop("broadcast_mod", o)
+    def __rmod__(self, o): return self._binop("broadcast_mod", o, True)
+    def __pow__(self, o): return self._binop("broadcast_power", o)
+    def __rpow__(self, o): return self._binop("broadcast_power", o, True)
+    def __matmul__(self, o): return self._binop("_matmul", o)
+
+    def __neg__(self): return _invoke1("negative", self)
+    def __abs__(self): return _invoke1("abs", self)
+
+    def __eq__(self, o): return self._binop("broadcast_equal", o)
+    def __ne__(self, o): return self._binop("broadcast_not_equal", o)
+    def __lt__(self, o): return self._binop("broadcast_lesser", o)
+    def __le__(self, o): return self._binop("broadcast_lesser_equal", o)
+    def __gt__(self, o): return self._binop("broadcast_greater", o)
+    def __ge__(self, o): return self._binop("broadcast_greater_equal", o)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: swap the handle (functional under the hood). Disallowed
+    # while recording, matching the reference's autograd semantics
+    # (reference: python/mxnet/ndarray/ndarray.py __iadd__ raises when
+    # recording) — the tape cannot alias a mutated output.
+    def _inplace(self, opname, o):
+        from .. import autograd
+
+        if autograd.is_recording():
+            raise MXNetError(
+                "Inplace operations (+=, -=, *=, /=) are not supported "
+                "when recording with autograd")
+        r = self._binop(opname, o)
+        self._data = r.data
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace("broadcast_add", o)
+
+    def __isub__(self, o):
+        return self._inplace("broadcast_sub", o)
+
+    def __imul__(self, o):
+        return self._inplace("broadcast_mul", o)
+
+    def __itruediv__(self, o):
+        return self._inplace("broadcast_div", o)
+
+    @property
+    def T(self):
+        return _invoke1("transpose", self)
+
+    # a generous set of mxnet NDArray methods, all dispatching to ops
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _invoke1("reshape", self, shape=shape)
+
+    def reshape_like(self, other):
+        return _invoke1("reshape", self, shape=other.shape)
+
+    def flatten(self):
+        return _invoke1("flatten", self)
+
+    def transpose(self, axes=None):
+        return _invoke1("transpose", self, axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke1("swapaxes", self, dim1=dim1, dim2=dim2)
+
+    def expand_dims(self, axis):
+        return _invoke1("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return _invoke1("squeeze", self, axis=axis)
+
+    def broadcast_to(self, shape):
+        return _invoke1("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return _invoke1("broadcast_to", self, shape=other.shape)
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke1("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke1("take", self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return _invoke1("one_hot", self, depth=depth, on_value=on_value,
+                        off_value=off_value, dtype=dtype)
+
+    # reduce-style methods (populated programmatically below for the rest)
+    def sum(self, axis=None, keepdims=False, exclude=False):
+        return _invoke1("sum", self, axis=axis, keepdims=keepdims,
+                        exclude=exclude)
+
+    def mean(self, axis=None, keepdims=False, exclude=False):
+        return _invoke1("mean", self, axis=axis, keepdims=keepdims,
+                        exclude=exclude)
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke1("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke1("min", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke1("prod", self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return _invoke1("argmax", self, axis=axis)
+
+    def argmin(self, axis=None):
+        return _invoke1("argmin", self, axis=axis)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke1("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke1("clip", self, a_min=a_min, a_max=a_max)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse
+
+        return sparse.cast_storage(self, stype)
+
+
+# unary op methods generated from the registry (mxnet NDArray method parity)
+def _install_unary_methods():
+    for name in ("abs", "exp", "expm1", "log", "log1p", "log10", "log2",
+                 "sqrt", "rsqrt", "square", "cbrt", "rcbrt", "reciprocal",
+                 "sign", "round", "rint", "ceil", "floor", "trunc", "fix",
+                 "relu", "sigmoid", "tanh", "softmax", "log_softmax", "sin",
+                 "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+                 "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+                 "erf", "erfinv", "gamma", "gammaln"):
+        if hasattr(NDArray, name):
+            continue
+
+        def method(self, *args, _name=name, **kwargs):
+            return _invoke1(_name, self, *args, **kwargs)
+
+        method.__name__ = name
+        setattr(NDArray, name, method)
+
+
+_install_unary_methods()
+
+
+# small helper so methods can dispatch without importing the populated module
+def _invoke1(opname, *args, **kwargs):
+    opdef = _reg.get_op(opname)
+    if opdef is None:
+        raise MXNetError(f"op '{opname}' not registered")
+    return _reg.invoke(opdef, args, kwargs)
+
+
+def _wrap(x):
+    return NDArray(x)
+
+
+def from_jax(x):
+    """Wrap a raw jax.Array as an NDArray (zero-copy)."""
+    return NDArray(jnp.asarray(x))
+
+
+def _unwrap_index(key):
+    if isinstance(key, NDArray):
+        return key.data
+    if isinstance(key, tuple):
+        return tuple(_unwrap_index(k) for k in key)
+    return key
+
+
+def _index_has_array(key):
+    if isinstance(key, (jax.Array, onp.ndarray)):
+        return True
+    if isinstance(key, tuple):
+        return any(_index_has_array(k) for k in key)
+    return False
+
+
+# ---- creation ------------------------------------------------------------
+
+def _put(data, ctx):
+    if ctx is None:
+        ctx = current_context()
+    try:
+        return jax.device_put(data, ctx.jax_device)
+    except Exception:
+        return data
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference: ndarray.py array)."""
+    if isinstance(source_array, NDArray):
+        source_array = source_array.data
+    dtype = _canon_dtype(dtype)
+    if dtype is None:
+        if isinstance(source_array, (onp.ndarray, jax.Array)):
+            dtype = source_array.dtype
+            if dtype == onp.float64:
+                dtype = onp.float32  # mxnet default_dtype is float32
+        else:
+            # python lists/scalars default to float32 like the reference
+            dtype = onp.float32
+    data = jnp.asarray(source_array, dtype=dtype)
+    return NDArray(_put(data, ctx))
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(jnp.zeros(shape, _canon_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(jnp.ones(shape, _canon_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(jnp.full(shape, val, _canon_dtype(dtype)), ctx))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    arr = jnp.arange(start, stop, step, _canon_dtype(dtype))
+    if repeat != 1:
+        arr = jnp.repeat(arr, repeat)
+    return NDArray(_put(arr, ctx))
+
+
+def zeros_like(other):
+    return NDArray(jnp.zeros_like(other.data))
+
+
+def ones_like(other):
+    return NDArray(jnp.ones_like(other.data))
+
+
+def moveaxis(data, source, destination):
+    return NDArray(jnp.moveaxis(data.data, source, destination))
+
+
+def concatenate(arrays, axis=0):
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis))
+
+
+def waitall():
+    """Block until all async computation completes (reference:
+    Engine::WaitForAll via MXNDArrayWaitAll). XLA orders execution per
+    device stream, so syncing a fresh trivial computation drains the queue."""
+    try:
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+# ---- serialization (reference: ndarray.h:404-416 Save/Load; mx.nd.save) --
+
+def save(fname, data):
+    """Save list or dict of NDArrays. Uses an npz container rather than the
+    reference's magic-versioned binary (reference src/ndarray/ndarray.cc),
+    but preserves the list/dict API of mx.nd.save."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"__list__:{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {f"__dict__:{k}": v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    with open(fname, "wb") as f:
+        onp.savez(f, **payload)
+
+
+def load(fname):
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and keys[0].startswith("__list__:"):
+            items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
+            return [array(z[k]) for k in items]
+        return {k.split(":", 1)[1]: array(z[k]) for k in keys}

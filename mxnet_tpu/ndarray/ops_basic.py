@@ -1,0 +1,512 @@
+"""Elementwise / scalar / broadcast / reduce / shape / matrix ops.
+
+TPU-native equivalents of the reference op families
+``src/operator/tensor/elemwise_*`` (~30 files), ``broadcast_reduce*``,
+``matrix_op-inl.h`` and ``dot`` (reference: SURVEY §2.2). Each op is a pure
+jnp/lax body; XLA fuses elementwise chains into surrounding matmuls so there
+is no hand-written kernel-bulking analog needed (the reference's engine op
+bulking, src/engine/threaded_engine.h:431, is performed by the XLA fuser).
+
+MXNet numeric conventions preserved: comparisons return 0/1 in the input
+dtype; reductions default to global reduce with the MXNet axis/keepdims/
+exclude kwargs; `reshape` honors the 0/-1/-2/-3/-4 shape codes
+(reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------- unary ---
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "round": jnp.round,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log,
+    "log10": jnp.log10, "log2": jnp.log2, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "square": jnp.square,
+    "cbrt": jnp.cbrt, "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+
+def _make_unary(name, fn):
+    def op(data):
+        return fn(data)
+
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name} (reference: src/operator/tensor/elemwise_unary_op_basic.cc)."
+    register(name)(op)
+
+
+for _n, _f in _UNARY.items():
+    _make_unary(_n, _f)
+
+
+@register()
+def rsqrt(data):
+    return lax.rsqrt(data)
+
+
+@register()
+def rcbrt(data):
+    return 1.0 / jnp.cbrt(data)
+
+
+@register(name="gamma")
+def _gamma_fn(data):
+    return jnp.exp(jax.scipy.special.gammaln(data))
+
+
+@register()
+def relu(data):
+    return jnp.maximum(data, 0)
+
+
+@register()
+def sigmoid(data):
+    return jax.nn.sigmoid(data)
+
+
+@register()
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register()
+def softsign(data):
+    return data / (1 + jnp.abs(data))
+
+
+@register()
+def cast(data, dtype):
+    from .ndarray import _canon_dtype
+
+    return data.astype(_canon_dtype(dtype))
+
+
+@register()
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+# ------------------------------------------------------------- binary -----
+
+def _bcast_pair(name, fn, cast_bool=True):
+    def op(lhs, rhs):
+        r = fn(lhs, rhs)
+        if cast_bool and r.dtype == jnp.bool_:
+            r = r.astype(jnp.result_type(lhs))
+        return r
+
+    op.__name__ = name
+    op.__doc__ = f"Broadcasting {name} (reference: src/operator/tensor/elemwise_binary_broadcast_op*.cc)."
+    register(name)(op)
+
+
+_BINARY = {
+    "broadcast_add": jnp.add, "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply, "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod, "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum, "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": jnp.equal, "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less, "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": lambda a, b: jnp.logical_and(a != 0, b != 0),
+    "broadcast_logical_or": lambda a, b: jnp.logical_or(a != 0, b != 0),
+    "broadcast_logical_xor": lambda a, b: jnp.logical_xor(a != 0, b != 0),
+}
+
+for _n, _f in _BINARY.items():
+    _bcast_pair(_n, _f)
+
+# non-broadcast aliases (reference elemwise_add etc. require equal shapes)
+for _alias, _target in [("elemwise_add", jnp.add), ("elemwise_sub", jnp.subtract),
+                        ("elemwise_mul", jnp.multiply), ("elemwise_div", jnp.divide),
+                        ("maximum", jnp.maximum), ("minimum", jnp.minimum)]:
+    _bcast_pair(_alias, _target)
+
+
+@register()
+def add_n(*args):
+    """Sum of n arrays (reference: src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ------------------------------------------------------------- scalar -----
+
+def _scalar_pair(name, fn, cast_bool=True):
+    def op(data, scalar=0.0, reverse=False):
+        a, b = (scalar, data) if reverse else (data, scalar)
+        r = fn(a, b)
+        if cast_bool and r.dtype == jnp.bool_:
+            r = r.astype(data.dtype)
+        if r.dtype != data.dtype and not jnp.issubdtype(data.dtype, jnp.integer):
+            r = r.astype(data.dtype)
+        return r
+
+    op.__name__ = name
+    register(name)(op)
+
+
+for _n, _f in {
+    "broadcast_add_scalar": jnp.add, "broadcast_sub_scalar": jnp.subtract,
+    "broadcast_mul_scalar": jnp.multiply, "broadcast_div_scalar": jnp.divide,
+    "broadcast_mod_scalar": jnp.mod, "broadcast_power_scalar": jnp.power,
+    "broadcast_equal_scalar": jnp.equal,
+    "broadcast_not_equal_scalar": jnp.not_equal,
+    "broadcast_greater_scalar": jnp.greater,
+    "broadcast_greater_equal_scalar": jnp.greater_equal,
+    "broadcast_lesser_scalar": jnp.less,
+    "broadcast_lesser_equal_scalar": jnp.less_equal,
+    "maximum_scalar": jnp.maximum, "minimum_scalar": jnp.minimum,
+}.items():
+    _scalar_pair(_n, _f)
+
+
+# ------------------------------------------------------------ reduce ------
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _make_reduce(name, fn):
+    def op(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=keepdims)
+
+    op.__name__ = name
+    op.__doc__ = f"Reduction {name} (reference: src/operator/tensor/broadcast_reduce_op_value.cc)."
+    register(name)(op)
+
+
+for _n, _f in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+               "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+               "max": jnp.max, "min": jnp.min}.items():
+    _make_reduce(_n, _f)
+
+register("sum_axis")(lambda data, axis=None, keepdims=False:
+                     jnp.sum(data, axis=_norm_axis(axis, data.ndim), keepdims=keepdims))
+
+
+@register()
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register()
+def argmax(data, axis=None, keepdims=False):
+    r = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return r.astype(jnp.float32)
+
+
+@register()
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register()
+def mean_all(data):
+    return jnp.mean(data)
+
+
+@register()
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """Reference: src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / n
+
+
+# ------------------------------------------------------------ shape -------
+
+@register()
+def reshape(data, shape=None, reverse=False):
+    """MXNet reshape with special codes 0/-1/-2/-3/-4
+    (reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape)."""
+    if shape is None:
+        return data
+    src = list(data.shape)
+    out = []
+    i = 0  # index into src
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        d = shape[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    return jnp.reshape(data, tuple(out))
+
+
+@register()
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register()
+def transpose(data, axes=None):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(data, axes)
+
+
+@register()
+def swapaxes(data, dim1=0, dim2=1):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register()
+def expand_dims(data, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@register()
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register()
+def broadcast_to(data, shape):
+    # mxnet allows 0 meaning "keep this dim"
+    shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register()
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register(name="slice")
+def _slice(data, begin, end, step=None):
+    idx = []
+    for i in range(len(begin)):
+        st = None if step is None else step[i]
+        idx.append(builtins_slice(begin[i], end[i], st))
+    return data[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register()
+def slice_axis(data, axis, begin, end):
+    idx = [slice(None)] * data.ndim
+    if end is None:
+        end = data.shape[axis]
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register()
+def slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register()
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register()
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register()
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register()
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    parts = jnp.split(data, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register()
+def tile(data, reps):
+    return jnp.tile(data, reps)
+
+
+@register()
+def repeat(data, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register()
+def reverse(data, axis=0):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(data, axis=axis)
+
+
+register("flip")(lambda data, axis=0: jnp.flip(
+    data, axis=(axis,) if isinstance(axis, int) else tuple(axis)))
+
+
+@register()
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Reference: src/operator/pad.cc (NCHW 4D/5D pads)."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register(name="where")
+def _where(condition, x, y):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register()
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register(name="zeros_like")
+def _zeros_like_op(data):
+    return jnp.zeros_like(data)
+
+
+@register(name="ones_like")
+def _ones_like_op(data):
+    return jnp.ones_like(data)
+
+
+@register()
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register()
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register()
+def identity(data):
+    return data
+
+
+register("stop_gradient")(lambda data: lax.stop_gradient(data))
+register("BlockGrad", namespaces=("nd",))(lambda data: lax.stop_gradient(data))
+
+
+@register()
+def depth_to_space(data, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register()
+def space_to_depth(data, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ------------------------------------------------------------ matrix ------
+
+@register()
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts lhs's last axis with rhs's first axis
+    (reference: src/operator/tensor/dot-inl.h). Maps straight onto the MXU."""
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register()
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register(name="_matmul")
+def _matmul(lhs, rhs):
+    return jnp.matmul(lhs, rhs)
+
+
+@register()
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out

@@ -1,0 +1,177 @@
+"""Indexing, gather/scatter and ordering ops.
+
+TPU-native equivalents of ``src/operator/tensor/indexing_op.{h,cc}``
+(take/gather_nd/scatter_nd/one_hot/Embedding), ``ordering_op-inl.h``
+(topk/sort/argsort) and ``histogram`` (reference: SURVEY §2.2). gather and
+scatter map to XLA gather/scatter HLO through jnp.take / ndarray.at; topk
+uses lax.top_k which is native on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register()
+def take(data, indices, axis=0, mode="clip"):
+    """Reference: indexing_op.h Take. mode clip/wrap (raise unsupported under
+    jit; clip used)."""
+    idx = indices.astype(jnp.int32)
+    return jnp.take(data, idx, axis=axis,
+                    mode="clip" if mode in ("clip", "raise") else "wrap")
+
+
+@register()
+def take_along_axis(data, indices, axis=0):
+    return jnp.take_along_axis(data, indices.astype(jnp.int32), axis=axis)
+
+
+@register()
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Reference: broadcast_reduce_op_index.cc pick."""
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register()
+def gather_nd(data, indices):
+    """Reference: indexing_op.h GatherND. indices: (M, ...) leading dim
+    indexes the first M axes of data."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register()
+def scatter_nd(data, indices, shape):
+    """Reference: indexing_op.h ScatterND."""
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].add(data)
+
+
+@register()
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    """Reference: indexing_op.h OneHot."""
+    from .ndarray import _canon_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * on_value + (1 - oh) * off_value
+    return out.astype(_canon_dtype(dtype))
+
+
+@register()
+def index_copy(old, index_vector, new_tensor):
+    """Reference: contrib/index_copy.cc."""
+    return old.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register()
+def index_array(data, axes=None):
+    """Reference: contrib/index_array.cc."""
+    shape = data.shape
+    axes = axes or tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    return jnp.stack([grids[a] for a in axes], axis=-1).astype(jnp.int64)
+
+
+@register()
+def boolean_mask(data, index, axis=0):
+    """Reference: contrib/boolean_mask.cc — data-dependent output shape; the
+    reference syncs to size the output (SURVEY §7 hard part 2). Same here:
+    forces a host sync, not usable under jit (use `where` there)."""
+    import numpy as onp
+
+    mask = onp.asarray(index) != 0
+    return jnp.compress(mask, data, axis=axis)
+
+
+# ------------------------------------------------------------- ordering ---
+
+@register()
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: ordering_op-inl.h TopK → lax.top_k (TPU-native sort unit)."""
+    from .ndarray import _canon_dtype
+
+    x = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(_canon_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        x = jnp.moveaxis(jnp.zeros_like(data), axis, -1)
+        oh = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+                            data.shape[axis]).sum(axis=-2)
+        return jnp.moveaxis(oh, -1, axis).astype(data.dtype)
+    raise ValueError(f"unknown ret_typ {ret_typ}")
+
+
+@register()
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register()
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from .ndarray import _canon_dtype
+
+    idx = jnp.argsort(data, axis=axis, stable=True)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(_canon_dtype(dtype))
+
+
+@register()
+def shuffle(data):
+    from .. import random as mxrandom
+
+    key = mxrandom.next_key()
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register()
+def histogram(data, bins=10, range=None, bin_cnt=None):
+    """Reference: src/operator/tensor/histogram.cc."""
+    if bin_cnt is not None:
+        bins = bin_cnt
+    cnt, edges = jnp.histogram(data.reshape(-1), bins=bins, range=range)
+    return cnt.astype(jnp.int64), edges
+
+
+@register()
+def unravel(data, shape=None):
+    idx = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack(idx).astype(data.dtype)
+
+
+@register()
+def ravel_multi_index(data, shape=None):
+    idx = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    return jnp.ravel_multi_index(idx, shape, mode="clip").astype(data.dtype)
+
+
+# -------------------------------------------------------- internal helpers
+
+@register(name="_static_slice")
+def _static_slice(data, key=None):
+    return data[key]
+
+
+@register(name="_slice_take")
+def _slice_take(data, key=None):
+    return data[key]

@@ -1,0 +1,179 @@
+"""Optimizer update ops.
+
+TPU-native equivalents of ``src/operator/optimizer_op.{cc,cu}``
+(reference: optimizer_op-inl.h — sgd_update, sgd_mom_update, adam_update,
+nag_mom_update, rmsprop_update, ftrl_update, signsgd/signum, lamb;
+multi-tensor fused variants in contrib). The reference mutates weights
+in-place from C++ kernels; here each op is a pure function returning the
+updated tensors and the Optimizer layer swaps NDArray handles — under one
+``jax.jit`` per Trainer step the whole multi-tensor update fuses into a
+single XLA executable (the analog of preloaded_multi_sgd).
+All ops honor rescale_grad / clip_gradient / wd exactly as the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad
+
+
+@register(differentiable=False)
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (grad + wd * weight)
+
+
+@register(differentiable=False)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (grad + wd * weight)
+    return weight + mom_new, mom_new
+
+
+@register(differentiable=False)
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mom_new = momentum * mom + grad
+    return weight - lr * (grad + momentum * mom_new), mom_new
+
+
+@register(differentiable=False)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mean_new = beta1 * mean + (1 - beta1) * grad
+    var_new = beta2 * var + (1 - beta2) * jnp.square(grad)
+    w_new = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w_new, mean_new, var_new
+
+
+@register(differentiable=False)
+def adamw_update(weight, grad, mean, var, lr, eta=1.0, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Reference: src/operator/contrib/adamw.cc (decoupled weight decay)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * grad
+    var_new = beta2 * var + (1 - beta2) * jnp.square(grad)
+    w_new = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                            + wd * weight)
+    return w_new, mean_new, var_new
+
+
+@register(differentiable=False)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.9, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = (1 - gamma1) * jnp.square(grad) + gamma1 * n
+    w_new = weight - lr * grad / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w_new = jnp.clip(w_new, -clip_weights, clip_weights)
+    return w_new, n_new
+
+
+@register(differentiable=False)
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95, gamma2=0.9,
+                       epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = (1 - gamma1) * jnp.square(grad) + gamma1 * n
+    g_new = (1 - gamma1) * grad + gamma1 * g
+    delta_new = gamma2 * delta - lr * grad / jnp.sqrt(
+        n_new - jnp.square(g_new) + epsilon)
+    w_new = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w_new = jnp.clip(w_new, -clip_weights, clip_weights)
+    return w_new, n_new, g_new, delta_new
+
+
+@register(differentiable=False)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    n_new = n + jnp.square(grad)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + grad - sigma * weight
+    w_new = jnp.where(
+        jnp.abs(z_new) <= lamda1, 0.0,
+        -(z_new - jnp.sign(z_new) * lamda1)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w_new.astype(weight.dtype), z_new, n_new
+
+
+@register(differentiable=False)
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(grad) + wd * weight)
+
+
+@register(differentiable=False)
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - (1 - momentum) * (grad + wd * weight)
+    w_new = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w_new, mom_new
+
+
+@register(differentiable=False)
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    grad = _prep_grad(grad, rescale_grad, clip_grad) + wd * weight
+    v_new = beta2 * v + (1 - beta2) * jnp.square(grad)
+    d_new = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * grad - sigma * weight
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+@register(differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * grad
+    var_new = beta2 * var + (1 - beta2) * jnp.square(grad)
+    m, v = mean_new, var_new
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    g = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return g, mean_new, var_new
+
+
+@register(differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    if lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    return weight - lr * ratio * g
+
+
+@register(differentiable=False)
+def all_finite(*arrays, init_output=True):
+    """Reference: contrib/all_finite.cc — underpins the AMP loss scaler."""
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register(differentiable=False)
+def multi_sum_sq(*arrays):
+    """Reference: contrib/multi_sum_sq.cc (used by LARS)."""
+    return tuple(jnp.sum(jnp.square(a)).reshape(1) for a in arrays)

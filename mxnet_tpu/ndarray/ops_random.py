@@ -1,0 +1,120 @@
+"""Random sampling ops.
+
+TPU-native equivalents of ``src/operator/random/`` (sample_op.cc,
+multisample_op.cc, sample_multinomial_op.cc; reference SURVEY §2.2).
+All draw keys from the ambient provider (mxnet_tpu.random) so they are pure
+under jit; JAX's Threefry counter PRNG replaces the reference's
+curand Philox per-thread states (include/mxnet/random_generator.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _key():
+    from .. import random as mxrandom
+
+    return mxrandom.next_key()
+
+
+def _dt(dtype):
+    from .ndarray import _canon_dtype
+
+    return _canon_dtype(dtype or "float32")
+
+
+@register(differentiable=False)
+def random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.uniform(_key(), shape, _dt(dtype), low, high)
+
+
+@register(differentiable=False)
+def random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.normal(_key(), shape, _dt(dtype)) * scale + loc
+
+
+@register(differentiable=False)
+def random_randint(low=0, high=1, shape=(1,), dtype="int32", ctx=None):
+    return jax.random.randint(_key(), shape, low, high, _dt(dtype))
+
+
+@register(differentiable=False)
+def random_exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.exponential(_key(), shape, _dt(dtype)) / lam
+
+
+@register(differentiable=False)
+def random_poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.poisson(_key(), lam, shape).astype(_dt(dtype))
+
+
+@register(differentiable=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.gamma(_key(), alpha, shape, _dt(dtype)) * beta
+
+
+@register(differentiable=False)
+def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None):
+    lam = jax.random.gamma(_key(), k, shape) * (1.0 - p) / p
+    return jax.random.poisson(_key(), lam, shape).astype(_dt(dtype))
+
+
+@register(differentiable=False)
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                         dtype="float32", ctx=None):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    lam = jax.random.gamma(_key(), k, shape) * (1.0 - p) / p
+    return jax.random.poisson(_key(), lam, shape).astype(_dt(dtype))
+
+
+@register(differentiable=False)
+def random_gumbel(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.gumbel(_key(), shape, _dt(dtype)) * scale + loc
+
+
+# ---- sample_* ops: per-row distribution parameters (multisample_op.cc) ----
+
+@register(differentiable=False)
+def sample_uniform(low, high, shape=(), dtype="float32"):
+    s = tuple(low.shape) + (tuple(shape) if shape else ())
+    u = jax.random.uniform(_key(), s, _dt(dtype))
+    ex = low.reshape(low.shape + (1,) * (len(s) - low.ndim))
+    exh = high.reshape(high.shape + (1,) * (len(s) - high.ndim))
+    return ex + u * (exh - ex)
+
+
+@register(differentiable=False)
+def sample_normal(mu, sigma, shape=(), dtype="float32"):
+    s = tuple(mu.shape) + (tuple(shape) if shape else ())
+    z = jax.random.normal(_key(), s, _dt(dtype))
+    ex = mu.reshape(mu.shape + (1,) * (len(s) - mu.ndim))
+    exs = sigma.reshape(sigma.shape + (1,) * (len(s) - sigma.ndim))
+    return ex + z * exs
+
+
+@register(differentiable=False)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    """Reference: sample_multinomial_op.cc — data is (batch, k) probs."""
+    n = 1
+    for d in (shape if isinstance(shape, (list, tuple)) else (shape,)):
+        n *= int(d) if d else 1
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    if data.ndim == 1:
+        out = jax.random.categorical(_key(), logits, shape=(n,))
+        out = out.reshape(tuple(shape) if shape else ())
+    else:
+        out = jax.random.categorical(_key(), logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+        out = out.reshape((data.shape[0],) + (tuple(shape) if shape else ()))
+    out = out.astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype(jnp.int32).reshape(data.shape[0], -1) if data.ndim > 1
+            else out.astype(jnp.int32).reshape(1, -1), axis=-1)
+        return out, lp.reshape(out.shape)
+    return out

@@ -1,0 +1,148 @@
+"""Op registry + namespace autogeneration.
+
+TPU-native replacement for the NNVM op registry + ``_init_op_module``
+autogen (reference: 429 NNVM_REGISTER_OP sites under src/operator/;
+python/mxnet/base.py:581, python/mxnet/ndarray/register.py:258). Each op is
+a pure JAX function (jnp/lax/pallas) plus metadata; the dispatch wrapper
+handles NDArray unwrap/wrap, the autograd tape (jax.vjp), and the ``out=``
+kwarg. Because every op body is traceable JAX, the same registry powers
+eager NDArray ops, hybridized (jit) CachedOp replay, and symbolic tracing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+_OPS = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "doc", "namespaces")
+
+    def __init__(self, name, fn, differentiable=True, doc=None, namespaces=("nd",)):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.doc = doc or fn.__doc__
+        self.namespaces = namespaces
+
+
+def register(name=None, differentiable=True, namespaces=("nd",)):
+    """Decorator registering a pure-JAX op body under `name`."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        if opname in _OPS:
+            raise ValueError(f"op '{opname}' already registered")
+        _OPS[opname] = OpDef(opname, fn, differentiable, fn.__doc__, namespaces)
+        return fn
+
+    return deco
+
+
+def get_op(name):
+    return _OPS.get(name)
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _unwrap(x):
+    from .ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x.data
+    return x
+
+
+def invoke(opdef, args, kwargs):
+    """Dispatch an op: unwrap NDArrays, run (recording a vjp if needed), wrap.
+
+    The analog of Imperative::Invoke + PushFCompute
+    (reference: src/imperative/imperative.cc:89,
+    src/imperative/imperative_utils.h:395): JAX's async dispatch plays the
+    role of the dependency engine — results are futures, sync happens at
+    `wait_to_read`/`asnumpy`.
+    """
+    from .ndarray import NDArray, _wrap
+    from .. import autograd
+
+    out = kwargs.pop("out", None)
+    # split array args (positional NDArray/ndarray-convertible) from config
+    arr_args = []
+    arg_template = []  # ('arr', i) | ('lit', value)
+    for a in args:
+        if isinstance(a, NDArray):
+            arg_template.append(("arr", len(arr_args)))
+            arr_args.append(a)
+        else:
+            arg_template.append(("lit", a))
+    kw_arrays = {}
+    for k, v in list(kwargs.items()):
+        if isinstance(v, NDArray):
+            kw_arrays[k] = len(arr_args)
+            arr_args.append(v)
+            del kwargs[k]
+
+    def pure_fn(*xs):
+        pos = [xs[a[1]] if a[0] == "arr" else a[1] for a in arg_template]
+        kw = dict(kwargs)
+        for k, idx in kw_arrays.items():
+            kw[k] = xs[idx]
+        return opdef.fn(*pos, **kw)
+
+    datas = [a.data for a in arr_args]
+    if autograd.is_recording() and opdef.differentiable and arr_args:
+        result, vjp_fn = jax.vjp(pure_fn, *datas)
+        multi = isinstance(result, tuple)
+        if out is not None:
+            if multi:
+                raise MXNetError("out= not supported for multi-output ops")
+            # the tape must reference `out` itself so downstream grads
+            # keyed by id(out) flow back through this node
+            out._data = jnp.asarray(result, out._data.dtype)
+            autograd._record_op(vjp_fn, arr_args, [out])
+            return out
+        outs = [_wrap(r) for r in (result if multi else (result,))]
+        autograd._record_op(vjp_fn, arr_args, outs)
+        return outs if multi else outs[0]
+
+    result = pure_fn(*datas)
+    if isinstance(result, tuple):
+        result = [_wrap(r) for r in result]
+    else:
+        result = _wrap(result)
+
+    if out is not None:
+        if isinstance(result, list):
+            raise MXNetError("out= not supported for multi-output ops")
+        out._data = jnp.asarray(result.data, out._data.dtype)
+        return out
+    return result
+
+
+def make_wrapper(opdef):
+    @functools.wraps(opdef.fn)
+    def wrapper(*args, **kwargs):
+        return invoke(opdef, args, kwargs)
+
+    wrapper.__name__ = opdef.name
+    wrapper.__qualname__ = opdef.name
+    return wrapper
+
+
+def populate_namespace(module, namespace="nd"):
+    """Install autogen wrappers into a module (mx.nd, mx.nd.op, ...).
+
+    Reference: _init_op_module (python/mxnet/base.py:581)."""
+    exported = []
+    for name, opdef in _OPS.items():
+        if namespace in opdef.namespaces and not hasattr(module, name):
+            setattr(module, name, make_wrapper(opdef))
+            exported.append(name)
+    return exported

@@ -1,0 +1,8 @@
+"""Pallas/Mosaic TPU kernels.
+
+Home of hand-written kernels for ops the reference implements in raw CUDA
+(reference: src/operator/contrib/ multibox*, roi_align, deformable conv,
+nms; SURVEY §2.2 contrib row). Standard ops live as XLA-lowered bodies in
+mxnet_tpu.ndarray.ops_*; only genuinely fusion-resistant ops get Pallas
+kernels here.
+"""

@@ -1,0 +1,11 @@
+"""Optimizers (reference: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdaGrad, RMSProp, AdaDelta,
+                        Ftrl, Adamax, Nadam, Signum, SignSGD, FTML, LAMB,
+                        Updater, get_updater, register, create)
+from . import lr_scheduler
+from .lr_scheduler import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum", "SignSGD",
+           "FTML", "LAMB", "Updater", "get_updater", "register", "create",
+           "lr_scheduler", "LRScheduler"]

@@ -1,0 +1,532 @@
+"""Optimizer classes.
+
+TPU-native equivalent of python/mxnet/optimizer/optimizer.py (reference:
+Optimizer registry :143, SGD :601, Adam, NAG, RMSProp, AdaGrad, AdaDelta,
+Ftrl, Adamax, Nadam, Signum, FTML, LAMB; Updater :1943). The update *math*
+lives in the registered optimizer ops (ops_optim.py) exactly like the
+reference keeps it in C++ ops; these classes manage state, lr/wd schedules
+and multipliers. `Trainer` fuses all per-parameter updates into one jitted
+XLA executable (the analog of the reference's multi-tensor fused updates).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as onp
+
+from ..base import register_entry, lookup_entry
+from .. import ndarray as nd
+
+__all__ = ["Optimizer", "register", "create", "SGD", "NAG", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
+           "SignSGD", "FTML", "LAMB", "Updater", "get_updater"]
+
+
+def register(klass):
+    register_entry("optimizer", klass.__name__, klass, override=True)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return lookup_entry("optimizer", name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:143)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+
+    create_optimizer = staticmethod(create)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp16 weights get an fp32 master copy (reference: optimizer.py:232)."""
+        if self.multi_precision and weight.dtype == onp.float16:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == onp.float16:
+            master, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, master, g32, base_state)
+            weight._data = master.data.astype(weight.data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+def _swap(weight, new):
+    weight._data = new.data
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer.py:601)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            _swap(weight, nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw))
+        else:
+            w, m = nd.sgd_mom_update(weight, grad, state, lr=lr,
+                                     momentum=self.momentum, wd=wd, **kw)
+            _swap(weight, w)
+            _swap(state, m)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            _swap(weight, nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw))
+        else:
+            w, m = nd.nag_mom_update(weight, grad, state, lr=lr,
+                                     momentum=self.momentum, wd=wd, **kw)
+            _swap(weight, w)
+            _swap(state, m)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        mean, var = state
+        w, m, v = nd.adam_update(weight, grad, mean, var, lr=lr,
+                                 beta1=self.beta1, beta2=self.beta2,
+                                 epsilon=self.epsilon, wd=wd,
+                                 **self._common_kwargs())
+        _swap(weight, w)
+        _swap(mean, m)
+        _swap(var, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        history = state
+        history._data = (history + grad * grad).data
+        # eps inside the sqrt, matching the reference (optimizer.py:1559)
+        div = grad / ((history + self.float_stable_eps) ** 0.5)
+        weight._data = (weight - lr * (div + wd * weight)).data
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype))
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            w, n = nd.rmsprop_update(weight, grad, state, lr=lr,
+                                     gamma1=self.gamma1, epsilon=self.epsilon,
+                                     wd=wd, **kw)
+            _swap(weight, w)
+            _swap(state, n)
+        else:
+            n, g, delta = state
+            w, n2, g2, d2 = nd.rmspropalex_update(
+                weight, grad, n, g, delta, lr=lr, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, wd=wd, **kw)
+            _swap(weight, w)
+            _swap(n, n2)
+            _swap(g, g2)
+            _swap(delta, d2)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._data = (self.rho * acc_g + (1 - self.rho) * grad * grad).data
+        delta = ((acc_delta + self.epsilon) ** 0.5) \
+            / ((acc_g + self.epsilon) ** 0.5) * grad
+        acc_delta._data = (self.rho * acc_delta
+                           + (1 - self.rho) * delta * delta).data
+        weight._data = (weight - delta - wd * weight).data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        w, z2, n2 = nd.ftrl_update(weight, grad, z, n, lr=lr,
+                                   lamda1=self.lamda1, beta=self.beta, wd=wd,
+                                   **self._common_kwargs())
+        _swap(weight, w)
+        _swap(z, z2)
+        _swap(n, n2)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t._data = (self.beta1 * m_t + (1.0 - self.beta1) * grad).data
+        u_t._data = nd.maximum(self.beta2 * u_t, nd.abs(grad)).data
+        weight._data = (weight - lr * m_t / (u_t + 1e-8)).data
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = (self.beta1 * m_t + (1.0 - self.beta1) * grad).data
+        v_t._data = (self.beta2 * v_t + (1.0 - self.beta2) * grad * grad).data
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._data = (weight - lr * m_t_bar
+                        / (v_t_prime ** 0.5 + self.epsilon)).data
+
+
+@register
+class SignSGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        _swap(weight, nd.signsgd_update(
+            weight, grad, lr=self._get_lr(index), wd=self._get_wd(index),
+            **self._common_kwargs()))
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _swap(weight, nd.signsgd_update(weight, grad, lr=lr, wd=wd,
+                                            **self._common_kwargs()))
+        else:
+            w, m = nd.signum_update(weight, grad, state, lr=lr,
+                                    momentum=self.momentum, wd=wd,
+                                    wd_lh=self.wd_lh, **self._common_kwargs())
+            _swap(weight, w)
+            _swap(state, m)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        d, v, z = state
+        kw = self._common_kwargs()
+        kw["clip_grad"] = kw.pop("clip_gradient", -1.0)
+        w, d2, v2, z2 = nd.ftml_update(weight, grad, d, v, z, lr=lr,
+                                       beta1=self.beta1, beta2=self.beta2,
+                                       epsilon=self.epsilon, wd=wd, t=t, **kw)
+        _swap(weight, w)
+        _swap(d, d2)
+        _swap(v, v2)
+        _swap(z, z2)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mean, var = state
+        g, m, v = nd.lamb_update_phase1(weight, grad, mean, var,
+                                        beta1=self.beta1, beta2=self.beta2,
+                                        epsilon=self.epsilon, t=t,
+                                        bias_correction=self.bias_correction,
+                                        wd=wd, **self._common_kwargs())
+        r1 = nd.norm(weight)
+        r2 = nd.norm(g)
+        w = nd.lamb_update_phase2(weight, g, r1, r2, lr=lr,
+                                  lower_bound=self.lower_bound or -1.0,
+                                  upper_bound=self.upper_bound or -1.0)
+        _swap(weight, w)
+        _swap(mean, m)
+        _swap(var, v)
+
+
+class Updater:
+    """kvstore updater closure (reference: optimizer.py:1943)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: (v.asnumpy() if isinstance(v, nd.NDArray) else
+                      tuple(s.asnumpy() if isinstance(s, nd.NDArray) else s
+                            for s in v) if isinstance(v, tuple) else v)
+                  for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple) and len(obj) == 2 and isinstance(obj[1], Optimizer):
+            states, self.optimizer = obj
+        else:
+            states = obj
+
+        def restore(v):
+            if isinstance(v, tuple):
+                return tuple(restore(s) for s in v)
+            if isinstance(v, onp.ndarray):
+                return nd.array(v)
+            return v
+
+        self.states = {k: restore(v) for k, v in states.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

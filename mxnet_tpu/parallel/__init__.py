@@ -1,0 +1,24 @@
+"""SPMD parallelism over a TPU device mesh.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (reference: src/kvstore/{comm.h,kvstore_nccl.h,
+kvstore_dist.h,kvstore_dist_server.h}, ps-lite, tools/launch.py; SURVEY
+§2.3/§5.8). Instead of explicit reduce machinery, parallelism is expressed
+as jax.sharding over a Mesh and XLA inserts the ICI/DCN collectives:
+
+- data parallel == batch axis sharded over 'dp' (replaces
+  DataParallelExecutorGroup + kvstore local/device/NCCL)
+- tensor parallel == weight axes sharded over 'mp' (NEW capability; the
+  reference only has by-device model placement via __ctx_group__)
+- multi-host == jax.distributed + the same mesh spanning hosts (replaces
+  ps-lite dist_sync)
+"""
+from __future__ import annotations
+
+from .mesh import make_mesh, current_mesh, mesh_scope, device_count
+from .spmd import (all_reduce, SPMDTrainer, shard_batch, replicate,
+                   shard_params)
+
+__all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count",
+           "all_reduce", "SPMDTrainer", "shard_batch", "replicate",
+           "shard_params"]

@@ -1,0 +1,72 @@
+"""Device mesh construction / scoping.
+
+The Mesh is the TPU analog of the reference's device group: where MXNet
+enumerates GPUs into a kvstore comm (reference: comm.h CommDevice over
+ctx lists), the TPU build lays out jax devices into a named
+``jax.sharding.Mesh`` whose axes ('dp', 'mp', ...) carry the parallelism
+meaning. Multi-host pods: the same mesh spans all processes after
+``jax.distributed.initialize`` (replaces ps-lite env rendezvous
+DMLC_ROLE/DMLC_PS_ROOT_URI, reference include/mxnet/kvstore.h:296).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count"]
+
+_CURRENT = []
+
+
+def device_count():
+    return jax.device_count()
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}. Sizes may use -1 for 'fill'.
+
+    >>> make_mesh({'dp': -1})            # pure data parallel
+    >>> make_mesh({'dp': 4, 'mp': 2})    # 4-way DP x 2-way TP
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    fill = 1
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if -1 in sizes:
+        assert n % known == 0, f"{n} devices not divisible by {known}"
+        fill = n // known
+        sizes = [fill if s == -1 else s for s in sizes]
+    total = 1
+    for s in sizes:
+        total *= s
+    assert total <= n, f"mesh {dict(zip(names, sizes))} needs {total} " \
+        f"devices, have {n}"
+    arr = onp.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+class mesh_scope:
+    """Context manager installing a default mesh for the parallel layer."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        _CURRENT.append(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _CURRENT.pop()
+
+
+def current_mesh():
+    return _CURRENT[-1] if _CURRENT else None

@@ -1,0 +1,97 @@
+"""Misc utilities (download, env knobs).
+
+Reference: python/mxnet/gluon/utils.py helpers + env-var config surface
+(docs env_var.md — SURVEY Appendix B). TPU build keeps MXNET_* names where
+semantics survive.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["getenv", "split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def getenv(name, default=None):
+    """Read an MXNET_* knob (reference: dmlc::GetEnv use sites)."""
+    return os.environ.get(name, default)
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Reference: gluon/utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference: gluon/utils.py split_and_load."""
+    from .. import ndarray as nd
+    from ..ndarray import NDArray
+
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Reference: gluon/utils.py clip_global_norm."""
+    import math
+
+    from .. import ndarray as nd
+
+    total = 0.0
+    for arr in arrays:
+        n = nd.norm(arr).asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf is detected.")
+        return total
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._data = (arr * scale).data
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):  # pragma: no cover - zero-egress environment
+    """Reference: gluon/utils.py download. This environment has no egress;
+    raises unless the file already exists locally."""
+    import os
+
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    raise RuntimeError(f"download of {url} unavailable (no network egress); "
+                       f"place the file at {fname} manually")

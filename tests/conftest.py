@@ -1,0 +1,36 @@
+"""Test harness config.
+
+Runs the suite on a virtual 8-device CPU mesh (like the reference's
+multi-process single-host distributed tests, SURVEY §4) so sharding paths
+are exercised without TPU hardware. Must set XLA flags before jax import.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # axon env presets this to the TPU tunnel
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# The axon sitecustomize registers a TPU-tunnel PJRT plugin at interpreter
+# start and sets the jax_platforms CONFIG to "axon,cpu" (config beats the
+# env var). Tests must run on the virtual CPU mesh — and the tunnel admits
+# one process at a time, so a test run would otherwise contend with the
+# bench/driver for the single chip. Force the config back to cpu before
+# any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    onp.random.seed(0)
+    yield

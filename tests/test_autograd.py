@@ -1,0 +1,146 @@
+"""Autograd tape (reference suite: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = y * y
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * onp.exp(4.0, dtype="f"), rtol=1e-5)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([2.0, 4.0]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6, 12])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = 2 * x
+    y.backward()  # should not crash
+
+
+def test_detach_stops_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = nd.stop_gradient(y) * x
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_multi_output_op_grad():
+    x = nd.array(onp.arange(6).reshape(2, 3).astype("f"))
+    x.attach_grad()
+    with autograd.record():
+        a, b, c = nd.split(x, 3, axis=1)
+        loss = (a * 1 + b * 2 + c * 3).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                [[1, 2, 3], [1, 2, 3]])
+
+
+def test_mark_variables_api():
+    x = nd.array([1.0, 1.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    autograd.backward([y])
+    onp.testing.assert_allclose(g.asnumpy(), [4, 4])
+
+
+def test_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad(y, x)
+    onp.testing.assert_allclose(g.asnumpy(), [12.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.25], rtol=1e-5)
+
+
+def test_retain_graph():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 5
+    y.backward(retain_graph=True)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [5])
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [5])
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=False):
+        y = nd.dropout(x, p=0.5)
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    with autograd.record(train_mode=True):
+        y = nd.dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()

@@ -1,0 +1,221 @@
+"""NDArray basics (reference suite: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    onp.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full_arange():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    onp.testing.assert_allclose(nd.full((2,), 7).asnumpy(), [7, 7])
+    onp.testing.assert_allclose(nd.arange(0, 6, 2).asnumpy(), [0, 2, 4])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    onp.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    onp.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    onp.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    onp.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    onp.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    onp.testing.assert_allclose((2 + a).asnumpy(), [3, 4, 5])
+    onp.testing.assert_allclose((2 - a).asnumpy(), [1, 0, -1])
+    onp.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    onp.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    onp.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparisons_return_numeric():
+    a = nd.array([1.0, 2.0, 3.0])
+    out = (a > 1.5).asnumpy()
+    assert out.dtype == onp.float32
+    onp.testing.assert_allclose(out, [0, 1, 1])
+
+
+def test_indexing():
+    a = nd.array(onp.arange(12).reshape(3, 4))
+    onp.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    onp.testing.assert_allclose(a[0:2, 1].asnumpy(), [1, 5])
+    idx = nd.array([0, 2], dtype="int32")
+    onp.testing.assert_allclose(a[idx].asnumpy(), [[0, 1, 2, 3],
+                                                   [8, 9, 10, 11]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1] = 5
+    assert a.asnumpy()[1].sum() == 15
+    a[0, 0] = 2
+    assert a.asnumpy()[0, 0] == 2
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, 0, 4)).shape == (2, 3, 4)
+    assert a.reshape((-3, 0)).shape == (6, 4)
+    assert a.reshape((0, -4, 1, 3, 0)).shape == (2, 1, 3, 4)
+    assert a.reshape((0, -2)).shape == (2, 3, 4)
+
+
+def test_reductions():
+    a = nd.array(onp.arange(6).reshape(2, 3).astype("float32"))
+    assert a.sum().asscalar() == 15
+    onp.testing.assert_allclose(nd.sum(a, axis=0).asnumpy(), [3, 5, 7])
+    onp.testing.assert_allclose(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                                [[3], [12]])
+    onp.testing.assert_allclose(
+        nd.sum(a, axis=0, exclude=True).asnumpy(), [3, 12])
+    onp.testing.assert_allclose(nd.mean(a).asnumpy(), 2.5)
+    assert nd.max(a).asscalar() == 5
+    assert nd.argmax(a, axis=1).asnumpy().tolist() == [2, 2]
+    onp.testing.assert_allclose(nd.norm(a).asscalar(),
+                                onp.sqrt((onp.arange(6) ** 2).sum()),
+                                rtol=1e-5)
+
+
+def test_dot():
+    a = nd.array(onp.random.rand(3, 4))
+    b = nd.array(onp.random.rand(4, 5))
+    onp.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                                a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy()[0, 0],
+        nd.dot(a, b).asnumpy()[0, 0], rtol=1e-5)
+
+
+def test_batch_dot():
+    a = nd.array(onp.random.rand(2, 3, 4))
+    b = nd.array(onp.random.rand(2, 4, 5))
+    out = nd.batch_dot(a, b)
+    assert out.shape == (2, 3, 5)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_shape_ops():
+    a = nd.array(onp.arange(6).reshape(2, 3))
+    assert nd.transpose(a).shape == (3, 2)
+    assert nd.expand_dims(a, axis=0).shape == (1, 2, 3)
+    assert nd.flip(a, axis=1).asnumpy()[0, 0] == 2
+    b = nd.concat(a, a, dim=0)
+    assert b.shape == (4, 3)
+    c = nd.stack(a, a, axis=0)
+    assert c.shape == (2, 2, 3)
+    parts = nd.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    parts = nd.split(a, 3, axis=1, squeeze_axis=True)
+    assert parts[0].shape == (2,)
+    assert nd.tile(a, (2, 2)).shape == (4, 6)
+    assert nd.repeat(a, 2, axis=0).shape == (4, 3)
+
+
+def test_slice_ops():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    s = nd.slice(a, begin=(0, 1, 0), end=(2, 3, 2))
+    assert s.shape == (2, 2, 2)
+    s2 = nd.slice_axis(a, axis=2, begin=1, end=3)
+    assert s2.shape == (2, 3, 2)
+    s3 = nd.slice_like(a, nd.zeros((1, 2, 2)))
+    assert s3.shape == (1, 2, 2)
+
+
+def test_take_pick_onehot():
+    a = nd.array(onp.arange(12).reshape(3, 4).astype("f"))
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.take(a, idx).shape == (2, 4)
+    p = nd.pick(a, nd.array([1, 0, 3]), axis=1)
+    onp.testing.assert_allclose(p.asnumpy(), [1, 4, 11])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(a, k=2, ret_typ="value")
+    onp.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    i = nd.topk(a, k=1)
+    onp.testing.assert_allclose(i.asnumpy(), [[0], [1]])
+    s = nd.sort(a, axis=1)
+    onp.testing.assert_allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+    ars = nd.argsort(a, axis=1)
+    onp.testing.assert_allclose(ars.asnumpy(), [[1, 2, 0], [0, 2, 1]])
+
+
+def test_cast_astype():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    assert b.asnumpy().tolist() == [1, 2]
+
+
+def test_where_clip():
+    a = nd.array([-1.0, 0.5, 2.0])
+    onp.testing.assert_allclose(nd.clip(a, 0, 1).asnumpy(), [0, 0.5, 1])
+    w = nd.where(a > 0, a, nd.zeros_like(a))
+    onp.testing.assert_allclose(w.asnumpy(), [0, 0.5, 2])
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.array([1.0, 2.0]), "b": nd.array([[3.0]])}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    onp.testing.assert_allclose(loaded["w"].asnumpy(), [1, 2])
+    lst = [nd.array([1.0]), nd.array([2.0, 3.0])]
+    nd.save(f, lst)
+    loaded = nd.load(f)
+    assert len(loaded) == 2
+    onp.testing.assert_allclose(loaded[1].asnumpy(), [2, 3])
+
+
+def test_gather_scatter():
+    data = nd.array(onp.arange(9).reshape(3, 3).astype("f"))
+    indices = nd.array([[0, 2], [1, 0]], dtype="int32")
+    # indices[0] = axis-0 coords, indices[1] = axis-1 coords (mxnet layout)
+    g = nd.gather_nd(data, indices)
+    onp.testing.assert_allclose(g.asnumpy(), [1, 6])
+    s = nd.scatter_nd(nd.array([1.0, 2.0]), indices, shape=(3, 3))
+    assert s.asnumpy()[0, 1] == 1 and s.asnumpy()[2, 0] == 2
+
+
+def test_broadcast_ops():
+    a = nd.array(onp.ones((2, 1, 3)))
+    assert nd.broadcast_to(a, (2, 4, 3)).shape == (2, 4, 3)
+    assert nd.broadcast_axis(a, axis=1, size=5).shape == (2, 5, 3)
+    b = nd.array(onp.ones((1, 3)))
+    assert nd.broadcast_add(a, b).shape == (2, 1, 3)
+    assert nd.broadcast_maximum(a, b).shape == (2, 1, 3)
+
+
+def test_context():
+    a = nd.array([1.0], ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b.shape == a.shape
+
+
+def test_wait_and_scalar():
+    a = nd.array([3.14])
+    a.wait_to_read()
+    assert abs(a.asscalar() - 3.14) < 1e-6
+    assert abs(float(a) - 3.14) < 1e-6
+    nd.waitall()
