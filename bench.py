@@ -1,0 +1,94 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: reference MXNet ResNet-50 training, fp32 batch 128 on 1x V100 =
+363.69 img/s (BASELINE.md, docs perf.md:243-254). The full training step
+(forward, backward, SGD+momentum update, BN stats) is ONE donated XLA
+executable built by mxnet_tpu.parallel.SPMDTrainer over a 1-device mesh.
+
+Env knobs: BENCH_BATCH (default 128, halved on OOM), BENCH_SMOKE=1 runs a
+tiny-shape CPU smoke for plumbing checks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+if SMOKE:
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+BASELINE_IMGS_PER_SEC = 363.69
+
+
+def build_trainer(mesh, image_size, classes=1000):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu import parallel
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=classes)
+    net.initialize(mx.init.Xavier())
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    return parallel.SPMDTrainer(
+        net, loss, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        mesh=mesh)
+
+
+def run(batch, image_size, classes, warmup=2, iters=8):
+    import jax
+
+    from mxnet_tpu import nd, parallel
+
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = build_trainer(mesh, image_size, classes)
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, image_size, image_size).astype("f"))
+    y = nd.array(rng.randint(0, classes, batch).astype("f"))
+    for _ in range(warmup):
+        lval = trainer.step(x, y)
+    lval.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        lval = trainer.step(x, y)
+    lval.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch * iters / dt, float(lval.asscalar())
+
+
+def main():
+    if SMOKE:
+        imgs, loss = run(batch=4, image_size=32, classes=10, warmup=1,
+                         iters=2)
+        print(json.dumps({"metric": "resnet50_train_smoke",
+                          "value": round(imgs, 2), "unit": "img/s",
+                          "vs_baseline": 0.0}))
+        return
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    last_err = None
+    while batch >= 16:
+        try:
+            imgs, loss = run(batch=batch, image_size=224, classes=1000)
+            print(json.dumps({
+                "metric": f"resnet50_train_imgs_per_sec_fp32_b{batch}",
+                "value": round(imgs, 2), "unit": "img/s",
+                "vs_baseline": round(imgs / BASELINE_IMGS_PER_SEC, 3)}))
+            return
+        except Exception as e:  # OOM → halve the batch
+            last_err = e
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                batch //= 2
+                continue
+            raise
+    raise SystemExit(f"bench failed at batch>=16: {last_err}")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,13 @@ from . import kvstore
 from . import gluon
 from . import parallel
 from . import utils  # noqa: F401
+from . import symbol
+from . import symbol as sym
+from . import executor
+from . import module
+from . import module as mod
+from . import model
+from . import callback
 
 # keep reference-style aliases
 Context = Context

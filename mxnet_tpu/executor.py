@@ -1,0 +1,185 @@
+"""Executor: compiled forward/backward of a bound symbolic graph.
+
+TPU-native equivalent of the reference GraphExecutor (reference:
+src/executor/graph_executor.cc, python/mxnet/executor.py). Where the
+reference builds per-node engine ops with a shared memory pool
+(InitCachedOps :1174, MXPlanMemory), here bind lowers the whole graph to
+one jitted XLA computation; backward is the jit-compiled vjp. Loss-head
+semantics of the legacy output ops are honored: softmax_output's backward
+is (softmax - one_hot(label)), make_loss's head gradient is 1 — matching
+FGradient of the reference ops (src/operator/softmax_output.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+_LOSS_HEADS = ("softmax_output", "make_loss", "linear_regression_output",
+               "logistic_regression_output", "mae_regression_output")
+
+
+class Executor:
+    def __init__(self, symbol, arg_names, arg_arrays, grad_arrays, grad_req,
+                 ctx=None):
+        self._symbol = symbol
+        self.arg_names = list(arg_names)
+        self.arg_arrays = list(arg_arrays)
+        self.grad_arrays = grad_arrays
+        self.grad_req = grad_req
+        self.outputs = []
+        self._ctx = ctx
+        self._fwd_jit = None
+        self._label_names = [n for n in self.arg_names
+                             if n.endswith("label")]
+
+    @property
+    def arg_dict(self):
+        return dict(zip(self.arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        if self.grad_arrays is None:
+            return {}
+        return {n: g for n, g in zip(self.arg_names, self.grad_arrays)
+                if g is not None}
+
+    @property
+    def aux_dict(self):
+        return {}
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Reference: executor.py copy_params_from."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise ValueError(f"Found name '{name}' that is not in the "
+                                 "arguments")
+
+    # ---- compiled paths --------------------------------------------------
+    def _ensure_fwd(self):
+        if self._fwd_jit is not None:
+            return
+        symbol, names = self._symbol, self.arg_names
+
+        def fwd(vals, train):
+            from . import autograd
+
+            with autograd.pause(train_mode=train):
+                feed = {n: NDArray(v) for n, v in zip(names, vals)}
+                out = symbol.eval_with(feed)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o.data for o in outs)
+
+        self._fwd_jit = jax.jit(fwd, static_argnums=(1,))
+
+        # loss-aware scalar function for backward
+        def loss_fn(vals):
+            from . import autograd
+
+            with autograd.pause(train_mode=True):
+                feed = {n: NDArray(v) for n, v in zip(names, vals)}
+                total = 0.0
+                head_syms = (symbol._group if symbol._group else [symbol])
+                cache = {}
+                for h in head_syms:
+                    if h._op == "softmax_output":
+                        data = h._inputs[0]._eval_nodes(feed, cache)
+                        label = h._inputs[1]._eval_nodes(feed, cache)
+                        logp = jax.nn.log_softmax(data.data, axis=-1)
+                        onehot = jax.nn.one_hot(label.data.astype(jnp.int32),
+                                                data.shape[-1])
+                        # normalization='null' (reference default):
+                        # head grad is (softmax - onehot), unscaled
+                        total = total - jnp.sum(logp * onehot)
+                    elif h._op == "linear_regression_output":
+                        data = h._inputs[0]._eval_nodes(feed, cache)
+                        label = h._inputs[1]._eval_nodes(feed, cache)
+                        total = total + 0.5 * jnp.sum(
+                            jnp.square(data.data - label.data.reshape(
+                                data.shape)))
+                    elif h._op == "logistic_regression_output":
+                        data = h._inputs[0]._eval_nodes(feed, cache)
+                        label = h._inputs[1]._eval_nodes(feed, cache)
+                        p = jax.nn.sigmoid(data.data)
+                        lbl = label.data.reshape(data.shape)
+                        total = total - jnp.sum(
+                            lbl * jnp.log(p + 1e-12)
+                            + (1 - lbl) * jnp.log(1 - p + 1e-12))
+                    elif h._op == "mae_regression_output":
+                        data = h._inputs[0]._eval_nodes(feed, cache)
+                        label = h._inputs[1]._eval_nodes(feed, cache)
+                        total = total + jnp.sum(jnp.abs(
+                            data.data - label.data.reshape(data.shape)))
+                    else:  # make_loss or generic head: sum it
+                        out = h._eval_nodes(feed, cache)
+                        outs = out if isinstance(out, (list, tuple)) else [out]
+                        total = total + sum(jnp.sum(o.data) for o in outs)
+                return total
+
+        self._grad_jit = jax.jit(jax.grad(loss_fn))
+
+        def head_vjp(vals, cots):
+            _, vjp_fn = jax.vjp(lambda v: fwd(v, True), vals)
+            return vjp_fn(cots)[0]
+
+        self._head_vjp_jit = jax.jit(head_vjp)
+
+    def forward(self, is_train=False, **kwargs):
+        """Reference: executor.py forward / GraphExecutor::RunOps."""
+        self._ensure_fwd()
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(
+                    f"unknown input '{k}' fed to executor; bound arguments "
+                    f"are {self.arg_names}")
+            self.arg_dict[k]._data = v.data if isinstance(v, NDArray) \
+                else jnp.asarray(v)
+        vals = [a.data for a in self.arg_arrays]
+        outs = self._fwd_jit(vals, bool(is_train))
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Reference: executor.py backward / GraphExecutor::Backward.
+
+        With out_grads: vjp of the bound outputs against the supplied head
+        gradients. Without: the loss-head rule (softmax_output et al.)."""
+        if self.grad_arrays is None or self.grad_req == "null":
+            return
+        self._ensure_fwd()
+        vals = [a.data for a in self.arg_arrays]
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads)
+            grads = self._head_vjp_jit(vals, cots)
+        else:
+            grads = self._grad_jit(vals)
+        for name, garr, g in zip(self.arg_names, self.grad_arrays, grads):
+            if garr is None:
+                continue
+            if self.grad_req == "add":
+                garr._data = garr.data + g
+            else:
+                garr._data = g
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (reference: graph_executor.cc:876).
+        jit re-specializes per shape automatically; just resize buffers."""
+        for name, shape in kwargs.items():
+            if name in self.arg_dict:
+                i = self.arg_names.index(name)
+                self.arg_arrays[i] = nd.zeros(shape)
+                if self.grad_arrays is not None and \
+                        self.grad_arrays[i] is not None:
+                    self.grad_arrays[i] = nd.zeros(shape)
+        return self

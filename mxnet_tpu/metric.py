@@ -55,7 +55,18 @@ def create(metric, *args, **kwargs):
         for m in metric:
             composite.add(create(m, *args, **kwargs))
         return composite
-    return lookup_entry("metric", metric)(*args, **kwargs)
+    try:  # exact registry name first (custom registered metrics)
+        return lookup_entry("metric", metric)(*args, **kwargs)
+    except ValueError:
+        pass
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "nll_loss": "negativeloglikelihood",
+               "top_k_accuracy": "topkaccuracy", "top_k_acc": "topkaccuracy",
+               "pearsonr": "pearsoncorrelation",
+               "composite": "compositeevalmetric"}
+    key = aliases.get(metric.lower(),
+                      metric.lower().replace("-", "").replace("_", ""))
+    return lookup_entry("metric", key)(*args, **kwargs)
 
 
 class EvalMetric:

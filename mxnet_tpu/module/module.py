@@ -1,0 +1,225 @@
+"""Module: symbolic training over the jit Executor.
+
+TPU-native equivalent of python/mxnet/module/module.py (reference:
+Module:40-646 — bind/init_params/init_optimizer/forward/backward/update).
+The reference splits the batch across a DataParallelExecutorGroup
+(executor_group.py:144); on TPU the single Executor's computation is the
+unit — data parallelism over chips is expressed by binding under a mesh
+(mxnet_tpu.parallel), not by N executor replicas.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from ..base import MXNetError
+from ..io import DataDesc
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._arg_params = None
+        self._aux_params = {}
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self.output_names, self._exec.outputs)]
+
+    def _param_names(self):
+        inputs = set(self._data_names) | set(self._label_names)
+        return [n for n in self._symbol.list_arguments() if n not in inputs]
+
+    # ---- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Reference: module.py:364 bind → simple_bind."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                              for d in (label_shapes or [])]
+        shapes = {d.name: tuple(d.shape) for d in
+                  self._data_shapes + self._label_shapes}
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context, grad_req=grad_req if for_training else "null",
+            **shapes)
+        self.binded = True
+        self.for_training = for_training
+
+    # ---- params ----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """Reference: module.py init_params."""
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        from .. import initializer as init_mod
+
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        if arg_params is None and self._arg_params is not None:
+            # params preloaded via Module.load / set_params-before-bind
+            arg_params = self._arg_params
+        for name in self._param_names():
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arg_params[name].copyto(arr)
+            else:
+                if arg_params is not None and not allow_missing and \
+                        name not in arg_params:
+                    raise RuntimeError(f"{name} is not presented")
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        """Reference: module.py get_params."""
+        assert self.binded and self.params_initialized
+        arg_params = {n: self._exec.arg_dict[n].copy()
+                      for n in self._param_names()}
+        return arg_params, dict(self._aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not self.binded:
+            self._arg_params = arg_params
+            return
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    # ---- optimizer -------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Reference: module.py init_optimizer (kvstore wiring)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            idx2name = {i: n for i, n in enumerate(self._param_names())}
+            # the reference normalizes by device batch size here
+            # (reference: module.py init_optimizer rescale_grad=1/batch)
+            if "rescale_grad" not in params and self._data_shapes:
+                params["rescale_grad"] = 1.0 / self._data_shapes[0].shape[0]
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        if kvstore:
+            self._kvstore = kvs.create(kvstore) \
+                if isinstance(kvstore, str) else kvstore
+        self.optimizer_initialized = True
+
+    # ---- step ------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """Reference: module.py forward."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        """Reference: module.py backward."""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads)
+
+    def update(self):
+        """Reference: module.py update → kvstore push/pull or updater."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names()):
+            if name in self._fixed_param_names:
+                continue
+            w = self._exec.arg_dict[name]
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            self._updater(i, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict[n] for n in self._data_names
+                if n in self._exec.grad_dict]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self.output_names, self._exec.outputs)))
+
+    # ---- checkpoint ------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Reference: module.py save_checkpoint → symbol json + params."""
+        from ..model import save_checkpoint
+
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Reference: module.py Module.load."""
+        from .. import symbol as sym
+        from ..model import load_checkpoint
+
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._arg_params = arg_params
+        mod._preloaded = (arg_params, aux_params)
+        return mod

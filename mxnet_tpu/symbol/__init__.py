@@ -1,0 +1,424 @@
+"""Symbolic API (mx.sym).
+
+TPU-native redesign of the reference's NNVM Symbol layer (reference:
+python/mxnet/symbol/symbol.py 3359 LoC over 3rdparty/tvm/nnvm Symbol/Graph;
+src/executor/graph_executor.cc). A Symbol here is a lightweight DAG of op
+nodes over the SAME op registry that powers mx.nd — binding lowers the
+whole graph to one jitted XLA computation (the analog of GraphExecutor's
+bind: memory planning, fusion and scheduling delegated to XLA instead of
+MXPlanMemory/engine bulking). JSON save/load keeps Module checkpoint
+compatibility at the API level.
+"""
+from __future__ import annotations
+
+import json
+import sys as _sys
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+class Symbol:
+    """A node (or group of output nodes) in a symbolic graph."""
+
+    def __init__(self, op=None, name=None, inputs=None, kwargs=None,
+                 num_outputs=1, output_index=0, group=None):
+        self._op = op  # str op name; None for variables/groups
+        self._name = name
+        self._inputs = inputs or []  # list[Symbol]
+        self._kwargs = kwargs or {}
+        self._num_outputs = num_outputs
+        self._output_index = output_index
+        self._group = group  # list[Symbol] when this is a Group
+        self._attrs = {}
+
+    # ---- construction ----------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def list_attr(self):
+        return dict(self._attrs)
+
+    def __repr__(self):
+        return f"<Symbol {self._name or self._op}>"
+
+    def __copy__(self):
+        return self
+
+    # ---- graph queries ---------------------------------------------------
+    def _walk(self, seen=None, order=None):
+        if seen is None:
+            seen, order = set(), []
+        if id(self) in seen:
+            return order
+        seen.add(id(self))
+        for i in self._inputs:
+            i._walk(seen, order)
+        if self._group:
+            for g in self._group:
+                g._walk(seen, order)
+        order.append(self)
+        return order
+
+    def list_arguments(self):
+        """Free variables in topological order (reference:
+        symbol.py list_arguments)."""
+        return [s._name for s in self._walk()
+                if s._op is None and s._group is None]
+
+    def list_outputs(self):
+        if self._group:
+            return [n for g in self._group for n in g.list_outputs()]
+        base = self._name or self._op
+        if self._num_outputs == 1:
+            return [f"{base}_output"]
+        return [f"{base}_output{i}" for i in range(self._num_outputs)]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        return Group([s for s in self._walk() if s._op is not None] or [self])
+
+    def __getitem__(self, index):
+        if self._group:
+            return self._group[index]
+        if isinstance(index, str):
+            for s in self._walk():
+                if (s._name or s._op) and index.startswith(s._name or ""):
+                    if index in s.list_outputs() or index == s._name:
+                        return s
+            raise ValueError(f"no output named {index}")
+        if self._num_outputs == 1 and index == 0:
+            return self
+        return Symbol(op=self._op, name=self._name, inputs=self._inputs,
+                      kwargs=self._kwargs, num_outputs=self._num_outputs,
+                      output_index=index)
+
+    # ---- evaluation ------------------------------------------------------
+    def _eval_nodes(self, feed, cache):
+        """Topologically evaluate; feed maps var name → NDArray."""
+        from .. import ndarray as nd
+        from ..ndarray import NDArray
+
+        if id(self) in cache:
+            return cache[id(self)]
+        if self._group is not None:
+            outs = []
+            for g in self._group:
+                o = g._eval_nodes(feed, cache)
+                outs.extend(o if isinstance(o, (list, tuple)) else [o])
+            cache[id(self)] = outs
+            return outs
+        if self._op is None:
+            if self._name not in feed:
+                raise MXNetError(f"variable '{self._name}' is not bound")
+            cache[id(self)] = feed[self._name]
+            return cache[id(self)]
+        args = []
+        for i in self._inputs:
+            v = i._eval_nodes(feed, cache)
+            if isinstance(v, (list, tuple)):
+                v = v[i._output_index]
+            args.append(v)
+        opdef = _registry.get_op(self._op)
+        if opdef is None:
+            raise MXNetError(f"op '{self._op}' is not registered")
+        kwargs = dict(self._kwargs)
+        out = _registry.invoke(opdef, tuple(args), kwargs)
+        cache[id(self)] = out
+        if isinstance(out, (list, tuple)):
+            return out[self._output_index] if self._num_outputs > 1 else out
+        return out
+
+    def eval_with(self, feed):
+        out = self._eval_nodes(dict(feed), {})
+        if isinstance(out, (list, tuple)) and self._num_outputs > 1:
+            return out[self._output_index]
+        return out
+
+    def eval(self, ctx=None, **kwargs):
+        """Reference: symbol.py eval."""
+        out = self.eval_with(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # ---- shape/type inference -------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Reference: symbol.py infer_shape — partial inference: parameter
+        shapes are derived from layer semantics (symbol/infer.py), output
+        shapes from jax.eval_shape over each op body."""
+        from .infer import infer_shapes
+
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        var_shapes, out_shapes = infer_shapes(self, known)
+        args = self.list_arguments()
+        return ([var_shapes.get(a) for a in args], out_shapes, [])
+
+    def infer_shape_partial(self, **kwargs):
+        from .infer import infer_shapes
+
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        var_shapes, out_shapes = infer_shapes(self, known,
+                                              allow_unknown=True)
+        args = self.list_arguments()
+        return ([var_shapes.get(a) for a in args], out_shapes, [])
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        return ([onp.float32] * len(args),
+                [onp.float32] * max(self._num_outputs, 1), [])
+
+    # ---- binding ---------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", **kwargs):
+        """Reference: MXExecutorSimpleBindEx (c_api_executor.cc:189) →
+        GraphExecutor::Init. Allocates arg/grad arrays from shapes and
+        returns a jit-compiled Executor."""
+        from .. import ndarray as nd
+        from ..executor import Executor
+
+        arg_shapes, _, _ = self.infer_shape(**kwargs)
+        args = self.list_arguments()
+        missing = [a for a, s in zip(args, arg_shapes) if s is None]
+        if missing:
+            raise MXNetError(f"simple_bind could not infer shapes for "
+                             f"{missing}")
+        arg_arrays = [nd.zeros(s) for s in arg_shapes]
+        grad_arrays = [nd.zeros(s) for s in arg_shapes] \
+            if grad_req != "null" else None
+        return Executor(self, args, arg_arrays, grad_arrays, grad_req, ctx)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        """Reference: executor.h:143 Bind."""
+        from ..executor import Executor
+
+        names = self.list_arguments()
+        if isinstance(args, dict):
+            arg_arrays = [args[n] for n in names]
+        else:
+            arg_arrays = list(args)
+        if args_grad is None:
+            grad_arrays = None
+        elif isinstance(args_grad, dict):
+            grad_arrays = [args_grad.get(n) for n in names]
+        else:
+            grad_arrays = list(args_grad)
+        return Executor(self, names, arg_arrays, grad_arrays, grad_req, ctx)
+
+    # ---- serialization ---------------------------------------------------
+    def tojson(self):
+        """Reference: symbol.py tojson (nnvm json graph)."""
+        order = [s for s in self._walk()]
+        idx = {id(s): i for i, s in enumerate(order)}
+        nodes = []
+        for s in order:
+            node = {
+                "op": "null" if s._op is None else s._op,
+                "name": s._name or (s._op + str(idx[id(s)])),
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in s._kwargs.items()},
+                "inputs": [[idx[id(i)], i._output_index, 0]
+                           for i in s._inputs],
+            }
+            if s._num_outputs != 1:
+                node["num_outputs"] = s._num_outputs
+            nodes.append(node)
+        heads = ([[idx[id(g)], g._output_index, 0] for g in self._group]
+                 if self._group else [[idx[id(self)], self._output_index, 0]])
+        return json.dumps({"nodes": nodes, "arg_nodes":
+                           [i for i, s in enumerate(order) if s._op is None],
+                           "heads": heads, "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ---- operators -------------------------------------------------------
+    def _binop(self, opname, other, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _make_node(opname, [a, b], {})
+        return _make_node(opname + "_scalar", [self],
+                          {"scalar": other, "reverse": reverse})
+
+    def __add__(self, o): return self._binop("broadcast_add", o)
+    def __radd__(self, o): return self._binop("broadcast_add", o, True)
+    def __sub__(self, o): return self._binop("broadcast_sub", o)
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o)
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, True)
+    def __truediv__(self, o): return self._binop("broadcast_div", o)
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, True)
+    def __pow__(self, o): return self._binop("broadcast_power", o)
+    def __neg__(self): return _make_node("negative", [self], {})
+
+    def reshape(self, shape):
+        return _make_node("reshape", [self], {"shape": shape})
+
+    def transpose(self, axes=None):
+        return _make_node("transpose", [self], {"axes": axes})
+
+
+_var_counter = [0]
+
+
+def Variable(name=None, shape=None, dtype=None, init=None, **kwargs):
+    """Reference: symbol.py Variable/var."""
+    if name is None:
+        name = f"var{_var_counter[0]}"
+        _var_counter[0] += 1
+    s = Symbol(op=None, name=name)
+    if shape is not None:
+        s._attrs["__shape__"] = str(tuple(shape))
+    return s
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Reference: symbol.py Group."""
+    return Symbol(group=list(symbols), name="group")
+
+
+_node_counter = [0]
+
+
+def _num_outputs_for(opname, kwargs):
+    """Static output count of a node (reference: each op's num_outputs
+    attr in the NNVM registry)."""
+    if opname in ("split", "split_v2", "slice_channel"):
+        n = kwargs.get("num_outputs")
+        if n is None and opname == "split_v2":
+            ios = kwargs.get("indices_or_sections")
+            n = ios if isinstance(ios, int) else len(ios) + 1
+        return int(n or 1)
+    if opname == "topk":
+        return 2 if kwargs.get("ret_typ") == "both" else 1
+    if opname in ("batch_norm", "layer_norm"):
+        return 3 if kwargs.get("output_mean_var") else 1
+    if opname == "rnn":
+        if kwargs.get("state_outputs", True):
+            return 3 if kwargs.get("mode", "lstm") == "lstm" else 2
+        return 1
+    if opname == "histogram":
+        return 2
+    return 1
+
+
+def _make_node(opname, inputs, kwargs, name=None):
+    if name is None:
+        name = f"{opname.lower()}{_node_counter[0]}"
+        _node_counter[0] += 1
+    return Symbol(op=opname, name=name, inputs=inputs, kwargs=kwargs,
+                  num_outputs=_num_outputs_for(opname, kwargs))
+
+
+def _sym_wrapper(opdef):
+    import inspect
+
+    sig_names = [p.name for p in
+                 inspect.signature(opdef.fn).parameters.values()
+                 if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+
+    def wrapper(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        # bind positional args (Symbol or config) to signature names, then
+        # split into Symbol inputs (kept in signature order) and config
+        bound = {}
+        for i, a in enumerate(args):
+            if i < len(sig_names):
+                bound[sig_names[i]] = a
+            elif isinstance(a, Symbol):
+                bound[f"__extra{i}"] = a  # varargs ops (concat, stack, ...)
+        bound.update(kwargs)
+        inputs, config = [], {}
+        for key in sig_names:
+            if key in bound:
+                v = bound.pop(key)
+                if isinstance(v, Symbol):
+                    inputs.append(v)
+                elif v is not None:
+                    config[key] = v
+        for key, v in bound.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+            else:
+                config[key] = v
+        node = _make_node(opdef.name, inputs, config, name=name)
+        if attr:
+            node._set_attr(**attr)
+        return node
+
+    wrapper.__name__ = opdef.name
+    wrapper.__doc__ = opdef.doc
+    return wrapper
+
+
+def _populate():
+    mod = _sys.modules[__name__]
+    for name in _registry.list_ops():
+        if not hasattr(mod, name):
+            setattr(mod, name, _sym_wrapper(_registry.get_op(name)))
+    from ..ndarray import _CAMEL_ALIASES
+
+    for alias, target in _CAMEL_ALIASES.items():
+        if not hasattr(mod, alias) and hasattr(mod, target):
+            setattr(mod, alias, getattr(mod, target))
+
+
+_populate()
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _make_node("_sym_zeros", [], {"shape": shape, "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _make_node("_sym_ones", [], {"shape": shape, "dtype": dtype})
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol DAG from tojson output."""
+    obj = json.loads(json_str)
+    nodes = obj["nodes"]
+    built = []
+    for n in nodes:
+        if n["op"] == "null":
+            built.append(Variable(n["name"]))
+        else:
+            inputs = []
+            for (i, oi, _) in n["inputs"]:
+                src = built[i]
+                src = src if oi == 0 else src[oi]
+                inputs.append(src)
+            kwargs = {}
+            for k, v in n.get("attrs", {}).items():
+                try:
+                    kwargs[k] = json.loads(v)
+                except (json.JSONDecodeError, TypeError):
+                    kwargs[k] = v
+            built.append(Symbol(op=n["op"], name=n["name"], inputs=inputs,
+                                kwargs=kwargs,
+                                num_outputs=n.get("num_outputs", 1)))
+    heads = [built[i] if oi == 0 else built[i][oi]
+             for (i, oi, _) in obj["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)

@@ -1,0 +1,180 @@
+"""Partial shape inference over a Symbol DAG.
+
+TPU-native equivalent of the reference's graph shape-inference pass
+(reference: src/executor/infer_graph_attr_pass.cc:360-661 — forward
+FInferShape with partial info). Per node: unknown *parameter* input shapes
+are derived from layer semantics (the FInferShape each NN op registers in
+the reference), then the node's output shape comes from
+``jax.eval_shape`` over the op's pure-JAX body — the op body IS its shape
+function, so there is no second shape-rule registry to keep in sync.
+"""
+from __future__ import annotations
+
+import inspect
+
+import numpy as onp
+
+import jax
+
+from ..base import MXNetError
+from ..ndarray import registry as _registry
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def _param_shape_rules(op, kw, in_shapes, arg_names):
+    """Given known data shape (index 0), return {input_idx: shape} for
+    unknown parameter inputs. Mirrors the reference ops' FInferShape."""
+    data = in_shapes.get(0)
+    if data is None:
+        return {}
+    out = {}
+
+    def named(name):
+        return arg_names.index(name) if name in arg_names else None
+
+    if op == "fully_connected":
+        num_hidden = kw.get("num_hidden")
+        flatten = kw.get("flatten", True)
+        in_units = _prod(data[1:]) if flatten else data[-1]
+        out[named("weight")] = (num_hidden, in_units)
+        if named("bias") is not None:
+            out[named("bias")] = (num_hidden,)
+    elif op == "convolution":
+        kernel = tuple(kw.get("kernel"))
+        nf = kw.get("num_filter")
+        g = kw.get("num_group", 1)
+        out[named("weight")] = (nf, data[1] // g) + kernel
+        if named("bias") is not None:
+            out[named("bias")] = (nf,)
+    elif op == "deconvolution":
+        kernel = tuple(kw.get("kernel"))
+        nf = kw.get("num_filter")
+        g = kw.get("num_group", 1)
+        out[named("weight")] = (data[1], nf // g) + kernel
+        if named("bias") is not None:
+            out[named("bias")] = (nf,)
+    elif op in ("batch_norm",):
+        axis = kw.get("axis", 1)
+        c = (data[axis],)
+        for pname in ("gamma", "beta", "moving_mean", "moving_var"):
+            idx = named(pname)
+            if idx is not None:
+                out[idx] = c
+    elif op in ("layer_norm",):
+        axis = kw.get("axis", -1)
+        c = (data[axis],)
+        out[named("gamma")] = c
+        out[named("beta")] = c
+    elif op in ("instance_norm", "group_norm"):
+        c = (data[1],)
+        out[named("gamma")] = c
+        out[named("beta")] = c
+    elif op == "embedding":
+        out[named("weight")] = (kw.get("input_dim"), kw.get("output_dim"))
+    elif op == "rnn":
+        from ..ndarray.ops_nn import rnn_param_size
+
+        size = rnn_param_size(kw.get("num_layers", 1), data[-1],
+                              kw.get("state_size"),
+                              kw.get("bidirectional", False),
+                              kw.get("mode", "lstm"))
+        out[named("parameters")] = (size,)
+        D = 2 if kw.get("bidirectional", False) else 1
+        st = (kw.get("num_layers", 1) * D, data[1], kw.get("state_size"))
+        if named("state") is not None:
+            out[named("state")] = st
+        if named("state_cell") is not None:
+            out[named("state_cell")] = st
+    elif op in ("leaky_relu",) and kw.get("act_type") == "prelu":
+        out[named("gamma")] = (data[1] if len(data) > 1 else 1,)
+    return {k: v for k, v in out.items() if k is not None}
+
+
+def _array_arg_names(opdef):
+    sig = inspect.signature(opdef.fn)
+    return [p.name for p in sig.parameters.values()
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+
+
+def infer_shapes(symbol, known, allow_unknown=False):
+    """Walk the DAG; return ({var_name: shape}, [output shapes]).
+
+    `known` maps variable names to shapes. Unknown parameter shapes are
+    filled by layer rules; raises if a needed shape stays unknown
+    (unless allow_unknown).
+    """
+    order = symbol._walk()
+    var_shapes = dict(known)
+    node_out = {}  # id(node) -> shape or list-of-shapes
+
+    for node in order:
+        if node._group is not None:
+            continue
+        if node._op is None:
+            if node._name in var_shapes:
+                node_out[id(node)] = tuple(var_shapes[node._name])
+            continue
+        opdef = _registry.get_op(node._op)
+        if opdef is None:
+            raise MXNetError(f"op '{node._op}' is not registered")
+        arg_names = _array_arg_names(opdef)
+        in_shapes = {}
+        for i, inp in enumerate(node._inputs):
+            s = node_out.get(id(inp))
+            if isinstance(s, list):
+                s = s[inp._output_index]
+            if s is not None:
+                in_shapes[i] = s
+        # fill unknown parameter-var inputs via layer rules
+        if len(in_shapes) < len(node._inputs):
+            rules = _param_shape_rules(node._op, node._kwargs, in_shapes,
+                                       arg_names)
+            for i, inp in enumerate(node._inputs):
+                if i in in_shapes:
+                    continue
+                if inp._op is None and i in rules:
+                    var_shapes[inp._name] = tuple(rules[i])
+                    node_out[id(inp)] = tuple(rules[i])
+                    in_shapes[i] = tuple(rules[i])
+        if len(in_shapes) < len(node._inputs):
+            if allow_unknown:
+                continue
+            missing = [node._inputs[i]._name for i in
+                       range(len(node._inputs)) if i not in in_shapes]
+            raise MXNetError(
+                f"cannot infer shape for inputs {missing} of op "
+                f"'{node._op}' ({node._name})")
+
+        specs = [jax.ShapeDtypeStruct(in_shapes[i], onp.float32)
+                 for i in range(len(node._inputs))]
+        kwargs = dict(node._kwargs)
+
+        def f(*xs):
+            return opdef.fn(*xs, **kwargs)
+
+        try:
+            o = jax.eval_shape(f, *specs)
+        except Exception as e:
+            raise MXNetError(
+                f"shape inference failed at op '{node._op}' "
+                f"({node._name}) with input shapes "
+                f"{[tuple(s.shape) for s in specs]}: {e}") from e
+        if isinstance(o, (list, tuple)):
+            node_out[id(node)] = [tuple(x.shape) for x in o]
+        else:
+            node_out[id(node)] = tuple(o.shape)
+
+    heads = symbol._group if symbol._group else [symbol]
+    out_shapes = []
+    for h in heads:
+        s = node_out.get(id(h))
+        if isinstance(s, list):
+            s = s[h._output_index]
+        out_shapes.append(s)
+    return var_shapes, out_shapes

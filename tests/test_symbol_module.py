@@ -1,0 +1,121 @@
+"""Symbol + Executor + Module (reference suites:
+tests/python/unittest/test_symbol.py, test_executor.py, test_module.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+
+def _mlp_symbol(hidden=16, classes=4):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=hidden,
+                             weight=sym.Variable("fc1_weight"),
+                             bias=sym.Variable("fc1_bias"))
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=classes,
+                             weight=sym.Variable("fc2_weight"),
+                             bias=sym.Variable("fc2_bias"))
+    label = sym.Variable("softmax_label")
+    return sym.SoftmaxOutput(fc2, label, name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert "data" in args and "fc1_weight" in args and \
+        "softmax_label" in args
+
+
+def test_symbol_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2 * a + b
+    out = c.eval(a=nd.array([1.0, 2.0]), b=nd.array([10.0, 20.0]))
+    onp.testing.assert_allclose(out[0].asnumpy(), [12, 24])
+
+
+def test_symbol_infer_shape():
+    s = _mlp_symbol(hidden=16, classes=4)
+    arg_shapes, out_shapes, _ = s.infer_shape(
+        data=(8, 10), fc1_weight=(16, 10), fc1_bias=(16,),
+        fc2_weight=(4, 16), fc2_bias=(4,), softmax_label=(8,))
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    s = _mlp_symbol()
+    f = str(tmp_path / "net-symbol.json")
+    s.save(f)
+    s2 = sym.load(f)
+    assert set(s2.list_arguments()) == set(s.list_arguments())
+    # same numeric behavior
+    feed = {n: nd.array(onp.random.rand(*shape).astype("f"))
+            for n, shape in [("data", (2, 10)), ("fc1_weight", (16, 10)),
+                             ("fc1_bias", (16,)), ("fc2_weight", (4, 16)),
+                             ("fc2_bias", (4,)), ("softmax_label", (2,))]}
+    o1 = s.eval_with(dict(feed))
+    o2 = s2.eval_with(dict(feed))
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-5)
+
+
+def test_executor_simple_bind_forward_backward():
+    s = _mlp_symbol()
+    exe = s.simple_bind(data=(8, 10), fc1_weight=(16, 10), fc1_bias=(16,),
+                        fc2_weight=(4, 16), fc2_bias=(4,),
+                        softmax_label=(8,))
+    for name, arr in exe.arg_dict.items():
+        if name.endswith("weight"):
+            arr._data = nd.array(
+                onp.random.rand(*arr.shape).astype("f") * 0.1).data
+    exe.arg_dict["data"]._data = nd.array(
+        onp.random.rand(8, 10).astype("f")).data
+    exe.arg_dict["softmax_label"]._data = nd.array(
+        onp.random.randint(0, 4, 8).astype("f")).data
+    outs = exe.forward(is_train=True)
+    assert outs[0].shape == (8, 4)
+    onp.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                                onp.ones(8), rtol=1e-5)
+    exe.backward()
+    g = exe.grad_dict["fc1_weight"].asnumpy()
+    assert (onp.abs(g) > 0).any()
+
+
+def test_module_fit_mlp():
+    onp.random.seed(0)
+    centroids = onp.random.randn(4, 10).astype("f") * 2
+    y = onp.random.randint(0, 4, 128).astype("f")
+    X = centroids[y.astype(int)] + \
+        0.3 * onp.random.randn(128, 10).astype("f")
+    train_iter = NDArrayIter(X, y, batch_size=32, shuffle=True,
+                             label_name="softmax_label")
+
+    mod = Module(_mlp_symbol(hidden=32, classes=4))
+    mod.fit(train_iter, num_epoch=12,
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(train_iter, "acc")
+    assert score[0][1] > 0.8, f"accuracy {score}"
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    onp.random.seed(1)
+    X = onp.random.rand(64, 10).astype("f")
+    y = onp.random.randint(0, 4, 64).astype("f")
+    it = NDArrayIter(X, y, batch_size=16)
+    mod = Module(_mlp_symbol(hidden=8, classes=4))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    pred = mod.predict(it)
+    assert pred.shape == (64, 4)
+    prefix = str(tmp_path / "ck")
+    mod.init_optimizer()
+    mod.save_checkpoint(prefix, 3)
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in arg_params
+    # reload into a fresh module and check predictions match
+    mod2 = Module(symbol)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=arg_params, aux_params=aux_params)
+    pred2 = mod2.predict(it)
+    onp.testing.assert_allclose(pred.asnumpy(), pred2.asnumpy(), rtol=1e-5)
