@@ -38,6 +38,8 @@ from . import gluon
 from . import parallel
 from . import utils  # noqa: F401
 from . import symbol
+from . import numpy as np
+from . import numpy_extension as npx
 from . import symbol as sym
 from . import executor
 from . import module

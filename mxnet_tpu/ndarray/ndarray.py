@@ -180,6 +180,25 @@ class NDArray:
     def asnative(self):
         return self._data
 
+    def _alias_view(self, out):
+        """Record an identity tape edge so a re-wrapped view keeps grads
+        flowing (the reference's tape is keyed by the C++ chunk, so views
+        are free there; ours is keyed by the Python wrapper)."""
+        from .. import autograd
+
+        if autograd.is_recording():
+            autograd._record_op(lambda g: (g,), [self], [out])
+        return out
+
+    def as_np_ndarray(self):
+        """View as mx.np ndarray (reference: ndarray.py as_np_ndarray)."""
+        from ..numpy import ndarray as _np_cls
+
+        return self._alias_view(_np_cls(self._data))
+
+    def as_nd_ndarray(self):
+        return self
+
     def detach(self):
         out = NDArray(self._data)
         return out
@@ -225,7 +244,7 @@ class NDArray:
             # keep python ints intact (exact jnp.power for integer exponents)
             return _invoke1(name + "_scalar", self, scalar=other,
                             reverse=reverse)
-        if isinstance(other, (onp.ndarray, list, tuple)):
+        if isinstance(other, (onp.ndarray, list, tuple, jax.Array)):
             other = array(other, dtype=self._data.dtype)
             a, b = (other, self) if reverse else (self, other)
             return _invoke1(name, a, b)

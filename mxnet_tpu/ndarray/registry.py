@@ -96,6 +96,18 @@ def invoke(opdef, args, kwargs):
             kw[k] = xs[idx]
         return opdef.fn(*pos, **kw)
 
+    # preserve the array subclass — ANY np-semantics operand forces an
+    # np-semantics output, regardless of operand order (mirroring the
+    # reference's _np_ndarray_cls output-class switch,
+    # python/mxnet/ndarray/register.py _np_imperative_invoke)
+    wrap_cls = NDArray
+    for a in arr_args:
+        if type(a) is not NDArray:
+            wrap_cls = type(a)
+            break
+    if wrap_cls is not NDArray:
+        _wrap = lambda r: wrap_cls(r)  # noqa: E731
+
     datas = [a.data for a in arr_args]
     if autograd.is_recording() and opdef.differentiable and arr_args:
         result, vjp_fn = jax.vjp(pure_fn, *datas)

@@ -1,0 +1,1040 @@
+"""``mx.np``: NumPy-compatible array API on the TPU runtime.
+
+TPU-native rebuild of the reference NumPy namespace (reference:
+python/mxnet/numpy/multiarray.py 7026 LoC, python/mxnet/ndarray/numpy/
+_op.py 5033 LoC, python/mxnet/numpy/linalg.py, python/mxnet/numpy/
+random.py; C++ ops under src/operator/numpy/). Where the reference
+re-implements NumPy semantics op-by-op in CUDA/C++, here each function is
+a thin taped wrapper over ``jax.numpy`` — XLA already speaks NumPy — so
+the whole namespace stays differentiable (autograd tape via jax.vjp, see
+ndarray/registry.py) and jit-traceable under hybridize.
+
+Dynamic-shape ops (``nonzero``, ``unique``, boolean-mask indexing) execute
+eagerly on host when outside a trace and raise inside one — the
+"sync-and-reshape escape hatch" for XLA's static shapes (reference analog:
+kSubgraphExec sync ops, src/operator/numpy/np_nonzero_op.cc).
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, numeric_types
+from ..context import current_context
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray import registry as _reg
+from ..ndarray.ndarray import NDArray, _canon_dtype, _is_tracer
+
+_float32 = onp.float32
+
+pi = onp.pi
+e = onp.e
+euler_gamma = onp.euler_gamma
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+
+# dtype names re-exported like numpy's (mx.np.float32 etc.)
+float16 = onp.float16
+float32 = onp.float32
+float64 = onp.float64
+bfloat16 = jnp.bfloat16
+int8 = onp.int8
+int16 = onp.int16
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (reference: numpy/multiarray.py:ndarray).
+
+    Subclasses the MXNet-semantics NDArray: same jax.Array payload, same
+    autograd tape; differences are numpy conventions — bool comparisons,
+    true division, zero-dim scalars, boolean-mask indexing.
+    """
+
+    __slots__ = ()
+
+    # numpy-style repr
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<np.ndarray-tracer {self.shape}>"
+        arr = self.asnumpy()
+        prefix = "array("
+        body = onp.array2string(arr, separator=", ", prefix=prefix)
+        dt = self._data.dtype
+        suffix = f", dtype={dt})" if dt not in (onp.float32, onp.int32, onp.bool_) \
+            else ")"
+        return prefix + body + suffix
+
+    def __str__(self):
+        if _is_tracer(self._data):
+            return self.__repr__()
+        return str(self.asnumpy())
+
+    # numpy comparison semantics: bool results (the parent returns
+    # mxnet-style float 0/1 masks)
+    def _cmp(self, other, fn):
+        try:
+            other = _as_jax(other, self._data.dtype)
+        except (TypeError, ValueError):
+            return NotImplemented
+        return _call(fn, self, other) if isinstance(other, NDArray) \
+            else _call(lambda a: fn(a, other), self)
+
+    def __eq__(self, o):
+        if o is None:
+            return full(self.shape, False, dtype=onp.bool_)
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        if o is None:
+            return full(self.shape, True, dtype=onp.bool_)
+        return self._cmp(o, jnp.not_equal)
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+
+    def __hash__(self):
+        return id(self)
+
+    def __truediv__(self, o):
+        return true_divide(self, o)
+
+    def __rtruediv__(self, o):
+        return true_divide(o, self)
+
+    def __floordiv__(self, o):
+        return floor_divide(self, o)
+
+    def __rfloordiv__(self, o):
+        return floor_divide(o, self)
+
+    def __invert__(self):
+        return _call(jnp.invert, self)
+
+    def __and__(self, o): return bitwise_and(self, o)
+    def __or__(self, o): return bitwise_or(self, o)
+    def __xor__(self, o): return bitwise_xor(self, o)
+
+    def __getitem__(self, key):
+        if _has_bool_mask(key):
+            if _is_tracer(self._data):
+                raise MXNetError(
+                    "boolean-mask indexing has a data-dependent shape and "
+                    "cannot run inside jit; use np.where or run eagerly")
+            # numpy semantics: a[mask] == a[nonzero(mask)] — converting to
+            # integer indices on host keeps the gather on the taped path,
+            # so gradients flow (reference: boolean_mask op FGradient,
+            # src/operator/contrib/boolean_mask.cc)
+            return super().__getitem__(_expand_bool_keys(key))
+        return super().__getitem__(key)
+
+    def __setitem__(self, key, value):
+        if _has_bool_mask(key):
+            from .. import autograd
+
+            if autograd.is_recording():
+                raise MXNetError(
+                    "ndarray.__setitem__ is not supported when recording "
+                    "with autograd (in-place writes cannot be taped)")
+            if _is_tracer(self._data):
+                raise MXNetError("boolean-mask assignment cannot run "
+                                 "inside jit (data-dependent shape)")
+            if isinstance(value, NDArray):
+                value = value.data
+            key = _nd_mod._unwrap_index(_expand_bool_keys(key))
+            self._data = self._data.at[key].set(value)
+            return
+        super().__setitem__(key, value)
+
+    # numpy-style methods
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _call(lambda a: jnp.reshape(a, shape), self)
+
+    def flatten(self, order="C"):
+        return _call(lambda a: jnp.ravel(a), self)
+
+    ravel = flatten
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    @property
+    def T(self):
+        return _call(jnp.transpose, self)
+
+    def any(self, axis=None, keepdims=False):
+        return _call(lambda a: jnp.any(a, axis=axis, keepdims=keepdims), self)
+
+    def all(self, axis=None, keepdims=False):
+        return _call(lambda a: jnp.all(a, axis=axis, keepdims=keepdims), self)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _call(lambda a: jnp.std(a, axis=axis, ddof=ddof,
+                                       keepdims=keepdims), self)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _call(lambda a: jnp.var(a, axis=axis, ddof=ddof,
+                                       keepdims=keepdims), self)
+
+    def cumsum(self, axis=None, dtype=None):
+        return _call(lambda a: jnp.cumsum(a, axis=axis,
+                                          dtype=_canon_dtype(dtype)), self)
+
+    def round(self, decimals=0):
+        return _call(lambda a: jnp.round(a, decimals), self)
+
+    def dot(self, b):
+        return dot(self, b)
+
+    def as_nd_ndarray(self):
+        """View as classic mx.nd NDArray (reference: multiarray.py
+        as_nd_ndarray); taped as identity so grads flow across."""
+        return self._alias_view(NDArray(self._data))
+
+    def as_np_ndarray(self):
+        return self
+
+    def copy(self):
+        return ndarray(jnp.array(self._data, copy=True))
+
+
+def _as_jax(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x
+    if isinstance(x, numeric_types):
+        return x
+    return jnp.asarray(x)
+
+
+def _has_bool_mask(key):
+    def is_mask(k):
+        if isinstance(k, NDArray):
+            k = k.data
+        return isinstance(k, (jax.Array, onp.ndarray)) and \
+            onp.dtype(k.dtype) == onp.bool_
+    if isinstance(key, tuple):
+        return builtins.any(is_mask(k) for k in key)
+    return is_mask(key)
+
+
+def _to_host_index(key):
+    def conv(k):
+        if isinstance(k, NDArray):
+            return onp.asarray(k.data)
+        if isinstance(k, jax.Array):
+            return onp.asarray(k)
+        return k
+    if isinstance(key, tuple):
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+def _expand_bool_keys(key):
+    """Replace boolean masks in an index with their integer nonzero()
+    index arrays (numpy's documented equivalence), host-side."""
+    def expand(k):
+        if isinstance(k, NDArray):
+            k = k.data
+        if isinstance(k, (jax.Array, onp.ndarray)) and \
+                onp.dtype(k.dtype) == onp.bool_:
+            return tuple(jnp.asarray(i) for i in onp.nonzero(onp.asarray(k)))
+        return (k,)
+    if isinstance(key, tuple):
+        out = []
+        for k in key:
+            out.extend(expand(k))
+        return tuple(out)
+    expanded = expand(key)
+    return expanded[0] if len(expanded) == 1 else expanded
+
+
+# ---- taped dispatch ------------------------------------------------------
+
+def _call(fn, *arrays):
+    """Run a pure jnp fn over NDArray args through the taped registry path."""
+    opdef = _reg.OpDef(getattr(fn, "__name__", "np_lambda"), fn,
+                       True, None, ())
+    return _reg.invoke(opdef, arrays, {})
+
+
+def _np(res):
+    """Coerce results (possibly nested) to np.ndarray, keeping the tape
+    connected via an identity edge when rewrapping a base NDArray."""
+    if isinstance(res, ndarray):
+        return res
+    if isinstance(res, NDArray):
+        return res._alias_view(ndarray(res._data))
+    if isinstance(res, (list, tuple)):
+        return type(res)(_np(r) for r in res)
+    return res
+
+
+# ---- creation ------------------------------------------------------------
+
+def array(object, dtype=None, ctx=None):
+    """reference: numpy/multiarray.py array()."""
+    if isinstance(object, NDArray):
+        object = object.data
+    dtype = _canon_dtype(dtype)
+    if dtype is None:
+        if isinstance(object, (onp.ndarray, jax.Array)):
+            dtype = object.dtype
+            if dtype == onp.float64:
+                dtype = _float32
+        elif isinstance(object, (bool, onp.bool_)):
+            dtype = onp.bool_
+        else:
+            # mx.np defaults to float32 for python scalars/sequences
+            # (reference: multiarray.py array(), default_dtype=float32)
+            dtype = _float32
+    return ndarray(_nd_mod._put(jnp.asarray(object, dtype=dtype), ctx))
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, ndarray) and dtype is None:
+        return a
+    return array(a, dtype=dtype)
+
+
+def _shape_tuple(shape):
+    return (shape,) if isinstance(shape, (int, onp.integer)) else tuple(shape)
+
+
+def zeros(shape, dtype=_float32, ctx=None):
+    return ndarray(_nd_mod._put(
+        jnp.zeros(_shape_tuple(shape), _canon_dtype(dtype) or _float32), ctx))
+
+
+def ones(shape, dtype=_float32, ctx=None):
+    return ndarray(_nd_mod._put(
+        jnp.ones(_shape_tuple(shape), _canon_dtype(dtype) or _float32), ctx))
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    if dtype is None:
+        dtype = _float32 if isinstance(fill_value, float) else None
+    return ndarray(_nd_mod._put(
+        jnp.full(_shape_tuple(shape), fill_value, _canon_dtype(dtype)), ctx))
+
+
+def empty(shape, dtype=_float32, ctx=None):
+    return zeros(shape, dtype, ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _call(lambda x: jnp.zeros_like(x, _canon_dtype(dtype)), asarray(a))
+
+
+def ones_like(a, dtype=None):
+    return _call(lambda x: jnp.ones_like(x, _canon_dtype(dtype)), asarray(a))
+
+
+def full_like(a, fill_value, dtype=None):
+    return _call(lambda x: jnp.full_like(x, fill_value, _canon_dtype(dtype)),
+                 asarray(a))
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    dtype = _canon_dtype(dtype)
+    if dtype is None:
+        dtype = _float32  # mx.np default is float32, unlike numpy
+    return ndarray(_nd_mod._put(jnp.arange(start, stop, step, dtype), ctx))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    r = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                     dtype=_canon_dtype(dtype) or _float32, axis=axis)
+    if retstep:
+        return ndarray(_nd_mod._put(r[0], ctx)), float(r[1])
+    return ndarray(_nd_mod._put(r, ctx))
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return ndarray(_nd_mod._put(
+        jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                     dtype=_canon_dtype(dtype) or _float32, axis=axis), ctx))
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0):
+    return ndarray(jnp.geomspace(start, stop, num, endpoint=endpoint,
+                                 dtype=_canon_dtype(dtype) or _float32,
+                                 axis=axis))
+
+
+def eye(N, M=None, k=0, dtype=_float32, ctx=None):
+    return ndarray(_nd_mod._put(
+        jnp.eye(N, M, k, _canon_dtype(dtype) or _float32), ctx))
+
+
+def identity(n, dtype=_float32, ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def tri(N, M=None, k=0, dtype=_float32):
+    return ndarray(jnp.tri(N, M, k, _canon_dtype(dtype) or _float32))
+
+
+def meshgrid(*xi, indexing="xy"):
+    outs = _call(lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing)),
+                 *[asarray(x) for x in xi])
+    return [_np(o) for o in (outs if isinstance(outs, (list, tuple))
+                             else (outs,))]
+
+
+def indices(dimensions, dtype=onp.int32):
+    return ndarray(jnp.indices(tuple(dimensions), _canon_dtype(dtype)))
+
+
+def tril_indices(n, k=0, m=None):
+    r, c = jnp.tril_indices(n, k, m)
+    return ndarray(r), ndarray(c)
+
+
+def copy(a):
+    return asarray(a).copy()
+
+
+# ---- dynamic-shape ops (eager escape hatch) ------------------------------
+
+def _eager_only(name, a):
+    if isinstance(a, NDArray) and _is_tracer(a.data):
+        raise MXNetError(
+            f"np.{name} has a data-dependent output shape and cannot run "
+            "inside jit (XLA static shapes); run it eagerly")
+
+
+def nonzero(a):
+    """reference: src/operator/numpy/np_nonzero_op.cc (sync-exec op)."""
+    a = asarray(a)
+    _eager_only("nonzero", a)
+    outs = onp.nonzero(onp.asarray(a.data))
+    return tuple(ndarray(jnp.asarray(o)) for o in outs)
+
+
+def flatnonzero(a):
+    a = asarray(a)
+    _eager_only("flatnonzero", a)
+    return ndarray(jnp.asarray(onp.flatnonzero(onp.asarray(a.data))))
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    """reference: src/operator/numpy/np_unique_op.cc."""
+    ar = asarray(ar)
+    _eager_only("unique", ar)
+    res = onp.unique(onp.asarray(ar.data), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(ndarray(jnp.asarray(r)) for r in res)
+    return ndarray(jnp.asarray(res))
+
+
+def delete(arr, obj, axis=None):
+    arr = asarray(arr)
+    _eager_only("delete", arr)
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.data)
+    return ndarray(jnp.asarray(
+        onp.delete(onp.asarray(arr.data), obj, axis=axis)))
+
+
+def insert(arr, obj, values, axis=None):
+    arr = asarray(arr)
+    _eager_only("insert", arr)
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.data)
+    if isinstance(values, NDArray):
+        values = onp.asarray(values.data)
+    return ndarray(jnp.asarray(
+        onp.insert(onp.asarray(arr.data), obj, values, axis=axis)))
+
+
+# ---- hand-written multi-arg / special-case functions ---------------------
+
+def true_divide(x1, x2):
+    return _binary(jnp.true_divide, x1, x2)
+
+
+def floor_divide(x1, x2):
+    return _binary(jnp.floor_divide, x1, x2)
+
+
+def _binary(jfn, x1, x2, **kw):
+    a1, a2 = isinstance(x1, NDArray), isinstance(x2, NDArray)
+    if a1 and a2:
+        return _np(_call(lambda a, b: jfn(a, b, **kw), x1, x2))
+    if a1:
+        return _np(_call(lambda a: jfn(a, x2 if isinstance(
+            x2, numeric_types) else jnp.asarray(x2), **kw), x1))
+    if a2:
+        return _np(_call(lambda b: jfn(x1 if isinstance(
+            x1, numeric_types) else jnp.asarray(x1), b, **kw), x2))
+    return _np(ndarray(jfn(jnp.asarray(x1), jnp.asarray(x2), **kw)))
+
+
+def dot(a, b, out=None):
+    r = _binary(jnp.dot, asarray(a), asarray(b))
+    if out is not None:
+        out._data = jnp.asarray(r.data, out._data.dtype)
+        return out
+    return r
+
+
+def matmul(a, b):
+    return _binary(jnp.matmul, asarray(a), asarray(b))
+
+
+def vdot(a, b):
+    return _binary(jnp.vdot, asarray(a), asarray(b))
+
+
+def inner(a, b):
+    return _binary(jnp.inner, asarray(a), asarray(b))
+
+
+def outer(a, b):
+    return _binary(jnp.outer, asarray(a), asarray(b))
+
+
+def kron(a, b):
+    return _binary(jnp.kron, asarray(a), asarray(b))
+
+
+def cross(a, b, axis=-1):
+    return _binary(functools.partial(jnp.cross, axis=axis),
+                   asarray(a), asarray(b))
+
+
+def tensordot(a, b, axes=2):
+    """reference: src/operator/numpy/np_tensordot_op.cc."""
+    return _binary(lambda x, y: jnp.tensordot(x, y, axes=axes),
+                   asarray(a), asarray(b))
+
+
+def einsum(subscripts, *operands, optimize=False):
+    """reference: src/operator/numpy/np_einsum_op.cc (+ path optimizer)."""
+    ops = [asarray(o) for o in operands]
+    return _np(_call(
+        lambda *xs: jnp.einsum(subscripts, *xs,
+                               optimize="optimal" if optimize else False),
+        *ops))
+
+
+def where(condition, x=None, y=None):
+    condition = asarray(condition)
+    if x is None and y is None:
+        return nonzero(condition)
+    x, y = asarray(x), asarray(y)
+    return _np(_call(jnp.where, condition, x, y))
+
+
+def concatenate(seq, axis=0, out=None):
+    arrs = [asarray(a) for a in seq]
+    r = _np(_call(lambda *xs: jnp.concatenate(xs, axis=axis), *arrs))
+    if out is not None:
+        out._data = r.data
+        return out
+    return r
+
+
+def stack(arrays, axis=0, out=None):
+    arrs = [asarray(a) for a in arrays]
+    r = _np(_call(lambda *xs: jnp.stack(xs, axis=axis), *arrs))
+    if out is not None:
+        out._data = r.data
+        return out
+    return r
+
+
+def vstack(tup):
+    return _np(_call(lambda *xs: jnp.vstack(xs), *[asarray(a) for a in tup]))
+
+
+def hstack(tup):
+    return _np(_call(lambda *xs: jnp.hstack(xs), *[asarray(a) for a in tup]))
+
+
+def dstack(tup):
+    return _np(_call(lambda *xs: jnp.dstack(xs), *[asarray(a) for a in tup]))
+
+
+def column_stack(tup):
+    return _np(_call(lambda *xs: jnp.column_stack(xs),
+                     *[asarray(a) for a in tup]))
+
+
+def split(ary, indices_or_sections, axis=0):
+    outs = _call(lambda x: tuple(jnp.split(x, indices_or_sections,
+                                           axis=axis)), asarray(ary))
+    return [_np(o) for o in outs]
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    outs = _call(lambda x: tuple(jnp.array_split(x, indices_or_sections,
+                                                 axis=axis)), asarray(ary))
+    return [_np(o) for o in outs]
+
+
+def hsplit(ary, indices_or_sections):
+    return split(asarray(ary), indices_or_sections,
+                 axis=1 if asarray(ary).ndim > 1 else 0)
+
+
+def vsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=0)
+
+
+def dsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=2)
+
+
+def broadcast_arrays(*args):
+    outs = _call(lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                 *[asarray(a) for a in args])
+    return [_np(o) for o in outs]
+
+
+def atleast_1d(*arys):
+    outs = [_np(_call(jnp.atleast_1d, asarray(a))) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*arys):
+    outs = [_np(_call(jnp.atleast_2d, asarray(a))) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*arys):
+    outs = [_np(_call(jnp.atleast_3d, asarray(a))) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def pad(array_, pad_width, mode="constant", **kwargs):
+    return _np(_call(
+        lambda a: jnp.pad(a, pad_width, mode=mode, **kwargs),
+        asarray(array_)))
+
+
+def take(a, indices, axis=None, mode="clip"):
+    a = asarray(a)
+    if isinstance(indices, NDArray):
+        return _np(_call(
+            lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis,
+                                  mode=mode), a, asarray(indices)))
+    return _np(_call(
+        lambda x: jnp.take(x, jnp.asarray(indices), axis=axis, mode=mode), a))
+
+
+def take_along_axis(arr, indices, axis):
+    return _np(_call(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+        asarray(arr), asarray(indices)))
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    r = _np(_call(lambda x: jnp.clip(x, a_min, a_max), asarray(a)))
+    if out is not None:
+        out._data = r.data
+        return out
+    return r
+
+
+def average(a, axis=None, weights=None, returned=False):
+    a = asarray(a)
+    if weights is None:
+        r = _np(_call(lambda x: jnp.mean(x, axis=axis), a))
+        scl = full(r.shape if r.shape else (), float(
+            a.size / builtins.max(r.size, 1)))
+    else:
+        w = asarray(weights)
+        r = _np(_call(
+            lambda x, ww: jnp.average(x, axis=axis, weights=ww), a, w))
+        scl = _np(_call(lambda ww: jnp.sum(ww, axis=axis), w))
+    return (r, scl) if returned else r
+
+
+def bincount(x, weights=None, minlength=0):
+    x = asarray(x)
+    _eager_only("bincount", x)
+    w = onp.asarray(asarray(weights).data) if weights is not None else None
+    return ndarray(jnp.asarray(
+        onp.bincount(onp.asarray(x.data).astype(onp.int64), w, minlength)))
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    a = asarray(a)
+    _eager_only("histogram", a)
+    h, edges = onp.histogram(onp.asarray(a.data), bins=bins, range=range,
+                             weights=weights, density=density)
+    return ndarray(jnp.asarray(h)), ndarray(jnp.asarray(edges))
+
+
+def interp(x, xp, fp, left=None, right=None):
+    return _np(_call(
+        lambda a, b, c: jnp.interp(a, b, c, left=left, right=right),
+        asarray(x), asarray(xp), asarray(fp)))
+
+
+def diff(a, n=1, axis=-1):
+    return _np(_call(lambda x: jnp.diff(x, n=n, axis=axis), asarray(a)))
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _np(_call(
+        lambda x: jnp.ediff1d(x, to_end=to_end, to_begin=to_begin),
+        asarray(ary)))
+
+
+def gradient(f, *varargs, axis=None):
+    res = _call(lambda x: _tup(jnp.gradient(x, *varargs, axis=axis)),
+                asarray(f))
+    if isinstance(res, (list, tuple)):
+        return [_np(r) for r in res]
+    return _np(res)
+
+
+def _tup(r):
+    return tuple(r) if isinstance(r, list) else r
+
+
+def searchsorted(a, v, side="left"):
+    return _np(_call(lambda x, y: jnp.searchsorted(x, y, side=side),
+                     asarray(a), asarray(v)))
+
+
+def digitize(x, bins, right=False):
+    return _np(_call(lambda a, b: jnp.digitize(a, b, right=right),
+                     asarray(x), asarray(bins)))
+
+
+def repeat(a, repeats, axis=None):
+    return _np(_call(lambda x: jnp.repeat(x, repeats, axis=axis), asarray(a)))
+
+
+def tile(A, reps):
+    return _np(_call(lambda x: jnp.tile(x, reps), asarray(A)))
+
+
+def roll(a, shift, axis=None):
+    return _np(_call(lambda x: jnp.roll(x, shift, axis=axis), asarray(a)))
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _np(_call(lambda x: jnp.rot90(x, k, axes), asarray(m)))
+
+
+def flip(m, axis=None):
+    return _np(_call(lambda x: jnp.flip(x, axis=axis), asarray(m)))
+
+
+def fliplr(m):
+    return _np(_call(jnp.fliplr, asarray(m)))
+
+
+def flipud(m):
+    return _np(_call(jnp.flipud, asarray(m)))
+
+
+def moveaxis(a, source, destination):
+    return _np(_call(lambda x: jnp.moveaxis(x, source, destination),
+                     asarray(a)))
+
+
+def swapaxes(a, axis1, axis2):
+    return _np(_call(lambda x: jnp.swapaxes(x, axis1, axis2), asarray(a)))
+
+
+def transpose(a, axes=None):
+    return _np(_call(lambda x: jnp.transpose(x, axes), asarray(a)))
+
+
+def expand_dims(a, axis):
+    return _np(_call(lambda x: jnp.expand_dims(x, axis), asarray(a)))
+
+
+def squeeze(a, axis=None):
+    return _np(_call(lambda x: jnp.squeeze(x, axis), asarray(a)))
+
+
+def reshape(a, newshape, order="C"):
+    return _np(_call(lambda x: jnp.reshape(x, newshape), asarray(a)))
+
+
+def ravel(a, order="C"):
+    return _np(_call(jnp.ravel, asarray(a)))
+
+
+def broadcast_to(array_, shape):
+    return _np(_call(lambda x: jnp.broadcast_to(x, _shape_tuple(shape)),
+                     asarray(array_)))
+
+
+def tril(m, k=0):
+    return _np(_call(lambda x: jnp.tril(x, k), asarray(m)))
+
+
+def triu(m, k=0):
+    return _np(_call(lambda x: jnp.triu(x, k), asarray(m)))
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _np(_call(lambda x: jnp.trace(x, offset, axis1, axis2),
+                     asarray(a)))
+
+
+def diag(v, k=0):
+    return _np(_call(lambda x: jnp.diag(x, k), asarray(v)))
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _np(_call(lambda x: jnp.diagonal(x, offset, axis1, axis2),
+                     asarray(a)))
+
+
+def diagflat(v, k=0):
+    return _np(_call(lambda x: jnp.diagflat(x, k), asarray(v)))
+
+
+def sort(a, axis=-1, kind=None):
+    return _np(_call(lambda x: jnp.sort(x, axis=axis), asarray(a)))
+
+
+def argsort(a, axis=-1, kind=None):
+    return _np(_call(lambda x: jnp.argsort(x, axis=axis), asarray(a),))
+
+
+def partition(a, kth, axis=-1):
+    return _np(_call(lambda x: jnp.partition(x, kth, axis=axis), asarray(a)))
+
+
+def argpartition(a, kth, axis=-1):
+    return _np(_call(lambda x: jnp.argpartition(x, kth, axis=axis),
+                     asarray(a)))
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _np(_call(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        asarray(x)))
+
+
+def around(a, decimals=0):
+    return _np(_call(lambda x: jnp.around(x, decimals), asarray(a)))
+
+
+round_ = around
+
+
+def fix(x):
+    return _np(_call(jnp.fix, asarray(x)))
+
+
+def may_share_memory(a, b):
+    return False  # functional runtime: every op produces a fresh buffer
+
+
+shares_memory = may_share_memory
+
+
+def result_type(*arrays_and_dtypes):
+    args = [a.data if isinstance(a, NDArray) else a
+            for a in arrays_and_dtypes]
+    return jnp.result_type(*args)
+
+
+def can_cast(from_, to):
+    if isinstance(from_, NDArray):
+        from_ = from_.data.dtype
+    return onp.can_cast(onp.dtype(from_) if not isinstance(from_, onp.dtype)
+                        else from_, to)
+
+
+def shape(a):
+    return asarray(a).shape
+
+
+def ndim(a):
+    return asarray(a).ndim
+
+
+def size(a, axis=None):
+    a = asarray(a)
+    return a.shape[axis] if axis is not None else a.size
+
+
+def vander(x, N=None, increasing=False):
+    return _np(_call(lambda a: jnp.vander(a, N, increasing), asarray(x)))
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    return ndarray(jnp.apply_along_axis(
+        lambda s: _raw(func1d(ndarray(s), *args, **kwargs)),
+        axis, asarray(arr).data))
+
+
+def _raw(x):
+    return x.data if isinstance(x, NDArray) else x
+
+
+# ---- generated single-array elementwise + reductions ---------------------
+
+_UNARY = [
+    "negative", "positive", "absolute", "fabs", "sign", "rint",
+    "ceil", "floor", "trunc", "sqrt", "cbrt", "square", "reciprocal",
+    "exp", "expm1", "exp2", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "deg2rad", "rad2deg",
+    "isnan", "isinf", "isfinite", "isposinf", "isneginf", "iscomplex",
+    "isreal", "signbit", "invert", "logical_not", "conj", "conjugate",
+    "real", "imag", "angle", "i0", "sinc",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "mod", "remainder", "fmod",
+    "power", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "arctan2", "hypot", "copysign", "nextafter", "ldexp", "heaviside",
+    "logaddexp", "logaddexp2", "gcd", "lcm",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift", "right_shift",
+    "logical_and", "logical_or", "logical_xor",
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "isclose", "allclose", "array_equal",
+]
+_REDUCE = {
+    "sum": jnp.sum, "prod": jnp.prod, "max": jnp.max, "min": jnp.min,
+    "amax": jnp.max, "amin": jnp.min, "mean": jnp.mean,
+    "nansum": jnp.nansum, "nanprod": jnp.nanprod, "nanmax": jnp.nanmax,
+    "nanmin": jnp.nanmin, "nanmean": jnp.nanmean,
+    "argmax": jnp.argmax, "argmin": jnp.argmin,
+    "nanargmax": jnp.nanargmax, "nanargmin": jnp.nanargmin,
+    "any": jnp.any, "all": jnp.all,
+    "cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
+    "nancumsum": jnp.nancumsum, "nancumprod": jnp.nancumprod,
+    "median": jnp.median, "nanmedian": jnp.nanmedian,
+    "count_nonzero": jnp.count_nonzero,
+    "ptp": jnp.ptp,
+}
+
+
+def _install():
+    g = globals()
+    for name in _UNARY:
+        if name in g:
+            continue
+        jfn = getattr(jnp, name)
+
+        def make_u(jfn_, name_):
+            def f(x, out=None, **kw):
+                r = _np(_call(lambda a: jfn_(a), asarray(x)))
+                if out is not None:
+                    out._data = r.data
+                    return out
+                return r
+            f.__name__ = name_
+            return f
+        g[name] = make_u(jfn, name)
+    g["abs"] = g["absolute"]
+
+    for name in _BINARY:
+        if name in g:
+            continue
+        jfn = getattr(jnp, name)
+
+        def make_b(jfn_, name_):
+            def f(x1, x2, out=None, **kw):
+                r = _binary(jfn_, x1, x2)
+                if name_ in ("allclose", "array_equal"):
+                    return bool(r.asscalar()) if isinstance(r, NDArray) else bool(r)
+                if out is not None:
+                    out._data = r.data
+                    return out
+                return r
+            f.__name__ = name_
+            return f
+        g[name] = make_b(jfn, name)
+
+    for name, jfn in _REDUCE.items():
+        if name in g:
+            continue
+
+        def make_r(jfn_, name_):
+            def f(a, axis=None, out=None, keepdims=False, **kw):
+                kwargs = {"axis": axis}
+                if name_ not in ("argmax", "argmin", "nanargmax",
+                                 "nanargmin", "cumsum", "cumprod",
+                                 "nancumsum", "nancumprod"):
+                    kwargs["keepdims"] = keepdims
+                if "dtype" in kw and kw["dtype"] is not None and \
+                        name_ in ("sum", "prod", "mean", "cumsum", "cumprod",
+                                  "nansum", "nanprod", "nanmean"):
+                    kwargs["dtype"] = _canon_dtype(kw["dtype"])
+                r = _np(_call(lambda x: jfn_(x, **kwargs), asarray(a)))
+                if out is not None:
+                    out._data = r.data
+                    return out
+                return r
+            f.__name__ = name_
+            return f
+        g[name] = make_r(jfn, name)
+
+    # std/var with ddof
+    def _make_sv(jfn_, name_):
+        def f(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+            r = _np(_call(lambda x: jfn_(x, axis=axis, ddof=ddof,
+                                         keepdims=keepdims), asarray(a)))
+            if dtype is not None:
+                r = r.astype(dtype)
+            if out is not None:
+                out._data = r.data
+                return out
+            return r
+        f.__name__ = name_
+        return f
+    g["std"] = _make_sv(jnp.std, "std")
+    g["var"] = _make_sv(jnp.var, "var")
+    g["nanstd"] = _make_sv(jnp.nanstd, "nanstd")
+    g["nanvar"] = _make_sv(jnp.nanvar, "nanvar")
+
+    def quantile(a, q, axis=None, keepdims=False, interpolation="linear"):
+        return _np(_call(
+            lambda x: jnp.quantile(x, jnp.asarray(q), axis=axis,
+                                   keepdims=keepdims,
+                                   method=interpolation), asarray(a)))
+    g["quantile"] = quantile
+
+    def percentile(a, q, axis=None, keepdims=False,
+                   interpolation="linear"):
+        return _np(_call(
+            lambda x: jnp.percentile(x, jnp.asarray(q), axis=axis,
+                                     keepdims=keepdims,
+                                     method=interpolation), asarray(a)))
+    g["percentile"] = percentile
+
+
+_install()
+
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+
+__all__ = [n for n in dir() if not n.startswith("_")]

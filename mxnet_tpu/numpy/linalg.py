@@ -1,0 +1,103 @@
+"""``mx.np.linalg`` (reference: python/mxnet/numpy/linalg.py; C++ ops
+src/operator/numpy/linalg/ and src/operator/tensor/la_op.cc via LAPACK).
+
+On TPU these lower to jax.lax.linalg primitives (QR/cholesky/eigh/SVD run
+on the MXU where XLA supports it, else via host offload) — no LAPACK
+binding needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _call, _np, asarray, ndarray
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _np(_call(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                               keepdims=keepdims),
+                     asarray(x)))
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    r = _call(lambda x: jnp.linalg.svd(x, full_matrices=full_matrices,
+                                       compute_uv=compute_uv), asarray(a))
+    return _np(r)
+
+
+def cholesky(a):
+    return _np(_call(jnp.linalg.cholesky, asarray(a)))
+
+
+def qr(a, mode="reduced"):
+    return _np(_call(lambda x: jnp.linalg.qr(x, mode=mode), asarray(a)))
+
+
+def inv(a):
+    return _np(_call(jnp.linalg.inv, asarray(a)))
+
+
+def pinv(a, rcond=1e-15):
+    return _np(_call(lambda x: jnp.linalg.pinv(x, rcond=rcond), asarray(a)))
+
+
+def det(a):
+    return _np(_call(jnp.linalg.det, asarray(a)))
+
+
+def slogdet(a):
+    return _np(_call(jnp.linalg.slogdet, asarray(a)))
+
+
+def solve(a, b):
+    return _np(_call(jnp.linalg.solve, asarray(a), asarray(b)))
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    x, res, rank, sv = _call(
+        lambda A, B: jnp.linalg.lstsq(A, B, rcond=rc),
+        asarray(a), asarray(b))
+    return _np(x), _np(res), int(rank.asscalar()), _np(sv)
+
+
+def eig(a):
+    w, v = jnp.linalg.eig(asarray(a).data)  # complex output: not taped
+    return ndarray(w), ndarray(v)
+
+
+def eigh(a, UPLO="L"):
+    return _np(_call(lambda x: jnp.linalg.eigh(x, UPLO=UPLO), asarray(a)))
+
+
+def eigvals(a):
+    return ndarray(jnp.linalg.eigvals(asarray(a).data))
+
+
+def eigvalsh(a, UPLO="L"):
+    return _np(_call(lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO),
+                     asarray(a)))
+
+
+def matrix_rank(M, tol=None):
+    return _np(_call(lambda x: jnp.linalg.matrix_rank(x, tol), asarray(M)))
+
+
+def matrix_power(a, n):
+    return _np(_call(lambda x: jnp.linalg.matrix_power(x, n), asarray(a)))
+
+
+def multi_dot(arrays):
+    return _np(_call(lambda *xs: jnp.linalg.multi_dot(xs),
+                     *[asarray(a) for a in arrays]))
+
+
+def tensorinv(a, ind=2):
+    return _np(_call(lambda x: jnp.linalg.tensorinv(x, ind), asarray(a)))
+
+
+def tensorsolve(a, b, axes=None):
+    return _np(_call(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                     asarray(a), asarray(b)))
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

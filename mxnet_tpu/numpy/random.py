@@ -1,0 +1,216 @@
+"""``mx.np.random`` (reference: python/mxnet/numpy/random.py; C++ ops
+src/operator/numpy/random/).
+
+Draws consume keys from the global counter-based PRNG stream
+(mxnet_tpu.random.next_key) — the TPU replacement for the reference's
+per-thread Philox states (include/mxnet/random_generator.h); under jit,
+the key-provider stack keeps sampling pure (randomness is an argument).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _gr
+from ..ndarray.ndarray import NDArray, _canon_dtype
+from . import asarray, ndarray
+
+_f32 = jnp.float32
+
+
+def seed(s):
+    _gr.seed(s)
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, (int, onp.integer)):
+        return (size,)
+    return tuple(size)
+
+
+def _wrap(x, dtype=None):
+    if dtype is not None:
+        x = x.astype(_canon_dtype(dtype))
+    return ndarray(x)
+
+
+def _param_shape(size, *params):
+    """size=None broadcasts to the distribution-parameter shape
+    (reference: np_uniform etc. infer output shape from params)."""
+    if size is not None:
+        return _shape(size)
+    return jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    low = low.data if isinstance(low, NDArray) else low
+    high = high.data if isinstance(high, NDArray) else high
+    return _wrap(jax.random.uniform(_gr.next_key(),
+                                    _param_shape(size, low, high), _f32,
+                                    minval=low, maxval=high), dtype)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    loc = loc.data if isinstance(loc, NDArray) else loc
+    scale = scale.data if isinstance(scale, NDArray) else scale
+    return _wrap(jax.random.normal(_gr.next_key(), _shape(size), _f32)
+                 * scale + loc, dtype)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    low = low.data if isinstance(low, NDArray) else low
+    high = high.data if isinstance(high, NDArray) else high
+    return _wrap(jax.random.randint(_gr.next_key(),
+                                    _param_shape(size, low, high), low,
+                                    high, _canon_dtype(dtype) or jnp.int32))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    if isinstance(a, (int, onp.integer)):
+        a = jnp.arange(a)
+    else:
+        a = asarray(a).data
+    p = asarray(p).data if p is not None else None
+    return _wrap(jax.random.choice(_gr.next_key(), a, _shape(size), replace,
+                                   p))
+
+
+def permutation(x):
+    if isinstance(x, (int, onp.integer)):
+        x = jnp.arange(x)
+    else:
+        x = asarray(x).data
+    return _wrap(jax.random.permutation(_gr.next_key(), x))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference: np_shuffle)."""
+    x._data = jax.random.permutation(_gr.next_key(), x.data)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    a = a.data if isinstance(a, NDArray) else a
+    b = b.data if isinstance(b, NDArray) else b
+    return _wrap(jax.random.beta(_gr.next_key(), a, b, _shape(size), _f32),
+                 dtype)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    shape_p = shape.data if isinstance(shape, NDArray) else shape
+    scale = scale.data if isinstance(scale, NDArray) else scale
+    return _wrap(jax.random.gamma(_gr.next_key(), shape_p, _shape(size),
+                                  _f32) * scale, dtype)
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    scale = scale.data if isinstance(scale, NDArray) else scale
+    return _wrap(jax.random.exponential(_gr.next_key(), _shape(size), _f32)
+                 * scale)
+
+
+def poisson(lam=1.0, size=None, ctx=None):
+    lam = lam.data if isinstance(lam, NDArray) else lam
+    return _wrap(jax.random.poisson(_gr.next_key(), lam, _shape(size)))
+
+
+def _p(x):
+    """Unwrap an NDArray distribution parameter to its jax.Array."""
+    return x.data if isinstance(x, NDArray) else x
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    loc, scale = _p(loc), _p(scale)
+    return _wrap(jax.random.laplace(_gr.next_key(),
+                                    _param_shape(size, loc, scale), _f32)
+                 * scale + loc, dtype)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None):
+    loc, scale = _p(loc), _p(scale)
+    return _wrap(jax.random.logistic(_gr.next_key(),
+                                     _param_shape(size, loc, scale), _f32)
+                 * scale + loc)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None):
+    loc, scale = _p(loc), _p(scale)
+    return _wrap(jax.random.gumbel(_gr.next_key(),
+                                   _param_shape(size, loc, scale), _f32)
+                 * scale + loc)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
+    mean, sigma = _p(mean), _p(sigma)
+    return _wrap(jnp.exp(jax.random.normal(
+        _gr.next_key(), _param_shape(size, mean, sigma), _f32)
+        * sigma + mean))
+
+
+def pareto(a, size=None, ctx=None):
+    a = _p(a)
+    return _wrap(jax.random.pareto(_gr.next_key(), a,
+                                   _param_shape(size, a), _f32) - 1.0)
+
+
+def power(a, size=None, ctx=None):
+    a = _p(a)
+    u = jax.random.uniform(_gr.next_key(), _param_shape(size, a), _f32)
+    return _wrap(u ** (1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None, ctx=None):
+    scale = _p(scale)
+    u = jax.random.uniform(_gr.next_key(), _param_shape(size, scale), _f32)
+    return _wrap(scale * jnp.sqrt(-2.0 * jnp.log1p(-u)))
+
+
+def weibull(a, size=None, ctx=None):
+    a = _p(a)
+    u = jax.random.uniform(_gr.next_key(), _param_shape(size, a), _f32)
+    return _wrap((-jnp.log1p(-u)) ** (1.0 / a))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None):
+    df = df.data if isinstance(df, NDArray) else df
+    return _wrap(2.0 * jax.random.gamma(_gr.next_key(), df / 2.0,
+                                        _shape(size), _f32), dtype)
+
+
+def multinomial(n, pvals, size=None):
+    pvals = asarray(pvals).data
+    shape = _shape(size)
+    counts = jax.random.multinomial(
+        _gr.next_key(), jnp.asarray(n, _f32),
+        jnp.broadcast_to(pvals, shape + pvals.shape))
+    return _wrap(counts.astype(jnp.int64) if counts.dtype != jnp.int32
+                 else counts)
+
+
+def multivariate_normal(mean, cov, size=None):
+    mean = asarray(mean).data
+    cov = asarray(cov).data
+    return _wrap(jax.random.multivariate_normal(_gr.next_key(), mean, cov,
+                                                _shape(size) or None))
+
+
+def binomial(n, p, size=None, ctx=None):
+    n_ = n.data if isinstance(n, NDArray) else n
+    p_ = p.data if isinstance(p, NDArray) else p
+    return _wrap(jax.random.binomial(_gr.next_key(), n_, p_, _shape(size)))
+
+
+__all__ = [x for x in dir() if not x.startswith("_")]

@@ -1,0 +1,133 @@
+"""``mx.npx``: NumPy-extension namespace — operators beyond the NumPy
+standard (neural-net ops, control, IO) usable on mx.np.ndarray.
+
+Reference: python/mxnet/numpy_extension/__init__.py + the npx op surface
+(python/mxnet/ndarray/numpy_extension/_op.py, npx.set_np in
+python/mxnet/util.py). Ops delegate to the central registry
+(ndarray/registry.py) whose dispatch preserves the np.ndarray subclass.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import numpy as onp
+
+from .. import random as _gr
+from ..base import MXNetError
+from ..ndarray import registry as _reg
+from ..ndarray.ndarray import NDArray
+from ..numpy import ndarray, asarray
+
+_NP_ARRAY = False
+_NP_SHAPE = False
+
+
+def set_np(shape=True, array=True):
+    """Activate NumPy-semantics mode (reference: python/mxnet/util.py
+    set_np). In this rebuild mx.np arrays are always available; the flag
+    switches what Gluon blocks hand to `forward` and zero-dim support."""
+    global _NP_ARRAY, _NP_SHAPE
+    _NP_ARRAY, _NP_SHAPE = array, shape
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def is_np_array():
+    return _NP_ARRAY
+
+
+def is_np_shape():
+    return _NP_SHAPE
+
+
+def use_np_array(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        old = _NP_ARRAY
+        try:
+            set_np(shape=_NP_SHAPE, array=True)
+            return func(*args, **kwargs)
+        finally:
+            set_np(shape=_NP_SHAPE, array=old)
+    return wrapper
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        old = _NP_SHAPE
+        try:
+            set_np(shape=True, array=_NP_ARRAY)
+            return func(*args, **kwargs)
+        finally:
+            set_np(shape=old, array=_NP_ARRAY)
+    return wrapper
+
+
+def use_np(func_or_cls):
+    """Decorator = use_np_shape + use_np_array (reference util.py:use_np)."""
+    if isinstance(func_or_cls, type):
+        return func_or_cls  # np semantics are ambient here
+    return use_np_array(use_np_shape(func_or_cls))
+
+
+def seed(s):
+    _gr.seed(s)
+
+
+def waitall():
+    from ..ndarray import waitall as _nd_waitall
+    _nd_waitall()
+
+
+def save(file, arr):
+    """npx.save — dict/list of np.ndarray (reference: npx.save →
+    MXNDArraySave)."""
+    from ..ndarray import save as _nd_save
+    _nd_save(file, arr)
+
+
+def load(file):
+    from ..ndarray import load as _nd_load
+    out = _nd_load(file)
+    if isinstance(out, dict):
+        return {k: ndarray(v.data) for k, v in out.items()}
+    return [ndarray(v.data) for v in out]
+
+
+def _npx_wrapper(opdef):
+    base = _reg.make_wrapper(opdef)
+
+    @functools.wraps(base)
+    def wrapper(*args, **kwargs):
+        args = tuple(asarray(a) if isinstance(a, (onp.ndarray, list))
+                     else a for a in args)
+        return base(*args, **kwargs)
+    return wrapper
+
+
+# the npx op surface: nn + sequence + indexing extension ops
+_NPX_OPS = [
+    "activation", "batch_norm", "convolution", "deconvolution", "dropout",
+    "embedding", "fully_connected", "layer_norm", "group_norm",
+    "instance_norm", "l2_normalization", "leaky_relu", "lrn", "pooling",
+    "rnn", "softmax", "log_softmax", "softmin", "relu", "sigmoid",
+    "one_hot", "pick", "topk", "gather_nd", "scatter_nd",
+    "sequence_mask", "sequence_last", "sequence_reverse", "slice",
+    "slice_axis", "slice_like", "shape_array", "reshape",
+    "ctc_loss", "stop_gradient", "erf", "erfinv",
+    "index_copy", "index_array", "boolean_mask", "upsampling", "gamma",
+]
+
+_mod = sys.modules[__name__]
+for _name in _NPX_OPS:
+    _opdef = _reg.get_op(_name)
+    if _opdef is not None and not hasattr(_mod, _name):
+        setattr(_mod, _name, _npx_wrapper(_opdef))
+
+from . import random  # noqa: E402,F401
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,36 @@
+"""``mx.npx.random`` — extension sampling ops (reference:
+python/mxnet/ndarray/numpy_extension/random.py: bernoulli etc.)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _gr
+from ..ndarray.ndarray import NDArray
+from ..numpy import ndarray, asarray
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype="float32"):
+    if (prob is None) == (logit is None):
+        raise ValueError("expect exactly one of prob / logit")
+    if prob is not None:
+        p = prob.data if isinstance(prob, NDArray) else prob
+    else:
+        lg = logit.data if isinstance(logit, NDArray) else logit
+        p = jax.nn.sigmoid(jnp.asarray(lg))
+    shape = _shape(size) or jnp.shape(p)
+    return ndarray(jax.random.bernoulli(_gr.next_key(), p, shape)
+                   .astype(dtype))
+
+
+def seed(s):
+    _gr.seed(s)
+
+
+__all__ = ["bernoulli", "seed"]

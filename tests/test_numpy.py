@@ -1,0 +1,320 @@
+"""mx.np / mx.npx tests (reference analog: tests/python/unittest/
+test_numpy_op.py, test_numpy_ndarray.py — 71+ test fns)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    onp.testing.assert_allclose(
+        a.asnumpy() if hasattr(a, "asnumpy") else a,
+        b.asnumpy() if hasattr(b, "asnumpy") else b, rtol=rtol, atol=atol)
+
+
+def test_array_creation():
+    a = np.array([[1, 2], [3, 4]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    z = np.zeros((3, 4))
+    assert z.shape == (3, 4) and float(z.sum()) == 0
+    o = np.ones((2,), dtype="int32")
+    assert o.dtype == onp.int32
+    f = np.full((2, 2), 7.0)
+    assert float(f[0, 0]) == 7.0
+    e = np.eye(3)
+    assert float(e.trace() if hasattr(e, 'trace') else np.trace(e)) == 3.0
+    r = np.arange(5)
+    assert r.shape == (5,) and r.dtype == onp.float32
+    ls = np.linspace(0, 1, 11)
+    assert ls.shape == (11,)
+    assert abs(float(ls[5]) - 0.5) < 1e-6
+
+
+def test_zero_dim_scalar():
+    a = np.array(3.5)
+    assert a.shape == ()
+    assert abs(float(a) - 3.5) < 1e-6
+    b = a + 1
+    assert b.shape == ()
+
+
+def test_elementwise_and_broadcast():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([10.0, 20.0])
+    c = a + b
+    assert isinstance(c, np.ndarray)
+    assert_close(c, onp.array([[11, 22], [13, 24]], dtype=onp.float32))
+    assert_close(np.add(a, b), c)
+    assert_close(np.exp(a), onp.exp(a.asnumpy()))
+    assert_close(np.sqrt(a), onp.sqrt(a.asnumpy()))
+    assert_close(np.maximum(a, 2.5), onp.maximum(a.asnumpy(), 2.5))
+    assert_close(a ** 2, a.asnumpy() ** 2)
+
+
+def test_true_divide_int():
+    a = np.array([1, 2, 3], dtype="int32")
+    r = a / 2
+    assert r.dtype.kind == "f"
+    assert_close(r, onp.array([0.5, 1.0, 1.5], dtype=onp.float32))
+    fd = a // 2
+    assert_close(fd, onp.array([0, 1, 1]))
+
+
+def test_comparisons_bool():
+    a = np.array([1.0, 2.0, 3.0])
+    m = a > 1.5
+    assert m.dtype == onp.bool_
+    assert m.asnumpy().tolist() == [False, True, True]
+
+
+def test_boolean_mask_indexing():
+    a = np.array([1.0, 2.0, 3.0, 4.0])
+    m = a > 2.0
+    sel = a[m]
+    assert sel.shape == (2,)
+    assert_close(sel, onp.array([3.0, 4.0], dtype=onp.float32))
+    a[a < 2.5] = 0.0
+    assert_close(a, onp.array([0, 0, 3, 4], dtype=onp.float32))
+
+
+def test_reductions():
+    x = onp.random.RandomState(0).rand(3, 4).astype(onp.float32)
+    a = np.array(x)
+    assert_close(np.sum(a, axis=1), x.sum(1), rtol=1e-4)
+    assert_close(np.mean(a), x.mean(), rtol=1e-5)
+    assert_close(np.std(a, axis=0), x.std(0), rtol=1e-4)
+    assert_close(np.var(a, ddof=1), x.var(ddof=1), rtol=1e-4)
+    assert_close(a.std(), x.std(), rtol=1e-4)
+    assert int(np.argmax(a)) == int(x.argmax())
+    assert_close(np.cumsum(a, axis=1), x.cumsum(1), rtol=1e-4)
+    assert bool(np.all(a >= 0))
+    assert_close(np.median(a), onp.median(x), rtol=1e-5)
+
+
+def test_shape_manipulation():
+    x = onp.arange(24, dtype=onp.float32).reshape(2, 3, 4)
+    a = np.array(x)
+    assert np.transpose(a).shape == (4, 3, 2)
+    assert a.T.shape == (4, 3, 2)
+    assert np.moveaxis(a, 0, -1).shape == (3, 4, 2)
+    assert np.reshape(a, (6, 4)).shape == (6, 4)
+    assert a.reshape(4, 6).shape == (4, 6)
+    assert np.squeeze(np.expand_dims(a, 0), 0).shape == x.shape
+    st = np.stack([a, a], axis=1)
+    assert st.shape == (2, 2, 3, 4)
+    cc = np.concatenate([a, a], axis=2)
+    assert cc.shape == (2, 3, 8)
+    parts = np.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    assert np.flip(a, 0).shape == x.shape
+    assert_close(np.flip(a, 0), onp.flip(x, 0))
+    assert np.tile(a, (1, 2, 1)).shape == (2, 6, 4)
+    assert np.repeat(a, 2, axis=0).shape == (4, 3, 4)
+    assert np.roll(a, 1, axis=2).shape == x.shape
+    assert np.pad(a, ((0, 0), (1, 1), (0, 0))).shape == (2, 5, 4)
+
+
+def test_linalg_family():
+    rs = onp.random.RandomState(1)
+    m = rs.rand(4, 4).astype(onp.float32)
+    spd = m @ m.T + 4 * onp.eye(4, dtype=onp.float32)
+    a = np.array(spd)
+    assert_close(np.linalg.inv(a), onp.linalg.inv(spd), rtol=1e-2, atol=1e-3)
+    assert abs(float(np.linalg.det(a)) - onp.linalg.det(spd)) / \
+        abs(onp.linalg.det(spd)) < 1e-3
+    L = np.linalg.cholesky(a)
+    assert_close(np.matmul(L, L.T if hasattr(L, 'T') else L),
+                 spd, rtol=1e-3, atol=1e-3)
+    w, v = np.linalg.eigh(a)
+    assert w.shape == (4,)
+    q, r = np.linalg.qr(a)
+    assert_close(np.matmul(q, r), spd, rtol=1e-3, atol=1e-3)
+    b = np.array(rs.rand(4).astype(onp.float32))
+    x = np.linalg.solve(a, b)
+    assert_close(np.matmul(a, x), b, rtol=1e-2, atol=1e-3)
+    assert_close(np.linalg.norm(a), onp.linalg.norm(spd), rtol=1e-4)
+    u, s, vt = np.linalg.svd(np.array(m), full_matrices=False,
+                             compute_uv=True)
+    assert s.shape == (4,)
+
+
+def test_einsum_tensordot():
+    rs = onp.random.RandomState(2)
+    x = rs.rand(3, 4).astype(onp.float32)
+    y = rs.rand(4, 5).astype(onp.float32)
+    a, b = np.array(x), np.array(y)
+    assert_close(np.einsum("ij,jk->ik", a, b), x @ y, rtol=1e-4)
+    assert_close(np.tensordot(a, b, axes=1), x @ y, rtol=1e-4)
+    assert_close(np.dot(a, b), x @ y, rtol=1e-4)
+    assert_close(np.matmul(a, b), x @ y, rtol=1e-4)
+
+
+def test_dynamic_shape_ops():
+    a = np.array([0.0, 1.0, 0.0, 2.0])
+    (idx,) = np.nonzero(a)
+    assert idx.asnumpy().tolist() == [1, 3]
+    u = np.unique(np.array([3, 1, 2, 3, 1]))
+    assert u.asnumpy().tolist() == [1, 2, 3]
+    vals, counts = np.unique(np.array([1, 1, 2]), return_counts=True)
+    assert counts.asnumpy().tolist() == [2, 1]
+
+
+def test_where_sort_takealong():
+    a = np.array([3.0, 1.0, 2.0])
+    assert_close(np.sort(a), onp.array([1, 2, 3], dtype=onp.float32))
+    idx = np.argsort(a)
+    assert idx.asnumpy().tolist() == [1, 2, 0]
+    w = np.where(a > 1.5, a, np.zeros_like(a))
+    assert_close(w, onp.array([3, 0, 2], dtype=onp.float32))
+    t = np.take(a, np.array([0, 2], dtype="int32"))
+    assert_close(t, onp.array([3, 2], dtype=onp.float32))
+
+
+def test_np_autograd():
+    from mxnet_tpu import autograd
+
+    a = np.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(a * a)
+    y.backward()
+    assert_close(a.grad, onp.array([2.0, 4.0, 6.0]), rtol=1e-5)
+    assert isinstance(a.grad, mx.NDArray)
+
+
+def test_np_random():
+    np.random.seed(0)
+    u = np.random.uniform(0, 1, size=(1000,))
+    assert u.shape == (1000,)
+    assert 0.4 < float(np.mean(u)) < 0.6
+    n = np.random.normal(5.0, 0.1, size=(500,))
+    assert 4.9 < float(np.mean(n)) < 5.1
+    r = np.random.randint(0, 10, size=(100,))
+    arr = r.asnumpy()
+    assert arr.min() >= 0 and arr.max() < 10
+    c = np.random.choice(5, size=(20,))
+    assert c.shape == (20,)
+    p = np.random.permutation(10)
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+    g = np.random.gamma(2.0, 1.0, size=(100,))
+    assert float(np.mean(g)) > 0
+
+
+def test_npx_mode_and_ops():
+    npx.set_np()
+    try:
+        assert npx.is_np_array()
+        x = np.array([[1.0, -1.0], [2.0, -2.0]])
+        r = npx.relu(x)
+        assert isinstance(r, np.ndarray)
+        assert_close(r, onp.array([[1, 0], [2, 0]], dtype=onp.float32))
+        s = npx.softmax(x, axis=-1)
+        assert_close(np.sum(s, axis=-1), onp.ones(2), rtol=1e-5)
+        oh = npx.one_hot(np.array([0, 1], dtype="int32"), 3)
+        assert oh.shape == (2, 3)
+    finally:
+        npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_npx_bernoulli():
+    np.random.seed(0)
+    b = npx.random.bernoulli(prob=0.5, size=(200,))
+    m = float(np.mean(b))
+    assert 0.3 < m < 0.7
+
+
+def test_mixed_nd_np():
+    a = np.array([1.0, 2.0])
+    nd_view = a.as_nd_ndarray()
+    assert type(nd_view) is mx.NDArray
+    back = mx.nd.array([1.0]).data
+    assert np.asarray(np.array(back)).shape == (1,)
+
+
+def test_np_in_jit():
+    import jax
+
+    @jax.jit
+    def f(x):
+        a = np.ndarray(x)
+        return np.sum(a * 2).data
+
+    out = f(onp.ones(4, onp.float32))
+    assert float(out) == 8.0
+
+
+def test_grad_flows_through_multi_output_and_views():
+    """Regression: taped path for split/as_nd_ndarray/bool-mask getitem."""
+    from mxnet_tpu import autograd
+
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        a, b = np.split(x, 2)
+        y = np.sum(a) + np.sum(b)
+    y.backward()
+    assert_close(x.grad, onp.ones(4))
+
+    x2 = mx.nd.array([1.0, 2.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = np.multiply(x2.as_np_ndarray(), x2.as_np_ndarray()).sum()
+    y2.backward()
+    assert_close(x2.grad, onp.array([2.0, 4.0]))
+
+    x3 = np.array([1.0, -2.0, 3.0])
+    x3.attach_grad()
+    with autograd.record():
+        z = x3[x3 > 0].sum()
+    z.backward()
+    assert_close(x3.grad, onp.array([1.0, 0.0, 1.0]))
+
+
+def test_bool_mask_setitem_compacted():
+    a = np.array([1.0, -2.0, 3.0])
+    a[a > 0] = np.array([10.0, 30.0])
+    assert_close(a, onp.array([10.0, -2.0, 30.0]))
+
+
+def test_random_param_broadcast():
+    u = np.random.uniform(np.array([0.0, 10.0]), np.array([1.0, 11.0]))
+    assert u.shape == (2,)
+    v = u.asnumpy()
+    assert 0 <= v[0] <= 1 and 10 <= v[1] <= 11
+    r = np.random.randint(np.array([0, 100], dtype="int32"),
+                          np.array([10, 110], dtype="int32"))
+    rv = r.asnumpy()
+    assert 0 <= rv[0] < 10 and 100 <= rv[1] < 110
+
+
+def test_review_regressions_round2():
+    import jax as _jax
+    from mxnet_tpu import autograd
+
+    # NDArray params to shifted/scaled samplers stay raw jax arrays
+    r = np.random.laplace(scale=np.array([1.0, 2.0]))
+    assert isinstance(r._data, _jax.Array) and r.shape == (2,)
+    r2 = np.random.rayleigh(scale=np.array([1.0, 1.0]))
+    assert r2.shape == (2,)
+
+    # bool-mask setitem is rejected under record
+    a = np.array([1.0, -2.0, 3.0])
+    a.attach_grad()
+    with pytest.raises(mx.MXNetError):
+        with autograd.record():
+            a[a > 0] = 0.0
+
+    # comparisons with None follow numpy semantics
+    eqn = np.array([1.0, 2.0]) == None  # noqa: E711
+    assert eqn.asnumpy().tolist() == [False, False]
+    nen = np.array([1.0, 2.0]) != None  # noqa: E711
+    assert nen.asnumpy().tolist() == [True, True]
+
+    # mixed nd/np ops yield np.ndarray in either operand order
+    nd_a, np_b = mx.nd.array([1.0]), np.array([2.0])
+    assert isinstance(nd_a + np_b, np.ndarray)
+    assert isinstance(np_b + nd_a, np.ndarray)
