@@ -87,13 +87,21 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Aggregate (sum over the device group) then apply updater if set
         (reference: kvstore_local.h:206 PushImpl → Comm reduce → updater_)."""
+        from .ndarray import sparse as _sp
+
         keys, values, _ = self._normalize(key, value)
         for k, v in zip(keys, values):
             k = str(k)
             if isinstance(v, (list, tuple)):
                 agg = v[0]
                 for x in v[1:]:
-                    agg = agg + x
+                    # sparse grads reduce sparse (reference: comm.h:478
+                    # row-sparse reduce path)
+                    if isinstance(agg, _sp.BaseSparseNDArray) or \
+                            isinstance(x, _sp.BaseSparseNDArray):
+                        agg = _sp.elemwise_add(agg, x)
+                    else:
+                        agg = agg + x
             else:
                 agg = v
             if self._type.startswith("dist"):
@@ -104,6 +112,10 @@ class KVStore:
                 raise MXNetError(f"key {k} was not initialized")
             if self._updater is not None:
                 self._updater(_key_to_int(k), agg, self._store[k])
+            elif isinstance(agg, _sp.BaseSparseNDArray) or isinstance(
+                    self._store[k], _sp.BaseSparseNDArray):
+                # rebind wholesale: merged result may change nnz/format
+                self._store[k] = _sp.elemwise_add(self._store[k], agg)
             else:
                 self._store[k]._data = (self._store[k] + agg).data
 
@@ -114,6 +126,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} was not initialized")
             src = self._store[k]
+            from .ndarray import sparse as _sp
+
+            if isinstance(src, _sp.BaseSparseNDArray):
+                src = src.todense()
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 t._data = src.data.astype(t.data.dtype)
@@ -126,7 +142,7 @@ class KVStore:
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         keys, outs, _ = self._normalize(key, out)
-        rids, _, _ = self._normalize(key, row_ids)
+        _, rids, _ = self._normalize(key, row_ids)
         for k, o, r in zip(keys, outs, rids):
             k = str(k)
             src = self._store[k]

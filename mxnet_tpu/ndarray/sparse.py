@@ -1,0 +1,422 @@
+"""Sparse NDArray: ``row_sparse`` and ``csr`` storage on the XLA runtime.
+
+TPU-native redesign of the reference sparse storage (reference:
+include/mxnet/ndarray.h:61-82 NDArrayStorageType, python/mxnet/ndarray/
+sparse.py 1637 LoC, kernels under src/operator/tensor/dot-inl.h and
+cast_storage-inl.h). XLA has no native sparse type, so both formats are
+(index array, value array) pairs of dense jax.Arrays — SURVEY §7 hard
+part 4. Everything with a *static* nnz (dot, retain, scatter into dense,
+lazy optimizer rows) runs jit-compatibly on device: CSR×dense matmul is a
+gather + segment-sum, which XLA lowers to MXU-friendly fused scatter
+kernels; only nnz *discovery* (cast_storage from dense) is data-dependent
+and therefore eager-only — the same sync point the reference pays when it
+densifies through kFComputeFallback (src/operator/../op_attr_types.h:129).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _canon_dtype, _is_tracer, array as _dense_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "cast_storage", "dot", "retain", "zeros",
+           "array", "add", "elemwise_add"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base for sparse formats (reference: sparse.py
+    BaseSparseNDArray). ``_data`` holds the *values* array so that generic
+    machinery (dtype inspection, wait_to_read) keeps working; shape is
+    stored explicitly since values.shape != logical shape."""
+
+    __slots__ = ("_sshape",)
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._sshape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    def asnumpy(self):
+        return onp.asarray(self.todense().data)
+
+    def asscalar(self):
+        return self.todense().asscalar()
+
+    def __repr__(self):
+        return f"\n<{type(self).__name__} {self.shape} nnz={self.nnz}>"
+
+    def __getitem__(self, key):  # pragma: no cover - format-specific
+        raise MXNetError(f"indexing not supported on {self.stype}")
+
+    def __setitem__(self, key, value):
+        raise MXNetError(f"__setitem__ not supported on {self.stype}")
+
+    def _dense_op(self, *a, **k):
+        raise MXNetError(
+            f"operation not supported on stype={self.stype}; call "
+            f".tostype('default') first (reference: storage fallback, "
+            f"src/executor/attach_op_execs_pass.cc)")
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self, stype)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(
+                other, BaseSparseNDArray):
+            other._data = self.todense().data
+            return other
+        return super().copyto(other)
+
+    def copy(self):
+        """Deep copy preserving the sparse format (the base NDArray.copy
+        would wrap only the values buffer)."""
+        if isinstance(self, CSRNDArray):
+            return CSRNDArray(jnp.array(self._data, copy=True),
+                              self._indices, self._indptr, self._sshape)
+        return RowSparseNDArray(jnp.array(self._data, copy=True),
+                                self._indices, self._sshape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py:CSRNDArray;
+    aux data layout ndarray.h:82 kIndPtr/kIdx)."""
+
+    __slots__ = ("_indices", "_indptr")
+
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(jnp.asarray(data))
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self._sshape = tuple(int(s) for s in shape)
+        if len(self._sshape) != 2:
+            raise ValueError("CSRNDArray must be 2-D")
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        """The non-zero values (mirrors reference csr.data)."""
+        return NDArray(self._data)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    @property
+    def nnz(self):
+        return int(self._indices.shape[0])
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    def todense(self):
+        m, n = self._sshape
+        row_ids = _csr_row_ids(self._indptr, self.nnz)
+        out = jnp.zeros((m, n), self._data.dtype)
+        out = out.at[row_ids, self._indices].add(self._data)
+        return NDArray(out)
+
+    def slice(self, begin, end):
+        """Row slice (reference: csr slicing keeps csr storage)."""
+        sub = self.todense().data[begin:end]
+        return cast_storage(NDArray(sub), "csr")
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.slice(key.start or 0, key.stop or self._sshape[0])
+        if isinstance(key, int):
+            return NDArray(self.todense().data[key])
+        raise MXNetError("csr supports int/slice row indexing only")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: values[i] is the slice at row indices[i]
+    (reference: sparse.py:RowSparseNDArray, ndarray.h kRowSparseStorage).
+    The storage type of sparse gradients (Embedding, sparse kvstore)."""
+
+    __slots__ = ("_indices",)
+
+    def __init__(self, data, indices, shape):
+        super().__init__(jnp.asarray(data))
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._sshape = tuple(int(s) for s in shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def nnz(self):
+        return int(self._indices.shape[0])
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    def todense(self):
+        out = jnp.zeros(self._sshape, self._data.dtype)
+        if self.nnz:
+            out = out.at[self._indices].add(self._data)
+        return NDArray(out)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return NDArray(self.todense().data[key])
+        raise MXNetError("row_sparse supports int row indexing only")
+
+
+# ---- helpers -------------------------------------------------------------
+
+def _csr_row_ids(indptr, nnz):
+    """Per-nonzero row id from indptr — jit-compatible for static nnz."""
+    return jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right") \
+        .astype(jnp.int32)
+
+
+# ---- creation ------------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference: sparse.py csr_matrix). Accepts
+    (data, indices, indptr) or a dense array-like."""
+    dtype = _canon_dtype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape required with (data, indices, indptr)")
+        return CSRNDArray(data, _raw(indices), _raw(indptr), shape)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference: sparse.py row_sparse_array)."""
+    dtype = _canon_dtype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape required with (data, indices)")
+        return RowSparseNDArray(data, _raw(indices), shape)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    dtype = _canon_dtype(dtype) or jnp.float32
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape)
+    from . import zeros as _dzeros
+    return _dzeros(shape, ctx, dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """mx.nd.sparse.array — copy constructor preserving stype."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array
+    return _dense_array(source_array, ctx, dtype)
+
+
+def _raw(x):
+    return x.data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+# ---- conversion ----------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference:
+    src/operator/tensor/cast_storage-inl.h). Dense→sparse discovers nnz —
+    data-dependent, so eager-only; sparse→dense is a jit-friendly scatter."""
+    if isinstance(arr, BaseSparseNDArray):
+        if stype == "default":
+            return arr.todense()
+        if stype == arr.stype:
+            return arr
+        return cast_storage(arr.todense(), stype)
+    if stype == "default":
+        return arr
+    if _is_tracer(arr.data):
+        raise MXNetError("cast_storage to sparse discovers nnz (dynamic "
+                         "shape) and cannot run inside jit")
+    host = onp.asarray(arr.data)
+    if stype == "row_sparse":
+        if host.ndim < 1:
+            raise ValueError("row_sparse needs ndim >= 1")
+        nz_rows = onp.nonzero(
+            onp.any(host.reshape(host.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(host[nz_rows]),
+                                jnp.asarray(nz_rows, onp.int32), host.shape)
+    if stype == "csr":
+        if host.ndim != 2:
+            raise ValueError("csr needs a 2-D array")
+        rows, cols = onp.nonzero(host)
+        indptr = onp.zeros(host.shape[0] + 1, onp.int32)
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr, dtype=onp.int32)
+        return CSRNDArray(jnp.asarray(host[rows, cols]),
+                          jnp.asarray(cols, onp.int32),
+                          jnp.asarray(indptr), host.shape)
+    raise ValueError(f"unknown stype {stype}")
+
+
+# ---- ops -----------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
+
+    csr × dense         → gather + segment_sum over rows (MXU-friendly)
+    csr.T × dense       → segment_sum scatter over columns
+    dense × row_sparse.T / rsp cases fall back to densify, mirroring the
+    reference's storage-fallback path."""
+    if isinstance(lhs, CSRNDArray) and not isinstance(
+            rhs, BaseSparseNDArray):
+        m, k = lhs.shape
+        nnz = lhs.nnz
+        rhs_d = rhs.data.T if transpose_b else rhs.data
+        row_ids = _csr_row_ids(lhs._indptr, nnz)
+        if transpose_a:
+            out = jax.ops.segment_sum(
+                lhs._data[:, None] * jnp.take(rhs_d, row_ids, axis=0),
+                lhs._indices, num_segments=k)
+            return NDArray(out)
+        vals = lhs._data[:, None] * jnp.take(
+            rhs_d, lhs._indices, axis=0)             # [nnz, n]
+        out = jax.ops.segment_sum(vals, row_ids, num_segments=m)
+        return NDArray(out)
+    lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    a = lhs_d.data.T if transpose_a else lhs_d.data
+    b = rhs_d.data.T if transpose_b else rhs_d.data
+    return NDArray(jnp.dot(a, b))
+
+
+def retain(rsp, row_ids):
+    """Keep only the requested rows (reference: _retain op,
+    src/operator/tensor/sparse_retain-inl.h) — the kvstore
+    row_sparse_pull building block."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    rid = _raw(row_ids).astype(jnp.int32)
+    if rsp.nnz == 0:
+        return RowSparseNDArray(
+            jnp.zeros((int(rid.shape[0]),) + rsp._data.shape[1:],
+                      rsp._data.dtype), rid, rsp.shape)
+    # gather stored rows for each requested id; missing rows → zeros
+    # (static shapes: [nrid, nnz] hit matrix, jit-compatible)
+    hit = rid[:, None] == rsp._indices[None, :]
+    sel = jnp.argmax(hit, axis=1)
+    found = hit.any(axis=1)
+    gathered = jnp.take(rsp._data, sel, axis=0)
+    gathered = jnp.where(found[(...,) + (None,) * (rsp._data.ndim - 1)],
+                         gathered, 0)
+    return RowSparseNDArray(gathered, rid, rsp.shape)
+
+
+def elemwise_add(lhs, rhs):
+    """sparse+sparse / sparse+dense add with reference stype rules
+    (rsp+rsp→rsp; anything else densifies like kFComputeFallback)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(
+            rhs, RowSparseNDArray):
+        idx = jnp.concatenate([lhs._indices, rhs._indices])
+        vals = jnp.concatenate([lhs._data, rhs._data])
+        if _is_tracer(idx) or _is_tracer(vals):
+            # can't discover duplicates under jit: scatter-add into the
+            # full row set (still a valid rsp, rows all stored)
+            full = jnp.zeros(lhs.shape, vals.dtype).at[idx].add(vals)
+            return RowSparseNDArray(full, jnp.arange(lhs.shape[0],
+                                                     dtype=jnp.int32),
+                                    lhs.shape)
+        # merge duplicate rows — consumers (lazy sgd/adam, retain)
+        # require unique indices
+        hidx = onp.asarray(idx)
+        uniq, inv = onp.unique(hidx, return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(vals)
+        return RowSparseNDArray(merged, jnp.asarray(uniq, onp.int32),
+                                lhs.shape)
+    ld = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return NDArray(ld.data + rd.data)
+
+
+add = elemwise_add
+
+
+def sgd_update_rsp(weight, grad_rsp, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None):
+    """Lazy sparse SGD row update (reference: sgd_update w/ row_sparse,
+    src/operator/optimizer_op-inl.h SGDUpdateRspImpl): touch only stored
+    rows — the jit-friendly scatter form."""
+    idx, vals = grad_rsp._indices, grad_rsp._data * rescale_grad
+    if clip_gradient is not None:
+        vals = jnp.clip(vals, -clip_gradient, clip_gradient)
+    w = weight.data
+    rows = jnp.take(w, idx, axis=0)
+    new_rows = rows * (1.0 - lr * wd) - lr * vals
+    return NDArray(w.at[idx].set(new_rows))
+
+
+def adam_update_rsp(weight, grad_rsp, mean, var, lr, beta1, beta2, epsilon,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    """Lazy sparse Adam (reference: AdamUpdateRspImpl,
+    src/operator/optimizer_op-inl.h): moments updated only on stored rows.
+    Returns (weight, mean, var) as dense NDArrays."""
+    idx, g = grad_rsp._indices, grad_rsp._data * rescale_grad
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w, m, v = weight.data, mean.data, var.data
+    w_rows = jnp.take(w, idx, axis=0)
+    g = g + wd * w_rows
+    m_rows = beta1 * jnp.take(m, idx, axis=0) + (1 - beta1) * g
+    v_rows = beta2 * jnp.take(v, idx, axis=0) + (1 - beta2) * g * g
+    w_rows = w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    return (NDArray(w.at[idx].set(w_rows)), NDArray(m.at[idx].set(m_rows)),
+            NDArray(v.at[idx].set(v_rows)))

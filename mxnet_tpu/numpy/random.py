@@ -105,26 +105,28 @@ def shuffle(x):
 def beta(a, b, size=None, dtype=None, ctx=None):
     a = a.data if isinstance(a, NDArray) else a
     b = b.data if isinstance(b, NDArray) else b
-    return _wrap(jax.random.beta(_gr.next_key(), a, b, _shape(size), _f32),
-                 dtype)
+    return _wrap(jax.random.beta(_gr.next_key(), a, b,
+                                 _param_shape(size, a, b), _f32), dtype)
 
 
 def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
     shape_p = shape.data if isinstance(shape, NDArray) else shape
     scale = scale.data if isinstance(scale, NDArray) else scale
-    return _wrap(jax.random.gamma(_gr.next_key(), shape_p, _shape(size),
-                                  _f32) * scale, dtype)
+    return _wrap(jax.random.gamma(
+        _gr.next_key(), shape_p, _param_shape(size, shape_p, scale), _f32)
+        * scale, dtype)
 
 
 def exponential(scale=1.0, size=None, ctx=None):
     scale = scale.data if isinstance(scale, NDArray) else scale
-    return _wrap(jax.random.exponential(_gr.next_key(), _shape(size), _f32)
-                 * scale)
+    return _wrap(jax.random.exponential(
+        _gr.next_key(), _param_shape(size, scale), _f32) * scale)
 
 
 def poisson(lam=1.0, size=None, ctx=None):
     lam = lam.data if isinstance(lam, NDArray) else lam
-    return _wrap(jax.random.poisson(_gr.next_key(), lam, _shape(size)))
+    return _wrap(jax.random.poisson(_gr.next_key(), lam,
+                                    _param_shape(size, lam)))
 
 
 def _p(x):
@@ -187,7 +189,8 @@ def weibull(a, size=None, ctx=None):
 def chisquare(df, size=None, dtype=None, ctx=None):
     df = df.data if isinstance(df, NDArray) else df
     return _wrap(2.0 * jax.random.gamma(_gr.next_key(), df / 2.0,
-                                        _shape(size), _f32), dtype)
+                                        _param_shape(size, df), _f32),
+                 dtype)
 
 
 def multinomial(n, pvals, size=None):

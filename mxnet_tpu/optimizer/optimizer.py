@@ -157,9 +157,20 @@ class SGD(Optimizer):
         return nd.zeros(weight.shape, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray import sparse as _sp
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs()
+        if isinstance(grad, _sp.RowSparseNDArray):
+            # lazy row update (reference: SGDUpdateRspImpl — only stored
+            # rows touched; momentum forces densify like the reference's
+            # std_update path)
+            if state is None and self.lazy_update:
+                _swap(weight, _sp.sgd_update_rsp(weight, grad, lr=lr,
+                                                 wd=wd, **kw))
+                return
+            grad = grad.todense()
         if state is None:
             _swap(weight, nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw))
         else:
@@ -199,21 +210,32 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, dtype=weight.dtype),
                 nd.zeros(weight.shape, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray import sparse as _sp
+
         self._update_count(index)
         t = self._index_update_count[index]
         lr, wd = self._get_lr(index), self._get_wd(index)
         lr *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         mean, var = state
-        w, m, v = nd.adam_update(weight, grad, mean, var, lr=lr,
-                                 beta1=self.beta1, beta2=self.beta2,
-                                 epsilon=self.epsilon, wd=wd,
-                                 **self._common_kwargs())
+        if isinstance(grad, _sp.RowSparseNDArray) and not self.lazy_update:
+            grad = grad.todense()
+        if isinstance(grad, _sp.RowSparseNDArray):
+            w, m, v = _sp.adam_update_rsp(
+                weight, grad, mean, var, lr=lr, beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                **self._common_kwargs())
+        else:
+            w, m, v = nd.adam_update(weight, grad, mean, var, lr=lr,
+                                     beta1=self.beta1, beta2=self.beta2,
+                                     epsilon=self.epsilon, wd=wd,
+                                     **self._common_kwargs())
         _swap(weight, w)
         _swap(mean, m)
         _swap(var, v)

@@ -1,0 +1,231 @@
+"""Sparse NDArray tests (reference analog: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py — 35+ test fns)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def dense_rand(shape, density=0.3, seed=0):
+    rs = onp.random.RandomState(seed)
+    arr = rs.rand(*shape).astype(onp.float32)
+    mask = rs.rand(*shape) < density
+    return arr * mask
+
+
+def test_csr_roundtrip():
+    d = dense_rand((6, 8))
+    a = nd.array(d)
+    csr = sparse.cast_storage(a, "csr")
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 8)
+    assert csr.nnz == int((d != 0).sum())
+    onp.testing.assert_allclose(csr.asnumpy(), d, rtol=1e-6)
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    onp.testing.assert_allclose(back.asnumpy(), d, rtol=1e-6)
+
+
+def test_csr_matrix_from_triplet():
+    data = [1.0, 2.0, 3.0]
+    indices = [0, 2, 1]
+    indptr = [0, 2, 2, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    expect = onp.zeros((3, 4), onp.float32)
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    onp.testing.assert_allclose(csr.asnumpy(), expect)
+    # aux accessors mirror reference API
+    assert csr.indices.asnumpy().tolist() == indices
+    assert csr.indptr.asnumpy().tolist() == indptr
+    assert csr.data.asnumpy().tolist() == data
+
+
+def test_row_sparse_roundtrip():
+    d = onp.zeros((8, 3), onp.float32)
+    d[2] = [1, 2, 3]
+    d[5] = [4, 5, 6]
+    rsp = sparse.cast_storage(nd.array(d), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    assert rsp.indices.asnumpy().tolist() == [2, 5]
+    onp.testing.assert_allclose(rsp.asnumpy(), d)
+
+
+def test_row_sparse_array_ctor():
+    rsp = sparse.row_sparse_array(
+        ([[1.0, 2.0], [3.0, 4.0]], [1, 3]), shape=(5, 2))
+    expect = onp.zeros((5, 2), onp.float32)
+    expect[1] = [1, 2]
+    expect[3] = [3, 4]
+    onp.testing.assert_allclose(rsp.asnumpy(), expect)
+
+
+def test_sparse_zeros():
+    csr = sparse.zeros("csr", (3, 4))
+    assert csr.nnz == 0 and csr.shape == (3, 4)
+    onp.testing.assert_allclose(csr.asnumpy(), onp.zeros((3, 4)))
+    rsp = sparse.zeros("row_sparse", (3, 4))
+    assert rsp.nnz == 0
+    onp.testing.assert_allclose(rsp.asnumpy(), onp.zeros((3, 4)))
+
+
+def test_csr_dot_dense():
+    d = dense_rand((5, 7), seed=1)
+    w = onp.random.RandomState(2).rand(7, 4).astype(onp.float32)
+    csr = sparse.cast_storage(nd.array(d), "csr")
+    out = sparse.dot(csr, nd.array(w))
+    onp.testing.assert_allclose(out.asnumpy(), d @ w, rtol=1e-5)
+    # transpose_a: csr.T @ dense
+    w2 = onp.random.RandomState(3).rand(5, 4).astype(onp.float32)
+    out_t = sparse.dot(csr, nd.array(w2), transpose_a=True)
+    onp.testing.assert_allclose(out_t.asnumpy(), d.T @ w2, rtol=1e-5)
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array(
+        ([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], [0, 2, 4]), shape=(6, 2))
+    kept = sparse.retain(rsp, nd.array([2, 3, 4]))
+    assert kept.indices.asnumpy().tolist() == [2, 3, 4]
+    expect = onp.zeros((6, 2), onp.float32)
+    expect[2] = 2
+    expect[4] = 3
+    onp.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_elemwise_add_stypes():
+    a = sparse.row_sparse_array(([[1.0, 1.0]], [1]), shape=(3, 2))
+    b = sparse.row_sparse_array(([[2.0, 2.0]], [1]), shape=(3, 2))
+    s = sparse.elemwise_add(a, b)
+    assert s.stype == "row_sparse"
+    expect = onp.zeros((3, 2), onp.float32)
+    expect[1] = 3
+    onp.testing.assert_allclose(s.asnumpy(), expect)
+    dense = nd.ones((3, 2))
+    mixed = sparse.elemwise_add(a, dense)
+    assert mixed.stype == "default"
+    onp.testing.assert_allclose(mixed.asnumpy(), expect / 3 + 1)
+
+
+def test_sparse_sgd_lazy_update():
+    w0 = onp.ones((6, 3), onp.float32)
+    weight = nd.array(w0)
+    grad = sparse.row_sparse_array(
+        (onp.full((2, 3), 0.5, onp.float32), [1, 4]), shape=(6, 3))
+    opt = mx.optimizer.SGD(learning_rate=0.1, lazy_update=True)
+    opt.update(0, weight, grad, opt.create_state(0, weight))
+    out = weight.asnumpy()
+    expect = w0.copy()
+    expect[[1, 4]] -= 0.1 * 0.5
+    onp.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_sparse_adam_lazy_update():
+    w0 = onp.ones((5, 2), onp.float32)
+    weight = nd.array(w0)
+    grad = sparse.row_sparse_array(
+        (onp.full((1, 2), 1.0, onp.float32), [3]), shape=(5, 2))
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    out = weight.asnumpy()
+    # untouched rows unchanged
+    onp.testing.assert_allclose(out[[0, 1, 2, 4]], w0[[0, 1, 2, 4]])
+    assert (out[3] < 1.0).all()
+
+
+def test_kvstore_sparse_push():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4, 2)))
+    g1 = sparse.row_sparse_array(([[1.0, 1.0]], [0]), shape=(4, 2))
+    g2 = sparse.row_sparse_array(([[2.0, 2.0]], [3]), shape=(4, 2))
+    kv.push("w", [g1, g2])
+    out = nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    expect = onp.zeros((4, 2), onp.float32)
+    expect[0] = 1
+    expect[3] = 2
+    onp.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = onp.arange(12, dtype=onp.float32).reshape(6, 2)
+    kv.init("e", nd.array(w))
+    out = nd.zeros((3, 2))
+    kv.row_sparse_pull("e", out=out, row_ids=nd.array([1, 3, 5]))
+    onp.testing.assert_allclose(out.asnumpy(), w[[1, 3, 5]])
+
+
+def test_sparse_dot_in_jit():
+    """csr dot with static nnz compiles under jit (TPU path)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = dense_rand((4, 6), seed=5)
+    csr = sparse.cast_storage(nd.array(d), "csr")
+    w = onp.random.RandomState(6).rand(6, 3).astype(onp.float32)
+
+    @jax.jit
+    def f(vals, idx, indptr, wj):
+        c = sparse.CSRNDArray(vals, idx, indptr, (4, 6))
+        return sparse.dot(c, mx.NDArray(wj)).data
+
+    out = f(csr.data.data, csr.indices.data, csr.indptr.data,
+            jnp.asarray(w))
+    onp.testing.assert_allclose(onp.asarray(out), d @ w, rtol=1e-5)
+
+
+def test_unsupported_ops_raise():
+    csr = sparse.zeros("csr", (2, 2))
+    with pytest.raises(mx.MXNetError):
+        csr[0, 1]
+    with pytest.raises(mx.MXNetError):
+        csr[0] = 1.0
+
+
+def test_review_regressions():
+    import jax.numpy as jnp
+    from mxnet_tpu import np as mnp
+
+    # rsp+rsp with overlapping rows merges duplicates
+    a = sparse.row_sparse_array(([[1.0, 1.0]], [2]), shape=(4, 2))
+    b = sparse.row_sparse_array(([[2.0, 2.0]], [2]), shape=(4, 2))
+    s = sparse.elemwise_add(a, b)
+    assert s.indices.asnumpy().tolist() == [2]
+    onp.testing.assert_allclose(s.asnumpy()[2], [3.0, 3.0])
+    # ...and the lazy SGD update after kvstore aggregation is exact
+    kv = mx.kv.create("local")
+    w = nd.ones((4, 2))
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    kv.init("w2", w)
+    kv.set_optimizer(opt)
+    kv.push("w2", [a, b])
+    out = nd.zeros((4, 2))
+    kv.pull("w2", out=out)
+    onp.testing.assert_allclose(out.asnumpy()[2], [-2.0, -2.0])
+
+    # sparse copy preserves format/shape
+    c = a.copy()
+    assert c.stype == "row_sparse" and c.shape == (4, 2)
+
+    # dot transpose_b
+    d = dense_rand((2, 3), seed=7)
+    csr = sparse.cast_storage(nd.array(d), "csr")
+    w2 = onp.random.RandomState(8).rand(4, 3).astype(onp.float32)
+    out_b = sparse.dot(csr, nd.array(w2), transpose_b=True)
+    onp.testing.assert_allclose(out_b.asnumpy(), d @ w2.T, rtol=1e-5)
+
+    # retain on empty rsp returns zeros
+    empty = sparse.zeros("row_sparse", (4, 2))
+    r = sparse.retain(empty, nd.array([0, 1]))
+    onp.testing.assert_allclose(r.asnumpy(), onp.zeros((4, 2)))
+
+    # np.random array params broadcast for gamma/beta/poisson/chisquare
+    g = mnp.random.gamma(mnp.array([1.0, 2.0]))
+    assert g.shape == (2,)
+    bt = mnp.random.beta(mnp.array([1.0, 2.0]), mnp.array([2.0, 3.0]))
+    assert bt.shape == (2,)
+    ch = mnp.random.chisquare(mnp.array([1.0, 2.0, 3.0]))
+    assert ch.shape == (3,)
