@@ -1,0 +1,220 @@
+"""mx.nd.contrib: control flow (foreach / while_loop / cond) + contrib ops.
+
+TPU-native replacement for the reference's stateful subgraph control-flow
+ops (src/operator/control_flow.cc: _foreach, _while_loop, _cond executing
+CachedOp bodies per iteration, WhileLoopState control_flow.cc:529-538) and
+the Python drivers (python/mxnet/ndarray/contrib.py:foreach/while_loop/cond).
+Here the bodies lower straight to lax.scan / lax.while_loop / lax.cond —
+compiler-friendly control flow that XLA pipelines on TPU instead of the
+reference's per-iteration engine pushes. Eagerly, `foreach` still records a
+single tape node for the whole scan (like the reference's one-subgraph-node
+recording); while_loop/cond on concrete values fall back to Python control
+flow so the actual trip count is observable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import registry as _registry
+from .registry import apply_pure
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _aslist(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Scan `body` over axis 0 of `data`.
+
+    body(data_slice, states) -> (outputs, new_states). Returns
+    (stacked_outputs, final_states). Reference:
+    python/mxnet/ndarray/contrib.py foreach → _foreach op
+    (src/operator/control_flow.cc:56). Lowers to one lax.scan; autograd
+    records a single vjp for the whole loop.
+    """
+    from .ndarray import NDArray
+    from .. import autograd
+
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    data_list = _aslist(data)
+    states = _aslist(init_states)
+    n_d = len(data_list)
+    meta = {}
+
+    concrete = not any(_is_tracer(v.data) for v in data_list + states
+                       if isinstance(v, NDArray))
+    if concrete and autograd.is_recording():
+        # Recording eagerly: unrolled Python loop so every op lands on the
+        # tape — gradients flow to *free variables* captured by the body
+        # too, which a single closed-over vjp cannot see. This mirrors the
+        # reference's imperative foreach (python/mxnet/ndarray/contrib.py),
+        # a plain Python loop when not symbolic.
+        n = data_list[0].shape[0]
+        outs_steps = []
+        for i in range(n):
+            slices = [d[i] for d in data_list]
+            out, new_s = body(slices[0] if single_data else slices,
+                              states[0] if single_state else
+                              _aslist(states))
+            outs_steps.append(_aslist(out))
+            states = _aslist(new_s)
+            single_out = not isinstance(out, (list, tuple))
+        from . import stack as _stack
+        stacked = [_stack(*[o[k] for o in outs_steps], axis=0)
+                   for k in range(len(outs_steps[0]))]
+        outs = stacked[0] if single_out else stacked
+        fin = states[0] if single_state else states
+        return outs, fin
+
+    def pure(*xs):
+        d, s = xs[:n_d], xs[n_d:]
+
+        def scan_body(carry, slices):
+            with autograd.pause():
+                s_nd = [NDArray(c) for c in carry]
+                x_nd = [NDArray(sl) for sl in slices]
+                out, new_s = body(x_nd[0] if single_data else x_nd,
+                                  s_nd[0] if single_state else s_nd)
+            out_l = _aslist(out)
+            ns_l = _aslist(new_s)
+            meta["n_out"] = len(out_l)
+            meta["single_out"] = not isinstance(out, (list, tuple))
+            meta["single_ns"] = not isinstance(new_s, (list, tuple))
+            return (tuple(o.data for o in ns_l),
+                    tuple(o.data for o in out_l))
+
+        carry, ys = lax.scan(scan_body, tuple(s), tuple(d))
+        return tuple(ys) + tuple(carry)
+
+    res = apply_pure(pure, data_list + states)
+    res = res if isinstance(res, list) else [res]
+    n_out = meta["n_out"]
+    outs, fin = res[:n_out], res[n_out:]
+    outs = outs[0] if meta["single_out"] and outs else outs
+    fin = fin[0] if meta["single_ns"] and fin else fin
+    return outs, fin
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference: python/mxnet/ndarray/contrib.py while_loop → _while_loop
+    (control_flow.cc:529). cond(*loop_vars) -> boolean scalar;
+    func(*loop_vars) -> (step_output, new_loop_vars). Returns
+    (stacked_outputs, final_loop_vars). On concrete values this runs a
+    Python loop (actual trip count, reference imperative semantics); under
+    tracing it lowers to lax.while_loop with outputs padded to
+    max_iterations (reference symbolic semantics)."""
+    from .ndarray import NDArray
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    single = not isinstance(loop_vars, (list, tuple))
+    lv = _aslist(loop_vars)
+    traced = any(_is_tracer(v.data) for v in lv if isinstance(v, NDArray))
+
+    if not traced:
+        outs = []
+        steps = 0
+        while steps < max_iterations:
+            c = cond(*lv)
+            cval = bool(c.asnumpy().item()) if isinstance(c, NDArray) else bool(c)
+            if not cval:
+                break
+            step_out, new_lv = func(*lv)
+            outs.append(_aslist(step_out))
+            lv = _aslist(new_lv)
+            steps += 1
+        if outs:
+            from . import stack as _stack
+            stacked = [_stack(*[o[i] for o in outs], axis=0)
+                       for i in range(len(outs[0]))]
+        else:
+            stacked = []
+        return stacked, (lv[0] if single else lv)
+
+    # traced: pad outputs to max_iterations via lax.while_loop
+    datas = [v.data for v in lv]
+    out_shapes = jax.eval_shape(
+        lambda *xs: tuple(o.data for o in _aslist(
+            func(*[NDArray(x) for x in xs])[0])), *datas)
+    bufs = [jnp.zeros((max_iterations,) + tuple(s.shape), s.dtype)
+            for s in out_shapes]
+
+    def c_fn(state):
+        i, vs, _ = state
+        c = cond(*[NDArray(v) for v in vs])
+        cd = c.data if isinstance(c, NDArray) else jnp.asarray(c)
+        return (i < max_iterations) & cd.reshape(()).astype(bool)
+
+    def b_fn(state):
+        i, vs, bs = state
+        step_out, new_lv = func(*[NDArray(v) for v in vs])
+        so = [o.data for o in _aslist(step_out)]
+        nbs = tuple(lax.dynamic_update_index_in_dim(b, o.astype(b.dtype), i, 0)
+                    for b, o in zip(bs, so))
+        return (i + 1, tuple(o.data for o in _aslist(new_lv)), nbs)
+
+    i, vs, bs = lax.while_loop(c_fn, b_fn,
+                               (jnp.asarray(0), tuple(datas), tuple(bufs)))
+    stacked = [NDArray(b) for b in bs]
+    final = [NDArray(v) for v in vs]
+    return stacked, (final[0] if single else final)
+
+
+def cond(pred, then_func, else_func):
+    """Reference: python/mxnet/ndarray/contrib.py cond → _cond op
+    (control_flow.cc). then_func/else_func take no args and must return
+    the same structure. Concrete pred → Python branch; traced → lax.cond."""
+    from .ndarray import NDArray
+
+    p = pred.data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    if not _is_tracer(p):
+        return then_func() if bool(jnp.reshape(p, ()).astype(bool)) else \
+            else_func()
+
+    meta = {}
+
+    def _run(f):
+        def g(_):
+            out = f()
+            meta["single"] = not isinstance(out, (list, tuple))
+            return tuple(o.data for o in _aslist(out))
+        return g
+
+    outs = lax.cond(p.reshape(()).astype(bool), _run(then_func),
+                    _run(else_func), operand=None)
+    wrapped = [NDArray(o) for o in outs]
+    # keep eager/traced structure identical: a list-returning branch stays
+    # a list even when it has one element
+    return wrapped[0] if meta["single"] else wrapped
+
+
+# contrib-namespaced registered ops (reference: mx.nd.contrib.*). Every
+# name listed here must resolve — _install raises on a missing op so the
+# advertised API surface can't silently rot.
+_CONTRIB_OPS = [
+    "boolean_mask", "index_copy", "index_array", "adaptive_avg_pooling2d",
+    "bilinear_resize2d", "all_finite", "multi_sum_sq",
+]
+
+
+def _install():
+    import sys
+    mod = sys.modules[__name__]
+    for name in _CONTRIB_OPS:
+        od = _registry.get_op(name) or _registry.get_op(name.lower())
+        if od is None:
+            raise RuntimeError(f"contrib op '{name}' listed but unregistered")
+        if not hasattr(mod, name):
+            setattr(mod, name, _registry.make_wrapper(od))
+
+
+_install()

@@ -1,0 +1,338 @@
+"""Legacy top-level ops: regression/SVM outputs, ROI pooling, spatial
+transformer family, correlation, crop, moments, batch_take, smooth_l1.
+
+TPU-native equivalents of the reference's legacy v1 operator set
+(src/operator/regression_output{-inl.h,.cc}, svm_output-inl.h,
+roi_pooling-inl.h, spatial_transformer-inl.h, grid_generator-inl.h,
+bilinear_sampler-inl.h, correlation-inl.h, crop-inl.h, nn/moments-inl.h,
+tensor/indexing_op.h batch_take, tensor/elemwise_unary_op smooth_l1).
+Bodies are pure jnp/lax so they fuse under jit; the output ops use
+jax.custom_vjp to reproduce the reference semantics of *ignoring the
+incoming head gradient* (their backward is defined by the loss itself,
+regression_output-inl.h:90-120).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ------------------------------------------------- regression outputs ----
+
+def _head_grad_free(fwd_fn, grad_fn):
+    """Build a custom-vjp fn whose backward ignores the head gradient's
+    value (uses only its presence), like the reference *Output ops."""
+
+    f = jax.custom_vjp(fwd_fn, nondiff_argnums=(2,))
+
+    def fwd(data, label, grad_scale):
+        return fwd_fn(data, label, grad_scale), (data, label)
+
+    def bwd(grad_scale, res, g):
+        data, label = res
+        return grad_fn(data, label, grad_scale, g), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_linreg = _head_grad_free(
+    lambda data, label, gs: data,
+    lambda data, label, gs, g: (data - label.reshape(data.shape)) * gs)
+
+_maereg = _head_grad_free(
+    lambda data, label, gs: data,
+    lambda data, label, gs, g: jnp.sign(data - label.reshape(data.shape)) * gs)
+
+_logreg = _head_grad_free(
+    lambda data, label, gs: jax.nn.sigmoid(data),
+    lambda data, label, gs, g:
+        (jax.nn.sigmoid(data) - label.reshape(data.shape)) * gs)
+
+
+@register()
+def linear_regression_output(data, label, grad_scale=1.0):
+    """Reference: src/operator/regression_output.cc (LinearRegressionOutput).
+    Forward = identity; backward = (pred - label) * grad_scale."""
+    return _linreg(data, label, float(grad_scale))
+
+
+@register()
+def mae_regression_output(data, label, grad_scale=1.0):
+    """Reference: MAERegressionOutput (regression_output.cc)."""
+    return _maereg(data, label, float(grad_scale))
+
+
+@register()
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """Reference: LogisticRegressionOutput (regression_output.cc)."""
+    return _logreg(data, label, float(grad_scale))
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+_svm = jax.custom_vjp(_svm_fwd, nondiff_argnums=(2, 3, 4))
+
+
+def _svm_b(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    n, k = data.shape[0], data.shape[1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), k, dtype=data.dtype)
+    signed = jnp.where(onehot > 0, data, -data)
+    viol = (margin - signed) > 0  # margin violated
+    if use_linear:
+        grad = jnp.where(viol, jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+    else:
+        grad = jnp.where(viol, 2.0 * (margin - signed) *
+                         jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+    return grad.astype(data.dtype) * reg_coef, jnp.zeros_like(label)
+
+
+_svm.defvjp(lambda data, label, m, r, u: (data, (data, label)),
+            _svm_b)
+
+
+@register()
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Reference: src/operator/svm_output.cc. Forward identity; backward is
+    the (squared) hinge-loss gradient scaled by regularization_coefficient."""
+    return _svm(data, label, float(margin),
+                float(regularization_coefficient), bool(use_linear))
+
+
+# --------------------------------------------------------- elementwise ----
+
+@register()
+def smooth_l1(data, scalar=1.0):
+    """Reference: mshadow_op.h smooth_l1_loss. f(x)=0.5 (sx)^2/|x|<1/s^2
+    else |x|-0.5/s^2."""
+    s2 = float(scalar) ** 2
+    ax = jnp.abs(data)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * data * data, ax - 0.5 / s2)
+
+
+@register()
+def moments(data, axes=None, keepdims=False):
+    """Reference: src/operator/nn/moments.cc → (mean, var)."""
+    axes = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=axes, keepdims=keepdims)
+    mk = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.mean((data - mk) ** 2, axis=axes, keepdims=keepdims)
+    return mean, var
+
+
+@register()
+def batch_take(a, indices):
+    """Reference: tensor/indexing_op.h BatchTake: out[i] = a[i, indices[i]]."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).reshape(-1)
+
+
+@register(name="crop")
+def crop_op(data, crop_like=None, offset=(0, 0), h_w=(0, 0),
+            center_crop=False):
+    """Reference: src/operator/crop.cc (legacy Crop). Crops the last two
+    (H, W) axes to `h_w` (or crop_like's spatial shape)."""
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# --------------------------------------------------------- ROI pooling ----
+
+@register()
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Reference: src/operator/roi_pooling.cc. Max-pools each ROI into a
+    fixed (ph, pw) grid. rois is (R, 5): [batch_idx, x1, y1, x2, y2] in
+    image coords. Implemented as two separable masked maxes (rows then
+    cols) — static shapes, jit/vmap friendly, no dynamic slicing."""
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+    dt = data.dtype
+    neg = jnp.asarray(-jnp.inf, dt)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = jnp.take(data, b, axis=0)  # (C,H,W)
+
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        hstart = jnp.floor(iy * bh) + y1
+        hend = jnp.ceil((iy + 1.0) * bh) + y1
+        rows = jnp.arange(H, dtype=jnp.float32)
+        rmask = (rows[None, :] >= hstart[:, None]) & \
+                (rows[None, :] < hend[:, None])  # (ph, H)
+
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        wstart = jnp.floor(ix * bw) + x1
+        wend = jnp.ceil((ix + 1.0) * bw) + x1
+        cols = jnp.arange(W, dtype=jnp.float32)
+        cmask = (cols[None, :] >= wstart[:, None]) & \
+                (cols[None, :] < wend[:, None])  # (pw, W)
+
+        # max over cols per col-bin: (C,H,W),(pw,W) -> (C,H,pw)
+        t = jnp.max(jnp.where(cmask[None, None, :, :],
+                              img[:, :, None, :], neg), axis=-1)
+        # max over rows per row-bin: (C,H,pw),(ph,H) -> (C,ph,pw)
+        out = jnp.max(jnp.where(rmask[None, :, :, None],
+                                t[:, None, :, :], neg), axis=2)
+        return jnp.where(jnp.isfinite(out), out, jnp.asarray(0, dt))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+# ------------------------------------------- spatial transformer family ----
+
+def _identity_grid(h, w, dtype):
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return gx, gy  # each (h, w)
+
+
+@register()
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Reference: src/operator/grid_generator.cc. affine: data (N,6) row-major
+    2x3 matrix over normalized coords; warp: data (N,2,H,W) pixel flow added
+    to the identity grid. Output (N, 2, H, W) with channel 0 = x, 1 = y in
+    [-1, 1]."""
+    if transform_type == "affine":
+        h, w = target_shape
+        gx, gy = _identity_grid(h, w, jnp.float32)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, h*w)
+        theta = data.reshape(-1, 2, 3).astype(jnp.float32)
+        out = jnp.einsum("nij,jk->nik", theta, base,
+                         precision="highest")  # (N,2,h*w) — tiny, exactness
+        # matters more than MXU throughput here
+        return out.reshape(-1, 2, h, w)
+    # warp: flow in pixels
+    n, _, h, w = data.shape
+    gx, gy = _identity_grid(h, w, jnp.float32)
+    fx = data[:, 0] * (2.0 / jnp.maximum(w - 1, 1))
+    fy = data[:, 1] * (2.0 / jnp.maximum(h - 1, 1))
+    return jnp.stack([gx[None] + fx, gy[None] + fy], axis=1)
+
+
+@register()
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Reference: src/operator/bilinear_sampler.cc. Samples data (N,C,H,W)
+    at grid (N,2,h,w) locations in [-1,1]; zero padding outside (matching
+    the reference's border behavior of zero-filled out-of-range reads)."""
+    N, C, H, W = data.shape
+    dt = data.dtype
+    gx = (grid[:, 0].astype(jnp.float32) + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1].astype(jnp.float32) + 1.0) * (H - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yi, xi):
+        # img (C,H,W); yi/xi (h,w) int32 — zero for out-of-range
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1)
+        xc = jnp.clip(xi, 0, W - 1)
+        v = img[:, yc, xc]  # (C,h,w)
+        return jnp.where(valid[None], v, jnp.asarray(0, img.dtype))
+
+    def one(img, x0_, y0_, wx_, wy_):
+        x0i = x0_.astype(jnp.int32)
+        y0i = y0_.astype(jnp.int32)
+        v00 = gather(img, y0i, x0i)
+        v01 = gather(img, y0i, x0i + 1)
+        v10 = gather(img, y0i + 1, x0i)
+        v11 = gather(img, y0i + 1, x0i + 1)
+        w00 = ((1 - wy_) * (1 - wx_))[None]
+        w01 = ((1 - wy_) * wx_)[None]
+        w10 = (wy_ * (1 - wx_))[None]
+        w11 = (wy_ * wx_)[None]
+        return v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+
+    out = jax.vmap(one)(data.astype(jnp.float32), x0, y0, wx, wy)
+    return out.astype(dt)
+
+
+@register()
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Reference: src/operator/spatial_transformer.cc =
+    GridGenerator(affine) + BilinearSampler."""
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=tuple(target_shape))
+    return bilinear_sampler(data, grid)
+
+
+# --------------------------------------------------------- correlation ----
+
+@register()
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Reference: src/operator/correlation.cc (FlowNet correlation). For
+    each displacement (dy,dx) on the stride2 grid, correlates kernel_size
+    patches of data1 with shifted data2, averaged over channels*K^2.
+    Static displacement count → unrolled shifts, each an XLA-fused
+    elementwise + avg-pool."""
+    N, C, H, W = data1.shape
+    K = kernel_size
+    bd = max_displacement // stride2  # border in displacement steps
+    D = 2 * bd + 1
+    p = pad_size
+    a = jnp.pad(data1.astype(jnp.float32),
+                ((0, 0), (0, 0), (p, p), (p, p)))
+    Hp, Wp = H + 2 * p, W + 2 * p
+    # data2 gets an extra max_displacement border of zeros so shifted
+    # windows past the pad read zeros, never wrapped pixels
+    md = max_displacement
+    b_big = jnp.pad(data2.astype(jnp.float32),
+                    ((0, 0), (0, 0), (p + md, p + md), (p + md, p + md)))
+    krad = K // 2
+    # output spatial grid (top-left anchored on stride1, inside the
+    # max_displacement border)
+    oh = (Hp - 2 * max_displacement - (K - 1) + stride1 - 1) // stride1
+    ow = (Wp - 2 * max_displacement - (K - 1) + stride1 - 1) // stride1
+    oh, ow = max(oh, 1), max(ow, 1)
+    y0 = max_displacement + krad
+    x0 = max_displacement + krad
+    norm = float(C * K * K)
+
+    outs = []
+    for dy in range(-bd, bd + 1):
+        for dx in range(-bd, bd + 1):
+            sy, sx = dy * stride2, dx * stride2
+            shifted = lax.slice(b_big, (0, 0, md + sy, md + sx),
+                                (N, C, md + sy + Hp, md + sx + Wp))
+            prod = a * shifted if is_multiply else jnp.abs(a - shifted)
+            # sum over KxK window and channels
+            win = lax.reduce_window(
+                prod, 0.0, lax.add,
+                (1, 1, K, K), (1, 1, 1, 1), "VALID")  # centers at +krad
+            s = jnp.sum(win, axis=1)  # (N, Hp-K+1, Wp-K+1)
+            patch = lax.slice(
+                s, (0, y0 - krad, x0 - krad),
+                (N, y0 - krad + (oh - 1) * stride1 + 1,
+                 x0 - krad + (ow - 1) * stride1 + 1),
+                (1, stride1, stride1))
+            outs.append(patch / norm)
+    return jnp.stack(outs, axis=1).astype(data1.dtype)  # (N, D*D, oh, ow)

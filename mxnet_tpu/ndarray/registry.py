@@ -69,8 +69,7 @@ def invoke(opdef, args, kwargs):
     role of the dependency engine — results are futures, sync happens at
     `wait_to_read`/`asnumpy`.
     """
-    from .ndarray import NDArray, _wrap
-    from .. import autograd
+    from .ndarray import NDArray
 
     out = kwargs.pop("out", None)
     # split array args (positional NDArray/ndarray-convertible) from config
@@ -105,11 +104,29 @@ def invoke(opdef, args, kwargs):
         if type(a) is not NDArray:
             wrap_cls = type(a)
             break
-    if wrap_cls is not NDArray:
-        _wrap = lambda r: wrap_cls(r)  # noqa: E731
+    wrap = (lambda r: wrap_cls(r)) if wrap_cls is not NDArray else None
 
-    datas = [a.data for a in arr_args]
-    if autograd.is_recording() and opdef.differentiable and arr_args:
+    return apply_pure(pure_fn, arr_args,
+                      differentiable=opdef.differentiable, out=out, wrap=wrap)
+
+
+def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
+    """Run a pure-JAX function over NDArray inputs with tape support.
+
+    The single tail of eager dispatch: unwrap → (vjp+record | run) → wrap,
+    with ``out=`` redirect. `invoke` routes registered ops through here;
+    control-flow helpers (foreach/while_loop/cond) and custom ops, whose
+    pure function closes over a user body and so cannot pre-register an
+    OpDef, call it directly. Reference analog: the stateful subgraph ops
+    executing CachedOp bodies (src/operator/control_flow.cc) record one
+    tape node for the whole subgraph."""
+    from .ndarray import NDArray
+    from .ndarray import _wrap as _default_wrap
+    from .. import autograd
+
+    _wrap = wrap or _default_wrap
+    datas = [a.data if isinstance(a, NDArray) else a for a in arr_args]
+    if autograd.is_recording() and differentiable and arr_args:
         result, vjp_fn = jax.vjp(pure_fn, *datas)
         multi = isinstance(result, tuple)
         if out is not None:
@@ -118,10 +135,10 @@ def invoke(opdef, args, kwargs):
             # the tape must reference `out` itself so downstream grads
             # keyed by id(out) flow back through this node
             out._data = jnp.asarray(result, out._data.dtype)
-            autograd._record_op(vjp_fn, arr_args, [out])
+            autograd._record_op(vjp_fn, list(arr_args), [out])
             return out
         outs = [_wrap(r) for r in (result if multi else (result,))]
-        autograd._record_op(vjp_fn, arr_args, outs)
+        autograd._record_op(vjp_fn, list(arr_args), outs)
         return outs if multi else outs[0]
 
     result = pure_fn(*datas)
@@ -129,7 +146,6 @@ def invoke(opdef, args, kwargs):
         result = [_wrap(r) for r in result]
     else:
         result = _wrap(result)
-
     if out is not None:
         if isinstance(result, list):
             raise MXNetError("out= not supported for multi-output ops")

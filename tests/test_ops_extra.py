@@ -1,0 +1,169 @@
+"""Legacy output ops, spatial-transformer family, ROI pooling, control flow.
+
+Mirrors reference coverage: tests/python/unittest/test_operator.py
+(test_regression, test_svmoutput, test_roipooling, test_stn,
+test_correlation) and test_contrib_control_flow.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_regression_outputs():
+    x = nd.array([[1., 2.], [3., 4.]])
+    lbl = nd.array([[0., 1.], [1., 0.]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LinearRegressionOutput(x, lbl, grad_scale=2.0)
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2.0 * (x.asnumpy() - lbl.asnumpy()))
+    assert onp.allclose(y.asnumpy(), x.asnumpy())
+
+    x.grad[:] = 0
+    with autograd.record():
+        y = nd.MAERegressionOutput(x, lbl)
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(),
+                        onp.sign(x.asnumpy() - lbl.asnumpy()))
+
+    x.grad[:] = 0
+    with autograd.record():
+        y = nd.LogisticRegressionOutput(x, lbl)
+    y.backward()
+    sig = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert onp.allclose(y.asnumpy(), sig, atol=1e-6)
+    assert onp.allclose(x.grad.asnumpy(), sig - lbl.asnumpy(), atol=1e-6)
+
+
+def test_svm_output():
+    x = nd.array([[0.5, -0.2, 0.3]])
+    lbl = nd.array([0.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, lbl, margin=1.0, use_linear=True)
+    y.backward()
+    assert onp.allclose(y.asnumpy(), x.asnumpy())
+    # violated iff margin - signed_score > 0; signed = x for the true
+    # class, -x otherwise (reference svm_output-inl.h L1-margin backward)
+    g = x.grad.asnumpy()
+    assert onp.allclose(g, [[-1., 1., 1.]])
+    # true-class margin satisfied -> all zeros
+    x2 = nd.array([[2., -2., -2.]])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = nd.SVMOutput(x2, lbl, margin=1.0, use_linear=True)
+    y2.backward()
+    assert onp.allclose(x2.grad.asnumpy(), 0.0)
+
+
+def test_smooth_l1_moments_batch_take():
+    out = nd.smooth_l1(nd.array([-3., 0.1, 3.]), scalar=1.0).asnumpy()
+    assert onp.allclose(out, [2.5, 0.005, 2.5], atol=1e-6)
+    m, v = nd.moments(nd.array([[1., 2.], [3., 4.]]), axes=[0])
+    assert onp.allclose(m.asnumpy(), [2., 3.])
+    assert onp.allclose(v.asnumpy(), [1., 1.])
+    bt = nd.batch_take(nd.array([[1., 2.], [3., 4.]]), nd.array([1, 0]))
+    assert onp.allclose(bt.asnumpy(), [2., 3.])
+
+
+def test_roi_pooling():
+    data = nd.array(onp.arange(36, dtype='f').reshape(1, 1, 6, 6))
+    rois = nd.array([[0, 0, 0, 2, 2], [0, 1, 1, 4, 4]])
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 1, 2, 2)
+    assert onp.allclose(out.asnumpy().reshape(2, 4),
+                        [[7, 8, 13, 14], [14, 16, 26, 28]])
+
+
+def test_spatial_transformer_identity():
+    data = nd.array(onp.random.RandomState(0).rand(2, 3, 5, 7).astype('f'))
+    theta = nd.array(onp.tile([1., 0, 0, 0, 1., 0], (2, 1)))
+    out = nd.SpatialTransformer(data, theta, target_shape=(5, 7))
+    assert onp.allclose(out.asnumpy(), data.asnumpy(), atol=1e-4)
+
+
+def test_bilinear_sampler_grad_flows():
+    data = nd.array(onp.random.RandomState(1).rand(1, 2, 4, 4).astype('f'))
+    grid = nd.array(onp.zeros((1, 2, 3, 3), dtype='f'))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.BilinearSampler(data, grid)
+    out.backward()
+    assert out.shape == (1, 2, 3, 3)
+    assert float(nd.sum(nd.abs(data.grad)).asnumpy()) > 0
+
+
+def test_correlation_shape():
+    a = nd.array(onp.random.rand(1, 2, 6, 6).astype('f'))
+    out = nd.Correlation(a, a, kernel_size=1, max_displacement=2,
+                         stride1=1, stride2=1, pad_size=2)
+    assert out.shape[1] == 25
+    # zero-displacement channel of self-correlation == mean over channels sq
+    c12 = out.asnumpy()[0, 12]
+    expect = (a.asnumpy()[0] ** 2).mean(axis=0)
+    assert onp.allclose(c12[:6, :6], expect, atol=1e-4)
+
+
+def test_foreach():
+    def body(x, s):
+        return x + s, x + s
+    outs, fin = nd.contrib.foreach(body, nd.array([1., 2., 3.]), nd.array(0.))
+    assert onp.allclose(outs.asnumpy(), [1., 3., 6.])
+    assert float(fin.asnumpy()) == 6.0
+
+
+def test_foreach_grad():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        outs, fin = nd.contrib.foreach(lambda xi, s: (xi * s, s + xi),
+                                       x, nd.array(1.))
+        loss = nd.sum(outs)
+    loss.backward()
+    assert onp.isfinite(x.grad.asnumpy()).all()
+    # d/dx of [x0, x1(1+x0), x2(1+x0+x1)] summed
+    g = x.grad.asnumpy()
+    xs = [1., 2., 3.]
+    expect = [1 + xs[1] + xs[2], (1 + xs[0]) + xs[2], 1 + xs[0] + xs[1]]
+    assert onp.allclose(g, expect)
+
+
+def test_while_loop_eager():
+    outs, st = nd.contrib.while_loop(
+        lambda i, s: i < 3,
+        lambda i, s: ([i * 2], [i + 1, s + i]),
+        [nd.array(0.), nd.array(1.)], max_iterations=10)
+    assert onp.allclose(outs[0].asnumpy(), [0., 2., 4.])
+    assert float(st[1].asnumpy()) == 4.0
+
+
+def test_while_loop_traced():
+    import jax
+
+    def run(i0, s0):
+        outs, st = nd.contrib.while_loop(
+            lambda i, s: i < 3,
+            lambda i, s: ([i * 2], [i + 1, s + i]),
+            [nd.NDArray(i0), nd.NDArray(s0)], max_iterations=5)
+        return outs[0].data, st[1].data
+
+    buf, s = jax.jit(run)(0.0, 1.0)
+    assert onp.allclose(onp.asarray(buf), [0., 2., 4., 0., 0.])
+    assert float(s) == 4.0
+
+
+def test_cond():
+    r = nd.contrib.cond(nd.array(1.), lambda: nd.array(10.),
+                        lambda: nd.array(20.))
+    assert float(r.asnumpy()) == 10.0
+    import jax
+
+    def f(p):
+        return nd.contrib.cond(nd.NDArray(p),
+                               lambda: nd.NDArray(p.astype('float32')) * 2,
+                               lambda: nd.NDArray(p.astype('float32')) - 1).data
+
+    assert float(jax.jit(f)(onp.bool_(True))) == 2.0
+    assert float(jax.jit(f)(onp.bool_(False))) == -1.0
