@@ -1,0 +1,2 @@
+"""Gluon contrib (reference: python/mxnet/gluon/contrib/__init__.py)."""
+from . import nn  # noqa: F401

@@ -1,0 +1,8 @@
+"""Contrib neural network layers (reference:
+python/mxnet/gluon/contrib/nn/__init__.py)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, PixelShuffle1D, PixelShuffle2D,
+                           SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "PixelShuffle1D", "PixelShuffle2D", "SyncBatchNorm"]

@@ -1,0 +1,155 @@
+"""Contrib layers: Concurrent, HybridConcurrent, Identity, SparseEmbedding,
+PixelShuffle, SyncBatchNorm.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py. SyncBatchNorm
+(reference :165, backed by contrib/sync_batch_norm.cc cross-device
+reduction) here computes batch stats with jax.lax.pmean over the data-
+parallel mesh axis when running inside shard_map/pjit — the TPU-native
+equivalent of the reference's NCCL-reduced statistics — and degrades to
+plain BatchNorm outside a mapped context.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential, BatchNorm
+
+
+class Concurrent(Sequential):
+    """Runs children on the same input, concatenating outputs along `axis`.
+
+    Reference: contrib/nn/basic_layers.py:Concurrent."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent. Reference: contrib/nn/basic_layers.py:93."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        from .... import nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Reference: contrib/nn/basic_layers.py:Identity."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding backed by row_sparse gradient storage.
+
+    Reference: contrib/nn/basic_layers.py:SparseEmbedding (grad_stype
+    'row_sparse' so only touched rows are updated by sparse optimizers)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, grad_stype="row_sparse")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.embedding(x, weight, **self._kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Reference: contrib/nn/basic_layers.py:PixelShuffle1D."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        n, c, w = x.shape
+        x = x.reshape(n, c // f, f, w)
+        x = x.transpose((0, 1, 3, 2))
+        return x.reshape(n, c // f, w * f)
+
+
+class PixelShuffle2D(HybridBlock):
+    """Reference: contrib/nn/basic_layers.py:PixelShuffle2D."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        f = factor if isinstance(factor, (list, tuple)) else (factor, factor)
+        self._factors = tuple(int(v) for v in f)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (f1 * f2), f1, f2, h, w)
+        x = x.transpose((0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (f1 * f2), h * f1, w * f2)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: contrib/nn/basic_layers.py:165,
+    kernel src/operator/contrib/sync_batch_norm.cc).
+
+    On TPU the cross-replica mean/var reduction is `lax.pmean` over the
+    mesh's data-parallel axis — XLA lowers it to an ICI all-reduce fused
+    into the step program, replacing the reference's explicit NCCL calls.
+    `num_devices` is accepted for API parity but the axis size comes from
+    the mesh. Outside a pmapped/shard_mapped context it behaves exactly
+    like BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, axis_name="dp", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+        self._axis_name = axis_name
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from .... import autograd
+        from jax import lax
+        import jax.numpy as jnp
+
+        import jax
+
+        training = autograd.is_training() and not self._use_global_stats
+        if not training or not isinstance(x.data, jax.core.Tracer):
+            # eager single-device: identical to BatchNorm (and the eager
+            # tape only records registered ops, so stay on that path)
+            return super().hybrid_forward(F, x, gamma, beta, running_mean,
+                                          running_var)
+        red = tuple(i for i in range(len(x.shape)) if i != self._axis)
+        xd = x.data
+        mean = jnp.mean(xd, axis=red)
+        sq = jnp.mean(xd * xd, axis=red)
+        try:
+            mean = lax.pmean(mean, self._axis_name)
+            sq = lax.pmean(sq, self._axis_name)
+        except NameError:
+            # axis not bound: tracing outside shard_map/pmap (plain jit on
+            # one device) — local stats are the correct stats there. A
+            # *wrongly named* axis inside a mapped context also raises
+            # NameError; pass axis_name= to match the mesh.
+            pass
+        var = sq - mean * mean
+        shape = [1] * len(x.shape)
+        shape[self._axis] = -1
+        g = gamma.data.reshape(shape) if self._scale else 1.0
+        b = beta.data.reshape(shape) if self._center else 0.0
+        y = (xd - mean.reshape(shape)) * lax.rsqrt(
+            var.reshape(shape) + self._epsilon) * g + b
+        m = self._momentum
+        running_mean._data = m * running_mean.data + (1 - m) * mean
+        running_var._data = m * running_var.data + (1 - m) * var
+        return type(x)(y)

@@ -2,15 +2,27 @@
 python/mxnet/gluon/model_zoo/vision/__init__.py get_model:91)."""
 from . import resnet as _resnet
 from . import alexnet as _alexnet
+from . import vgg as _vgg
+from . import squeezenet as _squeezenet
+from . import mobilenet as _mobilenet
+from . import densenet as _densenet
+from . import inception as _inception
 
 from .resnet import *  # noqa: F401,F403
 from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
 
 _models = {}
-for _mod in (_resnet, _alexnet):
+for _mod in (_resnet, _alexnet, _vgg, _squeezenet, _mobilenet, _densenet,
+             _inception):
     for _name in _mod.__all__:
         _obj = getattr(_mod, _name)
-        if callable(_obj) and _name[0].islower():
+        if callable(_obj) and _name[0].islower() and \
+                not _name.startswith("get_"):
             _models[_name] = _obj
 
 

@@ -52,7 +52,7 @@ def foreach(body, data, init_states):
 
     concrete = not any(_is_tracer(v.data) for v in data_list + states
                        if isinstance(v, NDArray))
-    if concrete and autograd.is_recording():
+    if concrete and autograd.is_recording() and data_list[0].shape[0] > 0:
         # Recording eagerly: unrolled Python loop so every op lands on the
         # tape — gradients flow to *free variables* captured by the body
         # too, which a single closed-over vjp cannot see. This mirrors the
@@ -140,31 +140,27 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
             stacked = []
         return stacked, (lv[0] if single else lv)
 
-    # traced: pad outputs to max_iterations via lax.while_loop
-    datas = [v.data for v in lv]
-    out_shapes = jax.eval_shape(
-        lambda *xs: tuple(o.data for o in _aslist(
-            func(*[NDArray(x) for x in xs])[0])), *datas)
-    bufs = [jnp.zeros((max_iterations,) + tuple(s.shape), s.dtype)
-            for s in out_shapes]
+    # traced: lax.scan over max_iterations with an active mask. Unlike
+    # lax.while_loop this is reverse-mode differentiable (hybridized
+    # training through a while_loop must keep working); outputs beyond the
+    # trip count stay zero — the reference's symbolic padding semantics.
+    datas = tuple(v.data for v in lv)
 
-    def c_fn(state):
-        i, vs, _ = state
+    def scan_step(carry, _):
+        active, vs = carry
         c = cond(*[NDArray(v) for v in vs])
         cd = c.data if isinstance(c, NDArray) else jnp.asarray(c)
-        return (i < max_iterations) & cd.reshape(()).astype(bool)
-
-    def b_fn(state):
-        i, vs, bs = state
+        act = active & cd.reshape(()).astype(bool)
         step_out, new_lv = func(*[NDArray(v) for v in vs])
-        so = [o.data for o in _aslist(step_out)]
-        nbs = tuple(lax.dynamic_update_index_in_dim(b, o.astype(b.dtype), i, 0)
-                    for b, o in zip(bs, so))
-        return (i + 1, tuple(o.data for o in _aslist(new_lv)), nbs)
+        so = tuple(jnp.where(act, o.data, jnp.zeros_like(o.data))
+                   for o in _aslist(step_out))
+        nvs = tuple(jnp.where(act, n.data.astype(v.dtype), v)
+                    for n, v in zip(_aslist(new_lv), vs))
+        return (act, nvs), so
 
-    i, vs, bs = lax.while_loop(c_fn, b_fn,
-                               (jnp.asarray(0), tuple(datas), tuple(bufs)))
-    stacked = [NDArray(b) for b in bs]
+    (_, vs), ys = lax.scan(scan_step, (jnp.asarray(True), datas), None,
+                           length=max_iterations)
+    stacked = [NDArray(b) for b in ys]
     final = [NDArray(v) for v in vs]
     return stacked, (final[0] if single else final)
 

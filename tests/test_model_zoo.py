@@ -1,0 +1,52 @@
+"""Model zoo coverage (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def test_get_model_listing():
+    with pytest.raises(ValueError):
+        vision.get_model("no_such_model")
+    for name in ["resnet18_v1", "alexnet", "vgg11", "vgg16_bn",
+                 "squeezenet1_0", "squeezenet1_1", "mobilenet1_0",
+                 "mobilenet_v2_1_0", "densenet121", "densenet201",
+                 "inception_v3"]:
+        net = vision.get_model(name, classes=7)
+        assert net is not None, name
+
+
+@pytest.mark.parametrize("name,size", [("vgg11", 32),
+                                       ("mobilenet0_25", 32),
+                                       ("mobilenet_v2_0_25", 32)])
+def test_zoo_forward(name, size):
+    net = vision.get_model(name, classes=5)
+    net.initialize(mx.init.Xavier())
+    out = net(nd.zeros((2, 3, size, size)))
+    assert out.shape == (2, 5)
+
+
+def test_zoo_hybridize_parity():
+    mx.random.seed(42)
+    net = vision.get_model("mobilenet_v2_0_25", classes=4)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).rand(2, 3, 32, 32).astype("f"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert onp.allclose(eager, hybrid, atol=1e-5), \
+        onp.abs(eager - hybrid).max()
+
+
+def test_zoo_save_load(tmp_path):
+    net = vision.get_model("squeezenet1_1", classes=4)
+    net.initialize(mx.init.Xavier())
+    x = nd.zeros((1, 3, 224, 224))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "params")
+    net.save_parameters(f)
+    net2 = vision.get_model("squeezenet1_1", classes=4)
+    net2.load_parameters(f)
+    assert onp.allclose(net2(x).asnumpy(), ref, atol=1e-6)
