@@ -46,6 +46,8 @@ from . import module
 from . import module as mod
 from . import model
 from . import callback
+from . import recordio
+from . import tools  # noqa: F401
 
 # keep reference-style aliases
 Context = Context

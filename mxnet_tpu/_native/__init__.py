@@ -1,0 +1,71 @@
+"""Loader for the native C++ runtime library (librecordio.so).
+
+The reference's input pipeline is C++ (src/io/iter_image_recordio_2.cc);
+ours lives in native/recordio.cc and is loaded here via ctypes. Builds
+lazily with make/g++ on first import if the .so is missing; every consumer
+must handle `lib is None` (pure-Python fallback) so the package works
+without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_so_path = os.path.join(_here, "librecordio.so")
+_src_dir = os.path.join(os.path.dirname(os.path.dirname(_here)), "native")
+
+lib = None
+
+
+def _try_build():
+    src = os.path.join(_src_dir, "recordio.cc")
+    if not os.path.isfile(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", _so_path,
+             src, "-ljpeg", "-lpthread"],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global lib
+    if not os.path.isfile(_so_path) or (
+            os.path.isfile(os.path.join(_src_dir, "recordio.cc")) and
+            os.path.getmtime(os.path.join(_src_dir, "recordio.cc"))
+            > os.path.getmtime(_so_path)):
+        if not _try_build() and not os.path.isfile(_so_path):
+            return
+    try:
+        L = ctypes.CDLL(_so_path)
+    except OSError:
+        return
+    L.rio_open.restype = ctypes.c_void_p
+    L.rio_open.argtypes = [ctypes.c_char_p]
+    L.rio_close.argtypes = [ctypes.c_void_p]
+    L.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    L.rio_tell.restype = ctypes.c_long
+    L.rio_tell.argtypes = [ctypes.c_void_p]
+    L.rio_next.restype = ctypes.c_long
+    L.rio_next.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+    L.decode_jpeg.restype = ctypes.c_int
+    L.decode_jpeg.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte)]
+    L.decode_batch.restype = ctypes.c_int
+    L.decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte)]
+    lib = L
+
+
+_load()

@@ -1,0 +1,428 @@
+"""ImageRecordIter / ImageDetRecordIter / LibSVMIter.
+
+TPU-native re-design of the reference's C++ input pipeline
+(src/io/iter_image_recordio_2.cc: chunked record read → OMP-parallel JPEG
+decode+augment → batch → PrefetcherIter double-buffer;
+src/io/iter_libsvm.cc). Decode + crop + mirror run in the native library's
+thread pool (native/recordio.cc, no GIL); normalization (mean/std/scale)
+runs on-device in jnp so XLA fuses it with the first conv — host→HBM
+transfer stays uint8, 4x smaller than shipping float32.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+
+import numpy as onp
+
+from .io import DataIter, DataBatch, DataDesc
+from .. import recordio as rio
+
+
+def _index_offsets(path_imgrec, path_imgidx=None):
+    """Byte offset of every record (from .idx sidecar or a full scan)."""
+    if path_imgidx and os.path.isfile(path_imgidx):
+        offsets = []
+        with open(path_imgidx) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) >= 2:
+                    offsets.append(int(parts[1]))
+        if offsets:
+            return offsets
+    offsets = []
+    from .. import _native
+    if _native.lib is not None:
+        import ctypes
+        h = _native.lib.rio_open(path_imgrec.encode())
+        if h:
+            out = ctypes.POINTER(ctypes.c_ubyte)()
+            while True:
+                pos = _native.lib.rio_tell(h)
+                n = _native.lib.rio_next(h, ctypes.byref(out))
+                if n < 0:
+                    break
+                offsets.append(pos)
+            _native.lib.rio_close(h)
+            return offsets
+    r = rio.MXRecordIO(path_imgrec, "r")
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        offsets.append(pos)
+    r.close()
+    return offsets
+
+
+def _decode_batch_python(blobs, H, W, resize_short, crops):
+    """PIL fallback mirroring native decode_batch semantics."""
+    from io import BytesIO
+    from PIL import Image
+
+    out = onp.zeros((len(blobs), H, W, 3), dtype=onp.uint8)
+    for i, blob in enumerate(blobs):
+        try:
+            im = Image.open(BytesIO(blob)).convert("RGB")
+        except Exception:
+            continue
+        sw, sh = im.size
+        tw, th = sw, sh
+        if resize_short > 0:
+            if sh < sw:
+                th, tw = resize_short, max(1, sw * resize_short // sh)
+            else:
+                tw, th = resize_short, max(1, sh * resize_short // sw)
+        # proportional cover-scale up to the crop (same order as native)
+        if tw < W:
+            th = th * W // tw
+            tw = W
+        if th < H:
+            tw = tw * H // th
+            th = H
+        if (tw, th) != (sw, sh):
+            im = im.resize((tw, th), Image.BILINEAR)
+            sw, sh = tw, th
+        cy, cx, mirror = crops[i]
+        if cy < 0:
+            cy = (sh - H) // 2
+        else:
+            cy = cy * (sh - H) // 10000
+        if cx < 0:
+            cx = (sw - W) // 2
+        else:
+            cx = cx * (sw - W) // 10000
+        cy = min(max(cy, 0), sh - H)
+        cx = min(max(cx, 0), sw - W)
+        arr = onp.asarray(im)[cy:cy + H, cx:cx + W]
+        if mirror:
+            arr = arr[:, ::-1]
+        out[i] = arr
+    return out
+
+
+class ImageRecordIter(DataIter):
+    """Reference: ImageRecordIter v2 (src/io/iter_image_recordio_2.cc:880,
+    augmenters src/io/image_aug_default.cc). Supported params mirror the
+    common reference surface: data_shape, batch_size, shuffle, resize
+    (short edge), rand_crop, rand_mirror, mean/std per channel, scale,
+    label_width, part_index/num_parts sharding, preprocess_threads,
+    prefetch_buffer, round_batch."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, resize=-1, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, label_width=1,
+                 part_index=0, num_parts=1, preprocess_threads=4,
+                 prefetch_buffer=2, round_batch=True, seed=0,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3 and data_shape[0] == 3, \
+            "data_shape must be (3, H, W)"
+        self.path_imgrec = path_imgrec
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.resize = resize
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.scale = scale
+        self.round_batch = round_batch
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self.prefetch_buffer = max(1, int(prefetch_buffer))
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self._mean = onp.array([mean_r, mean_g, mean_b], dtype=onp.float32)
+        self._std = onp.array([std_r, std_g, std_b], dtype=onp.float32)
+        self._rng = onp.random.RandomState(seed)
+
+        offsets = _index_offsets(path_imgrec, path_imgidx)
+        # part_index/num_parts sharding (reference: distributed data split)
+        offsets = offsets[part_index::num_parts]
+        if not offsets:
+            raise ValueError(f"no records found in {path_imgrec}")
+        self._offsets = onp.array(offsets, dtype=onp.int64)
+        self._fp = open(path_imgrec, "rb")
+        self._order = onp.arange(len(self._offsets))
+        self._queue = None
+        self._worker = None
+        self._epoch_done = False
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shp)]
+
+    def _read_record(self, offset):
+        self._fp.seek(offset)
+        head = self._fp.read(8)
+        magic, lrec = struct.unpack("<II", head)
+        cflag, length = lrec >> 29, lrec & ((1 << 29) - 1)
+        if cflag == 0:
+            return self._fp.read(length)
+        # split record: reassemble via the recordio module
+        r = rio.MXRecordIO(self.path_imgrec, "r")
+        r.fio.seek(offset)
+        data = r.read()
+        r.close()
+        return data
+
+    @staticmethod
+    def _pad_idxs(idxs, epoch_order, bs):
+        """Fill a short final batch by wrapping the epoch order (tiled, so
+        shards smaller than one batch still fill up)."""
+        pad = bs - len(idxs)
+        if pad:
+            reps = -(-pad // len(epoch_order))
+            filler = onp.tile(epoch_order, reps)[:pad]
+            idxs = onp.concatenate([idxs, filler])
+        return idxs, pad
+
+    def _produce(self, epoch_order):
+        """Worker thread: decode batches into the queue."""
+        C, H, W = self.data_shape
+        bs = self.batch_size
+        n = len(epoch_order)
+        nbatch = n // bs if not self.round_batch else (n + bs - 1) // bs
+        try:
+            for b in range(nbatch):
+                idxs, pad = self._pad_idxs(epoch_order[b * bs:(b + 1) * bs],
+                                           epoch_order, bs)
+                blobs, labels = [], []
+                for i in idxs:
+                    rec = self._read_record(int(self._offsets[i]))
+                    header, blob = rio.unpack(rec)
+                    lab = onp.atleast_1d(
+                        onp.asarray(header.label, dtype=onp.float32))
+                    if lab.size < self.label_width:
+                        lab = onp.pad(lab, (0, self.label_width - lab.size))
+                    labels.append(lab[:self.label_width])
+                    blobs.append(blob)
+                # cy/cx: -1 = center; else fraction of free space /10000
+                crops = onp.full((bs, 3), -1, dtype=onp.int32)
+                crops[:, 2] = 0
+                if self.rand_crop:
+                    crops[:, 0] = self._rng.randint(0, 10001, bs)
+                    crops[:, 1] = self._rng.randint(0, 10001, bs)
+                if self.rand_mirror:
+                    crops[:, 2] = self._rng.randint(0, 2, bs)
+                batch_u8 = self._decode(blobs, H, W, crops)
+                label = onp.stack(labels)
+                if self.label_width == 1:
+                    label = label[:, 0]
+                self._queue.put((batch_u8, label, pad))
+            self._queue.put(None)
+        except BaseException as e:  # surface worker failures in next()
+            self._queue.put(("error", e))
+
+    def _decode(self, blobs, H, W, crops):
+        from .. import _native
+        resize_short = self.resize if self.resize and self.resize > 0 else 0
+        if _native.lib is not None:
+            import ctypes
+            blob = b"".join(blobs)
+            offs = onp.zeros(len(blobs), dtype=onp.int64)
+            lens = onp.zeros(len(blobs), dtype=onp.int64)
+            o = 0
+            for i, b_ in enumerate(blobs):
+                offs[i] = o
+                lens[i] = len(b_)
+                o += len(b_)
+            out = onp.zeros((len(blobs), H, W, 3), dtype=onp.uint8)
+            nat_crops = onp.ascontiguousarray(crops, dtype=onp.int32)
+            cbuf = (ctypes.c_ubyte * len(blob)).from_buffer_copy(blob)
+            _native.lib.decode_batch(
+                ctypes.cast(cbuf, ctypes.POINTER(ctypes.c_ubyte)),
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(blobs), H, W, resize_short,
+                nat_crops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                self.preprocess_threads,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)))
+            return out
+        pcrops = [tuple(int(v) for v in crops[i]) for i in range(len(blobs))]
+        return _decode_batch_python(blobs, H, W, resize_short, pcrops)
+
+    def reset(self):
+        if self._worker is not None and self._worker.is_alive():
+            # drain so the worker can exit (stop on end or error sentinel)
+            while True:
+                item = self._queue.get()
+                if item is None or (isinstance(item, tuple) and
+                                    len(item) == 2 and item[0] == "error"):
+                    break
+            self._worker.join()
+        order = self._order.copy()
+        if self.shuffle:
+            self._rng.shuffle(order)
+        self._queue = queue.Queue(maxsize=self.prefetch_buffer)
+        self._worker = threading.Thread(target=self._produce, args=(order,),
+                                        daemon=True)
+        self._worker.start()
+        self._epoch_done = False
+
+    def next(self):
+        from .. import nd
+
+        if self._epoch_done:
+            raise StopIteration
+        item = self._queue.get()
+        if item is None:
+            self._epoch_done = True
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
+            self._epoch_done = True
+            raise item[1]
+        batch_u8, label, pad = item
+        # device-side normalize: uint8 HWC → float CHW, (x-mean)/std*scale;
+        # XLA fuses this into the consumer
+        x = nd.array(batch_u8)
+        x = (x.astype("float32") - nd.array(self._mean)) / \
+            nd.array(self._std) * self.scale
+        x = x.transpose((0, 3, 1, 2))
+        if self.dtype != "float32":
+            x = x.astype(self.dtype)
+        return DataBatch(data=[x], label=[nd.array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection variant (reference: src/io/iter_image_det_recordio.cc):
+    labels are variable-length [header_width, obj_width, id, xmin, ymin,
+    xmax, ymax, ...] padded with -1 to label_pad_width."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=35, label_pad_value=-1.0, **kwargs):
+        self._pad_value = label_pad_value
+        kwargs.setdefault("label_width", label_pad_width)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+
+    def _produce(self, epoch_order):
+        # identical pipeline; labels pad with label_pad_value instead of 0
+        C, H, W = self.data_shape
+        bs = self.batch_size
+        n = len(epoch_order)
+        nbatch = (n + bs - 1) // bs if self.round_batch else n // bs
+        try:
+            for b in range(nbatch):
+                idxs, pad = self._pad_idxs(epoch_order[b * bs:(b + 1) * bs],
+                                           epoch_order, bs)
+                blobs, labels = [], []
+                for i in idxs:
+                    rec = self._read_record(int(self._offsets[i]))
+                    header, blob = rio.unpack(rec)
+                    lab = onp.atleast_1d(
+                        onp.asarray(header.label, dtype=onp.float32))
+                    out = onp.full(self.label_width, self._pad_value,
+                                   dtype=onp.float32)
+                    out[:min(lab.size, self.label_width)] = \
+                        lab[:self.label_width]
+                    labels.append(out)
+                    blobs.append(blob)
+                crops = onp.full((bs, 3), -1, dtype=onp.int32)
+                crops[:, 2] = 0
+                batch_u8 = self._decode(blobs, H, W, crops)
+                self._queue.put((batch_u8, onp.stack(labels), pad))
+            self._queue.put(None)
+        except BaseException as e:
+            self._queue.put(("error", e))
+
+
+class LibSVMIter(DataIter):
+    """Sparse text format iterator (reference: src/io/iter_libsvm.cc).
+    Yields CSR data batches."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape) if hasattr(data_shape, "__len__") \
+            else (data_shape,)
+        ncol = self.data_shape[-1]
+        indptr, indices, values, labels = [0], [], [], []
+        # labels come from the first token of each data line unless a
+        # separate label file is given (reference: iter_libsvm.cc
+        # label_libsvm param)
+        inline_labels = label_libsvm is None
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                feats = parts
+                if inline_labels:
+                    labels.append(float(parts[0]))
+                    feats = parts[1:]
+                for tok in feats:
+                    k, v = tok.split(":")
+                    indices.append(int(k))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        if not inline_labels:
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        labels.append(float(parts[0]))
+            if len(labels) != len(indptr) - 1:
+                raise ValueError(
+                    "label_libsvm has %d rows, data has %d"
+                    % (len(labels), len(indptr) - 1))
+        self._indptr = onp.array(indptr, dtype=onp.int64)
+        self._indices = onp.array(indices, dtype=onp.int64)
+        self._values = onp.array(values, dtype=onp.float32)
+        self._labels = onp.array(labels, dtype=onp.float32)
+        self._ncol = ncol
+        self.round_batch = round_batch
+        self._cursor = 0
+        self.num_data = len(self._labels)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._ncol))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from .. import nd
+        from ..ndarray.sparse import csr_matrix
+
+        if self._cursor >= self.num_data:
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        self._cursor = lo + self.batch_size
+        pad = self.batch_size - (hi - lo)
+        rows = list(range(lo, hi)) + \
+            [i % self.num_data for i in range(pad)]
+        ip = [0]
+        ind, val = [], []
+        for r in rows:
+            s, e = self._indptr[r], self._indptr[r + 1]
+            ind.extend(self._indices[s:e])
+            val.extend(self._values[s:e])
+            ip.append(len(ind))
+        data = csr_matrix((onp.array(val, dtype=onp.float32),
+                           onp.array(ind, dtype=onp.int64),
+                           onp.array(ip, dtype=onp.int64)),
+                          shape=(self.batch_size, self._ncol))
+        label = self._labels[[r for r in rows]]
+        return DataBatch(data=[data], label=[nd.array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,2 @@
+"""Tooling (reference: tools/ — im2rec, launch.py)."""
+from . import im2rec  # noqa: F401

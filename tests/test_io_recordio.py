@@ -1,0 +1,166 @@
+"""RecordIO + image pipeline tests.
+
+Reference coverage model: tests/python/unittest/test_recordio.py +
+test_io.py (ImageRecordIter cases).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio as rio
+
+MAGIC = bytes.fromhex("0a23d7ce")  # little-endian 0xced7230a
+
+
+@pytest.fixture(scope="module")
+def img_pack(tmp_path_factory):
+    from PIL import Image
+    from mxnet_tpu.tools import im2rec as i2r
+
+    tmp = tmp_path_factory.mktemp("rec")
+    root = tmp / "imgs"
+    for ci, cls in enumerate(["a", "b"]):
+        (root / cls).mkdir(parents=True)
+        for i in range(5):
+            arr = onp.full((40 + 8 * i, 48, 3), 30 + 90 * ci,
+                           dtype=onp.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.jpg")
+    prefix = str(tmp / "ds")
+    i2r.make_list(str(root), prefix, shuffle=False)
+    n = i2r.im2rec(prefix + ".lst", str(root), prefix)
+    assert n == 10
+    return prefix
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    payloads = [b"hello", b"x" * 37, b"A" + MAGIC + b"B", MAGIC * 3, b"",
+                MAGIC + b"tail", b"head" + MAGIC]
+    w = rio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = rio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_native_reader_parity(tmp_path):
+    from mxnet_tpu import _native
+    if _native.lib is None:
+        pytest.skip("native lib unavailable")
+    import ctypes
+
+    path = str(tmp_path / "t.rec")
+    payloads = [b"abc", MAGIC + b"x" + MAGIC, b"z" * 101]
+    w = rio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    h = _native.lib.rio_open(path.encode())
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    for p in payloads:
+        n = _native.lib.rio_next(h, ctypes.byref(out))
+        got = bytes(bytearray(out[:n])) if n > 0 else b""
+        assert got == p
+    assert _native.lib.rio_next(h, ctypes.byref(out)) == -1
+    _native.lib.rio_close(h)
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = rio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(7):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = rio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(7))
+    assert r.read_idx(5) == b"rec5"
+    assert r.read_idx(0) == b"rec0"
+    r.close()
+
+
+def test_pack_unpack_labels():
+    h = rio.IRHeader(0, 3.5, 7, 0)
+    blob = rio.pack(h, b"payload")
+    h2, s = rio.unpack(blob)
+    assert h2.label == 3.5 and h2.id == 7 and s == b"payload"
+    # multi-label
+    h = rio.IRHeader(0, [1.0, 2.0, 3.0], 9, 0)
+    h2, s = rio.unpack(rio.pack(h, b"xy"))
+    assert h2.flag == 3
+    assert onp.allclose(h2.label, [1.0, 2.0, 3.0])
+    assert s == b"xy"
+
+
+def test_image_record_iter(img_pack):
+    it = mx.io.ImageRecordIter(
+        path_imgrec=img_pack + ".rec", path_imgidx=img_pack + ".idx",
+        data_shape=(3, 32, 32), batch_size=4, shuffle=False)
+    batches = list(it)
+    assert sum(4 - b.pad for b in batches) == 10
+    first = batches[0]
+    assert first.data[0].shape == (4, 3, 32, 32)
+    # class 'a' images are constant 30 (jpeg-lossy): first records
+    v = first.data[0].asnumpy()[0].mean()
+    assert abs(v - 30) < 3, v
+    assert onp.allclose(first.label[0].asnumpy(), 0)
+    # reset + iterate again works
+    it.reset()
+    assert sum(1 for _ in it) == len(batches)
+
+
+def test_image_record_iter_augment(img_pack):
+    it = mx.io.ImageRecordIter(
+        path_imgrec=img_pack + ".rec", data_shape=(3, 24, 24), batch_size=2,
+        shuffle=True, rand_crop=True, rand_mirror=True, resize=30,
+        mean_r=127.0, mean_g=127.0, mean_b=127.0, std_r=64.0, std_g=64.0,
+        std_b=64.0, seed=3)
+    b = next(iter(it))
+    x = b.data[0].asnumpy()
+    assert x.shape == (2, 3, 24, 24)
+    assert x.min() >= -2.1 and x.max() <= 2.1
+
+
+def test_image_det_record_iter(img_pack):
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=img_pack + ".rec", data_shape=(3, 24, 24), batch_size=5,
+        label_pad_width=8)
+    b = next(iter(it))
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (5, 8)
+    assert (lab[:, 1:] == -1).all()  # single scalar label, rest padded
+
+
+def test_libsvm_iter(tmp_path):
+    svm = str(tmp_path / "d.libsvm")
+    with open(svm, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=svm, data_shape=(5,), batch_size=2)
+    b = next(iter(it))
+    dense = b.data[0].asnumpy()
+    assert onp.allclose(dense, [[1.5, 0, 0, 2.0, 0], [0, 1.0, 0, 0, 0]])
+    assert onp.allclose(b.label[0].asnumpy(), [1, 0])
+
+
+def test_native_python_decode_parity(img_pack):
+    from mxnet_tpu import _native
+    from mxnet_tpu.io.image_record import _decode_batch_python
+    if _native.lib is None:
+        pytest.skip("native lib unavailable")
+    r = rio.MXIndexedRecordIO(img_pack + ".idx", img_pack + ".rec", "r")
+    _, blob = rio.unpack(r.read_idx(r.keys[0]))
+    r.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=img_pack + ".rec", data_shape=(3, 32, 32), batch_size=1,
+        resize=36)
+    native = it._decode([blob], 32, 32, onp.full((1, 3), -1, onp.int32))
+    native[0, :, :, :]  # shape check
+    py = _decode_batch_python([blob], 32, 32, 36, [(-1, -1, 0)])
+    # uniform-color images: decode paths must agree almost exactly
+    assert abs(native.astype(int) - py.astype(int)).max() <= 2
