@@ -48,6 +48,7 @@ from . import model
 from . import callback
 from . import recordio
 from . import tools  # noqa: F401
+from . import contrib  # noqa: F401
 
 # keep reference-style aliases
 Context = Context

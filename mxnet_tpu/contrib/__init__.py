@@ -1,0 +1,2 @@
+"""mx.contrib (reference: python/mxnet/contrib/__init__.py)."""
+from . import amp  # noqa: F401

@@ -1,0 +1,74 @@
+"""Automatic mixed precision (reference: python/mxnet/contrib/amp/amp.py).
+
+TPU-native policy: target dtype defaults to **bfloat16** — the MXU's
+native input type; fp32 accumulation comes free from XLA, so unlike the
+reference's fp16 flow no loss scaling is required by default (the dynamic
+LossScaler remains available and is exercised for fp16 parity). `init()`
+activates op-list-driven input casting inside the op dispatch layer
+(reference wraps every registered op at init, amp.py:251; here the
+registry applies the cast inside each op's pure function so the casts live
+on the tape/jaxpr and XLA fuses them into the MXU ops).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from . import lists
+from .loss_scaler import LossScaler
+from ...ndarray import registry as _registry
+
+_state = {"initialized": False, "target_dtype": None}
+
+
+def init(target_dtype="bfloat16"):
+    """Turn on AMP for all subsequently executed ops."""
+    assert target_dtype in ("bfloat16", "float16"), target_dtype
+    _registry.set_amp(target_dtype,
+                      target_ops=lists.TARGET_DTYPE_OPS,
+                      fp32_ops=lists.FP32_OPS,
+                      widest_ops=lists.WIDEST_TYPE_CASTS)
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+
+
+def disable():
+    """Turn AMP back off (testing convenience; reference has no inverse)."""
+    _registry.set_amp(None)
+    _state["initialized"] = False
+    _state["target_dtype"] = None
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Gluon Trainer (reference:
+    amp.py:288 init_trainer)."""
+    if not _state["initialized"]:
+        raise RuntimeError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """`with amp.scale_loss(loss, trainer) as scaled: scaled.backward()`
+    (reference: amp.py scale_loss). Scales the loss up; trainer.step
+    divides gradients back down and skips the step on overflow."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a Gluon block's parameters/compute to the target dtype, keeping
+    norm layers fp32 (reference: amp.py convert_model / the
+    low_precision_pass.cc graph rewrite; BatchNorm.cast pins its params
+    fp32 here)."""
+    net.cast(target_dtype)
+    return net
+
+
+convert_hybrid_block = convert_model

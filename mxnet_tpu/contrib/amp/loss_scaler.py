@@ -1,0 +1,37 @@
+"""Dynamic loss scaler (reference: python/mxnet/contrib/amp/loss_scaler.py).
+
+Needed for fp16 parity; bf16 on TPU has fp32's exponent range so the
+default bf16 policy trains without scaling (the scaler still works if
+enabled)."""
+from __future__ import annotations
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference: multi_all_finite
+        kernel, src/operator/contrib/all_finite.cc)."""
+        from ... import nd
+
+        grads = [p.grad() for p in params if p.grad_req != "null"]
+        if not grads:
+            return False
+        ok = nd.all_finite(*grads)
+        return not bool(ok.asnumpy().item())
+
+    def update_scale(self, overflow):
+        """Halve on overflow; double every scale_window clean steps."""
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -238,7 +238,7 @@ class CachedOp:
         self._block = block
         self._param_list = None  # list[Parameter], fixed order
         self._out_treedefs = {}
-        self._jitted = jax.jit(self._pure, static_argnums=(0,))
+        self._jitted = jax.jit(self._pure, static_argnums=(0, 1))
 
     def _ensure_params(self):
         if self._param_list is None:
@@ -246,7 +246,10 @@ class CachedOp:
                                 sorted(self._block.collect_params().items())]
         return self._param_list
 
-    def _pure(self, train, param_vals, key, input_datas):
+    def _pure(self, amp_ver, train, param_vals, key, input_datas):
+        # amp_ver is a static cache key only: a set_amp() bump forces a
+        # retrace so the current AMP policy is baked into the new trace
+        del amp_ver
         params = self._ensure_params()
         pnds = [p._ndarray for p in params]
         saved = [p._data for p in pnds]
@@ -278,10 +281,12 @@ class CachedOp:
         input_datas = [a.data for a in args]
         key = mxrandom.next_key()
         train = autograd.is_training()
+        from ..ndarray import registry as _op_registry
+        _amp_ver = _op_registry.amp_version()
 
         if autograd.is_recording():
             (out_datas, mutated), vjp_fn, = _vjp2(
-                lambda pv, iv: self._jitted(train, pv, key, iv),
+                lambda pv, iv: self._jitted(_amp_ver, train, pv, key, iv),
                 param_vals, input_datas)
             outs = [NDArray(d) for d in out_datas]
 
@@ -292,8 +297,8 @@ class CachedOp:
 
             autograd._record_op(tape_vjp, pnds + list(args), outs)
         else:
-            out_datas, mutated = self._jitted(train, param_vals, key,
-                                              input_datas)
+            out_datas, mutated = self._jitted(_amp_ver, train, param_vals,
+                                              key, input_datas)
             outs = [NDArray(d) for d in out_datas]
         for i_str, val in mutated.items():
             pnds[int(i_str)]._data = val

@@ -100,13 +100,29 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale by 1/batch_size, allreduce, update
-        (reference: trainer.py step)."""
+        (reference: trainer.py step). With an AMP loss scaler attached
+        (amp.init_trainer), gradients are additionally divided by the loss
+        scale and the whole step is skipped on overflow (reference:
+        amp/loss_scaler.py skip-step via multi_all_finite)."""
         rescale = self._scale / batch_size
-        self._optimizer.rescale_grad = rescale
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        # allreduce BEFORE the overflow check: every worker then sees the
+        # same reduced gradients and takes the same skip/apply branch (a
+        # local check would desync workers and hang the next collective)
         self.allreduce_grads()
+        if scaler is not None:
+            if scaler.has_overflow(self._params):
+                scaler.update_scale(True)
+                return  # skip the update entirely
+            # divide by the CURRENT scale (the one the loss was multiplied
+            # by); grow the scale only after the step is applied
+            rescale = rescale / scaler.loss_scale
+        self._optimizer.rescale_grad = rescale
         self.update(batch_size, ignore_stale_grad=ignore_stale_grad,
                     _skip_rescale=True)
         self._optimizer.rescale_grad = self._scale
+        if scaler is not None:
+            scaler.update_scale(False)
 
     def update(self, batch_size, ignore_stale_grad=False,
                _skip_rescale=False):

@@ -199,7 +199,15 @@ def cond(pred, then_func, else_func):
 _CONTRIB_OPS = [
     "boolean_mask", "index_copy", "index_array", "adaptive_avg_pooling2d",
     "bilinear_resize2d", "all_finite", "multi_sum_sq",
+    "box_iou", "box_nms", "bipartite_matching", "multibox_prior",
+    "multibox_target", "multibox_detection", "roi_align",
 ]
+
+# CamelCase contrib aliases (reference registered names)
+_CONTRIB_ALIASES = {"MultiBoxPrior": "multibox_prior",
+                    "MultiBoxTarget": "multibox_target",
+                    "MultiBoxDetection": "multibox_detection",
+                    "ROIAlign": "roi_align"}
 
 
 def _install():
@@ -211,6 +219,8 @@ def _install():
             raise RuntimeError(f"contrib op '{name}' listed but unregistered")
         if not hasattr(mod, name):
             setattr(mod, name, _registry.make_wrapper(od))
+    for alias, target in _CONTRIB_ALIASES.items():
+        setattr(mod, alias, getattr(mod, target))
 
 
 _install()

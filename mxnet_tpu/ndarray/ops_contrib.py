@@ -1,0 +1,364 @@
+"""Contrib detection ops: MultiBoxPrior/Target/Detection, box_nms, box_iou,
+bipartite_matching, roi_align.
+
+TPU-native equivalents of the reference's hand-CUDA detection kernels
+(src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc, bounding_box.cc, roi_align.cc). The reference
+suppresses boxes with sequential loops; here NMS/matching are expressed as
+masked O(N^2) computations driven by lax.fori_loop/scan over static shapes
+— XLA keeps the IoU matrices on-chip and the whole SSD head stays inside
+one compiled program (no host sync, unlike the CUDA kernels which
+round-trip through thrust sorts).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ----------------------------------------------------------------- IoU ----
+
+def _corner_iou(a, b):
+    """IoU between (..., Na, 4) and (..., Nb, 4) corner boxes →
+    (..., Na, Nb)."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0, None)
+    ih = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax2 - ax1, 0, None) * jnp.clip(ay2 - ay1, 0, None)
+    area_b = jnp.clip(bx2 - bx1, 0, None) * jnp.clip(by2 - by1, 0, None)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(x, fmt):
+    if fmt == "corner":
+        return x
+    cx, cy, w, h = (x[..., i] for i in range(4))
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register()
+def box_iou(lhs, rhs, format="corner"):
+    """Reference: src/operator/contrib/bounding_box.cc (_contrib_box_iou)."""
+    return _corner_iou(_to_corner(lhs, format), _to_corner(rhs, format))
+
+
+@register(differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Reference: src/operator/contrib/bounding_box.cc (_contrib_box_nms).
+    data (..., N, K) rows [.., score, .., coords]; suppressed/invalid rows
+    become -1. Greedy NMS as a fori_loop over score-sorted rows with a
+    keep mask — static shape, differentiation not required (matches
+    reference: no gradient)."""
+    d = data
+    batchless = d.ndim == 2
+    if batchless:
+        d = d[None]
+    B, N, K = d.shape
+    scores = d[..., score_index]
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid &= d[..., id_index] != background_id
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=-1)
+    ds = jnp.take_along_axis(d, order[..., None], axis=1)
+    vs = jnp.take_along_axis(valid, order, axis=1)
+    if topk > 0:
+        vs &= jnp.arange(N)[None, :] < topk
+    boxes = _to_corner(
+        lax.dynamic_slice_in_dim(ds, coord_start, 4, axis=2), in_format)
+    iou = _corner_iou(boxes, boxes)  # (B, N, N)
+    if id_index >= 0 and not force_suppress:
+        same = ds[..., :, None, id_index] == ds[..., None, :, id_index]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(i, keep):
+        ki = keep[:, i] & vs[:, i]
+        sup = (iou[:, i, :] > overlap_thresh) & ki[:, None] & \
+            (jnp.arange(N)[None, :] > i)
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, N, body, jnp.ones((B, N), bool)) & vs
+    out = jnp.where(keep[..., None], ds, -jnp.ones_like(ds))
+    if out_format != in_format:
+        c = _to_corner(out[..., coord_start:coord_start + 4], in_format) \
+            if out_format == "corner" else None
+        if c is None:  # corner → center
+            x1, y1, x2, y2 = (out[..., coord_start + i] for i in range(4))
+            c = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                          axis=-1)
+        out = out.at[..., coord_start:coord_start + 4].set(
+            jnp.where(keep[..., None], c, -1.0))
+    return out[0] if batchless else out
+
+
+@register(differentiable=False)
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Reference: src/operator/contrib/bounding_box.cc
+    (_contrib_bipartite_matching). data (B, N, M) score matrix → greedy
+    1:1 matching. Returns (row_match (B,N) col index or -1,
+    col_match (B,M) row index or -1)."""
+    d = data
+    batchless = d.ndim == 2
+    if batchless:
+        d = d[None]
+    B, N, M = d.shape
+    score = -d if is_ascend else d
+    thr = -threshold if is_ascend else threshold
+    n_iter = min(N, M) if topk <= 0 else min(topk, min(N, M))
+
+    def body(_, state):
+        s, rm, cm = state
+        flat = s.reshape(B, -1)
+        best = jnp.argmax(flat, axis=-1)
+        bi, bj = best // M, best % M
+        val = jnp.take_along_axis(flat, best[:, None], -1)[:, 0]
+        ok = val > thr
+        rm = jnp.where(ok[:, None] & (jnp.arange(N)[None] == bi[:, None]),
+                       bj[:, None], rm)
+        cm = jnp.where(ok[:, None] & (jnp.arange(M)[None] == bj[:, None]),
+                       bi[:, None], cm)
+        # knock out matched row+col
+        s = jnp.where((jnp.arange(N)[None, :, None] == bi[:, None, None]) |
+                      (jnp.arange(M)[None, None, :] == bj[:, None, None]),
+                      -jnp.inf, s)
+        return s, rm, cm
+
+    rm0 = jnp.full((B, N), -1, jnp.int32)
+    cm0 = jnp.full((B, M), -1, jnp.int32)
+    _, rm, cm = lax.fori_loop(0, n_iter, body, (score, rm0, cm0))
+    rm = rm.astype(data.dtype)
+    cm = cm.astype(data.dtype)
+    return (rm[0], cm[0]) if batchless else (rm, cm)
+
+
+# ----------------------------------------------------------- multibox ----
+
+@register(differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Reference: src/operator/contrib/multibox_prior.cc. data (N,C,H,W) →
+    (1, H*W*A, 4) normalized corner anchors, A = len(sizes)+len(ratios)-1:
+    (size_i, ratio_0) for every size then (size_0, ratio_j) for j>0."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    # steps/offsets are (y, x) — reference multibox_prior param docs
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    whs = [(s * (ratios[0] ** 0.5), s / (ratios[0] ** 0.5)) for s in sizes]
+    whs += [(sizes[0] * (r ** 0.5), sizes[0] / (r ** 0.5))
+            for r in ratios[1:]]
+    ws = jnp.asarray([w / 2 for w, _ in whs], jnp.float32)
+    hs = jnp.asarray([h / 2 for _, h in whs], jnp.float32)
+    x1 = gx[..., None] - ws
+    y1 = gy[..., None] - hs
+    x2 = gx[..., None] + ws
+    y2 = gy[..., None] + hs
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register(differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Reference: src/operator/contrib/multibox_target.cc. anchor
+    (1, N, 4); label (B, M, 5) rows [cls, x1, y1, x2, y2], -1-padded;
+    cls_pred (B, num_cls+1, N). Returns (box_target (B, N*4),
+    box_mask (B, N*4), cls_target (B, N)): bipartite match per gt, then
+    IoU>threshold matching; optional hard-negative mining by background
+    confidence."""
+    anc = anchor.reshape(-1, 4)
+    N = anc.shape[0]
+    B, M = label.shape[0], label.shape[1]
+    v = jnp.asarray(variances, jnp.float32)
+
+    def one(lab, cp):
+        gt_valid = lab[:, 0] >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _corner_iou(anc, gt_boxes)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+
+        # stage 1: greedy bipartite — each gt claims its best anchor
+        def bip(_, state):
+            s, amatch = state
+            flat_best = jnp.argmax(s)
+            bi, bj = flat_best // M, flat_best % M
+            ok = s[bi, bj] > 1e-12
+            amatch = jnp.where(
+                ok & (jnp.arange(N) == bi), bj, amatch)
+            s = jnp.where((jnp.arange(N)[:, None] == bi) |
+                          (jnp.arange(M)[None, :] == bj), -jnp.inf, s)
+            return s, amatch
+
+        amatch0 = jnp.full((N,), -1, jnp.int32)
+        _, amatch = lax.fori_loop(0, M, bip,
+                                  (jnp.where(gt_valid[None, :], iou,
+                                             -jnp.inf), amatch0))
+        # stage 2: remaining anchors match argmax gt if IoU > threshold
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        amatch = jnp.where((amatch < 0) & (best_iou > overlap_threshold),
+                           best_gt, amatch)
+
+        matched = amatch >= 0
+        gidx = jnp.clip(amatch, 0, M - 1)
+        gcls = jnp.take(lab[:, 0], gidx)
+        cls_t = jnp.where(matched, gcls + 1.0, 0.0)
+
+        # hard negative mining: keep top-(ratio*npos) negatives by bg conf
+        if negative_mining_ratio > 0:
+            npos = jnp.sum(matched)
+            maxneg = jnp.maximum(npos * negative_mining_ratio,
+                                 minimum_negative_samples).astype(jnp.int32)
+            # background confidence after softmax over classes
+            prob = jax.nn.softmax(cp, axis=0)  # (C+1, N)
+            bg_conf = prob[0]
+            neg_score = jnp.where(matched, jnp.inf, bg_conf)
+            # low bg confidence = hard negative → rank ascending
+            rank = jnp.argsort(jnp.argsort(neg_score))
+            is_neg = ~matched & (rank < maxneg) & \
+                (1.0 - bg_conf > negative_mining_thresh)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(is_neg, 0.0, ignore_label))
+
+        gbox = jnp.take(gt_boxes, gidx, axis=0)  # (N, 4)
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.clip(anc[:, 2] - anc[:, 0], 1e-8, None)
+        ah = jnp.clip(anc[:, 3] - anc[:, 1], 1e-8, None)
+        gcx = (gbox[:, 0] + gbox[:, 2]) / 2
+        gcy = (gbox[:, 1] + gbox[:, 3]) / 2
+        gw = jnp.clip(gbox[:, 2] - gbox[:, 0], 1e-8, None)
+        gh = jnp.clip(gbox[:, 3] - gbox[:, 1], 1e-8, None)
+        bt = jnp.stack([(gcx - acx) / aw / v[0], (gcy - acy) / ah / v[1],
+                        jnp.log(gw / aw) / v[2], jnp.log(gh / ah) / v[3]],
+                       axis=-1)
+        bt = jnp.where(matched[:, None], bt, 0.0).reshape(-1)
+        bm = jnp.repeat(matched.astype(jnp.float32), 4)
+        return bt, bm, cls_t
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+@register(differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Reference: src/operator/contrib/multibox_detection.cc. cls_prob
+    (B, C+1, N), loc_pred (B, N*4), anchor (1, N, 4) → (B, N, 6) rows
+    [class_id, score, x1, y1, x2, y2], suppressed rows -1."""
+    B, C1, N = cls_prob.shape
+    v = jnp.asarray(variances, jnp.float32)
+    anc = anchor.reshape(-1, 4)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    loc = loc_pred.reshape(B, N, 4)
+    cx = loc[..., 0] * v[0] * aw + acx
+    cy = loc[..., 1] * v[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * v[2]) * aw / 2
+    h = jnp.exp(loc[..., 3] * v[3]) * ah / 2
+    boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # best non-background class per anchor
+    scores = jnp.moveaxis(cls_prob, 1, 2)  # (B, N, C+1)
+    mask = jnp.arange(C1)[None, None, :] != background_id
+    scores_nb = jnp.where(mask, scores, -jnp.inf)
+    cls = jnp.argmax(scores_nb, axis=-1)
+    score = jnp.max(scores_nb, axis=-1)
+    # class id output excludes background slot (reference: id = argmax - 1
+    # for background_id == 0)
+    out_id = jnp.where(cls > background_id, cls - 1, cls).astype(jnp.float32)
+    keep = score > threshold
+    out = jnp.concatenate(
+        [jnp.where(keep, out_id, -1.0)[..., None],
+         jnp.where(keep, score, -1.0)[..., None],
+         jnp.where(keep[..., None], boxes, -1.0)], axis=-1)
+    return _nms_raw(out, nms_threshold, nms_topk, force_suppress)
+
+
+def _nms_raw(out, nms_threshold, nms_topk, force_suppress):
+    from .registry import get_op
+    fn = get_op("box_nms").fn
+    return fn(out, overlap_thresh=nms_threshold, valid_thresh=0.0,
+              topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+              force_suppress=force_suppress)
+
+
+# ----------------------------------------------------------- roi_align ----
+
+@register()
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False):
+    """Reference: src/operator/contrib/roi_align.cc (Mask-RCNN ROIAlign).
+    Average of bilinear samples on a fixed grid per bin (sample_ratio
+    points per axis; -1 → 2, static for XLA). Differentiable (the
+    reference implements a hand-written backward; here jax.vjp of the
+    gather does it)."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "position_sensitive=True (PSROIAlign) is not implemented")
+    ph, pw = pooled_size
+    s = 2 if sample_ratio <= 0 else int(sample_ratio)
+    N, C, H, W = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bw, bh = rw / pw, rh / ph
+        img = jnp.take(data, b, axis=0)  # (C, H, W)
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(s, dtype=jnp.float32)
+        # sample centers: y1 + (i + (k+0.5)/s) * bh
+        ys = y1 + (iy[:, None] + (sy[None, :] + 0.5) / s) * bh  # (ph, s)
+        xs = x1 + (ix[:, None] + (sy[None, :] + 0.5) / s) * bw  # (pw, s)
+        ys = ys.reshape(-1)  # (ph*s,)
+        xs = xs.reshape(-1)  # (pw*s,)
+
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+
+        def gat(yi, xi):
+            yi = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+            return img[:, yi[:, None], xi[None, :]]  # (C, ph*s, pw*s)
+
+        v00 = gat(y0, x0)
+        v01 = gat(y0, x0 + 1)
+        v10 = gat(y0 + 1, x0)
+        v11 = gat(y0 + 1, x0 + 1)
+        wy_ = wy[:, None]
+        wx_ = wx[None, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)  # (C, ph*s, pw*s)
+        val = val.reshape(C, ph, s, pw, s)
+        return val.mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois.astype(jnp.float32))

@@ -60,6 +60,60 @@ def _unwrap(x):
     return x
 
 
+# AMP state installed by mxnet_tpu.contrib.amp.init() — when active,
+# invoke() casts float inputs per the op lists before dispatch (the
+# reference wraps every registered op at amp.init, contrib/amp/amp.py:251)
+_AMP = {"on": False, "target": None, "target_ops": frozenset(),
+        "fp32_ops": frozenset(), "widest_ops": frozenset(), "version": 0}
+
+_FLOATS = ("float16", "bfloat16", "float32", "float64")
+
+
+def set_amp(target_dtype=None, target_ops=(), fp32_ops=(), widest_ops=()):
+    _AMP["on"] = target_dtype is not None
+    _AMP["target"] = target_dtype
+    _AMP["target_ops"] = frozenset(target_ops)
+    _AMP["fp32_ops"] = frozenset(fp32_ops)
+    _AMP["widest_ops"] = frozenset(widest_ops)
+    # traced code (CachedOp) bakes the casts in; bumping the version keys
+    # a fresh trace so init()/disable() take effect on hybridized blocks
+    _AMP["version"] += 1
+
+
+def amp_version():
+    return _AMP["version"]
+
+
+def _amp_cast_fn(opname):
+    """Returns f(list of arrays) -> list of arrays applying the AMP policy
+    for this op, or None. Applied inside the op's pure function so the
+    casts sit on the tape/jaxpr and gradients flow back through them."""
+    if not _AMP["on"]:
+        return None
+    if opname in _AMP["target_ops"]:
+        to = _AMP["target"]
+    elif opname in _AMP["fp32_ops"]:
+        to = "float32"
+    elif opname in _AMP["widest_ops"]:
+        def widest(xs):
+            fl = [x for x in xs if hasattr(x, "dtype")
+                  and str(x.dtype) in _FLOATS]
+            if not fl:
+                return xs
+            w = max((str(x.dtype) for x in fl), key=_FLOATS.index)
+            return [x.astype(w) if hasattr(x, "dtype")
+                    and str(x.dtype) in _FLOATS else x for x in xs]
+        return widest
+    else:
+        return None
+
+    def cast(xs):
+        return [x.astype(to) if hasattr(x, "dtype")
+                and str(x.dtype) in _FLOATS and str(x.dtype) != to else x
+                for x in xs]
+    return cast
+
+
 def invoke(opdef, args, kwargs):
     """Dispatch an op: unwrap NDArrays, run (recording a vjp if needed), wrap.
 
@@ -88,7 +142,11 @@ def invoke(opdef, args, kwargs):
             arr_args.append(v)
             del kwargs[k]
 
+    amp_cast = _amp_cast_fn(opdef.name)
+
     def pure_fn(*xs):
+        if amp_cast is not None:
+            xs = amp_cast(list(xs))
         pos = [xs[a[1]] if a[0] == "arr" else a[1] for a in arg_template]
         kw = dict(kwargs)
         for k, idx in kw_arrays.items():

@@ -1,0 +1,99 @@
+"""AMP tests (reference: tests/python/gpu/test_contrib_amp.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.contrib import amp
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.disable()
+
+
+def test_amp_cast_policy():
+    amp.init("bfloat16")
+    x = nd.array(onp.random.rand(4, 8).astype("f"))
+    w = nd.array(onp.random.rand(16, 8).astype("f"))
+    out = nd.fully_connected(x, w, num_hidden=16, no_bias=True)
+    assert str(out.dtype) == "bfloat16"  # target-dtype op
+    s = nd.softmax(nd.array(onp.random.rand(2, 3).astype("f"))
+                   .astype("bfloat16"))
+    assert str(s.dtype) == "float32"  # fp32 op upcasts
+    m = nd.elemwise_add(nd.array([1.]).astype("bfloat16"), nd.array([2.]))
+    assert str(m.dtype) == "float32"  # widest-type op
+    amp.disable()
+    out = nd.fully_connected(x, w, num_hidden=16, no_bias=True)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_grads_flow_through_casts():
+    amp.init("bfloat16")
+    x = nd.array(onp.random.rand(4, 8).astype("f"))
+    w = nd.array(onp.random.rand(16, 8).astype("f"))
+    w.attach_grad()
+    with autograd.record():
+        out = nd.fully_connected(x, w, num_hidden=16, no_bias=True)
+        loss = nd.sum(out)
+    loss.backward()
+    g = w.grad
+    assert str(g.dtype) == "float32"  # grads land in the param dtype
+    assert float(nd.sum(nd.abs(g)).asnumpy()) > 0
+
+
+def test_amp_training_with_loss_scaler():
+    amp.init("bfloat16")
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    X = rs.randn(32, 8).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    first = None
+    for _ in range(20):
+        with autograd.record():
+            l = lf(net(nd.array(X)), nd.array(y)).mean()
+            with amp.scale_loss(l, tr) as sl:
+                sl.backward()
+        tr.step(1)
+        first = first if first is not None else float(l.asscalar())
+    assert float(l.asscalar()) < first * 0.8
+
+
+def test_amp_overflow_skips_step():
+    amp.init("bfloat16")
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((1, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    p = list(net.collect_params().values())[0]
+    with autograd.record():
+        l = net(nd.ones((1, 3))).sum()
+        l.backward()
+    p.grad()[:] = float("inf")
+    w0 = p.data().asnumpy().copy()
+    s0 = tr._amp_loss_scaler.loss_scale
+    tr.step(1)
+    assert onp.allclose(p.data().asnumpy(), w0)
+    assert tr._amp_loss_scaler.loss_scale == s0 / 2
+
+
+def test_convert_model_keeps_norms_fp32():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((2, 3)))
+    amp.convert_model(net, "bfloat16")
+    params = net.collect_params()
+    dtypes = {name: str(p.dtype) for name, p in params.items()}
+    assert any(v == "bfloat16" for k, v in dtypes.items() if "dense" in k)
+    assert all(v == "float32" for k, v in dtypes.items()
+               if "batchnorm" in k or "gamma" in k or "beta" in k)

@@ -1,0 +1,105 @@
+"""Detection contrib ops (reference:
+tests/python/unittest/test_contrib_operator.py multibox/box_nms cases)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_box_iou():
+    a = nd.array([[0, 0, 2, 2], [1, 1, 3, 3]])
+    b = nd.array([[0, 0, 2, 2], [2, 2, 4, 4]])
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    assert onp.allclose(iou, [[1.0, 0.0], [1 / 7, 1 / 7]], atol=1e-6)
+
+
+def test_box_nms():
+    d = nd.array([[[0, 0.9, 0, 0, 2, 2], [0, 0.8, 0.1, 0.1, 2, 2],
+                   [1, 0.7, 0, 0, 2, 2], [0, 0.6, 5, 5, 6, 6]]])
+    out = nd.contrib.box_nms(d, overlap_thresh=0.5, coord_start=2,
+                             score_index=1, id_index=0).asnumpy()[0]
+    assert out[0][1] == 0.9
+    assert (out[1] == -1).all()  # same class, high overlap → suppressed
+    assert out[2][0] == 1  # different class survives
+    assert out[3][1] == 0.6  # disjoint box survives
+    # force_suppress kills cross-class overlaps too
+    out = nd.contrib.box_nms(d, overlap_thresh=0.5, coord_start=2,
+                             score_index=1, id_index=0,
+                             force_suppress=True).asnumpy()[0]
+    assert (out[2] == -1).all()
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=[0.5, 0.25], ratios=[1, 2])
+    assert anchors.shape == (1, 12, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor: size .5 centered (0.25, 0.25)
+    assert onp.allclose(a[0], [0, 0, 0.5, 0.5], atol=1e-6)
+    assert onp.allclose(a[1], [0.125, 0.125, 0.375, 0.375], atol=1e-6)
+    # ratio-2 anchor is wider than tall
+    w, h = a[2][2] - a[2][0], a[2][3] - a[2][1]
+    assert w > h
+    clipped = nd.contrib.MultiBoxPrior(x, sizes=[0.9], clip=True).asnumpy()
+    assert clipped.min() >= 0 and clipped.max() <= 1
+
+
+def test_multibox_target():
+    anc = nd.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                     [0.0, 0.0, 0.2, 0.2]]])
+    lab = nd.array([[[0, 0.1, 0.1, 0.42, 0.42], [-1, -1, -1, -1, -1]]])
+    cp = nd.zeros((1, 3, 3))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anc, lab, cp)
+    assert onp.allclose(ct.asnumpy(), [[1.0, 0.0, 0.0]])
+    assert onp.allclose(bm.asnumpy()[0][:4], 1.0)
+    assert onp.allclose(bm.asnumpy()[0][4:], 0.0)
+    assert onp.isfinite(bt.asnumpy()).all()
+
+
+def test_multibox_target_negative_mining():
+    anc = nd.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                     [0.0, 0.0, 0.2, 0.2], [0.6, 0.6, 0.8, 0.8]]])
+    lab = nd.array([[[0, 0.1, 0.1, 0.42, 0.42]]])
+    cp = nd.array(onp.random.RandomState(0).rand(1, 3, 4).astype("f"))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        anc, lab, cp, negative_mining_ratio=1.0, negative_mining_thresh=0.0)
+    c = ct.asnumpy()[0]
+    assert c[0] == 1.0
+    # with ratio 1.0 and 1 positive, at most 1 negative stays 0, rest -1
+    assert (c == -1).sum() >= 1
+
+
+def test_multibox_detection():
+    anc = nd.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]])
+    cls_prob = nd.array([[[0.2, 0.8], [0.7, 0.1], [0.1, 0.1]]])
+    loc = nd.zeros((1, 8))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc, anc,
+                                       threshold=0.05).asnumpy()[0]
+    # anchor0: class1 prob .7 → id 0; anchor1: bg .8 dominates, best
+    # non-bg .1 still > threshold
+    assert det[0][0] == 0 and abs(det[0][1] - 0.7) < 1e-6
+    assert onp.allclose(det[0][2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_roi_align_values_and_grad():
+    data = nd.array(onp.arange(16, dtype="f").reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.contrib.roi_align(data, rois, pooled_size=(2, 2),
+                                   spatial_scale=1.0)
+    assert onp.allclose(out.asnumpy().reshape(2, 2),
+                        [[3.75, 5.25], [9.75, 11.25]])
+    out.backward()
+    g = data.grad.asnumpy()
+    assert abs(g.sum() - 4.0) < 1e-5  # 4 bins of averaged weights
+
+
+def test_bipartite_matching():
+    s = nd.array([[[0.9, 0.1], [0.8, 0.7]]])
+    rm, cm = nd.contrib.bipartite_matching(s, threshold=0.05)
+    assert onp.allclose(rm.asnumpy(), [[0, 1]])
+    assert onp.allclose(cm.asnumpy(), [[0, 1]])
+    # threshold excludes weak matches
+    rm, cm = nd.contrib.bipartite_matching(s, threshold=0.75)
+    assert onp.allclose(rm.asnumpy(), [[0, -1]])
