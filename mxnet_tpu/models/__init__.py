@@ -4,5 +4,8 @@ Re-exports the Gluon model zoo (reference:
 python/mxnet/gluon/model_zoo/vision/) plus TPU-first training entry points.
 """
 from ..gluon.model_zoo import vision, get_model
+from .transformer import TransformerLM, TransformerBlock, \
+    MultiHeadSelfAttention
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "get_model", "TransformerLM", "TransformerBlock",
+           "MultiHeadSelfAttention"]

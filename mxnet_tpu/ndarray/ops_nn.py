@@ -630,3 +630,14 @@ def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
         in_sz = input_size if layer == 0 else H * D
         size += D * ngates * H * (in_sz + H + 2)
     return size
+
+
+@register(name="flash_attention")
+def flash_attention_op(query, key, value, sm_scale=None, causal=False):
+    """Blockwise Pallas attention over (B, H, S, D) (see
+    mxnet_tpu/ops/flash_attention.py; NEW capability vs the reference —
+    SURVEY §5.7)."""
+    from ..ops.flash_attention import flash_attention
+
+    return flash_attention(query, key, value, sm_scale=sm_scale,
+                           causal=causal)

@@ -6,3 +6,6 @@ nms; SURVEY §2.2 contrib row). Standard ops live as XLA-lowered bodies in
 mxnet_tpu.ndarray.ops_*; only genuinely fusion-resistant ops get Pallas
 kernels here.
 """
+from .flash_attention import flash_attention  # noqa: F401,E402
+
+__all__ = ["flash_attention"]

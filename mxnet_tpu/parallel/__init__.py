@@ -18,7 +18,8 @@ from __future__ import annotations
 from .mesh import make_mesh, current_mesh, mesh_scope, device_count
 from .spmd import (all_reduce, SPMDTrainer, shard_batch, replicate,
                    shard_params)
+from .ring_attention import ring_attention
 
 __all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count",
            "all_reduce", "SPMDTrainer", "shard_batch", "replicate",
-           "shard_params"]
+           "shard_params", "ring_attention"]
