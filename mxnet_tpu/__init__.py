@@ -49,12 +49,16 @@ from . import callback
 from . import recordio
 from . import tools  # noqa: F401
 from . import contrib  # noqa: F401
+from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from .visualization import print_summary  # noqa: F401
+from . import runtime  # noqa: F401
+from . import test_utils  # noqa: F401
+
+# reference alias: mx.viz.plot_network / print_summary
+viz = visualization
 
 # keep reference-style aliases
 Context = Context
-
-
-def test_utils():  # pragma: no cover
-    from . import test_utils as tu
-
-    return tu

@@ -1,2 +1,3 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/__init__.py)."""
 from . import nn  # noqa: F401
+from . import estimator  # noqa: F401

@@ -1,0 +1,99 @@
+"""Monitor: per-layer tensor statistics during training.
+
+Reference: python/mxnet/monitor.py — taps every op output via executor
+monitor callbacks (graph_executor.cc:1343-1382). Here the tap points are
+Gluon Block forwards (installed with Monitor.install(block)) and Module
+executor outputs; stat_func runs on-device and syncs only at toc().
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                from . import nd
+
+                return nd.norm(x) / (x.size ** 0.5)
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._hooks = []
+
+    def install(self, block_or_exe):
+        """Attach to a Gluon Block tree (wraps each child's forward) or an
+        Executor (reads outputs at toc)."""
+        from .gluon.block import Block
+
+        if isinstance(block_or_exe, Block):
+            self._install_block(block_or_exe, prefix="")
+        else:
+            exe = block_or_exe
+            self._hooks.append(("exe", exe))
+        return block_or_exe
+
+    def _install_block(self, block, prefix):
+        for name, child in block._children.items():
+            cname = getattr(child, "name", None) or name
+            self._install_block(child, prefix + cname + ".")
+        orig = block.forward
+        mon = self
+
+        def wrapped(*args, _orig=orig, _name=prefix.rstrip("."), **kw):
+            out = _orig(*args, **kw)
+            if mon.activated and _name and mon.re_pattern.match(_name):
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for i, o in enumerate(outs):
+                    if hasattr(o, "data"):
+                        mon.queue.append((mon.step, f"{_name}_output{i}",
+                                          mon.stat_func(o)))
+            return out
+
+        block.forward = wrapped
+        self._hooks.append(("block", block, orig))
+
+    def uninstall(self):
+        for h in self._hooks:
+            if h[0] == "block":
+                h[1].forward = h[2]
+        self._hooks = []
+
+    def tic(self):
+        """Start collecting for this step (every `interval` steps)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the step; returns [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        # executor taps: read outputs + aux now
+        for h in self._hooks:
+            if h[0] == "exe":
+                exe = h[1]
+                for i, o in enumerate(getattr(exe, "outputs", [])):
+                    self.queue.append((self.step, f"output{i}",
+                                       self.stat_func(o)))
+        res = []
+        queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \
+            else self.queue
+        for n, name, stat in queue:
+            res.append((n, name, str(stat.asnumpy().reshape(-1)[:4])
+                        if hasattr(stat, "asnumpy") else str(stat)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, name, stat in self.toc():
+            print(f"Batch: {n:7d} {name:30s} {stat}")

@@ -27,14 +27,18 @@ _NEG = -1e30
 
 
 def _ref_attention(q, k, v, sm_scale, causal, s_k_real):
-    """Plain XLA attention, the correctness oracle + backward recompute."""
+    """Plain XLA attention, the correctness oracle + backward recompute.
+
+    Causal masking is bottom-right aligned: query row i sits at global
+    position i + (S_k - S_q), so decode-style calls (S_q=1 against a long
+    KV cache) attend to the whole prefix."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     S_q, S_k = q.shape[2], k.shape[2]
     kid = jnp.arange(S_k)[None, :]
     mask = kid < s_k_real
     if causal:
-        qid = jnp.arange(S_q)[:, None]
+        qid = jnp.arange(S_q)[:, None] + (s_k_real - S_q)
         mask = mask & (kid <= qid)
     s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
@@ -42,7 +46,7 @@ def _ref_attention(q, k, v, sm_scale, causal, s_k_real):
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *, bq, bk, nk,
-               sm_scale, causal, s_k_real):
+               sm_scale, causal, s_k_real, causal_off):
     """Grid (BH, nq, nk), kb innermost: one (bq, bk) tile per step. Only a
     q tile, one k/v tile and the (m, l, acc) scratch live in VMEM — true
     streaming, O(bq·D + bk·D) on-chip whatever the sequence length. The
@@ -57,24 +61,31 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *, bq, bk, nk,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)  # (bq, D)
-    k = k_ref[0].astype(jnp.float32)  # (bk, D)
-    v = v_ref[0].astype(jnp.float32)
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-    kid = kb * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    mask = kid < s_k_real
-    if causal:
-        qid = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        mask &= kid <= qid
-    s = jnp.where(mask, s, _NEG)
-    m = m_s[:]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    m_s[:] = m_new
-    l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_s[:] = acc_s[:] * alpha + jnp.dot(p, v,
-                                          preferred_element_type=jnp.float32)
+    # causal: tiles entirely above the diagonal contribute nothing — skip
+    # both MXU matmuls (halves causal-LM FLOPs)
+    live = (kb * bk <= (i + 1) * bq - 1 + causal_off) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)  # (bq, D)
+        k = k_ref[0].astype(jnp.float32)  # (bk, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        kid = kb * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kid < s_k_real
+        if causal:
+            qid = i * bq + causal_off + \
+                lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask &= kid <= qid
+        s = jnp.where(mask, s, _NEG)
+        m = m_s[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_s[:] = m_new
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
     @pl.when(kb == nk - 1)
     def _finalize():
@@ -101,7 +112,7 @@ def _pallas_forward(q, k, v, sm_scale, causal, interpret):
     nk = Sk_p // bk
     kern = functools.partial(_fa_kernel, bq=bq, bk=bk, nk=nk,
                              sm_scale=sm_scale, causal=causal,
-                             s_k_real=S_k)
+                             s_k_real=S_k, causal_off=S_k - S_q)
     out = pl.pallas_call(
         kern,
         grid=(B * H, Sq_p // bq, nk),
@@ -136,22 +147,44 @@ def _flash_fwd(q, k, v, sm_scale, causal, impl):
 
 
 def _flash_bwd(sm_scale, causal, impl, res, do):
+    """Backward by q-chunk recompute (lax.scan): peak extra memory is
+    O(chunk·S_k) instead of materializing the full S_q×S_k attention
+    matrix — long-context training keeps the flash memory property."""
     q, k, v = res
-    qf = q.astype(jnp.float32)
+    S_q, S_k = q.shape[2], k.shape[2]
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    S_q, S_k = q.shape[2], k.shape[2]
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
-    if causal:
-        mask = jnp.arange(S_k)[None, :] <= jnp.arange(S_q)[:, None]
-        s = jnp.where(mask[None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * sm_scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
+    chunk = min(512, S_q)
+    pad = (-S_q) % chunk
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0))).astype(jnp.float32)
+    dop = jnp.pad(do, ((0, 0), (0, 0), (0, pad), (0, 0))).astype(
+        jnp.float32)  # zero do on padding → padded rows contribute nothing
+    nchunk = (S_q + pad) // chunk
+    B, H, _, D = q.shape
+    qc = qp.reshape(B, H, nchunk, chunk, D).transpose(2, 0, 1, 3, 4)
+    doc = dop.reshape(B, H, nchunk, chunk, D).transpose(2, 0, 1, 3, 4)
+    kid = jnp.arange(S_k)[None, :]
+    off = S_k - S_q  # bottom-right causal alignment
+
+    def step(carry, xs):
+        dk_acc, dv_acc, ci = carry
+        qb, dob = xs  # (B, H, chunk, D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qb, kf) * sm_scale
+        if causal:
+            qid = ci * chunk + jnp.arange(chunk)[:, None] + off
+            s = jnp.where((kid <= qid)[None, None], s, _NEG)
+        p = jax.nn.softmax(s, axis=-1)
+        dv_acc += jnp.einsum("bhqk,bhqd->bhkd", p, dob)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dob, vf)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dqb = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * sm_scale
+        dk_acc += jnp.einsum("bhqk,bhqd->bhkd", ds, qb) * sm_scale
+        return (dk_acc, dv_acc, ci + 1), dqb
+
+    (dk, dv, _), dqs = lax.scan(
+        step, (jnp.zeros_like(kf), jnp.zeros_like(vf), 0), (qc, doc))
+    dq = dqs.transpose(1, 2, 0, 3, 4).reshape(B, H, S_q + pad, D)[
+        :, :, :S_q]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

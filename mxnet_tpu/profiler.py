@@ -1,0 +1,244 @@
+"""Profiler (reference: python/mxnet/profiler.py over src/profiler/).
+
+Two layers, mirroring the reference design (SURVEY §5.1):
+- device/XLA tracing: start/stop drive jax.profiler traces (XPlane /
+  TensorBoard format — the TPU-native replacement for the reference's
+  chrome://tracing dumps, viewable in Perfetto/TensorBoard);
+- host-side scoped stats: Domain/Task/Frame/Event/Counter/Marker objects
+  plus an in-process aggregate table (reference aggregate_stats.cc),
+  dumped by `dumps()`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": False, "profile_imperative": False,
+           "profile_memory": False, "profile_api": False,
+           "aggregate_stats": False, "continuous_dump": False}
+_state = {"running": False, "jax_trace": False}
+_lock = threading.Lock()
+_agg = defaultdict(lambda: {"count": 0, "total": 0.0, "min": float("inf"),
+                            "max": 0.0})
+_events = []  # chrome-trace event dicts
+
+
+def set_config(**kwargs):
+    """Reference: profiler.py:33 set_config."""
+    for k, v in kwargs.items():
+        if k not in _config:
+            raise ValueError(f"unknown profiler option {k}")
+        _config[k] = v
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    """Start profiling; opens a jax.profiler trace when a filename is
+    configured (dir = filename without .json suffix)."""
+    if _state["running"]:
+        return
+    _state["running"] = True
+    fname = _config.get("filename")
+    if fname:
+        try:
+            import jax
+
+            logdir = fname[:-5] if fname.endswith(".json") else fname
+            jax.profiler.start_trace(logdir + "_xplane")
+            _state["jax_trace"] = True
+        except Exception:
+            _state["jax_trace"] = False
+
+
+def stop(profile_process="worker"):
+    # must finalize the device trace even when pause() flipped `running`
+    # off, else the XPlane file is never written and the next start()
+    # collides with the still-open trace
+    _state["running"] = False
+    if _state["jax_trace"]:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state["jax_trace"] = False
+    if _config.get("continuous_dump"):
+        dump()
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def is_running():
+    return _state["running"]
+
+
+def _record(domain, name, start_us, dur_us, cat="event", value=None):
+    with _lock:
+        if cat == "counter":
+            # chrome-trace counter sample: ph 'C' with the value payload
+            _events.append({"name": name, "cat": cat, "ph": "C",
+                            "ts": start_us, "pid": 0,
+                            "args": {name: value}})
+        else:
+            _events.append({"name": name, "cat": cat, "ph": "X",
+                            "ts": start_us, "dur": dur_us, "pid": 0,
+                            "tid": threading.get_ident() % 100000,
+                            "args": {"domain": domain}})
+        a = _agg[(domain, name)]
+        a["count"] += 1
+        if cat == "counter":
+            a["total"] = float(value)  # last observed value
+            a["min"] = min(a["min"], float(value))
+            a["max"] = max(a["max"], float(value))
+        else:
+            a["total"] += dur_us
+            a["min"] = min(a["min"], dur_us)
+            a["max"] = max(a["max"], dur_us)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write accumulated host events as chrome://tracing JSON."""
+    fname = _config.get("filename") or "profile.json"
+    with _lock:
+        payload = {"traceEvents": list(_events)}
+    with open(fname, "w") as f:
+        json.dump(payload, f)
+    return fname
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats table (reference: profiler.py:151 dumps)."""
+    with _lock:
+        rows = [(d, n, v["count"], v["total"], v["min"], v["max"],
+                 v["total"] / max(v["count"], 1))
+                for (d, n), v in _agg.items()]
+        if reset:
+            _agg.clear()
+    rows.sort(key=lambda r: r[3], reverse=not ascending)
+    if format == "json":
+        return json.dumps([{"domain": d, "name": n, "count": c,
+                            "total_us": t, "min_us": mn, "max_us": mx,
+                            "avg_us": av}
+                           for d, n, c, t, mn, mx, av in rows])
+    lines = ["%-20s %-30s %8s %12s %10s %10s %10s" %
+             ("Domain", "Name", "Count", "Total(us)", "Min(us)",
+              "Max(us)", "Avg(us)")]
+    for d, n, c, t, mn, mx, av in rows:
+        lines.append("%-20s %-30s %8d %12.1f %10.1f %10.1f %10.1f"
+                     % (d, n, c, t, mn, mx, av))
+    return "\n".join(lines)
+
+
+class Domain:
+    """Reference: profiler.py Domain — namespace for profiler objects."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_event(self, name):
+        return Event(name, domain=self)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Scoped:
+    def __init__(self, domain, name):
+        self.domain = domain.name if isinstance(domain, Domain) else \
+            str(domain)
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dur = (time.perf_counter() - self._t0) * 1e6
+        _record(self.domain, self.name, self._t0 * 1e6, dur,
+                cat=type(self).__name__.lower())
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scoped):
+    pass
+
+
+class Frame(_Scoped):
+    pass
+
+
+class Event(_Scoped):
+    def __init__(self, name, domain=None):
+        super().__init__(domain or Domain("event"), name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain.name
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+        _record(self.domain, self.name, time.perf_counter() * 1e6, 0,
+                cat="counter", value=value)
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain.name
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record(self.domain, self.name, time.perf_counter() * 1e6, 0,
+                cat="marker")

@@ -1,0 +1,65 @@
+"""Runtime feature introspection (reference: python/mxnet/runtime.py over
+include/mxnet/libinfo.h:47-146)."""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    import jax
+
+    feats = {}
+    backend = jax.default_backend()
+    feats["TPU"] = backend == "tpu"
+    feats["CUDA"] = False
+    feats["CUDNN"] = False
+    feats["MKLDNN"] = False
+    feats["OPENMP"] = True
+    feats["BLAS_OPEN"] = True
+    feats["XLA"] = True
+    feats["PALLAS"] = True
+    feats["DIST_KVSTORE"] = True  # jax.distributed collectives
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["SIGNAL_HANDLER"] = True
+    feats["F16C"] = True
+    try:
+        from . import _native
+
+        feats["NATIVE_IO"] = _native.lib is not None
+    except Exception:
+        feats["NATIVE_IO"] = False
+    feats["OPENCV"] = False
+    try:
+        import PIL  # noqa: F401
+
+        feats["PIL"] = True
+    except ImportError:
+        feats["PIL"] = False
+    return feats
+
+
+class Features(dict):
+    """Reference: runtime.py Features — dict of Feature, is_enabled()."""
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def is_enabled(self, name):
+        feat = self.get(name)
+        return bool(feat and feat.enabled)
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,169 @@
+"""Test helpers (reference: python/mxnet/test_utils.py, 2386 LoC — the
+de-facto harness for the reference's whole unittest suite; SURVEY §4).
+
+check_consistency's CPU↔GPU oracle becomes a CPU↔TPU / eager↔jit oracle
+here: the same op is run on each available backend (or both eagerly and
+under jit) and compared.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as onp
+
+from . import context as _ctx_mod
+from .context import Context, cpu, current_context
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._default_ctx.value = ctx
+
+
+def same(a, b):
+    return onp.array_equal(onp.asarray(a), onp.asarray(b))
+
+
+def _as_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    """Reference: test_utils.py assert_almost_equal (relative+absolute)."""
+    a = _as_numpy(a)
+    b = _as_numpy(b)
+    if a.shape != b.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{a.shape} vs {names[1]}{b.shape}")
+    if onp.allclose(a.astype(onp.float64), b.astype(onp.float64),
+                    rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    diff = onp.abs(a.astype(onp.float64) - b.astype(onp.float64))
+    denom = onp.maximum(onp.abs(b.astype(onp.float64)), atol)
+    rel = diff / onp.maximum(denom, 1e-300)
+    idx = onp.unravel_index(onp.argmax(rel), rel.shape)
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ: max rel err {rel.max():.3g} "
+        f"at {idx} ({a[idx]} vs {b[idx]}), rtol={rtol} atol={atol}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, distribution="uniform"):
+    from . import nd
+    from .ndarray import sparse
+
+    dtype = dtype or "float32"
+    if distribution == "normal":
+        arr = onp.random.normal(size=shape).astype(dtype)
+    else:
+        arr = onp.random.uniform(size=shape).astype(dtype)
+    if stype in ("row_sparse", "csr"):
+        density = 0.5 if density is None else density
+        mask = onp.random.uniform(size=shape) < density
+        if stype == "row_sparse":
+            mask = onp.broadcast_to(
+                mask.reshape(shape[0], -1).any(axis=1)
+                .reshape((-1,) + (1,) * (len(shape) - 1)), shape)
+        arr = onp.where(mask, arr, onp.zeros_like(arr))
+        return sparse.cast_storage(nd.array(arr), stype)
+    return nd.array(arr, dtype=dtype)
+
+
+def numeric_grad(executor_fn, x, eps=1e-4):
+    """Central finite differences of a scalar function at x (numpy)."""
+    x = onp.asarray(x, dtype=onp.float64)
+    g = onp.zeros_like(x)
+    it = onp.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + eps
+        fp = float(executor_fn(x))
+        x[idx] = orig - eps
+        fm = float(executor_fn(x))
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-4, eps=1e-3):
+    """Compare autograd gradients of `fn` against finite differences.
+
+    fn: NDArray... -> scalar NDArray (summed if not scalar).
+    inputs: list of numpy arrays. Reference: test_utils.py
+    check_numeric_gradient (finite-difference oracle)."""
+    from . import nd, autograd
+
+    nds = [nd.array(onp.asarray(a, dtype="float32")) for a in inputs]
+    for a in nds:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        loss = nd.sum(out)
+    loss.backward()
+    analytic = [a.grad.asnumpy() for a in nds]
+
+    for i, base in enumerate(inputs):
+        def f(x, _i=i):
+            args = [nd.array(onp.asarray(a, dtype="float32"))
+                    if j != _i else nd.array(x.astype("float32"))
+                    for j, a in enumerate(inputs)]
+            return float(nd.sum(fn(*args)).asnumpy())
+
+        num = numeric_grad(f, onp.asarray(base, dtype=onp.float64), eps)
+        assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
+                            names=(f"analytic[{i}]", f"numeric[{i}]"))
+
+
+def check_consistency(fn, inputs, rtol=1e-4, atol=1e-5):
+    """Run `fn` eagerly and under jax.jit and compare — the rebuild's
+    analog of the reference's CPU-vs-GPU check_consistency oracle."""
+    import jax
+
+    from . import nd
+
+    nds = [nd.array(onp.asarray(a, dtype="float32")) for a in inputs]
+    eager = fn(*nds)
+    eager_list = eager if isinstance(eager, (list, tuple)) else [eager]
+
+    def pure(*datas):
+        outs = fn(*[nd.NDArray(d) for d in datas])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return tuple(o.data for o in outs)
+
+    jitted = jax.jit(pure)(*[a.data for a in nds])
+    for e, j in zip(eager_list, jitted):
+        assert_almost_equal(e, onp.asarray(j), rtol=rtol, atol=atol,
+                            names=("eager", "jit"))
+    return eager
+
+
+def discard_stderr(fn):  # decorator used by reference tests
+    return fn

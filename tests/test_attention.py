@@ -53,6 +53,23 @@ def test_flash_ragged_shapes():
     assert float(jnp.abs(ref - pal).max()) < 1e-5
 
 
+def test_flash_causal_decode_alignment():
+    """S_q=1 against a long KV cache must attend to the WHOLE prefix
+    (bottom-right causal alignment), matching full-sequence attention."""
+    rs = onp.random.RandomState(3)
+    S_k = 40
+    q_full = jnp.asarray(rs.randn(1, 2, S_k, 8).astype("f"))
+    k = jnp.asarray(rs.randn(1, 2, S_k, 8).astype("f"))
+    v = jnp.asarray(rs.randn(1, 2, S_k, 8).astype("f"))
+    full = flash_attention(q_full, k, v, causal=True, use_pallas=False)
+    last = flash_attention(q_full[:, :, -1:], k, v, causal=True,
+                           use_pallas=False)
+    assert float(jnp.abs(full[:, :, -1:] - last).max()) < 1e-5
+    last_p = flash_attention(q_full[:, :, -1:], k, v, causal=True,
+                             use_pallas=True)
+    assert float(jnp.abs(full[:, :, -1:] - last_p).max()) < 1e-5
+
+
 def test_flash_grad(qkv):
     q, k, v = qkv
     gq = jax.grad(lambda q: flash_attention(q, k, v, causal=True).sum())(q)

@@ -1,0 +1,137 @@
+"""Profiler, monitor, visualization, runtime, test_utils, estimator.
+
+Reference coverage model: tests/python/unittest/test_profiler.py,
+test_metric.py + the estimator tests under tests/python/unittest/gluon/.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon, profiler
+from mxnet_tpu.gluon import nn
+
+
+def test_profiler_scoped_objects(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "prof.json"),
+                        aggregate_stats=True)
+    profiler.start()
+    dom = profiler.Domain("unit")
+    with dom.new_task("work"):
+        nd.waitall()
+    ev = dom.new_event("ev")
+    ev.start()
+    ev.stop()
+    c = dom.new_counter("ctr", 5)
+    c += 3
+    dom.new_marker("m").mark()
+    profiler.stop()
+    table = profiler.dumps()
+    assert "work" in table and "ev" in table
+    f = profiler.dump()
+    assert os.path.isfile(f)
+    import json
+
+    evts = json.load(open(f))["traceEvents"]
+    assert any(e["name"] == "work" for e in evts)
+    js = profiler.dumps(format="json", reset=True)
+    assert "work" in js
+    assert profiler.dumps(format="json") == "[]"
+
+
+def test_monitor_on_block():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    stats = mon.toc()
+    assert len(stats) >= 2  # both Dense outputs tapped
+    names = [s[1] for s in stats]
+    assert any("dense" in n for n in names)
+    mon.uninstall()
+    mon.tic()
+    net(nd.ones((2, 4)))
+    assert mon.toc() == []
+
+
+def test_visualization_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight")
+    b = mx.sym.Variable("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=10, name="fc")
+    out = mx.sym.softmax(out, name="sm")
+    total = mx.viz.print_summary(out, shape={"data": (1, 20)})
+    printed = capsys.readouterr().out
+    assert "fc" in printed and "Total params" in printed
+    assert total == 20 * 10 + 10
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert isinstance(mx.runtime.feature_list(), list)
+
+
+def test_test_utils_assert_and_grad():
+    tu = mx.test_utils
+    tu.assert_almost_equal(onp.ones(3), onp.ones(3))
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(onp.ones(3), 2 * onp.ones(3))
+    assert tu.almost_equal([1.0], [1.0 + 1e-7], rtol=1e-5)
+    # numeric vs analytic gradient of a tanh·square chain
+    tu.check_numeric_gradient(
+        lambda x: nd.tanh(x) * nd.square(x),
+        [onp.random.RandomState(0).randn(3, 2) * 0.5])
+    tu.check_consistency(lambda x: nd.relu(x) + 1,
+                         [onp.random.RandomState(1).randn(4)])
+    arr = tu.rand_ndarray((6, 4), stype="csr", density=0.3)
+    assert arr.stype == "csr"
+
+
+def test_estimator_fit(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   CheckpointHandler,
+                                                   EarlyStoppingHandler)
+    from mxnet_tpu.metric import Accuracy
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    rs = onp.random.RandomState(0)
+    X = rs.randn(64, 8).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=[Accuracy()], trainer=trainer)
+    ckpt = CheckpointHandler(str(tmp_path), monitor=est.train_metrics[0],
+                             save_best=True)
+    est.fit(train, epochs=4, event_handlers=[ckpt])
+    acc = est.train_metrics[0].get()[1]
+    assert acc > 0.8, acc
+    assert any(f.endswith(".params") for f in os.listdir(tmp_path))
+
+
+def test_estimator_early_stopping():
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   EarlyStoppingHandler)
+    from mxnet_tpu.metric import Accuracy
+
+    net = nn.Dense(2)
+    net.initialize()
+    X = onp.zeros((32, 4), "f")
+    y = onp.zeros(32, "f")
+    train = mx.io.NDArrayIter(X, y, batch_size=8)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=[Accuracy()])
+    stop = EarlyStoppingHandler(est.train_metrics[0], patience=1)
+    est.fit(train, epochs=50, event_handlers=[stop])
+    # constant data → accuracy flat → early stop long before 50 epochs
+    assert stop.stop_training
