@@ -56,6 +56,10 @@ from . import visualization  # noqa: F401
 from .visualization import print_summary  # noqa: F401
 from . import runtime  # noqa: F401
 from . import test_utils  # noqa: F401
+from . import operator  # noqa: F401
+from . import rtc  # noqa: F401
+
+operator._install_nd_custom()
 
 # reference alias: mx.viz.plot_network / print_summary
 viz = visualization

@@ -1,0 +1,296 @@
+"""Post-training int8 quantization driver (reference:
+python/mxnet/contrib/quantization.py:923 quantize_model/quantize_net over
+the quantize_graph_pass.cc graph rewrite).
+
+Gluon flow: `quantize_net(net, calib_data=...)` runs calibration batches
+to collect per-layer activation ranges (naive min/max or KL-entropy), then
+swaps Dense/Conv2D children for int8-computing wrappers. The int8 matmul
+accumulates in int32 on the MXU (jax lax.dot preferred_element_type) and
+dequantizes with the calibrated scales — the TPU analog of the reference's
+MKLDNN/cuDNN int8 kernels.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "calib_entropy"]
+
+
+def calib_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence threshold selection (reference: quantization.py
+    _get_optimal_threshold / calibrate.cc). Returns the |threshold| that
+    minimizes KL(P||Q) between the fp32 histogram and its int8 image."""
+    hist = onp.asarray(hist, dtype=onp.float64)
+    nbins = len(hist)
+    best_kl, best_t = None, hist_edges[-1]
+    # only consider thresholds that keep >=99% of the mass in range:
+    # mass piled into the clip bin is exactly representable by Q, so the
+    # raw KL objective would otherwise reward absurdly tight clips
+    cum = hist.cumsum() / max(hist.sum(), 1e-12)
+    start = int(onp.searchsorted(cum, 0.99)) + 1
+    start = max(start, num_quantized_bins // 2)
+    for i in range(start, nbins + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the edge bin
+        # quantize p into num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = onp.zeros(i)
+        for b in range(num_quantized_bins):
+            lo = int(onp.floor(b * factor))
+            hi = max(int(onp.ceil((b + 1) * factor)), lo + 1)
+            mass = p[lo:hi].sum()
+            nz = (p[lo:hi] > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(p[lo:hi] > 0, mass / nz, 0)
+        pm = p / max(p.sum(), 1e-12)
+        qm = q / max(q.sum(), 1e-12)
+        nzmask = pm > 0
+        kl = float((pm[nzmask] * onp.log(
+            pm[nzmask] / onp.maximum(qm[nzmask], 1e-12))).sum())
+        if best_kl is None or kl < best_kl:
+            best_kl, best_t = kl, hist_edges[i]
+    return best_t
+
+
+class _QuantizedBase:
+    def _quant_weight(self, w):
+        import jax.numpy as jnp
+
+        amax = float(onp.abs(w.asnumpy()).max())
+        scale = 127.0 / max(amax, 1e-20)
+        wq = jnp.clip(jnp.rint(w.data * scale), -127, 127).astype(jnp.int8)
+        return wq, amax
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 x int8 → int32 matmul + dequant (reference:
+    quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, act_range):
+        self._units = dense._units if hasattr(dense, "_units") else None
+        self._wq, self._wmax = self._quant_weight(dense.weight.data())
+        self._bias = dense.bias.data().data if dense.bias is not None \
+            else None
+        self._act = dense.act if getattr(dense, "act", None) else None
+        self._amax = max(abs(act_range[0]), abs(act_range[1]))
+        self._flatten = getattr(dense, "_flatten", True)
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray import NDArray
+
+        xd = x.data
+        if self._flatten and xd.ndim > 2:
+            xd = xd.reshape(xd.shape[0], -1)
+        xscale = 127.0 / max(self._amax, 1e-20)
+        xq = jnp.clip(jnp.rint(xd * xscale), -127, 127).astype(jnp.int8)
+        acc = lax.dot(xq, self._wq.T,
+                      preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (
+            (self._amax / 127.0) * (self._wmax / 127.0))
+        if self._bias is not None:
+            out = out + self._bias
+        res = NDArray(out.astype(x.data.dtype))
+        if self._act is not None:
+            res = self._act(res)
+        return res
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 conv accumulating int32 (reference: quantized_conv.cc)."""
+
+    def __init__(self, conv, act_range):
+        self._wq, self._wmax = self._quant_weight(conv.weight.data())
+        self._bias = conv.bias.data().data if conv.bias is not None \
+            else None
+        self._act = getattr(conv, "act", None)
+        self._amax = max(abs(act_range[0]), abs(act_range[1]))
+        self._strides = conv._stride
+        self._padding = conv._pad
+        self._groups = conv._groups
+        self._dilation = conv._dilate
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray import NDArray
+
+        xscale = 127.0 / max(self._amax, 1e-20)
+        xq = jnp.clip(jnp.rint(x.data * xscale), -127, 127).astype(jnp.int8)
+        pad = [(int(p), int(p)) for p in self._padding]
+        acc = lax.conv_general_dilated(
+            xq, self._wq, window_strides=tuple(int(s) for s in
+                                               self._strides),
+            padding=pad, feature_group_count=self._groups,
+            rhs_dilation=tuple(int(d) for d in self._dilation),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (
+            (self._amax / 127.0) * (self._wmax / 127.0))
+        if self._bias is not None:
+            out = out + self._bias.reshape(1, -1, 1, 1)
+        res = NDArray(out.astype(x.data.dtype))
+        if self._act is not None:
+            res = self._act(res)
+        return res
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, logger=None):
+    """Calibrate + swap Dense/Conv2D for int8 versions, in place.
+
+    Reference: contrib/quantization.py quantize_net (calib_mode 'naive' =
+    min/max, 'entropy' = KL threshold; layer exclusion by name).
+    """
+    from ..gluon import nn
+    from .. import autograd
+
+    exclude = set(exclude_layers or [])
+
+    # deactivate any hybridization: calibration taps must see eager
+    # NDArrays, and a stale CachedOp would keep replaying the fp32 graph
+    # after the swap
+    def dehybridize(block):
+        if hasattr(block, "_cached_op"):
+            block._cached_op = None
+        if hasattr(block, "_active"):
+            block._active = False
+        for child in getattr(block, "_children", {}).values():
+            dehybridize(child)
+
+    dehybridize(network)
+
+    targets = {}  # (id(parent), child_name) -> [parent, name, child]
+
+    def find(block):
+        for name, child in list(block._children.items()):
+            if isinstance(child, (nn.Dense, nn.Conv2D)) and \
+                    child.name not in exclude:
+                if isinstance(child, nn.Conv2D) and \
+                        child._layout != "NCHW":
+                    continue  # only NCHW is wired for int8 conv
+                targets[(id(block), name)] = [block, name, child]
+            find(child)
+
+    find(network)
+    if not targets:
+        return network
+
+    # calibration taps: O(1) running min/max + bounded sample reservoir
+    # for the entropy histogram (full activations are never retained)
+    stats = {key: {"min": onp.inf, "max": -onp.inf, "samples": []}
+             for key in targets}
+    _CAP = 16384  # abs-value samples kept per layer per batch
+    hooks = []
+    for key, (blk, name, child) in targets.items():
+        orig = child.forward
+
+        def tapped(x, *a, _orig=orig, _key=key, **kw):
+            v = onp.asarray(x.asnumpy(), dtype=onp.float32).reshape(-1)
+            st = stats[_key]
+            st["min"] = min(st["min"], float(v.min()))
+            st["max"] = max(st["max"], float(v.max()))
+            if calib_mode == "entropy":
+                av = onp.abs(v)
+                if av.size > _CAP:
+                    av = av[onp.random.RandomState(0).choice(
+                        av.size, _CAP, replace=False)]
+                st["samples"].append(av)
+            return _orig(x, *a, **kw)
+
+        child.forward = tapped
+        hooks.append((child, orig))
+    try:
+        if calib_data is not None:
+            with autograd.pause():
+                n = 0
+                if hasattr(calib_data, "reset"):
+                    calib_data.reset()
+                for batch in calib_data:
+                    from ..ndarray import NDArray
+
+                    if isinstance(batch, NDArray):
+                        data = batch
+                    elif isinstance(batch, (list, tuple)):
+                        data = batch[0]
+                    else:  # DataBatch
+                        data = batch.data[0]
+                    network(data)
+                    n += 1
+                    if num_calib_batches and n >= num_calib_batches:
+                        break
+    finally:
+        for child, orig in hooks:
+            child.forward = orig
+
+    for key, (blk, name, child) in targets.items():
+        st = stats[key]
+        if not onp.isfinite(st["min"]):
+            continue  # never saw a batch
+        if calib_mode == "entropy" and st["samples"]:
+            allv = onp.concatenate(st["samples"])
+            hist, edges = onp.histogram(allv, bins=2048)
+            t = calib_entropy(hist, edges)
+            rng = (-t, t)
+        else:
+            rng = (st["min"], st["max"])
+        wrapper = QuantizedDense(child, rng) if isinstance(child, nn.Dense) \
+            else QuantizedConv2D(child, rng)
+        shim = _QuantizedShim(wrapper, child)
+        blk._children[name] = shim
+        # subclassed Blocks call children through instance attributes
+        # (self.fc = nn.Dense(...)), not _children — rebind those too
+        for attr, val in list(vars(blk).items()):
+            if val is child:
+                object.__setattr__(blk, attr, shim)
+    return network
+
+
+class _QuantizedShim:
+    """Block-API shim standing in for a quantized child. Delegates every
+    tree-walk API (params, cast, names) to the wrapped fp32 original so
+    save_parameters / collect_params / summary keep working; forward runs
+    the int8 wrapper. Built without Block.__init__ so the original is NOT
+    re-registered as a child (no double-walk)."""
+
+    def __init__(self, wrapper, original):
+        self._wrapper = wrapper
+        self._original = original
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+        self.name = getattr(original, "name", "quantized")
+        self.prefix = getattr(original, "prefix", "")
+
+    def __call__(self, x, *args):
+        return self._wrapper(x)
+
+    def forward(self, x, *args):
+        return self._wrapper(x)
+
+    @property
+    def params(self):
+        return self._original.params
+
+    def collect_params(self, select=None):
+        return self._original.collect_params(select)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        return self._original._collect_params_with_prefix(prefix)
+
+    def cast(self, dtype):
+        pass  # int8 weights are baked; fp32 originals keep their dtype
+
+    def hybridize(self, active=True, **kwargs):
+        pass  # the wrapper body is pure jnp — jit-traceable as-is
+
+    def apply(self, fn):
+        fn(self)
+        return self
+
+    def initialize(self, *args, **kwargs):
+        pass

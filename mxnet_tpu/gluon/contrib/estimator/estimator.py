@@ -45,10 +45,13 @@ class Estimator:
 
     @staticmethod
     def _unpack(batch):
-        if hasattr(batch, "data"):  # DataBatch
-            return batch.data[0], batch.label[0]
-        data, label = batch[0], batch[1]
-        return data, label
+        from ....ndarray import NDArray
+
+        if isinstance(batch, (list, tuple)):  # DataLoader-style pair
+            return batch[0], batch[1]
+        if isinstance(batch, NDArray):
+            raise ValueError("batch must be (data, label) or a DataBatch")
+        return batch.data[0], batch.label[0]  # DataBatch
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None):

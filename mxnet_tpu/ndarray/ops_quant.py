@@ -1,0 +1,93 @@
+"""Quantization ops: quantize/quantize_v2/dequantize/requantize.
+
+TPU-native equivalents of src/operator/quantization/ (quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc; SURVEY §2.2). int8 affine
+(symmetric) quantization in jnp — XLA lowers int8 matmul/conv onto the
+MXU natively, which is the whole point of the int8 path on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _qparams(min_range, max_range, out_type):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    if out_type == "int8":
+        scale = 127.0 / jnp.maximum(amax, 1e-20)
+        lo, hi, dt = -127, 127, jnp.int8
+    elif out_type == "uint8":
+        scale = 255.0 / jnp.maximum(max_range - min_range, 1e-20)
+        lo, hi, dt = 0, 255, jnp.uint8
+    else:
+        raise ValueError(f"unsupported out_type {out_type}")
+    return scale, lo, hi, dt
+
+
+@register(differentiable=False)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Reference: quantization/quantize.cc. Returns (q, min, max)."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx_ = jnp.reshape(max_range, ()).astype(jnp.float32)
+    scale, lo, hi, dt = _qparams(mn, mx_, out_type)
+    if out_type == "int8":
+        q = jnp.clip(jnp.rint(data * scale), lo, hi).astype(dt)
+        return q, -jnp.maximum(jnp.abs(mn), jnp.abs(mx_)), \
+            jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+    q = jnp.clip(jnp.rint((data - mn) * scale), lo, hi).astype(dt)
+    return q, mn, mx_
+
+
+@register(differentiable=False)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Reference: quantization/quantize_v2.cc — computes ranges from data
+    when no calibrated range is given."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx_ = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx_ = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize_raw(data, mn, mx_, out_type)
+
+
+def _quantize_raw(data, mn, mx_, out_type):
+    from .registry import get_op
+
+    return get_op("quantize").fn(data, mn, mx_, out_type=out_type)
+
+
+@register(differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """Reference: quantization/dequantize.cc."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx_ = jnp.reshape(max_range, ()).astype(jnp.float32)
+    if data.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        return data.astype(jnp.float32) * (amax / 127.0)
+    # uint8 affine
+    scale = (mx_ - mn) / 255.0
+    return data.astype(jnp.float32) * scale + mn
+
+
+@register(differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, out_type="int8"):
+    """Reference: quantization/requantize.cc — int32 accum → int8."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx_ = jnp.reshape(max_range, ()).astype(jnp.float32)
+    # int32 data represents values on scale amax/ (127*127)
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(mn), jnp.abs(mx_)) / (127.0 * 127.0))
+    if (min_calib_range is None) != (max_calib_range is None):
+        raise ValueError("min_calib_range and max_calib_range must be "
+                         "given together")
+    if min_calib_range is not None:
+        cmn = jnp.asarray(min_calib_range, jnp.float32)
+        cmx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        cmn = jnp.min(real)
+        cmx = jnp.max(real)
+    return _quantize_raw(real, cmn, cmx, "int8")

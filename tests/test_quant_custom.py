@@ -1,0 +1,172 @@
+"""Quantization, custom ops, rtc (reference:
+tests/python/quantization/test_quantization.py, unittest/test_operator.py
+custom-op cases, unittest/test_rtc.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as mxop
+from mxnet_tpu import nd, autograd, rtc
+from mxnet_tpu.contrib import quantization as qz
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(onp.linspace(-3, 3, 20).astype("f"))
+    q, mn, mx_ = nd.quantize_v2(x, out_type="int8")
+    assert str(q.dtype) == "int8"
+    deq = nd.dequantize(q, mn, mx_)
+    assert float(nd.max(nd.abs(deq - x)).asnumpy()) < 3.0 / 127 + 1e-6
+    # uint8 affine
+    x2 = nd.array(onp.linspace(0, 6, 20).astype("f"))
+    q2, mn2, mx2 = nd.quantize(x2, nd.array(0.0), nd.array(6.0),
+                               out_type="uint8")
+    assert str(q2.dtype) == "uint8"
+    deq2 = nd.dequantize(q2, mn2, mx2)
+    assert float(nd.max(nd.abs(deq2 - x2)).asnumpy()) < 6.0 / 255 + 1e-6
+
+
+def test_quantize_net_mlp():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    X = onp.random.RandomState(0).randn(64, 16).astype("f")
+    ref = net(nd.array(X)).asnumpy()
+    qz.quantize_net(net, calib_data=[nd.array(X)], calib_mode="naive")
+    out = net(nd.array(X)).asnumpy()
+    rel = onp.abs(out - ref).max() / onp.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_quantize_net_conv_entropy():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    Xi = onp.random.RandomState(1).rand(16, 3, 12, 12).astype("f")
+    ref = net(nd.array(Xi)).asnumpy()
+    qz.quantize_net(net, calib_data=[nd.array(Xi)], calib_mode="entropy")
+    out = net(nd.array(Xi)).asnumpy()
+    rel = onp.abs(out - ref).max() / onp.abs(ref).max()
+    assert rel < 0.1, rel
+
+
+def test_calib_entropy_sane_threshold():
+    rs = onp.random.RandomState(0)
+    t = qz.calib_entropy(*onp.histogram(onp.abs(rs.randn(100000)),
+                                        bins=2048))
+    assert 2.0 < t < 5.0  # high-coverage threshold for a gaussian
+
+
+def test_quantize_net_exclude_layers():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    X = onp.random.RandomState(0).randn(8, 6).astype("f")
+    net(nd.array(X))
+    names = [c.name for c in net._children.values()]
+    qz.quantize_net(net, calib_data=[nd.array(X)], exclude_layers=[names[0]])
+    kids = list(net._children.values())
+    assert isinstance(kids[0], nn.Dense)  # excluded, untouched
+    assert not isinstance(kids[1], nn.Dense)  # swapped
+
+
+def test_quantize_net_subclassed_block():
+    from mxnet_tpu.gluon.block import Block
+
+    class M(Block):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Dense(8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    m.initialize()
+    X = onp.random.RandomState(0).randn(16, 6).astype("f")
+    ref = m(nd.array(X)).asnumpy()
+    qz.quantize_net(m, calib_data=[nd.array(X)])
+    out = m(nd.array(X)).asnumpy()
+    d = onp.abs(out - ref).max() / onp.abs(ref).max()
+    assert 1e-7 < d < 0.05, d  # actually quantized AND close
+
+
+def test_quantize_net_dilated_conv():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=2, dilation=2))
+    net.initialize()
+    Xi = onp.random.RandomState(1).rand(2, 3, 10, 10).astype("f")
+    ref = net(nd.array(Xi)).asnumpy()
+    qz.quantize_net(net, calib_data=[nd.array(Xi)])
+    out = net(nd.array(Xi)).asnumpy()
+    assert out.shape == ref.shape
+    assert onp.abs(out - ref).max() / onp.abs(ref).max() < 0.05
+
+
+def test_quantize_net_hybridized_then_save(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    X = onp.random.RandomState(2).randn(8, 6).astype("f")
+    ref = net(nd.array(X)).asnumpy()
+    qz.quantize_net(net, calib_data=[nd.array(X)])
+    out = net(nd.array(X)).asnumpy()
+    assert not onp.allclose(out, ref)  # int8 path actually ran
+    f = str(tmp_path / "q.params")
+    net.save_parameters(f)  # fp32 originals still exportable
+    fresh = nn.HybridSequential()
+    fresh.add(nn.Dense(8), nn.Dense(4))
+    fresh.load_parameters(f)
+    assert onp.allclose(fresh(nd.array(X)).asnumpy(), ref, atol=1e-5)
+
+
+class _Sigmoid(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], nd.sigmoid(in_data[0]))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mxop.register("test_sigmoid")
+class _SigmoidProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    a = nd.array([0.5, -1.0, 2.0])
+    a.attach_grad()
+    with autograd.record():
+        out = nd.Custom(a, op_type="test_sigmoid")
+        loss = nd.sum(out)
+    loss.backward()
+    sig = 1 / (1 + onp.exp(-a.asnumpy()))
+    assert onp.allclose(out.asnumpy(), sig, atol=1e-6)
+    assert onp.allclose(a.grad.asnumpy(), sig * (1 - sig), atol=1e-6)
+
+
+def test_custom_op_unregistered():
+    with pytest.raises(ValueError):
+        nd.Custom(nd.ones(3), op_type="never_registered")
+
+
+def test_rtc_pallas_module():
+    def double_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = rtc.PallasModule(double=double_kernel)
+    out = mod.get_kernel("double").launch([nd.array([1., 2., 3.])])
+    assert onp.allclose(out.asnumpy(), [2., 4., 6.])
+    with pytest.raises(ValueError):
+        mod.get_kernel("nope")
+    with pytest.raises(NotImplementedError):
+        rtc.CudaModule("__global__ void f(){}")
