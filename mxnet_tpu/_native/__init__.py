@@ -16,7 +16,8 @@ _here = os.path.dirname(os.path.abspath(__file__))
 _so_path = os.path.join(_here, "librecordio.so")
 _src_dir = os.path.join(os.path.dirname(os.path.dirname(_here)), "native")
 
-lib = None
+lib = None       # librecordio: frame parsing + jpeg pipeline
+englib = None    # libengine: dependency engine + pooled storage
 
 
 def _try_build():
@@ -68,4 +69,43 @@ def _load():
     lib = L
 
 
+def _load_engine():
+    global englib
+    so = os.path.join(_here, "libengine.so")
+    src = os.path.join(_src_dir, "engine.cc")
+    if (not os.path.isfile(so) or (os.path.isfile(src) and
+                                   os.path.getmtime(src)
+                                   > os.path.getmtime(so))):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", so,
+                 src, "-lpthread"],
+                check=True, capture_output=True, timeout=120)
+        except Exception:
+            if not os.path.isfile(so):
+                return
+    try:
+        L = ctypes.CDLL(so)
+    except OSError:
+        return
+    i64 = ctypes.c_int64
+    L.eng_create.restype = ctypes.c_void_p
+    L.eng_create.argtypes = [ctypes.c_int]
+    L.eng_destroy.argtypes = [ctypes.c_void_p]
+    L.eng_new_var.restype = i64
+    L.eng_new_var.argtypes = [ctypes.c_void_p]
+    L.eng_push.restype = i64
+    L.eng_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.POINTER(i64),
+                           ctypes.c_int, ctypes.POINTER(i64), ctypes.c_int,
+                           ctypes.c_int]
+    L.eng_wait_for_var.restype = i64
+    L.eng_wait_for_var.argtypes = [ctypes.c_void_p, i64]
+    L.eng_wait_all.argtypes = [ctypes.c_void_p]
+    L.eng_var_version.restype = ctypes.c_uint64
+    L.eng_var_version.argtypes = [ctypes.c_void_p, i64]
+    englib = L
+
+
 _load()
+_load_engine()

@@ -1,0 +1,112 @@
+"""Pooled host storage manager (reference: include/mxnet/storage.h +
+src/storage/pooled_storage_manager.h — exact-size bucket recycling with
+env-tunable behavior).
+
+On TPU, HBM is owned by PJRT/XLA (the north star's device allocator);
+this native pool (native/engine.cc:PooledStorage) manages HOST buffers —
+IO batch staging, recordio chunks, shm-style transfer buffers — where the
+reference used its CPU/pinned managers. `MXNET_CPU_MEM_POOL_DISABLE=1`
+falls back to plain malloc-per-alloc semantics (pool bypass).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+
+__all__ = ["Storage", "get"]
+
+
+class _Handle:
+    __slots__ = ("ptr", "size")
+
+    def __init__(self, ptr, size):
+        self.ptr = ptr
+        self.size = size
+
+
+class Storage:
+    def __init__(self):
+        from . import _native
+
+        self._lib = None
+        if _native.englib is not None:
+            L = _native.englib
+            L.pool_create.restype = ctypes.c_void_p
+            L.pool_alloc.restype = ctypes.c_void_p
+            L.pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            L.pool_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            L.pool_direct_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            L.pool_release_all.argtypes = [ctypes.c_void_p]
+            L.pool_destroy.argtypes = [ctypes.c_void_p]
+            L.pool_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+            self._lib = L
+            self._h = L.pool_create()
+        self._fallback = {}
+
+    @property
+    def native(self):
+        return self._lib is not None
+
+    def alloc(self, size):
+        """→ handle with .ptr/.size (reference: Storage::Alloc)."""
+        if self._lib is not None and not os.environ.get(
+                "MXNET_CPU_MEM_POOL_DISABLE"):
+            ptr = self._lib.pool_alloc(self._h, int(size))
+            if ptr:
+                return _Handle(ptr, size)
+        buf = ctypes.create_string_buffer(int(size))
+        h = _Handle(ctypes.addressof(buf), size)
+        self._fallback[h.ptr] = buf
+        return h
+
+    def free(self, handle):
+        """Return to the pool (reference: Storage::Free)."""
+        if handle.ptr in self._fallback:
+            del self._fallback[handle.ptr]
+            return
+        if self._lib is not None:
+            self._lib.pool_free(self._h, handle.ptr)
+
+    def direct_free(self, handle):
+        if handle.ptr in self._fallback:
+            del self._fallback[handle.ptr]
+            return
+        if self._lib is not None:
+            self._lib.pool_direct_free(self._h, handle.ptr)
+
+    def release_all(self):
+        if self._lib is not None:
+            self._lib.pool_release_all(self._h)
+
+    def stats(self):
+        """→ dict(used_bytes, pooled_bytes, total_mallocs)."""
+        if self._lib is None:
+            used = sum(len(b) for b in self._fallback.values())
+            return {"used_bytes": used, "pooled_bytes": 0,
+                    "total_mallocs": len(self._fallback)}
+        out = (ctypes.c_int64 * 3)()
+        self._lib.pool_stats(self._h, out)
+        return {"used_bytes": int(out[0]), "pooled_bytes": int(out[1]),
+                "total_mallocs": int(out[2])}
+
+    def as_array(self, handle, shape, dtype=onp.uint8):
+        """Zero-copy numpy view of a pooled buffer (IO staging)."""
+        n = int(onp.prod(shape)) * onp.dtype(dtype).itemsize
+        assert n <= handle.size, (n, handle.size)
+        buf = (ctypes.c_ubyte * handle.size).from_address(handle.ptr)
+        return onp.frombuffer(buf, dtype=dtype,
+                              count=int(onp.prod(shape))).reshape(shape)
+
+
+_storage = None
+
+
+def get():
+    """Singleton (reference: Storage::Get())."""
+    global _storage
+    if _storage is None:
+        _storage = Storage()
+    return _storage

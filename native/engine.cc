@@ -1,0 +1,365 @@
+// Native dependency engine + pooled storage for mxnet_tpu.
+//
+// TPU-native equivalent of the reference's core runtime C++ (SURVEY §2.1):
+//  - ThreadedEngine dependency scheduling: versioned vars, ops with
+//    const/mutable var sets, per-var waiter FIFOs (the reference's
+//    VersionedVarBlock lists, src/engine/threaded_engine.h:120-229),
+//    worker thread pool with priorities, async exception capture and
+//    propagation to dependent ops' vars (threaded_engine.h:310,466-498)
+//  - pooled storage manager: exact-size bucket recycling with stats
+//    (reference: src/storage/pooled_storage_manager.h:52-94)
+//
+// On TPU the XLA runtime already sequences device computations, so this
+// engine schedules the HOST side: IO pipelines, checkpoint writes, custom
+// op bodies — anything the reference pushed to its CPU workers. Exposed
+// as a flat C ABI consumed via ctypes (mxnet_tpu/engine.py).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Callback = int (*)(void*);  // user fn: 0 ok, nonzero = failed
+
+struct Op;
+
+struct Var {
+  std::deque<std::pair<Op*, bool>> waiters;  // (op, is_write)
+  int active_readers = 0;
+  bool active_writer = false;
+  uint64_t version = 0;
+  bool has_error = false;
+  int64_t error_op = -1;  // op id that poisoned this var
+};
+
+struct Op {
+  int64_t id;
+  Callback fn;
+  void* ctx;
+  std::vector<int64_t> const_vars;
+  std::vector<int64_t> mutable_vars;
+  std::atomic<int> missing{0};  // ungranted deps
+  int priority = 0;
+};
+
+struct OpCmp {
+  bool operator()(Op* a, Op* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->id > b->id;  // FIFO within priority
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int nthreads) : shutdown_(false), inflight_(0) {
+    if (nthreads < 1) nthreads = 1;
+    for (int i = 0; i < nthreads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    for (auto& kv : vars_) delete kv.second;
+  }
+
+  int64_t NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_[id] = new Var();
+    return id;
+  }
+
+  int64_t Push(Callback fn, void* ctx, const int64_t* cvars, int ncon,
+               const int64_t* mvars, int nmut, int priority) {
+    Op* op = new Op();
+    std::unique_lock<std::mutex> lk(mu_);
+    op->id = next_op_++;
+    op->fn = fn;
+    op->ctx = ctx;
+    op->priority = priority;
+    op->const_vars.assign(cvars, cvars + ncon);
+    op->mutable_vars.assign(mvars, mvars + nmut);
+    op->missing.store(ncon + nmut);
+    ++inflight_;
+    if (ncon + nmut == 0) {
+      Ready(op);
+    } else {
+      for (int i = 0; i < ncon; ++i)
+        vars_[cvars[i]]->waiters.emplace_back(op, false);
+      for (int i = 0; i < nmut; ++i)
+        vars_[mvars[i]]->waiters.emplace_back(op, true);
+      for (int i = 0; i < ncon; ++i) Grant(vars_[cvars[i]]);
+      for (int i = 0; i < nmut; ++i) Grant(vars_[mvars[i]]);
+    }
+    return op->id;
+  }
+
+  // blocks until every op that reads or writes `var` (pushed so far) is
+  // done; returns the id of the op that poisoned the var, or -1
+  int64_t WaitForVar(int64_t var) {
+    std::mutex m;
+    std::condition_variable c;
+    bool done = false;
+    struct Sync {
+      std::mutex* m;
+      std::condition_variable* c;
+      bool* done;
+    } sync{&m, &c, &done};
+    auto cb = [](void* p) -> int {
+      Sync* s = static_cast<Sync*>(p);
+      std::unique_lock<std::mutex> lk(*s->m);
+      *s->done = true;
+      s->c->notify_all();
+      return 0;
+    };
+    int64_t v[1] = {var};
+    Push(cb, &sync, v, 1, nullptr, 0, 1 << 20);
+    {
+      std::unique_lock<std::mutex> lk(m);
+      c.wait(lk, [&] { return done; });
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    Var* vp = vars_[var];
+    return vp->has_error ? vp->error_op : -1;
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    all_done_.wait(lk, [&] { return inflight_ == 0; });
+  }
+
+  uint64_t Version(int64_t var) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return vars_[var]->version;
+  }
+
+ private:
+  // grant queue heads under mu_
+  void Grant(Var* v) {
+    while (!v->waiters.empty()) {
+      Op* op = v->waiters.front().first;
+      bool w = v->waiters.front().second;
+      if (w) {
+        if (v->active_readers == 0 && !v->active_writer) {
+          v->active_writer = true;
+          v->waiters.pop_front();
+          if (op->missing.fetch_sub(1) == 1) Ready(op);
+        }
+        break;  // a write (granted or not) blocks everything behind it
+      }
+      if (v->active_writer) break;
+      v->active_readers++;
+      v->waiters.pop_front();
+      if (op->missing.fetch_sub(1) == 1) Ready(op);
+    }
+  }
+
+  void Ready(Op* op) {  // under mu_
+    ready_.push(op);
+    cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.top();
+        ready_.pop();
+        // poisoned inputs? skip execution, propagate to outputs
+        bool poisoned = false;
+        int64_t src = -1;
+        for (int64_t vid : op->const_vars)
+          if (vars_[vid]->has_error) { poisoned = true;
+            src = vars_[vid]->error_op; break; }
+        if (!poisoned)
+          for (int64_t vid : op->mutable_vars)
+            if (vars_[vid]->has_error) { poisoned = true;
+              src = vars_[vid]->error_op; break; }
+        if (poisoned) {
+          Complete(op, true, src);
+          continue;
+        }
+      }
+      int rc = op->fn(op->ctx);  // run WITHOUT the lock
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        Complete(op, rc != 0, op->id);
+      }
+    }
+  }
+
+  void Complete(Op* op, bool failed, int64_t err_src) {  // under mu_
+    for (int64_t vid : op->const_vars) {
+      Var* v = vars_[vid];
+      v->active_readers--;
+      Grant(v);
+    }
+    for (int64_t vid : op->mutable_vars) {
+      Var* v = vars_[vid];
+      v->active_writer = false;
+      v->version++;
+      if (failed && !v->has_error) {
+        v->has_error = true;
+        v->error_op = err_src;
+      }
+      Grant(v);
+    }
+    delete op;
+    if (--inflight_ == 0) all_done_.notify_all();
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable all_done_;
+  std::priority_queue<Op*, std::vector<Op*>, OpCmp> ready_;
+  std::unordered_map<int64_t, Var*> vars_;
+  std::vector<std::thread> workers_;
+  int64_t next_var_ = 0;
+  int64_t next_op_ = 0;
+  bool shutdown_;
+  int inflight_;
+};
+
+// ------------------------------------------------------- pooled storage --
+
+class PooledStorage {
+ public:
+  void* Alloc(size_t size) {
+    size = RoundUp(size);
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = pool_.find(size);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= size;
+        used_bytes_ += size;
+        sizes_[p] = size;
+        return p;
+      }
+    }
+    void* p = malloc(size);
+    if (!p) return nullptr;
+    std::unique_lock<std::mutex> lk(mu_);
+    used_bytes_ += size;
+    total_allocs_++;
+    sizes_[p] = size;
+    return p;
+  }
+
+  void Free(void* p) {  // returns to the pool
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;
+    size_t size = it->second;
+    sizes_.erase(it);
+    used_bytes_ -= size;
+    pooled_bytes_ += size;
+    pool_[size].push_back(p);
+  }
+
+  void DirectFree(void* p) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it != sizes_.end()) {
+      used_bytes_ -= it->second;
+      sizes_.erase(it);
+    }
+    free(p);
+  }
+
+  void ReleaseAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) free(p);
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(int64_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    out[0] = static_cast<int64_t>(used_bytes_);
+    out[1] = static_cast<int64_t>(pooled_bytes_);
+    out[2] = static_cast<int64_t>(total_allocs_);
+  }
+
+ private:
+  static size_t RoundUp(size_t s) {  // page-round large, 64B-round small
+    const size_t kPage = 4096;
+    if (s >= kPage) return (s + kPage - 1) / kPage * kPage;
+    size_t r = 64;
+    while (r < s) r <<= 1;
+    return r;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void*>> pool_;
+  std::unordered_map<void*, size_t> sizes_;
+  size_t used_bytes_ = 0;
+  size_t pooled_bytes_ = 0;
+  size_t total_allocs_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* eng_create(int nthreads) { return new Engine(nthreads); }
+void eng_destroy(void* h) { delete static_cast<Engine*>(h); }
+int64_t eng_new_var(void* h) { return static_cast<Engine*>(h)->NewVar(); }
+
+int64_t eng_push(void* h, Callback fn, void* ctx, const int64_t* cvars,
+                 int ncon, const int64_t* mvars, int nmut, int priority) {
+  return static_cast<Engine*>(h)->Push(fn, ctx, cvars, ncon, mvars, nmut,
+                                       priority);
+}
+
+int64_t eng_wait_for_var(void* h, int64_t var) {
+  return static_cast<Engine*>(h)->WaitForVar(var);
+}
+
+void eng_wait_all(void* h) { static_cast<Engine*>(h)->WaitForAll(); }
+
+uint64_t eng_var_version(void* h, int64_t var) {
+  return static_cast<Engine*>(h)->Version(var);
+}
+
+void* pool_create() { return new PooledStorage(); }
+void pool_destroy(void* h) {
+  static_cast<PooledStorage*>(h)->ReleaseAll();
+  delete static_cast<PooledStorage*>(h);
+}
+void* pool_alloc(void* h, int64_t size) {
+  return static_cast<PooledStorage*>(h)->Alloc(
+      static_cast<size_t>(size));
+}
+void pool_free(void* h, void* p) { static_cast<PooledStorage*>(h)->Free(p); }
+void pool_direct_free(void* h, void* p) {
+  static_cast<PooledStorage*>(h)->DirectFree(p);
+}
+void pool_release_all(void* h) {
+  static_cast<PooledStorage*>(h)->ReleaseAll();
+}
+void pool_stats(void* h, int64_t* out) {
+  static_cast<PooledStorage*>(h)->Stats(out);
+}
+
+}  // extern "C"
