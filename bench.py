@@ -6,27 +6,41 @@ Baseline: reference MXNet ResNet-50 training, fp32 batch 128 on 1x V100 =
 (forward, backward, SGD+momentum update, BN stats) is ONE donated XLA
 executable built by mxnet_tpu.parallel.SPMDTrainer over a 1-device mesh.
 
-Env knobs: BENCH_BATCH (default 128, halved on OOM), BENCH_SMOKE=1 runs a
-tiny-shape CPU smoke for plumbing checks.
+Robustness: the axon TPU tunnel admits one process at a time and its
+backend init can hang or fail transiently (round-1 BENCH died at backend
+setup). The parent process therefore runs the measurement in a CHILD
+subprocess with a per-attempt timeout and retries with backoff; if the TPU
+never comes up it falls back to a small CPU measurement so a parsed number
+always exists (metric name says which platform produced it).
+
+Env knobs:
+  BENCH_BATCH   (default 128; halved on OOM, progress carried across
+                retries via BENCH_STATE)
+  BENCH_SMOKE=1 tiny-shape CPU smoke for plumbing checks
+  BENCH_CHILD   internal: set by the parent to 'axon' or 'cpu'
+  BENCH_STATE   internal: file where the child records the last batch
+                size it attempted, so a retry resumes the OOM descent
+  BENCH_ATTEMPT_TIMEOUT seconds per TPU attempt (default 480)
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as onp
-
-SMOKE = os.environ.get("BENCH_SMOKE") == "1"
-if SMOKE:
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
 BASELINE_IMGS_PER_SEC = 363.69
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+CHILD = os.environ.get("BENCH_CHILD")
 
 
-def build_trainer(mesh, image_size, classes=1000):
+from _cpu_platform import force_cpu_platform
+
+
+# ---------------------------------------------------------------- child ---
+
+def build_trainer(mesh, classes=1000):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
@@ -44,11 +58,12 @@ def build_trainer(mesh, image_size, classes=1000):
 
 def run(batch, image_size, classes, warmup=2, iters=8):
     import jax
+    import numpy as onp
 
     from mxnet_tpu import nd, parallel
 
     mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = build_trainer(mesh, image_size, classes)
+    trainer = build_trainer(mesh, classes)
     rng = onp.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, image_size, image_size).astype("f"))
     y = nd.array(rng.randint(0, classes, batch).astype("f"))
@@ -63,19 +78,28 @@ def run(batch, image_size, classes, warmup=2, iters=8):
     return batch * iters / dt, float(lval.asscalar())
 
 
-def main():
-    if SMOKE:
-        imgs, loss = run(batch=4, image_size=32, classes=10, warmup=1,
-                         iters=2)
-        print(json.dumps({"metric": "resnet50_train_smoke",
-                          "value": round(imgs, 2), "unit": "img/s",
-                          "vs_baseline": 0.0}))
+def child_main(platform):
+    if platform == "cpu":
+        force_cpu_platform()
+        imgs, _ = run(batch=8, image_size=64, classes=100, warmup=1, iters=4)
+        # different workload (64px/100cls) — not comparable to the V100
+        # 224px baseline, so vs_baseline stays 0 like the smoke
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_fp32_cpu_fallback",
+            "value": round(imgs, 2), "unit": "img/s", "vs_baseline": 0.0}))
         return
     batch = int(os.environ.get("BENCH_BATCH", "128"))
+    state = os.environ.get("BENCH_STATE")
     last_err = None
     while batch >= 16:
+        if state:
+            try:
+                with open(state, "w") as f:
+                    f.write(str(batch))
+            except OSError:
+                pass
         try:
-            imgs, loss = run(batch=batch, image_size=224, classes=1000)
+            imgs, _ = run(batch=batch, image_size=224, classes=1000)
             print(json.dumps({
                 "metric": f"resnet50_train_imgs_per_sec_fp32_b{batch}",
                 "value": round(imgs, 2), "unit": "img/s",
@@ -88,6 +112,81 @@ def main():
                 continue
             raise
     raise SystemExit(f"bench failed at batch>=16: {last_err}")
+
+
+def smoke_main():
+    force_cpu_platform()
+    imgs, _ = run(batch=4, image_size=32, classes=10, warmup=1, iters=2)
+    print(json.dumps({"metric": "resnet50_train_smoke",
+                      "value": round(imgs, 2), "unit": "img/s",
+                      "vs_baseline": 0.0}))
+
+
+# --------------------------------------------------------------- parent ---
+
+def _attempt(platform, timeout):
+    """Run the child; return its JSON line or None."""
+    env = dict(os.environ, BENCH_CHILD=platform)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {platform} attempt timed out after {timeout}s",
+              file=sys.stderr)
+        return None
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    if "metric" in json.loads(line):
+                        return line
+                except ValueError:
+                    continue
+    tail = (proc.stderr or "")[-2000:]
+    print(f"[bench] {platform} attempt rc={proc.returncode}: {tail}",
+          file=sys.stderr)
+    return None
+
+
+def main():
+    if CHILD:
+        child_main(CHILD)
+        return
+    if SMOKE:
+        smoke_main()
+        return
+    # total worst-case budget 480+10+480+240 = 1210 s ≈ 20 min if every
+    # stage times out — the goal is that a hung tunnel still ends in a
+    # printed JSON line, not an rc=124 kill
+    t0 = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "480"))
+    state = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_state")
+    os.environ["BENCH_STATE"] = state
+    for i in range(2):
+        if i:
+            time.sleep(10)
+            # resume the OOM batch-halving descent where the killed
+            # attempt left off instead of restarting at BENCH_BATCH
+            try:
+                with open(state) as f:
+                    os.environ["BENCH_BATCH"] = f.read().strip()
+            except (OSError, ValueError):
+                pass
+        line = _attempt("axon", t0)
+        if line:
+            print(line)
+            return
+    line = _attempt("cpu", 240)
+    if line:
+        print(line)
+        return
+    print(json.dumps({"metric": "resnet50_train_imgs_per_sec_fp32",
+                      "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+                      "error": "TPU backend unavailable and CPU fallback "
+                               "failed"}))
+    raise SystemExit(1)
 
 
 if __name__ == "__main__":

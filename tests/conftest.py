@@ -2,26 +2,18 @@
 
 Runs the suite on a virtual 8-device CPU mesh (like the reference's
 multi-process single-host distributed tests, SURVEY §4) so sharding paths
-are exercised without TPU hardware. Must set XLA flags before jax import.
+are exercised without TPU hardware. The platform forcing lives in
+``_cpu_platform.force_cpu_platform`` (shared with bench.py and
+__graft_entry__.py) — it must run before any backend initializes.
 """
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # axon env presets this to the TPU tunnel
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon sitecustomize registers a TPU-tunnel PJRT plugin at interpreter
-# start and sets the jax_platforms CONFIG to "axon,cpu" (config beats the
-# env var). Tests must run on the virtual CPU mesh — and the tunnel admits
-# one process at a time, so a test run would otherwise contend with the
-# bench/driver for the single chip. Force the config back to cpu before
-# any backend initializes.
-import jax  # noqa: E402
+from _cpu_platform import force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+force_cpu_platform(num_devices=8)
 
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
