@@ -184,6 +184,10 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     stats = {key: {"min": onp.inf, "max": -onp.inf, "samples": []}
              for key in targets}
     _CAP = 16384  # abs-value samples kept per layer per batch
+    # one persistent RNG per quantize_net call: a fresh RandomState(0)
+    # per batch would resample the same flattened indices every batch for
+    # equal-size activations, biasing the histogram toward fixed positions
+    _rng = onp.random.RandomState(0)
     hooks = []
     for key, (blk, name, child) in targets.items():
         orig = child.forward
@@ -196,8 +200,7 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             if calib_mode == "entropy":
                 av = onp.abs(v)
                 if av.size > _CAP:
-                    av = av[onp.random.RandomState(0).choice(
-                        av.size, _CAP, replace=False)]
+                    av = av[_rng.choice(av.size, _CAP, replace=False)]
                 st["samples"].append(av)
             return _orig(x, *a, **kw)
 

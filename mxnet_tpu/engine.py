@@ -82,7 +82,6 @@ class Engine:
     def wait_for_var(self, v):
         """Block until all ops touching v finish; re-raise its poison."""
         err_op = self._lib.eng_wait_for_var(self._h, v.id)
-        self._gc_callbacks()
         if err_op >= 0:
             with self._lock:
                 exc = self._exceptions.get(err_op)
@@ -91,16 +90,30 @@ class Engine:
             raise RuntimeError(f"engine op {err_op} failed")
 
     def wait_all(self):
+        # snapshot BEFORE the barrier: a concurrent push() racing with the
+        # barrier's return may register a new callback whose op is still
+        # in flight — only ops pushed before the barrier are provably done
+        with self._lock:
+            done_ids = list(self._live_cbs)
         self._lib.eng_wait_all(self._h)
-        self._gc_callbacks()
+        self._gc_callbacks(done_ids)
 
     def var_version(self, v):
         return int(self._lib.eng_var_version(self._h, v.id))
 
-    def _gc_callbacks(self):
-        # callbacks for completed ops can be dropped once no worker can
-        # still be inside them — i.e. after a full barrier
-        pass  # conservative: keep alive for engine lifetime
+    def num_live_callbacks(self):
+        with self._lock:
+            return len(self._live_cbs)
+
+    def _gc_callbacks(self, done_ids):
+        # WaitForAll is a full barrier: every op pushed before it has
+        # completed and its trampoline frame has returned, so no worker
+        # can still be inside those ctypes callbacks — safe to drop their
+        # keepalives. Poison exceptions stay (bounded by error count) so
+        # a later wait_for_var on a still-poisoned var re-raises.
+        with self._lock:
+            for op_id in done_ids:
+                self._live_cbs.pop(op_id, None)
 
     def __del__(self):
         try:
@@ -134,6 +147,8 @@ class NaiveEngine:
             src = self._errors[poisoned[0].id]
             for v in mutable_vars:
                 self._errors.setdefault(v.id, src)
+                # native Complete() bumps versions for skipped ops too
+                self._versions[v.id] += 1
             return op_id
         try:
             fn()
@@ -143,6 +158,9 @@ class NaiveEngine:
             self._exceptions[op_id] = e
             for v in mutable_vars:
                 self._errors[v.id] = op_id
+                # native Complete() bumps versions even on failure
+                # (engine.cc) — keep the two engine types in lockstep
+                self._versions[v.id] += 1
         return op_id
 
     def wait_for_var(self, v):

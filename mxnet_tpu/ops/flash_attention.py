@@ -197,6 +197,13 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, use_pallas=None):
     use_pallas: None = pallas on TPU / XLA elsewhere; True forces the
     kernel (interpreted off-TPU — slow, for testing); False forces XLA.
     """
+    if causal and q.shape[-2] > k.shape[-2]:
+        # bottom-right-aligned causal with S_q > S_k gives query rows a
+        # negative offset — rows with zero visible keys would come out of
+        # the all-masked online-softmax as an unnormalized average of V
+        raise ValueError(
+            "flash_attention(causal=True) requires S_q <= S_k, got "
+            f"S_q={q.shape[-2]} S_k={k.shape[-2]}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if use_pallas is None:

@@ -64,10 +64,15 @@ class MXRecordIO:
 
     def __getstate__(self):
         """Override pickling behaviour (DataLoader workers)."""
+        if self.writable:
+            # setstate reopens with 'w', which would truncate the file and
+            # drop buffered state — the reference forbids this too
+            # (python/mxnet/recordio.py writable-pickle guard)
+            raise RuntimeError(
+                "cannot pickle a writable (MX)RecordIO instance")
         d = dict(self.__dict__)
         d["fio"] = None
-        if not self.writable:
-            d["_pos"] = self.fio.tell() if self.fio else 0
+        d["_pos"] = self.fio.tell() if self.fio else 0
         return d
 
     def __setstate__(self, d):

@@ -50,6 +50,8 @@ struct Op {
   std::vector<int64_t> mutable_vars;
   std::atomic<int> missing{0};  // ungranted deps
   int priority = 0;
+  bool always_run = false;  // run even when inputs are poisoned (internal
+                            // WaitForVar sync ops must fire their cv)
 };
 
 struct OpCmp {
@@ -85,13 +87,15 @@ class Engine {
   }
 
   int64_t Push(Callback fn, void* ctx, const int64_t* cvars, int ncon,
-               const int64_t* mvars, int nmut, int priority) {
+               const int64_t* mvars, int nmut, int priority,
+               bool always_run = false) {
     Op* op = new Op();
     std::unique_lock<std::mutex> lk(mu_);
     op->id = next_op_++;
     op->fn = fn;
     op->ctx = ctx;
     op->priority = priority;
+    op->always_run = always_run;
     op->const_vars.assign(cvars, cvars + ncon);
     op->mutable_vars.assign(mvars, mvars + nmut);
     op->missing.store(ncon + nmut);
@@ -128,7 +132,7 @@ class Engine {
       return 0;
     };
     int64_t v[1] = {var};
-    Push(cb, &sync, v, 1, nullptr, 0, 1 << 20);
+    Push(cb, &sync, v, 1, nullptr, 0, 1 << 20, /*always_run=*/true);
     {
       std::unique_lock<std::mutex> lk(m);
       c.wait(lk, [&] { return done; });
@@ -193,7 +197,7 @@ class Engine {
           for (int64_t vid : op->mutable_vars)
             if (vars_[vid]->has_error) { poisoned = true;
               src = vars_[vid]->error_op; break; }
-        if (poisoned) {
+        if (poisoned && !op->always_run) {
           Complete(op, true, src);
           continue;
         }

@@ -1,0 +1,103 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md r1).
+
+Each test pins one of the five fixes: engine callback GC, flash-attention
+causal shape guard, NaiveEngine version bump on error, persistent
+calibration RNG, writable-recordio pickle guard.
+"""
+import pickle
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, engine, recordio
+from mxnet_tpu.gluon import nn
+
+
+def test_engine_callback_gc_after_wait_all():
+    try:
+        eng = engine.Engine(nthreads=2)
+    except RuntimeError:
+        pytest.skip("native engine unavailable")
+    out = []
+    for i in range(64):
+        v = eng.new_variable()
+        eng.push(lambda i=i: out.append(i), mutable_vars=(v,))
+    eng.wait_all()
+    assert len(out) == 64
+    # full barrier -> every trampoline has returned; keepalives dropped
+    assert eng.num_live_callbacks() == 0
+    # poison survives GC: error pushed after the barrier still re-raises
+    v = eng.new_variable()
+
+    def boom():
+        raise ValueError("poison")
+
+    eng.push(boom, mutable_vars=(v,))
+    with pytest.raises(ValueError, match="poison"):
+        eng.wait_for_var(v)
+    eng.wait_all()
+    assert eng.num_live_callbacks() == 0
+
+
+def test_flash_attention_causal_sq_gt_sk_raises():
+    from mxnet_tpu.ops.flash_attention import flash_attention
+    import jax.numpy as jnp
+
+    q = jnp.zeros((1, 2, 8, 4))
+    kv = jnp.zeros((1, 2, 4, 4))
+    with pytest.raises(ValueError, match="S_q <= S_k"):
+        flash_attention(q, kv, kv, causal=True)
+    # non-causal cross-attention with S_q > S_k stays legal
+    o = flash_attention(q, kv, kv, causal=False)
+    assert o.shape == (1, 2, 8, 4)
+
+
+def test_naive_engine_version_bump_on_error():
+    eng = engine.NaiveEngine()
+    v = eng.new_variable()
+    eng.push(lambda: None, mutable_vars=(v,))
+    assert eng.var_version(v) == 1
+
+    def boom():
+        raise RuntimeError("x")
+
+    eng.push(boom, mutable_vars=(v,))
+    # native Complete() bumps the version even on failure — match it
+    assert eng.var_version(v) == 2
+    with pytest.raises(RuntimeError):
+        eng.wait_for_var(v)
+
+
+def test_quant_entropy_reservoir_persistent_rng(monkeypatch):
+    from mxnet_tpu.contrib import quantization as qz
+
+    calls = []
+    real = onp.random.RandomState
+
+    class Recording(real):
+        def __init__(self, *a, **kw):
+            calls.append(a)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(onp.random, "RandomState", Recording)
+    net = nn.Dense(4)
+    net.initialize()
+    rs = real(7)
+    # 3 equal-size batches each larger than the 16384-sample reservoir cap
+    batches = [nd.array(rs.randn(64, 600).astype("float32"))
+               for _ in range(3)]
+    qz.quantize_net(net, calib_data=batches, calib_mode="entropy")
+    # one persistent RNG per quantize_net call, not one per batch
+    assert len(calls) <= 1
+
+
+def test_writable_recordio_pickle_raises(tmp_path):
+    w = recordio.MXRecordIO(str(tmp_path / "a.rec"), "w")
+    w.write(b"hello")
+    with pytest.raises(RuntimeError, match="writable"):
+        pickle.dumps(w)
+    w.close()
+    r = recordio.MXRecordIO(str(tmp_path / "a.rec"), "r")
+    r2 = pickle.loads(pickle.dumps(r))  # readable pickling still works
+    assert r2.read() == b"hello"
