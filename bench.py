@@ -30,7 +30,18 @@ import subprocess
 import sys
 import time
 
-BASELINE_IMGS_PER_SEC = 363.69
+BASELINE_IMGS_PER_SEC = 363.69  # reference fp32 training, 1xV100
+# the reference publishes no fp16 TRAINING number; its fp16/fp32 scoring
+# ratio is 2355.04/1233.15 = 1.91x (perf.md:187-215) — applied to the fp32
+# training baseline as the fairest half-precision comparison point
+BASELINE_FP16_EST = BASELINE_IMGS_PER_SEC * 2355.04 / 1233.15
+# ResNet-50 fwd = 4.089 GFLOP/img at 224x224 (2 FLOPs/MAC); training
+# fwd+bwd ~ 3x fwd
+TRAIN_GFLOPS_PER_IMG = 3 * 4.089
+# bf16 MXU peak per chip by device_kind (TFLOP/s)
+PEAK_TFLOPS = {"TPU v4": 275, "TPU v5": 459, "TPU v5p": 459,
+               "TPU v5 lite": 197, "TPU v5e": 197,
+               "TPU v6 lite": 918, "TPU v6e": 918}
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 CHILD = os.environ.get("BENCH_CHILD")
 
@@ -40,7 +51,7 @@ from _cpu_platform import force_cpu_platform
 
 # ---------------------------------------------------------------- child ---
 
-def build_trainer(mesh, classes=1000):
+def build_trainer(mesh, classes=1000, dtype=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
@@ -53,17 +64,17 @@ def build_trainer(mesh, classes=1000):
     return parallel.SPMDTrainer(
         net, loss, optimizer="sgd",
         optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
-        mesh=mesh)
+        mesh=mesh, compute_dtype=dtype)
 
 
-def run(batch, image_size, classes, warmup=2, iters=8):
+def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
     import jax
     import numpy as onp
 
     from mxnet_tpu import nd, parallel
 
     mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = build_trainer(mesh, classes)
+    trainer = build_trainer(mesh, classes, dtype=dtype)
     rng = onp.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, image_size, image_size).astype("f"))
     y = nd.array(rng.randint(0, classes, batch).astype("f"))
@@ -78,6 +89,22 @@ def run(batch, image_size, classes, warmup=2, iters=8):
     return batch * iters / dt, float(lval.asscalar())
 
 
+def mfu_pct(imgs_per_sec):
+    """Sustained training FLOP/s as % of the chip's bf16 MXU peak."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind)
+    if peak is None:  # longest-matching-prefix fallback ("TPU v5 lite"...)
+        match = max((k for k in PEAK_TFLOPS if kind.startswith(k)),
+                    key=len, default=None)
+        peak = PEAK_TFLOPS.get(match)
+    if not peak:
+        return None
+    return round(100.0 * imgs_per_sec * TRAIN_GFLOPS_PER_IMG
+                 / (peak * 1000.0), 2)
+
+
 def child_main(platform):
     if platform == "cpu":
         force_cpu_platform()
@@ -88,30 +115,89 @@ def child_main(platform):
             "metric": "resnet50_train_imgs_per_sec_fp32_cpu_fallback",
             "value": round(imgs, 2), "unit": "img/s", "vs_baseline": 0.0}))
         return
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
     state = os.environ.get("BENCH_STATE")
-    last_err = None
-    while batch >= 16:
+    progress = {}  # persisted across retries via the state file
+
+    def checkpoint():
         if state:
             try:
                 with open(state, "w") as f:
-                    f.write(str(batch))
+                    f.write(json.dumps(progress))
             except OSError:
                 pass
+
+    def measure(tag, batch, dtype):
+        """OOM-halving descent; returns (imgs/s, batch) or raises
+        RuntimeError (NOT SystemExit — the bf16 phase's failure must be
+        catchable so a measured fp32 result still gets printed)."""
+        last_err = None
+        while batch >= 16:
+            progress.update({"tag": tag, "batch": batch})
+            checkpoint()
+            try:
+                imgs, _ = run(batch=batch, image_size=224, classes=1000,
+                              dtype=dtype)
+                return imgs, batch
+            except RuntimeError as e:  # OOM → halve the batch
+                last_err = e
+                if "RESOURCE_EXHAUSTED" in str(e) or \
+                        "Out of memory" in str(e):
+                    batch //= 2
+                    continue
+                raise
+        raise RuntimeError(f"bench {tag} failed at batch>=16: {last_err}")
+
+    fp32_batch = int(os.environ.get("BENCH_BATCH", "128"))
+    bf16_batch = int(os.environ.get("BENCH_BF16_BATCH", "256"))
+    # resume point from a killed attempt: skip straight to its phase,
+    # reusing the fp32 result the killed attempt already measured
+    resume = {}
+    if os.environ.get("BENCH_RESUME"):
         try:
-            imgs, _ = run(batch=batch, image_size=224, classes=1000)
-            print(json.dumps({
-                "metric": f"resnet50_train_imgs_per_sec_fp32_b{batch}",
-                "value": round(imgs, 2), "unit": "img/s",
-                "vs_baseline": round(imgs / BASELINE_IMGS_PER_SEC, 3)}))
-            return
-        except Exception as e:  # OOM → halve the batch
-            last_err = e
-            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
-                batch //= 2
-                continue
-            raise
-    raise SystemExit(f"bench failed at batch>=16: {last_err}")
+            resume = json.loads(os.environ["BENCH_RESUME"])
+        except ValueError:
+            pass
+    if resume.get("tag") == "fp32":
+        fp32_batch = int(resume["batch"])
+    elif resume.get("tag") == "bf16":
+        bf16_batch = int(resume["batch"])
+
+    if resume.get("fp32_done"):
+        imgs32, b32 = resume["fp32_done"]
+        progress["fp32_done"] = resume["fp32_done"]
+    else:
+        imgs32, b32 = measure("fp32", fp32_batch, None)
+        progress["fp32_done"] = [imgs32, b32]
+        checkpoint()
+    extra = {"fp32_imgs_per_sec": round(imgs32, 2), "fp32_batch": b32,
+             "fp32_vs_v100_fp32_train": round(
+                 imgs32 / BASELINE_IMGS_PER_SEC, 3)}
+    m32 = mfu_pct(imgs32)
+    if m32 is not None:
+        extra["fp32_mfu_pct_of_bf16_peak"] = m32
+    try:
+        imgs16, b16 = measure("bf16", bf16_batch, "bfloat16")
+    except Exception as e:
+        print(f"[bench] bf16 phase failed: {e}", file=sys.stderr)
+        imgs16 = None
+    if imgs16 is not None:
+        m16 = mfu_pct(imgs16)
+        if m16 is not None:
+            extra["bf16_mfu_pct_of_bf16_peak"] = m16
+        extra["bf16_vs_v100_fp16_train_est"] = round(
+            imgs16 / BASELINE_FP16_EST, 3)
+        extra["bf16_speedup_over_fp32"] = round(imgs16 / imgs32, 3)
+        print(json.dumps({
+            "metric": f"resnet50_train_imgs_per_sec_bf16_b{b16}",
+            "value": round(imgs16, 2), "unit": "img/s",
+            "vs_baseline": round(imgs16 / BASELINE_IMGS_PER_SEC, 3),
+            "extra": extra}))
+    else:
+        print(json.dumps({
+            "metric": f"resnet50_train_imgs_per_sec_fp32_b{b32}",
+            "value": round(imgs32, 2), "unit": "img/s",
+            "vs_baseline": round(imgs32 / BASELINE_IMGS_PER_SEC, 3),
+            "extra": extra}))
 
 
 def smoke_main():
@@ -168,11 +254,11 @@ def main():
         if i:
             time.sleep(10)
             # resume the OOM batch-halving descent where the killed
-            # attempt left off instead of restarting at BENCH_BATCH
+            # attempt left off instead of restarting from scratch
             try:
                 with open(state) as f:
-                    os.environ["BENCH_BATCH"] = f.read().strip()
-            except (OSError, ValueError):
+                    os.environ["BENCH_RESUME"] = f.read().strip()
+            except OSError:
                 pass
         line = _attempt("axon", t0)
         if line:

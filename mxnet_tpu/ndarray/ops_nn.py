@@ -257,14 +257,24 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape[axis] = data.shape[axis]
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
+    # half-precision inputs: accumulate statistics in fp32 (bf16 variance
+    # has ~3 significant digits — unusable for rsqrt), output back in the
+    # input dtype; this is cuDNN's CUDNN_BATCHNORM_SPATIAL fp32-stat
+    # behavior the reference relies on for fp16 training
+    half = data.dtype in (jnp.bfloat16, jnp.float16)
+    xf = data.astype(jnp.float32) if half else data
     if use_batch_stats and not use_global_stats:
-        mean = jnp.mean(data, axis=ax)
-        var = jnp.var(data, axis=ax)
+        mean = jnp.mean(xf, axis=ax)
+        var = jnp.var(xf, axis=ax)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(xf.dtype)
+        var = moving_var.astype(xf.dtype)
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * \
-        gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (xf - mean.reshape(bshape)) * inv.reshape(bshape) * \
+        gamma.astype(xf.dtype).reshape(bshape) + \
+        beta.astype(xf.dtype).reshape(bshape)
+    if half:
+        out = out.astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

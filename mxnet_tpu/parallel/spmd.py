@@ -77,13 +77,84 @@ def shard_params(named_params, mesh, rules=None):
     return out
 
 
-def _sgd_mom(w, g, m, lr, momentum, wd):
-    m_new = momentum * m - lr * (g + wd * w)
-    return w + m_new, m_new
+def _make_optimizer(name, op):
+    """Build (init_state, update) for the compiled step.
 
+    Master weights and state live in fp32 regardless of compute dtype
+    (the reference's multi-precision mode, optimizer.py
+    create_state_multi_precision). update(w, g, s, t) -> (w', s') with t
+    the 1-based global step (replicated int32 scalar) for bias
+    correction. The update math is the registered optimizer ops
+    (ndarray/ops_optim.py) — one implementation shared with the eager
+    Trainer path, as the reference shares optimizer_op-inl.h kernels.
+    Reference semantics: python/mxnet/optimizer/optimizer.py (SGD:560,
+    Adam:1155, LAMB:754 — Adam bias correction via the lr coefficient).
+    """
+    from ..ndarray import ops_optim as _oo
 
-def _sgd(w, g, _, lr, momentum, wd):
-    return w - lr * (g + wd * w), None
+    lr = float(op.get("learning_rate", 0.01))
+    wd = float(op.get("wd", 0.0))
+    momentum = float(op.get("momentum", 0.0))
+    beta1 = float(op.get("beta1", 0.9))
+    beta2 = float(op.get("beta2", 0.999))
+    eps = float(op.get("epsilon", 1e-8 if name != "lamb" else 1e-6))
+    rescale = float(op.get("rescale_grad", 1.0))
+    clip = op.get("clip_gradient")
+    clip = float(clip) if clip is not None else -1.0
+
+    if name == "sgd":
+        if momentum:
+            def init(w):
+                return jnp.zeros_like(w)
+
+            def update(w, g, s, t):
+                return _oo.sgd_mom_update(
+                    w, g, s, lr, momentum=momentum, wd=wd,
+                    rescale_grad=rescale, clip_gradient=clip)
+        else:
+            def init(w):
+                return None
+
+            def update(w, g, s, t):
+                return _oo.sgd_update(
+                    w, g, lr, wd=wd, rescale_grad=rescale,
+                    clip_gradient=clip), None
+    elif name in ("adam", "adamw"):
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, t):
+            m, v = s
+            tf = t.astype(jnp.float32)
+            coef = jnp.sqrt(1.0 - beta2 ** tf) / (1.0 - beta1 ** tf)
+            if name == "adam":
+                w2, m2, v2 = _oo.adam_update(
+                    w, g, m, v, lr * coef, beta1=beta1, beta2=beta2,
+                    epsilon=eps, wd=wd, rescale_grad=rescale,
+                    clip_gradient=clip)
+            else:
+                w2, m2, v2 = _oo.adamw_update(
+                    w, g, m, v, lr * coef, beta1=beta1, beta2=beta2,
+                    epsilon=eps, wd=wd, rescale_grad=rescale,
+                    clip_gradient=clip)
+            return w2, (m2, v2)
+    elif name == "lamb":
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, t):
+            m, v = s
+            gdir, m2, v2 = _oo.lamb_update_phase1(
+                w, g, m, v, beta1=beta1, beta2=beta2, epsilon=eps,
+                t=t.astype(jnp.float32), bias_correction=True, wd=wd,
+                rescale_grad=rescale, clip_gradient=clip)
+            r1 = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2))
+            r2 = jnp.sqrt(jnp.sum(gdir.astype(jnp.float32) ** 2))
+            return _oo.lamb_update_phase2(w, gdir, r1, r2, lr), (m2, v2)
+    else:
+        raise NotImplementedError(
+            f"SPMDTrainer supports sgd/adam/adamw/lamb, got {name}")
+    return init, update
 
 
 class SPMDTrainer:
@@ -97,20 +168,19 @@ class SPMDTrainer:
     """
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
-                 mesh=None, param_rules=None, batch_axis_name="dp"):
+                 mesh=None, param_rules=None, batch_axis_name="dp",
+                 compute_dtype=None):
         self._net = net
         self._loss = loss
         self._mesh = mesh if mesh is not None else make_mesh()
         self._axis = batch_axis_name
-        op = dict(optimizer_params or {})
-        self._lr = float(op.get("learning_rate", 0.01))
-        self._momentum = float(op.get("momentum", 0.0))
-        self._wd = float(op.get("wd", 0.0))
-        if optimizer == "sgd":
-            self._update = _sgd_mom if self._momentum else _sgd
-        else:
-            raise NotImplementedError(
-                f"SPMDTrainer supports sgd for now, got {optimizer}")
+        self._init_state, self._update = _make_optimizer(
+            optimizer, dict(optimizer_params or {}))
+        # mixed precision: fp32 master weights/state, half-precision
+        # forward/backward (reference AMP; bf16 needs no loss scaling —
+        # same exponent range as fp32)
+        self._cdtype = (jnp.dtype(compute_dtype) if compute_dtype
+                        else None)
         self._param_rules = param_rules
         self._compiled = None
         self._params = None
@@ -134,20 +204,35 @@ class SPMDTrainer:
         batch_shard = NamedSharding(mesh, P(self._axis))
         rep = NamedSharding(mesh, P())
         pnds = [p._ndarray for p in self._params]
-        update, lr, momentum, wd = (self._update, self._lr, self._momentum,
-                                    self._wd)
+        update, cdtype = self._update, self._cdtype
 
-        def step(param_vals, states, xd, yd, key):
+        def step(param_vals, states, xd, yd, key, t):
             def loss_fn(pv):
                 saved = [p._data for p in pnds]
                 try:
-                    for p, v in zip(pnds, pv):
+                    for i, (p, v) in enumerate(zip(pnds, pv)):
+                        # half-precision compute on fp32 masters; the
+                        # cast's vjp upcasts cotangents, so grads come
+                        # back fp32. Non-trainable params (BN running
+                        # stats) stay fp32 — re-quantizing the running
+                        # statistic each step would defeat the fp32-stat
+                        # accumulation in batch_norm (AMP rule: norm
+                        # stats keep full precision)
+                        if cdtype is not None and trainable[i] and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            v = v.astype(cdtype)
                         p._data = v
+                    xin = xd
+                    if cdtype is not None and \
+                            jnp.issubdtype(xin.dtype, jnp.floating):
+                        xin = xin.astype(cdtype)
                     with autograd.pause(train_mode=True), \
                             mxrandom.key_provider(key):
-                        out = net.forward(NDArray(xd))
+                        out = net.forward(NDArray(xin))
+                        if cdtype is not None:
+                            out = NDArray(out.data.astype(jnp.float32))
                         lval = loss.forward(out, NDArray(yd))
-                        scalar = jnp.mean(lval.data)
+                        scalar = jnp.mean(lval.data.astype(jnp.float32))
                     mut = {str(i): p._data for i, (p, v) in
                            enumerate(zip(pnds, pv)) if p._data is not v}
                     return scalar, mut
@@ -160,29 +245,33 @@ class SPMDTrainer:
             new_params, new_states = [], []
             for i, (w, g, s) in enumerate(zip(param_vals, grads, states)):
                 if not trainable[i]:
-                    new_params.append(mut.get(str(i), w))
+                    # mutated aux state (BN running stats) back to the
+                    # master dtype
+                    w2 = mut.get(str(i), w)
+                    new_params.append(w2.astype(w.dtype))
                     new_states.append(s)
                 else:
-                    w2, s2 = update(w, g, s, lr, momentum, wd)
+                    w2, s2 = update(w, g, s, t)
                     new_params.append(w2)
                     new_states.append(s2)
             return lval, new_params, new_states
 
         self._states = [
-            jax.device_put(jnp.zeros_like(p._ndarray.data), s)
-            if trainable[i] and self._momentum else None
+            jax.tree_util.tree_map(
+                lambda z, s=s: jax.device_put(z, s),
+                self._init_state(p._ndarray.data))
+            if trainable[i] else None
             for i, (p, s) in enumerate(zip(self._params, self._pshard))]
+        state_shards = [jax.tree_util.tree_map(lambda _, ps=ps: ps, st)
+                        for st, ps in zip(self._states, self._pshard)]
         self._param_vals = [jax.device_put(p._ndarray.data, s)
                             for p, s in zip(self._params, self._pshard)]
+        self._t = 0
         self._compiled = jax.jit(
             step,
-            in_shardings=(self._pshard,
-                          [None if s is None else ps for s, ps in
-                           zip(self._states, self._pshard)],
-                          batch_shard, batch_shard, rep),
-            out_shardings=(rep, self._pshard,
-                           [None if s is None else ps for s, ps in
-                            zip(self._states, self._pshard)]),
+            in_shardings=(self._pshard, state_shards, batch_shard,
+                          batch_shard, rep, rep),
+            out_shardings=(rep, self._pshard, state_shards),
             donate_argnums=(0, 1))
 
     # -- public -----------------------------------------------------------
@@ -196,8 +285,10 @@ class SPMDTrainer:
         xd = shard_batch(x, self._mesh, self._axis).data
         yd = shard_batch(y, self._mesh, self._axis).data
         key = mxrandom.next_key()
+        self._t += 1
+        t = replicate(jnp.int32(self._t), self._mesh)
         lval, self._param_vals, self._states = self._compiled(
-            self._param_vals, self._states, xd, yd, key)
+            self._param_vals, self._states, xd, yd, key, t)
         return NDArray(lval)
 
     def sync_params_to_gluon(self):

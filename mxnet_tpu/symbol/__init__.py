@@ -315,6 +315,8 @@ def _num_outputs_for(opname, kwargs):
         return 1
     if opname == "histogram":
         return 2
+    if opname in ("linalg_gelqf", "linalg_syevd", "linalg_slogdet"):
+        return 2
     return 1
 
 
@@ -381,6 +383,16 @@ def _populate():
 
 
 _populate()
+
+# `mx.sym.linalg` namespace (reference: python/mxnet/symbol/linalg.py)
+import types as _types  # noqa: E402
+
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _lname in _registry.list_ops():
+    if _lname.startswith("linalg_"):
+        setattr(linalg, _lname[len("linalg_"):],
+                _sym_wrapper(_registry.get_op(_lname)))
+_sys.modules[linalg.__name__] = linalg
 
 
 def zeros(shape, dtype="float32", **kwargs):

@@ -1,0 +1,221 @@
+"""nd.linalg la_op family: value + numeric-gradient coverage.
+
+Reference test model: tests/python/unittest/test_operator.py
+test_laop / test_laop_2 / test_laop_3 (value checks against numpy and
+gradient checks via check_numeric_gradient for every la_op).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+rs = onp.random.RandomState(42)
+
+
+def _spd(n, batch=()):
+    """Random symmetric positive-definite batch."""
+    a = rs.randn(*batch, n, n).astype("f")
+    return a @ onp.swapaxes(a, -1, -2) + n * onp.eye(n, dtype="f")
+
+
+def test_gemm_value_and_grad():
+    A = rs.randn(2, 3, 4).astype("f")
+    B = rs.randn(2, 4, 5).astype("f")
+    C = rs.randn(2, 3, 5).astype("f")
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(out.asnumpy(), 2.0 * (A @ B) + 0.5 * C, rtol=1e-4)
+    outT = nd.linalg.gemm(nd.array(onp.swapaxes(A, -1, -2)), nd.array(B),
+                          nd.array(C), transpose_a=True)
+    assert_almost_equal(outT.asnumpy(), A @ B + C, rtol=1e-4)
+    check_numeric_gradient(
+        lambda a, b, c: nd.linalg.gemm(a, b, c, alpha=1.5, beta=2.0),
+        [A, B, C])
+
+
+def test_gemm2_value_and_grad():
+    A = rs.randn(3, 4).astype("f")
+    B = rs.randn(5, 4).astype("f")
+    out = nd.linalg.gemm2(nd.array(A), nd.array(B), transpose_b=True,
+                          alpha=3.0)
+    assert_almost_equal(out.asnumpy(), 3.0 * (A @ B.T), rtol=1e-4)
+    check_numeric_gradient(
+        lambda a, b: nd.linalg.gemm2(a, b, transpose_b=True), [A, B])
+
+
+def test_syrk():
+    A = rs.randn(2, 3, 4).astype("f")
+    assert_almost_equal(nd.linalg.syrk(nd.array(A), alpha=2.0).asnumpy(),
+                        2.0 * A @ onp.swapaxes(A, -1, -2), rtol=1e-4)
+    assert_almost_equal(
+        nd.linalg.syrk(nd.array(A), transpose=True).asnumpy(),
+        onp.swapaxes(A, -1, -2) @ A, rtol=1e-4)
+    check_numeric_gradient(lambda a: nd.linalg.syrk(a), [A[0]])
+
+
+def test_potrf_and_potri():
+    A = _spd(4, (2,))
+    L = nd.linalg.potrf(nd.array(A))
+    assert_almost_equal(L.asnumpy() @ onp.swapaxes(L.asnumpy(), -1, -2),
+                        A, rtol=1e-3, atol=1e-3)
+    # potri: (L Lᵀ)⁻¹ from the factor
+    Ainv = nd.linalg.potri(L)
+    assert_almost_equal(Ainv.asnumpy() @ A,
+                        onp.broadcast_to(onp.eye(4, dtype="f"), A.shape),
+                        rtol=1e-2, atol=1e-2)
+    check_numeric_gradient(lambda a: nd.linalg.potrf(a), [_spd(3)],
+                           rtol=5e-2, atol=1e-2)
+
+
+def test_trmm():
+    A = onp.tril(rs.randn(4, 4)).astype("f") + 4 * onp.eye(4, dtype="f")
+    B = rs.randn(4, 5).astype("f")
+    out = nd.linalg.trmm(nd.array(A), nd.array(B), alpha=2.0)
+    assert_almost_equal(out.asnumpy(), 2.0 * onp.tril(A) @ B, rtol=1e-4)
+    out = nd.linalg.trmm(nd.array(A), nd.array(B.T), rightside=True)
+    assert_almost_equal(out.asnumpy(), B.T @ onp.tril(A), rtol=1e-4)
+    out = nd.linalg.trmm(nd.array(A), nd.array(B), transpose=True)
+    assert_almost_equal(out.asnumpy(), onp.tril(A).T @ B, rtol=1e-4)
+    check_numeric_gradient(lambda a, b: nd.linalg.trmm(a, b), [A, B])
+
+
+@pytest.mark.parametrize("transpose,rightside",
+                         [(False, False), (True, False),
+                          (False, True), (True, True)])
+def test_trsm(transpose, rightside):
+    A = (onp.tril(rs.randn(4, 4)) + 5 * onp.eye(4)).astype("f")
+    tri = onp.tril(A)
+    op = tri.T if transpose else tri
+    if rightside:
+        B = rs.randn(3, 4).astype("f")
+        X = nd.linalg.trsm(nd.array(A), nd.array(B), transpose=transpose,
+                           rightside=True, alpha=2.0)
+        assert_almost_equal(X.asnumpy() @ op, 2.0 * B, rtol=1e-3,
+                            atol=1e-4)
+    else:
+        B = rs.randn(4, 3).astype("f")
+        X = nd.linalg.trsm(nd.array(A), nd.array(B), transpose=transpose,
+                           alpha=2.0)
+        assert_almost_equal(op @ X.asnumpy(), 2.0 * B, rtol=1e-3,
+                            atol=1e-4)
+
+
+def test_trsm_grad():
+    A = (onp.tril(rs.randn(3, 3)) + 4 * onp.eye(3)).astype("f")
+    B = rs.randn(3, 2).astype("f")
+    check_numeric_gradient(lambda a, b: nd.linalg.trsm(a, b), [A, B],
+                           rtol=3e-2, atol=1e-3)
+
+
+def test_gelqf():
+    A = rs.randn(3, 5).astype("f")
+    L, Q = nd.linalg.gelqf(nd.array(A))
+    Ln, Qn = L.asnumpy(), Q.asnumpy()
+    assert_almost_equal(Ln @ Qn, A, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(Qn @ Qn.T, onp.eye(3, dtype="f"), rtol=1e-3,
+                        atol=1e-4)
+    assert onp.allclose(onp.triu(Ln, 1), 0, atol=1e-5)  # lower triangular
+    assert (onp.diag(Ln) > 0).all()
+
+
+def test_syevd():
+    A = _spd(4)
+    U, L = nd.linalg.syevd(nd.array(A))
+    Un, Ln = U.asnumpy(), L.asnumpy()
+    # A = Uᵀ diag(L) U with rows of U the eigenvectors
+    assert_almost_equal(Un.T @ onp.diag(Ln) @ Un, A, rtol=1e-3, atol=1e-3)
+
+
+def test_inverse_det_slogdet():
+    A = _spd(3, (2,))
+    Ainv = nd.linalg.inverse(nd.array(A))
+    assert_almost_equal(Ainv.asnumpy() @ A,
+                        onp.broadcast_to(onp.eye(3, dtype="f"), A.shape),
+                        rtol=1e-3, atol=1e-3)
+    d = nd.linalg.det(nd.array(A))
+    assert_almost_equal(d.asnumpy(), onp.linalg.det(A), rtol=1e-3)
+    sign, logabs = nd.linalg.slogdet(nd.array(A))
+    sn, ln = onp.linalg.slogdet(A)
+    assert_almost_equal(sign.asnumpy(), sn.astype("f"), rtol=1e-5)
+    assert_almost_equal(logabs.asnumpy(), ln.astype("f"), rtol=1e-4)
+    check_numeric_gradient(lambda a: nd.linalg.slogdet(a)[1], [_spd(3)],
+                           rtol=3e-2, atol=1e-3)
+
+
+def test_sumlogdiag():
+    A = _spd(4)
+    out = nd.linalg.sumlogdiag(nd.array(A))
+    assert_almost_equal(out.asnumpy(),
+                        onp.sum(onp.log(onp.diag(A))).astype("f"),
+                        rtol=1e-4)
+    check_numeric_gradient(lambda a: nd.linalg.sumlogdiag(a), [A],
+                           rtol=3e-2, atol=1e-3)
+
+
+def test_extractdiag_makediag_roundtrip():
+    A = rs.randn(2, 4, 4).astype("f")
+    d = nd.linalg.extractdiag(nd.array(A))
+    assert_almost_equal(d.asnumpy(),
+                        onp.diagonal(A, axis1=-2, axis2=-1), rtol=1e-6)
+    d1 = nd.linalg.extractdiag(nd.array(A), offset=1)
+    assert_almost_equal(d1.asnumpy(),
+                        onp.diagonal(A, offset=1, axis1=-2, axis2=-1),
+                        rtol=1e-6)
+    v = rs.randn(3).astype("f")
+    M = nd.linalg.makediag(nd.array(v))
+    assert_almost_equal(M.asnumpy(), onp.diag(v), rtol=1e-6)
+    M1 = nd.linalg.makediag(nd.array(v), offset=-1)
+    assert_almost_equal(M1.asnumpy(), onp.diag(v, k=-1), rtol=1e-6)
+
+
+def test_extracttrian_maketrian_roundtrip():
+    A = rs.randn(4, 4).astype("f")
+    v = nd.linalg.extracttrian(nd.array(A))
+    assert v.shape == (10,)
+    back = nd.linalg.maketrian(v)
+    assert_almost_equal(back.asnumpy(), onp.tril(A), rtol=1e-6)
+    vu = nd.linalg.extracttrian(nd.array(A), lower=False)
+    backu = nd.linalg.maketrian(vu, lower=False)
+    assert_almost_equal(backu.asnumpy(), onp.triu(A), rtol=1e-6)
+
+
+def test_linalg_multi_output_symbolic():
+    import mxnet_tpu.symbol as sym
+
+    a = sym.Variable("a")
+    U, L = sym.linalg.syevd(a)
+    A = _spd(4)
+    ex = (U * 1).bind(mx.cpu(), {"a": nd.array(A)})
+    (Un,) = ex.forward()
+    w = onp.linalg.eigvalsh(A)
+    Ln, Qn = sym.linalg.gelqf(sym.Variable("x"))
+    assert Un.shape == (4, 4) and w.shape == (4,)
+    s, ld = sym.linalg.slogdet(sym.Variable("y"))
+    ex2 = ld.bind(mx.cpu(), {"y": nd.array(A)})
+    (ldv,) = ex2.forward()
+    assert_almost_equal(ldv.asnumpy(), onp.linalg.slogdet(A)[1], rtol=1e-3)
+
+
+def test_linalg_under_symbol_and_autograd():
+    # la_ops work through the symbolic executor (registered ops, not
+    # jnp delegates) and record on the tape
+    import mxnet_tpu.symbol as sym
+
+    a = sym.Variable("a")
+    out = sym.linalg.sumlogdiag(sym.linalg.potrf(a))
+    A = _spd(3)
+    ex = out.bind(mx.cpu(), {"a": nd.array(A)})
+    (res,) = ex.forward()
+    # sum(log(diag(chol(A)))) == 0.5*logdet(A)
+    assert_almost_equal(res.asnumpy(), 0.5 * onp.linalg.slogdet(A)[1],
+                        rtol=1e-3)
+    x = nd.array(A)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.linalg.sumlogdiag(nd.linalg.potrf(x))
+    y.backward()
+    # d(0.5 logdet A)/dA = 0.5 A^{-T}; tape grad should match
+    assert_almost_equal(x.grad.asnumpy(), 0.5 * onp.linalg.inv(A).T,
+                        rtol=2e-2, atol=1e-3)
