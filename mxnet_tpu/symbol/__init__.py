@@ -101,6 +101,12 @@ class Symbol:
                     if index in s.list_outputs() or index == s._name:
                         return s
             raise ValueError(f"no output named {index}")
+        if index < 0 or index >= self._num_outputs:
+            # terminate the sequence protocol so `U, L = sym.op(...)`
+            # unpacking works on multi-output nodes
+            raise IndexError(
+                f"output index {index} out of range "
+                f"({self._num_outputs} outputs)")
         if self._num_outputs == 1 and index == 0:
             return self
         return Symbol(op=self._op, name=self._name, inputs=self._inputs,
