@@ -176,9 +176,16 @@ class Block:
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current"):
-        """Reference: gluon/block.py:472."""
+        """Reference: gluon/block.py:472. Accepts both structure-based
+        files (save_parameters) and arg:/aux:-prefixed export/Module
+        checkpoints, matching the latter by full parameter name as the
+        reference does."""
         loaded = nd.load(filename)
-        params = self._collect_params_with_prefix()
+        if loaded and all(k.startswith(("arg:", "aux:")) for k in loaded):
+            loaded = {k.split(":", 1)[1]: v for k, v in loaded.items()}
+            params = dict(self.collect_params().items())
+        else:
+            params = self._collect_params_with_prefix()
         if not allow_missing:
             for name in params.keys():
                 if name not in loaded:
@@ -389,7 +396,16 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         """Dispatch to hybrid_forward with params as kwargs
-        (reference: gluon/block.py:1127)."""
+        (reference: gluon/block.py:1127). Symbol inputs trace the block
+        through the sym namespace instead — the reference's F-dispatch
+        (gluon/block.py:1146 _call_cached_op symbol branch) that powers
+        ``export`` and ONNX."""
+        from .. import symbol as _sym
+
+        if isinstance(x, _sym.Symbol):
+            params = {name: _sym.var(param.name)
+                      for name, param in self._reg_params.items()}
+            return self.hybrid_forward(_sym, x, *args, **params)
         params = {}
         for name, param in self._reg_params.items():
             try:
@@ -414,12 +430,32 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """Reference: gluon/block.py:1077 export → symbol json + params.
-        Here: params file + a jax-jittable forward; symbol json export comes
-        with the symbolic layer."""
+    def export(self, path, epoch=0, input_names=("data",)):
+        """Write ``path-symbol.json`` (reference-format nnvm JSON, via the
+        F=sym trace) + ``path-{epoch:04d}.params`` (reference binary with
+        arg:/aux: prefixes) — full parity with reference
+        gluon/block.py:1077 export, loadable by SymbolBlock.imports, the
+        Module API, and reference-era tooling. ``input_names`` sets the
+        traced data-input variable names for multi-input blocks."""
+        from .. import symbol as _sym
+        from .. import ndarray as _nd
+
+        out = self(*[_sym.var(n) for n in input_names])
+        out.save(f"{path}-symbol.json")
+        # aux states are what the graph says they are — the stat inputs
+        # of batch_norm nodes — not "anything frozen": a weight with
+        # grad_req='null' is still a graph argument
+        aux_names = set()
+        for s in out._walk():
+            if s._op == "batch_norm" and len(s._inputs) >= 5:
+                aux_names.update(i._name for i in s._inputs[3:5]
+                                 if i._op is None)
+        payload = {}
+        for name, p in self.collect_params().items():
+            tag = "aux" if name in aux_names else "arg"
+            payload[f"{tag}:{name}"] = p.data()
         fname = f"{path}-{epoch:04d}.params"
-        self.save_parameters(fname)
+        _nd.save(fname, payload)
         return fname
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
@@ -435,6 +471,15 @@ class SymbolBlock(HybridBlock):
         super().__init__(prefix="", params=params)
         self._outputs = outputs
         self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        # every free variable of the graph that is not a declared input
+        # becomes a Parameter (reference: gluon/block.py:1246 — arg/aux
+        # inputs of the imported symbol turn into block params)
+        input_names = {i.name for i in self._inputs}
+        for s in outputs._walk():
+            if s._op is None and s._name not in input_names \
+                    and s._name not in self._reg_params:
+                self._reg_params[s._name] = self.params.get(
+                    s._name, allow_deferred_init=True)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):

@@ -37,9 +37,11 @@ class HybridConcurrent(HybridSequential):
         self.axis = axis
 
     def forward(self, x, *args):
-        from .... import nd
-        return nd.concat(*[block(x) for block in self._children.values()],
-                         dim=self.axis)
+        from .... import nd, symbol as _sym
+
+        F = _sym if isinstance(x, _sym.Symbol) else nd
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
 
 
 class Identity(HybridBlock):

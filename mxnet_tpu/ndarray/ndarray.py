@@ -526,24 +526,137 @@ def waitall():
 
 # ---- serialization (reference: ndarray.h:404-416 Save/Load; mx.nd.save) --
 
+# reference binary .params format (src/ndarray/ndarray.cc:1596-1860):
+# uint64 0x112 list magic, uint64 reserved, uint64 count, per-array
+# [uint32 version magic, int32 stype, TShape(int32 ndim + int32 dims),
+#  Context(int32 dev_type, int32 dev_id), int32 type_flag, raw LE data],
+# uint64 nkeys, per-key [uint64 len, bytes]
+_LIST_MAGIC = 0x112
+_ND_V1_MAGIC = 0xF993FAC8
+_ND_V2_MAGIC = 0xF993FAC9
+_ND_V3_MAGIC = 0xF993FACA
+# mshadow TypeFlag (3rdparty/mshadow/mshadow/base.h:307-314)
+_TYPE_FLAG_TO_DTYPE = {0: "float32", 1: "float64", 2: "float16",
+                       3: "uint8", 4: "int32", 5: "int8", 6: "int64",
+                       7: "bool"}
+_DTYPE_TO_TYPE_FLAG = {v: k for k, v in _TYPE_FLAG_TO_DTYPE.items()}
+
+
 def save(fname, data):
-    """Save list or dict of NDArrays. Uses an npz container rather than the
-    reference's magic-versioned binary (reference src/ndarray/ndarray.cc),
-    but preserves the list/dict API of mx.nd.save."""
+    """Save list or dict of NDArrays in the reference's magic-versioned
+    binary format (src/ndarray/ndarray.cc NDArray::Save + the 0x112 list
+    container), so checkpoints interoperate with reference-era tooling
+    in both directions."""
+    import struct
+
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        payload = {f"__list__:{i}": d.asnumpy() for i, d in enumerate(data)}
+        names, arrays = [], list(data)
     elif isinstance(data, dict):
-        payload = {f"__dict__:{k}": v.asnumpy() for k, v in data.items()}
+        names = [str(k) for k in data]
+        arrays = list(data.values())
     else:
         raise TypeError("save expects NDArray, list or dict")
     with open(fname, "wb") as f:
-        onp.savez(f, **payload)
+        f.write(struct.pack("<QQQ", _LIST_MAGIC, 0, len(arrays)))
+        for a in arrays:
+            arr = onp.ascontiguousarray(
+                a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a))
+            if str(arr.dtype) not in _DTYPE_TO_TYPE_FLAG:
+                # widen to the nearest LOSSLESS reference flag; float32
+                # only for sub-single floats (bfloat16/float16 variants)
+                if arr.dtype.kind == "i":
+                    arr = arr.astype("int64")
+                elif arr.dtype.kind == "u":
+                    if arr.dtype.itemsize >= 8:
+                        raise TypeError(
+                            f"cannot save dtype {arr.dtype}: no lossless "
+                            "reference type flag (max is int64)")
+                    arr = arr.astype("int64")
+                elif arr.dtype.kind == "f" and arr.dtype.itemsize <= 4:
+                    arr = arr.astype("float32")
+                else:
+                    raise TypeError(
+                        f"cannot save dtype {arr.dtype}: no reference "
+                        "type flag")
+            flag = _DTYPE_TO_TYPE_FLAG[str(arr.dtype)]
+            f.write(struct.pack("<I", _ND_V2_MAGIC))
+            f.write(struct.pack("<i", 0))  # kDefaultStorage
+            f.write(struct.pack(f"<i{arr.ndim}i", arr.ndim, *arr.shape))
+            f.write(struct.pack("<ii", 1, 0))  # Context: cpu(0)
+            f.write(struct.pack("<i", flag))
+            f.write(arr.astype(arr.dtype.newbyteorder("<")).tobytes())
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode()
+            f.write(struct.pack("<Q", len(b)) + b)
+
+
+def _load_ref_params(buf):
+    import struct
+
+    off = 16  # past list magic + reserved
+    (count,) = struct.unpack_from("<Q", buf, off)
+    off += 8
+    arrays = []
+    for _ in range(count):
+        (magic,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        if magic in (_ND_V2_MAGIC, _ND_V3_MAGIC):
+            (stype,) = struct.unpack_from("<i", buf, off)
+            off += 4
+            if stype != 0:
+                raise MXNetError("only dense NDArrays supported in "
+                                 "reference-format load")
+            (ndim,) = struct.unpack_from("<i", buf, off)
+            off += 4
+            shape = struct.unpack_from(f"<{ndim}i", buf, off)
+            off += 4 * ndim
+        elif magic == _ND_V1_MAGIC:
+            (ndim,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            shape = struct.unpack_from(f"<{ndim}I", buf, off)
+            off += 4 * ndim
+        else:
+            # oldest format: the magic word IS the ndim
+            ndim = magic
+            shape = struct.unpack_from(f"<{ndim}I", buf, off)
+            off += 4 * ndim
+        off += 8  # Context (dev_type, dev_id) — placement is ours
+        (flag,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        dtype = onp.dtype(_TYPE_FLAG_TO_DTYPE[flag])
+        n = int(onp.prod(shape)) if ndim else 1
+        arr = onp.frombuffer(buf, dtype.newbyteorder("<"), n, off)
+        off += dtype.itemsize * n
+        arrays.append(array(arr.reshape(shape).astype(dtype)))
+    (nkeys,) = struct.unpack_from("<Q", buf, off)
+    off += 8
+    names = []
+    for _ in range(nkeys):
+        (ln,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        names.append(buf[off:off + ln].decode())
+        off += ln
+    if not names:
+        return arrays
+    # reference save_checkpoint prefixes arg:/aux: — strip like mx.mod
+    return {n: a for n, a in zip(names, arrays)}
 
 
 def load(fname):
-    with onp.load(fname, allow_pickle=False) as z:
+    """Load NDArrays from the reference binary format (auto-detected) or
+    the npz container earlier versions of this package wrote."""
+    import struct
+
+    with open(fname, "rb") as f:
+        buf = f.read()
+    if len(buf) >= 8 and struct.unpack_from("<Q", buf)[0] == _LIST_MAGIC:
+        return _load_ref_params(buf)
+    import io
+
+    with onp.load(io.BytesIO(buf), allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and keys[0].startswith("__list__:"):
             items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))

@@ -225,27 +225,57 @@ class Symbol:
 
     # ---- serialization ---------------------------------------------------
     def tojson(self):
-        """Reference: symbol.py tojson (nnvm json graph)."""
-        order = [s for s in self._walk()]
-        idx = {id(s): i for i, s in enumerate(order)}
+        """Emit reference-format nnvm graph JSON (reference: symbol.py
+        tojson → nnvm/src/core/graph.cc JSON; format spec observed in
+        reference model-zoo ``*-symbol.json`` files): CamelCase legacy op
+        names where they exist, all attr values stringified MXNet-style
+        ("(3, 3)", "True"), node_row_ptr, and a version stamp. Loadable
+        by both `symbol.load` here and reference-era tooling."""
+        from ..ndarray import _CAMEL_ALIASES
+
+        # SoftmaxActivation is a LOSSY alias (different op/params in the
+        # reference) — never reverse-map onto it
+        rev = {v: k for k, v in _CAMEL_ALIASES.items()
+               if k != "SoftmaxActivation"}
+        # canonicalize: output-view Symbols (same node, different
+        # output_index) must collapse to ONE emitted node, keyed by name
+        order, idx = [], {}
+        for s in self._walk():
+            key = s._name
+            if key not in idx:
+                idx[key] = len(order)
+                order.append(s)
+
+        def attr_str(v):
+            if isinstance(v, bool):
+                return "True" if v else "False"
+            if isinstance(v, (list, tuple)):
+                return "(" + ", ".join(str(x) for x in v) + ")"
+            return str(v)
+
         nodes = []
+        row_ptr = [0]
         for s in order:
             node = {
-                "op": "null" if s._op is None else s._op,
-                "name": s._name or (s._op + str(idx[id(s)])),
-                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
-                          for k, v in s._kwargs.items()},
-                "inputs": [[idx[id(i)], i._output_index, 0]
+                "op": "null" if s._op is None else rev.get(s._op, s._op),
+                "name": s._name or (s._op + str(idx[s._name])),
+                "inputs": [[idx[i._name], i._output_index, 0]
                            for i in s._inputs],
             }
-            if s._num_outputs != 1:
-                node["num_outputs"] = s._num_outputs
+            if s._op is not None and s._kwargs:
+                node["attrs"] = {k: attr_str(v)
+                                 for k, v in s._kwargs.items()}
             nodes.append(node)
-        heads = ([[idx[id(g)], g._output_index, 0] for g in self._group]
-                 if self._group else [[idx[id(self)], self._output_index, 0]])
-        return json.dumps({"nodes": nodes, "arg_nodes":
-                           [i for i, s in enumerate(order) if s._op is None],
-                           "heads": heads, "mxnet_tpu_version": 1}, indent=2)
+            row_ptr.append(row_ptr[-1] + s._num_outputs)
+        heads = ([[idx[g._name], g._output_index, 0] for g in self._group]
+                 if self._group else [[idx[self._name],
+                                       self._output_index, 0]])
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [i for i, s in enumerate(order) if s._op is None],
+            "node_row_ptr": row_ptr,
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10500]}}, indent=2)
 
     def save(self, fname):
         with open(fname, "w") as f:
@@ -414,29 +444,84 @@ def load(fname):
         return load_json(f.read())
 
 
+def _parse_attr_value(v):
+    """Parse an MXNet-stringified attr ("(3, 3)", "True", "2", "0.9",
+    "relu") back to a python value."""
+    if not isinstance(v, str):
+        return v
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        pass
+    low = v.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    return v
+
+
 def load_json(json_str):
-    """Rebuild a Symbol DAG from tojson output."""
+    """Rebuild a Symbol DAG from tojson output — accepts both this
+    package's emission and reference-era nnvm JSON (CamelCase legacy op
+    names, stringified attrs, "attr"/"param" instead of "attrs" in very
+    old files). Reference: nnvm/src/core/graph.cc JSON load + SURVEY §7
+    step 8 checkpoint-interop requirement."""
+    import inspect
+
+    from ..ndarray import registry as _reg
+
     obj = json.loads(json_str)
     nodes = obj["nodes"]
+    legacy = "mxnet_tpu_version" in obj  # round-1/2 own-format files
     built = []
     for n in nodes:
         if n["op"] == "null":
             built.append(Variable(n["name"]))
-        else:
-            inputs = []
-            for (i, oi, _) in n["inputs"]:
-                src = built[i]
-                src = src if oi == 0 else src[oi]
-                inputs.append(src)
-            kwargs = {}
-            for k, v in n.get("attrs", {}).items():
+            continue
+        inputs = []
+        for entry in n["inputs"]:
+            i, oi = entry[0], entry[1]
+            src = built[i]
+            src = src if oi == 0 else src[oi]
+            inputs.append(src)
+        opname = n["op"]
+        attrs = n.get("attrs", n.get("attr", n.get("param", {}))) or {}
+        kwargs = {}
+        if legacy:
+            for k, v in attrs.items():
                 try:
                     kwargs[k] = json.loads(v)
                 except (json.JSONDecodeError, TypeError):
                     kwargs[k] = v
-            built.append(Symbol(op=n["op"], name=n["name"], inputs=inputs,
-                                kwargs=kwargs,
-                                num_outputs=n.get("num_outputs", 1)))
-    heads = [built[i] if oi == 0 else built[i][oi]
-             for (i, oi, _) in obj["heads"]]
+        else:
+            opdef = _reg.get_op(opname)
+            if opdef is None:
+                # legacy CamelCase name → registered snake_case op
+                from ..ndarray import _CAMEL_ALIASES
+
+                mapped = _CAMEL_ALIASES.get(opname)
+                if mapped is None or _reg.get_op(mapped) is None:
+                    raise MXNetError(
+                        f"unknown op '{opname}' in symbol JSON")
+                opname = mapped
+                opdef = _reg.get_op(opname)
+            # keep only attrs the op body understands (reference files
+            # carry backend knobs like workspace/cudnn_tune)
+            sig = inspect.signature(opdef.fn)
+            accepts_kw = any(p.kind == p.VAR_KEYWORD
+                             for p in sig.parameters.values())
+            known = set(sig.parameters)
+            for k, v in attrs.items():
+                if accepts_kw or k in known:
+                    kwargs[k] = _parse_attr_value(v)
+        built.append(Symbol(op=opname, name=n["name"], inputs=inputs,
+                            kwargs=kwargs,
+                            num_outputs=n.get(
+                                "num_outputs",
+                                _num_outputs_for(opname, kwargs))))
+    heads = [built[i] if h[1] == 0 else built[i][h[1]]
+             for h in obj["heads"] for i in [h[0]]]
     return heads[0] if len(heads) == 1 else Group(heads)
