@@ -1,3 +1,4 @@
 """mx.contrib (reference: python/mxnet/contrib/__init__.py)."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

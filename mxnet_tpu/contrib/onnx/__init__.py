@@ -1,0 +1,10 @@
+"""ONNX interchange (reference: python/mxnet/contrib/onnx/__init__.py —
+mx2onnx exporter + onnx2mx importer). Self-contained: the ONNX IR
+messages are compiled from the wire-compatible schema subset in
+``onnx.proto`` (no dependency on the onnx package)."""
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import (import_model, get_model_metadata,  # noqa: F401
+                      import_to_gluon)
+
+# reference module aliases (mx.contrib.onnx.mx2onnx / onnx2mx)
+from . import mx2onnx, onnx2mx  # noqa: F401

@@ -1,0 +1,537 @@
+"""ONNX -> Symbol importer.
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/_op_translations.py +
+import_onnx.py GraphProto. Builds a Symbol DAG + arg/aux param dicts from
+a ModelProto; aux states are the BatchNormalization mean/var inputs, as
+in the reference importer.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import onnx_pb2 as O
+from ...base import MXNetError
+
+_ONNX_TO_DTYPE = {O.TensorProto.FLOAT: "float32",
+                  O.TensorProto.DOUBLE: "float64",
+                  O.TensorProto.FLOAT16: "float16",
+                  O.TensorProto.BFLOAT16: "bfloat16",
+                  O.TensorProto.UINT8: "uint8",
+                  O.TensorProto.INT8: "int8",
+                  O.TensorProto.INT32: "int32",
+                  O.TensorProto.INT64: "int64",
+                  O.TensorProto.BOOL: "bool"}
+
+
+def _tensor_to_numpy(t):
+    dtype = _ONNX_TO_DTYPE.get(t.data_type)
+    if dtype is None:
+        raise MXNetError(f"unsupported ONNX tensor dtype {t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        arr = onp.frombuffer(t.raw_data, dtype=onp.dtype(dtype)
+                             if dtype != "bfloat16" else onp.uint16)
+        if dtype == "bfloat16":
+            arr = (arr.astype(onp.uint32) << 16).view(onp.float32)
+    elif t.float_data:
+        arr = onp.asarray(list(t.float_data), dtype=dtype)
+    elif t.int64_data:
+        arr = onp.asarray(list(t.int64_data), dtype=dtype)
+    elif t.int32_data:
+        arr = onp.asarray(list(t.int32_data), dtype=dtype)
+    elif t.double_data:
+        arr = onp.asarray(list(t.double_data), dtype=dtype)
+    else:
+        arr = onp.zeros(shape, dtype=dtype)
+    return arr.reshape(shape)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == O.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == O.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == O.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == O.AttributeProto.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+        elif a.type == O.AttributeProto.FLOATS:
+            out[a.name] = tuple(float(x) for x in a.floats)
+        elif a.type == O.AttributeProto.TENSOR:
+            out[a.name] = _tensor_to_numpy(a.t)
+        else:
+            out[a.name] = None
+    return out
+
+
+def _split_pads(pads):
+    """ONNX pads [b0,b1,...,e0,e1,...] -> symmetric mxnet pad or raise."""
+    if not pads:
+        return None
+    n = len(pads) // 2
+    before, after = pads[:n], pads[n:]
+    if tuple(before) != tuple(after):
+        raise MXNetError(f"asymmetric pads {pads} not supported")
+    return tuple(before)
+
+
+class _Importer:
+    def __init__(self, model):
+        from ... import symbol as sym
+
+        self.sym = sym
+        self.model = model
+        self.tensors = {}  # onnx tensor name -> Symbol
+        self.params = {}  # name -> numpy (initializers)
+        self.aux_names = set()
+
+    def const_value(self, name):
+        """Numpy value of an initializer-backed tensor (for Reshape
+        shapes, Clip bounds, ...)."""
+        if name in self.params:
+            return self.params[name]
+        raise MXNetError(f"expected constant input '{name}'")
+
+    def run(self):
+        g = self.model.graph
+        for t in g.initializer:
+            self.params[t.name] = _tensor_to_numpy(t)
+        for vi in g.input:
+            if vi.name not in self.params:
+                self.tensors[vi.name] = self.sym.var(vi.name)
+        for node in g.node:
+            fn = ONNX2MX_OPS.get(node.op_type)
+            if fn is None:
+                raise MXNetError(
+                    f"ONNX op '{node.op_type}' has no import translation")
+            fn(self, node, _attrs(node))
+        outs = [self.tensors[o.name] for o in g.output]
+        out = outs[0] if len(outs) == 1 else self.sym.Group(outs)
+        from ... import ndarray as nd
+
+        args, aux = {}, {}
+        # params consumed as graph tensors become Variables lazily; only
+        # those referenced by the built graph are returned
+        used = {s._name for s in out._walk() if s._op is None}
+        for name, arr in self.params.items():
+            if name not in used:
+                continue
+            dst = aux if name in self.aux_names else args
+            dst[name] = nd.array(arr)
+        return out, args, aux
+
+    def inp(self, name):
+        """Symbol for a tensor name; initializers materialize as vars."""
+        if name in self.tensors:
+            return self.tensors[name]
+        if name in self.params:
+            v = self.sym.var(name)
+            self.tensors[name] = v
+            return v
+        raise MXNetError(f"undefined tensor '{name}'")
+
+
+ONNX2MX_OPS = {}
+
+
+def register_import(*ops):
+    def deco(fn):
+        for n in ops:
+            ONNX2MX_OPS[n] = fn
+        return fn
+
+    return deco
+
+
+def _set(ctx, node, symbol):
+    ctx.tensors[node.output[0]] = symbol
+
+
+@register_import("Conv")
+def _conv(ctx, node, attrs):
+    ins = [ctx.inp(n) for n in node.input]
+    w = ctx.const_value(node.input[1])
+    ctx.tensors[node.output[0]] = ctx.sym.convolution(
+        *ins,
+        kernel=tuple(attrs.get("kernel_shape") or w.shape[2:]),
+        stride=tuple(attrs.get("strides") or ()) or None,
+        dilate=tuple(attrs.get("dilations") or ()) or None,
+        pad=_split_pads(attrs.get("pads")),
+        num_filter=int(w.shape[0]),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(node.input) < 3,
+        name=node.name or node.output[0])
+
+
+@register_import("ConvTranspose")
+def _deconv(ctx, node, attrs):
+    ins = [ctx.inp(n) for n in node.input]
+    w = ctx.const_value(node.input[1])
+    ctx.tensors[node.output[0]] = ctx.sym.deconvolution(
+        *ins,
+        kernel=tuple(attrs.get("kernel_shape") or w.shape[2:]),
+        stride=tuple(attrs.get("strides") or ()) or None,
+        dilate=tuple(attrs.get("dilations") or ()) or None,
+        pad=_split_pads(attrs.get("pads")),
+        num_filter=int(w.shape[1]) * int(attrs.get("group", 1)),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(node.input) < 3,
+        name=node.name or node.output[0])
+
+
+@register_import("BatchNormalization")
+def _bn(ctx, node, attrs):
+    ins = [ctx.inp(n) for n in node.input[:5]]
+    ctx.aux_names.update(node.input[3:5])
+    # ONNX BatchNormalization (single output) is inference mode: always
+    # normalize with the running statistics, never batch stats
+    _set(ctx, node, ctx.sym.batch_norm(
+        *ins, eps=float(attrs.get("epsilon", 1e-5)),
+        momentum=float(attrs.get("momentum", 0.9)),
+        fix_gamma=False, use_global_stats=True, use_batch_stats=False,
+        name=node.name or node.output[0]))
+
+
+@register_import("Gemm")
+def _gemm(ctx, node, attrs):
+    if attrs.get("alpha", 1.0) != 1.0 or attrs.get("beta", 1.0) != 1.0 \
+            or attrs.get("transA", 0):
+        raise MXNetError("Gemm with alpha/beta/transA != defaults "
+                         "not supported")
+    w = ctx.const_value(node.input[1])
+    if not attrs.get("transB", 0):
+        # mxnet FC weight is (num_hidden, in); rewrite the initializer
+        w = onp.ascontiguousarray(w.T)
+        ctx.params[node.input[1]] = w
+    ins = [ctx.inp(n) for n in node.input]
+    _set(ctx, node, ctx.sym.fully_connected(
+        *ins, num_hidden=int(w.shape[0]), no_bias=len(node.input) < 3,
+        flatten=False, name=node.name or node.output[0]))
+
+
+@register_import("MatMul")
+def _matmul(ctx, node, attrs):
+    a, b = (ctx.inp(n) for n in node.input[:2])
+    _set(ctx, node, ctx.sym.dot(a, b, name=node.name or node.output[0]))
+
+
+@register_import("MaxPool", "AveragePool")
+def _pool(ctx, node, attrs):
+    x = ctx.inp(node.input[0])
+    kwargs = dict(
+        kernel=tuple(attrs.get("kernel_shape") or ()),
+        stride=tuple(attrs.get("strides") or ()) or None,
+        pad=_split_pads(attrs.get("pads")),
+        pool_type="max" if node.op_type == "MaxPool" else "avg",
+        pooling_convention="full" if attrs.get("ceil_mode") else "valid")
+    if node.op_type == "AveragePool":
+        kwargs["count_include_pad"] = bool(
+            attrs.get("count_include_pad", 1))
+    _set(ctx, node, ctx.sym.pooling(
+        x, name=node.name or node.output[0], **kwargs))
+
+
+@register_import("GlobalMaxPool", "GlobalAveragePool")
+def _gpool(ctx, node, attrs):
+    x = ctx.inp(node.input[0])
+    _set(ctx, node, ctx.sym.pooling(
+        x, global_pool=True,
+        pool_type="max" if "Max" in node.op_type else "avg",
+        name=node.name or node.output[0]))
+
+
+for _onnx, _act in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                    ("Tanh", "tanh"), ("Softplus", "softrelu"),
+                    ("Softsign", "softsign")]:
+    def _mk_act(act):
+        def tr(ctx, node, attrs):
+            _set(ctx, node, ctx.sym.activation(
+                ctx.inp(node.input[0]), act_type=act,
+                name=node.name or node.output[0]))
+        return tr
+    register_import(_onnx)(_mk_act(_act))
+
+
+@register_import("LeakyRelu")
+def _leaky(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.leaky_relu(
+        ctx.inp(node.input[0]), act_type="leaky",
+        slope=float(attrs.get("alpha", 0.01)),
+        name=node.name or node.output[0]))
+
+
+@register_import("Elu")
+def _elu(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.leaky_relu(
+        ctx.inp(node.input[0]), act_type="elu",
+        slope=float(attrs.get("alpha", 1.0)),
+        name=node.name or node.output[0]))
+
+
+@register_import("Selu")
+def _selu(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.leaky_relu(
+        ctx.inp(node.input[0]), act_type="selu",
+        name=node.name or node.output[0]))
+
+
+@register_import("PRelu")
+def _prelu(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.leaky_relu(
+        ctx.inp(node.input[0]), ctx.inp(node.input[1]), act_type="prelu",
+        name=node.name or node.output[0]))
+
+
+@register_import("Flatten")
+def _flatten(ctx, node, attrs):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError("Flatten axis != 1 not supported")
+    _set(ctx, node, ctx.sym.flatten(ctx.inp(node.input[0]),
+                                    name=node.name or node.output[0]))
+
+
+@register_import("Concat")
+def _concat(ctx, node, attrs):
+    ins = [ctx.inp(n) for n in node.input]
+    _set(ctx, node, ctx.sym.concat(*ins, dim=int(attrs.get("axis", 1)),
+                                   name=node.name or node.output[0]))
+
+
+@register_import("Dropout")
+def _dropout(ctx, node, attrs):
+    p = attrs.get("ratio", 0.5)
+    if len(node.input) > 1:
+        p = float(onp.asarray(ctx.const_value(node.input[1])).reshape(()))
+    _set(ctx, node, ctx.sym.dropout(ctx.inp(node.input[0]), p=p,
+                                    name=node.name or node.output[0]))
+
+
+@register_import("Softmax")
+def _softmax(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.softmax(
+        ctx.inp(node.input[0]), axis=int(attrs.get("axis", -1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("LogSoftmax")
+def _log_softmax(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.log_softmax(
+        ctx.inp(node.input[0]), axis=int(attrs.get("axis", -1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("Clip")
+def _clip(ctx, node, attrs):
+    lo, hi = attrs.get("min"), attrs.get("max")
+    if len(node.input) > 1 and node.input[1]:
+        lo = float(ctx.const_value(node.input[1]))
+    if len(node.input) > 2 and node.input[2]:
+        hi = float(ctx.const_value(node.input[2]))
+    _set(ctx, node, ctx.sym.clip(
+        ctx.inp(node.input[0]),
+        a_min=lo if lo is not None else -3.4e38,
+        a_max=hi if hi is not None else 3.4e38,
+        name=node.name or node.output[0]))
+
+
+@register_import("Reshape")
+def _reshape(ctx, node, attrs):
+    shape = attrs.get("shape")
+    if shape is None:
+        shape = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    _set(ctx, node, ctx.sym.reshape(ctx.inp(node.input[0]),
+                                    shape=tuple(shape),
+                                    name=node.name or node.output[0]))
+
+
+@register_import("Transpose")
+def _transpose(ctx, node, attrs):
+    perm = attrs.get("perm")
+    _set(ctx, node, ctx.sym.transpose(
+        ctx.inp(node.input[0]),
+        axes=tuple(perm) if perm else None,
+        name=node.name or node.output[0]))
+
+
+@register_import("Unsqueeze")
+def _unsqueeze(ctx, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None:
+        axes = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    out = ctx.inp(node.input[0])
+    for ax in sorted(axes):
+        out = ctx.sym.expand_dims(out, axis=int(ax))
+    ctx.tensors[node.output[0]] = out
+
+
+@register_import("Squeeze")
+def _squeeze(ctx, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    _set(ctx, node, ctx.sym.squeeze(
+        ctx.inp(node.input[0]),
+        axis=tuple(axes) if axes else None,
+        name=node.name or node.output[0]))
+
+
+@register_import("Identity")
+def _identity(ctx, node, attrs):
+    ctx.tensors[node.output[0]] = ctx.inp(node.input[0])
+
+
+_SCALAR_FOLD = {"broadcast_add": "broadcast_add_scalar",
+                "broadcast_sub": "broadcast_sub_scalar",
+                "broadcast_mul": "broadcast_mul_scalar",
+                "broadcast_div": "broadcast_div_scalar",
+                "broadcast_power": "broadcast_power_scalar",
+                "broadcast_maximum": "maximum_scalar",
+                "broadcast_minimum": "minimum_scalar"}
+
+
+for _onnx, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                   ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                   ("Pow", "broadcast_power"),
+                   ("Max", "broadcast_maximum"),
+                   ("Min", "broadcast_minimum")]:
+    def _mk_bin(mxop):
+        def tr(ctx, node, attrs):
+            n0, n1 = node.input[:2]
+
+            def scalar_const(n):
+                """Fold initializer scalars back into op attrs — keeps
+                re-imported graphs free of synthetic one-element params
+                (reference onnx2mx does the same for broadcast ops)."""
+                if n in ctx.params and n not in ctx.tensors:
+                    v = onp.asarray(ctx.params[n])
+                    if v.size == 1:
+                        return float(v.reshape(()))
+                return None
+
+            sc = scalar_const(n1)
+            scalar_op = _SCALAR_FOLD[mxop]
+            if sc is not None:
+                _set(ctx, node, getattr(ctx.sym, scalar_op)(
+                    ctx.inp(n0), scalar=sc,
+                    name=node.name or node.output[0]))
+                return
+            sc = scalar_const(n0)
+            if sc is not None:
+                _set(ctx, node, getattr(ctx.sym, scalar_op)(
+                    ctx.inp(n1), scalar=sc, reverse=True,
+                    name=node.name or node.output[0]))
+                return
+            a, b = ctx.inp(n0), ctx.inp(n1)
+            _set(ctx, node, getattr(ctx.sym, mxop)(
+                a, b, name=node.name or node.output[0]))
+        return tr
+    register_import(_onnx)(_mk_bin(_mx))
+
+
+for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                   ("Abs", "abs"), ("Neg", "negative"),
+                   ("Floor", "floor"), ("Ceil", "ceil"), ("Erf", "erf")]:
+    def _mk_un(mxop):
+        def tr(ctx, node, attrs):
+            _set(ctx, node, getattr(ctx.sym, mxop)(
+                ctx.inp(node.input[0]), name=node.name or node.output[0]))
+        return tr
+    register_import(_onnx)(_mk_un(_mx))
+
+
+def _mk_reduce(mxop):
+    def tr(ctx, node, attrs):
+        axes = attrs.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = tuple(int(x) for x in ctx.const_value(node.input[1]))
+        _set(ctx, node, getattr(ctx.sym, mxop)(
+            ctx.inp(node.input[0]),
+            axis=tuple(axes) if axes is not None else None,
+            keepdims=bool(attrs.get("keepdims", 1)),
+            name=node.name or node.output[0]))
+    return tr
+
+
+register_import("ReduceMean")(_mk_reduce("mean"))
+register_import("ReduceSum")(_mk_reduce("sum"))
+register_import("ReduceMax")(_mk_reduce("max"))
+register_import("ReduceMin")(_mk_reduce("min"))
+register_import("ReduceProd")(_mk_reduce("prod"))
+
+
+@register_import("Sum")
+def _sum_n(ctx, node, attrs):
+    ins = [ctx.inp(n) for n in node.input]
+    _set(ctx, node, ctx.sym.add_n(*ins, name=node.name or node.output[0]))
+
+
+@register_import("LRN")
+def _lrn(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.lrn(
+        ctx.inp(node.input[0]), alpha=float(attrs.get("alpha", 1e-4)),
+        beta=float(attrs.get("beta", 0.75)),
+        knorm=float(attrs.get("bias", 2.0)),
+        nsize=int(attrs.get("size", 5)),
+        name=node.name or node.output[0]))
+
+
+@register_import("Pad")
+def _pad(ctx, node, attrs):
+    pads = attrs.get("pads")
+    if pads is None:
+        pads = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    n = len(pads) // 2
+    width = []
+    for i in range(n):
+        width += [pads[i], pads[n + i]]
+    _set(ctx, node, ctx.sym.pad(
+        ctx.inp(node.input[0]), mode=attrs.get("mode", "constant"),
+        pad_width=tuple(width), name=node.name or node.output[0]))
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params).
+
+    Reference API: python/mxnet/contrib/onnx/onnx2mx/import_model.py."""
+    with open(model_file, "rb") as f:
+        model = O.ModelProto.FromString(f.read())
+    return _Importer(model).run()
+
+
+def get_model_metadata(model_file):
+    """Reference: import_model.py get_model_metadata."""
+    with open(model_file, "rb") as f:
+        model = O.ModelProto.FromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def shapes(vis):
+        out = []
+        for vi in vis:
+            if vi.name in inits:
+                continue
+            dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": shapes(g.input),
+            "output_tensor_data": shapes(g.output)}
+
+
+def import_to_gluon(model_file, ctx=None):
+    """Reference: contrib/onnx/onnx2mx/import_to_gluon.py."""
+    from ...gluon import SymbolBlock
+    from ... import symbol as _sym
+
+    sym, args, aux = import_model(model_file)
+    meta = get_model_metadata(model_file)
+    inputs = [_sym.var(n) for n, _ in meta["input_tensor_data"]]
+    net = SymbolBlock(sym, inputs)
+    for name, p in net.collect_params().items():
+        if name in args:
+            p._load_init_from(args[name])
+        elif name in aux:
+            p._load_init_from(aux[name])
+    return net

@@ -476,7 +476,8 @@ class SymbolBlock(HybridBlock):
         # inputs of the imported symbol turn into block params)
         input_names = {i.name for i in self._inputs}
         for s in outputs._walk():
-            if s._op is None and s._name not in input_names \
+            if s._op is None and not s._group \
+                    and s._name not in input_names \
                     and s._name not in self._reg_params:
                 self._reg_params[s._name] = self.params.get(
                     s._name, allow_deferred_init=True)

@@ -566,7 +566,9 @@ def save(fname, data):
             if str(arr.dtype) not in _DTYPE_TO_TYPE_FLAG:
                 # widen to the nearest LOSSLESS reference flag; float32
                 # only for sub-single floats (bfloat16/float16 variants)
-                if arr.dtype.kind == "i":
+                if str(arr.dtype) == "bfloat16":  # ml_dtypes kind is 'V'
+                    arr = arr.astype("float32")
+                elif arr.dtype.kind == "i":
                     arr = arr.astype("int64")
                 elif arr.dtype.kind == "u":
                     if arr.dtype.itemsize >= 8:
@@ -586,7 +588,9 @@ def save(fname, data):
             f.write(struct.pack(f"<i{arr.ndim}i", arr.ndim, *arr.shape))
             f.write(struct.pack("<ii", 1, 0))  # Context: cpu(0)
             f.write(struct.pack("<i", flag))
-            f.write(arr.astype(arr.dtype.newbyteorder("<")).tobytes())
+            if arr.dtype.byteorder == ">":
+                arr = arr.byteswap().view(arr.dtype.newbyteorder("<"))
+            f.write(arr.tobytes())
         f.write(struct.pack("<Q", len(names)))
         for n in names:
             b = n.encode()

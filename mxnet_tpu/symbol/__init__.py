@@ -241,6 +241,8 @@ class Symbol:
         # output_index) must collapse to ONE emitted node, keyed by name
         order, idx = [], {}
         for s in self._walk():
+            if s._group:  # Group wrapper is not a graph node
+                continue
             key = s._name
             if key not in idx:
                 idx[key] = len(order)

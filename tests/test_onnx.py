@@ -1,0 +1,128 @@
+"""ONNX export/import round-trips.
+
+Reference test model: tests/python-pytest/onnx/test_models.py — export a
+model, re-import, compare logits exactly (same params round-tripped
+through the ONNX file).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.gluon.model_zoo import vision
+
+rs = onp.random.RandomState(7)
+
+
+def _roundtrip_block(net, shape, tmp_path, rtol=1e-4, atol=1e-4):
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.rand(*shape).astype("f"))
+    ref = net(x)
+    prefix = str(tmp_path / "m")
+    net.export(prefix, epoch=0)
+    onnx_file = onnx_mxnet.export_model(
+        prefix + "-symbol.json", prefix + "-0000.params", shape,
+        onnx_file_path=str(tmp_path / "m.onnx"))
+    assert os.path.getsize(onnx_file) > 0
+    s, args, aux = onnx_mxnet.import_model(onnx_file)
+    feed = {"data": x}
+    feed.update(args)
+    feed.update(aux)
+    ex = s.bind(mx.cpu(), feed)
+    (out,) = ex.forward()
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=rtol, atol=atol)
+    return onnx_file
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    (vision.resnet18_v1, (1, 3, 224, 224)),
+    (vision.resnet18_v2, (1, 3, 224, 224)),
+    (vision.mobilenet_v2_0_25, (1, 3, 224, 224)),
+    (vision.mobilenet0_25, (1, 3, 224, 224)),
+    (vision.squeezenet1_0, (1, 3, 224, 224)),
+    (vision.densenet121, (1, 3, 224, 224)),
+    (vision.vgg11_bn, (1, 3, 224, 224)),
+    (vision.alexnet, (1, 3, 224, 224)),
+    (vision.inception_v3, (1, 3, 299, 299)),
+])
+def test_zoo_family_onnx_roundtrip(ctor, shape, tmp_path):
+    _roundtrip_block(ctor(classes=10), shape, tmp_path)
+
+
+def test_onnx_metadata(tmp_path):
+    net = vision.squeezenet1_0(classes=10)
+    f = _roundtrip_block(net, (2, 3, 224, 224), tmp_path)
+    meta = onnx_mxnet.get_model_metadata(f)
+    assert meta["input_tensor_data"] == [("data", (2, 3, 224, 224))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_import_to_gluon(tmp_path):
+    net = vision.mobilenet0_25(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.rand(1, 3, 224, 224).astype("f"))
+    ref = net(x)
+    prefix = str(tmp_path / "g")
+    net.export(prefix)
+    f = onnx_mxnet.export_model(
+        prefix + "-symbol.json", prefix + "-0000.params",
+        (1, 3, 224, 224), onnx_file_path=str(tmp_path / "g.onnx"))
+    net2 = onnx_mxnet.import_to_gluon(f)
+    out = net2(x)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_mlp_ops(tmp_path):
+    """Dense/softmax/dropout/reshape path without conv."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dropout(0.2), nn.Dense(4))
+    _roundtrip_block(net, (3, 8), tmp_path)
+
+
+def test_onnx_reduce_gelu_group(tmp_path):
+    """Reduce ops (opset-13 attr/input forms), gelu decomposition, and
+    multi-output Group export."""
+    a = sym.Variable("data")
+    m = sym.mean(a, axis=1, keepdims=True)
+    s = sym.sum(a, axis=0)
+    g = sym.leaky_relu(a, act_type="gelu")
+    out = sym.Group([m, s, g])
+    A = rs.rand(3, 5).astype("f")
+    f = onnx_mxnet.export_model(out, {}, (3, 5),
+                                onnx_file_path=str(tmp_path / "r.onnx"))
+    s2, args, aux = onnx_mxnet.import_model(f)
+    ex = s2.bind(mx.cpu(), {"data": nd.array(A)})
+    rm, rsum, rg = ex.forward()
+    onp.testing.assert_allclose(rm.asnumpy(), A.mean(1, keepdims=True),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(rsum.asnumpy(), A.sum(0), rtol=1e-5,
+                                atol=1e-6)
+    import math
+
+    erf = onp.array([[math.erf(v / 2 ** 0.5) for v in row] for row in A],
+                    "f")
+    onp.testing.assert_allclose(rg.asnumpy(), 0.5 * A * (1 + erf),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_symbol_level_ops(tmp_path):
+    """Hand-built symbol covering scalar/broadcast/reduce translations."""
+    a = sym.Variable("data")
+    out = sym.broadcast_add(sym.transpose(a * 2.0 + 1.0), a * 1.0)
+    out = sym.reshape(out, shape=(-1,))
+    A = rs.rand(4, 4).astype("f")
+    ref = (A.T * 2 + 1 + A).reshape(-1)
+    f = onnx_mxnet.export_model(out, {}, (4, 4),
+                                onnx_file_path=str(tmp_path / "s.onnx"))
+    s, args, aux = onnx_mxnet.import_model(f)
+    ex = s.bind(mx.cpu(), {"data": nd.array(A)})
+    (res,) = ex.forward()
+    onp.testing.assert_allclose(res.asnumpy(), ref, rtol=1e-5, atol=1e-5)
