@@ -47,6 +47,7 @@ from . import module as mod
 from . import model
 from . import callback
 from . import recordio
+from . import image  # noqa: F401
 from . import tools  # noqa: F401
 from . import contrib  # noqa: F401
 from . import profiler  # noqa: F401

@@ -326,9 +326,9 @@ def _log_softmax(ctx, node, attrs):
 def _clip(ctx, node, attrs):
     lo, hi = attrs.get("min"), attrs.get("max")
     if len(node.input) > 1 and node.input[1]:
-        lo = float(ctx.const_value(node.input[1]))
+        lo = float(onp.asarray(ctx.const_value(node.input[1])).reshape(()))
     if len(node.input) > 2 and node.input[2]:
-        hi = float(ctx.const_value(node.input[2]))
+        hi = float(onp.asarray(ctx.const_value(node.input[2])).reshape(()))
     _set(ctx, node, ctx.sym.clip(
         ctx.inp(node.input[0]),
         a_min=lo if lo is not None else -3.4e38,

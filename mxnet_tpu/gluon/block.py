@@ -186,6 +186,12 @@ class Block:
             params = dict(self.collect_params().items())
         else:
             params = self._collect_params_with_prefix()
+            if loaded and not any(k in params for k in loaded):
+                # reference-era zoo checkpoints use full parameter names
+                # ("resnetv10_conv0_weight"), not structure paths
+                by_name = dict(self.collect_params().items())
+                if any(k in by_name for k in loaded):
+                    params = by_name
         if not allow_missing:
             for name in params.keys():
                 if name not in loaded:

@@ -42,5 +42,7 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file("alexnet", root=root))
     return net

@@ -90,7 +90,10 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weight download not wired yet")
+        from ..model_store import get_model_file
+
+        net.load_parameters(
+            get_model_file(f"densenet{num_layers}", root=root))
     return net
 
 

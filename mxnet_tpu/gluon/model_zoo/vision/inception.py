@@ -161,5 +161,7 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weight download not wired yet")
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file("inceptionv3", root=root))
     return net

@@ -134,7 +134,11 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
                   **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weight download not wired yet")
+        from ..model_store import get_model_file
+
+        ver = str(float(multiplier))
+        net.load_parameters(
+            get_model_file(f"mobilenet{ver}", root=root))
     return net
 
 
@@ -142,7 +146,11 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weight download not wired yet")
+        from ..model_store import get_model_file
+
+        ver = str(float(multiplier))
+        net.load_parameters(
+            get_model_file(f"mobilenetv2_{ver}", root=root))
     return net
 
 

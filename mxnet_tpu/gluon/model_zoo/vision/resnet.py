@@ -255,8 +255,10 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress); "
-                           "use load_parameters with a local file")
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file(
+            f"resnet{num_layers}_v{version}", root=root))
     return net
 
 

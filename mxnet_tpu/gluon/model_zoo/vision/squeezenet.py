@@ -85,9 +85,21 @@ class SqueezeNet(HybridBlock):
         return self.output(x)
 
 
+def get_squeezenet(version, pretrained=False, ctx=None, root=None,
+                   **kwargs):
+    """Reference: squeezenet.py get_squeezenet."""
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+
+        net.load_parameters(
+            get_model_file(f"squeezenet{version}", root=root))
+    return net
+
+
 def squeezenet1_0(**kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    return get_squeezenet("1.0", **kwargs)
 
 
 def squeezenet1_1(**kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    return get_squeezenet("1.1", **kwargs)

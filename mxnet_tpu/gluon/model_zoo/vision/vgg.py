@@ -60,7 +60,11 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weight download not wired yet")
+        from ..model_store import get_model_file
+
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_parameters(
+            get_model_file(f"vgg{num_layers}{bn}", root=root))
     return net
 
 

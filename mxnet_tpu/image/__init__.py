@@ -1,0 +1,4 @@
+"""mx.image namespace (reference: python/mxnet/image/__init__.py)."""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import image, detection  # noqa: F401

@@ -1,0 +1,334 @@
+"""Detection image pipeline: box-aware augmenters + ImageDetIter.
+
+Reference: python/mxnet/image/detection.py (1009 LoC). Labels use the
+reference's packed format: [header_width, object_width, extra..., then
+per-object (id, xmin, ymin, xmax, ymax, ...)] with coordinates
+normalized to [0, 1].
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..io.io import DataBatch, DataDesc
+from .image import (ImageIter, Augmenter, imresize, fixed_crop,
+                    HorizontalFlipAug, CastAug, ColorNormalizeAug,
+                    ColorJitterAug, HueJitterAug, RandomGrayAug,
+                    ForceResizeAug, _to_numpy)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Reference: detection.py:DetAugmenter — operates on (img, label)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter (reference: detection.py:112)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates (reference: detection.py:131)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd.array(_to_numpy(src)[:, ::-1].copy())
+            label = label.copy()
+            tmp = 1.0 - label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+def _box_iou(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) \
+        - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference: detection.py:164)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        arr = _to_numpy(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range) * h * w
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = int(round((area * ratio) ** 0.5))
+            ch = int(round((area / ratio) ** 0.5))
+            if cw > w or ch > h or cw <= 0 or ch <= 0:
+                continue
+            x0 = pyrandom.randint(0, w - cw)
+            y0 = pyrandom.randint(0, h - ch)
+            crop = (x0 / w, y0 / h, (x0 + cw) / w, (y0 + ch) / h)
+            new_label = self._update_labels(label, crop)
+            if new_label is None:
+                continue
+            out = fixed_crop(arr, x0, y0, cw, ch)
+            return out, new_label
+        return src, label
+
+    def _update_labels(self, label, crop):
+        cx0, cy0, cx1, cy1 = crop
+        cw, chh = cx1 - cx0, cy1 - cy0
+        out = []
+        covered = False
+        for row in label:
+            box = row[1:5]
+            inter = (max(box[0], cx0), max(box[1], cy0),
+                     min(box[2], cx1), min(box[3], cy1))
+            if inter[2] <= inter[0] or inter[3] <= inter[1]:
+                continue
+            barea = (box[2] - box[0]) * (box[3] - box[1])
+            carea = (inter[2] - inter[0]) * (inter[3] - inter[1])
+            coverage = carea / barea if barea > 0 else 0
+            if coverage < self.min_eject_coverage:
+                continue
+            if coverage >= self.min_object_covered:
+                covered = True
+            new_row = row.copy()
+            new_row[1] = (inter[0] - cx0) / cw
+            new_row[2] = (inter[1] - cy0) / chh
+            new_row[3] = (inter[2] - cx0) / cw
+            new_row[4] = (inter[3] - cy0) / chh
+            out.append(new_row)
+        if not out or not covered:
+            return None
+        return onp.stack(out)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-pad (reference: detection.py:308)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _to_numpy(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(round((scale * h * w * ratio) ** 0.5))
+            nh = int(round((scale * h * w / ratio) ** 0.5))
+            if nw < w or nh < h:
+                continue
+            x0 = pyrandom.randint(0, nw - w)
+            y0 = pyrandom.randint(0, nh - h)
+            canvas = onp.empty((nh, nw, 3), arr.dtype)
+            canvas[:] = onp.asarray(self.pad_val, arr.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = arr
+            new_label = label.copy()
+            new_label[:, 1] = (label[:, 1] * w + x0) / nw
+            new_label[:, 2] = (label[:, 2] * h + y0) / nh
+            new_label[:, 3] = (label[:, 3] * w + x0) / nw
+            new_label[:, 4] = (label[:, 4] * h + y0) / nh
+            return nd.array(canvas), new_label
+        return src, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one sub-augmenter (reference: detection.py:274)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, hue=0,
+                       pca_noise=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Reference: detection.py:CreateDetAugmenter (same knobs/order)."""
+    auglist = []
+    if resize > 0:
+        from .image import ResizeAug
+
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(1.0, area_range[0]), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference: detection.py:ImageDetIter).
+
+    Labels come from the record header (reference pack_det format) or
+    the imglist; emitted as (batch, max_objects, object_width)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "hue", "pca_noise",
+                         "inter_method", "min_object_covered",
+                         "aspect_ratio_range", "area_range",
+                         "min_eject_coverage", "max_attempts", "pad_val")})
+        super().__init__(batch_size, data_shape, label_width=-1,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         path_imgidx=path_imgidx, shuffle=shuffle,
+                         aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name)
+        self.det_auglist = aug_list
+        self.max_objects, self.obj_width = self._infer_label_shape()
+
+    def _parse_label(self, label):
+        """Packed header label -> (num_obj, obj_width) array
+        (reference: detection.py:_parse_label)."""
+        raw = onp.asarray(label, "float32").reshape(-1)
+        if raw.size < 2:
+            raise MXNetError(f"label too short: {raw}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        body = raw[header_width:]
+        nobj = body.size // obj_width
+        return body[:nobj * obj_width].reshape(nobj, obj_width)
+
+    def _infer_label_shape(self):
+        pos = self.cur
+        maxo, width = 0, 5
+        n = 0
+        while n < 200:
+            try:
+                lab, _ = self.next_sample()
+            except StopIteration:
+                break
+            parsed = self._parse_label(lab)
+            maxo = max(maxo, parsed.shape[0])
+            width = parsed.shape[1]
+            n += 1
+        self.cur = pos
+        self.reset()
+        return max(maxo, 1), width
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name,
+                         (self.batch_size, self.max_objects,
+                          self.obj_width))]
+
+    def next(self):
+        from .image import imdecode
+
+        H, W = self.data_shape[1], self.data_shape[2]
+        data = onp.zeros((self.batch_size, H, W, 3), "float32")
+        labels = onp.full((self.batch_size, self.max_objects,
+                           self.obj_width), -1.0, "float32")
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                lab, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            arr = imdecode(img)
+            parsed = self._parse_label(lab)
+            for aug in self.det_auglist:
+                arr, parsed = aug(arr, parsed)
+            a = _to_numpy(arr)
+            if a.shape[:2] != (H, W):
+                a = _to_numpy(imresize(a, W, H))
+            data[i] = a.astype("float32")
+            nobj = min(parsed.shape[0], self.max_objects)
+            labels[i, :nobj] = parsed[:nobj]
+            i += 1
+        batch_data = nd.array(onp.transpose(data, (0, 3, 1, 2)))
+        return DataBatch([batch_data], [nd.array(labels)], pad=pad)

@@ -32,11 +32,21 @@ class KVStore:
     """Reference: include/mxnet/kvstore.h:59-438."""
 
     def __init__(self, kv_type="local"):
+        import os
+
         self._type = kv_type
         self._store = {}
         self._updater = None
         self._optimizer = None
         self._update_on_kvstore = True
+        self._compression = None
+        self._residuals = {}  # (key, source_idx) -> residual state
+        # keys bigger than this are stored row-sharded across the local
+        # device group (the analog of splitting big arrays across
+        # ps-lite servers, reference kvstore_dist.h
+        # MXNET_KVSTORE_BIGARRAY_BOUND)
+        self._bigarray_bound = int(
+            os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
 
     @property
     def type(self):
@@ -82,42 +92,116 @@ class KVStore:
                 continue
             if isinstance(v, (list, tuple)):
                 v = v[0]
-            self._store[k] = v.copy()
+            v = v.copy()
+            self._store[k] = v
+            self._maybe_shard(k)
+
+    def _maybe_shard(self, k):
+        """Row-shard big dense values across the local device group
+        (reference: kvstore_dist.h big-array server split)."""
+        from .ndarray import sparse as _sp
+
+        v = self._store[k]
+        if isinstance(v, _sp.BaseSparseNDArray) or not self._type.startswith(
+                "dist"):
+            return
+        if v.size < self._bigarray_bound or v.ndim == 0:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .parallel import make_mesh
+
+        ndev = jax.local_device_count()
+        if ndev <= 1 or v.shape[0] % ndev != 0:
+            return
+        mesh = make_mesh({"kvshard": ndev}, devices=jax.local_devices())
+        v._data = jax.device_put(v.data,
+                                 NamedSharding(mesh, P("kvshard")))
+
+    def _compress(self, k, idx, grad):
+        """Quantize+dequantize one source's gradient through the 2-bit
+        wire format with its error-feedback residual (reference:
+        kvstore_dist.h PushCompressed — workers send the quantized
+        tensor, the server dequantizes before aggregation)."""
+        import jax.numpy as jnp
+
+        flat = grad.data.reshape(-1).astype(jnp.float32)
+        res = self._residuals.get((k, idx))
+        if res is None:
+            res = jnp.zeros_like(flat)
+        packed, new_res = self._compression.quantize(flat, res)
+        self._residuals[(k, idx)] = new_res
+        deq = self._compression.dequantize(packed, flat.shape[0])
+        return NDArray(deq.reshape(grad.shape).astype(grad.data.dtype))
 
     def push(self, key, value, priority=0):
         """Aggregate (sum over the device group) then apply updater if set
-        (reference: kvstore_local.h:206 PushImpl → Comm reduce → updater_)."""
+        (reference: kvstore_local.h:206 PushImpl → Comm reduce → updater_).
+        A LIST value (one gradient per device) reduces in ONE compiled XLA
+        all-reduce over the device group when the values live on distinct
+        devices — the CommDevice/NCCL path — with serial adds as the
+        same-device fallback."""
         from .ndarray import sparse as _sp
 
         keys, values, _ = self._normalize(key, value)
         for k, v in zip(keys, values):
             k = str(k)
             if isinstance(v, (list, tuple)):
-                agg = v[0]
-                for x in v[1:]:
-                    # sparse grads reduce sparse (reference: comm.h:478
-                    # row-sparse reduce path)
-                    if isinstance(agg, _sp.BaseSparseNDArray) or \
-                            isinstance(x, _sp.BaseSparseNDArray):
-                        agg = _sp.elemwise_add(agg, x)
-                    else:
-                        agg = agg + x
+                vs = list(v)
+                if self._compression is not None and not any(
+                        isinstance(x, _sp.BaseSparseNDArray) for x in vs):
+                    vs = [self._compress(k, i, x)
+                          for i, x in enumerate(vs)]
+                agg = None
+                if len(vs) > 1 and not any(
+                        isinstance(x, _sp.BaseSparseNDArray) for x in vs):
+                    from . import parallel
+
+                    try:
+                        agg = parallel.group_all_reduce(vs)[0]
+                    except MXNetError:
+                        agg = None  # values share a device → serial sum
+                if agg is None:
+                    agg = vs[0]
+                    for x in vs[1:]:
+                        # sparse grads reduce sparse (reference:
+                        # comm.h:478 row-sparse reduce path)
+                        if isinstance(agg, _sp.BaseSparseNDArray) or \
+                                isinstance(x, _sp.BaseSparseNDArray):
+                            agg = _sp.elemwise_add(agg, x)
+                        else:
+                            agg = agg + x
             else:
                 agg = v
+                if self._compression is not None and not isinstance(
+                        agg, _sp.BaseSparseNDArray):
+                    agg = self._compress(k, 0, agg)
             if self._type.startswith("dist"):
                 from . import parallel
 
                 agg = parallel.all_reduce(agg)
             if k not in self._store:
                 raise MXNetError(f"key {k} was not initialized")
+            stored = self._store[k]
+            if not isinstance(agg, _sp.BaseSparseNDArray) and \
+                    not isinstance(stored, _sp.BaseSparseNDArray) and \
+                    agg.data.sharding != stored.data.sharding:
+                # big keys live row-sharded (_maybe_shard) — bring the
+                # aggregate onto the same layout so the update stays a
+                # sharded computation instead of a device clash
+                import jax
+
+                agg = NDArray(jax.device_put(agg.data,
+                                             stored.data.sharding))
             if self._updater is not None:
-                self._updater(_key_to_int(k), agg, self._store[k])
+                self._updater(_key_to_int(k), agg, stored)
             elif isinstance(agg, _sp.BaseSparseNDArray) or isinstance(
-                    self._store[k], _sp.BaseSparseNDArray):
+                    stored, _sp.BaseSparseNDArray):
                 # rebind wholesale: merged result may change nnz/format
-                self._store[k] = _sp.elemwise_add(self._store[k], agg)
+                self._store[k] = _sp.elemwise_add(stored, agg)
             else:
-                self._store[k]._data = (self._store[k] + agg).data
+                stored._data = (stored + agg).data
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs, _ = self._normalize(key, out)
@@ -132,7 +216,14 @@ class KVStore:
                 src = src.todense()
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
-                t._data = src.data.astype(t.data.dtype)
+                data = src.data
+                if data.sharding != t.data.sharding:
+                    # don't leak the store's (possibly kvshard) layout
+                    # into the caller's array
+                    import jax
+
+                    data = jax.device_put(data, t.data.sharding)
+                t._data = data.astype(t.data.dtype)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (reference: kvstore.h PushPull)."""
@@ -164,17 +255,28 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        """Reference: kvstore.py set_gradient_compression →
+        gradient_compression.cc SetParams. 2-bit quantization with
+        error-feedback residuals applies to every subsequent dense push."""
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params)
+        ctype = params.pop("type", "2bit")
+        if ctype in (None, "none"):
+            self._compression = None
+            self._residuals.clear()
+            return
+        self._compression = GradientCompression(type=ctype, **params)
+        self._residuals.clear()
 
     def barrier(self):
-        """Reference: kvstore.h:391 Barrier. Multi-host: a psum sync."""
+        """Reference: kvstore.h:391 Barrier. Multi-host: a global device
+        sync; failures propagate (a swallowed barrier error would let
+        workers desynchronize silently)."""
         if self._type.startswith("dist") and self.num_workers > 1:
-            try:
-                from jax.experimental import multihost_utils
+            from jax.experimental import multihost_utils
 
-                multihost_utils.sync_global_devices("kvstore_barrier")
-            except Exception:
-                pass
+            multihost_utils.sync_global_devices("kvstore_barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

@@ -16,10 +16,10 @@ as jax.sharding over a Mesh and XLA inserts the ICI/DCN collectives:
 from __future__ import annotations
 
 from .mesh import make_mesh, current_mesh, mesh_scope, device_count
-from .spmd import (all_reduce, SPMDTrainer, shard_batch, replicate,
-                   shard_params)
+from .spmd import (all_reduce, group_all_reduce, SPMDTrainer, shard_batch,
+                   replicate, shard_params)
 from .ring_attention import ring_attention
 
 __all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count",
-           "all_reduce", "SPMDTrainer", "shard_batch", "replicate",
-           "shard_params", "ring_attention"]
+           "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
+           "replicate", "shard_params", "ring_attention"]

@@ -1,0 +1,209 @@
+"""mx.image namespace: decode/resize/crop ops, augmenters (seeded
+determinism), ImageIter and ImageDetIter.
+
+Reference coverage model: tests/python/unittest/test_image.py
+(TestImage.test_imdecode/test_resize_short/test_augmenters/
+test_image_iter/test_image_detiter).
+"""
+import os
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, image, recordio
+
+rs = onp.random.RandomState(5)
+
+
+def _jpeg_bytes(arr):
+    import io
+
+    from PIL import Image
+
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="JPEG", quality=95)
+    return b.getvalue()
+
+
+@pytest.fixture(scope="module")
+def img_dataset(tmp_path_factory):
+    """8 random images on disk + a .rec/.idx pair + an imglist."""
+    d = tmp_path_factory.mktemp("imgs")
+    files, labels = [], []
+    rec = recordio.MXIndexedRecordIO(str(d / "data.idx"),
+                                     str(d / "data.rec"), "w")
+    for i in range(8):
+        arr = rs.randint(0, 255, (80 + 4 * i, 100, 3)).astype("uint8")
+        fname = f"im{i}.jpg"
+        with open(d / fname, "wb") as f:
+            f.write(_jpeg_bytes(arr))
+        files.append(fname)
+        labels.append(float(i % 4))
+        header = recordio.IRHeader(0, float(i % 4), i, 0)
+        rec.write_idx(i, recordio.pack(header, _jpeg_bytes(arr)))
+    rec.close()
+    return d, files, labels
+
+
+def test_imdecode_imread(img_dataset):
+    d, files, _ = img_dataset
+    img = image.imread(str(d / files[0]))
+    assert img.dtype == onp.uint8 or str(img.dtype) == "uint8"
+    assert img.shape == (80, 100, 3)
+    gray = image.imread(str(d / files[0]), flag=0)
+    assert gray.shape == (80, 100, 1)
+    with open(d / files[0], "rb") as f:
+        img2 = image.imdecode(f.read())
+    onp.testing.assert_array_equal(img.asnumpy(), img2.asnumpy())
+    bgr = image.imdecode(open(d / files[0], "rb").read(), to_rgb=0)
+    onp.testing.assert_array_equal(bgr.asnumpy()[:, :, ::-1],
+                                   img.asnumpy())
+
+
+def test_resize_short_and_crops():
+    arr = rs.randint(0, 255, (60, 120, 3)).astype("uint8")
+    out = image.resize_short(nd.array(arr, dtype="uint8"), 30)
+    assert out.shape == (30, 60, 3)  # aspect preserved, short edge 30
+    c, (x0, y0, w, h) = image.center_crop(arr, (40, 20))
+    assert c.shape == (20, 40, 3) and (w, h) == (40, 20)
+    assert x0 == (120 - 40) // 2 and y0 == (60 - 20) // 2
+    f = image.fixed_crop(arr, 5, 10, 30, 20)
+    onp.testing.assert_array_equal(f.asnumpy(), arr[10:30, 5:35])
+    rc, rect = image.random_crop(arr, (32, 24))
+    assert rc.shape == (24, 32, 3)
+    rsz, _ = image.random_size_crop(arr, (32, 24), (0.3, 1.0),
+                                    (0.8, 1.2))
+    assert rsz.shape == (24, 32, 3)
+
+
+def test_color_normalize_and_border():
+    arr = rs.randint(0, 255, (8, 8, 3)).astype("uint8")
+    mean = onp.array([1.0, 2.0, 3.0], "f")
+    std = onp.array([2.0, 2.0, 2.0], "f")
+    out = image.color_normalize(arr, mean, std)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                (arr.astype("f") - mean) / std, rtol=1e-5)
+    padded = image.copyMakeBorder(arr, 1, 2, 3, 4, value=7)
+    assert padded.shape == (11, 15, 3)
+    assert (padded.asnumpy()[0] == 7).all()
+
+
+def test_create_augmenter_composition():
+    augs = image.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, hue=0.1, pca_noise=0.1,
+                                 rand_gray=0.1)
+    names = [type(a).__name__ for a in augs]
+    assert names == ["ResizeAug", "RandomCropAug", "HorizontalFlipAug",
+                     "CastAug", "ColorJitterAug", "HueJitterAug",
+                     "LightingAug", "RandomGrayAug", "ColorNormalizeAug"]
+    for a in augs:
+        assert a.dumps()  # serializable
+
+
+def test_augmenter_seeded_determinism():
+    arr = rs.randint(0, 255, (50, 50, 3)).astype("uint8")
+    augs = image.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                 rand_mirror=True, brightness=0.3,
+                                 contrast=0.3, saturation=0.3, hue=0.3)
+
+    def run():
+        pyrandom.seed(42)
+        onp.random.seed(42)
+        out = nd.array(arr, dtype="uint8")
+        for a in augs:
+            out = a(out)
+        return out.asnumpy()
+
+    onp.testing.assert_array_equal(run(), run())
+    pyrandom.seed(7)
+    different = False
+    for _ in range(4):  # different seed → (almost surely) different crop
+        out = nd.array(arr, dtype="uint8")
+        for a in augs:
+            out = a(out)
+        if not onp.array_equal(out.asnumpy(), run()):
+            different = True
+            break
+    assert different
+
+
+def test_image_iter_rec(img_dataset):
+    d, _, labels = img_dataset
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=str(d / "data.rec"),
+                         path_imgidx=str(d / "data.idx"))
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+    onp.testing.assert_allclose(batch.label[0].asnumpy(), labels[:4])
+    batch2 = it.next()
+    assert batch2.pad == 0
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().data[0].shape == (4, 3, 32, 32)
+
+
+def test_image_iter_imglist(img_dataset):
+    d, files, labels = img_dataset
+    imglist = [[lab, f] for lab, f in zip(labels, files)]
+    it = image.ImageIter(batch_size=3, data_shape=(3, 28, 28),
+                         imglist=imglist, path_root=str(d),
+                         shuffle=False)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (3, 3, 28, 28)
+        n += 1
+    assert n == 3  # 8 imgs → 2 full + 1 padded batch
+    assert batch.pad == 1
+    assert it.provide_data[0].shape == (3, 3, 28, 28)
+
+
+def test_image_det_iter(img_dataset):
+    d, _, _ = img_dataset
+    rec = recordio.MXIndexedRecordIO(str(d / "det.idx"),
+                                     str(d / "det.rec"), "w")
+    for i in range(6):
+        arr = rs.randint(0, 255, (64, 64, 3)).astype("uint8")
+        # header: [header_width=2, obj_width=5, (cls, x0, y0, x1, y1) x2]
+        label = [2, 5, 1, 0.1, 0.2, 0.5, 0.6, 2, 0.3, 0.3, 0.9, 0.8]
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack(header, _jpeg_bytes(arr)))
+    rec.close()
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 48, 48),
+                            path_imgrec=str(d / "det.rec"),
+                            path_imgidx=str(d / "det.idx"))
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 48, 48)
+    assert batch.label[0].shape == (2, 2, 5)
+    lab = batch.label[0].asnumpy()
+    onp.testing.assert_allclose(
+        lab[0], [[1, 0.1, 0.2, 0.5, 0.6], [2, 0.3, 0.3, 0.9, 0.8]],
+        rtol=1e-5)
+
+
+def test_det_flip_updates_boxes():
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    arr = nd.array(rs.randint(0, 255, (10, 10, 3)).astype("uint8"),
+                   dtype="uint8")
+    label = onp.array([[1, 0.1, 0.2, 0.4, 0.6]], "f")
+    out, lab2 = aug(arr, label)
+    onp.testing.assert_allclose(lab2, [[1, 0.6, 0.2, 0.9, 0.6]],
+                                rtol=1e-5)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   arr.asnumpy()[:, ::-1])
+
+
+def test_det_random_crop_keeps_valid_boxes():
+    pyrandom.seed(0)
+    aug = image.DetRandomCropAug(min_object_covered=0.1,
+                                 area_range=(0.5, 1.0))
+    arr = nd.array(rs.randint(0, 255, (40, 40, 3)).astype("uint8"),
+                   dtype="uint8")
+    label = onp.array([[0, 0.25, 0.25, 0.75, 0.75]], "f")
+    out, lab2 = aug(arr, label)
+    assert lab2.shape[1] == 5
+    assert (lab2[:, 1:5] >= -1e-6).all() and (lab2[:, 1:5] <= 1 + 1e-6).all()

@@ -1,0 +1,94 @@
+"""model_store cache resolution + pretrained wiring.
+
+Reference: the download/caching logic of
+python/mxnet/gluon/model_zoo/model_store.py (checksummed cache, purge).
+Network-free: only the cache/verify paths run; download raises the
+documented no-egress error.
+"""
+import hashlib
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def test_short_hash_known_and_unknown():
+    assert len(model_store.short_hash("resnet50_v1")) == 8
+    with pytest.raises(ValueError):
+        model_store.short_hash("not_a_model")
+
+
+def test_cache_hit_returns_verified_file(tmp_path):
+    # build a fake cached weight whose sha1 we register temporarily
+    payload = b"fake-params-bytes"
+    sha = hashlib.sha1(payload).hexdigest()
+    old = model_store._model_sha1.get("resnet18_v1")
+    model_store._model_sha1["resnet18_v1"] = sha
+    try:
+        fname = tmp_path / f"resnet18_v1-{sha[:8]}.params"
+        fname.write_bytes(payload)
+        got = model_store.get_model_file("resnet18_v1",
+                                         root=str(tmp_path))
+        assert got == str(fname)
+    finally:
+        model_store._model_sha1["resnet18_v1"] = old
+
+
+def test_download_raises_helpful_error_without_egress(tmp_path):
+    with pytest.raises((RuntimeError, ValueError)) as ei:
+        model_store.get_model_file("alexnet", root=str(tmp_path))
+    assert "alexnet" in str(ei.value)
+
+
+def test_pretrained_flag_routes_to_model_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    asked = []
+
+    def fake_get(name, root=None):
+        asked.append(name)
+        raise RuntimeError("no egress in test")
+
+    monkeypatch.setattr(model_store, "get_model_file", fake_get)
+    for ctor, expect in [
+            (lambda: vision.resnet50_v1(pretrained=True), "resnet50_v1"),
+            (lambda: vision.mobilenet1_0(pretrained=True),
+             "mobilenet1.0"),
+            (lambda: vision.mobilenet_v2_0_5(pretrained=True),
+             "mobilenetv2_0.5"),
+            (lambda: vision.squeezenet1_1(pretrained=True),
+             "squeezenet1.1"),
+            (lambda: vision.vgg16_bn(pretrained=True), "vgg16_bn"),
+            (lambda: vision.densenet169(pretrained=True), "densenet169"),
+            (lambda: vision.inception_v3(pretrained=True),
+             "inceptionv3"),
+            (lambda: vision.alexnet(pretrained=True), "alexnet")]:
+        with pytest.raises(RuntimeError):
+            ctor()
+        assert asked[-1] == expect
+
+
+def test_purge(tmp_path):
+    f = tmp_path / "x-12345678.params"
+    f.write_bytes(b"1")
+    model_store.purge(str(tmp_path))
+    assert not f.exists()
+
+
+def test_structure_checkpoint_roundtrip_zoo(tmp_path):
+    """save_parameters/load_parameters (the zoo-file format) restores
+    identical outputs."""
+    net = vision.squeezenet1_1(classes=13)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).rand(1, 3, 224, 224)
+                 .astype("f"))
+    ref = net(x)
+    f = str(tmp_path / "w.params")
+    net.save_parameters(f)
+    net2 = vision.squeezenet1_1(classes=13)
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(x).asnumpy(), ref.asnumpy(),
+                                rtol=1e-5)
