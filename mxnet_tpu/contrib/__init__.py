@@ -2,3 +2,4 @@
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import svrg_optimization  # noqa: F401

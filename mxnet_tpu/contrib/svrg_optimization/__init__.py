@@ -1,0 +1,4 @@
+"""SVRG optimization (reference:
+python/mxnet/contrib/svrg_optimization/__init__.py)."""
+from .svrg_module import SVRGModule  # noqa: F401
+from .svrg_optimizer import _SVRGOptimizer  # noqa: F401

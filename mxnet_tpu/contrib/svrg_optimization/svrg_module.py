@@ -1,0 +1,156 @@
+"""SVRGModule: Module with stochastic variance-reduced gradients.
+
+Reference: python/mxnet/contrib/svrg_optimization/svrg_module.py.
+Maintains a snapshot of the parameters taken every ``update_freq``
+epochs plus the full-dataset gradient at that snapshot; each minibatch
+update uses g(w) - g(w_snapshot) + mean_full_grad ('Accelerating
+Stochastic Gradient Descent using Predictive Variance Reduction',
+Johnson & Zhang 2013).
+"""
+from __future__ import annotations
+
+import logging
+
+from ... import ndarray as nd
+from ...module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._param_dict = None  # full grads at the snapshot
+        self._snapshot_taken = False
+
+    # -- plumbing that must mirror into the snapshot module ------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  force_init=True, allow_missing=False)
+
+    def take_snapshot(self):
+        """Copy current params into the snapshot module (reference:
+        svrg_module.py update_full_grads prologue)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg, aux)
+        self._snapshot_taken = True
+
+    def update_full_grads(self, train_data):
+        """One full pass over train_data at the snapshot params to
+        compute the mean full gradient (reference svrg_module.py:207)."""
+        self.take_snapshot()
+        mod = self._mod_aux
+        accum = {}
+        nbatch = 0
+        if hasattr(train_data, "reset"):
+            train_data.reset()
+        for batch in train_data:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            nbatch += 1
+            for name in mod._param_names():
+                g = mod._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if name in accum:
+                    accum[name] = accum[name] + g
+                else:
+                    accum[name] = g.copy()
+        self._param_dict = {k: v / max(nbatch, 1)
+                            for k, v in accum.items()}
+        if hasattr(train_data, "reset"):
+            train_data.reset()
+
+    def _update_svrg_gradients(self, data_batch):
+        """Replace this module's gradients with the variance-reduced
+        combination (reference svrg_module.py:233)."""
+        mod = self._mod_aux
+        mod.forward(data_batch, is_train=True)
+        mod.backward()
+        for name in self._param_names():
+            g = self._exec.grad_dict.get(name)
+            g_snap = mod._exec.grad_dict.get(name)
+            if g is None or g_snap is None or \
+                    self._param_dict is None or \
+                    name not in self._param_dict:
+                continue
+            g._data = (g - g_snap + self._param_dict[name]).data
+
+    def forward_backward(self, data_batch):
+        super().forward(data_batch, is_train=True)
+        super().backward()
+        if self._snapshot_taken and self._param_dict is not None:
+            self._update_svrg_gradients(data_batch)
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd", optimizer_params=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """Training loop with a full-gradient refresh every
+        ``update_freq`` epochs (reference svrg_module.py fit)."""
+        from ... import metric as _metric
+
+        assert num_epoch is not None
+        self.bind([(d.name, d.shape) if hasattr(d, "name") else d
+                   for d in train_data.provide_data],
+                  [(d.name, d.shape) if hasattr(d, "name") else d
+                   for d in train_data.provide_label],
+                  for_training=True, force_rebind=force_rebind)
+        from ... import initializer as _init
+
+        self.init_params(initializer or _init.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params or
+                            (("learning_rate", 0.01),))
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    for cb in (batch_end_callback if isinstance(
+                            batch_end_callback, (list, tuple))
+                            else [batch_end_callback]):
+                        cb(type("P", (), {"epoch": epoch,
+                                          "nbatch": nbatch,
+                                          "eval_metric": eval_metric})())
+            self.logger.info("Epoch[%d] Train-%s=%f", epoch,
+                             *eval_metric.get())
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in (epoch_end_callback if isinstance(
+                        epoch_end_callback, (list, tuple))
+                        else [epoch_end_callback]):
+                    cb(epoch, self._symbol, arg, aux)

@@ -1,0 +1,56 @@
+"""SVRG gradient-corrected optimizer.
+
+Reference: python/mxnet/contrib/svrg_optimization/svrg_optimizer.py —
+a wrapper optimizer that (a) assigns full-gradient snapshots into the
+kvstore for the special keys and (b) applies the variance-reduced update
+g_corrected = g - g_snapshot(w) + mean_full_grad for normal keys.
+"""
+from __future__ import annotations
+
+from ... import optimizer as _opt
+
+__all__ = ["_SVRGOptimizer"]
+
+
+@_opt.register
+class _AssignmentOptimizer(_opt.Optimizer):
+    """kvstore 'update' that just overwrites the stored value (used for
+    the full-gradient bookkeeping keys; reference svrg_optimizer.py:30)."""
+
+    def update(self, index, weight, grad, state):
+        weight._data = grad.data
+
+
+@_opt.register
+class _SVRGOptimizer(_opt.Optimizer):
+    """Dispatch: special-key gradients are assigned, normal keys run the
+    wrapped default optimizer (reference svrg_optimizer.py:60)."""
+
+    def __init__(self, default_optimizer, **kwargs):
+        # pull out the wrapped optimizer's kwargs
+        super().__init__(rescale_grad=kwargs.pop("rescale_grad", 1.0),
+                         learning_rate=kwargs.pop("learning_rate", 0.01),
+                         wd=kwargs.pop("wd", 0.0))
+        if isinstance(default_optimizer, str):
+            self.default_opt = _opt.create(
+                default_optimizer, learning_rate=self.lr, wd=self.wd,
+                rescale_grad=self.rescale_grad, **kwargs)
+        else:
+            self.default_opt = default_optimizer
+        self.aux_opt = _AssignmentOptimizer()
+
+    def update(self, index, weight, grad, state):
+        if self._is_special_key(index):
+            self.aux_opt.update(index, weight, grad, None)
+        else:
+            self.default_opt.update(index, weight, grad, state)
+
+    def create_state(self, index, weight):
+        if self._is_special_key(index):
+            return None
+        return self.default_opt.create_state(index, weight)
+
+    @staticmethod
+    def _is_special_key(index):
+        name = str(index)
+        return name.startswith("key_") or name.endswith("_full")

@@ -280,10 +280,12 @@ class ImageDetIter(ImageIter):
         return body[:nobj * obj_width].reshape(nobj, obj_width)
 
     def _infer_label_shape(self):
+        """Scan the WHOLE dataset for the max object count — a capped
+        scan would silently truncate labels of late samples (reference
+        detection.py estimates via label_shape/_estimate too)."""
         pos = self.cur
         maxo, width = 0, 5
-        n = 0
-        while n < 200:
+        while True:
             try:
                 lab, _ = self.next_sample()
             except StopIteration:
@@ -291,7 +293,6 @@ class ImageDetIter(ImageIter):
             parsed = self._parse_label(lab)
             maxo = max(maxo, parsed.shape[0])
             width = parsed.shape[1]
-            n += 1
         self.cur = pos
         self.reset()
         return max(maxo, 1), width
@@ -319,7 +320,13 @@ class ImageDetIter(ImageIter):
                     raise
                 pad = self.batch_size - i
                 break
-            arr = imdecode(img)
+            try:
+                arr = imdecode(img)
+            except Exception as e:  # corrupt image — skip, like reference
+                import logging
+
+                logging.debug("skipping corrupted image: %s", e)
+                continue
             parsed = self._parse_label(lab)
             for aug in self.det_auglist:
                 arr, parsed = aug(arr, parsed)

@@ -485,6 +485,11 @@ class ImageIter(DataIter):
                  num_parts=1, aug_list=None, imglist=None,
                  data_name="data", label_name="softmax_label",
                  dtype="float32", last_batch_handle="pad", **kwargs):
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(
+                f"unknown last_batch_handle '{last_batch_handle}'")
+        self._last_batch_handle = last_batch_handle
+        self._rolled = []  # (label, raw) carried across epochs
         super().__init__(batch_size)
         assert len(data_shape) == 3 and data_shape[0] == 3, \
             "data_shape must be (3, H, W)"
@@ -525,7 +530,12 @@ class ImageIter(DataIter):
         else:
             raise MXNetError(
                 "need path_imgrec, path_imglist or imglist")
-        if self.seq is not None and num_parts > 1:
+        if num_parts > 1:
+            if self.seq is None:
+                raise MXNetError(
+                    "num_parts > 1 needs a sequence source (indexed "
+                    ".rec or imglist) to partition — plain .rec without "
+                    "an .idx cannot be split")
             n = len(self.seq) // num_parts
             self.seq = self.seq[part_index * n:(part_index + 1) * n]
         if aug_list is None:
@@ -588,11 +598,21 @@ class ImageIter(DataIter):
         labels = onp.zeros(label_shape, "float32")
         i = 0
         pad = 0
+        pending = []  # raw samples consumed into this batch
         while i < self.batch_size:
             try:
-                lab, img = self.next_sample()
+                if self._rolled:
+                    lab, img = self._rolled.pop(0)
+                else:
+                    lab, img = self.next_sample()
             except StopIteration:
                 if i == 0:
+                    raise
+                if self._last_batch_handle == "discard":
+                    raise
+                if self._last_batch_handle == "roll_over":
+                    # keep the partial batch for the next epoch
+                    self._rolled = pending
                     raise
                 pad = self.batch_size - i
                 break
@@ -601,6 +621,7 @@ class ImageIter(DataIter):
             except Exception as e:  # corrupt image — skip, like reference
                 logging.debug("skipping corrupted image: %s", e)
                 continue
+            pending.append((lab, img))
             for aug in self.auglist:
                 arr = aug(arr)
             a = _to_numpy(arr)

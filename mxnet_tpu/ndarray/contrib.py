@@ -201,13 +201,21 @@ _CONTRIB_OPS = [
     "bilinear_resize2d", "all_finite", "multi_sum_sq",
     "box_iou", "box_nms", "bipartite_matching", "multibox_prior",
     "multibox_target", "multibox_detection", "roi_align",
+    "fft", "ifft", "count_sketch", "deformable_convolution",
+    "proposal", "multi_proposal", "psroi_pooling",
+    "deformable_psroi_pooling", "mrcnn_mask_target",
 ]
 
 # CamelCase contrib aliases (reference registered names)
 _CONTRIB_ALIASES = {"MultiBoxPrior": "multibox_prior",
                     "MultiBoxTarget": "multibox_target",
                     "MultiBoxDetection": "multibox_detection",
-                    "ROIAlign": "roi_align"}
+                    "ROIAlign": "roi_align",
+                    "Proposal": "proposal",
+                    "MultiProposal": "multi_proposal",
+                    "PSROIPooling": "psroi_pooling",
+                    "DeformableConvolution": "deformable_convolution",
+                    "DeformablePSROIPooling": "deformable_psroi_pooling"}
 
 
 def _install():

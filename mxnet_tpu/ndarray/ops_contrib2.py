@@ -1,0 +1,410 @@
+"""Contrib operator tail: FFT, count_sketch, deformable convolution,
+RPN proposals, (deformable) PSROI pooling, MRCNN mask targets
+(index_copy lives in ops_index.py).
+
+Reference: src/operator/contrib/{fft.cc,count_sketch.cc,
+deformable_convolution.cc,proposal.cc,multi_proposal.cc,
+psroi_pooling.cc,deformable_psroi_pooling.cc,mrcnn_mask_target.cu}.
+The reference implements these as hand-written CUDA kernels; here each
+is a pure jnp/lax body — bilinear sampling becomes vectorized gathers,
+PSROI bin sums ride an integral image, NMS is a fixed-trip greedy
+lax.fori_loop — so XLA fuses them and the same code serves eager, jit,
+symbolic and tape execution.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+# ------------------------------------------------------------------ fft ---
+
+@register("fft")
+def fft(data, compute_size=128):
+    """Real -> interleaved complex FFT along the last axis: (..., d) ->
+    (..., 2d) with [re0, im0, re1, im1, ...] layout (reference
+    fft-inl.h; cuFFT C2C semantics)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        jnp.float32)
+
+
+@register("ifft")
+def ifft(data, compute_size=128):
+    """Interleaved complex -> real inverse FFT, UNNORMALIZED like cuFFT
+    (ifft(fft(x)) == d * x — reference fft-inl.h docs)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1) * d  # undo numpy's 1/d scaling
+    return out.real.astype(jnp.float32)
+
+
+# --------------------------------------------------------- count_sketch ---
+
+@register("count_sketch")
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection: out[:, h[i]] += s[i] * data[:, i]
+    (reference count_sketch-inl.h; used by compact bilinear pooling)."""
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    vals = data * ss[None, :]
+    out = jnp.zeros((n, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(vals)
+
+
+# ------------------------------------------------- deformable convolution ---
+
+def _bilinear_chw(img, y, x):
+    """Sample img (C, H, W) at float coords y/x (...,) with zero padding
+    outside; returns (C, ...)."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def at(yy, xx):
+        valid = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return img[:, yc, xc] * valid.astype(img.dtype)
+
+    return (at(y0, x0) * (1 - wy) * (1 - wx) +
+            at(y0, x0 + 1) * (1 - wy) * wx +
+            at(y0 + 1, x0) * wy * (1 - wx) +
+            at(y0 + 1, x0 + 1) * wy * wx)
+
+
+@register("deformable_convolution")
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=None, dilate=None, pad=None,
+                           num_filter=0, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=1024, layout=None):
+    """Deformable ConvNets v1 convolution (reference
+    deformable_convolution-inl.h; im2col with per-tap learned offsets
+    becomes vectorized bilinear gathers)."""
+    B, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride or (1, 1)
+    dh, dw = dilate or (1, 1)
+    ph, pw = pad or (0, 0)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    ndg = num_deformable_group
+    cpg = C // ndg
+    base_y = jnp.arange(Ho) * sh - ph
+    base_x = jnp.arange(Wo) * sw - pw
+    off = offset.reshape(B, ndg, kh * kw, 2, Ho, Wo)
+
+    def one_image(img, off_img):
+        cols = []  # per tap: (C, Ho, Wo)
+        for i in range(kh):
+            for j in range(kw):
+                k = i * kw + j
+                per_dg = []
+                for g in range(ndg):
+                    y = base_y[:, None] + i * dh + off_img[g, k, 0]
+                    x = base_x[None, :] + j * dw + off_img[g, k, 1]
+                    per_dg.append(_bilinear_chw(
+                        img[g * cpg:(g + 1) * cpg], y, x))
+                cols.append(jnp.concatenate(per_dg, axis=0))
+        return jnp.stack(cols, axis=1)  # (C, K, Ho, Wo)
+
+    sampled = jax.vmap(one_image)(data, off)  # (B, C, K, Ho, Wo)
+    G = num_group
+    w = weight.reshape(G, num_filter // G, C // G, kh * kw)
+    s = sampled.reshape(B, G, C // G, kh * kw, Ho, Wo)
+    out = jnp.einsum("bgckhw,gfck->bgfhw", s, w).reshape(
+        B, num_filter, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# -------------------------------------------------------------- proposal ---
+
+def _make_anchors(scales, ratios, feature_stride):
+    """Base anchors at one position (reference rcnn anchor generation:
+    proposal-inl.h GenerateAnchors)."""
+    import numpy as onp
+
+    base = onp.array([0, 0, feature_stride - 1, feature_stride - 1],
+                     "float32")
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = onp.round(onp.sqrt(size / r))
+        hs = onp.round(ws * r)
+        for sc in scales:
+            wss, hss = ws * sc, hs * sc
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return onp.array(anchors, "float32")
+
+
+def _nms_keep(boxes, scores, thresh, max_out):
+    """Greedy NMS: returns indices of kept boxes (padded with -1),
+    fixed trip count for jit."""
+    n = boxes.shape[0]
+    areas = (boxes[:, 2] - boxes[:, 0] + 1) * \
+        (boxes[:, 3] - boxes[:, 1] + 1)
+
+    def body(state, _):
+        live_scores, = state
+        idx = jnp.argmax(live_scores)
+        valid = live_scores[idx] > -jnp.inf
+        box = boxes[idx]
+        xx1 = jnp.maximum(box[0], boxes[:, 0])
+        yy1 = jnp.maximum(box[1], boxes[:, 1])
+        xx2 = jnp.minimum(box[2], boxes[:, 2])
+        yy2 = jnp.minimum(box[3], boxes[:, 3])
+        inter = jnp.maximum(0.0, xx2 - xx1 + 1) * \
+            jnp.maximum(0.0, yy2 - yy1 + 1)
+        iou = inter / (areas + areas[idx] - inter)
+        suppress = iou > thresh
+        new_scores = jnp.where(suppress, -jnp.inf, live_scores)
+        new_scores = new_scores.at[idx].set(-jnp.inf)
+        return (new_scores,), jnp.where(valid, idx, -1)
+
+    (_,), keep = lax.scan(body, (scores,), None, length=max_out)
+    return keep
+
+
+def _proposal_one(scores, deltas, im_info, anchors, stride, pre_n,
+                  post_n, thresh, min_size):
+    K = anchors.shape[0]
+    hfeat, wfeat = scores.shape[-2:]
+    fg = scores[K:].transpose(1, 2, 0).reshape(-1)  # (h*w*K,)
+    d = deltas.reshape(K, 4, hfeat, wfeat).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)
+    shift_x = jnp.arange(wfeat) * stride
+    shift_y = jnp.arange(hfeat) * stride
+    anc = (anchors[None, None] + jnp.stack(
+        [shift_x[None, :, None] * jnp.ones((hfeat, 1, 1)),
+         shift_y[:, None, None] * jnp.ones((1, wfeat, 1)),
+         shift_x[None, :, None] * jnp.ones((hfeat, 1, 1)),
+         shift_y[:, None, None] * jnp.ones((1, wfeat, 1))],
+        axis=-1)).reshape(-1, 4)
+    # bbox transform inv (reference rcnn bbox_pred)
+    ws = anc[:, 2] - anc[:, 0] + 1
+    hs = anc[:, 3] - anc[:, 1] + 1
+    cx = anc[:, 0] + 0.5 * (ws - 1)
+    cy = anc[:, 1] + 0.5 * (hs - 1)
+    ncx = d[:, 0] * ws + cx
+    ncy = d[:, 1] * hs + cy
+    nw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * ws
+    nh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * hs
+    boxes = jnp.stack([ncx - 0.5 * (nw - 1), ncy - 0.5 * (nh - 1),
+                       ncx + 0.5 * (nw - 1), ncy + 0.5 * (nh - 1)],
+                      axis=1)
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 1], 0, im_info[0] - 1),
+                       jnp.clip(boxes[:, 2], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 3], 0, im_info[0] - 1)],
+                      axis=1)
+    msz = min_size * im_info[2]
+    keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1) >= msz) & \
+        ((boxes[:, 3] - boxes[:, 1] + 1) >= msz)
+    fg = jnp.where(keep_sz, fg, -jnp.inf)
+    pre_n = min(pre_n, fg.shape[0])
+    top_scores, top_idx = lax.top_k(fg, pre_n)
+    top_boxes = boxes[top_idx]
+    keep = _nms_keep(top_boxes, top_scores, thresh, post_n)
+    safe = jnp.maximum(keep, 0)
+    out_boxes = jnp.where(keep[:, None] >= 0, top_boxes[safe], 0.0)
+    out_scores = jnp.where(keep >= 0, top_scores[safe], 0.0)
+    return out_boxes, out_scores
+
+
+@register("proposal", differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (reference proposal.cc). Output rois are
+    (B*post_n, 5) [batch_idx, x1, y1, x2, y2]; fixed shapes (NMS pads
+    with zero-rows) keep the op jittable on TPU."""
+    anchors = jnp.asarray(_make_anchors(scales, ratios, feature_stride))
+    B = cls_prob.shape[0]
+    rois, scores = [], []
+    for b in range(B):
+        bx, sc = _proposal_one(cls_prob[b], bbox_pred[b], im_info[b],
+                               anchors, feature_stride,
+                               int(rpn_pre_nms_top_n),
+                               int(rpn_post_nms_top_n), float(threshold),
+                               float(rpn_min_size))
+        rois.append(jnp.concatenate(
+            [jnp.full((bx.shape[0], 1), float(b)), bx], axis=1))
+        scores.append(sc)
+    out = jnp.concatenate(rois, axis=0)
+    if output_score:
+        return out, jnp.concatenate(scores)[:, None]
+    return out
+
+
+@register("multi_proposal", differentiable=False)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batch variant (reference multi_proposal.cc) — same math, one NMS
+    per image; `proposal` here already loops the batch."""
+    return proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+# -------------------------------------------------------- psroi pooling ---
+
+@register("psroi_pooling")
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                  pooled_size=0, group_size=0):
+    """Position-sensitive ROI average pooling (reference
+    psroi_pooling-inl.h). Bin sums come from a 2-D integral image so
+    every (roi, cell) is an O(1) gather — no dynamic-size loops."""
+    P = int(pooled_size)
+    G = int(group_size) or P
+    B, C, H, W = data.shape
+    # integral image with a zero border: ii[y, x] = sum(data[:y, :x])
+    ii = jnp.pad(data, ((0, 0), (0, 0), (1, 0), (1, 0))).cumsum(
+        axis=2).cumsum(axis=3)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / P, rh / P
+        iy = jnp.arange(P)
+        ix = jnp.arange(P)
+        hs = jnp.clip(jnp.floor(y1 + iy * bh), 0, H).astype(jnp.int32)
+        he = jnp.clip(jnp.ceil(y1 + (iy + 1) * bh), 0, H).astype(
+            jnp.int32)
+        ws = jnp.clip(jnp.floor(x1 + ix * bw), 0, W).astype(jnp.int32)
+        we = jnp.clip(jnp.ceil(x1 + (ix + 1) * bw), 0, W).astype(
+            jnp.int32)
+        # channel for (d, i, j): (d*G + gi)*G + gj with gi=i*G//P
+        gi = (iy * G) // P
+        gj = (ix * G) // P
+        dch = jnp.arange(int(output_dim))
+        ch = (dch[:, None, None] * G + gi[None, :, None]) * G + \
+            gj[None, None, :]  # (D, P, P)
+        img = ii[bidx]  # (C, H+1, W+1)
+        hs2, he2 = hs[None, :, None], he[None, :, None]
+        ws2, we2 = ws[None, None, :], we[None, None, :]
+        ch3 = jnp.broadcast_to(ch, (int(output_dim), P, P))
+        hs3 = jnp.broadcast_to(hs2, ch3.shape)
+        he3 = jnp.broadcast_to(he2, ch3.shape)
+        ws3 = jnp.broadcast_to(ws2, ch3.shape)
+        we3 = jnp.broadcast_to(we2, ch3.shape)
+        ssum = (img[ch3, he3, we3] - img[ch3, hs3, we3]
+                - img[ch3, he3, ws3] + img[ch3, hs3, ws3])
+        cnt = jnp.maximum((he3 - hs3) * (we3 - ws3), 1)
+        empty = (he3 <= hs3) | (we3 <= ws3)
+        return jnp.where(empty, 0.0, ssum / cnt)
+
+    return jax.vmap(one_roi)(rois)  # (R, D, P, P)
+
+
+@register("deformable_psroi_pooling")
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=0, group_size=0, pooled_size=0,
+                             part_size=0, sample_per_part=1,
+                             trans_std=0.0, no_trans=False):
+    """Deformable PSROI pooling (reference
+    deformable_psroi_pooling-inl.h): per-part learned offsets, bilinear
+    sub-samples averaged per bin."""
+    P = int(pooled_size)
+    G = int(group_size) or P
+    PT = int(part_size) or P
+    sp = int(sample_per_part)
+    B, C, H, W = data.shape
+    D = int(output_dim)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / P, rh / P
+        img = data[bidx]
+        out = jnp.zeros((D, P, P), data.dtype)
+        iy = jnp.arange(P)
+        gi = (iy * G) // P
+        pi = (iy * PT) // P
+        for di in range(sp):
+            for dj in range(sp):
+                # sub-sample (di, dj) inside each bin
+                offy = (di + 0.5) * bh / sp
+                offx = (dj + 0.5) * bw / sp
+                ys = y1 + iy * bh + offy  # (P,)
+                yy = ys[:, None] * jnp.ones((1, P))
+                xx = (x1 + jnp.arange(P) * bw + offx)[None, :] * \
+                    jnp.ones((P, 1))
+                if not no_trans and tr is not None:
+                    ty = tr[0, pi[:, None], pi[None, :]] * trans_std
+                    tx = tr[1, pi[:, None], pi[None, :]] * trans_std
+                    yy = yy + ty * rh
+                    xx = xx + tx * rw
+                samp = _bilinear_chw(img, yy, xx)  # (C, P, P)
+                ch = (jnp.arange(D)[:, None, None] * G +
+                      gi[None, :, None]) * G + gi[None, None, :]
+                out = out + samp[ch, jnp.arange(P)[None, :, None],
+                                 jnp.arange(P)[None, None, :]]
+        return out / (sp * sp)
+
+    if trans is None or no_trans:
+        tr_in = jnp.zeros((rois.shape[0], 2, PT, PT), data.dtype)
+    else:
+        tr_in = trans.reshape(rois.shape[0], 2, PT, PT)
+    return jax.vmap(one_roi)(rois, tr_in)
+
+
+# ---------------------------------------------------- mrcnn mask target ---
+
+@register("mrcnn_mask_target", differentiable=False)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=0, num_classes=0, mask_size=(14, 14)):
+    """Mask R-CNN training targets (reference mrcnn_mask_target.cu):
+    crop each roi's matched GT mask, bilinear-resize to mask_size, and
+    emit per-class selection weights."""
+    if isinstance(mask_size, int):
+        mask_size = (mask_size, mask_size)
+    MS_h, MS_w = mask_size
+    B, N = rois.shape[:2]
+    Hm, Wm = gt_masks.shape[-2:]
+
+    def one(roi, match, mask_set):
+        x1, y1, x2, y2 = roi
+        m = mask_set[match.astype(jnp.int32)]  # (Hm, Wm)
+        ys = y1 + (jnp.arange(MS_h) + 0.5) / MS_h * (y2 - y1)
+        xs = x1 + (jnp.arange(MS_w) + 0.5) / MS_w * (x2 - x1)
+        yy = ys[:, None] * jnp.ones((1, MS_w))
+        xx = xs[None, :] * jnp.ones((MS_h, 1))
+        return _bilinear_chw(m[None], yy, xx)[0]
+
+    targets = jax.vmap(lambda r, mt, ms: jax.vmap(
+        lambda roi, match: one(roi, match, ms))(r, mt))(
+        rois, matches, gt_masks)  # (B, N, MS, MS)
+    C = int(num_classes)
+    cls = jax.nn.one_hot(cls_targets.astype(jnp.int32), C,
+                         dtype=rois.dtype)  # (B, N, C)
+    mask_cls = cls[:, :, :, None, None] * jnp.ones(
+        (1, 1, 1, MS_h, MS_w), rois.dtype)
+    mask_targets = jnp.broadcast_to(
+        targets[:, :, None], (B, N, C, MS_h, MS_w))
+    return mask_targets, mask_cls

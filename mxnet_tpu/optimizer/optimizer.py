@@ -506,6 +506,192 @@ class LAMB(Optimizer):
         _swap(var, v)
 
 
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling SGD (reference: optimizer.py:796,
+    'Large Batch Training of Convolutional Networks'): per-layer lr =
+    lr * eta * ||w|| / (||g|| + wd*||w|| + eps) when both norms > 0."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, eta=0.001, eps=0,
+                 momentum_correction=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+        self.eta = eta
+        self.eps = eps
+        self.momentum_correction = momentum_correction
+        self.last_lr = None
+        self.cur_lr = None
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _is_scaled(self, index):
+        """bias / batch-norm params keep the plain lr (reference LARS
+        doc: 'except bias and batch norm parameters')."""
+        name = self.idx2name.get(index, str(index))
+        return not (name.endswith("_bias") or name.endswith("_gamma")
+                    or name.endswith("_beta")
+                    or "batchnorm" in name.lower())
+
+    @staticmethod
+    def lars_scale(w_norm, g_norm, wd, eta, eps):
+        """The layer-wise lr multiplier (shared with LBSGD's 'lars'
+        strategy)."""
+        if w_norm > 0 and g_norm > 0:
+            return eta * w_norm / (g_norm + wd * w_norm + eps)
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        # momentum correction tracks the SCHEDULER's base lr across
+        # steps — not the per-parameter lr, which mixes different
+        # params' lr_mults (reference optimizer.py:854 cur_lr bookkeeping)
+        base_lr = self.learning_rate
+        if base_lr != self.cur_lr:
+            self.last_lr, self.cur_lr = self.cur_lr, base_lr
+        momentum = self.momentum
+        if self.momentum_correction and self.last_lr not in (None, 0):
+            momentum = self.momentum * self.cur_lr / self.last_lr
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        if self._is_scaled(index):
+            lr = lr * self.lars_scale(float(nd.norm(weight).asscalar()),
+                                      float(nd.norm(g).asscalar()),
+                                      wd, self.eta, self.eps)
+        if state is None:
+            _swap(weight, nd.sgd_update(weight, g, lr=lr, wd=wd))
+        else:
+            w, m = nd.sgd_mom_update(weight, g, state, lr=lr,
+                                     momentum=momentum, wd=wd)
+            _swap(weight, w)
+            _swap(state, m)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with warmup (reference: optimizer.py:899):
+    momentum SGD whose effective lr follows a warmup schedule
+    ('linear'|'power2'|'sqrt') over warmup_epochs and is LARS-scaled
+    ('lars' strategy) afterwards."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = max(1, updates_per_epoch)
+        self.init_updates = begin_epoch * self.updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _warmup_mult(self):
+        nup = self.num_update + self.init_updates + 1
+        total_warm = self.warmup_epochs * self.updates_per_epoch
+        if nup >= total_warm:
+            return float(self.batch_scale)
+        frac = nup / total_warm
+        if self.warmup_strategy == "power2":
+            mult = self.batch_scale * frac * frac
+        elif self.warmup_strategy == "sqrt":
+            mult = self.batch_scale * (frac ** 0.5)
+        else:  # linear (reference default 'linear')
+            mult = 1.0 + frac * (self.batch_scale - 1)
+        return float(max(mult, 1.0))
+
+    def _lars_mult(self, weight, g, wd):
+        return LARS.lars_scale(float(nd.norm(weight).asscalar()),
+                               float(nd.norm(g).asscalar()),
+                               wd, eta=0.001, eps=1e-9)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        if self.warmup_strategy == "lars":
+            lr = lr * self._lars_mult(weight, g, wd)
+        else:
+            lr = lr * self._warmup_mult() / max(self.batch_scale, 1)
+        if state is None:
+            _swap(weight, nd.sgd_update(weight, g, lr=lr, wd=wd))
+        else:
+            w, m = nd.sgd_mom_update(weight, g, state, lr=lr,
+                                     momentum=self.momentum, wd=wd)
+            _swap(weight, w)
+            _swap(state, m)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:1251,
+    'Asynchronous Stochastic Gradient Descent with Delay
+    Compensation')."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else \
+            nd.zeros(weight.shape, dtype=weight.dtype)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + wd * weight + \
+            self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            new_mom = self.momentum * mom - lr * comp
+            _swap(mom, new_mom)
+            step = new_mom
+        else:
+            step = -lr * comp
+        _swap(prev, weight.copy())
+        _swap(weight, weight + step)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference:
+    optimizer.py:1385): gradient step plus N(0, sqrt(lr)) noise —
+    sampling from the posterior rather than optimizing."""
+
+    def update(self, index, weight, grad, state):
+        import math
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        from .. import random as mxrandom
+
+        noise = mxrandom.normal(0, math.sqrt(lr), shape=weight.shape,
+                                dtype=str(weight.data.dtype))
+        _swap(weight, weight - (lr / 2) * (g + wd * weight) + noise)
+
+
 class Updater:
     """kvstore updater closure (reference: optimizer.py:1943)."""
 

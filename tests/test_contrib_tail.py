@@ -1,0 +1,236 @@
+"""Contrib op tail: fft/ifft, count_sketch, deformable conv, proposal,
+psroi pooling, mrcnn mask targets.
+
+Reference coverage model: tests/python/unittest/test_operator.py
+test_laop-style value checks + tests/python/gpu/test_operator_gpu.py
+test_deformable_convolution/test_psroipooling (numeric checks vs naive
+implementations).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = onp.random.RandomState(9)
+
+
+def test_fft_ifft_roundtrip():
+    x = rs.randn(4, 16).astype("f")
+    out = nd.contrib.fft(nd.array(x))
+    assert out.shape == (4, 32)
+    ref = onp.fft.fft(x, axis=-1)
+    inter = onp.stack([ref.real, ref.imag], -1).reshape(4, 32)
+    assert_almost_equal(out.asnumpy(), inter.astype("f"), rtol=1e-3,
+                        atol=1e-3)
+    # cuFFT-style unnormalized inverse: ifft(fft(x)) == d * x
+    back = nd.contrib.ifft(out)
+    assert_almost_equal(back.asnumpy(), 16 * x, rtol=1e-3, atol=1e-3)
+
+
+def test_fft_gradient():
+    x = rs.randn(2, 8).astype("f")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.contrib.fft(a))
+    y.backward()
+    assert a.grad.shape == (2, 8)
+    assert onp.isfinite(a.grad.asnumpy()).all()
+
+
+def test_count_sketch():
+    n, d, od = 3, 10, 6
+    x = rs.randn(n, d).astype("f")
+    h = rs.randint(0, od, (1, d))
+    s = rs.choice([-1, 1], (1, d)).astype("f")
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h.astype("f")),
+                                  nd.array(s), out_dim=od)
+    expect = onp.zeros((n, od), "f")
+    for i in range(d):
+        expect[:, h[0, i]] += s[0, i] * x[:, i]
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-5)
+
+
+def _naive_deform_conv(x, off, w, stride, pad, dilate):
+    """Scalar-loop oracle for deformable convolution (no groups)."""
+    B, C, H, W = x.shape
+    F, _, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    def bil(img, y, x_):
+        if y <= -1 or y >= img.shape[0] or x_ <= -1 or x_ >= img.shape[1]:
+            return 0.0
+        y0, x0 = int(onp.floor(y)), int(onp.floor(x_))
+        vy, vx = y - y0, x_ - x0
+        tot = 0.0
+        for (yy, xx, wgt) in [(y0, x0, (1 - vy) * (1 - vx)),
+                              (y0, x0 + 1, (1 - vy) * vx),
+                              (y0 + 1, x0, vy * (1 - vx)),
+                              (y0 + 1, x0 + 1, vy * vx)]:
+            if 0 <= yy < img.shape[0] and 0 <= xx < img.shape[1]:
+                tot += wgt * img[yy, xx]
+        return tot
+
+    out = onp.zeros((B, F, Ho, Wo), "f")
+    for b in range(B):
+        for f in range(F):
+            for oy in range(Ho):
+                for ox in range(Wo):
+                    acc = 0.0
+                    for c in range(C):
+                        for i in range(kh):
+                            for j in range(kw):
+                                k = i * kw + j
+                                y = oy * sh - ph + i * dh + \
+                                    off[b, 2 * k, oy, ox]
+                                x_ = ox * sw - pw + j * dw + \
+                                    off[b, 2 * k + 1, oy, ox]
+                                acc += w[f, c, i, j] * bil(x[b, c], y, x_)
+                    out[b, f, oy, ox] = acc
+    return out
+
+
+def test_deformable_convolution_matches_naive():
+    x = rs.randn(1, 2, 6, 6).astype("f")
+    w = rs.randn(3, 2, 3, 3).astype("f")
+    off = (rs.rand(1, 18, 6, 6).astype("f") - 0.5)
+    out = nd.contrib.deformable_convolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1), dilate=(1, 1), num_filter=3,
+        no_bias=True)
+    ref = _naive_deform_conv(x, off, w, (1, 1), (1, 1), (1, 1))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    x = rs.randn(2, 3, 8, 8).astype("f")
+    w = rs.randn(4, 3, 3, 3).astype("f")
+    off = onp.zeros((2, 18, 8, 8), "f")
+    out = nd.contrib.deformable_convolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        pad=(1, 1), num_filter=4, no_bias=True)
+    ref = nd.convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=4, no_bias=True)
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_deformable_conv_gradient():
+    x = rs.randn(1, 2, 5, 5).astype("f")
+    w = rs.randn(2, 2, 3, 3).astype("f")
+    off = (rs.rand(1, 18, 5, 5).astype("f") - 0.5) * 0.1
+    xs, offs, ws = nd.array(x), nd.array(off), nd.array(w)
+    for a in (xs, offs, ws):
+        a.attach_grad()
+    with autograd.record():
+        out = nd.contrib.deformable_convolution(
+            xs, offs, ws, kernel=(3, 3), pad=(1, 1), num_filter=2,
+            no_bias=True)
+        loss = nd.sum(out)
+    loss.backward()
+    for a in (xs, offs, ws):
+        assert onp.isfinite(a.grad.asnumpy()).all()
+        assert onp.abs(a.grad.asnumpy()).sum() > 0
+
+
+def test_proposal_shapes_and_validity():
+    K = 3 * 4  # ratios x scales (defaults: 3 ratios, 4 scales)
+    h = w = 4
+    cls = rs.rand(2, 2 * K, h, w).astype("f")
+    bbox = (rs.rand(2, 4 * K, h, w).astype("f") - 0.5) * 0.1
+    im_info = onp.array([[64, 64, 1.0], [64, 64, 1.0]], "f")
+    rois = nd.contrib.proposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, threshold=0.7,
+        rpn_min_size=4)
+    assert rois.shape == (20, 5)
+    r = rois.asnumpy()
+    assert set(onp.unique(r[:, 0])) <= {0.0, 1.0}
+    assert (r[:10, 0] == 0).all() and (r[10:, 0] == 1).all()
+    # boxes inside the image
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 63).all()
+    assert (r[:, 2] >= 0).all() and (r[:, 4] <= 63).all()
+    # with scores
+    rois2, sc = nd.contrib.proposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, output_score=True)
+    assert sc.shape == (20, 1)
+
+
+def test_psroi_pooling_constant_plane():
+    """On a channel-constant input each output cell equals its source
+    channel's constant (position-sensitive channel mapping check)."""
+    P, D = 2, 3
+    C = D * P * P
+    x = onp.zeros((1, C, 8, 8), "f")
+    for c in range(C):
+        x[0, c] = c
+    rois = onp.array([[0, 0, 0, 7, 7]], "f")
+    out = nd.contrib.psroi_pooling(nd.array(x), nd.array(rois),
+                                   spatial_scale=1.0, output_dim=D,
+                                   pooled_size=P)
+    assert out.shape == (1, D, P, P)
+    o = out.asnumpy()[0]
+    for d in range(D):
+        for i in range(P):
+            for j in range(P):
+                expect = (d * P + i) * P + j
+                assert abs(o[d, i, j] - expect) < 1e-4, (d, i, j, o[d])
+
+
+def test_psroi_pooling_gradient():
+    P, D = 2, 2
+    C = D * P * P
+    x = nd.array(rs.randn(1, C, 6, 6).astype("f"))
+    rois = nd.array(onp.array([[0, 1, 1, 4, 4]], "f"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.contrib.psroi_pooling(x, rois, spatial_scale=1.0,
+                                       output_dim=D, pooled_size=P)
+        loss = nd.sum(out)
+    loss.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_deformable_psroi_pooling_no_trans_matches_psroi_roughly():
+    P, D = 2, 2
+    C = D * P * P
+    x = rs.randn(1, C, 8, 8).astype("f")
+    rois = onp.array([[0, 0, 0, 7, 7]], "f")
+    out = nd.contrib.deformable_psroi_pooling(
+        nd.array(x), nd.array(rois), spatial_scale=1.0, output_dim=D,
+        group_size=P, pooled_size=P, sample_per_part=4, no_trans=True)
+    assert out.shape == (1, D, P, P)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_mrcnn_mask_target():
+    B, N, M = 1, 2, 3
+    rois = onp.array([[[2, 2, 10, 10], [0, 0, 6, 6]]], "f")
+    masks = onp.zeros((B, M, 16, 16), "f")
+    masks[0, 1, :, :8] = 1.0  # left half on
+    matches = onp.array([[1, 0]], "f")
+    cls_t = onp.array([[2, 1]], "f")
+    targets, weights = nd.contrib.mrcnn_mask_target(
+        nd.array(rois), nd.array(masks), nd.array(matches),
+        nd.array(cls_t), num_rois=N, num_classes=4, mask_size=(4, 4))
+    assert targets.shape == (1, 2, 4, 4, 4)
+    assert weights.shape == (1, 2, 4, 4, 4)
+    wn = weights.asnumpy()
+    assert wn[0, 0, 2].sum() == 16 and wn[0, 0, 1].sum() == 0
+    assert wn[0, 1, 1].sum() == 16
+    # roi 0 covers x 2..10 of a mask whose left half (x<8) is 1
+    t = targets.asnumpy()[0, 0, 2]
+    assert t[:, 0].mean() > 0.9 and t[:, 3].mean() < 0.1
+
+
+def test_contrib_tail_camelcase_aliases():
+    for name in ("Proposal", "MultiProposal", "PSROIPooling",
+                 "DeformableConvolution", "DeformablePSROIPooling"):
+        assert hasattr(nd.contrib, name)

@@ -1,0 +1,184 @@
+"""Optimizer tail: LARS, LBSGD, DCASGD, SGLD, multi-precision, SVRG.
+
+Reference coverage model: tests/python/unittest/test_optimizer.py
+(per-optimizer update-math checks) +
+tests/python/unittest/test_contrib_svrg_module.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon, optimizer as opt
+from mxnet_tpu.gluon import nn
+
+rs = onp.random.RandomState(2)
+
+
+def _one_step(name, params, w0, g0, steps=1):
+    o = opt.create(name, **params)
+    w = nd.array(w0.copy())
+    state = o.create_state(0, w)
+    for _ in range(steps):
+        o.update(0, w, nd.array(g0), state)
+    return w.asnumpy()
+
+
+def test_lars_update_math():
+    w0 = rs.rand(6).astype("f") + 0.5
+    g0 = rs.rand(6).astype("f")
+    lr, eta, wd = 0.1, 0.01, 0.001
+    out = _one_step("lars", {"learning_rate": lr, "eta": eta, "wd": wd,
+                             "momentum": 0.0}, w0, g0)
+    wn = onp.linalg.norm(w0)
+    gn = onp.linalg.norm(g0)
+    lr_l = lr * eta * wn / (gn + wd * wn)
+    expect = w0 - lr_l * (g0 + wd * w0)
+    onp.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_lars_skips_bias_and_bn_params():
+    o = opt.create("lars", learning_rate=0.1, eta=0.01,
+                   param_idx2name={0: "fc_bias", 1: "bn_gamma",
+                                   2: "fc_weight"})
+    assert not o._is_scaled(0)
+    assert not o._is_scaled(1)
+    assert o._is_scaled(2)
+
+
+def test_lbsgd_warmup_schedule():
+    o = opt.create("lbsgd", learning_rate=0.1, momentum=0.0,
+                   batch_scale=8, warmup_epochs=2, updates_per_epoch=10,
+                   warmup_strategy="linear")
+    m0 = o._warmup_mult()
+    for _ in range(9):
+        o._update_count(0)
+    m_mid = o._warmup_mult()  # halfway through the 20-update warmup
+    for _ in range(30):
+        o._update_count(0)
+    m_end = o._warmup_mult()
+    assert m0 < m_mid < m_end == 8.0
+
+
+def test_dcasgd_update_math():
+    w0 = rs.rand(5).astype("f")
+    g0 = rs.rand(5).astype("f")
+    lr, lamda = 0.05, 0.04
+    out = _one_step("dcasgd", {"learning_rate": lr, "lamda": lamda,
+                               "momentum": 0.0, "wd": 0.0}, w0, g0)
+    # first step: previous == current weight, so compensation is zero
+    onp.testing.assert_allclose(out, w0 - lr * g0, rtol=1e-5)
+    # two steps with constant grad: second step compensates
+    o = opt.create("dcasgd", learning_rate=lr, lamda=lamda, momentum=0.0)
+    w = nd.array(w0.copy())
+    st = o.create_state(0, w)
+    o.update(0, w, nd.array(g0), st)
+    w1 = w.asnumpy().copy()
+    o.update(0, w, nd.array(g0), st)
+    expect2 = w1 - lr * (g0 + lamda * g0 * g0 * (w1 - w0))
+    onp.testing.assert_allclose(w.asnumpy(), expect2, rtol=1e-5)
+
+
+def test_sgld_moves_and_is_stochastic():
+    mx.random.seed(0)
+    w0 = onp.zeros(1000, "f")
+    g0 = onp.zeros(1000, "f")
+    out = _one_step("sgld", {"learning_rate": 0.01}, w0, g0)
+    # pure noise step: mean ~ 0, std ~ sqrt(lr)
+    assert abs(out.mean()) < 0.02
+    assert abs(out.std() - 0.1) < 0.02
+
+
+def test_multi_precision_master_weights():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    w = nd.array(rs.rand(4).astype("float16"), dtype="float16")
+    st = o.create_state_multi_precision(0, w)
+    master, base = st
+    assert str(master.data.dtype) == "float32"
+    g = nd.array(rs.rand(4).astype("float16"), dtype="float16")
+    w_before = w.asnumpy().copy()
+    o.update_multi_precision(0, w, g, st)
+    assert str(w.data.dtype) == "float16"
+    assert not onp.allclose(w.asnumpy(), w_before)
+    # master kept full precision
+    assert str(master.data.dtype) == "float32"
+
+
+def test_svrg_module_runs_and_learns():
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    from mxnet_tpu.io import NDArrayIter
+
+    r = onp.random.RandomState(0)
+    X = r.randn(128, 10).astype("f")
+    yv = (X.sum(1) > 0).astype("f")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1",
+                             weight=sym.Variable("fc1_weight"),
+                             bias=sym.Variable("fc1_bias"))
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2",
+                             weight=sym.Variable("fc2_weight"),
+                             bias=sym.Variable("fc2_bias"))
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    it = NDArrayIter(X, yv, batch_size=32, shuffle=False,
+                     label_name="softmax_label")
+    mod = SVRGModule(net, update_freq=2)
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    import mxnet_tpu.metric as metric
+
+    m = metric.create("acc")
+    mod_score = mod.score(it, m) if hasattr(mod, "score") else None
+    # direct predict accuracy
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += len(lab)
+    assert correct / total > 0.8
+
+
+def test_svrg_variance_reduction_changes_grads():
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    from mxnet_tpu.io import NDArrayIter
+
+    r = onp.random.RandomState(1)
+    X = r.randn(64, 8).astype("f")
+    yv = r.randint(0, 2, 64).astype("f")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc",
+                             weight=sym.Variable("fc_weight"),
+                             bias=sym.Variable("fc_bias"))
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    it = NDArrayIter(X, yv, batch_size=16, label_name="softmax_label")
+    mod = SVRGModule(net, update_freq=1)
+    mod.bind([d for d in it.provide_data],
+             [d for d in it.provide_label])
+    mod.init_params()
+    mod.update_full_grads(it)
+    assert mod._param_dict and all(
+        onp.isfinite(v.asnumpy()).all()
+        for v in mod._param_dict.values())
+    it.reset()
+    batch = next(iter(it))
+    # plain gradient
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    plain = {n: mod._exec.grad_dict[n].asnumpy().copy()
+             for n in mod._param_names() if n in mod._exec.grad_dict}
+    # svrg-corrected gradient from the same batch
+    mod.forward_backward(batch)
+    changed = any(
+        not onp.allclose(plain[n],
+                         mod._exec.grad_dict[n].asnumpy())
+        for n in plain)
+    # snapshot == current params and full-grad != batch-grad => corrected
+    assert changed
