@@ -48,6 +48,8 @@ from . import model
 from . import callback
 from . import recordio
 from . import image  # noqa: F401
+from . import rnn  # noqa: F401
+from . import env  # noqa: F401
 from . import tools  # noqa: F401
 from . import contrib  # noqa: F401
 from . import profiler  # noqa: F401
@@ -67,3 +69,14 @@ viz = visualization
 
 # keep reference-style aliases
 Context = Context
+
+# env-knob wiring (mxnet_tpu.env KNOBS table): global seed + profiler
+# autostart, applied once at import like the reference's engine init
+import os as _os  # noqa: E402
+
+if _os.environ.get("MXNET_SEED"):
+    random.seed(env.get_int("MXNET_SEED", 0))
+if env.get_bool("MXNET_PROFILER_AUTOSTART"):
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+env.check()

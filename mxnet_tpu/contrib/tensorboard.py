@@ -1,0 +1,132 @@
+"""TensorBoard logging (reference: python/mxnet/contrib/tensorboard.py).
+
+The reference delegates to the external `tensorboard` package; this
+build writes genuine TensorBoard event files itself — tfrecord framing
+(masked crc32c) around hand-encoded Event/Summary protobuf messages —
+so `tensorboard --logdir` reads them with no extra dependency.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+# ---------------------------------------------------------------- crc32c
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ (poly if c & 1 else 0)
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --------------------------------------------- minimal protobuf encoding
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _field(num, wire, payload):
+    return _varint(num << 3 | wire) + payload
+
+
+def _encode_summary_value(tag, value):
+    # Summary.Value { string tag = 1; float simple_value = 2; }
+    tag_b = tag.encode()
+    body = _field(1, 2, _varint(len(tag_b)) + tag_b)
+    body += _field(2, 5, struct.pack("<f", float(value)))
+    return body
+
+
+def _encode_event(step, tag_values, wall_time=None):
+    # Event { double wall_time = 1; int64 step = 2; Summary summary = 5; }
+    # Summary { repeated Value value = 1; }
+    summary = b""
+    for tag, v in tag_values:
+        val = _encode_summary_value(tag, v)
+        summary += _field(1, 2, _varint(len(val)) + val)
+    body = _field(1, 1, struct.pack(
+        "<d", time.time() if wall_time is None else wall_time))
+    body += _field(2, 0, _varint(int(step)))
+    if summary:
+        body += _field(5, 2, _varint(len(summary)) + summary)
+    return body
+
+
+class SummaryWriter:
+    """Minimal event-file writer (API subset of tensorboard's)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        self._f = open(os.path.join(logdir, fname), "wb")
+        self._write_event(_encode_event(0, [], wall_time=time.time()))
+
+    def _write_event(self, payload):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event(_encode_event(global_step, [(tag, value)]))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming metrics to TensorBoard (reference:
+    contrib/tensorboard.py:LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in zip(*param.eval_metric.get_name_value()
+                               if hasattr(param.eval_metric,
+                                          "get_name_value")
+                               else ([param.eval_metric.get()[0]],
+                                     [param.eval_metric.get()[1]])):
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

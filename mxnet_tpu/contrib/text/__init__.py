@@ -1,0 +1,5 @@
+"""Text utilities (reference: python/mxnet/contrib/text/__init__.py)."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,169 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py).
+
+Pretrained GloVe/fastText downloads need egress; the file-backed
+CustomEmbedding path (the same loader those use underneath) is fully
+functional, and `register`/`create` keep the registry API.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as onp
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Reference: embedding.py:register."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """Reference: embedding.py:create."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown embedding '{embedding_name}'; registered: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference: embedding.py:get_pretrained_file_names."""
+    out = {name: list(getattr(cls, "pretrained_file_names", []))
+           for name, cls in _REGISTRY.items()}
+    if embedding_name is not None:
+        return out[embedding_name.lower()]
+    return out
+
+
+class TokenEmbedding(Vocabulary):
+    """Base embedding: vocabulary + vector table (reference
+    embedding.py:_TokenEmbedding)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_txt(self, path, elem_delim=" ",
+                            encoding="utf8"):
+        """Parse a '<token> <v0> <v1> ...' file (the GloVe/fastText text
+        format; reference _load_embedding)."""
+        tokens, vecs = [], []
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2:
+                    continue  # fastText header "count dim"
+                token, elems = parts[0], parts[1:]
+                if not elems:
+                    logging.warning("skipping token %r with no vector",
+                                    token)
+                    continue
+                if self._vec_len and len(elems) != self._vec_len:
+                    logging.warning("skipping token %r with bad length",
+                                    token)
+                    continue
+                self._vec_len = self._vec_len or len(elems)
+                tokens.append(token)
+                vecs.append([float(x) for x in elems])
+        table = onp.zeros((len(self._idx_to_token) + len(tokens),
+                           self._vec_len), "float32")
+        for token, vec in zip(tokens, vecs):
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+            table[self._token_to_idx[token]] = vec
+        self._idx_to_vec = nd.array(
+            table[:len(self._idx_to_token)])
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Reference: embedding.py:get_vecs_by_tokens."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idxs = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idxs.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idxs.append(self._token_to_idx[t.lower()])
+            else:
+                idxs.append(0)
+        vecs = self._idx_to_vec.asnumpy()[idxs]
+        out = nd.array(vecs)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Reference: embedding.py:update_token_vectors."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        table = onp.array(self._idx_to_vec.asnumpy())  # writable copy
+        newv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else onp.asarray(new_vectors)
+        newv = newv.reshape(len(tokens), -1)
+        for t, v in zip(tokens, newv):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown; only tokens "
+                                 "in the vocabulary can be updated")
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(table)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user text file (reference
+    embedding.py:CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        if vocabulary is not None:
+            super().__init__(counter=None, **kwargs)
+            # seed vocab from the provided vocabulary's tokens
+            for t in vocabulary.idx_to_token[1:]:
+                if t not in self._token_to_idx:
+                    self._token_to_idx[t] = len(self._idx_to_token)
+                    self._idx_to_token.append(t)
+        else:
+            super().__init__(counter=None, **kwargs)
+        if not os.path.exists(pretrained_file_path):
+            raise FileNotFoundError(pretrained_file_path)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    embedding.py:CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__()
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = vocabulary.token_to_idx
+        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for e in token_embeddings]
+        table = onp.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        self._idx_to_vec = nd.array(table)
+
+
+__all__.append("CompositeEmbedding")

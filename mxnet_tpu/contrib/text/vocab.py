@@ -1,0 +1,87 @@
+"""Indexed vocabulary (reference: python/mxnet/contrib/text/vocab.py)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Token <-> index mapping built from a Counter.
+
+    Reference: vocab.py:Vocabulary — same ordering rules (frequency
+    desc, then alphabetical), reserved tokens first, index 0 = unknown.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            res = set(reserved_tokens)
+            if len(res) != len(reserved_tokens):
+                raise ValueError("reserved tokens must be unique")
+            if unknown_token in res:
+                raise ValueError("unknown token cannot be reserved")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens \
+            else None
+        self._idx_to_token = [unknown_token] + (
+            list(reserved_tokens) if reserved_tokens else [])
+        self._token_to_idx = collections.defaultdict(
+            lambda: 0, {t: i for i, t in enumerate(self._idx_to_token)})
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        existing = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda kv: kv[0])
+        pairs.sort(key=lambda kv: kv[1], reverse=True)
+        kept = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and kept >= most_freq_count:
+                break
+            if token not in existing:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                kept += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices (unknown -> 0)."""
+        if isinstance(tokens, str):
+            return self._token_to_idx[tokens]
+        return [self._token_to_idx[t] for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            indices = [indices]
+            single = True
+        else:
+            single = False
+        out = []
+        for i in indices:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"index {i} out of vocabulary range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

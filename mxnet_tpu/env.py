@@ -1,0 +1,107 @@
+"""Environment-variable knob registry.
+
+Reference: docs/static_site/src/pages/api/faq/env_var.md (~80 MXNET_*
+knobs). On TPU most CUDA/MKLDNN/ps-lite knobs have no analog — XLA owns
+kernel tuning and memory — so each documented knob is either WIRED
+(changes behavior here), ACCEPTED (read, validated, intentionally a
+no-op because XLA/PJRT owns that concern), or absent. ``describe()``
+prints the table; ``check()`` warns about set-but-unknown MXNET_ vars
+so typos don't silently do nothing.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KNOBS", "describe", "check", "get_int", "get_float",
+           "get_bool"]
+
+# name -> (status, consumer, description)
+KNOBS = {
+    # wired
+    "MXNET_ENGINE_TYPE": (
+        "wired", "engine.get", "ThreadedEngine (native) | NaiveEngine"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "wired", "engine.Engine", "host worker-pool size"),
+    "MXNET_MP_WORKER_NTHREADS": (
+        "wired", "gluon DataLoader", "default data-loading workers"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "wired", "kvstore", "row-shard stored values above this size"),
+    "MXNET_CPU_MEM_POOL_DISABLE": (
+        "wired", "storage", "disable the pooled host allocator"),
+    "MXNET_HOME": ("wired", "model_store/base", "cache directory"),
+    "MXNET_GLUON_REPO": (
+        "wired", "model_store", "pretrained-weight repo URL"),
+    "MXNET_SEED": (
+        "wired", "random", "global PRNG seed applied at import"),
+    "MXNET_PROFILER_AUTOSTART": (
+        "wired", "profiler", "start profiling at import when 1"),
+    "MXNET_ENFORCE_DETERMINISM": (
+        "wired", "random/io", "thread-pool decode keeps input order; "
+        "all compute is already deterministic under XLA"),
+    "MXNET_COORDINATOR": (
+        "wired", "tools.launch", "jax.distributed coordinator addr"),
+    "MXNET_NUM_PROCESSES": ("wired", "tools.launch", "world size"),
+    "MXNET_PROCESS_ID": ("wired", "tools.launch", "process rank"),
+    "MXNET_KVSTORE_GC_TYPE": (
+        "wired", "kvstore", "gradient compression type via env"),
+    "MXNET_KVSTORE_GC_THRESHOLD": (
+        "wired", "kvstore", "gradient compression threshold via env"),
+    # accepted no-ops: the concern is owned by XLA/PJRT on TPU
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (
+        "accepted", "-", "XLA fuses whole programs; always bulk"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "accepted", "-", "XLA fuses whole programs; always bulk"),
+    "MXNET_GPU_MEM_POOL_RESERVE": (
+        "accepted", "-", "HBM is managed by PJRT"),
+    "MXNET_GPU_MEM_POOL_TYPE": ("accepted", "-", "PJRT-owned"),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
+        "accepted", "-", "XLA autotuning replaces cuDNN autotune"),
+    "MXNET_ENABLE_GPU_P2P": ("accepted", "-", "ICI always on"),
+    "MXNET_KVSTORE_USETREE": (
+        "accepted", "-", "XLA picks the reduction topology"),
+    "MXNET_CPU_PRIORITY_NTHREADS": (
+        "accepted", "engine", "priority lanes share the one pool"),
+    "MXNET_EXEC_NUM_TEMP": ("accepted", "-", "XLA memory planning"),
+    "MXNET_GPU_WORKER_NTHREADS": ("accepted", "-", "PJRT streams"),
+}
+
+
+def get_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        logging.warning("invalid integer for %s; using %s", name,
+                        default)
+        return int(default)
+
+
+def get_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        logging.warning("invalid float for %s; using %s", name, default)
+        return float(default)
+
+
+def get_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+def describe():
+    lines = [f"{name:36s} {status:9s} {desc}"
+             for name, (status, _, desc) in sorted(KNOBS.items())]
+    return "\n".join(lines)
+
+
+def check():
+    """Warn about set-but-unrecognized MXNET_ vars (typo guard)."""
+    unknown = [k for k in os.environ
+               if k.startswith("MXNET_") and k not in KNOBS]
+    for k in unknown:
+        logging.warning("environment variable %s is not recognized by "
+                        "mxnet_tpu (see mxnet_tpu.env.describe())", k)
+    return unknown

@@ -37,8 +37,10 @@ class DataLoader:
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 num_workers=None, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=True, timeout=120):
+        # num_workers: None (default) falls back to MXNET_MP_WORKER_NTHREADS;
+        # an EXPLICIT 0 stays synchronous regardless of the env var
         self._dataset = dataset
         self._pin_memory = pin_memory
         if batch_sampler is None:
@@ -59,6 +61,10 @@ class DataLoader:
                              "must not be specified if batch_sampler is "
                              "specified.")
         self._batch_sampler = batch_sampler
+        if num_workers is None:
+            from ... import env as _env
+
+            num_workers = _env.get_int("MXNET_MP_WORKER_NTHREADS", 0)
         self._num_workers = num_workers
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * max(num_workers, 1))

@@ -304,6 +304,17 @@ _VALID = ("local", "device", "nccl", "dist_sync", "dist_async",
 
 def create(name="local"):
     """Reference: src/kvstore/kvstore.cc:40-73 KVStore::Create."""
+    import os
+
     if name not in _VALID:
         raise MXNetError(f"unknown kvstore type {name}")
-    return KVStore(name)
+    kv = KVStore(name)
+    gc_type = os.environ.get("MXNET_KVSTORE_GC_TYPE")
+    if gc_type:
+        from . import env as _env
+
+        kv.set_gradient_compression({
+            "type": gc_type,
+            "threshold": _env.get_float("MXNET_KVSTORE_GC_THRESHOLD",
+                                        0.5)})
+    return kv

@@ -20,9 +20,19 @@ __all__ = ["seed", "next_key", "key_provider", "uniform", "normal", "randn",
            "generalized_negative_binomial", "multinomial"]
 
 
+# process-wide base seed: fresh per-thread states derive from it (with a
+# thread-id fold-in so threads draw DIFFERENT streams), and mx.random.seed
+# re-seeds it for threads created afterwards
+_GLOBAL_SEED = [0]
+
+
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        base = jax.random.PRNGKey(_GLOBAL_SEED[0])
+        if threading.current_thread() is not threading.main_thread():
+            base = jax.random.fold_in(base, threading.get_ident()
+                                      & 0x7FFFFFFF)
+        self.key = base
         self.providers = []
 
 
@@ -32,8 +42,11 @@ _STATE = _RngState()
 def seed(seed_state, ctx="all"):
     """Set the global seed (reference: mx.random.seed,
     python/mxnet/random.py; MXRandomSeed → ResourceManager SeedRandom
-    src/resource.cc:174)."""
+    src/resource.cc:174). Applies to this thread immediately and to
+    threads created afterwards via the process-wide base seed."""
+    _GLOBAL_SEED[0] = int(seed_state)
     _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.providers = []
 
 
 def next_key():

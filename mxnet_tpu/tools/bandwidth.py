@@ -1,0 +1,72 @@
+"""Collective-bandwidth measurement tool.
+
+Reference: tools/bandwidth/measure.py (kvstore push/pull throughput
+across devices). TPU-native: times the compiled group all-reduce over
+the local device mesh (the path kvstore 'device'/'dist' rides) and the
+kvstore push/pull round-trip, reporting GB/s per size.
+
+  python -m mxnet_tpu.tools.bandwidth --sizes 1e6,1e7 --iters 10
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+__all__ = ["measure", "main"]
+
+
+def measure(size, iters=10, warmup=2):
+    """Returns {collective_gbps, kvstore_gbps} for float32 arrays of
+    `size` elements reduced across all local devices."""
+    import jax
+    import numpy as onp
+
+    from .. import nd, kvstore
+    from ..parallel import group_all_reduce
+
+    devs = jax.local_devices()
+    n = len(devs)
+    vals = [nd.NDArray(jax.device_put(
+        onp.random.rand(int(size)).astype("f"), d)) for d in devs]
+    for _ in range(warmup):
+        out = group_all_reduce(vals)
+    jax.block_until_ready([o.data for o in out])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = group_all_reduce(vals)
+    jax.block_until_ready([o.data for o in out])
+    dt = (time.perf_counter() - t0) / iters
+    # ring all-reduce moves 2*(n-1)/n of the payload per device
+    nbytes = int(size) * 4 * 2 * (n - 1) / max(n, 1)
+    coll = nbytes / dt / 1e9
+
+    kv = kvstore.create("device")
+    kv.init("x", nd.zeros((int(size),)))
+    outarr = nd.zeros((int(size),))
+    for _ in range(warmup):
+        kv.push("x", vals)
+        kv.pull("x", out=outarr)
+    outarr.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.push("x", vals)
+        kv.pull("x", out=outarr)
+    outarr.wait_to_read()
+    dt = (time.perf_counter() - t0) / iters
+    kvs = nbytes / dt / 1e9
+    return {"num_devices": n, "size": int(size),
+            "collective_gbps": round(coll, 3),
+            "kvstore_gbps": round(kvs, 3)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes", default="1e5,1e6,1e7")
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args(argv)
+    for s in args.sizes.split(","):
+        print(measure(float(s), args.iters))
+
+
+if __name__ == "__main__":
+    main()
