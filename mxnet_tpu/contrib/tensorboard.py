@@ -122,11 +122,7 @@ class LogMetricsCallback:
         self.step += 1
         if param.eval_metric is None:
             return
-        for name, value in zip(*param.eval_metric.get_name_value()
-                               if hasattr(param.eval_metric,
-                                          "get_name_value")
-                               else ([param.eval_metric.get()[0]],
-                                     [param.eval_metric.get()[1]])):
+        for name, value in param.eval_metric.get_name_value():
             if self.prefix is not None:
                 name = f"{self.prefix}-{name}"
             self.summary_writer.add_scalar(name, value, self.step)

@@ -28,8 +28,10 @@ class Vocabulary:
             else None
         self._idx_to_token = [unknown_token] + (
             list(reserved_tokens) if reserved_tokens else [])
-        self._token_to_idx = collections.defaultdict(
-            lambda: 0, {t: i for i, t in enumerate(self._idx_to_token)})
+        # plain dict: a defaultdict would INSERT unknown tokens on
+        # lookup, corrupting later membership checks
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
         if counter is not None:
             self._index_counter_keys(counter, most_freq_count, min_freq)
 
@@ -70,8 +72,8 @@ class Vocabulary:
     def to_indices(self, tokens):
         """Token(s) -> index/indices (unknown -> 0)."""
         if isinstance(tokens, str):
-            return self._token_to_idx[tokens]
-        return [self._token_to_idx[t] for t in tokens]
+            return self._token_to_idx.get(tokens, 0)
+        return [self._token_to_idx.get(t, 0) for t in tokens]
 
     def to_tokens(self, indices):
         if isinstance(indices, int):

@@ -287,17 +287,18 @@ class GroupNorm(HybridBlock):
         self._num_groups = num_groups
         self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get("gamma", shape=(in_channels,),
+            # gamma/beta are PER-GROUP (reference gluon
+            # basic_layers.py:700 shape=(num_groups,))
+            self.gamma = self.params.get("gamma", shape=(num_groups,),
                                          init=gamma_initializer,
                                          allow_deferred_init=True)
-            self.beta = self.params.get("beta", shape=(in_channels,),
+            self.beta = self.params.get("beta", shape=(num_groups,),
                                         init=beta_initializer,
                                         allow_deferred_init=True)
 
     def infer_param_shapes(self, x, *args):
-        c = x.shape[1]
-        self.gamma.shape = (c,)
-        self.beta.shape = (c,)
+        self.gamma.shape = (self._num_groups,)
+        self.beta.shape = (self._num_groups,)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.group_norm(x, gamma, beta, num_groups=self._num_groups,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax import lax
 
 from .registry import register
@@ -39,18 +40,28 @@ def _head_grad_free(fwd_fn, grad_fn):
     return f
 
 
+def _per_sample(data):
+    """grad_scale / num_output scaling, num_output = per-sample feature
+    count (reference regression_output-inl.h:201)."""
+    return max(int(onp.prod(data.shape[1:])), 1) if data.ndim > 1 else 1
+
+
 _linreg = _head_grad_free(
     lambda data, label, gs: data,
-    lambda data, label, gs, g: (data - label.reshape(data.shape)) * gs)
+    lambda data, label, gs, g:
+        (data - label.reshape(data.shape)) * (gs / _per_sample(data)))
 
 _maereg = _head_grad_free(
     lambda data, label, gs: data,
-    lambda data, label, gs, g: jnp.sign(data - label.reshape(data.shape)) * gs)
+    lambda data, label, gs, g:
+        jnp.sign(data - label.reshape(data.shape))
+        * (gs / _per_sample(data)))
 
 _logreg = _head_grad_free(
     lambda data, label, gs: jax.nn.sigmoid(data),
     lambda data, label, gs, g:
-        (jax.nn.sigmoid(data) - label.reshape(data.shape)) * gs)
+        (jax.nn.sigmoid(data) - label.reshape(data.shape))
+        * (gs / _per_sample(data)))
 
 
 @register()

@@ -307,7 +307,9 @@ def instance_norm(data, gamma, beta, eps=1e-3):
 
 @register()
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
-    """Reference: src/operator/nn/group_norm.cc."""
+    """Reference: src/operator/nn/group_norm.cc — gamma/beta are
+    PER-GROUP (shape (num_groups,)), applied on the grouped view
+    (group_norm-inl.h:163 new_param_shape[1]=num_groups)."""
     n, c = data.shape[:2]
     rest = data.shape[2:]
     x = data.reshape((n, num_groups, c // num_groups) + rest)
@@ -315,9 +317,9 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     mean = jnp.mean(x, axis=ax, keepdims=True)
     var = jnp.var(x, axis=ax, keepdims=True)
     x = (x - mean) * lax.rsqrt(var + eps)
-    x = x.reshape(data.shape)
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    gshape = (1, num_groups) + (1,) * (x.ndim - 2)
+    x = x * gamma.reshape(gshape) + beta.reshape(gshape)
+    return x.reshape(data.shape)
 
 
 @register()

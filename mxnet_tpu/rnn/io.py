@@ -66,7 +66,11 @@ class BucketSentenceIter(DataIter):
             buff = onp.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [onp.asarray(x, dtype=dtype) for x in self.data]
+        # empty buckets keep a 2-D (0, bucket_len) shape so reset()'s
+        # label shift slicing stays valid
+        self.data = [onp.asarray(x, dtype=dtype) if x
+                     else onp.empty((0, blen), dtype=dtype)
+                     for x, blen in zip(self.data, buckets)]
         if ndiscard:
             import logging
 

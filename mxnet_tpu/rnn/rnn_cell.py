@@ -108,12 +108,29 @@ class RNNCell(BaseRNNCell):
 
 
 class LSTMCell(BaseRNNCell):
-    """LSTM cell (reference rnn_cell.py:LSTMCell; gate order i,f,g,o)."""
+    """LSTM cell (reference rnn_cell.py:LSTMCell; gate order i,f,c,o).
+
+    ``forget_bias`` is an INITIALIZATION hint, exposed as
+    ``bias_init_value()``: the reference seeds the forget-gate slice of
+    h2h_bias with it via the LSTMBias initializer; in this symbolic API
+    the caller owns parameter values at bind time, so seed your
+    h2h_bias with ``bias_init_value()`` to reproduce that behavior (the
+    gate math itself is identical either way)."""
 
     def __init__(self, num_hidden, prefix="lstm_", params=None,
                  forget_bias=1.0):
         super().__init__(prefix, params)
         self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+
+    def bias_init_value(self):
+        """h2h_bias seed honoring forget_bias (reference LSTMBias
+        initializer, python/mxnet/initializer.py:LSTMBias)."""
+        import numpy as onp
+
+        b = onp.zeros(4 * self._num_hidden, "float32")
+        b[self._num_hidden:2 * self._num_hidden] = self._forget_bias
+        return b
         self._iW = self._var("i2h_weight")
         self._iB = self._var("i2h_bias")
         self._hW = self._var("h2h_weight")

@@ -122,6 +122,8 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
     if args.launcher == "ssh" or args.host_file:
+        if not args.host_file:
+            parser.error("ssh launcher requires --host-file")
         return submit_ssh(args)
     return submit_local(args)
 

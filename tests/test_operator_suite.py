@@ -1,0 +1,516 @@
+"""Op-spec suite, part 1: unary math, binary/broadcast, reductions,
+shape manipulation — value checks against numpy oracles + numeric
+gradients for the differentiable families.
+
+Reference coverage model: tests/python/unittest/test_operator.py
+(test_unary_math_operators, test_binary_op, test_reduce,
+test_reshape/test_transpose/...).
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+
+rs = onp.random.RandomState(77)
+
+
+def _x(shape=(3, 4), lo=-2.0, hi=2.0):
+    return (rs.rand(*shape) * (hi - lo) + lo).astype("f")
+
+
+# ------------------------------------------------------------ unary math ---
+
+def _unary_case(opname, np_fn, lo=-2.0, hi=2.0, grad=True, rtol=1e-4):
+    x = _x(lo=lo, hi=hi)
+    out = getattr(nd, opname)(nd.array(x))
+    assert_almost_equal(out.asnumpy(), np_fn(x).astype("f"), rtol=rtol,
+                        atol=1e-5)
+    if grad:
+        check_numeric_gradient(lambda a: getattr(nd, opname)(a), [x],
+                               rtol=2e-2, atol=1e-3)
+
+
+def test_op_exp():
+    _unary_case("exp", onp.exp)
+
+
+def test_op_log():
+    _unary_case("log", onp.log, lo=0.1, hi=4.0)
+
+
+def test_op_log2_log10_log1p_expm1():
+    for name, fn, lo in [("log2", onp.log2, 0.1), ("log10", onp.log10,
+                                                   0.1),
+                         ("log1p", onp.log1p, -0.5),
+                         ("expm1", onp.expm1, -1.0)]:
+        x = _x(lo=lo, hi=3.0)
+        assert_almost_equal(getattr(nd, name)(nd.array(x)).asnumpy(),
+                            fn(x).astype("f"), rtol=1e-4, atol=1e-5)
+
+
+def test_op_sqrt_rsqrt_cbrt_rcbrt():
+    x = _x(lo=0.2, hi=4.0)
+    assert_almost_equal(nd.sqrt(nd.array(x)).asnumpy(), onp.sqrt(x),
+                        rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(nd.array(x)).asnumpy(),
+                        1 / onp.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.cbrt(nd.array(x)).asnumpy(), onp.cbrt(x),
+                        rtol=1e-5)
+    assert_almost_equal(nd.rcbrt(nd.array(x)).asnumpy(),
+                        1 / onp.cbrt(x), rtol=1e-5)
+
+
+def test_op_square_reciprocal():
+    _unary_case("square", onp.square)
+    _unary_case("reciprocal", lambda v: 1.0 / v, lo=0.5, hi=3.0)
+
+
+def test_op_abs_sign_negative():
+    x = _x()
+    assert_almost_equal(nd.abs(nd.array(x)).asnumpy(), onp.abs(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.sign(nd.array(x)).asnumpy(), onp.sign(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.negative(nd.array(x)).asnumpy(), -x,
+                        rtol=1e-6)
+
+
+def test_op_rounding_family():
+    x = _x(lo=-3.0, hi=3.0)
+    assert_almost_equal(nd.floor(nd.array(x)).asnumpy(), onp.floor(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.ceil(nd.array(x)).asnumpy(), onp.ceil(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.trunc(nd.array(x)).asnumpy(), onp.trunc(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.rint(nd.array(x)).asnumpy(), onp.rint(x),
+                        rtol=1e-6)
+    assert_almost_equal(nd.fix(nd.array(x)).asnumpy(), onp.fix(x),
+                        rtol=1e-6)
+
+
+def test_op_trig():
+    x = _x(lo=-1.2, hi=1.2)
+    for name, fn in [("sin", onp.sin), ("cos", onp.cos),
+                     ("tan", onp.tan)]:
+        assert_almost_equal(getattr(nd, name)(nd.array(x)).asnumpy(),
+                            fn(x).astype("f"), rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(lambda a: nd.sin(a), [x], rtol=2e-2,
+                           atol=1e-3)
+
+
+def test_op_hyperbolic():
+    x = _x(lo=-1.5, hi=1.5)
+    for name, fn in [("sinh", onp.sinh), ("cosh", onp.cosh),
+                     ("tanh", onp.tanh)]:
+        assert_almost_equal(getattr(nd, name)(nd.array(x)).asnumpy(),
+                            fn(x).astype("f"), rtol=1e-4, atol=1e-5)
+
+
+def test_op_degrees_radians():
+    x = _x(lo=-180, hi=180)
+    assert_almost_equal(nd.degrees(nd.array(x)).asnumpy(),
+                        onp.degrees(x).astype("f"), rtol=1e-5)
+    assert_almost_equal(nd.radians(nd.array(x)).asnumpy(),
+                        onp.radians(x).astype("f"), rtol=1e-5)
+
+
+def test_op_erf_erfinv():
+    x = _x(lo=-1.5, hi=1.5)
+    expect = onp.array([[math.erf(v) for v in row] for row in x], "f")
+    assert_almost_equal(nd.erf(nd.array(x)).asnumpy(), expect,
+                        rtol=1e-4, atol=1e-5)
+    y = _x(lo=-0.9, hi=0.9)
+    inv = nd.erfinv(nd.array(y))
+    back = onp.array([[math.erf(v) for v in row]
+                      for row in inv.asnumpy()], "f")
+    assert_almost_equal(back, y, rtol=1e-3, atol=1e-4)
+
+
+def test_op_gamma_gammaln():
+    x = _x(lo=0.5, hi=4.0)
+    expect = onp.array([[math.gamma(v) for v in row] for row in x], "f")
+    assert_almost_equal(nd.gamma(nd.array(x)).asnumpy(), expect,
+                        rtol=1e-3)
+    expectln = onp.array([[math.lgamma(v) for v in row] for row in x],
+                         "f")
+    assert_almost_equal(nd.gammaln(nd.array(x)).asnumpy(), expectln,
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_op_sigmoid_softsign_hard_sigmoid():
+    x = _x()
+    assert_almost_equal(nd.sigmoid(nd.array(x)).asnumpy(),
+                        1 / (1 + onp.exp(-x)), rtol=1e-4)
+    assert_almost_equal(nd.softsign(nd.array(x)).asnumpy(),
+                        x / (1 + onp.abs(x)), rtol=1e-4)
+    hs = nd.hard_sigmoid(nd.array(x))
+    assert_almost_equal(hs.asnumpy(),
+                        onp.clip(0.2 * x + 0.5, 0, 1), rtol=1e-4)
+
+
+def test_op_relu_grad_at_kink():
+    x = onp.array([[-1.0, 0.5, 2.0, -0.25]], "f")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.relu(a)
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), (x > 0).astype("f"),
+                        rtol=1e-6)
+
+
+def test_op_clip_gradient_masks():
+    x = onp.array([[-2.0, 0.0, 0.5, 3.0]], "f")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.clip(a, -1.0, 1.0)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), onp.clip(x, -1, 1), rtol=1e-6)
+    assert_almost_equal(a.grad.asnumpy(),
+                        ((x > -1) & (x < 1)).astype("f"), rtol=1e-6)
+
+
+# --------------------------------------------------------- binary family ---
+
+def test_op_elemwise_binary():
+    a, b = _x(), _x(lo=0.5, hi=2.0)
+    assert_almost_equal(nd.elemwise_add(nd.array(a),
+                                        nd.array(b)).asnumpy(), a + b,
+                        rtol=1e-5)
+    assert_almost_equal(nd.elemwise_sub(nd.array(a),
+                                        nd.array(b)).asnumpy(), a - b,
+                        rtol=1e-5)
+    assert_almost_equal(nd.elemwise_mul(nd.array(a),
+                                        nd.array(b)).asnumpy(), a * b,
+                        rtol=1e-5)
+    assert_almost_equal(nd.elemwise_div(nd.array(a),
+                                        nd.array(b)).asnumpy(), a / b,
+                        rtol=1e-5)
+
+
+def test_op_broadcast_binary_shapes():
+    a = _x((2, 1, 4))
+    b = _x((1, 3, 1))
+    for name, fn in [("broadcast_add", onp.add),
+                     ("broadcast_sub", onp.subtract),
+                     ("broadcast_mul", onp.multiply),
+                     ("broadcast_maximum", onp.maximum),
+                     ("broadcast_minimum", onp.minimum)]:
+        out = getattr(nd, name)(nd.array(a), nd.array(b))
+        assert out.shape == (2, 3, 4)
+        assert_almost_equal(out.asnumpy(), fn(a, b).astype("f"),
+                            rtol=1e-5)
+
+
+def test_op_broadcast_power_mod_hypot():
+    a = _x(lo=0.5, hi=2.0)
+    b = _x(lo=0.5, hi=2.0)
+    assert_almost_equal(
+        nd.broadcast_power(nd.array(a), nd.array(b)).asnumpy(),
+        onp.power(a, b).astype("f"), rtol=1e-4)
+    assert_almost_equal(
+        nd.broadcast_mod(nd.array(a), nd.array(b)).asnumpy(),
+        onp.mod(a, b).astype("f"), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        nd.broadcast_hypot(nd.array(a), nd.array(b)).asnumpy(),
+        onp.hypot(a, b).astype("f"), rtol=1e-4)
+
+
+def test_op_comparison_family():
+    a = _x()
+    b = _x()
+    for name, fn in [("broadcast_equal", onp.equal),
+                     ("broadcast_not_equal", onp.not_equal),
+                     ("broadcast_greater", onp.greater),
+                     ("broadcast_greater_equal", onp.greater_equal),
+                     ("broadcast_lesser", onp.less),
+                     ("broadcast_lesser_equal", onp.less_equal)]:
+        out = getattr(nd, name)(nd.array(a), nd.array(b))
+        assert_almost_equal(out.asnumpy(), fn(a, b).astype("f"),
+                            rtol=1e-6)
+
+
+def test_op_logical_family():
+    a = (rs.rand(3, 4) > 0.5).astype("f")
+    b = (rs.rand(3, 4) > 0.5).astype("f")
+    assert_almost_equal(
+        nd.broadcast_logical_and(nd.array(a), nd.array(b)).asnumpy(),
+        onp.logical_and(a, b).astype("f"), rtol=1e-6)
+    assert_almost_equal(
+        nd.broadcast_logical_or(nd.array(a), nd.array(b)).asnumpy(),
+        onp.logical_or(a, b).astype("f"), rtol=1e-6)
+    assert_almost_equal(
+        nd.broadcast_logical_xor(nd.array(a), nd.array(b)).asnumpy(),
+        onp.logical_xor(a, b).astype("f"), rtol=1e-6)
+    assert_almost_equal(nd.logical_not(nd.array(a)).asnumpy(),
+                        onp.logical_not(a).astype("f"), rtol=1e-6)
+
+
+def test_op_scalar_binops_reverse():
+    a = _x(lo=0.5, hi=2.0)
+    x = nd.array(a)
+    assert_almost_equal((3.0 - x).asnumpy(), 3.0 - a, rtol=1e-5)
+    assert_almost_equal((3.0 / x).asnumpy(), 3.0 / a, rtol=1e-5)
+    assert_almost_equal((x ** 2.0).asnumpy(), a ** 2, rtol=1e-5)
+    assert_almost_equal((2.0 ** x).asnumpy(), 2.0 ** a, rtol=1e-4)
+
+
+def test_op_binary_gradients():
+    a, b = _x(lo=0.5, hi=2.0), _x(lo=0.5, hi=2.0)
+    check_numeric_gradient(
+        lambda x, y: nd.broadcast_mul(x, y) + nd.broadcast_div(x, y),
+        [a, b], rtol=2e-2, atol=1e-3)
+
+
+def test_op_maximum_minimum_scalar():
+    a = _x()
+    assert_almost_equal(nd.maximum(nd.array(a), 0.5).asnumpy(),
+                        onp.maximum(a, 0.5), rtol=1e-6)
+    assert_almost_equal(nd.minimum(nd.array(a), 0.5).asnumpy(),
+                        onp.minimum(a, 0.5), rtol=1e-6)
+
+
+def test_op_where():
+    cond = (rs.rand(3, 4) > 0.5).astype("f")
+    a, b = _x(), _x()
+    out = nd.where(nd.array(cond), nd.array(a), nd.array(b))
+    assert_almost_equal(out.asnumpy(), onp.where(cond > 0, a, b),
+                        rtol=1e-6)
+
+
+def test_op_dot_transpose_flags():
+    a = _x((3, 4))
+    b = _x((4, 5))
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                        a @ b, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4)
+
+
+# ------------------------------------------------------------ reductions ---
+
+def test_op_sum_axis_exclude_keepdims():
+    x = _x((2, 3, 4))
+    assert_almost_equal(nd.sum(nd.array(x)).asnumpy(),
+                        x.sum().astype("f"), rtol=1e-4)
+    assert_almost_equal(nd.sum(nd.array(x), axis=1).asnumpy(),
+                        x.sum(1), rtol=1e-4)
+    assert_almost_equal(
+        nd.sum(nd.array(x), axis=1, keepdims=True).asnumpy(),
+        x.sum(1, keepdims=True), rtol=1e-4)
+    assert_almost_equal(
+        nd.sum(nd.array(x), axis=1, exclude=True).asnumpy(),
+        x.sum(axis=(0, 2)), rtol=1e-4)
+
+
+def test_op_mean_prod_max_min():
+    x = _x((2, 3, 4), lo=0.5, hi=1.5)
+    assert_almost_equal(nd.mean(nd.array(x), axis=2).asnumpy(),
+                        x.mean(2), rtol=1e-4)
+    assert_almost_equal(nd.prod(nd.array(x), axis=0).asnumpy(),
+                        x.prod(0), rtol=1e-4)
+    assert_almost_equal(nd.max(nd.array(x), axis=1).asnumpy(),
+                        x.max(1), rtol=1e-5)
+    assert_almost_equal(nd.min(nd.array(x), axis=1).asnumpy(),
+                        x.min(1), rtol=1e-5)
+
+
+def test_op_nansum_nanprod():
+    x = _x((3, 4))
+    x[0, 0] = onp.nan
+    x[1, 2] = onp.nan
+    assert_almost_equal(nd.nansum(nd.array(x), axis=0).asnumpy(),
+                        onp.nansum(x, 0), rtol=1e-4)
+    assert_almost_equal(nd.nanprod(nd.array(x), axis=0).asnumpy(),
+                        onp.nanprod(x, 0), rtol=1e-4)
+
+
+def test_op_norm_orders():
+    x = _x((3, 4))
+    assert_almost_equal(nd.norm(nd.array(x)).asnumpy(),
+                        onp.linalg.norm(x).astype("f"), rtol=1e-4)
+    assert_almost_equal(nd.norm(nd.array(x), ord=1, axis=1).asnumpy(),
+                        onp.abs(x).sum(1), rtol=1e-4)
+    assert_almost_equal(nd.norm(nd.array(x), ord=2, axis=0).asnumpy(),
+                        onp.sqrt((x * x).sum(0)), rtol=1e-4)
+
+
+def test_op_argmax_argmin():
+    x = _x((3, 5))
+    assert_almost_equal(nd.argmax(nd.array(x), axis=1).asnumpy(),
+                        x.argmax(1).astype("f"), rtol=1e-6)
+    assert_almost_equal(nd.argmin(nd.array(x), axis=0).asnumpy(),
+                        x.argmin(0).astype("f"), rtol=1e-6)
+
+
+def test_op_sum_gradient_broadcast_back():
+    x = _x((2, 3))
+    check_numeric_gradient(
+        lambda a: nd.sum(a, axis=1, keepdims=True) * a, [x],
+        rtol=2e-2, atol=1e-3)
+
+
+# ------------------------------------------------------------ shape ops ---
+
+def test_op_reshape_special_codes():
+    x = _x((2, 3, 4))
+    assert nd.reshape(nd.array(x), shape=(-1,)).shape == (24,)
+    assert nd.reshape(nd.array(x), shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(nd.array(x), shape=(4, 6)).shape == (4, 6)
+    assert_almost_equal(
+        nd.reshape(nd.array(x), shape=(4, 6)).asnumpy(),
+        x.reshape(4, 6), rtol=1e-6)
+
+
+def test_op_transpose_swapaxes():
+    x = _x((2, 3, 4))
+    assert_almost_equal(nd.transpose(nd.array(x)).asnumpy(),
+                        x.T, rtol=1e-6)
+    assert_almost_equal(
+        nd.transpose(nd.array(x), axes=(1, 0, 2)).asnumpy(),
+        x.transpose(1, 0, 2), rtol=1e-6)
+    assert_almost_equal(nd.swapaxes(nd.array(x), 0, 2).asnumpy(),
+                        x.swapaxes(0, 2), rtol=1e-6)
+
+
+def test_op_flip_reverse():
+    x = _x((2, 3))
+    assert_almost_equal(nd.flip(nd.array(x), axis=1).asnumpy(),
+                        x[:, ::-1], rtol=1e-6)
+    assert_almost_equal(nd.reverse(nd.array(x), axis=0).asnumpy(),
+                        x[::-1], rtol=1e-6)
+
+
+def test_op_tile_repeat():
+    x = _x((2, 3))
+    assert_almost_equal(nd.tile(nd.array(x), reps=(2, 2)).asnumpy(),
+                        onp.tile(x, (2, 2)), rtol=1e-6)
+    assert_almost_equal(
+        nd.repeat(nd.array(x), repeats=2, axis=1).asnumpy(),
+        onp.repeat(x, 2, 1), rtol=1e-6)
+    assert_almost_equal(nd.repeat(nd.array(x), repeats=2).asnumpy(),
+                        onp.repeat(x, 2), rtol=1e-6)
+
+
+def test_op_expand_squeeze():
+    x = _x((2, 3))
+    e = nd.expand_dims(nd.array(x), axis=1)
+    assert e.shape == (2, 1, 3)
+    s = nd.squeeze(e)
+    assert s.shape == (2, 3)
+    assert_almost_equal(s.asnumpy(), x, rtol=1e-6)
+
+
+def test_op_stack_concat_split():
+    a, b = _x((2, 3)), _x((2, 3))
+    st = nd.stack(nd.array(a), nd.array(b), axis=1)
+    assert st.shape == (2, 2, 3)
+    cc = nd.concat(nd.array(a), nd.array(b), dim=0)
+    assert cc.shape == (4, 3)
+    parts = nd.split(nd.array(a), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    sq = nd.split(nd.array(a), num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+def test_op_slice_family():
+    x = _x((4, 6))
+    assert_almost_equal(
+        nd.slice(nd.array(x), begin=(1, 2), end=(3, 5)).asnumpy(),
+        x[1:3, 2:5], rtol=1e-6)
+    assert_almost_equal(
+        nd.slice_axis(nd.array(x), axis=1, begin=1, end=4).asnumpy(),
+        x[:, 1:4], rtol=1e-6)
+    y = _x((2, 3))
+    out = nd.slice_like(nd.array(x), nd.array(y))
+    assert out.shape == (2, 3)
+    ch = nd.slice_channel(nd.array(x), num_outputs=2, axis=1)
+    assert len(ch) == 2 and ch[0].shape == (4, 3)
+
+
+def test_op_pad_constant_edge():
+    x = _x((1, 2, 3, 3))
+    out = nd.pad(nd.array(x), mode="constant",
+                 pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=7.0)
+    assert out.shape == (1, 2, 5, 7)
+    assert (out.asnumpy()[0, 0, 0] == 7).all()
+    oute = nd.pad(nd.array(x), mode="edge",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert_almost_equal(oute.asnumpy()[0, 0, 0, 1:-1], x[0, 0, 0],
+                        rtol=1e-6)
+
+
+def test_op_depth_space_roundtrip():
+    x = _x((1, 8, 2, 3))
+    d2s = nd.depth_to_space(nd.array(x), block_size=2)
+    assert d2s.shape == (1, 2, 4, 6)
+    back = nd.space_to_depth(d2s, block_size=2)
+    assert_almost_equal(back.asnumpy(), x, rtol=1e-6)
+
+
+def test_op_broadcast_axis_to():
+    x = _x((1, 3, 1))
+    out = nd.broadcast_axis(nd.array(x), axis=(0, 2), size=(2, 4))
+    assert out.shape == (2, 3, 4)
+    out2 = nd.broadcast_to(nd.array(x), shape=(2, 3, 4))
+    assert_almost_equal(out.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+
+def test_op_diag_khatri_rao():
+    x = _x((4, 4))
+    assert_almost_equal(nd.diag(nd.array(x)).asnumpy(), onp.diag(x),
+                        rtol=1e-6)
+    v = _x((3,))
+    assert_almost_equal(nd.diag(nd.array(v)).asnumpy(), onp.diag(v),
+                        rtol=1e-6)
+    a = _x((2, 3))
+    b = _x((4, 3))
+    kr = nd.khatri_rao(nd.array(a), nd.array(b))
+    expect = onp.stack([onp.kron(a[:, i], b[:, i]).reshape(-1)
+                        for i in range(3)], axis=1)
+    assert kr.shape == (8, 3)
+    assert_almost_equal(kr.asnumpy(), expect, rtol=1e-5)
+
+
+def test_op_shape_size_arrays():
+    x = _x((3, 5))
+    assert list(nd.shape_array(nd.array(x)).asnumpy()) == [3, 5]
+    assert int(nd.size_array(nd.array(x)).asnumpy().reshape(())) == 15
+
+
+def test_op_zeros_ones_like():
+    x = _x((2, 3))
+    assert (nd.zeros_like(nd.array(x)).asnumpy() == 0).all()
+    assert (nd.ones_like(nd.array(x)).asnumpy() == 1).all()
+
+
+def test_op_cast_dtypes():
+    x = _x((2, 3), lo=0, hi=10)
+    for dt in ("float16", "int32", "uint8"):
+        out = nd.cast(nd.array(x), dtype=dt)
+        assert str(out.data.dtype) == dt
+    assert_almost_equal(
+        nd.cast(nd.array(x), dtype="int32").asnumpy(),
+        x.astype("int32"), rtol=1e-6)
+
+
+def test_op_stop_gradient_blocks():
+    x = _x((2, 2))
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sum(a * nd.stop_gradient(a))
+    y.backward()
+    # d/da [a * sg(a)] = sg(a), not 2a
+    assert_almost_equal(a.grad.asnumpy(), x, rtol=1e-5)
