@@ -122,6 +122,10 @@ class LSTMCell(BaseRNNCell):
         super().__init__(prefix, params)
         self._num_hidden = num_hidden
         self._forget_bias = forget_bias
+        self._iW = self._var("i2h_weight")
+        self._iB = self._var("i2h_bias")
+        self._hW = self._var("h2h_weight")
+        self._hB = self._var("h2h_bias")
 
     def bias_init_value(self):
         """h2h_bias seed honoring forget_bias (reference LSTMBias
