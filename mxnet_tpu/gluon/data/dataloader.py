@@ -69,8 +69,29 @@ class DataLoader:
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * max(num_workers, 1))
         self._batchify_fn = batchify_fn or default_batchify_fn
-        self._pool = ThreadPoolExecutor(max_workers=max(num_workers, 1)) \
-            if num_workers > 0 else None
+        self._decode = None
+        if num_workers > 0 and not thread_pool:
+            # cross-process workers (reference dataloader.py:207 worker
+            # pool + shm NDArray transfer): spawn context because a
+            # live XLA runtime must not be forked
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            from . import _mp_worker
+
+            self._pool = ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_mp_worker._init_worker,
+                initargs=(self._dataset, self._batchify_fn))
+            self._decode = _mp_worker.decode
+            self._submit_fn = _mp_worker.worker_make_batch
+        elif num_workers > 0:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(num_workers, 1))
+            self._submit_fn = self._make_batch
+        else:
+            self._pool = None
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
@@ -80,18 +101,23 @@ class DataLoader:
             for batch_indices in self._batch_sampler:
                 yield self._make_batch(batch_indices)
             return
-        # pipelined prefetch through the thread pool
+        # pipelined prefetch through the worker pool (threads or
+        # processes — same schedule)
         futures = []
         it = iter(self._batch_sampler)
         try:
             for _ in range(self._prefetch):
-                futures.append(self._pool.submit(self._make_batch, next(it)))
+                futures.append(self._pool.submit(self._submit_fn,
+                                                 list(next(it))))
         except StopIteration:
             pass
         while futures:
             batch = futures.pop(0).result()
+            if self._decode is not None:
+                batch = self._decode(batch)
             try:
-                futures.append(self._pool.submit(self._make_batch, next(it)))
+                futures.append(self._pool.submit(self._submit_fn,
+                                                 list(next(it))))
             except StopIteration:
                 pass
             yield batch
