@@ -10,6 +10,7 @@ workers + CPUSharedStorageManager without cross-process NDArray plumbing.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures.process import BrokenProcessPool
 
 import numpy as onp
 
@@ -72,16 +73,22 @@ class DataLoader:
         self._decode = None
         if num_workers > 0 and not thread_pool:
             # cross-process workers (reference dataloader.py:207 worker
-            # pool + shm NDArray transfer): spawn context because a
-            # live XLA runtime must not be forked
+            # pool + shm NDArray transfer). forkserver context: workers
+            # fork from a clean server process that never initialized
+            # XLA (forking a live XLA runtime is unsafe) and — unlike
+            # spawn — never re-imports __main__, so guard-less scripts
+            # and REPLs work
             import multiprocessing
             from concurrent.futures import ProcessPoolExecutor
 
             from . import _mp_worker
 
+            try:
+                ctx = multiprocessing.get_context("forkserver")
+            except ValueError:  # platform without forkserver
+                ctx = multiprocessing.get_context("spawn")
             self._pool = ProcessPoolExecutor(
-                max_workers=num_workers,
-                mp_context=multiprocessing.get_context("spawn"),
+                max_workers=num_workers, mp_context=ctx,
                 initializer=_mp_worker._init_worker,
                 initargs=(self._dataset, self._batchify_fn))
             self._decode = _mp_worker.decode
@@ -112,7 +119,17 @@ class DataLoader:
         except StopIteration:
             pass
         while futures:
-            batch = futures.pop(0).result()
+            try:
+                batch = futures.pop(0).result()
+            except BrokenProcessPool:
+                raise RuntimeError(
+                    "DataLoader process workers died during startup. "
+                    "Like torch's DataLoader, process workers need the "
+                    "script's entry point guarded with "
+                    "`if __name__ == '__main__':` (spawn/forkserver "
+                    "re-import __main__); alternatively pass "
+                    "thread_pool=True for guard-free thread workers."
+                ) from None
             if self._decode is not None:
                 batch = self._decode(batch)
             try:
