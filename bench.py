@@ -243,16 +243,18 @@ def main():
     if SMOKE:
         smoke_main()
         return
-    # total worst-case budget 480+10+480+240 = 1210 s ≈ 20 min if every
-    # stage times out — the goal is that a hung tunnel still ends in a
-    # printed JSON line, not an rc=124 kill
+    # worst-case budget 3*480 + 2*60 + 240 ≈ 28 min if every stage
+    # times out — the goal is that a hung tunnel still ends in a
+    # printed JSON line, not an rc=124 kill. A killed axon process can
+    # wedge the tunnel for minutes, so inter-attempt sleeps are long
+    # enough for it to recover.
     t0 = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "480"))
     state = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_state")
     os.environ["BENCH_STATE"] = state
-    for i in range(2):
+    for i in range(3):
         if i:
-            time.sleep(10)
+            time.sleep(60)  # tunnel recovery window
             # resume the OOM batch-halving descent where the killed
             # attempt left off instead of restarting from scratch
             try:

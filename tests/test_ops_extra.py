@@ -18,7 +18,9 @@ def test_regression_outputs():
     with autograd.record():
         y = nd.LinearRegressionOutput(x, lbl, grad_scale=2.0)
     y.backward()
-    assert onp.allclose(x.grad.asnumpy(), 2.0 * (x.asnumpy() - lbl.asnumpy()))
+    # grad = grad_scale/num_output * (pred - label), num_output = 2
+    # per-sample features (reference regression_output-inl.h:201)
+    assert onp.allclose(x.grad.asnumpy(), (x.asnumpy() - lbl.asnumpy()))
     assert onp.allclose(y.asnumpy(), x.asnumpy())
 
     x.grad[:] = 0
@@ -26,7 +28,7 @@ def test_regression_outputs():
         y = nd.MAERegressionOutput(x, lbl)
     y.backward()
     assert onp.allclose(x.grad.asnumpy(),
-                        onp.sign(x.asnumpy() - lbl.asnumpy()))
+                        onp.sign(x.asnumpy() - lbl.asnumpy()) / 2)
 
     x.grad[:] = 0
     with autograd.record():
@@ -34,7 +36,8 @@ def test_regression_outputs():
     y.backward()
     sig = 1 / (1 + onp.exp(-x.asnumpy()))
     assert onp.allclose(y.asnumpy(), sig, atol=1e-6)
-    assert onp.allclose(x.grad.asnumpy(), sig - lbl.asnumpy(), atol=1e-6)
+    assert onp.allclose(x.grad.asnumpy(), (sig - lbl.asnumpy()) / 2,
+                        atol=1e-6)
 
 
 def test_svm_output():
