@@ -458,6 +458,12 @@ def identity(data):
 register("stop_gradient")(lambda data: lax.stop_gradient(data))
 register("BlockGrad", namespaces=("nd",))(lambda data: lax.stop_gradient(data))
 
+# literal-shaped constants backing sym.zeros / sym.ones graph nodes
+register("_sym_zeros", differentiable=False, namespaces=())(
+    lambda shape=None, dtype="float32": jnp.zeros(tuple(shape), dtype))
+register("_sym_ones", differentiable=False, namespaces=())(
+    lambda shape=None, dtype="float32": jnp.ones(tuple(shape), dtype))
+
 
 @register()
 def depth_to_space(data, block_size):

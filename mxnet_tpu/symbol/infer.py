@@ -120,6 +120,10 @@ def infer_shapes(symbol, known, allow_unknown=False):
             if node._name in var_shapes:
                 node_out[id(node)] = tuple(var_shapes[node._name])
             continue
+        if node._op in ("_sym_zeros", "_sym_ones"):
+            # literal-shaped constants (sym.zeros / sym.ones)
+            node_out[id(node)] = tuple(node._kwargs["shape"])
+            continue
         opdef = _registry.get_op(node._op)
         if opdef is None:
             raise MXNetError(f"op '{node._op}' is not registered")
