@@ -254,7 +254,7 @@ def main():
     os.environ["BENCH_STATE"] = state
     for i in range(3):
         if i:
-            time.sleep(60)  # tunnel recovery window
+            time.sleep(120)  # tunnel recovery window
             # resume the OOM batch-halving descent where the killed
             # attempt left off instead of restarting from scratch
             try:
