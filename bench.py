@@ -78,15 +78,20 @@ def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
     rng = onp.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, image_size, image_size).astype("f"))
     y = nd.array(rng.randint(0, classes, batch).astype("f"))
+    # Sync via device_get of the scalar loss, NOT wait_to_read: on the
+    # tunneled axon platform block_until_ready returns before the device
+    # finishes, so only a host readback is a faithful barrier (verified:
+    # chained 8192^3 matmuls "complete" in 0.1ms under block_until_ready
+    # but meter 131-151 TF/s — 66-77% of v5e peak — under device_get).
     for _ in range(warmup):
         lval = trainer.step(x, y)
-    lval.wait_to_read()
+    _ = jax.device_get(lval.data)
     t0 = time.perf_counter()
     for _ in range(iters):
         lval = trainer.step(x, y)
-    lval.wait_to_read()
+    loss_val = float(jax.device_get(lval.data))
     dt = time.perf_counter() - t0
-    return batch * iters / dt, float(lval.asscalar())
+    return batch * iters / dt, loss_val
 
 
 def mfu_pct(imgs_per_sec):

@@ -12,6 +12,7 @@ PartitionSpecs (new capability vs the reference's __ctx_group__ placement).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import re
 
@@ -267,9 +268,27 @@ class SPMDTrainer:
         if self._compiled is not None:
             return
         net, loss = self._net, self._loss
-        # finish deferred init eagerly on tiny slices
-        with autograd.pause(train_mode=True):
-            net.forward(x)
+        # Finish deferred init eagerly on a ONE-sample host batch, pinned to
+        # the CPU backend when one exists. Only shapes matter here, and on a
+        # remote-tunneled TPU (axon) each eager op dispatch pays a network
+        # round trip — a full-batch eager forward through the tunnel takes
+        # minutes while the same shapes-only pass on host CPU is instant.
+        cpu = None
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            pass
+        init_ctx = (jax.default_device(cpu) if cpu is not None
+                    else contextlib.nullcontext())
+        with init_ctx, autograd.pause(train_mode=True):
+            xs = x
+            if getattr(x, "shape", None) and x.shape:
+                # fresh 1-sample host batch, created INSIDE the CPU
+                # context so even a device-committed x never drags the
+                # op-by-op init forward through the tunnel
+                xs = nd.array(onp.zeros((1,) + tuple(x.shape[1:]),
+                                        dtype=str(x.dtype)))
+            net.forward(xs)
         self._params = [p for _, p in sorted(net.collect_params().items())]
         names = [p.name for p in self._params]
         trainable = [p.grad_req != "null" for p in self._params]
@@ -282,7 +301,15 @@ class SPMDTrainer:
         pnds = [p._ndarray for p in self._params]
         update, cdtype = self._update, self._cdtype
 
-        def step(param_vals, states, xd, yd, key, t):
+        def step(param_vals, states, aux, xd, yd):
+            # aux = (PRNG key, 1-based step counter) carried ON DEVICE in
+            # donated buffers — a remote tunnel pays a host→device round
+            # trip per transferred input, so nothing host-side crosses per
+            # step except the (possibly fresh) batch itself.
+            key, t = aux
+            key, fwd_key = jax.random.split(key)
+            t = t + 1
+
             def loss_fn(pv):
                 saved = [p._data for p in pnds]
                 try:
@@ -303,7 +330,7 @@ class SPMDTrainer:
                             jnp.issubdtype(xin.dtype, jnp.floating):
                         xin = xin.astype(cdtype)
                     with autograd.pause(train_mode=True), \
-                            mxrandom.key_provider(key):
+                            mxrandom.key_provider(fwd_key):
                         out = net.forward(NDArray(xin))
                         if cdtype is not None:
                             out = NDArray(out.data.astype(jnp.float32))
@@ -330,7 +357,7 @@ class SPMDTrainer:
                     w2, s2 = update(w, g, s, t)
                     new_params.append(w2)
                     new_states.append(s2)
-            return lval, new_params, new_states
+            return lval, new_params, new_states, (key, t)
 
         self._states = [
             jax.tree_util.tree_map(
@@ -342,13 +369,18 @@ class SPMDTrainer:
                         for st, ps in zip(self._states, self._pshard)]
         self._param_vals = [jax.device_put(p._ndarray.data, s)
                             for p, s in zip(self._params, self._pshard)]
-        self._t = 0
+        self._t = 0  # display-only mirror; the authoritative counter is
+        # the on-device aux[1], incremented inside the compiled step
+        key0 = mxrandom.next_key()
+        key0 = key0.data if isinstance(key0, NDArray) else jnp.asarray(key0)
+        self._aux = (replicate(key0, mesh), replicate(jnp.int32(0), mesh))
+        aux_shard = (rep, rep)
         self._compiled = jax.jit(
             step,
-            in_shardings=(self._pshard, state_shards, batch_shard,
-                          batch_shard, rep, rep),
-            out_shardings=(rep, self._pshard, state_shards),
-            donate_argnums=(0, 1))
+            in_shardings=(self._pshard, state_shards, aux_shard,
+                          batch_shard, batch_shard),
+            out_shardings=(rep, self._pshard, state_shards, aux_shard),
+            donate_argnums=(0, 1, 2))
 
     # -- public -----------------------------------------------------------
     @property
@@ -360,11 +392,9 @@ class SPMDTrainer:
         self._ensure_built(x, y)
         xd = shard_batch(x, self._mesh, self._axis).data
         yd = shard_batch(y, self._mesh, self._axis).data
-        key = mxrandom.next_key()
         self._t += 1
-        t = replicate(jnp.int32(self._t), self._mesh)
-        lval, self._param_vals, self._states = self._compiled(
-            self._param_vals, self._states, xd, yd, key, t)
+        lval, self._param_vals, self._states, self._aux = self._compiled(
+            self._param_vals, self._states, self._aux, xd, yd)
         return NDArray(lval)
 
     def sync_params_to_gluon(self):
