@@ -1,0 +1,77 @@
+/* Flat C ABI for the mxnet_tpu runtime.
+ *
+ * Reference surface: include/mxnet/c_api.h and c_predict_api.h of the
+ * upstream project. Every function returns 0 on success and -1 on
+ * failure; call MXGetLastError() for the message (valid until the next
+ * failing call on the same thread).
+ *
+ * Link against libmxnet_c.so (built by `make c_api` in native/). The
+ * library attaches to the calling process's Python interpreter when one
+ * is live (e.g. loaded via ctypes), or embeds one on first use from a
+ * standalone C/C++ application — in that case make sure PYTHONPATH
+ * reaches the mxnet_tpu package.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MX_MAX_DIM 8
+
+typedef void* NDArrayHandle;
+typedef void* PredictorHandle;
+
+/* dtype flags (mshadow type flags, reference include/mxnet/base.h):
+ * 0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64 7=bool */
+
+int MXGetVersion(int* out);
+const char* MXGetLastError(void);
+
+int MXNDArrayCreate(const int64_t* shape, int ndim, int dtype,
+                    NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, int* out_ndim,
+                      int64_t* out_shape /* int64_t[MX_MAX_DIM] */);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t nbytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t nbytes);
+int MXNDArrayWaitAll(void);
+
+/* Run a registered operator by name. Param values are stringified the
+ * same way the reference C API expects ("(3, 3)", "True", "relu").
+ * *outputs points at thread-local storage owned by the library and valid
+ * until this thread's next MXImperativeInvoke; do NOT call
+ * MXNDArrayFree on the returned output handles. */
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+
+/* ---- C predict API (deploy-only inference) --------------------------- */
+
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 size_t param_size, int dev_type, int dev_id,
+                 uint32_t num_input, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const int64_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, uint32_t size /* #floats */);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         int* out_ndim,
+                         int64_t* out_shape /* int64_t[MX_MAX_DIM] */);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size /* #floats */);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXNET_TPU_C_API_H_ */

@@ -107,5 +107,31 @@ def _load_engine():
     englib = L
 
 
+def build_c_api():
+    """Build (if stale) and return the path to libmxnet_c.so — the flat C
+    ABI over this runtime (native/c_api.cc; reference include/mxnet/c_api.h
+    + c_predict_api.h). Loaded on demand, not at import: the library links
+    libpython and is meant for external C/C++ consumers and ctypes tests.
+    Returns None when no toolchain/source is available."""
+    so = os.path.join(_here, "libmxnet_c.so")
+    src = os.path.join(_src_dir, "c_api.cc")
+    header = os.path.join(os.path.dirname(_src_dir), "include",
+                          "mxnet_tpu", "c_api.h")
+    stale = not os.path.isfile(so) or any(
+        os.path.isfile(dep) and os.path.getmtime(dep) > os.path.getmtime(so)
+        for dep in (src, header))
+    if stale:
+        if not os.path.isfile(src):
+            return so if os.path.isfile(so) else None
+        # single source of truth for the build recipe: the Makefile
+        proc = subprocess.run(
+            ["make", "-C", _src_dir, "c_api"],
+            capture_output=True, text=True, timeout=180)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"libmxnet_c.so build failed:\n{proc.stderr[-2000:]}")
+    return so if os.path.isfile(so) else None
+
+
 _load()
 _load_engine()

@@ -1,0 +1,172 @@
+"""Python side of the flat C ABI (``native/c_api.cc``).
+
+Reference: ``src/c_api/c_api.cc`` (NDArray + imperative invoke entry
+points) and ``src/c_api/c_predict_api.cc`` (deploy-only predictor). The
+reference's C API fronts its C++ runtime so non-C++ frontends and C/C++
+applications can drive it; in this rebuild the runtime is Python/JAX, so
+the C library attaches to (or embeds) CPython and calls the marshalling
+helpers in this module. Everything here takes/returns only plain Python
+objects (tuples, ints, bytes, NDArrays) so the C side stays a thin
+argument-shuffling layer.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as onp
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.ndarray import _TYPE_FLAG_TO_DTYPE, _DTYPE_TO_TYPE_FLAG
+
+__all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_from_bytes",
+           "nd_to_bytes", "invoke", "wait_all", "CPredictor"]
+
+
+def nd_create(shape, dtype_flag):
+    """MXNDArrayCreate: zero-initialized array (reference c_api.cc
+    MXNDArrayCreateEx)."""
+    return nd.zeros(tuple(int(s) for s in shape),
+                    dtype=_TYPE_FLAG_TO_DTYPE[int(dtype_flag)])
+
+
+def nd_shape(a):
+    return tuple(int(s) for s in a.shape)
+
+
+def nd_dtype(a):
+    return int(_DTYPE_TO_TYPE_FLAG[str(a.dtype)])
+
+
+def nd_from_bytes(a, buf):
+    """MXNDArraySyncCopyFromCPU: overwrite `a` in place from raw bytes."""
+    arr = onp.frombuffer(buf, dtype=str(a.dtype)).reshape(a.shape)
+    a[:] = nd.array(arr, dtype=str(a.dtype))
+    return None
+
+
+def nd_to_bytes(a):
+    """MXNDArraySyncCopyToCPU: raw little-endian bytes of the value."""
+    return onp.ascontiguousarray(a.asnumpy()).tobytes()
+
+
+def _parse_param(v):
+    """C callers pass stringified params exactly like the reference C API
+    ("(3, 3)", "True", "0.5", "relu") — parse literals, keep strings."""
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke: run a registered operator by name.
+
+    Reference: c_api_ndarray.cc MXImperativeInvokeEx. Returns a list of
+    output NDArrays (ops with a single output return a 1-list).
+    """
+    # confine lookups to the operator registry (not arbitrary nd-module
+    # attributes): the C surface must only reach registered operators
+    from .ndarray import registry as _registry
+
+    name = op_name
+    if _registry.get_op(name) is None:
+        from .ndarray import _CAMEL_ALIASES
+
+        name = _CAMEL_ALIASES.get(op_name)
+        if name is None or _registry.get_op(name) is None:
+            raise MXNetError(f"unknown operator '{op_name}'")
+    fn = getattr(nd, name)
+    params = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    out = fn(*inputs, **params)
+    if isinstance(out, (list, tuple)):
+        return [o if isinstance(o, NDArray) else nd.array(o) for o in out]
+    return [out if isinstance(out, NDArray) else nd.array(out)]
+
+
+def wait_all():
+    nd.waitall()
+    return None
+
+
+class CPredictor:
+    """Deploy-only forward pass over an exported checkpoint.
+
+    Reference: src/c_api/c_predict_api.cc MXPredCreate — loads a
+    ``*-symbol.json`` graph plus ``*.params`` bytes (arg:/aux: prefixed),
+    binds with fixed input shapes, and serves Forward/GetOutput. The
+    whole graph compiles to one XLA executable on first forward.
+    """
+
+    def __init__(self, symbol_json, param_bytes, dev_type=1, dev_id=0,
+                 input_shapes=None):
+        from . import symbol as sym_mod
+
+        self._sym = sym_mod.load_json(symbol_json)
+        params = nd.load_frombuffer(param_bytes) if param_bytes else {}
+        arg_params, aux_params = {}, {}
+        if isinstance(params, dict):
+            for k, v in params.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v
+                else:
+                    arg_params[k] = v
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in (input_shapes or {}).items()}
+        self._input_names = sorted(shapes)
+        self._exec = self._sym.simple_bind(grad_req="null", **shapes)
+        loaded = {**arg_params, **aux_params}
+        for name, arr in zip(self._exec.arg_names, self._exec.arg_arrays):
+            if name in loaded:
+                arr[:] = loaded[name]
+        self._outputs = None
+
+    def set_input(self, key, buf, shape=None):
+        d = self._exec.arg_dict
+        if key not in d:
+            raise MXNetError(f"unknown input '{key}'; inputs are "
+                             f"{self._input_names}")
+        tgt = d[key]
+        shape = tuple(shape) if shape else tgt.shape
+        arr = onp.frombuffer(buf, dtype=str(tgt.dtype)).reshape(shape)
+        tgt[:] = nd.array(arr, dtype=str(tgt.dtype))
+        return None
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+        return None
+
+    def num_outputs(self):
+        self._ensure_forward()
+        return len(self._outputs)
+
+    def output_shape(self, index):
+        self._ensure_forward()
+        return tuple(int(s) for s in self._outputs[index].shape)
+
+    def output_bytes(self, index):
+        """Output `index` as float32 little-endian bytes (the C predict
+        API is float-only, matching the reference's MXPredGetOutput)."""
+        self._ensure_forward()
+        return onp.ascontiguousarray(
+            self._outputs[index].asnumpy().astype("float32")).tobytes()
+
+    def _ensure_forward(self):
+        if self._outputs is None:
+            raise MXNetError("call forward() before reading outputs")
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: rebind with new input shapes, keeping weights."""
+        old = dict(zip(self._exec.arg_names, self._exec.arg_arrays))
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in input_shapes.items()}
+        self._exec = self._sym.simple_bind(grad_req="null", **shapes)
+        for name, arr in zip(self._exec.arg_names, self._exec.arg_arrays):
+            if name in old and name not in shapes and \
+                    tuple(old[name].shape) == tuple(arr.shape):
+                arr[:] = old[name]
+        self._outputs = None
+        return None

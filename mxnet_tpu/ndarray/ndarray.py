@@ -652,10 +652,17 @@ def _load_ref_params(buf):
 def load(fname):
     """Load NDArrays from the reference binary format (auto-detected) or
     the npz container earlier versions of this package wrote."""
+    with open(fname, "rb") as f:
+        return load_frombuffer(f.read())
+
+
+def load_frombuffer(buf):
+    """Load NDArrays from an in-memory buffer (reference:
+    MXNDArrayLoadFromBuffer, c_api.cc — the deploy path feeds ``.params``
+    bytes without touching the filesystem)."""
     import struct
 
-    with open(fname, "rb") as f:
-        buf = f.read()
+    buf = bytes(buf)
     if len(buf) >= 8 and struct.unpack_from("<Q", buf)[0] == _LIST_MAGIC:
         return _load_ref_params(buf)
     import io

@@ -1,0 +1,371 @@
+// Flat C ABI over the mxnet_tpu runtime.
+//
+// Reference: src/c_api/c_api.cc (NDArray entry points, MXImperativeInvoke)
+// and src/c_api/c_predict_api.cc (deploy-only predictor). The reference's
+// C API fronts a C++ runtime; in this TPU rebuild the runtime is
+// Python/JAX, so this library attaches to a live CPython (when loaded
+// from a Python process via ctypes) or embeds one (when linked into a
+// standalone C/C++ application) and marshals through the pure-Python
+// helpers in mxnet_tpu/c_bridge.py. All entry points return 0 on
+// success, -1 on failure with the message retrievable via
+// MXGetLastError() — the reference's error convention (c_api_error.h).
+//
+// Build: make c_api (links libpython; see native/Makefile).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_api.h"  // keep definitions in ABI lockstep
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::string& last_error() {
+  thread_local std::string err;
+  return err;
+}
+
+// Initialize (or attach to) the interpreter exactly once. When this
+// library embeds Python itself, the GIL is released right after init so
+// every entry point can use the uniform PyGILState_Ensure pattern.
+void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class Gil {
+ public:
+  Gil() { ensure_python(); state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int set_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  last_error() = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) last_error() = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+int set_error(const char* msg) {
+  last_error() = msg;
+  return -1;
+}
+
+PyObject* bridge() {  // borrowed (cached) reference, GIL held
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) mod = PyImport_ImportModule("mxnet_tpu.c_bridge");
+  return mod;
+}
+
+// call bridge.<fn>(*args); returns new reference or nullptr
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* mod = bridge();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+// per-thread backing store for MXImperativeInvoke output handle arrays
+// (valid until the thread's next invoke — the reference's ret_buf
+// convention, c_api_ndarray.cc). The stored handles are OWNED here:
+// clear_invoke_ret drops the previous invoke's refs so callers must not
+// MXNDArrayFree them (and outputs never leak across a long-lived loop).
+std::vector<void*>& invoke_ret() {
+  thread_local std::vector<void*> ret;
+  return ret;
+}
+
+void clear_invoke_ret() {  // GIL must be held
+  auto& ret = invoke_ret();
+  for (void* h : ret) Py_DECREF(reinterpret_cast<PyObject*>(h));
+  ret.clear();
+}
+
+constexpr int kMaxDim = 8;
+
+}  // namespace
+
+MXTPU_API int MXGetVersion(int* out) {
+  *out = 10700;  // tracks the reference's 1.7 line
+  return 0;
+}
+
+MXTPU_API const char* MXGetLastError() { return last_error().c_str(); }
+
+MXTPU_API int MXNDArrayCreate(const int64_t* shape, int ndim, int dtype,
+                              void** out) {
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Ni)", shp, dtype);
+  PyObject* r = bridge_call("nd_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // ownership transferred to the handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFree(void* handle) {
+  if (handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape(void* handle, int* out_ndim,
+                                int64_t* out_shape) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = bridge_call("nd_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > kMaxDim) {
+    Py_DECREF(r);
+    return set_error("ndim exceeds MX_MAX_DIM (8)");
+  }
+  *out_ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetDType(void* handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = bridge_call("nd_dtype", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromCPU(void* handle, const void* data,
+                                       size_t nbytes) {
+  Gil gil;
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_READ);
+  if (mem == nullptr) return set_py_error();
+  PyObject* args = Py_BuildValue("(ON)", handle, mem);
+  PyObject* r = bridge_call("nd_from_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyToCPU(void* handle, void* data,
+                                     size_t nbytes) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = bridge_call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return set_py_error();
+  }
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(r);
+    return set_error("MXNDArraySyncCopyToCPU: size mismatch");
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = bridge_call("wait_all", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXImperativeInvoke(const char* op_name, int num_inputs,
+                                 void** inputs, int* num_outputs,
+                                 void*** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals) {
+  Gil gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* h = reinterpret_cast<PyObject*>(inputs[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(ins, i, h);
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
+  PyObject* r = bridge_call("invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_ssize_t n = PyList_Size(r);
+  clear_invoke_ret();
+  auto& ret = invoke_ret();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    ret.push_back(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = ret.data();
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// C predict API (reference: src/c_api/c_predict_api.cc)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                           size_t param_size, int dev_type, int dev_id,
+                           uint32_t num_input, const char** input_keys,
+                           const uint32_t* input_shape_indptr,
+                           const int64_t* input_shape_data, void** out) {
+  Gil gil;
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLongLong(input_shape_data[j]));
+    PyObject* k = PyUnicode_FromString(input_keys[i]);
+    PyDict_SetItem(shapes, k, shp);
+    Py_DECREF(k);
+    Py_DECREF(shp);
+  }
+  PyObject* pbytes =
+      PyBytes_FromStringAndSize(static_cast<const char*>(param_bytes),
+                                static_cast<Py_ssize_t>(param_size));
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    Py_DECREF(shapes);
+    Py_XDECREF(pbytes);
+    return set_py_error();
+  }
+  PyObject* cls = PyObject_GetAttrString(mod, "CPredictor");
+  if (cls == nullptr) {
+    Py_DECREF(shapes);
+    Py_XDECREF(pbytes);
+    return set_py_error();
+  }
+  PyObject* args =
+      Py_BuildValue("(sNiiN)", symbol_json, pbytes, dev_type, dev_id, shapes);
+  PyObject* pred = PyObject_CallObject(cls, args);
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  if (pred == nullptr) return set_py_error();
+  *out = pred;
+  return 0;
+}
+
+MXTPU_API int MXPredSetInput(void* handle, const char* key,
+                             const float* data, uint32_t size) {
+  Gil gil;
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  if (mem == nullptr) return set_py_error();
+  PyObject* r = PyObject_CallMethod(reinterpret_cast<PyObject*>(handle),
+                                    "set_input", "sN", key, mem);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredForward(void* handle) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(reinterpret_cast<PyObject*>(handle),
+                                    "forward", nullptr);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutputShape(void* handle, uint32_t index,
+                                   int* out_ndim, int64_t* out_shape) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(reinterpret_cast<PyObject*>(handle),
+                                    "output_shape", "I", index);
+  if (r == nullptr) return set_py_error();
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > kMaxDim) {
+    Py_DECREF(r);
+    return set_error("ndim exceeds MX_MAX_DIM (8)");
+  }
+  *out_ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutput(void* handle, uint32_t index, float* data,
+                              uint32_t size) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(reinterpret_cast<PyObject*>(handle),
+                                    "output_bytes", "I", index);
+  if (r == nullptr) return set_py_error();
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return set_py_error();
+  }
+  if (static_cast<size_t>(len) != static_cast<size_t>(size) * 4) {
+    Py_DECREF(r);
+    return set_error("MXPredGetOutput: size mismatch");
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredFree(void* handle) {
+  if (handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}

@@ -1,0 +1,279 @@
+"""Flat C ABI tests (native/c_api.cc over mxnet_tpu/c_bridge.py).
+
+Reference surface: include/mxnet/c_api.h + c_predict_api.h; the reference
+exercises these through its frontend bindings, here we drive them through
+ctypes exactly as an external C consumer would (plus one genuinely
+standalone compiled C program for the deploy story).
+"""
+import ctypes
+import os
+import shutil
+import struct
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu._native import build_c_api
+
+i64 = ctypes.c_int64
+
+
+@pytest.fixture(scope="module")
+def capi():
+    so = build_c_api()
+    if so is None:
+        pytest.skip("no toolchain to build libmxnet_c.so")
+    lib = ctypes.CDLL(so)
+    vp, c_int, u32 = ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXGetVersion.argtypes = [ctypes.POINTER(c_int)]
+    lib.MXNDArrayCreate.argtypes = [ctypes.POINTER(i64), c_int, c_int,
+                                    ctypes.POINTER(vp)]
+    lib.MXNDArrayFree.argtypes = [vp]
+    lib.MXNDArrayGetShape.argtypes = [vp, ctypes.POINTER(c_int),
+                                      ctypes.POINTER(i64)]
+    lib.MXNDArrayGetDType.argtypes = [vp, ctypes.POINTER(c_int)]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, c_int, ctypes.POINTER(vp), ctypes.POINTER(c_int),
+        ctypes.POINTER(ctypes.POINTER(vp)), c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, vp, ctypes.c_size_t, c_int, c_int, u32,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(u32),
+        ctypes.POINTER(i64), ctypes.POINTER(vp)]
+    lib.MXPredSetInput.argtypes = [vp, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_float), u32]
+    lib.MXPredForward.argtypes = [vp]
+    lib.MXPredGetOutputShape.argtypes = [vp, u32, ctypes.POINTER(c_int),
+                                         ctypes.POINTER(i64)]
+    lib.MXPredGetOutput.argtypes = [vp, u32,
+                                    ctypes.POINTER(ctypes.c_float), u32]
+    lib.MXPredFree.argtypes = [vp]
+    return lib
+
+
+def _err(lib):
+    return lib.MXGetLastError().decode()
+
+
+def test_version_and_error_empty(capi):
+    v = ctypes.c_int()
+    assert capi.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value >= 10000
+
+
+def test_ndarray_roundtrip(capi):
+    shape = (i64 * 2)(3, 4)
+    h = ctypes.c_void_p()
+    assert capi.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h)) == 0, _err(capi)
+    ndim = ctypes.c_int()
+    out_shape = (i64 * 8)()
+    assert capi.MXNDArrayGetShape(h, ctypes.byref(ndim), out_shape) == 0
+    assert ndim.value == 2 and tuple(out_shape[:2]) == (3, 4)
+    dt = ctypes.c_int()
+    assert capi.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0  # float32
+    data = onp.arange(12, dtype="f").reshape(3, 4)
+    assert capi.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), data.nbytes) == 0, _err(capi)
+    back = onp.zeros_like(data)
+    assert capi.MXNDArraySyncCopyToCPU(
+        h, back.ctypes.data_as(ctypes.c_void_p), back.nbytes) == 0, _err(capi)
+    onp.testing.assert_array_equal(back, data)
+    assert capi.MXNDArrayFree(h) == 0
+
+
+def test_imperative_invoke(capi):
+    def make(vals):
+        a = onp.asarray(vals, dtype="f")
+        shape = (i64 * a.ndim)(*a.shape)
+        h = ctypes.c_void_p()
+        assert capi.MXNDArrayCreate(shape, a.ndim, 0, ctypes.byref(h)) == 0
+        assert capi.MXNDArraySyncCopyFromCPU(
+            h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes) == 0
+        return h, a
+
+    ha, a = make([[1.0, 2.0], [3.0, 4.0]])
+    hb, b = make([[10.0, 20.0], [30.0, 40.0]])
+    ins = (ctypes.c_void_p * 2)(ha, hb)
+    nout = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert capi.MXImperativeInvoke(
+        b"broadcast_add", 2, ins, ctypes.byref(nout), ctypes.byref(outs),
+        0, None, None) == 0, _err(capi)
+    assert nout.value == 1
+    res = onp.zeros((2, 2), dtype="f")
+    assert capi.MXNDArraySyncCopyToCPU(
+        outs[0], res.ctypes.data_as(ctypes.c_void_p), res.nbytes) == 0
+    onp.testing.assert_allclose(res, a + b)
+    assert capi.MXNDArrayWaitAll() == 0
+    capi.MXNDArrayFree(ha)
+    capi.MXNDArrayFree(hb)
+
+
+def test_imperative_invoke_with_params(capi):
+    a = onp.arange(6, dtype="f").reshape(2, 3)
+    shape = (i64 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    capi.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h))
+    capi.MXNDArraySyncCopyFromCPU(
+        h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes)
+    ins = (ctypes.c_void_p * 1)(h)
+    nout = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(b"shape")
+    vals = (ctypes.c_char_p * 1)(b"(3, 2)")
+    assert capi.MXImperativeInvoke(
+        b"reshape", 1, ins, ctypes.byref(nout), ctypes.byref(outs),
+        1, keys, vals) == 0, _err(capi)
+    ndim = ctypes.c_int()
+    oshape = (i64 * 8)()
+    capi.MXNDArrayGetShape(outs[0], ctypes.byref(ndim), oshape)
+    assert tuple(oshape[:2]) == (3, 2)
+    capi.MXNDArrayFree(h)
+
+
+def test_invoke_unknown_op_sets_error(capi):
+    nout = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    rc = capi.MXImperativeInvoke(
+        b"definitely_not_an_op", 0, None, ctypes.byref(nout),
+        ctypes.byref(outs), 0, None, None)
+    assert rc == -1
+    assert "definitely_not_an_op" in _err(capi)
+
+
+@pytest.fixture(scope="module")
+def exported_mlp(tmp_path_factory):
+    """Export a small trained-ish MLP the way a deploy pipeline would:
+    symbol json + reference-format params with arg:/aux: prefixes."""
+    root = tmp_path_factory.mktemp("c_predict")
+    from mxnet_tpu import sym
+
+    x = sym.Variable("data")
+    fc1 = sym.FullyConnected(x, name="fc1", num_hidden=16,
+                             weight=sym.Variable("fc1_weight"),
+                             bias=sym.Variable("fc1_bias"))
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=3,
+                             weight=sym.Variable("fc2_weight"),
+                             bias=sym.Variable("fc2_bias"))
+    out = sym.softmax(fc2)
+    rng = onp.random.RandomState(0)
+    params = {
+        "arg:fc1_weight": nd.array(rng.randn(16, 8).astype("f") * 0.1),
+        "arg:fc1_bias": nd.array(rng.randn(16).astype("f") * 0.1),
+        "arg:fc2_weight": nd.array(rng.randn(3, 16).astype("f") * 0.1),
+        "arg:fc2_bias": nd.array(rng.randn(3).astype("f") * 0.1),
+    }
+    json_path = os.path.join(root, "mlp-symbol.json")
+    params_path = os.path.join(root, "mlp-0000.params")
+    with open(json_path, "w") as f:
+        f.write(out.tojson())
+    nd.save(params_path, params)
+    xval = rng.rand(4, 8).astype("f")
+    args = {"data": nd.array(xval)}
+    args.update({k[4:]: v for k, v in params.items()})
+    ex = out.bind(args=args)
+    expect = ex.forward(is_train=False)[0].asnumpy()
+    return json_path, params_path, xval, expect
+
+
+def test_c_predict_api(capi, exported_mlp):
+    json_path, params_path, xval, expect = exported_mlp
+    with open(json_path) as f:
+        sym_json = f.read().encode()
+    with open(params_path, "rb") as f:
+        param_bytes = f.read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shp = (i64 * 2)(4, 8)
+    h = ctypes.c_void_p()
+    assert capi.MXPredCreate(
+        sym_json, param_bytes, len(param_bytes), 1, 0, 1, keys, indptr,
+        shp, ctypes.byref(h)) == 0, _err(capi)
+    assert capi.MXPredSetInput(
+        h, b"data", xval.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        xval.size) == 0, _err(capi)
+    assert capi.MXPredForward(h) == 0, _err(capi)
+    ndim = ctypes.c_int()
+    oshape = (i64 * 8)()
+    assert capi.MXPredGetOutputShape(
+        h, 0, ctypes.byref(ndim), oshape) == 0, _err(capi)
+    shape = tuple(oshape[:ndim.value])
+    assert shape == expect.shape
+    res = onp.zeros(shape, dtype="f")
+    assert capi.MXPredGetOutput(
+        h, 0, res.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        res.size) == 0, _err(capi)
+    onp.testing.assert_allclose(res, expect, rtol=1e-5, atol=1e-6)
+    assert capi.MXPredFree(h) == 0
+
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdint.h>
+#include <string.h>
+#include "mxnet_tpu/c_api.h"
+
+int main(void) {
+  int version = 0;
+  if (MXGetVersion(&version) != 0 || version < 10000) return 1;
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle h = NULL;
+  if (MXNDArrayCreate(shape, 2, 0, &h) != 0) {
+    fprintf(stderr, "create: %s\n", MXGetLastError());
+    return 2;
+  }
+  float data[6] = {1, 2, 3, 4, 5, 6};
+  if (MXNDArraySyncCopyFromCPU(h, data, sizeof(data)) != 0) return 3;
+  NDArrayHandle ins[1] = {h};
+  int nout = 0;
+  NDArrayHandle* outs = NULL;
+  const char* keys[1] = {"shape"};
+  const char* vals[1] = {"(3, 2)"};
+  if (MXImperativeInvoke("reshape", 1, ins, &nout, &outs, 1, keys, vals)
+      != 0) {
+    fprintf(stderr, "invoke: %s\n", MXGetLastError());
+    return 4;
+  }
+  int ndim = 0;
+  int64_t oshape[MX_MAX_DIM];
+  if (MXNDArrayGetShape(outs[0], &ndim, oshape) != 0) return 5;
+  if (ndim != 2 || oshape[0] != 3 || oshape[1] != 2) return 6;
+  float back[6];
+  if (MXNDArraySyncCopyToCPU(outs[0], back, sizeof(back)) != 0) return 7;
+  if (memcmp(back, data, sizeof(back)) != 0) return 8;
+  MXNDArrayFree(h);
+  printf("C_OK\n");
+  return 0;
+}
+"""
+
+
+def test_standalone_c_program(capi, tmp_path):
+    """The deploy story: a plain C program (no Python code) linking
+    libmxnet_c drives the runtime end to end."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    so = build_c_api()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    csrc = tmp_path / "main.c"
+    csrc.write_text(C_PROGRAM)
+    exe = tmp_path / "drive"
+    subprocess.run(
+        ["gcc", str(csrc), "-o", str(exe), f"-I{repo}/include",
+         so, f"-Wl,-rpath,{os.path.dirname(so)}"],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the child off the tunnel
+    proc = subprocess.run([str(exe)], env=env, capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "C_OK" in proc.stdout
