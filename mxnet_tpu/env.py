@@ -47,6 +47,8 @@ KNOBS = {
         "wired", "kvstore", "gradient compression type via env"),
     "MXNET_KVSTORE_GC_THRESHOLD": (
         "wired", "kvstore", "gradient compression threshold via env"),
+    "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
+        "wired", "optimizer.SGD", "multi-tensor fused update group size"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

@@ -177,3 +177,159 @@ def all_finite(*arrays, init_output=True):
 def multi_sum_sq(*arrays):
     """Reference: contrib/multi_sum_sq.cc (used by LARS)."""
     return tuple(jnp.sum(jnp.square(a)).reshape(1) for a in arrays)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused updates (reference: src/operator/optimizer_op.cc
+# MultiSGDUpdate/MultiSGDMomUpdate + the MP variants, and
+# src/operator/contrib/preloaded_multi_sgd.cc where lrs/wds arrive as
+# tensors). The reference fuses to amortize kernel-launch overhead; under
+# XLA the fusion is the jit, but the ops exist so kvstore/Updater batches
+# and external callers (C API, symbols) get one registered entry point —
+# and one compiled executable — per aggregated group.
+# ---------------------------------------------------------------------------
+
+def _scalar_list(v, n, name):
+    if v is None:
+        raise ValueError(f"{name} is required")
+    if not isinstance(v, (list, tuple)):
+        v = [v] * n
+    if len(v) != n:
+        raise ValueError(f"{name} has {len(v)} entries for {n} weights")
+    return [float(x) for x in v]
+
+
+def _multi_n(num_weights, nargs, per):
+    n = int(num_weights) if num_weights else nargs // per
+    if nargs != n * per:
+        raise ValueError(
+            f"expected {n * per} inputs ({per} per weight), got {nargs}")
+    return n
+
+
+@register(differentiable=False)
+def multi_sgd_update(*args, lrs=None, wds=None, num_weights=0,
+                     rescale_grad=1.0, clip_gradient=-1.0):
+    """Inputs interleaved [w0, g0, w1, g1, ...]; returns updated weights."""
+    n = _multi_n(num_weights, len(args), 2)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    outs = []
+    for i in range(n):
+        w, g = args[2 * i], args[2 * i + 1]
+        g = _prep_grad(g.astype(w.dtype), rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs)
+
+
+@register(differentiable=False)
+def multi_sgd_mom_update(*args, lrs=None, wds=None, momentum=0.0,
+                         num_weights=0, rescale_grad=1.0,
+                         clip_gradient=-1.0):
+    """Inputs [w0, g0, m0, w1, g1, m1, ...]; returns
+    (w0', ..., wn-1', m0', ..., mn-1')."""
+    n = _multi_n(num_weights, len(args), 3)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    ws, ms = [], []
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        g = _prep_grad(g.astype(w.dtype), rescale_grad, clip_gradient)
+        m2 = momentum * m - lrs[i] * (g + wds[i] * w)
+        ws.append(w + m2)
+        ms.append(m2)
+    return tuple(ws) + tuple(ms)
+
+
+@register(differentiable=False)
+def multi_mp_sgd_update(*args, lrs=None, wds=None, num_weights=0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """Mixed-precision: inputs [w0, g0, w32_0, ...] with half-precision
+    weights/grads and an fp32 master per weight; returns
+    (w0', ..., w32_0', ...) — update computed on the master, half weight
+    is its cast (reference MultiMPSGDUpdate)."""
+    n = _multi_n(num_weights, len(args), 3)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    ws, masters = [], []
+    for i in range(n):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        g = _prep_grad(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        m2 = w32 - lrs[i] * (g + wds[i] * w32)
+        masters.append(m2)
+        ws.append(m2.astype(w.dtype))
+    return tuple(ws) + tuple(masters)
+
+
+@register(differentiable=False)
+def multi_mp_sgd_mom_update(*args, lrs=None, wds=None, momentum=0.0,
+                            num_weights=0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    """Inputs [w0, g0, m0, w32_0, ...]; returns
+    (w'..., mom'..., master'...). Momentum and master stay fp32."""
+    n = _multi_n(num_weights, len(args), 4)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    ws, moms, masters = [], [], []
+    for i in range(n):
+        w, g, m, w32 = (args[4 * i], args[4 * i + 1], args[4 * i + 2],
+                        args[4 * i + 3])
+        g = _prep_grad(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        m2 = momentum * m - lrs[i] * (g + wds[i] * w32)
+        w2 = w32 + m2
+        moms.append(m2)
+        masters.append(w2)
+        ws.append(w2.astype(w.dtype))
+    return tuple(ws) + tuple(moms) + tuple(masters)
+
+
+@register(differentiable=False)
+def preloaded_multi_sgd_update(*args, num_weights=0, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    """Reference contrib/preloaded_multi_sgd.cc: like multi_sgd_update but
+    lrs/wds ride as the LAST TWO tensor inputs (shape (n,)) so the whole
+    schedule stays on device."""
+    if len(args) < 2:
+        raise ValueError("missing lrs/wds tensor inputs")
+    lrs_t, wds_t = args[-2], args[-1]
+    args = args[:-2]
+    n = _multi_n(num_weights, len(args), 2)
+    outs = []
+    for i in range(n):
+        w, g = args[2 * i], args[2 * i + 1]
+        g = _prep_grad(g.astype(w.dtype), rescale_grad, clip_gradient)
+        lr = lrs_t[i].astype(w.dtype)
+        wd = wds_t[i].astype(w.dtype)
+        outs.append(w - lr * (g + wd * w))
+    return tuple(outs)
+
+
+@register(differentiable=False)
+def preloaded_multi_sgd_mom_update(*args, momentum=0.0, num_weights=0,
+                                   rescale_grad=1.0, clip_gradient=-1.0):
+    """[w0, g0, m0, ..., lrs, wds] -> (w'..., m'...)."""
+    if len(args) < 2:
+        raise ValueError("missing lrs/wds tensor inputs")
+    lrs_t, wds_t = args[-2], args[-1]
+    args = args[:-2]
+    n = _multi_n(num_weights, len(args), 3)
+    ws, ms = [], []
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        g = _prep_grad(g.astype(w.dtype), rescale_grad, clip_gradient)
+        m2 = momentum * m - lrs_t[i].astype(w.dtype) * (
+            g + wds_t[i].astype(w.dtype) * w)
+        ws.append(w + m2)
+        ms.append(m2)
+    return tuple(ws) + tuple(ms)
+
+
+@register(differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-9, rescale_grad=1.0):
+    """Reference: contrib/multi_lars.cc — layerwise LARS rates from the
+    stacked per-layer ||w||^2 / ||g||^2 vectors (fed by multi_sum_sq)."""
+    wnorm = jnp.sqrt(weights_sum_sq)
+    gnorm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * wnorm / (gnorm + wds * wnorm + eps)
+    return lrs * jnp.where(wnorm > 0, jnp.where(gnorm > 0, ratio, 1.0), 1.0)

@@ -61,9 +61,16 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    @staticmethod
+    def _is_half(weight):
+        # reference gates on float16 (optimizer.py:232); bfloat16 is the
+        # TPU-native half type and needs the same fp32 master treatment
+        return str(weight.dtype) in ("float16", "bfloat16")
+
     def create_state_multi_precision(self, index, weight):
-        """fp16 weights get an fp32 master copy (reference: optimizer.py:232)."""
-        if self.multi_precision and weight.dtype == onp.float16:
+        """Half-precision weights get an fp32 master copy (reference:
+        optimizer.py:232 create_state_multi_precision)."""
+        if self.multi_precision and self._is_half(weight):
             master = weight.astype("float32")
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
@@ -72,7 +79,7 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == onp.float16:
+        if self.multi_precision and self._is_half(weight):
             master, base_state = state
             g32 = grad.astype("float32")
             self.update(index, master, g32, base_state)
@@ -144,12 +151,22 @@ def _swap(weight, new):
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum (reference: optimizer.py:601)."""
+    """SGD with momentum (reference: optimizer.py:601).
+
+    Supports multi-tensor aggregated updates: when the Updater is handed a
+    LIST of indices, updates run through the fused multi_sgd_* /
+    multi_mp_sgd_* ops in chunks of ``aggregate_num`` (reference
+    optimizer.py _update_impl + MXNET_OPTIMIZER_AGGREGATION_SIZE).
+    """
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        from .. import env as _env
+
+        self.aggregate_num = _env.get_int(
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE", 4)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -178,6 +195,68 @@ class SGD(Optimizer):
                                      momentum=self.momentum, wd=wd, **kw)
             _swap(weight, w)
             _swap(state, m)
+
+    def update_multi(self, indices, weights, grads, states):
+        """Aggregated update through the fused multi-tensor ops, chunked
+        by ``aggregate_num`` (reference: optimizer.py _update_impl with
+        aggregate=True → MultiSGD(Mom)Update / MultiMPSGD(Mom)Update)."""
+        agg = max(1, int(self.aggregate_num))
+        kw = self._common_kwargs()
+        mom = self.momentum
+        for i0 in range(0, len(indices), agg):
+            idxs = indices[i0:i0 + agg]
+            ws = weights[i0:i0 + agg]
+            gs = grads[i0:i0 + agg]
+            sts = states[i0:i0 + agg]
+            n = len(idxs)
+            halfs = [self.multi_precision and self._is_half(w) for w in ws]
+            mp = all(halfs)
+            if any(halfs) and not mp:
+                # heterogeneous chunk: per-tensor path keeps state
+                # layouts consistent (it does its own update counting)
+                for i, w, g, s in zip(idxs, ws, gs, sts):
+                    self.update_multi_precision(i, w, g, s)
+                continue
+            for i in idxs:
+                self._update_count(i)
+            lrs = [self._get_lr(i) for i in idxs]
+            wds = [self._get_wd(i) for i in idxs]
+            if mp:
+                masters = [s[0] for s in sts]
+                base = [s[1] for s in sts]
+                if mom:
+                    ins = [x for w, g, s, m32 in zip(ws, gs, base, masters)
+                           for x in (w, g, s, m32)]
+                    out = nd.multi_mp_sgd_mom_update(
+                        *ins, lrs=lrs, wds=wds, momentum=mom,
+                        num_weights=n, **kw)
+                    for j in range(n):
+                        _swap(ws[j], out[j])
+                        _swap(base[j], out[n + j])
+                        _swap(masters[j], out[2 * n + j])
+                else:
+                    ins = [x for w, g, m32 in zip(ws, gs, masters)
+                           for x in (w, g, m32)]
+                    out = nd.multi_mp_sgd_update(
+                        *ins, lrs=lrs, wds=wds, num_weights=n, **kw)
+                    for j in range(n):
+                        _swap(ws[j], out[j])
+                        _swap(masters[j], out[n + j])
+            elif mom:
+                ins = [x for w, g, s in zip(ws, gs, sts)
+                       for x in (w, g, s)]
+                out = nd.multi_sgd_mom_update(
+                    *ins, lrs=lrs, wds=wds, momentum=mom,
+                    num_weights=n, **kw)
+                for j in range(n):
+                    _swap(ws[j], out[j])
+                    _swap(sts[j], out[n + j])
+            else:
+                ins = [x for w, g in zip(ws, gs) for x in (w, g)]
+                out = nd.multi_sgd_update(
+                    *ins, lrs=lrs, wds=wds, num_weights=n, **kw)
+                for j in range(n):
+                    _swap(ws[j], out[j])
 
 
 @register
@@ -702,12 +781,32 @@ class Updater:
         self.aggregate_updates = False
 
     def __call__(self, index, grad, weight):
-        if index not in self.states:
-            self.states[index] = \
-                self.optimizer.create_state_multi_precision(index, weight)
-            self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+        """Single index or, as in the reference (optimizer.py:1954), a
+        LIST of (index, grad, weight) triples — aggregated through the
+        optimizer's fused multi-tensor path when it has one."""
+        if isinstance(index, (list, tuple)):
+            indices, grads, weights = list(index), list(grad), list(weight)
+        else:
+            indices, grads, weights = [index], [grad], [weight]
+        for i, w in zip(indices, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+        from ..ndarray import sparse as _sp
+
+        dense = all(not isinstance(g, _sp.BaseSparseNDArray)
+                    for g in grads)
+        if (len(indices) > 1 and dense and
+                getattr(self.optimizer, "aggregate_num", 0) >= 1 and
+                hasattr(self.optimizer, "update_multi")):
+            self.optimizer.update_multi(
+                indices, weights, grads,
+                [self.states[i] for i in indices])
+        else:
+            for i, g, w in zip(indices, grads, weights):
+                self.optimizer.update_multi_precision(i, w, g,
+                                                      self.states[i])
 
     def get_states(self, dump_optimizer=False):
         states = {k: (v.asnumpy() if isinstance(v, nd.NDArray) else

@@ -123,6 +123,8 @@ MXTPU_API const char* MXGetLastError() { return last_error().c_str(); }
 
 MXTPU_API int MXNDArrayCreate(const int64_t* shape, int ndim, int dtype,
                               void** out) {
+  if (ndim < 0 || ndim > kMaxDim)
+    return set_error("MXNDArrayCreate: ndim must be in [0, 8]");
   Gil gil;
   PyObject* shp = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i)

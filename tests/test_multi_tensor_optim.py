@@ -1,0 +1,183 @@
+"""Multi-tensor fused optimizer ops + aggregated Updater path.
+
+Reference: src/operator/optimizer_op.cc MultiSGD(Mom)Update /
+MultiMPSGD(Mom)Update, src/operator/contrib/preloaded_multi_sgd.cc,
+contrib/multi_lars.cc, and python/mxnet/optimizer/optimizer.py
+_update_impl(aggregate=True) + create_state_multi_precision.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+def _rand(shape, dtype="float32", seed=0):
+    rng = onp.random.RandomState(seed)
+    return (rng.rand(*shape).astype("float32") - 0.5).astype(dtype)
+
+
+def test_multi_sgd_update_matches_single():
+    ws = [_rand((4, 3), seed=i) for i in range(3)]
+    gs = [_rand((4, 3), seed=10 + i) for i in range(3)]
+    lrs, wds = [0.1, 0.2, 0.05], [0.01, 0.0, 0.1]
+    ins = [nd.array(x) for pair in zip(ws, gs) for x in pair]
+    outs = nd.multi_sgd_update(*ins, lrs=lrs, wds=wds, num_weights=3,
+                               rescale_grad=0.5, clip_gradient=0.2)
+    for i in range(3):
+        single = nd.sgd_update(nd.array(ws[i]), nd.array(gs[i]), lrs[i],
+                               wd=wds[i], rescale_grad=0.5,
+                               clip_gradient=0.2)
+        onp.testing.assert_allclose(outs[i].asnumpy(), single.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+
+
+def test_multi_sgd_mom_update_matches_single():
+    n = 3
+    ws = [_rand((5,), seed=i) for i in range(n)]
+    gs = [_rand((5,), seed=10 + i) for i in range(n)]
+    ms = [_rand((5,), seed=20 + i) for i in range(n)]
+    lrs, wds = [0.1] * n, [0.01] * n
+    ins = [nd.array(x) for tri in zip(ws, gs, ms) for x in tri]
+    outs = nd.multi_sgd_mom_update(*ins, lrs=lrs, wds=wds, momentum=0.9,
+                                   num_weights=n)
+    for i in range(n):
+        w2, m2 = nd.sgd_mom_update(nd.array(ws[i]), nd.array(gs[i]),
+                                   nd.array(ms[i]), lrs[i], momentum=0.9,
+                                   wd=wds[i])
+        onp.testing.assert_allclose(outs[i].asnumpy(), w2.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+        onp.testing.assert_allclose(outs[n + i].asnumpy(), m2.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+
+
+def test_multi_mp_sgd_mom_update_fp32_master():
+    """Half weights advance through an fp32 master: after many tiny steps
+    the master must accumulate what bf16 weights alone would drop."""
+    n = 2
+    w32 = [onp.ones((8,), "float32") for _ in range(n)]
+    wh = [nd.array(w.astype("float32"), dtype="bfloat16") for w in w32]
+    masters = [nd.array(w) for w in w32]
+    moms = [nd.zeros((8,)) for _ in range(n)]
+    g = onp.full((8,), 1e-3, "float32")
+    for _ in range(10):
+        ins = [x for j in range(n)
+               for x in (wh[j], nd.array(g, dtype="bfloat16"), moms[j],
+                         masters[j])]
+        out = nd.multi_mp_sgd_mom_update(*ins, lrs=[0.01] * n,
+                                         wds=[0.0] * n, momentum=0.0,
+                                         num_weights=n)
+        for j in range(n):
+            wh[j], moms[j], masters[j] = out[j], out[n + j], out[2 * n + j]
+    # 10 steps of -0.01*1e-3 = -1e-4 total; bf16 can't represent
+    # 1 - 1e-5 per-step (eps≈7.8e-3) but the fp32 master can
+    expect = 1.0 - 1e-4
+    onp.testing.assert_allclose(masters[0].asnumpy(),
+                                onp.full((8,), expect), rtol=1e-6)
+    assert str(wh[0].dtype) == "bfloat16"
+
+
+def test_preloaded_multi_sgd_update():
+    n = 2
+    ws = [_rand((3, 3), seed=i) for i in range(n)]
+    gs = [_rand((3, 3), seed=5 + i) for i in range(n)]
+    lrs = onp.array([0.1, 0.3], "float32")
+    wds = onp.array([0.0, 0.02], "float32")
+    ins = [nd.array(x) for pair in zip(ws, gs) for x in pair]
+    outs = nd.preloaded_multi_sgd_update(
+        *ins, nd.array(lrs), nd.array(wds), num_weights=n)
+    for i in range(n):
+        single = nd.sgd_update(nd.array(ws[i]), nd.array(gs[i]),
+                               float(lrs[i]), wd=float(wds[i]))
+        onp.testing.assert_allclose(outs[i].asnumpy(), single.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+
+
+def test_preloaded_multi_sgd_mom_update():
+    n = 2
+    ws = [_rand((4,), seed=i) for i in range(n)]
+    gs = [_rand((4,), seed=5 + i) for i in range(n)]
+    ms = [_rand((4,), seed=9 + i) for i in range(n)]
+    lrs = onp.array([0.1, 0.3], "float32")
+    wds = onp.array([0.01, 0.0], "float32")
+    ins = [nd.array(x) for tri in zip(ws, gs, ms) for x in tri]
+    outs = nd.preloaded_multi_sgd_mom_update(
+        *ins, nd.array(lrs), nd.array(wds), momentum=0.85, num_weights=n)
+    for i in range(n):
+        w2, m2 = nd.sgd_mom_update(nd.array(ws[i]), nd.array(gs[i]),
+                                   nd.array(ms[i]), float(lrs[i]),
+                                   momentum=0.85, wd=float(wds[i]))
+        onp.testing.assert_allclose(outs[i].asnumpy(), w2.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+        onp.testing.assert_allclose(outs[n + i].asnumpy(), m2.asnumpy(),
+                                    rtol=RTOL, atol=ATOL)
+
+
+def test_multi_lars_rates():
+    lrs = nd.array(onp.array([0.1, 0.1, 0.1], "float32"))
+    wsq = nd.array(onp.array([4.0, 0.0, 1.0], "float32"))
+    gsq = nd.array(onp.array([1.0, 1.0, 0.0], "float32"))
+    wds = nd.array(onp.array([0.0, 0.0, 0.0], "float32"))
+    out = nd.multi_lars(lrs, wsq, gsq, wds, eta=0.1, eps=0.0).asnumpy()
+    # layer 0: 0.1 * eta*||w||/||g|| = 0.1 * 0.1*2/1 = 0.02
+    onp.testing.assert_allclose(out[0], 0.02, rtol=1e-5)
+    # zero-norm weight or grad → keep base lr
+    onp.testing.assert_allclose(out[1], 0.1, rtol=1e-5)
+    onp.testing.assert_allclose(out[2], 0.1, rtol=1e-5)
+
+
+def _run_updater(aggregate, n=6, steps=3, dtype="float32",
+                 multi_precision=False):
+    mx.random.seed(0)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                  multi_precision=multi_precision)
+    if not aggregate:
+        sgd.aggregate_num = 0
+    upd = opt.get_updater(sgd)
+    ws = [nd.array(_rand((7,), dtype=dtype, seed=i)) for i in range(n)]
+    for step in range(steps):
+        gs = [nd.array(_rand((7,), dtype=dtype, seed=100 + step * n + i))
+              for i in range(n)]
+        if aggregate:
+            upd(list(range(n)), gs, ws)
+        else:
+            for i in range(n):
+                upd(i, gs[i], ws[i])
+    return [w.asnumpy().astype("float32") for w in ws]
+
+
+def test_updater_aggregate_matches_sequential():
+    agg = _run_updater(True)
+    seq = _run_updater(False)
+    for a, s in zip(agg, seq):
+        onp.testing.assert_allclose(a, s, rtol=1e-5, atol=1e-6)
+
+
+def test_updater_aggregate_multi_precision_bf16():
+    agg = _run_updater(True, dtype="bfloat16", multi_precision=True)
+    seq = _run_updater(False, dtype="bfloat16", multi_precision=True)
+    for a, s in zip(agg, seq):
+        onp.testing.assert_allclose(a, s, rtol=1e-2, atol=1e-3)
+
+
+def test_updater_num_update_counting():
+    sgd = opt.SGD(learning_rate=0.1)
+    upd = opt.get_updater(sgd)
+    ws = [nd.array(_rand((3,), seed=i)) for i in range(5)]
+    gs = [nd.array(_rand((3,), seed=10 + i)) for i in range(5)]
+    upd(list(range(5)), gs, ws)
+    assert sgd.num_update == 1
+    upd(list(range(5)), gs, ws)
+    assert sgd.num_update == 2
+
+
+def test_create_state_multi_precision_bf16_master():
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = nd.array(_rand((4,), dtype="bfloat16"))
+    st = sgd.create_state_multi_precision(0, w)
+    master, mom = st
+    assert str(master.dtype) == "float32"
+    assert str(mom.dtype) == "float32"
