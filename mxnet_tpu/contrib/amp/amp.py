@@ -18,6 +18,7 @@ from .loss_scaler import LossScaler
 from ...ndarray import registry as _registry
 
 _state = {"initialized": False, "target_dtype": None}
+_NODE_SERIAL = [0]  # process-wide uniquifier for inserted graph nodes
 
 
 def init(target_dtype="bfloat16"):
@@ -62,13 +63,113 @@ def scale_loss(loss, trainer):
         yield loss * scaler.loss_scale
 
 
-def convert_model(net, target_dtype="bfloat16"):
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, widest_dtype_ops=None,
+                   excluded_sym_names=()):
+    """Graph-conversion pass: rebuild the Symbol DAG with amp_cast /
+    amp_multicast nodes at op boundaries per the op lists.
+
+    Reference: amp.py convert_symbol → src/nnvm/low_precision_pass.cc
+    ReducePrecision. Target-list ops get their inputs amp_cast to the
+    target dtype, fp32-list ops get amp_cast to float32 (amp_cast only
+    touches floating tensors, so casting blindly is safe), widest-list
+    ops route all inputs through one amp_multicast node. The pass is
+    purely structural — no parameter values are touched — so the result
+    works under bind/simple_bind, tojson, and ONNX export alike.
+    """
+    from ...symbol import Symbol
+
+    tgt = set(lists.TARGET_DTYPE_OPS if target_dtype_ops is None
+              else target_dtype_ops)
+    f32 = set(lists.FP32_OPS if fp32_ops is None else fp32_ops)
+    widest = set(lists.WIDEST_TYPE_CASTS if widest_dtype_ops is None
+                 else widest_dtype_ops)
+    excluded = set(excluded_sym_names)
+    memo = {}
+    # tojson collapses nodes BY NAME — every inserted node needs a name
+    # unique across ALL conversions (re-converting an already-converted
+    # graph must not mint a second node with a first-pass name)
+    serial = _NODE_SERIAL
+
+    def cast_in(s, dtype, tag):
+        serial[0] += 1
+        nm = (f"{s._name or s._op or 'sym'}_amp_cast_{dtype}_"
+              f"{tag}_{serial[0]}")
+        return Symbol(op="amp_cast", name=nm, inputs=[s],
+                      kwargs={"dtype": dtype})
+
+    def conv(s):
+        # output views of one multi-output node share the base node's
+        # _inputs/_kwargs objects — memoize by THAT identity so every
+        # view maps onto views of ONE converted node (names stay unique
+        # for tojson, and the eval cache's shared-identity keying holds)
+        if s._group is not None:
+            key = id(s)
+        elif s._op is None:
+            key = id(s)
+        else:
+            key = (s._op, id(s._inputs), id(s._kwargs), s._name)
+        base = memo.get(key)
+        if base is None:
+            if s._group is not None:
+                base = Symbol(group=[conv(g) for g in s._group])
+                memo[key] = base
+                return base
+            ins = [conv(i) for i in s._inputs]
+            op, name = s._op, s._name
+            if op is not None and name not in excluded:
+                if op in tgt:
+                    ins = [cast_in(x, target_dtype, i)
+                           for i, x in enumerate(ins)]
+                elif op in f32:
+                    ins = [cast_in(x, "float32", i)
+                           for i, x in enumerate(ins)]
+                elif op in widest and len(ins) > 1:
+                    serial[0] += 1
+                    mc = Symbol(op="amp_multicast",
+                                name=f"{name or op}_amp_multicast_"
+                                     f"{serial[0]}",
+                                inputs=ins,
+                                kwargs={"num_outputs": len(ins)},
+                                num_outputs=len(ins))
+                    ins = [mc[i] for i in range(len(ins))]
+            base = Symbol(op=op, name=name, inputs=ins,
+                          kwargs=dict(s._kwargs),
+                          num_outputs=s._num_outputs)
+            base._attrs = dict(s._attrs)
+            memo[key] = base
+        if s._op is not None and s._num_outputs > 1:
+            return base[s._output_index]
+        return base
+
+    return conv(sym)
+
+
+def convert_model(sym_or_net, arg_params=None, aux_params=None,
+                  target_dtype="bfloat16", **kwargs):
+    """Reference amp.py convert_model: symbolic (sym, arg_params,
+    aux_params) -> converted triple via the graph pass. Passing a Gluon
+    block keeps the round-1 behavior (cast with norm layers pinned
+    fp32), including the old positional form convert_model(net, dtype)."""
+    from ...symbol import Symbol
+
+    if isinstance(sym_or_net, Symbol):
+        out = convert_symbol(sym_or_net, target_dtype=target_dtype,
+                             **kwargs)
+        return out, dict(arg_params or {}), dict(aux_params or {})
+    if isinstance(arg_params, str):  # legacy convert_model(net, "float16")
+        target_dtype = arg_params
+    elif arg_params is not None or aux_params is not None:
+        raise TypeError(
+            "arg_params/aux_params only apply to symbolic conversion; "
+            "for Gluon blocks use convert_model(net, target_dtype=...)")
+    sym_or_net.cast(target_dtype)
+    return sym_or_net
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16"):
     """Cast a Gluon block's parameters/compute to the target dtype, keeping
-    norm layers fp32 (reference: amp.py convert_model / the
-    low_precision_pass.cc graph rewrite; BatchNorm.cast pins its params
-    fp32 here)."""
+    norm layers fp32 (reference: amp.py convert_hybrid_block; BatchNorm.cast
+    pins its params fp32 here)."""
     net.cast(target_dtype)
     return net
-
-
-convert_hybrid_block = convert_model

@@ -350,25 +350,29 @@ class LibSVMIter(DataIter):
         self.data_shape = tuple(data_shape) if hasattr(data_shape, "__len__") \
             else (data_shape,)
         ncol = self.data_shape[-1]
-        indptr, indices, values, labels = [0], [], [], []
         # labels come from the first token of each data line unless a
         # separate label file is given (reference: iter_libsvm.cc
         # label_libsvm param)
         inline_labels = label_libsvm is None
-        with open(data_libsvm) as f:
-            for line in f:
-                parts = line.split()
-                if not parts:
-                    continue
-                feats = parts
-                if inline_labels:
-                    labels.append(float(parts[0]))
-                    feats = parts[1:]
-                for tok in feats:
-                    k, v = tok.split(":")
-                    indices.append(int(k))
-                    values.append(float(v))
-                indptr.append(len(indices))
+        parsed = self._parse_native(data_libsvm, inline_labels)
+        if parsed is not None:
+            values, indices, indptr, labels = parsed
+        else:
+            indptr, indices, values, labels = [0], [], [], []
+            with open(data_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    feats = parts
+                    if inline_labels:
+                        labels.append(float(parts[0]))
+                        feats = parts[1:]
+                    for tok in feats:
+                        k, v = tok.split(":")
+                        indices.append(int(k))
+                        values.append(float(v))
+                    indptr.append(len(indices))
         if not inline_labels:
             with open(label_libsvm) as f:
                 for line in f:
@@ -379,14 +383,46 @@ class LibSVMIter(DataIter):
                 raise ValueError(
                     "label_libsvm has %d rows, data has %d"
                     % (len(labels), len(indptr) - 1))
-        self._indptr = onp.array(indptr, dtype=onp.int64)
-        self._indices = onp.array(indices, dtype=onp.int64)
-        self._values = onp.array(values, dtype=onp.float32)
-        self._labels = onp.array(labels, dtype=onp.float32)
+        self._indptr = onp.asarray(indptr, dtype=onp.int64)
+        self._indices = onp.asarray(indices, dtype=onp.int64)
+        self._values = onp.asarray(values, dtype=onp.float32)
+        self._labels = onp.asarray(labels, dtype=onp.float32)
         self._ncol = ncol
         self.round_batch = round_batch
         self._cursor = 0
         self.num_data = len(self._labels)
+
+    @staticmethod
+    def _parse_native(path, inline_labels):
+        """Compiled multithreaded parse (native/textio.cc — the analog of
+        iter_libsvm.cc's C++ tokenizer). None → Python fallback."""
+        from .._native import textlib
+
+        if textlib is None:
+            return None
+        h = textlib.svm_parse(str(path).encode(), 1 if inline_labels else 0)
+        if not h:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                f"libsvm parse failed: "
+                f"{textlib.textio_last_error().decode()}")
+        try:
+            rows, nnz = textlib.svm_rows(h), textlib.svm_nnz(h)
+
+            def arr(ptr, n, dtype):
+                if n == 0:
+                    return onp.zeros(0, dtype)
+                return onp.ctypeslib.as_array(ptr, shape=(n,)).copy()
+
+            values = arr(textlib.svm_data(h), nnz, "f")
+            indices = arr(textlib.svm_indices(h), nnz, onp.int64)
+            indptr = arr(textlib.svm_indptr(h), rows + 1, onp.int64)
+            labels = (arr(textlib.svm_labels(h), rows, "f")
+                      if inline_labels else onp.zeros(0, "f"))
+            return values, indices, indptr, list(labels)
+        finally:
+            textlib.svm_free(h)
 
     @property
     def provide_data(self):

@@ -16,6 +16,7 @@ from collections import namedtuple
 import numpy as onp
 
 from .. import ndarray as nd
+from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
@@ -363,16 +364,41 @@ class MNISTIter(NDArrayIter):
                          shuffle=bool(shuffle), last_batch_handle="discard")
 
 
+def _parse_csv(path):
+    """Parse a float CSV with the compiled multithreaded parser
+    (native/textio.cc, the analog of src/io/iter_csv.cc's C++ parse);
+    numpy.loadtxt only as the no-toolchain fallback."""
+    from .._native import textlib
+
+    if textlib is not None:
+        h = textlib.csv_parse(str(path).encode())
+        if not h:
+            raise MXNetError(
+                f"CSV parse failed: "
+                f"{textlib.textio_last_error().decode()}")
+        try:
+            rows, cols = textlib.csv_rows(h), textlib.csv_cols(h)
+            if rows * cols == 0:
+                return onp.zeros((rows, cols), dtype=onp.float32)
+            flat = onp.ctypeslib.as_array(
+                textlib.csv_data(h), shape=(rows * cols,))
+            return flat.reshape(rows, cols).copy()
+        finally:
+            textlib.csv_free(h)
+    return onp.loadtxt(path, delimiter=",", dtype=onp.float32,
+                       ndmin=2)
+
+
 class CSVIter(NDArrayIter):
-    """CSV iterator (reference: src/io/iter_csv.cc)."""
+    """CSV iterator (reference: src/io/iter_csv.cc). Parsing is native
+    C++ (GIL-free, line-chunk multithreaded) via native/textio.cc."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
-        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
-        data = data.reshape((-1,) + tuple(data_shape))
+        data = _parse_csv(data_csv).reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32)
-            label = label.reshape((-1,) + tuple(label_shape))
+            label = _parse_csv(label_csv).reshape(
+                (-1,) + tuple(label_shape))
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="pad" if round_batch else "discard")

@@ -16,6 +16,15 @@ is kept but aggregation is executed by XLA:
   kvstore_dist_server.h:346 ApplyUpdates) runs the optimizer on the
   aggregated value exactly once per key, preserving update_on_kvstore
   semantics.
+- ``dist_async`` — push() is non-blocking: a background applier thread
+  aggregates and applies updates off the critical path (the latency-
+  hiding property async mode exists for; reference
+  kvstore_dist_server.h async push). pull/barrier flush this worker's
+  pending updates (read-your-writes); applier failures re-raise
+  deferred at the next pull/barrier like the engine's poison vars.
+  With >1 process it degrades to synchronous pushes — XLA collectives
+  must execute in identical order on every process, which an
+  independent per-worker applier thread cannot guarantee.
 """
 from __future__ import annotations
 
@@ -47,6 +56,93 @@ class KVStore:
         # MXNET_KVSTORE_BIGARRAY_BOUND)
         self._bigarray_bound = int(
             os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+        # dist_async: pushes apply on a background thread (non-blocking
+        # push, eventual consistency — the property async mode exists
+        # for). Cross-process collectives can't be safely reordered onto
+        # a worker thread (mismatched all-reduce ordering deadlocks), so
+        # with >1 process async degrades to synchronous pushes.
+        self._async_mode = False
+        self._async_q = None
+        self._async_thread = None
+        self._async_err = None
+        if kv_type == "dist_async":
+            try:
+                import jax
+
+                nproc = jax.process_count()
+            except Exception:
+                nproc = 1
+            self._async_mode = nproc == 1
+
+    # -- async applier -----------------------------------------------------
+    def _async_submit(self, k, agg):
+        import queue
+        import threading
+
+        self._check_async_error()
+        if self._async_thread is None:
+            import atexit
+            import weakref
+
+            self._async_q = queue.Queue()
+            ref = weakref.ref(self)
+
+            def flush_at_exit():
+                kv = ref()
+                if kv is None:
+                    return
+                try:  # pushes after the last pull must still apply
+                    kv._async_flush()
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "dist_async flush at exit failed: %s", e)
+
+            atexit.register(flush_at_exit)
+            # the worker must NOT hold a strong ref to self: a discarded
+            # kvstore would otherwise be pinned (with its whole parameter
+            # store) by its own applier thread forever. The weakref lets
+            # the store die; its finalizer then sends the None sentinel
+            # that releases the thread.
+            q = self._async_q
+
+            def drain():
+                while True:
+                    item = q.get()
+                    try:
+                        if item is None:
+                            return
+                        kv = ref()
+                        if kv is None:
+                            return
+                        try:
+                            kv._apply_update(*item)
+                        except Exception as e:  # deferred re-raise
+                            kv._async_err = kv._async_err or e
+                        finally:
+                            del kv
+                    finally:
+                        q.task_done()
+
+            self._async_thread = threading.Thread(
+                target=drain, name="kvstore-async", daemon=True)
+            self._async_thread.start()
+            weakref.finalize(self, q.put, None)
+        self._async_q.put((k, agg))
+
+    def _async_flush(self):
+        """Wait for in-flight async updates; re-raise their first error
+        (deferred-raise, matching the engine's poison-var semantics)."""
+        if self._async_q is not None:
+            self._async_q.join()
+        self._check_async_error()
+
+    def _check_async_error(self):
+        if self._async_err is not None:
+            err, self._async_err = self._async_err, None
+            raise MXNetError(
+                f"asynchronous kvstore update failed: {err}") from err
 
     @property
     def type(self):
@@ -177,33 +273,50 @@ class KVStore:
                 if self._compression is not None and not isinstance(
                         agg, _sp.BaseSparseNDArray):
                     agg = self._compress(k, 0, agg)
-            if self._type.startswith("dist"):
-                from . import parallel
-
-                agg = parallel.all_reduce(agg)
             if k not in self._store:
                 raise MXNetError(f"key {k} was not initialized")
-            stored = self._store[k]
-            if not isinstance(agg, _sp.BaseSparseNDArray) and \
-                    not isinstance(stored, _sp.BaseSparseNDArray) and \
-                    agg.data.sharding != stored.data.sharding:
-                # big keys live row-sharded (_maybe_shard) — bring the
-                # aggregate onto the same layout so the update stays a
-                # sharded computation instead of a device clash
-                import jax
-
-                agg = NDArray(jax.device_put(agg.data,
-                                             stored.data.sharding))
-            if self._updater is not None:
-                self._updater(_key_to_int(k), agg, stored)
-            elif isinstance(agg, _sp.BaseSparseNDArray) or isinstance(
-                    stored, _sp.BaseSparseNDArray):
-                # rebind wholesale: merged result may change nnz/format
-                self._store[k] = _sp.elemwise_add(stored, agg)
+            if self._async_mode:
+                # dist_async: push returns immediately; a single applier
+                # thread aggregates + applies off the critical path
+                # (reference kvstore_dist_server.h async push handling —
+                # workers never wait on each other's updates)
+                self._async_submit(k, agg)
             else:
-                stored._data = (stored + agg).data
+                self._apply_update(k, agg)
+
+    def _apply_update(self, k, agg):
+        from .ndarray import sparse as _sp
+
+        if self._type.startswith("dist"):
+            from . import parallel
+
+            agg = parallel.all_reduce(agg)
+        stored = self._store[k]
+        if not isinstance(agg, _sp.BaseSparseNDArray) and \
+                not isinstance(stored, _sp.BaseSparseNDArray) and \
+                agg.data.sharding != stored.data.sharding:
+            # big keys live row-sharded (_maybe_shard) — bring the
+            # aggregate onto the same layout so the update stays a
+            # sharded computation instead of a device clash
+            import jax
+
+            agg = NDArray(jax.device_put(agg.data,
+                                         stored.data.sharding))
+        if self._updater is not None:
+            self._updater(_key_to_int(k), agg, stored)
+        elif isinstance(agg, _sp.BaseSparseNDArray) or isinstance(
+                stored, _sp.BaseSparseNDArray):
+            # rebind wholesale: merged result may change nnz/format
+            self._store[k] = _sp.elemwise_add(stored, agg)
+        else:
+            stored._data = (stored + agg).data
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Read current values. In dist_async, this worker's own pending
+        pushes are flushed first (read-your-writes; the reference engine
+        orders same-key push→pull through variable dependencies)."""
+        if self._async_mode:
+            self._async_flush()
         keys, outs, _ = self._normalize(key, out)
         for k, o in zip(keys, outs):
             k = str(k)
@@ -232,6 +345,8 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._async_mode:
+            self._async_flush()
         keys, outs, _ = self._normalize(key, out)
         _, rids, _ = self._normalize(key, row_ids)
         for k, o, r in zip(keys, outs, rids):
@@ -273,6 +388,8 @@ class KVStore:
         """Reference: kvstore.h:391 Barrier. Multi-host: a global device
         sync; failures propagate (a swallowed barrier error would let
         workers desynchronize silently)."""
+        if self._async_mode:
+            self._async_flush()
         if self._type.startswith("dist") and self.num_workers > 1:
             from jax.experimental import multihost_utils
 
@@ -281,6 +398,8 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer is set")
+        if self._async_mode:
+            self._async_flush()
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer))
 

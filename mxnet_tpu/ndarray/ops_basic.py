@@ -97,6 +97,33 @@ def cast(data, dtype):
     return data.astype(_canon_dtype(dtype))
 
 
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+@register()
+def amp_cast(data, dtype="float32"):
+    """Cast FLOATING inputs only (reference: src/operator/tensor/
+    amp_cast.cc — inserted by the AMP graph pass; integer/bool tensors
+    pass through untouched so the pass can cast blindly)."""
+    from .ndarray import _canon_dtype
+
+    if str(data.dtype) in _FLOAT_DTYPES:
+        return data.astype(_canon_dtype(dtype))
+    return data
+
+
+@register()
+def amp_multicast(*data, num_outputs=0):
+    """Cast all floating inputs to the widest floating dtype present
+    (reference: amp_cast.cc AMPMultiCast)."""
+    fl = [str(x.dtype) for x in data if str(x.dtype) in _FLOAT_DTYPES]
+    if not fl:
+        return tuple(data)
+    widest = max(fl, key=_FLOAT_DTYPES.index)
+    return tuple(x.astype(widest) if str(x.dtype) in _FLOAT_DTYPES else x
+                 for x in data)
+
+
 @register()
 def clip(data, a_min=None, a_max=None):
     return jnp.clip(data, a_min, a_max)

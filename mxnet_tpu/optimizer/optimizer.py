@@ -778,7 +778,11 @@ class Updater:
         self.optimizer = optimizer
         self.states = {}
         self.states_synced = {}
-        self.aggregate_updates = False
+        # reference optimizer.py:1954: aggregation is on when the
+        # optimizer has a fused multi-tensor path; users may toggle it
+        self.aggregate_updates = (
+            getattr(optimizer, "aggregate_num", 0) >= 1 and
+            hasattr(optimizer, "update_multi"))
 
     def __call__(self, index, grad, weight):
         """Single index or, as in the reference (optimizer.py:1954), a
@@ -797,8 +801,7 @@ class Updater:
 
         dense = all(not isinstance(g, _sp.BaseSparseNDArray)
                     for g in grads)
-        if (len(indices) > 1 and dense and
-                getattr(self.optimizer, "aggregate_num", 0) >= 1 and
+        if (len(indices) > 1 and dense and self.aggregate_updates and
                 hasattr(self.optimizer, "update_multi")):
             self.optimizer.update_multi(
                 indices, weights, grads,

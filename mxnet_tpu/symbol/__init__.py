@@ -119,20 +119,30 @@ class Symbol:
         from .. import ndarray as nd
         from ..ndarray import NDArray
 
-        if id(self) in cache:
-            return cache[id(self)]
+        # output views made by __getitem__ share the base node's _inputs
+        # and _kwargs objects — keying op nodes on those identities makes
+        # every view hit ONE evaluation of the underlying multi-output op
+        # instead of re-invoking it per view
+        key = (self._op, id(self._inputs), id(self._kwargs)) \
+            if self._op is not None else id(self)
+        if key in cache:
+            out = cache[key]
+            if self._op is not None and isinstance(out, (list, tuple)):
+                return out[self._output_index] \
+                    if self._num_outputs > 1 else out
+            return out
         if self._group is not None:
             outs = []
             for g in self._group:
                 o = g._eval_nodes(feed, cache)
                 outs.extend(o if isinstance(o, (list, tuple)) else [o])
-            cache[id(self)] = outs
+            cache[key] = outs
             return outs
         if self._op is None:
             if self._name not in feed:
                 raise MXNetError(f"variable '{self._name}' is not bound")
-            cache[id(self)] = feed[self._name]
-            return cache[id(self)]
+            cache[key] = feed[self._name]
+            return cache[key]
         args = []
         for i in self._inputs:
             v = i._eval_nodes(feed, cache)
@@ -144,7 +154,7 @@ class Symbol:
             raise MXNetError(f"op '{self._op}' is not registered")
         kwargs = dict(self._kwargs)
         out = _registry.invoke(opdef, tuple(args), kwargs)
-        cache[id(self)] = out
+        cache[key] = out
         if isinstance(out, (list, tuple)):
             return out[self._output_index] if self._num_outputs > 1 else out
         return out

@@ -1,0 +1,94 @@
+"""Training-log parser.
+
+Reference: example/image-classification/parse_log.py (and the epoch/
+accuracy tables in tools/) — turns Speedometer/Estimator log lines into a
+per-epoch table or machine-readable rows. Works on the logging format
+emitted by mxnet_tpu.callback.Speedometer / LogValidationMetricsCallback.
+
+Run: ``python -m mxnet_tpu.tools.parse_log train.log [--format md|csv]``
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+# Epoch[3] Batch [40]  Speed: 1056.32 samples/sec  accuracy=0.8123
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\].*?Speed:\s*([\d.]+)\s*samples/sec(?:.*?=([\d.]+))?")
+# Epoch[3] Validation-accuracy=0.7612  /  Epoch[3] Train-accuracy=0.81
+_METRIC = re.compile(r"Epoch\[(\d+)\]\s+(\S+?)-(\S+)=([\d.]+)")
+# Epoch[3] Time cost=123.456
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse(lines):
+    """Returns {epoch: {"speed": [..], "train": {metric: v},
+    "valid": {metric: v}, "time": s}}."""
+    out = {}
+
+    def ep(i):
+        return out.setdefault(int(i), {"speed": [], "train": {},
+                                       "valid": {}, "time": None})
+
+    for line in lines:
+        m = _SPEED.search(line)
+        if m:
+            ep(m.group(1))["speed"].append(float(m.group(2)))
+            continue
+        m = _TIME.search(line)
+        if m:
+            ep(m.group(1))["time"] = float(m.group(2))
+            continue
+        m = _METRIC.search(line)
+        if m:
+            epoch, kind, metric, val = m.groups()
+            kind = kind.lower()
+            bucket = "valid" if kind.startswith("valid") else "train"
+            ep(epoch)[bucket][metric] = float(val)
+    return out
+
+
+def rows(parsed):
+    metrics = sorted({m for e in parsed.values()
+                      for m in (*e["train"], *e["valid"])})
+    header = ["epoch", "speed(samples/s)", "time(s)"]
+    for m in metrics:
+        header += [f"train-{m}", f"valid-{m}"]
+    table = [header]
+    for epoch in sorted(parsed):
+        e = parsed[epoch]
+        speed = (sum(e["speed"]) / len(e["speed"])) if e["speed"] else None
+        row = [str(epoch),
+               f"{speed:.1f}" if speed is not None else "-",
+               f"{e['time']:.1f}" if e["time"] is not None else "-"]
+        for m in metrics:
+            row.append(f"{e['train'][m]:.4f}" if m in e["train"] else "-")
+            row.append(f"{e['valid'][m]:.4f}" if m in e["valid"] else "-")
+        table.append(row)
+    return table
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("md", "csv"), default="md")
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        parsed = parse(f)
+    table = rows(parsed)
+    if args.format == "csv":
+        for row in table:
+            print(",".join(row))
+    else:
+        widths = [max(len(r[i]) for r in table)
+                  for i in range(len(table[0]))]
+        for j, row in enumerate(table):
+            print(" | ".join(c.ljust(w) for c, w in zip(row, widths)))
+            if j == 0:
+                print("-|-".join("-" * w for w in widths))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

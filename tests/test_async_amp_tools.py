@@ -1,0 +1,205 @@
+"""dist_async kvstore, AMP graph-conversion pass, diagnose/parse_log tools.
+
+Reference: kvstore_dist_server.h async push; amp.py convert_symbol →
+low_precision_pass.cc; tools/diagnose.py; parse_log.
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, kvstore, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import amp
+
+
+# ---------------------------------------------------------------- async ---
+
+def test_dist_async_applies_eventually():
+    kv = kvstore.create("dist_async")
+    kv.init("w", nd.zeros((4,)))
+    for _ in range(5):
+        kv.push("w", nd.ones((4,)))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)  # flushes pending pushes
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 5.0))
+
+
+def test_dist_async_matches_sync_result():
+    def run(kind):
+        kv = kvstore.create(kind)
+        kv.init("3", nd.ones((2, 3)))
+        for step in range(4):
+            kv.push("3", nd.array(onp.full((2, 3), step + 1.0, "f")))
+        kv.barrier()
+        out = nd.zeros((2, 3))
+        kv.pull("3", out=out)
+        return out.asnumpy()
+
+    onp.testing.assert_allclose(run("dist_async"), run("dist_sync"))
+
+
+def test_dist_async_updater_and_error_propagation():
+    kv = kvstore.create("dist_async")
+    kv.init("w", nd.zeros((3,)))
+    seen = []
+
+    def updater(key, grad, weight):
+        if len(seen) == 1:
+            raise RuntimeError("boom at second update")
+        seen.append(key)
+        weight._data = (weight - 0.1 * grad).data
+
+    kv.set_updater(updater)
+    kv.push("w", nd.ones((3,)))
+    kv.push("w", nd.ones((3,)))
+    with pytest.raises(MXNetError, match="boom"):
+        for _ in range(100):
+            kv.barrier()
+            time.sleep(0.01)
+
+
+def test_dist_async_nonblocking_push():
+    """push must return before a slow updater finishes applying."""
+    kv = kvstore.create("dist_async")
+    kv.init("w", nd.zeros((2,)))
+    applied = []
+
+    def slow_updater(key, grad, weight):
+        time.sleep(0.3)
+        applied.append(key)
+
+    kv.set_updater(slow_updater)
+    t0 = time.perf_counter()
+    kv.push("w", nd.ones((2,)))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.25, f"push blocked for {elapsed:.3f}s"
+    kv.barrier()
+    assert applied
+
+
+# ------------------------------------------------------------- amp pass ---
+
+def _mlp():
+    x = sym.Variable("data")
+    fc = sym.FullyConnected(x, name="fc", num_hidden=8,
+                            weight=sym.Variable("fc_weight"),
+                            bias=sym.Variable("fc_bias"))
+    act = sym.Activation(fc, act_type="relu")
+    return sym.softmax(act)
+
+
+def test_convert_symbol_inserts_casts():
+    converted = amp.convert_symbol(_mlp(), target_dtype="bfloat16")
+    ops = [s._op for s in converted._walk() if s._op]
+    assert "amp_cast" in ops
+    # fully_connected is a TARGET op: its data/weight/bias all get casts;
+    # softmax is FP32-listed: its input gets a cast back up
+    assert ops.count("amp_cast") >= 4
+
+
+def test_convert_symbol_runs_and_matches_fp32():
+    s = _mlp()
+    rng = onp.random.RandomState(0)
+    args = {"data": nd.array(rng.rand(4, 6).astype("f")),
+            "fc_weight": nd.array(rng.rand(8, 6).astype("f") * 0.1),
+            "fc_bias": nd.array(rng.rand(8).astype("f") * 0.1)}
+    base = s.bind(args=dict(args)).forward(is_train=False)[0].asnumpy()
+    conv = amp.convert_symbol(s, target_dtype="bfloat16")
+    got = conv.bind(args=dict(args)).forward(is_train=False)[0].asnumpy()
+    assert got.dtype == onp.float32  # softmax forced back to fp32
+    onp.testing.assert_allclose(got, base, rtol=2e-2, atol=2e-2)
+
+
+def test_convert_symbol_excluded_names():
+    conv = amp.convert_symbol(_mlp(), target_dtype="bfloat16",
+                              excluded_sym_names=["fc"])
+    # fc excluded -> only softmax's fp32 cast remains
+    casts = [s for s in conv._walk() if s._op == "amp_cast"]
+    assert all("softmax" in (c._name or "") or
+               "relu" in (c._name or "") or
+               "activation" in (c._name or "").lower()
+               for c in casts)
+
+
+def test_convert_model_symbolic_triple():
+    s = _mlp()
+    arg = {"fc_weight": nd.ones((8, 6))}
+    aux = {}
+    s2, arg2, aux2 = amp.convert_model(s, arg, aux,
+                                       target_dtype="bfloat16")
+    assert "amp_cast" in [n._op for n in s2._walk()]
+    assert set(arg2) == {"fc_weight"}
+
+
+def test_amp_multicast_widest():
+    a = nd.array(onp.ones((2, 2), "float32"))
+    b = nd.array(onp.ones((2, 2)), dtype="bfloat16")
+    oa, ob = nd.amp_multicast(a, b, num_outputs=2)
+    assert str(oa.dtype) == "float32" and str(ob.dtype) == "float32"
+
+
+def test_amp_cast_leaves_ints():
+    x = nd.array(onp.arange(4, dtype="int32"))
+    y = nd.amp_cast(x, dtype="bfloat16")
+    assert str(y.dtype) == "int32"
+
+
+# ---------------------------------------------------------------- tools ---
+
+def test_parse_log(tmp_path):
+    from mxnet_tpu.tools import parse_log
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Batch [20] Speed: 1000.00 samples/sec "
+        "accuracy=0.50\n"
+        "INFO Epoch[0] Batch [40] Speed: 1200.00 samples/sec "
+        "accuracy=0.60\n"
+        "INFO Epoch[0] Train-accuracy=0.61\n"
+        "INFO Epoch[0] Time cost=33.3\n"
+        "INFO Epoch[0] Validation-accuracy=0.55\n"
+        "INFO Epoch[1] Batch [20] Speed: 1100.00 samples/sec "
+        "accuracy=0.70\n"
+        "INFO Epoch[1] Validation-accuracy=0.65\n")
+    parsed = parse_log.parse(log.read_text().splitlines())
+    assert parsed[0]["valid"]["accuracy"] == 0.55
+    assert parsed[0]["train"]["accuracy"] == 0.61
+    assert parsed[0]["time"] == 33.3
+    assert parsed[0]["speed"] == [1000.0, 1200.0]
+    assert parsed[1]["valid"]["accuracy"] == 0.65
+    table = parse_log.rows(parsed)
+    assert table[0][0] == "epoch" and len(table) == 3
+
+
+def test_diagnose_runs(capsys):
+    from mxnet_tpu.tools import diagnose
+
+    diagnose.check_python()
+    diagnose.check_deps()
+    diagnose.check_mxnet()
+    diagnose.check_environment()
+    out = capsys.readouterr().out
+    assert "Python Info" in out
+    assert "MXNet-TPU Info" in out
+    assert "Native libs" in out
+
+
+def test_convert_symbol_multi_output_views_stay_one_node():
+    """Re-converting a graph whose amp_multicast outputs feed one op must
+    keep ONE converted multicast node (unique names; views share it)."""
+    a, b = sym.Variable("a"), sym.Variable("b")
+    s = sym.elemwise_add(a, b)  # widest-list op -> amp_multicast inserted
+    c1 = amp.convert_symbol(s)
+    c2 = amp.convert_symbol(c1)  # multicast outputs consumed as views
+    nodes = {}
+    for n in c2._walk():
+        if n._op == "amp_multicast":
+            nodes.setdefault(n._name, set()).add(
+                (id(n._inputs), id(n._kwargs)))
+    for name, idents in nodes.items():
+        assert len(idents) == 1, f"{name} split into {len(idents)} nodes"
+    # still evaluates correctly
+    out = c2.bind(args={"a": nd.ones((2,)), "b": nd.ones((2,))}).forward()
+    onp.testing.assert_allclose(out[0].asnumpy(), [2.0, 2.0])

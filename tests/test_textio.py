@@ -1,0 +1,121 @@
+"""Compiled CSV/LibSVM parser tests (native/textio.cc behind CSVIter /
+LibSVMIter; reference: src/io/iter_csv.cc, src/io/iter_libsvm.cc)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu._native import textlib
+from mxnet_tpu.io import CSVIter
+from mxnet_tpu.io.image_record import LibSVMIter
+from mxnet_tpu.base import MXNetError
+
+
+def test_native_parser_loaded():
+    assert textlib is not None, "libtextio.so failed to build/load"
+
+
+def test_csviter_matches_numpy(tmp_path):
+    rng = onp.random.RandomState(0)
+    data = rng.randn(256, 6).astype("f")
+    labels = rng.randint(0, 3, (256, 1)).astype("f")
+    dpath, lpath = tmp_path / "d.csv", tmp_path / "l.csv"
+    onp.savetxt(dpath, data, delimiter=",", fmt="%.6g")
+    onp.savetxt(lpath, labels, delimiter=",", fmt="%.6g")
+    it = CSVIter(data_csv=str(dpath), data_shape=(6,),
+                 label_csv=str(lpath), label_shape=(1,), batch_size=64,
+                 round_batch=False)
+    got_d, got_l = [], []
+    for batch in it:
+        got_d.append(batch.data[0].asnumpy())
+        got_l.append(batch.label[0].asnumpy())
+    got_d = onp.concatenate(got_d)
+    got_l = onp.concatenate(got_l)
+    onp.testing.assert_allclose(got_d, data, rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(got_l.reshape(-1, 1), labels, rtol=1e-5)
+
+
+def test_csv_blank_lines_and_spaces(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("1.0, 2.0 ,3.0\n\n4.5,5.5,6.5\n   \n7,8,9\n")
+    it = CSVIter(data_csv=str(p), data_shape=(3,), batch_size=3,
+                 round_batch=False)
+    batch = next(iter(it))
+    onp.testing.assert_allclose(
+        batch.data[0].asnumpy(),
+        [[1.0, 2.0, 3.0], [4.5, 5.5, 6.5], [7.0, 8.0, 9.0]])
+
+
+def test_csv_ragged_raises(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises((MXNetError, ValueError)):
+        CSVIter(data_csv=str(p), data_shape=(3,), batch_size=1)
+
+
+def test_csv_malformed_raises(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,abc,3\n")
+    with pytest.raises((MXNetError, ValueError)):
+        CSVIter(data_csv=str(p), data_shape=(3,), batch_size=1)
+
+
+def test_libsvm_inline_labels(tmp_path):
+    p = tmp_path / "t.libsvm"
+    p.write_text("1 0:1.5 3:2.5\n"
+                 "0 1:-1.0\n"
+                 "\n"
+                 "2 0:0.5 2:4.0 3:-2.0\n")
+    it = LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=3,
+                    round_batch=False)
+    batch = next(iter(it))
+    dense = batch.data[0].asnumpy() if not hasattr(
+        batch.data[0], "todense") else batch.data[0].todense().asnumpy()
+    expect = onp.array([[1.5, 0, 0, 2.5],
+                        [0, -1.0, 0, 0],
+                        [0.5, 0, 4.0, -2.0]], "f")
+    onp.testing.assert_allclose(dense, expect)
+    onp.testing.assert_allclose(batch.label[0].asnumpy(), [1, 0, 2])
+
+
+def test_libsvm_separate_label_file(tmp_path):
+    d = tmp_path / "d.libsvm"
+    l = tmp_path / "l.libsvm"
+    d.write_text("0:1.0\n1:2.0\n")
+    l.write_text("5\n7\n")
+    it = LibSVMIter(data_libsvm=str(d), data_shape=(2,),
+                    label_libsvm=str(l), batch_size=2, round_batch=False)
+    batch = next(iter(it))
+    onp.testing.assert_allclose(batch.label[0].asnumpy(), [5, 7])
+
+
+def test_libsvm_malformed_raises(tmp_path):
+    p = tmp_path / "bad.libsvm"
+    p.write_text("1 0:1.5 nonsense\n")
+    with pytest.raises((MXNetError, ValueError)):
+        LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=1)
+
+
+def test_native_csv_large_parallel(tmp_path):
+    """Big enough to span several parser threads; order must hold."""
+    n = 20000
+    data = onp.arange(n * 3, dtype=onp.float32).reshape(n, 3)
+    p = tmp_path / "big.csv"
+    onp.savetxt(p, data, delimiter=",", fmt="%.1f")
+    from mxnet_tpu.io.io import _parse_csv
+
+    out = _parse_csv(str(p))
+    assert out.shape == (n, 3)
+    onp.testing.assert_allclose(out, data)
+
+
+def test_csv_comments_like_loadtxt(tmp_path):
+    p = tmp_path / "c.csv"
+    p.write_text("# header comment\n1,2,3\n4,5,6 # trailing\n")
+    from mxnet_tpu.io.io import _parse_csv
+
+    out = _parse_csv(str(p))
+    onp.testing.assert_allclose(out, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_csv_directory_raises_not_aborts(tmp_path):
+    with pytest.raises((MXNetError, ValueError, OSError)):
+        CSVIter(data_csv=str(tmp_path), data_shape=(3,), batch_size=1)
