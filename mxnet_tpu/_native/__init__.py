@@ -19,29 +19,39 @@ _src_dir = os.path.join(os.path.dirname(os.path.dirname(_here)), "native")
 lib = None       # librecordio: frame parsing + jpeg pipeline
 englib = None    # libengine: dependency engine + pooled storage
 
+# the one lazy-rebuild recipe shared by every native library: flags kept
+# identical to native/Makefile's CXXFLAGS so a lazily rebuilt .so matches
+# a make-built one
+_CXXFLAGS = ["-O3", "-fPIC", "-std=c++17", "-Wall"]
 
-def _try_build():
-    src = os.path.join(_src_dir, "recordio.cc")
+
+def _ensure_built(so_name, src_name, extra_flags=()):
+    """Build OUTDIR/so_name from native/src_name when missing or stale.
+    Returns the .so path, or None when it can't be produced (no source /
+    no toolchain) — callers fall back to pure Python."""
+    so = os.path.join(_here, so_name)
+    src = os.path.join(_src_dir, src_name)
+    if os.path.isfile(so) and (not os.path.isfile(src) or
+                               os.path.getmtime(src)
+                               <= os.path.getmtime(so)):
+        return so
     if not os.path.isfile(src):
-        return False
+        return so if os.path.isfile(so) else None
     try:
         subprocess.run(
-            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", _so_path,
-             src, "-ljpeg", "-lpthread"],
+            ["g++", *_CXXFLAGS, "-shared", "-o", so, src,
+             *extra_flags, "-lpthread"],
             check=True, capture_output=True, timeout=120)
-        return True
     except Exception:
-        return False
+        pass
+    return so if os.path.isfile(so) else None
 
 
 def _load():
     global lib
-    if not os.path.isfile(_so_path) or (
-            os.path.isfile(os.path.join(_src_dir, "recordio.cc")) and
-            os.path.getmtime(os.path.join(_src_dir, "recordio.cc"))
-            > os.path.getmtime(_so_path)):
-        if not _try_build() and not os.path.isfile(_so_path):
-            return
+    if _ensure_built("librecordio.so", "recordio.cc",
+                     ("-ljpeg",)) is None:
+        return
     try:
         L = ctypes.CDLL(_so_path)
     except OSError:
@@ -71,26 +81,28 @@ def _load():
 
 def _load_engine():
     global englib
-    so = os.path.join(_here, "libengine.so")
-    src = os.path.join(_src_dir, "engine.cc")
-    if (not os.path.isfile(so) or (os.path.isfile(src) and
-                                   os.path.getmtime(src)
-                                   > os.path.getmtime(so))):
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", so,
-                 src, "-lpthread"],
-                check=True, capture_output=True, timeout=120)
-        except Exception:
-            if not os.path.isfile(so):
-                return
+    so = _ensure_built("libengine.so", "engine.cc")
+    if so is None:
+        return
     try:
         L = ctypes.CDLL(so)
     except OSError:
         return
     i64 = ctypes.c_int64
+    try:
+        _bind_engine(L, i64)
+    except AttributeError:
+        # stale prebuilt .so missing newer symbols and no toolchain to
+        # rebuild: degrade to the pure-Python engine, don't break import
+        return
+    englib = L
+
+
+def _bind_engine(L, i64):
     L.eng_create.restype = ctypes.c_void_p
     L.eng_create.argtypes = [ctypes.c_int]
+    L.eng_create_lanes.restype = ctypes.c_void_p
+    L.eng_create_lanes.argtypes = [ctypes.c_int, ctypes.c_int]
     L.eng_destroy.argtypes = [ctypes.c_void_p]
     L.eng_new_var.restype = i64
     L.eng_new_var.argtypes = [ctypes.c_void_p]
@@ -99,12 +111,56 @@ def _load_engine():
                            ctypes.c_void_p, ctypes.POINTER(i64),
                            ctypes.c_int, ctypes.POINTER(i64), ctypes.c_int,
                            ctypes.c_int]
+    L.eng_push_lane.restype = i64
+    L.eng_push_lane.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_void_p, ctypes.POINTER(i64),
+                                ctypes.c_int, ctypes.POINTER(i64),
+                                ctypes.c_int, ctypes.c_int, ctypes.c_int]
     L.eng_wait_for_var.restype = i64
     L.eng_wait_for_var.argtypes = [ctypes.c_void_p, i64]
     L.eng_wait_all.argtypes = [ctypes.c_void_p]
     L.eng_var_version.restype = ctypes.c_uint64
     L.eng_var_version.argtypes = [ctypes.c_void_p, i64]
-    englib = L
+
+
+textlib = None  # libtextio: compiled CSV / LibSVM parsers
+
+
+def _load_textio():
+    global textlib
+    so = _ensure_built("libtextio.so", "textio.cc")
+    if so is None:
+        return
+    try:
+        L = ctypes.CDLL(so)
+    except OSError:
+        return
+    i64 = ctypes.c_int64
+    vp = ctypes.c_void_p
+    L.textio_last_error.restype = ctypes.c_char_p
+    L.csv_parse.restype = vp
+    L.csv_parse.argtypes = [ctypes.c_char_p]
+    for fn in (L.csv_rows, L.csv_cols):
+        fn.restype = i64
+        fn.argtypes = [vp]
+    L.csv_data.restype = ctypes.POINTER(ctypes.c_float)
+    L.csv_data.argtypes = [vp]
+    L.csv_free.argtypes = [vp]
+    L.svm_parse.restype = vp
+    L.svm_parse.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    for fn in (L.svm_rows, L.svm_nnz):
+        fn.restype = i64
+        fn.argtypes = [vp]
+    L.svm_data.restype = ctypes.POINTER(ctypes.c_float)
+    L.svm_data.argtypes = [vp]
+    L.svm_indices.restype = ctypes.POINTER(i64)
+    L.svm_indices.argtypes = [vp]
+    L.svm_indptr.restype = ctypes.POINTER(i64)
+    L.svm_indptr.argtypes = [vp]
+    L.svm_labels.restype = ctypes.POINTER(ctypes.c_float)
+    L.svm_labels.argtypes = [vp]
+    L.svm_free.argtypes = [vp]
+    textlib = L
 
 
 def build_c_api():
@@ -138,3 +194,4 @@ def build_c_api():
 
 _load()
 _load_engine()
+_load_textio()

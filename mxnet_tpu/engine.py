@@ -20,7 +20,7 @@ import os
 import threading
 
 __all__ = ["Engine", "NaiveEngine", "get", "var", "push", "wait_for_var",
-           "wait_all"]
+           "wait_all", "LANE_COMPUTE", "LANE_IO"]
 
 _CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
 
@@ -32,10 +32,20 @@ class _Var:
         self.id = vid
 
 
-class Engine:
-    """Threaded native engine (reference: ThreadedEnginePerDevice)."""
+#: named lanes over the per-lane worker pools (ThreadedEnginePerDevice
+#: analog — threaded_engine_perdevice.cc runs a pool per device plus
+#: dedicated copy workers; on TPU device compute is XLA-async, so the
+#: split that matters is compute vs host copy/IO)
+LANE_COMPUTE = 0
+LANE_IO = 1
 
-    def __init__(self, nthreads=None):
+
+class Engine:
+    """Threaded native engine (reference: ThreadedEnginePerDevice —
+    `nlanes` independent worker pools over one dependency state; push
+    with ``lane=LANE_IO`` to keep slow IO from starving compute ops)."""
+
+    def __init__(self, nthreads=None, nlanes=None):
         from . import _native
 
         if _native.englib is None:
@@ -43,7 +53,9 @@ class Engine:
         self._lib = _native.englib
         nthreads = nthreads or int(os.environ.get(
             "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4))
-        self._h = self._lib.eng_create(int(nthreads))
+        nlanes = nlanes or int(os.environ.get("MXNET_ENGINE_NUM_LANES", 2))
+        self._h = self._lib.eng_create_lanes(int(nthreads), int(nlanes))
+        self._nlanes = int(nlanes)
         self._lock = threading.Lock()
         self._exceptions = {}  # op_id -> exception
         self._live_cbs = {}  # op_id -> (callback, ctx) keepalive
@@ -51,7 +63,8 @@ class Engine:
     def new_variable(self):
         return _Var(self._lib.eng_new_var(self._h))
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             lane=LANE_COMPUTE):
         """Schedule fn() after its deps; returns the op id. An exception
         in fn poisons `mutable_vars` and surfaces at wait_for_var."""
         holder = {}
@@ -71,10 +84,10 @@ class Engine:
         mv = (ctypes.c_int64 * max(len(mutable_vars), 1))(
             *[v.id for v in mutable_vars])
         with self._lock:
-            op_id = self._lib.eng_push(
+            op_id = self._lib.eng_push_lane(
                 self._h, ctypes.cast(cb, ctypes.c_void_p), None, cv,
-                                       len(const_vars), mv,
-                                       len(mutable_vars), int(priority))
+                len(const_vars), mv, len(mutable_vars), int(priority),
+                int(lane))
             holder["op_id"] = op_id
             self._live_cbs[op_id] = cb
         return op_id
@@ -138,7 +151,8 @@ class NaiveEngine:
         self._versions[v.id] = 0
         return v
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             lane=0):
         op_id = self._next
         self._next += 1
         poisoned = [v for v in list(const_vars) + list(mutable_vars)
@@ -199,8 +213,8 @@ def var():
     return get().new_variable()
 
 
-def push(fn, const_vars=(), mutable_vars=(), priority=0):
-    return get().push(fn, const_vars, mutable_vars, priority)
+def push(fn, const_vars=(), mutable_vars=(), priority=0, lane=0):
+    return get().push(fn, const_vars, mutable_vars, priority, lane)
 
 
 def wait_for_var(v):

@@ -49,14 +49,18 @@ KNOBS = {
         "wired", "kvstore", "gradient compression threshold via env"),
     "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
         "wired", "optimizer.SGD", "multi-tensor fused update group size"),
+    "MXNET_ENGINE_NUM_LANES": (
+        "wired", "engine.Engine", "worker-pool lanes (compute/IO split)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),
     "MXNET_EXEC_BULK_EXEC_TRAIN": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),
     "MXNET_GPU_MEM_POOL_RESERVE": (
-        "accepted", "-", "HBM is managed by PJRT"),
-    "MXNET_GPU_MEM_POOL_TYPE": ("accepted", "-", "PJRT-owned"),
+        "wired", "storage", "host-pool cap: keep reserve% of RAM unpooled"
+        " (HBM itself is PJRT-owned)"),
+    "MXNET_GPU_MEM_POOL_TYPE": (
+        "wired", "storage", "host-pool strategy: Naive|Round|Unpooled"),
     "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
         "accepted", "-", "XLA autotuning replaces cuDNN autotune"),
     "MXNET_ENABLE_GPU_P2P": ("accepted", "-", "ICI always on"),

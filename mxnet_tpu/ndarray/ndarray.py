@@ -454,6 +454,14 @@ def _put(data, ctx):
 
 def array(source_array, ctx=None, dtype=None):
     """Create an NDArray from any array-like (reference: ndarray.py array)."""
+    if ctx is not None and getattr(ctx, "device_type", None) == "cpu_shared":
+        from .shared_mem import to_shared
+
+        src = source_array
+        if dtype is not None:
+            src = onp.asarray(src.asnumpy() if isinstance(src, NDArray)
+                              else src).astype(str(_canon_dtype(dtype)))
+        return to_shared(src)
     if isinstance(source_array, NDArray):
         source_array = source_array.data
     dtype = _canon_dtype(dtype)

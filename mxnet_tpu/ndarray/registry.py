@@ -160,8 +160,13 @@ def invoke(opdef, args, kwargs):
     wrap_cls = NDArray
     for a in arr_args:
         if type(a) is not NDArray:
-            wrap_cls = type(a)
-            break
+            # subclasses may opt out of propagating to op results
+            # (SharedNDArray: results are ordinary device arrays, only
+            # explicitly shared buffers live in shm)
+            cls = type(a)
+            if getattr(cls, "_propagate_to_results", True):
+                wrap_cls = cls
+                break
     wrap = (lambda r: wrap_cls(r)) if wrap_cls is not NDArray else None
 
     return apply_pure(pure_fn, arr_args,

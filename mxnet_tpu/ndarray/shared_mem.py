@@ -1,0 +1,111 @@
+"""Cross-process shared-memory NDArray (``ctx=mx.Context('cpu_shared')``).
+
+Reference: src/storage/cpu_shared_storage_manager.h + the NDArray
+``cpu_shared`` context — the reference backs NDArrays with POSIX shm so
+DataLoader worker processes hand batches to the trainer without copying
+through a pipe; pickling such an NDArray transfers the shm descriptor,
+not the bytes (python/mxnet/gluon/data/dataloader.py:28-90
+reduce_ndarray/rebuild_ndarray).
+
+Here a SharedNDArray keeps its payload as a numpy view onto a
+``multiprocessing.shared_memory`` segment. Every jnp op consuming it
+converts on use (host→device transfer is inherent anyway); in-place
+writes go INTO the segment so producer mutations are visible to
+attached consumers. Pickling sends ``(name, shape, dtype)``; the
+receiving process attaches to the same segment. The creating process
+owns the segment and unlinks it when its handle is garbage collected.
+"""
+from __future__ import annotations
+
+import weakref
+from multiprocessing import shared_memory
+
+import numpy as onp
+
+from .ndarray import NDArray, _canon_dtype
+
+__all__ = ["SharedNDArray", "shared_empty", "to_shared"]
+
+
+class SharedNDArray(NDArray):
+    """NDArray whose buffer lives in named shared memory."""
+
+    __slots__ = ("_shm", "_owner")
+    # op results on shm inputs are ordinary device arrays — only buffers
+    # the user explicitly allocated as shared stay in shm
+    _propagate_to_results = False
+
+    def __init__(self, shm, shape, dtype, owner):
+        view = onp.ndarray(shape, dtype=dtype, buffer=shm.buf)
+        super().__init__(view)
+        self._shm = shm
+        self._owner = owner
+        # close always; unlink only from the creating process
+        if owner:
+            weakref.finalize(self, _cleanup_owner, shm)
+        else:
+            weakref.finalize(self, _cleanup_attached, shm)
+
+    # -- shm identity ------------------------------------------------------
+    @property
+    def shm_name(self):
+        return self._shm.name
+
+    @property
+    def context(self):
+        from ..context import Context
+
+        return Context("cpu_shared", 0)
+
+    # -- in-place writes stay inside the segment ---------------------------
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        self._data[key] = value
+
+    # -- pickle = descriptor transfer (reference reduce_ndarray) -----------
+    def __reduce__(self):
+        return (_rebuild, (self._shm.name, self.shape, str(self.dtype)))
+
+
+def _cleanup_owner(shm):
+    try:  # BufferError: teardown order may release the view after us
+        shm.close()
+    except (OSError, BufferError):
+        pass
+    try:
+        shm.unlink()
+    except OSError:
+        pass
+
+
+def _cleanup_attached(shm):
+    try:
+        shm.close()
+    except (OSError, BufferError):
+        pass
+
+
+def _rebuild(name, shape, dtype):
+    shm = shared_memory.SharedMemory(name=name)
+    return SharedNDArray(shm, shape, _canon_dtype(dtype), owner=False)
+
+
+def shared_empty(shape, dtype="float32"):
+    """Allocate an uninitialized shm-backed NDArray (reference:
+    NDArray(shape, Context::CPUShared())."""
+    dtype = onp.dtype(_canon_dtype(dtype))
+    nbytes = max(1, int(onp.prod(shape)) * dtype.itemsize)
+    shm = shared_memory.SharedMemory(create=True, size=nbytes)
+    return SharedNDArray(shm, tuple(shape), dtype, owner=True)
+
+
+def to_shared(source):
+    """Copy an array (numpy / NDArray / nested list) into shared memory."""
+    if isinstance(source, SharedNDArray):
+        return source
+    arr = source.asnumpy() if isinstance(source, NDArray) \
+        else onp.asarray(source)
+    out = shared_empty(arr.shape, arr.dtype)
+    out._data[...] = arr
+    return out

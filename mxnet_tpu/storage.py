@@ -34,6 +34,10 @@ class Storage:
         if _native.englib is not None:
             L = _native.englib
             L.pool_create.restype = ctypes.c_void_p
+            has_create2 = hasattr(L, "pool_create2")
+            if has_create2:  # stale prebuilt .so may predate strategies
+                L.pool_create2.restype = ctypes.c_void_p
+                L.pool_create2.argtypes = [ctypes.c_int, ctypes.c_int64]
             L.pool_alloc.restype = ctypes.c_void_p
             L.pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
             L.pool_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
@@ -43,7 +47,23 @@ class Storage:
             L.pool_stats.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
             self._lib = L
-            self._h = L.pool_create()
+            # strategy + reserve knobs (reference MXNET_GPU_MEM_POOL_TYPE
+            # / _RESERVE steer the GPU pool; on TPU HBM belongs to PJRT,
+            # so they steer this host pool — Round = pow2 buckets,
+            # Naive = exact-size, Unpooled = plain malloc/free)
+            strategy = {"Naive": 0, "Round": 1, "Unpooled": 2}.get(
+                os.environ.get("MXNET_GPU_MEM_POOL_TYPE", "Naive"), 0)
+            reserve = int(os.environ.get("MXNET_GPU_MEM_POOL_RESERVE", "0"))
+            cap = -1
+            if reserve > 0:
+                try:  # keep at most (100-reserve)% of phys mem pooled
+                    page = os.sysconf("SC_PAGE_SIZE")
+                    phys = os.sysconf("SC_PHYS_PAGES") * page
+                    cap = phys * max(0, 100 - reserve) // 100
+                except (ValueError, OSError):
+                    cap = -1
+            self._h = (L.pool_create2(strategy, cap) if has_create2
+                       else L.pool_create())
         self._fallback = {}
 
     @property

@@ -50,6 +50,8 @@ struct Op {
   std::vector<int64_t> mutable_vars;
   std::atomic<int> missing{0};  // ungranted deps
   int priority = 0;
+  int lane = 0;  // worker-pool lane (ThreadedEnginePerDevice analog:
+                 // lane 0 = compute, lane 1 = copy/IO, ...)
   bool always_run = false;  // run even when inputs are poisoned (internal
                             // WaitForVar sync ops must fire their cv)
 };
@@ -63,17 +65,34 @@ struct OpCmp {
 
 class Engine {
  public:
-  explicit Engine(int nthreads) : shutdown_(false), inflight_(0) {
+  // nlanes worker pools share ONE dependency/var state: the reference's
+  // ThreadedEnginePerDevice runs a pool per device plus dedicated copy
+  // workers (threaded_engine_perdevice.cc) so slow IO ops can't starve
+  // compute ops; on TPU device compute is XLA-async so the lanes that
+  // matter are compute vs host-side copy/IO.
+  explicit Engine(int nthreads, int nlanes = 1)
+      : shutdown_(false), inflight_(0) {
     if (nthreads < 1) nthreads = 1;
-    for (int i = 0; i < nthreads; ++i)
-      workers_.emplace_back([this] { WorkerLoop(); });
+    if (nlanes < 1) nlanes = 1;
+    ready_.resize(nlanes);
+    lane_cv_ = std::vector<std::condition_variable>(nlanes);
+    // total thread count honors nthreads (MXNET_CPU_WORKER_NTHREADS):
+    // auxiliary lanes (copy/IO) get 1 worker each like the reference's
+    // small copy pools, the compute lane keeps the rest
+    int aux = nlanes - 1;
+    int lane0 = nthreads > aux ? nthreads - aux : 1;
+    for (int l = 0; l < nlanes; ++l) {
+      int n = (l == 0) ? lane0 : 1;
+      for (int i = 0; i < n; ++i)
+        workers_.emplace_back([this, l] { WorkerLoop(l); });
+    }
   }
 
   ~Engine() {
     {
       std::unique_lock<std::mutex> lk(mu_);
       shutdown_ = true;
-      cv_.notify_all();
+      for (auto& c : lane_cv_) c.notify_all();
     }
     for (auto& t : workers_) t.join();
     for (auto& kv : vars_) delete kv.second;
@@ -88,13 +107,15 @@ class Engine {
 
   int64_t Push(Callback fn, void* ctx, const int64_t* cvars, int ncon,
                const int64_t* mvars, int nmut, int priority,
-               bool always_run = false) {
+               bool always_run = false, int lane = 0) {
     Op* op = new Op();
     std::unique_lock<std::mutex> lk(mu_);
     op->id = next_op_++;
     op->fn = fn;
     op->ctx = ctx;
     op->priority = priority;
+    op->lane = (lane >= 0 && lane < static_cast<int>(ready_.size()))
+                   ? lane : 0;
     op->always_run = always_run;
     op->const_vars.assign(cvars, cvars + ncon);
     op->mutable_vars.assign(mvars, mvars + nmut);
@@ -174,19 +195,20 @@ class Engine {
   }
 
   void Ready(Op* op) {  // under mu_
-    ready_.push(op);
-    cv_.notify_one();
+    ready_[op->lane].push(op);
+    lane_cv_[op->lane].notify_one();
   }
 
-  void WorkerLoop() {
+  void WorkerLoop(int lane) {
     for (;;) {
       Op* op;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
-        if (shutdown_ && ready_.empty()) return;
-        op = ready_.top();
-        ready_.pop();
+        lane_cv_[lane].wait(
+            lk, [&] { return shutdown_ || !ready_[lane].empty(); });
+        if (shutdown_ && ready_[lane].empty()) return;
+        op = ready_[lane].top();
+        ready_[lane].pop();
         // poisoned inputs? skip execution, propagate to outputs
         bool poisoned = false;
         int64_t src = -1;
@@ -231,9 +253,9 @@ class Engine {
   }
 
   std::mutex mu_;
-  std::condition_variable cv_;
+  std::vector<std::condition_variable> lane_cv_;
   std::condition_variable all_done_;
-  std::priority_queue<Op*, std::vector<Op*>, OpCmp> ready_;
+  std::vector<std::priority_queue<Op*, std::vector<Op*>, OpCmp>> ready_;
   std::unordered_map<int64_t, Var*> vars_;
   std::vector<std::thread> workers_;
   int64_t next_var_ = 0;
@@ -246,7 +268,27 @@ class Engine {
 
 class PooledStorage {
  public:
+  // strategy + cap knobs (reference: pooled_storage_manager.h
+  // GPUPooledStorageManager [Round strategy, pow2 rounding with linear
+  // cutoff] / GPUPooledRoundedStorageManager, MXNET_GPU_MEM_POOL_TYPE /
+  // _RESERVE / _ROUND_LINEAR_CUTOFF — on TPU HBM belongs to PJRT, so
+  // the knobs steer THIS host pool)
+  enum Strategy { kNaive = 0, kRound = 1, kUnpooled = 2 };
+
+  explicit PooledStorage(int strategy = kNaive,
+                         int64_t max_pooled_bytes = -1)
+      : strategy_(strategy), max_pooled_bytes_(max_pooled_bytes) {}
+
   void* Alloc(size_t size) {
+    if (strategy_ == kUnpooled) {
+      void* p = malloc(size);
+      if (!p) return nullptr;
+      std::unique_lock<std::mutex> lk(mu_);
+      used_bytes_ += size;
+      total_allocs_++;
+      sizes_[p] = size;
+      return p;
+    }
     size = RoundUp(size);
     {
       std::unique_lock<std::mutex> lk(mu_);
@@ -276,6 +318,12 @@ class PooledStorage {
     size_t size = it->second;
     sizes_.erase(it);
     used_bytes_ -= size;
+    if (strategy_ == kUnpooled ||
+        (max_pooled_bytes_ >= 0 &&
+         pooled_bytes_ + size > static_cast<size_t>(max_pooled_bytes_))) {
+      free(p);  // over the reserve cap: give it back to the OS
+      return;
+    }
     pooled_bytes_ += size;
     pool_[size].push_back(p);
   }
@@ -306,13 +354,25 @@ class PooledStorage {
   }
 
  private:
-  static size_t RoundUp(size_t s) {  // page-round large, 64B-round small
+  size_t RoundUp(size_t s) const {
+    if (strategy_ == kRound) {
+      // pow2 rounding above a linear cutoff (GPUPooledRounded semantics)
+      const size_t kCutoff = 1u << 14;
+      if (s <= kCutoff) return (s + 63) / 64 * 64;
+      size_t r = kCutoff;
+      while (r < s) r <<= 1;
+      return r;
+    }
+    // kNaive: page-round large, 64B-round small (exact-size buckets)
     const size_t kPage = 4096;
     if (s >= kPage) return (s + kPage - 1) / kPage * kPage;
     size_t r = 64;
     while (r < s) r <<= 1;
     return r;
   }
+
+  const int strategy_;
+  const int64_t max_pooled_bytes_;
 
   std::mutex mu_;
   std::unordered_map<size_t, std::vector<void*>> pool_;
@@ -336,6 +396,20 @@ int64_t eng_push(void* h, Callback fn, void* ctx, const int64_t* cvars,
                                        priority);
 }
 
+// ThreadedEnginePerDevice analog: nlanes independent worker pools over
+// one dependency state; lane selects the pool (0 = compute, 1 = copy/IO)
+void* eng_create_lanes(int nthreads, int nlanes) {
+  return new Engine(nthreads, nlanes);
+}
+
+int64_t eng_push_lane(void* h, Callback fn, void* ctx,
+                      const int64_t* cvars, int ncon, const int64_t* mvars,
+                      int nmut, int priority, int lane) {
+  return static_cast<Engine*>(h)->Push(fn, ctx, cvars, ncon, mvars, nmut,
+                                       priority, /*always_run=*/false,
+                                       lane);
+}
+
 int64_t eng_wait_for_var(void* h, int64_t var) {
   return static_cast<Engine*>(h)->WaitForVar(var);
 }
@@ -347,6 +421,9 @@ uint64_t eng_var_version(void* h, int64_t var) {
 }
 
 void* pool_create() { return new PooledStorage(); }
+void* pool_create2(int strategy, int64_t max_pooled_bytes) {
+  return new PooledStorage(strategy, max_pooled_bytes);
+}
 void pool_destroy(void* h) {
   static_cast<PooledStorage*>(h)->ReleaseAll();
   delete static_cast<PooledStorage*>(h);
