@@ -51,14 +51,17 @@ from _cpu_platform import force_cpu_platform
 
 # ---------------------------------------------------------------- child ---
 
-def build_trainer(mesh, classes=1000, dtype=None):
+LAYOUT = os.environ.get("BENCH_LAYOUT", "NHWC")  # NHWC = TPU-preferred
+
+
+def build_trainer(mesh, classes=1000, dtype=None, layout=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu import parallel
 
     mx.random.seed(0)
-    net = vision.resnet50_v1(classes=classes)
+    net = vision.resnet50_v1(classes=classes, layout=layout or LAYOUT)
     net.initialize(mx.init.Xavier())
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     return parallel.SPMDTrainer(
@@ -76,7 +79,9 @@ def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
     mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = build_trainer(mesh, classes, dtype=dtype)
     rng = onp.random.RandomState(0)
-    x = nd.array(rng.rand(batch, 3, image_size, image_size).astype("f"))
+    shape = ((batch, image_size, image_size, 3) if LAYOUT == "NHWC"
+             else (batch, 3, image_size, image_size))
+    x = nd.array(rng.rand(*shape).astype("f"))
     y = nd.array(rng.randint(0, classes, batch).astype("f"))
     # Sync via device_get of the scalar loss, NOT wait_to_read: on the
     # tunneled axon platform block_until_ready returns before the device

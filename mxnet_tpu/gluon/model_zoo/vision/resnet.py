@@ -2,7 +2,9 @@
 
 The flagship model family for the TPU build: all convs lower to XLA
 conv_general_dilated tiled onto the MXU; BN folds into the surrounding
-fusion; blocks run in NCHW for API parity with the reference zoo.
+fusion. Blocks default to NCHW for API parity with the reference zoo;
+pass layout="NHWC" (a TPU-native extension) to keep channels in XLA's
+preferred minor dimension end-to-end (convs, BN axis, pooling).
 """
 from __future__ import annotations
 
@@ -17,29 +19,35 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv3x3(channels, stride, in_channels):
+def _conv3x3(channels, stride, in_channels, layout="NCHW"):
     return Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                  use_bias=False, in_channels=in_channels)
+                  use_bias=False, in_channels=in_channels, layout=layout)
+
+
+def _bn_axis(layout):
+    return -1 if layout == "NHWC" else 1
 
 
 class BasicBlockV1(HybridBlock):
     """Reference: resnet.py BasicBlockV1."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = _bn_axis(layout)
         self.body = HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(BatchNorm())
+        self.body.add(_conv3x3(channels, stride, in_channels, layout))
+        self.body.add(BatchNorm(axis=ax))
         self.body.add(Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(BatchNorm())
+        self.body.add(_conv3x3(channels, 1, channels, layout))
+        self.body.add(BatchNorm(axis=ax))
         if downsample:
             self.downsample = HybridSequential(prefix="")
             self.downsample.add(Conv2D(channels, kernel_size=1,
                                        strides=stride, use_bias=False,
-                                       in_channels=in_channels))
-            self.downsample.add(BatchNorm())
+                                       in_channels=in_channels,
+                                       layout=layout))
+            self.downsample.add(BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -55,23 +63,27 @@ class BottleneckV1(HybridBlock):
     """Reference: resnet.py BottleneckV1."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = _bn_axis(layout)
         self.body = HybridSequential(prefix="")
-        self.body.add(Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(BatchNorm())
+        self.body.add(Conv2D(channels // 4, kernel_size=1, strides=stride,
+                             layout=layout))
+        self.body.add(BatchNorm(axis=ax))
         self.body.add(Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(BatchNorm())
+        self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
+        self.body.add(BatchNorm(axis=ax))
         self.body.add(Activation("relu"))
-        self.body.add(Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(BatchNorm())
+        self.body.add(Conv2D(channels, kernel_size=1, strides=1,
+                             layout=layout))
+        self.body.add(BatchNorm(axis=ax))
         if downsample:
             self.downsample = HybridSequential(prefix="")
             self.downsample.add(Conv2D(channels, kernel_size=1,
                                        strides=stride, use_bias=False,
-                                       in_channels=in_channels))
-            self.downsample.add(BatchNorm())
+                                       in_channels=in_channels,
+                                       layout=layout))
+            self.downsample.add(BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -87,15 +99,17 @@ class BasicBlockV2(HybridBlock):
     """Reference: resnet.py BasicBlockV2 (pre-activation)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
+        ax = _bn_axis(layout)
+        self.bn1 = BatchNorm(axis=ax)
+        self.conv1 = _conv3x3(channels, stride, in_channels, layout)
+        self.bn2 = BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels, 1, channels, layout)
         if downsample:
             self.downsample = Conv2D(channels, 1, stride, use_bias=False,
-                                     in_channels=in_channels)
+                                     in_channels=in_channels,
+                                     layout=layout)
         else:
             self.downsample = None
 
@@ -116,19 +130,21 @@ class BottleneckV2(HybridBlock):
     """Reference: resnet.py BottleneckV2."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = BatchNorm()
+        ax = _bn_axis(layout)
+        self.bn1 = BatchNorm(axis=ax)
         self.conv1 = Conv2D(channels // 4, kernel_size=1, strides=1,
-                            use_bias=False)
-        self.bn2 = BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = BatchNorm()
+                            use_bias=False, layout=layout)
+        self.bn2 = BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4, layout)
+        self.bn3 = BatchNorm(axis=ax)
         self.conv3 = Conv2D(channels, kernel_size=1, strides=1,
-                            use_bias=False)
+                            use_bias=False, layout=layout)
         if downsample:
             self.downsample = Conv2D(channels, 1, stride, use_bias=False,
-                                     in_channels=in_channels)
+                                     in_channels=in_channels,
+                                     layout=layout)
         else:
             self.downsample = None
 
@@ -152,36 +168,40 @@ class ResNetV1(HybridBlock):
     """Reference: resnet.py ResNetV1."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        assert layout in ("NCHW", "NHWC"), layout
+        self._layout = layout
+        ax = _bn_axis(layout)
         with self.name_scope():
             self.features = HybridSequential(prefix="")
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
                 self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False))
-                self.features.add(BatchNorm())
+                                         use_bias=False, layout=layout))
+                self.features.add(BatchNorm(axis=ax))
                 self.features.add(Activation("relu"))
-                self.features.add(MaxPool2D(3, 2, 1))
+                self.features.add(MaxPool2D(3, 2, 1, layout=layout))
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(GlobalAvgPool2D())
+                    in_channels=channels[i], layout=layout))
+            self.features.add(GlobalAvgPool2D(layout=layout))
             self.output = Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
+                    in_channels=0, layout="NCHW"):
         layer = HybridSequential(prefix=f"stage{stage_index}_")
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
+                            in_channels=in_channels, layout=layout,
+                            prefix=""))
             for _ in range(layers - 1):
                 layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
+                                layout=layout, prefix=""))
         return layer
 
     def hybrid_forward(self, F, x):
@@ -194,30 +214,33 @@ class ResNetV2(HybridBlock):
     """Reference: resnet.py ResNetV2."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        assert layout in ("NCHW", "NHWC"), layout
+        self._layout = layout
+        ax = _bn_axis(layout)
         with self.name_scope():
             self.features = HybridSequential(prefix="")
-            self.features.add(BatchNorm(scale=False, center=False))
+            self.features.add(BatchNorm(axis=ax, scale=False, center=False))
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
                 self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False))
-                self.features.add(BatchNorm())
+                                         use_bias=False, layout=layout))
+                self.features.add(BatchNorm(axis=ax))
                 self.features.add(Activation("relu"))
-                self.features.add(MaxPool2D(3, 2, 1))
+                self.features.add(MaxPool2D(3, 2, 1, layout=layout))
             in_channels = channels[0]
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
+                    in_channels=in_channels, layout=layout))
                 in_channels = channels[i + 1]
-            self.features.add(BatchNorm())
+            self.features.add(BatchNorm(axis=ax))
             self.features.add(Activation("relu"))
-            self.features.add(GlobalAvgPool2D())
+            self.features.add(GlobalAvgPool2D(layout=layout))
             self.features.add(Flatten())
             self.output = Dense(classes, in_units=in_channels)
 

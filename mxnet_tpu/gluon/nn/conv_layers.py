@@ -38,11 +38,16 @@ class _Conv(HybridBlock):
         self._dilate = _tuplize(dilation, nd_)
         self._groups = groups
         self._layout = layout
+        # channel-last layouts store the filter as (O, *k, I) — the
+        # cuDNN-NHWC convention the reference uses on GPU (here: the
+        # layout XLA:TPU prefers; see ops_nn._conv_dims)
+        self._channel_last = layout in ("NWC", "NHWC", "NDHWC")
+        ic = in_channels // groups if in_channels else 0
+        wshape = ((channels,) + kernel_size + (ic,)) if self._channel_last \
+            else ((channels, ic) + kernel_size)
         with self.name_scope():
             self.weight = self.params.get(
-                "weight",
-                shape=(channels, in_channels // groups if in_channels else 0)
-                + kernel_size,
+                "weight", shape=wshape,
                 init=weight_initializer, allow_deferred_init=True)
             if use_bias:
                 self.bias = self.params.get("bias", shape=(channels,),
@@ -52,15 +57,21 @@ class _Conv(HybridBlock):
             self.act = Activation(activation) if activation else None
 
     def infer_param_shapes(self, x, *args):
-        in_c = x.shape[1]
-        self.weight.shape = (self._channels, in_c // self._groups) + \
-            self._kernel
+        if self._channel_last:
+            in_c = x.shape[-1]
+            self.weight.shape = (self._channels,) + self._kernel + \
+                (in_c // self._groups,)
+        else:
+            in_c = x.shape[1]
+            self.weight.shape = (self._channels, in_c // self._groups) + \
+                self._kernel
 
     def hybrid_forward(self, F, x, weight, bias=None):
         out = F.convolution(x, weight, bias, kernel=self._kernel,
                             stride=self._stride, dilate=self._dilate,
                             pad=self._pad, num_filter=self._channels,
-                            num_group=self._groups, no_bias=bias is None)
+                            num_group=self._groups, no_bias=bias is None,
+                            layout=self._layout)
         if self.act is not None:
             out = self.act(out)
         return out
@@ -112,6 +123,10 @@ class _ConvTranspose(_Conv):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, layout, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, **kwargs)
+        if self._channel_last:
+            raise ValueError(
+                "transposed convolution supports channel-first layouts "
+                "only (NCW/NCHW/NCDHW)")
         self._adj = _tuplize(output_padding, len(kernel_size))
         # deconv weight layout is (in, out/groups, *k), not (out, in/g, *k)
         self.weight._shape = (in_channels if in_channels else 0,
@@ -172,7 +187,8 @@ class Conv3DTranspose(_ConvTranspose):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, prefix=None, params=None):
+                 pool_type, count_include_pad=None, layout=None,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._kernel = pool_size
         self._stride = _tuplize(strides if strides is not None else pool_size,
@@ -182,11 +198,14 @@ class _Pooling(HybridBlock):
         self._global = global_pool
         self._type = pool_type
         self._count_include_pad = count_include_pad
+        self._layout = layout
 
     def hybrid_forward(self, F, x):
         kw = {}
         if self._count_include_pad is not None:
             kw["count_include_pad"] = self._count_include_pad
+        if self._layout is not None:
+            kw["layout"] = self._layout
         return F.pooling(x, kernel=self._kernel, pool_type=self._type,
                          global_pool=self._global, stride=self._stride,
                          pad=self._pad,
@@ -202,28 +221,29 @@ class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tuplize(pool_size, 1), strides, padding, ceil_mode,
-                         False, "max", **kwargs)
+                         False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_tuplize(pool_size, 2), strides, padding, ceil_mode,
-                         False, "max", **kwargs)
+                         False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_tuplize(pool_size, 3), strides, padding, ceil_mode,
-                         False, "max", **kwargs)
+                         False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuplize(pool_size, 1), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kwargs)
+                         False, "avg", count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -231,7 +251,8 @@ class AvgPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tuplize(pool_size, 2), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kwargs)
+                         False, "avg", count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -239,12 +260,14 @@ class AvgPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tuplize(pool_size, 3), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kwargs)
+                         False, "avg", count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class _GlobalPooling(_Pooling):
-    def __init__(self, pool_type, **kwargs):
-        super().__init__((1,), None, 0, False, True, pool_type, **kwargs)
+    def __init__(self, pool_type, layout=None, **kwargs):
+        super().__init__((1,), None, 0, False, True, pool_type,
+                         layout=layout, **kwargs)
 
     def __repr__(self):
         return type(self).__name__
@@ -252,32 +275,32 @@ class _GlobalPooling(_Pooling):
 
 class GlobalMaxPool1D(_GlobalPooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__("max", **kwargs)
+        super().__init__("max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_GlobalPooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__("max", **kwargs)
+        super().__init__("max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_GlobalPooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__("max", **kwargs)
+        super().__init__("max", layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_GlobalPooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__("avg", **kwargs)
+        super().__init__("avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_GlobalPooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__("avg", **kwargs)
+        super().__init__("avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_GlobalPooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__("avg", **kwargs)
+        super().__init__("avg", layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -457,11 +457,19 @@ def array(source_array, ctx=None, dtype=None):
     if ctx is not None and getattr(ctx, "device_type", None) == "cpu_shared":
         from .shared_mem import to_shared
 
-        src = source_array
-        if dtype is not None:
-            src = onp.asarray(src.asnumpy() if isinstance(src, NDArray)
-                              else src).astype(str(_canon_dtype(dtype)))
-        return to_shared(src)
+        src = onp.asarray(source_array.asnumpy()
+                          if isinstance(source_array, NDArray)
+                          else source_array)
+        d = _canon_dtype(dtype)
+        if d is None:  # same default-dtype rules as the device path below
+            if isinstance(source_array, (onp.ndarray, jax.Array, NDArray)):
+                d = src.dtype
+                if d == onp.float64:
+                    d = onp.float32
+            else:
+                d = onp.float32
+        d = onp.dtype(d)
+        return to_shared(src if src.dtype == d else src.astype(d))
     if isinstance(source_array, NDArray):
         source_array = source_array.data
     dtype = _canon_dtype(dtype)

@@ -47,6 +47,17 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
 
 _CONV_DIMS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
               3: ("NCDHW", "OIDHW", "NCDHW")}
+# channel-last layouts (reference: NHWC/NDHWC 'only supported on GPU' —
+# here they exist because NHWC is the layout XLA:TPU's conv emitters
+# prefer; weight rides as (O, *spatial, I) like cuDNN's NHWC filters)
+_CHANNEL_LAST = {"NWC": 1, "NHWC": 2, "NDHWC": 3}
+
+
+def _conv_dims(nd, layout):
+    if layout in _CHANNEL_LAST:
+        rhs = "O" + layout[1:-1] + "I"
+        return (layout, rhs, layout)
+    return _CONV_DIMS[nd]
 
 
 @register()
@@ -54,13 +65,15 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=0, num_group=1, no_bias=False,
                 layout=None):
     """Reference: src/operator/nn/convolution-inl.h (cuDNN path
-    nn/cudnn/cudnn_convolution-inl.h). XLA conv_general_dilated; NCHW layout
-    kept for API parity — Mosaic re-layouts internally for the MXU."""
+    nn/cudnn/cudnn_convolution-inl.h). XLA conv_general_dilated. Default
+    NCHW for API parity; layout='NHWC' (weight (O, kh, kw, I)) keeps the
+    channel dimension in XLA's preferred minor position on TPU."""
     nd = len(kernel) if kernel is not None else data.ndim - 2
     stride = _tup(stride or 1, nd)
     dilate = _tup(dilate or 1, nd)
     pad = _tup(pad or 0, nd)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dims(nd, layout))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
@@ -68,7 +81,9 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None)
     out = out.astype(data.dtype)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if layout in _CHANNEL_LAST \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -76,7 +91,12 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
 def deconvolution(data, weight, bias=None, kernel=None, stride=None,
                   dilate=None, pad=None, adj=None, num_filter=0, num_group=1,
                   no_bias=True, target_shape=None, layout=None):
-    """Transposed convolution (reference: src/operator/nn/deconvolution-inl.h)."""
+    """Transposed convolution (reference: src/operator/nn/deconvolution-inl.h).
+    Channel-first layouts only."""
+    if layout in _CHANNEL_LAST:
+        raise ValueError(
+            "deconvolution supports channel-first layouts only "
+            "(NCW/NCHW/NCDHW)")
     nd = len(kernel)
     stride = _tup(stride or 1, nd)
     pad = _tup(pad or 0, nd)
@@ -117,27 +137,38 @@ def _deconv1(data, weight, stride, pad, adj, dilate, nd):
 def pooling(data, kernel=None, pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
             count_include_pad=True, layout=None):
-    """Reference: src/operator/nn/pooling-inl.h → XLA reduce_window."""
+    """Reference: src/operator/nn/pooling-inl.h → XLA reduce_window.
+    layout NWC/NHWC/NDHWC pools over the middle (spatial) axes."""
     nd = data.ndim - 2
+    channel_last = layout in _CHANNEL_LAST
     if global_pool:
-        ax = tuple(range(2, data.ndim))
+        ax = tuple(range(1, data.ndim - 1)) if channel_last \
+            else tuple(range(2, data.ndim))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
         return jnp.mean(data, axis=ax, keepdims=True)
     kernel = _tup(kernel, nd)
     stride = _tup(stride or 1, nd)
     pad = _tup(pad or 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    sp = [data.shape[1 + i] if channel_last else data.shape[2 + i]
+          for i in range(nd)]
+    spads = tuple((p, p) for p in pad)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil-mode: add extra high padding so last window fits
         extra = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = sp[i] + 2 * pad[i] - kernel[i]
             rem = size % stride[i]
             extra.append(stride[i] - rem if rem else 0)
-        pads = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra))
+        spads = tuple((p, p + e) for p, e in zip(pad, extra))
+    pads = ((0, 0),) + spads + ((0, 0),) if channel_last \
+        else ((0, 0), (0, 0)) + spads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, pads)

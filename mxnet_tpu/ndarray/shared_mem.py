@@ -57,6 +57,10 @@ class SharedNDArray(NDArray):
 
         return Context("cpu_shared", 0)
 
+    # NDArray binds `ctx = context` at class-definition time (the base
+    # property object) — rebind so arr.ctx agrees with arr.context
+    ctx = context
+
     # -- in-place writes stay inside the segment ---------------------------
     def __setitem__(self, key, value):
         if isinstance(value, NDArray):

@@ -1,0 +1,138 @@
+"""NHWC layout support: ops, gluon layers, and model-zoo equivalence.
+
+Reference: layout="NHWC" convs/pooling on the reference's GPU path
+(convolution-inl.h layout param, cudnn NHWC filters); here NHWC exists
+because it keeps channels in XLA:TPU's preferred minor dimension.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+
+RTOL, ATOL = 2e-4, 2e-4
+
+
+def test_conv_op_nhwc_matches_nchw():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(2, 5, 8, 8).astype("f")
+    w = (rng.rand(7, 5, 3, 3).astype("f") - 0.5) * 0.2
+    b = rng.rand(7).astype("f")
+    ref = nd.convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         num_filter=7).asnumpy()
+    got = nd.convolution(
+        nd.array(x.transpose(0, 2, 3, 1)),
+        nd.array(w.transpose(0, 2, 3, 1)), nd.array(b),
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=7,
+        layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                rtol=RTOL, atol=ATOL)
+
+
+def test_grouped_conv_nhwc():
+    rng = onp.random.RandomState(1)
+    x = rng.rand(2, 6, 4, 4).astype("f")
+    w = rng.rand(6, 3, 3, 3).astype("f") * 0.2
+    ref = nd.convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=6, num_group=2,
+                         no_bias=True).asnumpy()
+    got = nd.convolution(
+        nd.array(x.transpose(0, 2, 3, 1)),
+        nd.array(w.transpose(0, 2, 3, 1)), kernel=(3, 3), pad=(1, 1),
+        num_filter=6, num_group=2, no_bias=True,
+        layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_nhwc_matches_nchw(pool_type):
+    rng = onp.random.RandomState(2)
+    x = rng.rand(2, 3, 9, 9).astype("f")
+    ref = nd.pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1), pool_type=pool_type,
+                     pooling_convention="full").asnumpy()
+    got = nd.pooling(nd.array(x.transpose(0, 2, 3, 1)), kernel=(3, 3),
+                     stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+                     pooling_convention="full",
+                     layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                rtol=RTOL, atol=ATOL)
+
+
+def test_global_pool_nhwc():
+    rng = onp.random.RandomState(3)
+    x = rng.rand(2, 4, 5, 5).astype("f")
+    ref = nd.pooling(nd.array(x), global_pool=True,
+                     pool_type="avg").asnumpy()
+    got = nd.pooling(nd.array(x.transpose(0, 2, 3, 1)),
+                     global_pool=True, pool_type="avg",
+                     layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_layer_nhwc_shapes_and_grad():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, layout="NHWC",
+                    activation="relu")
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(4).rand(2, 6, 6, 5).astype("f"))
+    with autograd.record():
+        out = net(x)
+    out.mean().backward()
+    assert out.shape == (2, 6, 6, 8)
+    assert net.weight.shape == (8, 3, 3, 5)  # (O, kh, kw, I)
+    assert net.weight.grad().shape == (8, 3, 3, 5)
+
+
+def _transplant(src, dst):
+    """Copy NCHW-net params into the NHWC net (conv weights transposed
+    (O,I,kh,kw) -> (O,kh,kw,I); everything else verbatim)."""
+    # identical architecture ⇒ identical parameter creation order; names
+    # carry per-class instance counters that differ between the two nets
+    sp = list(src.collect_params().values())
+    dp = list(dst.collect_params().values())
+    assert len(sp) == len(dp)
+    for p, tgt in zip(sp, dp):
+        v = p._ndarray.asnumpy()
+        if v.ndim == 4 and tuple(tgt.shape) != v.shape:
+            v = v.transpose(0, 2, 3, 1)
+        assert tuple(tgt.shape) == v.shape, (p.name, tgt.shape, v.shape)
+        tgt._ndarray[:] = nd.array(v)
+
+
+def test_resnet18_nhwc_equivalent_logits():
+    mx.random.seed(0)
+    a = vision.resnet18_v1(classes=10)
+    a.initialize(mx.init.Xavier())
+    b = vision.resnet18_v1(classes=10, layout="NHWC")
+    b.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+    x = rng.rand(2, 3, 32, 32).astype("f")
+    ref = a(nd.array(x)).asnumpy()  # also finishes a's deferred init
+    _ = b(nd.array(x.transpose(0, 2, 3, 1)))  # finish deferred init
+    _transplant(a, b)
+    got = b(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet_nhwc_trains_under_spmd():
+    from mxnet_tpu import parallel, gluon
+    import jax
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10, layout="NHWC", thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"dp": min(2, len(jax.devices()))})
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+        compute_dtype="bfloat16")
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(8, 16, 16, 3).astype("f"))
+    y = nd.array(rng.randint(0, 10, 8).astype("f"))
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(4)]
+    assert onp.isfinite(losses).all()
