@@ -70,6 +70,59 @@ KNOBS = {
         "accepted", "engine", "priority lanes share the one pool"),
     "MXNET_EXEC_NUM_TEMP": ("accepted", "-", "XLA memory planning"),
     "MXNET_GPU_WORKER_NTHREADS": ("accepted", "-", "PJRT streams"),
+    "MXNET_GPU_COPY_NTHREADS": (
+        "accepted", "engine", "engine IO lane covers host copies"),
+    "MXNET_OMP_MAX_THREADS": ("accepted", "-", "XLA:CPU owns threading"),
+    "MXNET_MKLDNN_ENABLED": ("accepted", "-", "no MKLDNN; XLA kernels"),
+    "MXNET_MKLDNN_CACHE_NUM": ("accepted", "-", "no MKLDNN on TPU"),
+    "MXNET_CUDNN_AUTOTUNE_LIMIT": ("accepted", "-", "XLA autotuning"),
+    "MXNET_CUDA_ALLOW_TENSOR_CORE": (
+        "accepted", "-", "MXU always on; bf16 via AMP/compute_dtype"),
+    "MXNET_CUDA_TENSOR_OP_MATH_ALLOW_CONVERSION": (
+        "accepted", "-", "bf16 casting is explicit (AMP op lists)"),
+    "MXNET_CUDA_LIB_CHECKING": ("accepted", "-", "no CUDA libs"),
+    "MXNET_CUDNN_LIB_CHECKING": ("accepted", "-", "no cuDNN"),
+    "MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF": (
+        "accepted", "storage", "Round strategy uses a fixed 16KiB cutoff"),
+    "MXNET_GPU_MEM_LARGE_ALLOC_ROUND_SIZE": (
+        "accepted", "-", "PJRT-owned HBM rounding"),
+    "MXNET_ENGINE_OPENMP": ("accepted", "-", "no OpenMP in op bodies"),
+    "MXNET_EXEC_ENABLE_INPLACE": (
+        "accepted", "-", "XLA buffer aliasing (donated args)"),
+    "MXNET_EXEC_MATCH_RANGE": ("accepted", "-", "XLA memory planner"),
+    "MXNET_BACKWARD_DO_MIRROR": (
+        "accepted", "-", "use jax.checkpoint/remat for memory-vs-compute"),
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": ("accepted", "-", "XLA fusion"),
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": (
+        "accepted", "-", "reduction is one compiled XLA all-reduce"),
+    "MXNET_KVSTORE_SLICE_THRESHOLD": (
+        "accepted", "kvstore", "BIGARRAY_BOUND covers sharding"),
+    "MXNET_ENABLE_GPU_P2P_CHECK": ("accepted", "-", "ICI topology fixed"),
+    "MXNET_CPU_NNPACK_NTHREADS": ("accepted", "-", "no NNPACK"),
+    "MXNET_CPU_TEMP_COPY": ("accepted", "-", "XLA-owned"),
+    "MXNET_GPU_PARALLEL_RAND_COPY": (
+        "accepted", "random", "PRNG is counter-based (jax.random)"),
+    "MXNET_RANDOM_RESOURCE_POOL_SIZE": (
+        "accepted", "random", "stateless threefry needs no pool"),
+    "MXNET_SUBGRAPH_BACKEND": (
+        "accepted", "-", "whole-program XLA replaces subgraph backends"),
+    "MXNET_SUBGRAPH_VERBOSE": ("accepted", "-", "see profiler traces"),
+    "MXNET_USE_FUSION": ("accepted", "-", "XLA fuses unconditionally"),
+    "MXNET_FUSION_VERBOSE": ("accepted", "-", "XLA dump flags instead"),
+    "MXNET_MODULE_UPDATE_ON_KVSTORE": (
+        "accepted", "module", "Module always updates via kvstore updater"),
+    "MXNET_UPDATE_ON_KVSTORE": (
+        "accepted", "gluon.Trainer", "Trainer decides from kvstore type"),
+    "MXNET_IS_WORKER": ("accepted", "tools.launch", "all processes rank"),
+    "MXNET_IS_SERVER": (
+        "accepted", "tools.launch", "no parameter servers on TPU"),
+    "MXNET_IS_SCHEDULER": (
+        "accepted", "tools.launch", "jax.distributed coordinator instead"),
+    "MXNET_PROFILER_MODE": ("accepted", "profiler", "always all-events"),
+    "MXNET_EXEC_VERBOSE_LOGGING": ("accepted", "-", "XLA dump flags"),
+    "MXNET_SAFE_ACCUMULATION": (
+        "accepted", "-", "fp32 accumulation is always on (MXU native)"),
+    "MXNET_MEMORY_OPT": ("accepted", "-", "XLA memory planning"),
 }
 
 

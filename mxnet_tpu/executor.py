@@ -26,12 +26,16 @@ _LOSS_HEADS = ("softmax_output", "make_loss", "linear_regression_output",
 
 class Executor:
     def __init__(self, symbol, arg_names, arg_arrays, grad_arrays, grad_req,
-                 ctx=None):
+                 ctx=None, aux_names=(), aux_arrays=()):
         self._symbol = symbol
         self.arg_names = list(arg_names)
         self.arg_arrays = list(arg_arrays)
         self.grad_arrays = grad_arrays
         self.grad_req = grad_req
+        # auxiliary states (BN running stats): fed to the graph, never
+        # differentiated (reference: executor.h aux_states)
+        self.aux_names = list(aux_names)
+        self.aux_arrays = list(aux_arrays)
         self.outputs = []
         self._ctx = ctx
         self._fwd_jit = None
@@ -51,7 +55,7 @@ class Executor:
 
     @property
     def aux_dict(self):
-        return {}
+        return dict(zip(self.aux_names, self.aux_arrays))
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
@@ -62,12 +66,19 @@ class Executor:
             elif not allow_extra_params:
                 raise ValueError(f"Found name '{name}' that is not in the "
                                  "arguments")
+        for name, array in (aux_params or {}).items():
+            if name in self.aux_dict:
+                array.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise ValueError(f"Found name '{name}' that is not in the "
+                                 "aux states")
 
     # ---- compiled paths --------------------------------------------------
     def _ensure_fwd(self):
         if self._fwd_jit is not None:
             return
-        symbol, names = self._symbol, self.arg_names
+        symbol = self._symbol
+        names = self.arg_names + self.aux_names
 
         def fwd(vals, train):
             from . import autograd
@@ -142,7 +153,7 @@ class Executor:
                     f"are {self.arg_names}")
             self.arg_dict[k]._data = v.data if isinstance(v, NDArray) \
                 else jnp.asarray(v)
-        vals = [a.data for a in self.arg_arrays]
+        vals = [a.data for a in self.arg_arrays + self.aux_arrays]
         outs = self._fwd_jit(vals, bool(is_train))
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
@@ -155,7 +166,7 @@ class Executor:
         if self.grad_arrays is None or self.grad_req == "null":
             return
         self._ensure_fwd()
-        vals = [a.data for a in self.arg_arrays]
+        vals = [a.data for a in self.arg_arrays + self.aux_arrays]
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]

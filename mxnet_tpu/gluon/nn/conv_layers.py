@@ -41,7 +41,9 @@ class _Conv(HybridBlock):
         # channel-last layouts store the filter as (O, *k, I) — the
         # cuDNN-NHWC convention the reference uses on GPU (here: the
         # layout XLA:TPU prefers; see ops_nn._conv_dims)
-        self._channel_last = layout in ("NWC", "NHWC", "NDHWC")
+        from ...ndarray.ops_nn import _CHANNEL_LAST
+
+        self._channel_last = layout in _CHANNEL_LAST
         ic = in_channels // groups if in_channels else 0
         wshape = ((channels,) + kernel_size + (ic,)) if self._channel_last \
             else ((channels, ic) + kernel_size)

@@ -117,6 +117,13 @@ class Module(BaseModule):
                         name not in arg_params:
                     raise RuntimeError(f"{name} is not presented")
                 initializer(init_mod.InitDesc(name), arr)
+        if aux_params is None and self._aux_params:
+            aux_params = self._aux_params
+        for name, arr in self._exec.aux_dict.items():
+            # aux states keep their bind-time defaults (mean 0 / var 1)
+            # unless a checkpoint provides them
+            if aux_params and name in aux_params:
+                aux_params[name].copyto(arr)
         self.params_initialized = True
 
     def get_params(self):
@@ -124,12 +131,16 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         arg_params = {n: self._exec.arg_dict[n].copy()
                       for n in self._param_names()}
-        return arg_params, dict(self._aux_params)
+        aux_params = {n: a.copy() for n, a in self._exec.aux_dict.items()}
+        aux_params.update({k: v for k, v in self._aux_params.items()
+                           if k not in aux_params})
+        return arg_params, aux_params
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not self.binded:
             self._arg_params = arg_params
+            self._aux_params = dict(aux_params or {})
             return
         self.init_params(arg_params=arg_params, aux_params=aux_params,
                          allow_missing=allow_missing, force_init=force_init)
@@ -221,5 +232,6 @@ class Module(BaseModule):
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         mod = Module(symbol, **kwargs)
         mod._arg_params = arg_params
+        mod._aux_params = dict(aux_params or {})
         mod._preloaded = (arg_params, aux_params)
         return mod

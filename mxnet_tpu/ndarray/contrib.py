@@ -232,3 +232,12 @@ def _install():
 
 
 _install()
+
+# DGL graph-sampling ops (host-side CSR work; reference:
+# src/operator/contrib/dgl_graph.cc). Exposed with the reference's
+# public names: mx.nd.contrib.dgl_subgraph, dgl_csr_neighbor_*_sample...
+from .ops_dgl import (  # noqa: E402,F401
+    edge_id, dgl_adjacency, dgl_subgraph, dgl_graph_compact,
+    csr_neighbor_uniform_sample as dgl_csr_neighbor_uniform_sample,
+    csr_neighbor_non_uniform_sample as
+    dgl_csr_neighbor_non_uniform_sample)

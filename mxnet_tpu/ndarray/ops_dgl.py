@@ -1,0 +1,206 @@
+"""DGL graph-sampling operators over CSR adjacency matrices.
+
+Reference: src/operator/contrib/dgl_graph.cc — the reference registers
+these as CPU-only ops feeding the Deep Graph Library integration:
+neighbor sampling (uniform/non-uniform), vertex-induced subgraphs,
+adjacency extraction, graph compaction, and edge-id lookup. Graph
+sampling is pointer-chasing over irregular CSR structure — host work in
+the reference and host work here (numpy over the CSR arrays); only the
+resulting batch tensors move to device.
+
+Conventions kept from the reference:
+- sampled-vertex outputs are padded to ``max_num_vertices`` with -1 and
+  carry the vertex count in the LAST slot (dgl_graph.cc output layout);
+- subgraph CSR ``data`` holds parent edge ids + 1 so callers can map
+  edges back (0 is reserved for "no edge").
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["edge_id", "dgl_adjacency", "dgl_subgraph",
+           "csr_neighbor_uniform_sample", "csr_neighbor_non_uniform_sample",
+           "dgl_graph_compact"]
+
+
+def _csr_parts(graph):
+    from . import sparse as _sp
+
+    if not isinstance(graph, _sp.CSRNDArray):
+        raise MXNetError("DGL ops expect a CSRNDArray adjacency graph")
+    indptr = onp.asarray(graph.indptr.asnumpy(), onp.int64)
+    indices = onp.asarray(graph.indices.asnumpy(), onp.int64)
+    data = onp.asarray(graph.data.asnumpy())
+    return indptr, indices, data, graph.shape
+
+
+def _make_csr(data, indices, indptr, shape):
+    from . import sparse as _sp
+
+    return _sp.CSRNDArray(onp.asarray(data, onp.float32),
+                          onp.asarray(indices, onp.int64),
+                          onp.asarray(indptr, onp.int64), shape)
+
+
+def edge_id(graph, u, v):
+    """Edge ids (csr values) for vertex pairs; -1 where no edge exists
+    (reference: dgl_graph.cc EdgeID / _contrib_edge_id)."""
+    from . import ndarray as _nd
+
+    indptr, indices, data, _ = _csr_parts(graph)
+    uu = onp.asarray(u.asnumpy() if hasattr(u, "asnumpy") else u,
+                     onp.int64).ravel()
+    vv = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                     onp.int64).ravel()
+    out = onp.full(uu.shape, -1.0, onp.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = onp.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = data[indptr[a] + hit[0]]
+    return _nd.array(out)
+
+
+def dgl_adjacency(graph):
+    """Adjacency with all edge values 1.0, same sparsity (reference:
+    dgl_graph.cc DGLAdjacency — converts edge-id csr to 0/1 weights)."""
+    indptr, indices, data, shape = _csr_parts(graph)
+    return _make_csr(onp.ones_like(data, onp.float32), indices, indptr,
+                     shape)
+
+
+def _induced(indptr, indices, data, vids):
+    """Vertex-induced subgraph; returns (data, indices, indptr) with
+    parent edge ids + 1 as values."""
+    vids = onp.asarray(vids, onp.int64)
+    vids = vids[vids >= 0]
+    old2new = {int(v): i for i, v in enumerate(vids)}
+    sub_indptr = [0]
+    sub_indices = []
+    sub_data = []
+    for v in vids:
+        for e in range(int(indptr[v]), int(indptr[v + 1])):
+            col = int(indices[e])
+            if col in old2new:
+                sub_indices.append(old2new[col])
+                sub_data.append(e + 1)  # parent edge id + 1
+        sub_indptr.append(len(sub_indices))
+    n = len(vids)
+    return (onp.asarray(sub_data, onp.float32),
+            onp.asarray(sub_indices, onp.int64),
+            onp.asarray(sub_indptr, onp.int64), (n, n))
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Vertex-induced subgraphs (reference: dgl_graph.cc DGLSubgraph).
+    Returns one CSR per vid array; with return_mapping=True also one CSR
+    per vid array whose values are parent edge ids + 1."""
+    indptr, indices, data, _ = _csr_parts(graph)
+    subs, maps = [], []
+    for v in vids:
+        vv = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                         onp.int64).ravel()
+        d, i, p, shape = _induced(indptr, indices, data, vv)
+        subs.append(_make_csr(onp.ones_like(d), i, p, shape))
+        if return_mapping:
+            maps.append(_make_csr(d, i, p, shape))
+    return subs + maps if return_mapping else subs
+
+
+def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                     max_num_vertices, probability=None, seed=0):
+    from . import ndarray as _nd
+
+    indptr, indices, data, _ = _csr_parts(graph)
+    rng = onp.random.RandomState(seed)
+    prob = None
+    if probability is not None:  # one host fetch, not one per vertex
+        prob = onp.asarray(
+            probability.asnumpy() if hasattr(probability, "asnumpy")
+            else probability, onp.float64)
+    out = []
+    for sd in seeds:
+        sv = onp.asarray(sd.asnumpy() if hasattr(sd, "asnumpy") else sd,
+                         onp.int64).ravel()
+        sv = sv[sv >= 0]
+        visited = list(dict.fromkeys(int(s) for s in sv))
+        frontier = list(visited)
+        for _ in range(int(num_hops)):
+            nxt = []
+            for v in frontier:
+                nbrs = indices[indptr[v]:indptr[v + 1]]
+                if nbrs.size == 0:
+                    continue
+                k = min(int(num_neighbor), nbrs.size)
+                if prob is not None:
+                    p = prob[nbrs]
+                    tot = p.sum()
+                    if tot <= 0:
+                        continue
+                    k = min(k, int(onp.count_nonzero(p)))
+                    chosen = rng.choice(nbrs, size=k, replace=False,
+                                        p=p / tot)
+                else:
+                    chosen = rng.choice(nbrs, size=k, replace=False)
+                nxt.extend(int(c) for c in chosen)
+            vset = set(visited)
+            fresh = [v for v in dict.fromkeys(nxt) if v not in vset]
+            room = max_num_vertices - 1 - len(visited)
+            fresh = fresh[:max(0, room)]
+            visited.extend(fresh)
+            frontier = fresh
+            if not frontier:
+                break
+        if len(visited) > max_num_vertices - 1:
+            visited = visited[:max_num_vertices - 1]
+        padded = onp.full((max_num_vertices,), -1, onp.int64)
+        padded[:len(visited)] = visited
+        padded[-1] = len(visited)  # reference layout: count in last slot
+        d, i, p, shape = _induced(indptr, indices, data,
+                                  onp.asarray(visited, onp.int64))
+        out.append((_nd.array(padded.astype("float32")),
+                    _make_csr(d, i, p, shape)))
+    vs = [v for v, _ in out]
+    gs = [g for _, g in out]
+    return vs + gs
+
+
+def csr_neighbor_uniform_sample(graph, *seeds, num_hops=1, num_neighbor=2,
+                                max_num_vertices=100, seed=0):
+    """Uniform neighborhood sampling from seed vertices (reference:
+    dgl_graph.cc CSRNeighborUniformSample). Returns, for each seed
+    array, a padded vertex array (count in last slot) followed by the
+    induced sub-CSRs (values = parent edge id + 1)."""
+    return _neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                            max_num_vertices, None, seed)
+
+
+def csr_neighbor_non_uniform_sample(graph, probability, *seeds, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    seed=0):
+    """Probability-weighted neighborhood sampling (reference:
+    dgl_graph.cc CSRNeighborNonUniformSample)."""
+    return _neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                            max_num_vertices, probability, seed)
+
+
+def dgl_graph_compact(*graphs_and_vids, return_mapping=False,
+                      graph_sizes=None):
+    """Compact padded subgraphs to their real vertex count (reference:
+    dgl_graph.cc DGLGraphCompact). Input alternates: N csr graphs then N
+    padded vid arrays (as produced by the samplers); graph_sizes gives
+    the true vertex counts."""
+    n = len(graphs_and_vids) // 2
+    graphs = graphs_and_vids[:n]
+    sizes = graph_sizes if graph_sizes is not None else [None] * n
+    out = []
+    for g, size in zip(graphs, sizes):
+        indptr, indices, data, shape = _csr_parts(g)
+        k = int(size) if size is not None else shape[0]
+        p = indptr[:k + 1]
+        d = data[:p[-1]]
+        i = indices[:p[-1]]
+        out.append(_make_csr(d, i, p, (k, k)))
+    return out

@@ -74,9 +74,12 @@ class Symbol:
 
     def list_arguments(self):
         """Free variables in topological order (reference:
-        symbol.py list_arguments)."""
+        symbol.py list_arguments). Auxiliary states (variables tagged
+        __aux__, e.g. BN running stats) are excluded — they are not
+        optimizer-visible arguments."""
         return [s._name for s in self._walk()
-                if s._op is None and s._group is None]
+                if s._op is None and s._group is None
+                and "__aux__" not in s._attrs]
 
     def list_outputs(self):
         if self._group:
@@ -87,7 +90,11 @@ class Symbol:
         return [f"{base}_output{i}" for i in range(self._num_outputs)]
 
     def list_auxiliary_states(self):
-        return []
+        """Mutable non-gradient states (reference: symbol.py
+        list_auxiliary_states — BN moving_mean/moving_var et al.)."""
+        return [s._name for s in self._walk()
+                if s._op is None and s._group is None
+                and "__aux__" in s._attrs]
 
     def get_internals(self):
         return Group([s for s in self._walk() if s._op is not None] or [self])
@@ -180,7 +187,9 @@ class Symbol:
         known = {k: tuple(v) for k, v in kwargs.items()}
         var_shapes, out_shapes = infer_shapes(self, known)
         args = self.list_arguments()
-        return ([var_shapes.get(a) for a in args], out_shapes, [])
+        aux = self.list_auxiliary_states()
+        return ([var_shapes.get(a) for a in args], out_shapes,
+                [var_shapes.get(a) for a in aux])
 
     def infer_shape_partial(self, **kwargs):
         from .infer import infer_shapes
@@ -204,16 +213,22 @@ class Symbol:
         from .. import ndarray as nd
         from ..executor import Executor
 
-        arg_shapes, _, _ = self.infer_shape(**kwargs)
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         args = self.list_arguments()
-        missing = [a for a, s in zip(args, arg_shapes) if s is None]
+        aux = self.list_auxiliary_states()
+        missing = [a for a, s in zip(args, arg_shapes) if s is None] + \
+            [a for a, s in zip(aux, aux_shapes) if s is None]
         if missing:
             raise MXNetError(f"simple_bind could not infer shapes for "
                              f"{missing}")
         arg_arrays = [nd.zeros(s) for s in arg_shapes]
         grad_arrays = [nd.zeros(s) for s in arg_shapes] \
             if grad_req != "null" else None
-        return Executor(self, args, arg_arrays, grad_arrays, grad_req, ctx)
+        # moving stats start at the reference defaults (mean 0, var 1)
+        aux_arrays = [nd.ones(s) if n.endswith("var") else nd.zeros(s)
+                      for n, s in zip(aux, aux_shapes)]
+        return Executor(self, args, arg_arrays, grad_arrays, grad_req, ctx,
+                        aux_names=aux, aux_arrays=aux_arrays)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):
@@ -231,7 +246,23 @@ class Symbol:
             grad_arrays = [args_grad.get(n) for n in names]
         else:
             grad_arrays = list(args_grad)
-        return Executor(self, names, arg_arrays, grad_arrays, grad_req, ctx)
+        aux = self.list_auxiliary_states()
+        from .. import ndarray as _ndmod
+
+        if isinstance(aux_states, dict):
+            aux_arrays = [aux_states[n] for n in aux]
+        elif aux_states is not None:
+            aux_arrays = list(aux_states)
+        else:
+            _, _, aux_shapes = self.infer_shape(
+                **{n: tuple(a.shape) for n, a in zip(names, arg_arrays)})
+            aux_arrays = [
+                _ndmod.ones(sh) if n.endswith("var") else _ndmod.zeros(sh)
+                for n, sh in zip(aux, aux_shapes)] if all(
+                    sh is not None for sh in aux_shapes) else []
+        return Executor(self, names, arg_arrays, grad_arrays, grad_req, ctx,
+                        aux_names=aux if aux_arrays else [],
+                        aux_arrays=aux_arrays)
 
     # ---- serialization ---------------------------------------------------
     def tojson(self):
@@ -376,12 +407,34 @@ def _make_node(opname, inputs, kwargs, name=None):
                   num_outputs=_num_outputs_for(opname, kwargs))
 
 
+# op -> tensor-parameter inputs auto-created when omitted (reference:
+# each op's NNVM ListInputNames; composition fills missing inputs with
+# variables named {node}_{input})
+_AUX_PARAM_ARGS = frozenset({"moving_mean", "moving_var"})
+
+_AUTO_PARAMS = {
+    "fully_connected": ("weight", "bias"),
+    "convolution": ("weight", "bias"),
+    "deconvolution": ("weight", "bias"),
+    "embedding": ("weight",),
+    "batch_norm": ("gamma", "beta", "moving_mean", "moving_var"),
+    "layer_norm": ("gamma", "beta"),
+    "group_norm": ("gamma", "beta"),
+    "instance_norm": ("gamma", "beta"),
+}
+
+
 def _sym_wrapper(opdef):
     import inspect
 
-    sig_names = [p.name for p in
-                 inspect.signature(opdef.fn).parameters.values()
+    sig = inspect.signature(opdef.fn)
+    sig_names = [p.name for p in sig.parameters.values()
                  if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+    # ops like deconvolution default no_bias=True — the auto-created
+    # bias must respect the signature default, not just explicit kwargs
+    _nb = sig.parameters.get("no_bias")
+    no_bias_default = bool(_nb.default) if _nb is not None and \
+        _nb.default is not inspect.Parameter.empty else False
 
     def wrapper(*args, **kwargs):
         name = kwargs.pop("name", None)
@@ -395,6 +448,29 @@ def _sym_wrapper(opdef):
             elif isinstance(a, Symbol):
                 bound[f"__extra{i}"] = a  # varargs ops (concat, stack, ...)
         bound.update(kwargs)
+        # auto-create missing parameter inputs as Variables named
+        # {node}_{arg} like the reference's NNVM composition (symbol.py:
+        # FullyConnected(data, num_hidden=8) creates fc_weight/fc_bias).
+        # Only fires when a real Symbol input was given, and skips bias
+        # under no_bias=True (the input doesn't exist then).
+        auto = _AUTO_PARAMS.get(opdef.name)
+        has_sym = any(isinstance(v, Symbol) for v in bound.values())
+        if auto and has_sym:
+            if name is None:
+                name = f"{opdef.name.lower()}{_node_counter[0]}"
+                _node_counter[0] += 1
+            no_bias = bool(bound.get("no_bias", no_bias_default))
+            for key in auto:
+                if key in bound:
+                    continue
+                if key == "bias" and no_bias:
+                    continue
+                v = Variable(f"{name}_{key}")
+                if key in _AUX_PARAM_ARGS:
+                    # auxiliary state, not a trainable argument
+                    # (reference: BN's FMutateInputs marks these)
+                    v._attrs["__aux__"] = "1"
+                bound[key] = v
         inputs, config = [], {}
         for key in sig_names:
             if key in bound:

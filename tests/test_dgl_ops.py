@@ -1,0 +1,120 @@
+"""DGL graph-sampling op tests (reference: src/operator/contrib/
+dgl_graph.cc + tests/python/unittest/test_dgl_graph.py)."""
+import numpy as onp
+
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu.ndarray.contrib import (
+    edge_id, dgl_adjacency, dgl_subgraph, dgl_graph_compact,
+    dgl_csr_neighbor_uniform_sample, dgl_csr_neighbor_non_uniform_sample)
+
+
+def _toy_graph():
+    """5-vertex ring + chords; values are edge ids 1..nnz (the layout
+    the reference samplers expect)."""
+    dense = onp.array([
+        [0, 1, 0, 0, 1],
+        [1, 0, 1, 0, 0],
+        [0, 1, 0, 1, 0],
+        [0, 0, 1, 0, 1],
+        [1, 0, 0, 1, 0]], onp.float32)
+    indptr = [0]
+    indices = []
+    data = []
+    eid = 1
+    for r in range(5):
+        for c in range(5):
+            if dense[r, c]:
+                indices.append(c)
+                data.append(eid)
+                eid += 1
+        indptr.append(len(indices))
+    return sp.CSRNDArray(onp.asarray(data, "f"),
+                         onp.asarray(indices, onp.int64),
+                         onp.asarray(indptr, onp.int64), (5, 5))
+
+
+def test_edge_id():
+    g = _toy_graph()
+    out = edge_id(g, nd.array([0, 0, 2]), nd.array([1, 2, 3])).asnumpy()
+    assert out[0] == 1     # edge 0->1 is the first stored edge
+    assert out[1] == -1    # 0->2 absent
+    assert out[2] > 0      # 2->3 exists
+
+
+def test_dgl_adjacency():
+    g = _toy_graph()
+    adj = dgl_adjacency(g)
+    assert adj.stype == "csr"
+    onp.testing.assert_array_equal(adj.data.asnumpy(),
+                                   onp.ones(g.nnz, "f"))
+    onp.testing.assert_array_equal(adj.indices.asnumpy(),
+                                   g.indices.asnumpy())
+
+
+def test_dgl_subgraph_induced():
+    g = _toy_graph()
+    (sub,) = dgl_subgraph(g, nd.array([0, 1, 4]))
+    d = sub.todense().asnumpy()
+    # induced on {0,1,4}: edges 0-1, 0-4, 1-0, 4-0 survive; 4-3, 1-2 drop
+    expect = onp.array([[0, 1, 1],
+                        [1, 0, 0],
+                        [1, 0, 0]], "f")
+    onp.testing.assert_array_equal((d > 0).astype("f"), expect)
+
+
+def test_dgl_subgraph_mapping_edge_ids():
+    g = _toy_graph()
+    sub, mapping = dgl_subgraph(g, nd.array([0, 1]),
+                                return_mapping=True)
+    md = mapping.todense().asnumpy()
+    # value = parent edge id + 1; edge 0->1 has parent edge index 0
+    assert md[0, 1] == 1.0
+    assert md[1, 0] >= 1.0
+
+
+def test_uniform_sample_layout():
+    g = _toy_graph()
+    verts, subg = dgl_csr_neighbor_uniform_sample(
+        g, nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=10, seed=0)
+    v = verts.asnumpy().astype(int)
+    count = v[-1]
+    assert 1 <= count <= 9
+    ids = v[:count]
+    assert ids[0] == 0  # seeds come first
+    assert (v[count:-1] == -1).all()  # padding
+    assert subg.shape == (count, count)
+
+
+def test_uniform_sample_respects_max_vertices():
+    g = _toy_graph()
+    verts, subg = dgl_csr_neighbor_uniform_sample(
+        g, nd.array([0, 1, 2, 3, 4]), num_hops=3, num_neighbor=5,
+        max_num_vertices=4, seed=0)
+    v = verts.asnumpy().astype(int)
+    assert v[-1] <= 3
+    assert subg.shape[0] == v[-1]
+
+
+def test_non_uniform_sample_probability_zero_excluded():
+    g = _toy_graph()
+    # probability 0 for all but vertices 0,1 -> sampled neighbors of 0
+    # can only be 1 (its other neighbor, 4, has p=0)
+    prob = nd.array([1.0, 1.0, 0.0, 0.0, 0.0])
+    verts, subg = dgl_csr_neighbor_non_uniform_sample(
+        g, prob, nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=10, seed=0)
+    v = verts.asnumpy().astype(int)
+    ids = set(v[:v[-1]].tolist())
+    assert ids <= {0, 1}
+
+
+def test_graph_compact():
+    g = _toy_graph()
+    verts, subg = dgl_csr_neighbor_uniform_sample(
+        g, nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=10, seed=0)
+    count = int(verts.asnumpy()[-1])
+    (compact,) = dgl_graph_compact(subg, verts, graph_sizes=[count])
+    assert compact.shape == (count, count)

@@ -119,3 +119,49 @@ def test_module_predict_and_checkpoint(tmp_path):
     mod2.init_params(arg_params=arg_params, aux_params=aux_params)
     pred2 = mod2.predict(it)
     onp.testing.assert_allclose(pred.asnumpy(), pred2.asnumpy(), rtol=1e-5)
+
+
+def test_auto_created_param_variables():
+    """Omitted weight/bias become variables named {node}_{arg}
+    (reference: NNVM composition fills missing inputs)."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    args = fc.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias"]
+    # no_bias drops the bias input entirely
+    fc2 = sym.FullyConnected(data, name="fc2", num_hidden=8, no_bias=True)
+    assert fc2.list_arguments() == ["data", "fc2_weight"]
+    # shapes infer from data like the reference
+    exe = fc.simple_bind(data=(4, 6))
+    assert dict(zip(exe.arg_names,
+                    [a.shape for a in exe.arg_arrays]))["fc1_weight"] \
+        == (8, 6)
+    out = exe.forward()[0]
+    assert out.shape == (4, 8)
+
+
+def test_auto_created_batchnorm_params():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="c0", kernel=(3, 3), num_filter=4,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn0")
+    # running stats are AUXILIARY states, not optimizer-visible arguments
+    # (reference: BN's FMutateInputs; Module must never train them)
+    assert bn.list_arguments() == ["data", "c0_weight", "c0_bias",
+                                   "bn0_gamma", "bn0_beta"]
+    assert bn.list_auxiliary_states() == ["bn0_moving_mean",
+                                          "bn0_moving_var"]
+    exe = bn.simple_bind(data=(2, 3, 8, 8))
+    assert exe.aux_dict["bn0_moving_var"].shape == (4,)
+    # moving_var initializes to ONES (rsqrt(0) would be inf)
+    onp.testing.assert_array_equal(
+        exe.aux_dict["bn0_moving_var"].asnumpy(), onp.ones(4, "f"))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (2, 4, 8, 8)
+
+
+def test_auto_created_deconv_respects_no_bias_default():
+    data = sym.Variable("data")
+    d = sym.Deconvolution(data, name="d0", kernel=(2, 2), num_filter=4)
+    # deconvolution defaults no_bias=True: no phantom bias argument
+    assert d.list_arguments() == ["data", "d0_weight"]
