@@ -37,6 +37,9 @@ from . import kvstore
 from . import gluon
 from . import parallel
 from . import utils  # noqa: F401
+from . import initialize as _initialize
+
+_initialize.initialize()  # crash tracebacks + fork-safe engine (initialize.cc)
 from . import symbol
 from . import numpy as np
 from . import numpy_extension as npx

@@ -51,6 +51,8 @@ KNOBS = {
         "wired", "optimizer.SGD", "multi-tensor fused update group size"),
     "MXNET_ENGINE_NUM_LANES": (
         "wired", "engine.Engine", "worker-pool lanes (compute/IO split)"),
+    "MXNET_USE_SIGNAL_HANDLER": (
+        "wired", "initialize", "crash tracebacks via faulthandler"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

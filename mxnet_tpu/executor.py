@@ -79,17 +79,57 @@ class Executor:
             return
         symbol = self._symbol
         names = self.arg_names + self.aux_names
+        aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        # BatchNorm nodes whose running stats live in our aux arrays:
+        # training forward must fold fresh batch statistics into them
+        # (reference: BN FMutateInputs mutates aux in Forward)
+        bn_specs = []
+        for node in symbol._walk():
+            if node._op == "batch_norm" and len(node._inputs) >= 5:
+                if node._kwargs.get("use_global_stats"):
+                    continue  # frozen BN: never update running stats
+                mname = node._inputs[3]._name
+                vname = node._inputs[4]._name
+                if mname in aux_index and vname in aux_index:
+                    bn_specs.append(
+                        (node, aux_index[mname], aux_index[vname],
+                         float(node._kwargs.get("momentum", 0.9)),
+                         int(node._kwargs.get("axis", 1))))
 
         def fwd(vals, train):
             from . import autograd
 
             with autograd.pause(train_mode=train):
                 feed = {n: NDArray(v) for n, v in zip(names, vals)}
-                out = symbol.eval_with(feed)
+                cache = {}
+                out = symbol._eval_nodes(feed, cache)
+                if isinstance(out, (list, tuple)) and \
+                        symbol._num_outputs > 1:
+                    out = out[symbol._output_index]
+                aux_new = ()
+                if train and bn_specs:
+                    upd = list(vals[len(self.arg_names):])
+                    for node, mi, vi, mom, bax in bn_specs:
+                        xv = node._inputs[0]._eval_nodes(feed, cache)
+                        if isinstance(xv, (list, tuple)):
+                            xv = xv[node._inputs[0]._output_index]
+                        xd = xv.data.astype(jnp.float32)
+                        ax = tuple(i for i in range(xd.ndim)
+                                   if i != bax % xd.ndim)
+                        bm = jnp.mean(xd, axis=ax)
+                        bv = jnp.var(xd, axis=ax)
+                        upd[mi] = mom * upd[mi] + (1 - mom) * bm
+                        upd[vi] = mom * upd[vi] + (1 - mom) * bv
+                    aux_new = tuple(upd)
             outs = out if isinstance(out, (list, tuple)) else [out]
-            return tuple(o.data for o in outs)
+            return tuple(o.data for o in outs), aux_new
 
-        self._fwd_jit = jax.jit(fwd, static_argnums=(1,))
+        self._fwd_full_jit = jax.jit(fwd, static_argnums=(1,))
+
+        def fwd_only(vals, train):
+            return fwd(vals, train)[0]
+
+        self._fwd_jit = jax.jit(fwd_only, static_argnums=(1,))
 
         # loss-aware scalar function for backward
         def loss_fn(vals):
@@ -138,7 +178,7 @@ class Executor:
         self._grad_jit = jax.jit(jax.grad(loss_fn))
 
         def head_vjp(vals, cots):
-            _, vjp_fn = jax.vjp(lambda v: fwd(v, True), vals)
+            _, vjp_fn = jax.vjp(lambda v: fwd_only(v, True), vals)
             return vjp_fn(cots)[0]
 
         self._head_vjp_jit = jax.jit(head_vjp)
@@ -154,7 +194,12 @@ class Executor:
             self.arg_dict[k]._data = v.data if isinstance(v, NDArray) \
                 else jnp.asarray(v)
         vals = [a.data for a in self.arg_arrays + self.aux_arrays]
-        outs = self._fwd_jit(vals, bool(is_train))
+        if is_train and self.aux_arrays:
+            outs, aux_new = self._fwd_full_jit(vals, True)
+            for arr, new in zip(self.aux_arrays, aux_new):
+                arr._data = new
+        else:
+            outs = self._fwd_jit(vals, bool(is_train))
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 

@@ -1,0 +1,74 @@
+"""Process initialization: crash tracebacks and fork safety.
+
+Reference: src/initialize.cc — the reference installs a SIGSEGV handler
+that prints a C++ stack trace (MXNET_USE_SIGNAL_HANDLER) and pthread
+atfork hooks that stop the engine's worker threads before fork and
+restart them in parent and child (LibraryInitializer::install_pthread_
+atfork_handlers; threads never survive fork, so a child inheriting a
+"running" engine would deadlock on its first push).
+
+TPU-native equivalents:
+- crash tracebacks via ``faulthandler`` (SIGSEGV/SIGFPE/SIGABRT/SIGBUS
+  dump the Python stack of every thread — the useful trace here, since
+  compute crashes surface through the XLA runtime's own diagnostics);
+- ``os.register_at_fork`` resets the engine singleton and the pooled
+  storage handle in the child, so a forked worker lazily builds fresh
+  worker threads instead of deadlocking on the parent's dead ones.
+
+Both install at import (mxnet_tpu/__init__) and honor the reference's
+MXNET_USE_SIGNAL_HANDLER knob (default on, like the reference wheels).
+"""
+from __future__ import annotations
+
+import faulthandler
+import io
+import os
+import sys
+
+from . import env as _env
+
+_installed = {"signals": False, "fork": False}
+
+
+def install_signal_handlers():
+    """Enable crash tracebacks (reference: initialize.cc SegfaultLogger)."""
+    if _installed["signals"]:
+        return
+    if not _env.get_bool("MXNET_USE_SIGNAL_HANDLER", True):
+        return
+    try:
+        faulthandler.enable(file=sys.stderr, all_threads=True)
+        _installed["signals"] = True
+    except (RuntimeError, io.UnsupportedOperation, AttributeError):
+        pass  # no usable stderr (embedded interpreter)
+
+
+def _reinit_child():
+    """After fork, the child owns no engine/kvstore worker threads —
+    drop the singletons so they rebuild lazily (reference:
+    LibraryInitializer::atfork_child resets the engine)."""
+    from . import engine as _engine
+    from . import storage as _storage
+
+    # LOCKLESS on purpose: the child is single-threaded right after
+    # fork, and _engine_lock may have been COW-copied in the locked
+    # state if another parent thread was inside engine.get() — taking
+    # it here would deadlock the fork (threading.Lock is not
+    # fork-safe). Plain assignment is atomic enough for one thread.
+    _engine._engine = None
+    # the native pool's mutex/freelist were COW-snapshotted mid-flight;
+    # the child must not touch the parent's pool
+    _storage._storage = None
+
+
+def install_fork_handlers():
+    if _installed["fork"]:
+        return
+    if hasattr(os, "register_at_fork"):
+        os.register_at_fork(after_in_child=_reinit_child)
+        _installed["fork"] = True
+
+
+def initialize():
+    install_signal_handlers()
+    install_fork_handlers()

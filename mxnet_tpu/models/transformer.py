@@ -21,13 +21,17 @@ class MultiHeadSelfAttention(HybridBlock):
     """Causal self-attention over (B, S, E) via flash attention."""
 
     def __init__(self, embed_dim, num_heads, ring_axis=None,
-                 ring_batch_axis=None, **kwargs):
+                 ring_batch_axis=None, sp_mode="ring", **kwargs):
         super().__init__(**kwargs)
         assert embed_dim % num_heads == 0
         self._e = embed_dim
         self._h = num_heads
         self._ring_axis = ring_axis
         self._ring_batch_axis = ring_batch_axis
+        # "ring" (ppermute pipeline, any head count) or "ulysses"
+        # (all-to-all head scatter, needs heads % sp == 0, fewer
+        # collectives when heads are plentiful) — parallel/ulysses.py
+        self._sp_mode = sp_mode
         with self.name_scope():
             self.qkv = nn.Dense(3 * embed_dim, use_bias=False,
                                 flatten=False)
@@ -44,7 +48,10 @@ class MultiHeadSelfAttention(HybridBlock):
         if self._ring_axis is not None:
             from .. import parallel
 
-            attn = parallel.ring_attention(
+            sp_attn = (parallel.ulysses_attention
+                       if self._sp_mode == "ulysses"
+                       else parallel.ring_attention)
+            attn = sp_attn(
                 q, k, v, causal=True, axis_name=self._ring_axis,
                 batch_axis=self._ring_batch_axis)
         else:
@@ -55,13 +62,14 @@ class MultiHeadSelfAttention(HybridBlock):
 
 class TransformerBlock(HybridBlock):
     def __init__(self, embed_dim, num_heads, ffn_dim, dropout=0.0,
-                 ring_axis=None, ring_batch_axis=None, **kwargs):
+                 ring_axis=None, ring_batch_axis=None, sp_mode="ring",
+                 **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ln1 = nn.LayerNorm()
             self.attn = MultiHeadSelfAttention(
                 embed_dim, num_heads, ring_axis=ring_axis,
-                ring_batch_axis=ring_batch_axis)
+                ring_batch_axis=ring_batch_axis, sp_mode=sp_mode)
             self.ln2 = nn.LayerNorm()
             self.ffn1 = nn.Dense(ffn_dim, flatten=False, activation="relu")
             self.ffn2 = nn.Dense(embed_dim, flatten=False)
@@ -81,7 +89,8 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size, embed_dim=256, num_layers=2, num_heads=4,
                  ffn_dim=None, max_len=1024, dropout=0.0, tie_weights=False,
-                 ring_axis=None, ring_batch_axis=None, **kwargs):
+                 ring_axis=None, ring_batch_axis=None, sp_mode="ring",
+                 **kwargs):
         super().__init__(**kwargs)
         ffn_dim = ffn_dim or 4 * embed_dim
         self._scale = math.sqrt(embed_dim)
@@ -93,7 +102,8 @@ class TransformerLM(HybridBlock):
             for _ in range(num_layers):
                 self.blocks.add(TransformerBlock(
                     embed_dim, num_heads, ffn_dim, dropout,
-                    ring_axis=ring_axis, ring_batch_axis=ring_batch_axis))
+                    ring_axis=ring_axis, ring_batch_axis=ring_batch_axis,
+                    sp_mode=sp_mode))
             self.ln_f = nn.LayerNorm()
             self._tie = tie_weights
             if not tie_weights:

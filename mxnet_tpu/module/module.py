@@ -124,6 +124,8 @@ class Module(BaseModule):
             # unless a checkpoint provides them
             if aux_params and name in aux_params:
                 aux_params[name].copyto(arr)
+            elif aux_params and not allow_missing:
+                raise RuntimeError(f"{name} is not presented")
         self.params_initialized = True
 
     def get_params(self):

@@ -12,7 +12,8 @@ Conventions kept from the reference:
 - sampled-vertex outputs are padded to ``max_num_vertices`` with -1 and
   carry the vertex count in the LAST slot (dgl_graph.cc output layout);
 - subgraph CSR ``data`` holds parent edge ids + 1 so callers can map
-  edges back (0 is reserved for "no edge").
+  edges back (0 is reserved for "no edge"); edge-id payloads are float64
+  (exact to 2^53 — float32 would corrupt ids past 16.7M edges).
 """
 from __future__ import annotations
 
@@ -36,10 +37,10 @@ def _csr_parts(graph):
     return indptr, indices, data, graph.shape
 
 
-def _make_csr(data, indices, indptr, shape):
+def _make_csr(data, indices, indptr, shape, dtype=onp.float32):
     from . import sparse as _sp
 
-    return _sp.CSRNDArray(onp.asarray(data, onp.float32),
+    return _sp.CSRNDArray(onp.asarray(data, dtype),
                           onp.asarray(indices, onp.int64),
                           onp.asarray(indptr, onp.int64), shape)
 
@@ -54,13 +55,13 @@ def edge_id(graph, u, v):
                      onp.int64).ravel()
     vv = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
                      onp.int64).ravel()
-    out = onp.full(uu.shape, -1.0, onp.float32)
+    out = onp.full(uu.shape, -1.0, onp.float64)
     for i, (a, b) in enumerate(zip(uu, vv)):
         row = indices[indptr[a]:indptr[a + 1]]
         hit = onp.nonzero(row == b)[0]
         if hit.size:
             out[i] = data[indptr[a] + hit[0]]
-    return _nd.array(out)
+    return _nd.array(out, dtype="float64")
 
 
 def dgl_adjacency(graph):
@@ -71,9 +72,8 @@ def dgl_adjacency(graph):
                      shape)
 
 
-def _induced(indptr, indices, data, vids):
-    """Vertex-induced subgraph; returns (data, indices, indptr) with
-    parent edge ids + 1 as values."""
+def _induced(indptr, indices, vids):
+    """Vertex-induced subgraph; returns (edge_ids+1, indices, indptr)."""
     vids = onp.asarray(vids, onp.int64)
     vids = vids[vids >= 0]
     old2new = {int(v): i for i, v in enumerate(vids)}
@@ -88,7 +88,7 @@ def _induced(indptr, indices, data, vids):
                 sub_data.append(e + 1)  # parent edge id + 1
         sub_indptr.append(len(sub_indices))
     n = len(vids)
-    return (onp.asarray(sub_data, onp.float32),
+    return (onp.asarray(sub_data, onp.float64),
             onp.asarray(sub_indices, onp.int64),
             onp.asarray(sub_indptr, onp.int64), (n, n))
 
@@ -102,10 +102,10 @@ def dgl_subgraph(graph, *vids, return_mapping=False):
     for v in vids:
         vv = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
                          onp.int64).ravel()
-        d, i, p, shape = _induced(indptr, indices, data, vv)
-        subs.append(_make_csr(onp.ones_like(d), i, p, shape))
+        d, i, p, shape = _induced(indptr, indices, vv)
+        subs.append(_make_csr(onp.ones(d.shape, onp.float32), i, p, shape))
         if return_mapping:
-            maps.append(_make_csr(d, i, p, shape))
+            maps.append(_make_csr(d, i, p, shape, onp.float64))
     return subs + maps if return_mapping else subs
 
 
@@ -158,10 +158,10 @@ def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
         padded = onp.full((max_num_vertices,), -1, onp.int64)
         padded[:len(visited)] = visited
         padded[-1] = len(visited)  # reference layout: count in last slot
-        d, i, p, shape = _induced(indptr, indices, data,
+        d, i, p, shape = _induced(indptr, indices,
                                   onp.asarray(visited, onp.int64))
-        out.append((_nd.array(padded.astype("float32")),
-                    _make_csr(d, i, p, shape)))
+        out.append((_nd.array(padded.astype("float64"), dtype="float64"),
+                    _make_csr(d, i, p, shape, onp.float64)))
     vs = [v for v, _ in out]
     gs = [g for _, g in out]
     return vs + gs
@@ -194,7 +194,18 @@ def dgl_graph_compact(*graphs_and_vids, return_mapping=False,
     the true vertex counts."""
     n = len(graphs_and_vids) // 2
     graphs = graphs_and_vids[:n]
-    sizes = graph_sizes if graph_sizes is not None else [None] * n
+    vid_arrays = graphs_and_vids[n:]
+    if graph_sizes is not None:
+        sizes = list(graph_sizes)
+    else:
+        # the samplers' padded vid layout carries the count in the LAST
+        # slot — that is why the vid arrays ride along (reference
+        # DGLGraphCompact reads it the same way)
+        sizes = []
+        for v in vid_arrays:
+            arr = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                              else v).ravel()
+            sizes.append(int(arr[-1]) if arr.size else 0)
     out = []
     for g, size in zip(graphs, sizes):
         indptr, indices, data, shape = _csr_parts(g)

@@ -277,12 +277,19 @@ def softmin(data, axis=-1):
 @register()
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
-               output_mean_var=False, axis=1, use_batch_stats=True):
+               output_mean_var=False, axis=1, use_batch_stats=None):
     """Functional BatchNorm (reference: src/operator/nn/batch_norm.cc).
 
-    Running-stat mutation is done by the Gluon layer (swap-on-write), keeping
-    this body pure/traceable. ``use_batch_stats`` False → inference stats.
+    Running-stat mutation is done by the caller (Gluon layer swap-on-write
+    / Executor aux write-back), keeping this body pure/traceable.
+    ``use_batch_stats`` None follows the ambient autograd train mode like
+    the reference op's is_train flag (outside autograd.record the op
+    normalizes with the moving statistics); True/False force it.
     """
+    if use_batch_stats is None:
+        from .. import autograd as _ag
+
+        use_batch_stats = _ag.is_training()
     ax = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]

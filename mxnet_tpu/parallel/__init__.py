@@ -19,7 +19,9 @@ from .mesh import make_mesh, current_mesh, mesh_scope, device_count
 from .spmd import (all_reduce, group_all_reduce, SPMDTrainer, shard_batch,
                    replicate, shard_params)
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count",
            "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
-           "replicate", "shard_params", "ring_attention"]
+           "replicate", "shard_params", "ring_attention",
+           "ulysses_attention"]

@@ -224,8 +224,7 @@ class Symbol:
         arg_arrays = [nd.zeros(s) for s in arg_shapes]
         grad_arrays = [nd.zeros(s) for s in arg_shapes] \
             if grad_req != "null" else None
-        # moving stats start at the reference defaults (mean 0, var 1)
-        aux_arrays = [nd.ones(s) if n.endswith("var") else nd.zeros(s)
+        aux_arrays = [_default_aux_array(n, s)
                       for n, s in zip(aux, aux_shapes)]
         return Executor(self, args, arg_arrays, grad_arrays, grad_req, ctx,
                         aux_names=aux, aux_arrays=aux_arrays)
@@ -253,16 +252,20 @@ class Symbol:
             aux_arrays = [aux_states[n] for n in aux]
         elif aux_states is not None:
             aux_arrays = list(aux_states)
-        else:
+        elif aux:
             _, _, aux_shapes = self.infer_shape(
                 **{n: tuple(a.shape) for n, a in zip(names, arg_arrays)})
-            aux_arrays = [
-                _ndmod.ones(sh) if n.endswith("var") else _ndmod.zeros(sh)
-                for n, sh in zip(aux, aux_shapes)] if all(
-                    sh is not None for sh in aux_shapes) else []
+            missing = [n for n, sh in zip(aux, aux_shapes) if sh is None]
+            if missing:  # fail HERE, not deep inside the first forward
+                raise MXNetError(
+                    f"bind could not infer aux-state shapes for {missing}; "
+                    "pass aux_states explicitly")
+            aux_arrays = [_default_aux_array(n, sh)
+                          for n, sh in zip(aux, aux_shapes)]
+        else:
+            aux_arrays = []
         return Executor(self, names, arg_arrays, grad_arrays, grad_req, ctx,
-                        aux_names=aux if aux_arrays else [],
-                        aux_arrays=aux_arrays)
+                        aux_names=aux, aux_arrays=aux_arrays)
 
     # ---- serialization ---------------------------------------------------
     def tojson(self):
@@ -410,7 +413,32 @@ def _make_node(opname, inputs, kwargs, name=None):
 # op -> tensor-parameter inputs auto-created when omitted (reference:
 # each op's NNVM ListInputNames; composition fills missing inputs with
 # variables named {node}_{input})
-_AUX_PARAM_ARGS = frozenset({"moving_mean", "moving_var"})
+# op -> input positions that are auxiliary states. Aux-ness is a property
+# of the graph STRUCTURE (the reference derives it from each op's
+# FMutateInputs, nnvm has no aux marker in JSON) — so it is re-derived
+# whenever a node is built: by the op wrappers AND by the JSON loader.
+_AUX_INPUT_SLOTS = {"batch_norm": (3, 4)}
+
+
+def _default_aux_array(name, shape):
+    """Bind-time default for an aux state: variances start at ONE
+    (rsqrt(0) would blow up), means/others at zero — the reference's
+    BatchNorm aux initialization."""
+    from .. import ndarray as _ndmod
+
+    return _ndmod.ones(shape) if name.endswith("var") \
+        else _ndmod.zeros(shape)
+
+
+def _mark_aux_inputs(node):
+    slots = _AUX_INPUT_SLOTS.get(node._op)
+    if not slots:
+        return
+    for idx in slots:
+        if idx < len(node._inputs):
+            v = node._inputs[idx]
+            if v._op is None and v._group is None:
+                v._attrs.setdefault("__aux__", "1")
 
 _AUTO_PARAMS = {
     "fully_connected": ("weight", "bias"),
@@ -465,12 +493,9 @@ def _sym_wrapper(opdef):
                     continue
                 if key == "bias" and no_bias:
                     continue
-                v = Variable(f"{name}_{key}")
-                if key in _AUX_PARAM_ARGS:
-                    # auxiliary state, not a trainable argument
-                    # (reference: BN's FMutateInputs marks these)
-                    v._attrs["__aux__"] = "1"
-                bound[key] = v
+                # aux-ness is applied structurally by _mark_aux_inputs
+                # on the finished node (single source of truth)
+                bound[key] = Variable(f"{name}_{key}")
         inputs, config = [], {}
         for key in sig_names:
             if key in bound:
@@ -485,6 +510,7 @@ def _sym_wrapper(opdef):
             else:
                 config[key] = v
         node = _make_node(opdef.name, inputs, config, name=name)
+        _mark_aux_inputs(node)  # structural aux-ness (FMutateInputs)
         if attr:
             node._set_attr(**attr)
         return node
@@ -605,11 +631,13 @@ def load_json(json_str):
             for k, v in attrs.items():
                 if accepts_kw or k in known:
                     kwargs[k] = _parse_attr_value(v)
-        built.append(Symbol(op=opname, name=n["name"], inputs=inputs,
-                            kwargs=kwargs,
-                            num_outputs=n.get(
-                                "num_outputs",
-                                _num_outputs_for(opname, kwargs))))
+        node = Symbol(op=opname, name=n["name"], inputs=inputs,
+                      kwargs=kwargs,
+                      num_outputs=n.get(
+                          "num_outputs",
+                          _num_outputs_for(opname, kwargs)))
+        _mark_aux_inputs(node)
+        built.append(node)
     heads = [built[i] if h[1] == 0 else built[i][h[1]]
              for h in obj["heads"] for i in [h[0]]]
     return heads[0] if len(heads) == 1 else Group(heads)

@@ -135,3 +135,43 @@ def test_estimator_early_stopping():
     est.fit(train, epochs=50, event_handlers=[stop])
     # constant data → accuracy flat → early stop long before 50 epochs
     assert stop.stop_training
+
+
+def test_fork_reinitializes_engine():
+    """A forked child must not inherit dead engine worker threads
+    (reference: initialize.cc atfork handlers)."""
+    import os
+
+    import mxnet_tpu  # noqa: F401 — installs the fork handler
+    from mxnet_tpu import engine as eng
+
+    e = eng.get()
+    v = e.new_variable()
+    done = []
+    e.push(lambda: done.append(1), mutable_vars=(v,))
+    e.wait_for_var(v)
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork")
+    pid = os.fork()
+    if pid == 0:  # child: the singleton must have been reset + rebuilt
+        rc = 1
+        try:
+            ce = eng.get()
+            assert ce is not e or isinstance(ce, eng.NaiveEngine)
+            cv = ce.new_variable()
+            got = []
+            ce.push(lambda: got.append(1), mutable_vars=(cv,))
+            ce.wait_for_var(cv)
+            rc = 0 if got == [1] else 2
+        finally:
+            os._exit(rc)
+    _, status = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(status) == 0
+
+
+def test_signal_handler_knob_installed():
+    import faulthandler
+
+    import mxnet_tpu  # noqa: F401
+
+    assert faulthandler.is_enabled()

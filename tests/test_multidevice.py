@@ -315,9 +315,11 @@ def test_sync_batch_norm_stats_match_global_batch():
                                 out_specs=P("dp")))(
             sharded)
     # plain BN over the full batch gives the same normalized output
+    # (use_batch_stats=True explicitly: outside autograd.record the op
+    # now follows the reference and normalizes with the MOVING stats)
     bn_full = nd.batch_norm(
         nd.array(X), nd.ones(4), nd.zeros(4), nd.zeros(4), nd.ones(4),
-        fix_gamma=False, eps=1e-5)
+        fix_gamma=False, eps=1e-5, use_batch_stats=True)
     onp.testing.assert_allclose(onp.asarray(out), bn_full.asnumpy(),
                                 rtol=2e-3, atol=2e-3)
 

@@ -165,3 +165,62 @@ def test_auto_created_deconv_respects_no_bias_default():
     d = sym.Deconvolution(data, name="d0", kernel=(2, 2), num_filter=4)
     # deconvolution defaults no_bias=True: no phantom bias argument
     assert d.list_arguments() == ["data", "d0_weight"]
+
+
+def test_bn_aux_states_update_and_drive_inference():
+    """Training forwards fold batch statistics into moving_mean/var;
+    inference normalizes WITH them (reference: BN FMutateInputs +
+    is_train gating in batch_norm.cc)."""
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn0", momentum=0.5)
+    exe = bn.simple_bind(data=(64, 3))
+    rng = onp.random.RandomState(0)
+    x = (rng.rand(64, 3).astype("f") * 4.0 + 10.0)  # mean ~12, var ~1.3
+    exe.arg_dict["data"][:] = nd.array(x)
+    m0 = exe.aux_dict["bn0_moving_mean"].asnumpy().copy()
+    for _ in range(8):
+        exe.forward(is_train=True)
+    m1 = exe.aux_dict["bn0_moving_mean"].asnumpy()
+    v1 = exe.aux_dict["bn0_moving_var"].asnumpy()
+    assert not onp.allclose(m0, m1), "moving_mean never updated"
+    # after several steps the moving stats approach the batch stats
+    onp.testing.assert_allclose(m1, x.mean(0), rtol=0.1)
+    onp.testing.assert_allclose(v1, x.var(0), rtol=0.3, atol=0.2)
+    # inference normalizes with the moving stats, not the batch's
+    out = exe.forward(is_train=False)[0].asnumpy()
+    expect = (x - m1) / onp.sqrt(v1 + 1e-3)
+    onp.testing.assert_allclose(out, expect, rtol=1e-2, atol=1e-2)
+
+
+def test_bn_eager_follows_autograd_mode():
+    from mxnet_tpu import autograd
+
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.rand(8, 4).astype("f") + 3.0)
+    g, b = nd.ones(4), nd.zeros(4)
+    mm, mv = nd.zeros(4), nd.ones(4)
+    # outside record: moving stats (mean 0, var 1) -> out ~ x
+    out_inf = nd.batch_norm(x, g, b, mm, mv, eps=1e-5,
+                            fix_gamma=False).asnumpy()
+    onp.testing.assert_allclose(out_inf, x.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+    # under record(train_mode=True): batch stats -> zero mean
+    with autograd.record():
+        out_tr = nd.batch_norm(x, g, b, mm, mv, eps=1e-5,
+                               fix_gamma=False).asnumpy()
+    assert abs(out_tr.mean()) < 1e-5
+
+
+def test_bn_use_global_stats_never_updates_aux():
+    """Frozen BN (use_global_stats=True) must keep its running stats
+    untouched by training forwards (reference batch_norm.cc)."""
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn0", use_global_stats=True)
+    exe = bn.simple_bind(data=(16, 3))
+    exe.arg_dict["data"][:] = nd.array(
+        onp.random.RandomState(0).rand(16, 3).astype("f") * 5 + 7)
+    before = exe.aux_dict["bn0_moving_mean"].asnumpy().copy()
+    for _ in range(3):
+        exe.forward(is_train=True)
+    onp.testing.assert_array_equal(
+        exe.aux_dict["bn0_moving_mean"].asnumpy(), before)
