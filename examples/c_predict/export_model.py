@@ -1,0 +1,49 @@
+"""Train a small classifier and export it for the C predict API.
+
+Produces mlp-symbol.json + mlp-0000.params (reference checkpoint format,
+arg:/aux: tags) that predict.c loads through libmxnet_c.so — the deploy
+flow of the reference's example/image-classification/predict-cpp, rebuilt
+on this runtime.
+
+Run: python export_model.py   (writes into this directory)
+"""
+import os
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    rng = onp.random.RandomState(0)
+    X = rng.rand(512, 16).astype("f")
+    y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype("f")
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=2)
+    out = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+
+    it = NDArrayIter(X, y, batch_size=64, label_name="softmax_label")
+    mod = Module(out)
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    score = mod.score(it, "acc")
+    print("train accuracy:", score)
+    mod.save_checkpoint(os.path.join(HERE, "mlp"), 0)
+    # one sample for predict.c to classify
+    onp.savetxt(os.path.join(HERE, "sample.txt"), X[:1], fmt="%.6f")
+    pred = mod.predict(it).asnumpy()[0]
+    print("python probabilities for sample 0:", pred)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+/* Deploy example: classify one sample from a plain C program.
+ *
+ * Loads the checkpoint exported by export_model.py through the flat C
+ * ABI (include/mxnet_tpu/c_api.h + libmxnet_c.so) — no Python source in
+ * sight; the library attaches to an embedded interpreter internally.
+ *
+ * Build + run (from this directory):
+ *   python export_model.py
+ *   make -C ../../native c_api
+ *   gcc predict.c -o predict -I../../include \
+ *       ../../mxnet_tpu/_native/libmxnet_c.so \
+ *       -Wl,-rpath,$PWD/../../mxnet_tpu/_native
+ *   PYTHONPATH=../.. JAX_PLATFORMS=cpu ./predict
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_api.h"
+
+static char* read_file(const char* path, size_t* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = malloc(n + 1);
+  if (fread(buf, 1, n, f) != (size_t)n) exit(1);
+  buf[n] = 0;
+  fclose(f);
+  *size = (size_t)n;
+  return buf;
+}
+
+int main(void) {
+  size_t json_size, param_size;
+  char* sym_json = read_file("mlp-symbol.json", &json_size);
+  char* params = read_file("mlp-0000.params", &param_size);
+
+  const char* input_keys[1] = {"data"};
+  uint32_t indptr[2] = {0, 2};
+  int64_t shape[2] = {1, 16};
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(sym_json, params, param_size, 1, 0, 1, input_keys,
+                   indptr, shape, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  float sample[16];
+  FILE* f = fopen("sample.txt", "r");
+  if (!f) { fprintf(stderr, "run export_model.py first\n"); return 1; }
+  for (int i = 0; i < 16; ++i)
+    if (fscanf(f, "%f", &sample[i]) != 1) return 1;
+  fclose(f);
+
+  if (MXPredSetInput(pred, "data", sample, 16) != 0 ||
+      MXPredForward(pred) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+  int ndim = 0;
+  int64_t oshape[MX_MAX_DIM];
+  MXPredGetOutputShape(pred, 0, &ndim, oshape);
+  float probs[2];
+  if (MXPredGetOutput(pred, 0, probs, 2) != 0) {
+    fprintf(stderr, "get output: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("C probabilities: [%f, %f] -> class %d\n", probs[0], probs[1],
+         probs[1] > probs[0] ? 1 : 0);
+  MXPredFree(pred);
+  free(sym_json);
+  free(params);
+  return 0;
+}

@@ -54,8 +54,13 @@ def _reinit_child():
     # fork, and _engine_lock may have been COW-copied in the locked
     # state if another parent thread was inside engine.get() — taking
     # it here would deadlock the fork (threading.Lock is not
-    # fork-safe). Plain assignment is atomic enough for one thread.
+    # fork-safe). Plain assignment is atomic enough for one thread;
+    # the lock itself is replaced too, else the child's first
+    # engine.get() would block on the orphaned held lock.
+    import threading
+
     _engine._engine = None
+    _engine._engine_lock = threading.Lock()
     # the native pool's mutex/freelist were COW-snapshotted mid-flight;
     # the child must not touch the parent's pool
     _storage._storage = None

@@ -25,32 +25,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["ulysses_attention"]
 
-_NEG = -1e30
-
-
-def _attn_full(q, k, v, sm_scale, causal):
-    """Plain full attention on (B, h, S, D) — all sequence local."""
-    qf = q.astype(jnp.float32)
-    sc = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32),
-                    preferred_element_type=jnp.float32) * sm_scale
-    if causal:
-        S = q.shape[2]
-        mask = jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
-        sc = jnp.where(mask[None, None], sc, _NEG)
-    p = jax.nn.softmax(sc, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
-
-
 def _ulysses_local(q, k, v, axis_name, sm_scale, causal):
     """Runs INSIDE shard_map: q/k/v are sequence shards (B, H, Sl, D)."""
+    from ..ops.flash_attention import flash_attention
+
     # seq-sharded -> head-sharded: split heads across the axis, gather
     # the sequence (one ICI all-to-all per tensor)
     qh, kh, vh = (lax.all_to_all(x, axis_name, split_axis=1,
                                  concat_axis=2, tiled=True)
                   for x in (q, k, v))
-    out = _attn_full(qh, kh, vh, sm_scale, causal)  # (B, H/P, S, D)
+    # local attention over the full sequence via the streaming flash
+    # kernel — O(S) memory per head, not an S x S score matrix
+    out = flash_attention(qh, kh, vh, sm_scale=sm_scale, causal=causal)
     # head-sharded -> seq-sharded
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)

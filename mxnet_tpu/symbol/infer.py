@@ -93,6 +93,21 @@ def _param_shape_rules(op, kw, in_shapes, arg_names):
             out[named("state_cell")] = st
     elif op in ("leaky_relu",) and kw.get("act_type") == "prelu":
         out[named("gamma")] = (data[1] if len(data) > 1 else 1,)
+    elif op == "softmax_output":
+        # label shape = data shape without the class axis (reference
+        # softmax_output.cc FInferShape) — lets the C predict API bind
+        # exported training graphs with only `data` provided.
+        # multi_output mode softmaxes axis 1: label is (N, *spatial)
+        if kw.get("multi_output"):
+            out[named("label")] = (data[0],) + tuple(data[2:])
+        else:
+            out[named("label")] = tuple(data[:-1])
+    elif op == "svm_output":
+        # class-index labels like softmax_output (reference svm_output.cc)
+        out[named("label")] = tuple(data[:-1])
+    elif op in ("linear_regression_output", "mae_regression_output",
+                "logistic_regression_output"):
+        out[named("label")] = tuple(data)
     return {k: v for k, v in out.items() if k is not None}
 
 
