@@ -61,7 +61,11 @@ int main(void) {
   }
   int ndim = 0;
   int64_t oshape[MX_MAX_DIM];
-  MXPredGetOutputShape(pred, 0, &ndim, oshape);
+  if (MXPredGetOutputShape(pred, 0, &ndim, oshape) != 0 ||
+      ndim != 2 || oshape[0] != 1 || oshape[1] != 2) {
+    fprintf(stderr, "unexpected output shape (ndim=%d)\n", ndim);
+    return 1;
+  }
   float probs[2];
   if (MXPredGetOutput(pred, 0, probs, 2) != 0) {
     fprintf(stderr, "get output: %s\n", MXGetLastError());

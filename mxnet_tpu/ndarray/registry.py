@@ -189,8 +189,19 @@ def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
 
     _wrap = wrap or _default_wrap
     datas = [a.data if isinstance(a, NDArray) else a for a in arr_args]
+
+    def normalized(*xs):
+        # jnp routines return result NAMEDTUPLES (QRResult, SVDResult,
+        # SlogdetResult...); backward rebuilds cotangents as plain
+        # tuples, and jax.vjp rejects the pytree-type mismatch — flatten
+        # the type here once for every op
+        r = pure_fn(*xs)
+        if isinstance(r, tuple) and type(r) is not tuple:
+            return tuple(r)
+        return r
+
     if autograd.is_recording() and differentiable and arr_args:
-        result, vjp_fn = jax.vjp(pure_fn, *datas)
+        result, vjp_fn = jax.vjp(normalized, *datas)
         multi = isinstance(result, tuple)
         if out is not None:
             if multi:
