@@ -25,18 +25,21 @@ class RELU6(HybridBlock):
 
 
 def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
+              active=True, relu6=False, layout="NCHW"):
     out.add(Conv2D(channels, kernel, stride, pad, groups=num_group,
-                   use_bias=False))
-    out.add(BatchNorm(scale=True))
+                   use_bias=False, layout=layout))
+    from .resnet import _bn_axis
+
+    out.add(BatchNorm(scale=True, axis=_bn_axis(layout)))
     if active:
         out.add(RELU6() if relu6 else Activation("relu"))
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False,
+                 layout="NCHW"):
     _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels, relu6=relu6)
+              num_group=dw_channels, relu6=relu6, layout=layout)
+    _add_conv(out, channels, relu6=relu6, layout=layout)
 
 
 class LinearBottleneck(HybridBlock):
@@ -44,15 +47,19 @@ class LinearBottleneck(HybridBlock):
     LinearBottleneck): 1x1 expand (t*) → 3x3 depthwise → 1x1 linear
     project, residual add when stride==1 and channels match."""
 
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
+    def __init__(self, in_channels, channels, t, stride, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
         self.use_shortcut = stride == 1 and in_channels == channels
         with self.name_scope():
             self.out = HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
+            _add_conv(self.out, in_channels * t, relu6=True,
+                      layout=layout)
             _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
-                      pad=1, num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+                      pad=1, num_group=in_channels * t, relu6=True,
+                      layout=layout)
+            _add_conv(self.out, channels, active=False, relu6=True,
+                      layout=layout)
 
     def hybrid_forward(self, F, x):
         out = self.out(x)
@@ -65,12 +72,14 @@ class MobileNet(HybridBlock):
     """MobileNet v1 with width multiplier (reference: mobilenet.py
     MobileNet)."""
 
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
+        assert layout in ("NCHW", "NHWC"), layout
         with self.name_scope():
             self.features = HybridSequential(prefix="")
             _add_conv(self.features, channels=int(32 * multiplier),
-                      kernel=3, pad=1, stride=2)
+                      kernel=3, pad=1, stride=2, layout=layout)
             dw_channels = [int(x * multiplier) for x in
                            [32, 64] + [128] * 2 + [256] * 2 + [512] * 6
                            + [1024]]
@@ -80,8 +89,8 @@ class MobileNet(HybridBlock):
             strides = [1, 2] * 3 + [1] * 5 + [2, 1]
             for dwc, c, s in zip(dw_channels, channels, strides):
                 _add_conv_dw(self.features, dw_channels=dwc, channels=c,
-                             stride=s)
-            self.features.add(GlobalAvgPool2D())
+                             stride=s, layout=layout)
+            self.features.add(GlobalAvgPool2D(layout=layout))
             self.features.add(Flatten())
             self.output = Dense(classes)
 
@@ -93,12 +102,14 @@ class MobileNet(HybridBlock):
 class MobileNetV2(HybridBlock):
     """MobileNet v2 (reference: mobilenet.py MobileNetV2)."""
 
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
+        assert layout in ("NCHW", "NHWC"), layout
         with self.name_scope():
             self.features = HybridSequential(prefix="features_")
             _add_conv(self.features, int(32 * multiplier), kernel=3,
-                      stride=2, pad=1, relu6=True)
+                      stride=2, pad=1, relu6=True, layout=layout)
 
             in_channels_group = [int(x * multiplier) for x in
                                  [32] + [16] + [24] * 2 + [32] * 3
@@ -113,16 +124,18 @@ class MobileNetV2(HybridBlock):
                                      ts, strides):
                 self.features.add(LinearBottleneck(in_channels=in_c,
                                                    channels=c, t=t,
-                                                   stride=s))
+                                                   stride=s,
+                                                   layout=layout))
 
             last_channels = int(1280 * multiplier) if multiplier > 1.0 \
                 else 1280
-            _add_conv(self.features, last_channels, relu6=True)
-            self.features.add(GlobalAvgPool2D())
+            _add_conv(self.features, last_channels, relu6=True,
+                      layout=layout)
+            self.features.add(GlobalAvgPool2D(layout=layout))
 
             self.output = HybridSequential(prefix="output_")
             self.output.add(Conv2D(classes, 1, use_bias=False,
-                                   prefix="pred_"))
+                                   prefix="pred_", layout=layout))
             self.output.add(Flatten())
 
     def hybrid_forward(self, F, x):

@@ -19,15 +19,11 @@ def norm(x, ord=None, axis=None, keepdims=False):
 
 
 def svd(a, full_matrices=False, compute_uv=True):
-    # normalize jnp's SVDResult namedtuple to a plain tuple INSIDE the
-    # taped call: the vjp machinery reconstructs cotangents as tuples,
-    # and mismatched pytree types break backward
-    def f(x):
-        r = jnp.linalg.svd(x, full_matrices=full_matrices,
-                           compute_uv=compute_uv)
-        return tuple(r) if compute_uv else r
-
-    return _np(_call(f, asarray(a)))
+    # (result namedtuples are normalized centrally in
+    # registry.apply_pure before the vjp)
+    return _np(_call(lambda x: jnp.linalg.svd(
+        x, full_matrices=full_matrices, compute_uv=compute_uv),
+        asarray(a)))
 
 
 def cholesky(a):
@@ -51,8 +47,7 @@ def det(a):
 
 
 def slogdet(a):
-    return _np(_call(lambda x: tuple(jnp.linalg.slogdet(x)),
-                     asarray(a)))
+    return _np(_call(jnp.linalg.slogdet, asarray(a)))
 
 
 def solve(a, b):
@@ -73,8 +68,7 @@ def eig(a):
 
 
 def eigh(a, UPLO="L"):
-    return _np(_call(lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)),
-                     asarray(a)))
+    return _np(_call(lambda x: jnp.linalg.eigh(x, UPLO=UPLO), asarray(a)))
 
 
 def eigvals(a):

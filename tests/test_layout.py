@@ -136,3 +136,29 @@ def test_resnet_nhwc_trains_under_spmd():
     y = nd.array(rng.randint(0, 10, 8).astype("f"))
     losses = [float(tr.step(x, y).asscalar()) for _ in range(4)]
     assert onp.isfinite(losses).all()
+
+
+def test_mobilenet_nhwc_equivalent_logits():
+    mx.random.seed(1)
+    a = vision.mobilenet_v2_0_25(classes=10)
+    a.initialize(mx.init.Xavier())
+    b = vision.mobilenet_v2_0_25(classes=10, layout="NHWC")
+    b.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(1)
+    x = rng.rand(2, 3, 32, 32).astype("f")
+    ref = a(nd.array(x)).asnumpy()
+    _ = b(nd.array(x.transpose(0, 2, 3, 1)))
+    _transplant(a, b)
+    got = b(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_mobilenet_v1_nhwc_builds_and_trains():
+    mx.random.seed(0)
+    net = vision.mobilenet0_25(classes=5, layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).rand(2, 32, 32, 3).astype("f"))
+    with autograd.record():
+        out = net(x)
+    out.mean().backward()
+    assert out.shape == (2, 5)
