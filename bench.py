@@ -31,10 +31,12 @@ import sys
 import time
 
 BASELINE_IMGS_PER_SEC = 363.69  # reference fp32 training, 1xV100
+SCORE_V100_FP32 = 1233.15  # scoring, fp32 b128 (perf.md:187-197)
+SCORE_V100_FP16 = 2355.04  # scoring, fp16 b128 (perf.md:199-215)
 # the reference publishes no fp16 TRAINING number; its fp16/fp32 scoring
-# ratio is 2355.04/1233.15 = 1.91x (perf.md:187-215) — applied to the fp32
-# training baseline as the fairest half-precision comparison point
-BASELINE_FP16_EST = BASELINE_IMGS_PER_SEC * 2355.04 / 1233.15
+# ratio (perf.md:187-215) applied to the fp32 training baseline is the
+# fairest half-precision comparison point
+BASELINE_FP16_EST = BASELINE_IMGS_PER_SEC * SCORE_V100_FP16 / SCORE_V100_FP32
 # ResNet-50 fwd = 4.089 GFLOP/img at 224x224 (2 FLOPs/MAC); training
 # fwd+bwd ~ 3x fwd
 TRAIN_GFLOPS_PER_IMG = 3 * 4.089
@@ -97,6 +99,101 @@ def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
     loss_val = float(jax.device_get(lval.data))
     dt = time.perf_counter() - t0
     return batch * iters / dt, loss_val
+
+
+def build_scoring(image_size=224):
+    """Build the scoring net ONCE (off-tunnel) and stage params on the
+    device; run_scoring reuses it across dtypes."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.model_zoo import vision
+    import numpy as onp
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(layout=LAYOUT)
+    cpu = jax.devices("cpu")[0]
+    shape = ((1, image_size, image_size, 3) if LAYOUT == "NHWC"
+             else (1, 3, image_size, image_size))
+    with jax.default_device(cpu):  # build off-tunnel
+        net.initialize(mx.init.Xavier())
+        with autograd.pause(train_mode=False):
+            net.forward(mx.nd.array(onp.zeros(shape, "f")))
+    params = [p for _, p in sorted(net.collect_params().items())]
+    pnds = [p._ndarray for p in params]
+    dev = jax.devices()[0]
+    vals = [jax.device_put(p._ndarray.data, dev) for p in params]
+    return net, pnds, vals, shape
+
+
+def run_scoring(batch, built, dtype=None, iters=30):
+    """Inference ("scoring") throughput: the whole measurement is ONE
+    jitted fori_loop whose carry threads an epsilon of each output back
+    into the input, so no per-iteration dispatch crosses the tunnel and
+    XLA cannot collapse identical iterations. Reference comparison:
+    perf.md:187-215 V100 scoring table."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import NDArray
+    import numpy as onp
+
+    net, pnds, vals, shape = built
+    dev = jax.devices()[0]
+    cdtype = jnp.dtype(dtype) if dtype else None
+
+    def fwd(pv, x):
+        saved = [p._data for p in pnds]
+        try:
+            for p, v in zip(pnds, pv):
+                if cdtype is not None and \
+                        jnp.issubdtype(v.dtype, jnp.floating):
+                    v = v.astype(cdtype)
+                p._data = v
+            xin = x.astype(cdtype) if cdtype is not None else x
+            with autograd.pause(train_mode=False):
+                out = net.forward(NDArray(xin))
+            return out.data.astype(jnp.float32)
+        finally:
+            for p, v in zip(pnds, saved):
+                p._data = v
+
+    def loop(pv, x):
+        def body(i, carry):
+            xc, acc = carry
+            o = fwd(pv, xc)
+            s = jnp.sum(o)
+            return xc + (1e-30 * s).astype(xc.dtype), acc + s
+
+        return lax.fori_loop(0, iters, body, (x, jnp.float32(0)))
+
+    rng = onp.random.RandomState(0)
+    bshape = (batch,) + shape[1:]
+    x = jax.device_put(jnp.asarray(rng.rand(*bshape).astype("f")), dev)
+    jloop = jax.jit(loop)
+    _, acc = jloop(vals, x)  # compile + run once
+    _ = jax.device_get(acc)
+    t0 = time.perf_counter()
+    _, acc = jloop(vals, x)
+    _ = jax.device_get(acc)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def _score_with_descent(batch, built, dtype):
+    """OOM-halving like the training phases."""
+    while batch >= 16:
+        try:
+            return run_scoring(batch, built, dtype=dtype), batch
+        except RuntimeError as e:
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                batch //= 2
+                continue
+            raise
+    raise RuntimeError("scoring failed at batch>=16")
 
 
 def mfu_pct(imgs_per_sec):
@@ -197,17 +294,40 @@ def child_main(platform):
         extra["bf16_vs_v100_fp16_train_est"] = round(
             imgs16 / BASELINE_FP16_EST, 3)
         extra["bf16_speedup_over_fp32"] = round(imgs16 / imgs32, 3)
-        print(json.dumps({
+        result = {
             "metric": f"resnet50_train_imgs_per_sec_bf16_b{b16}",
             "value": round(imgs16, 2), "unit": "img/s",
             "vs_baseline": round(imgs16 / BASELINE_IMGS_PER_SEC, 3),
-            "extra": extra}))
+            "extra": extra}
     else:
-        print(json.dumps({
+        result = {
             "metric": f"resnet50_train_imgs_per_sec_fp32_b{b32}",
             "value": round(imgs32, 2), "unit": "img/s",
             "vs_baseline": round(imgs32 / BASELINE_IMGS_PER_SEC, 3),
-            "extra": extra}))
+            "extra": extra}
+    # training results are safe NOW (the parent takes the LAST metric
+    # line) — a scoring hang/failure can no longer discard them
+    print(json.dumps(result), flush=True)
+    # inference scoring vs the reference's V100 table (perf.md:187-215);
+    # per-dtype try so an fp32 failure doesn't take bf16 down with it
+    try:
+        built = build_scoring()
+    except Exception as e:
+        print(f"[bench] scoring build failed: {e}", file=sys.stderr)
+        built = None
+    if built is not None:
+        for tag, dt_, base, base_name in (
+                ("fp32", None, SCORE_V100_FP32, "v100"),
+                ("bf16", "bfloat16", SCORE_V100_FP16, "v100_fp16")):
+            try:
+                sc, sb = _score_with_descent(128, built, dt_)
+                extra[f"score_{tag}_imgs_per_sec_b{sb}"] = round(sc, 2)
+                extra[f"score_{tag}_vs_{base_name}"] = round(sc / base, 3)
+            except Exception as e:
+                print(f"[bench] {tag} scoring failed: {e}",
+                      file=sys.stderr)
+        result["extra"] = extra
+        print(json.dumps(result), flush=True)
 
 
 def smoke_main():
