@@ -15,7 +15,8 @@ from ...nn import Sequential, HybridSequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting"]
+           "RandomLighting",
+           "RandomHue", "RandomColorJitter", "CropResize"]
 
 
 class Compose(Sequential):
@@ -172,6 +173,96 @@ class RandomSaturation(_RandomJitter):
         out = f * alpha + gray * (1 - alpha)
         return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
             else out
+
+
+class RandomHue(_RandomJitter):
+    """YIQ-rotation hue jitter (reference: transforms.py RandomHue /
+    image.py HueJitterAug matrices)."""
+
+    _tyiq = onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], "float32")
+    _ityiq = onp.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], "float32")
+
+    def forward(self, x):
+        import random as pyrandom
+
+        alpha = pyrandom.uniform(-self._val, self._val)
+        u = onp.cos(alpha * onp.pi)
+        w = onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       "float32")
+        t = onp.dot(onp.dot(self._ityiq, bt), self._tyiq).T
+        f = x.astype("float32")
+        out = nd.dot(f, nd.array(t))
+        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
+            else out
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue jitter in one transform
+    (reference: transforms.py RandomColorJitter — applies each enabled
+    jitter in random order)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        import random as pyrandom
+
+        ts = list(self._ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class CropResize(Block):
+    """Fixed crop then optional resize (reference: transforms.py
+    CropResize(x, y, width, height, size, interpolation))."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = int(x), int(y)
+        self._w, self._h = int(width), int(height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, data):
+        # (..., H, W, C): support batched input like CenterCrop
+        H, W = data.shape[-3], data.shape[-2]
+        if self._y + self._h > H or self._x + self._w > W:
+            raise ValueError(
+                f"crop ({self._x},{self._y},{self._w},{self._h}) exceeds "
+                f"image size {W}x{H}")
+        out = data[..., self._y:self._y + self._h,
+                   self._x:self._x + self._w, :]
+        if self._size is not None:
+            from ....image import imresize
+
+            size = self._size if isinstance(self._size, (list, tuple)) \
+                else (self._size, self._size)
+            if out.ndim == 3:
+                out = imresize(out, size[0], size[1],
+                               interp=self._interp)
+            else:
+                from ... import nd as _nd
+
+                out = _nd.stack(*[imresize(out[i], size[0], size[1],
+                                           interp=self._interp)
+                                  for i in range(out.shape[0])], axis=0)
+        return out
 
 
 class RandomLighting(Block):

@@ -207,3 +207,51 @@ def test_det_random_crop_keeps_valid_boxes():
     out, lab2 = aug(arr, label)
     assert lab2.shape[1] == 5
     assert (lab2[:, 1:5] >= -1e-6).all() and (lab2[:, 1:5] <= 1 + 1e-6).all()
+
+
+def test_transform_random_hue_preserves_gray():
+    """Hue rotation leaves achromatic (gray) pixels unchanged."""
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    t = transforms.RandomHue(0.4)
+    x = nd.full((4, 4, 3), 120.0)
+    out = t(x).asnumpy()
+    onp.testing.assert_allclose(out, 120.0, rtol=1e-3, atol=0.5)
+
+
+def test_transform_random_color_jitter_runs():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    t = transforms.RandomColorJitter(brightness=0.2, contrast=0.2,
+                                     saturation=0.2, hue=0.2)
+    x = nd.array(onp.random.RandomState(0).randint(
+        0, 255, (8, 8, 3)).astype("f"))
+    out = t(x)
+    assert out.shape == (8, 8, 3)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_transform_crop_resize():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    x = nd.array(onp.arange(6 * 6 * 3, dtype="f").reshape(6, 6, 3))
+    t = transforms.CropResize(1, 2, 4, 3)
+    out = t(x)
+    assert out.shape == (3, 4, 3)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy()[2:5, 1:5, :])
+    xu = nd.array(onp.random.RandomState(0).randint(
+        0, 255, (6, 6, 3)), dtype="uint8")
+    t2 = transforms.CropResize(0, 0, 4, 4, size=8)
+    assert t2(xu).shape == (8, 8, 3)
+
+
+def test_transform_crop_resize_batched_and_bounds():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    xb = nd.array(onp.arange(2 * 6 * 6 * 3, dtype="f").reshape(2, 6, 6, 3))
+    out = transforms.CropResize(1, 2, 4, 3)(xb)
+    assert out.shape == (2, 3, 4, 3)
+    onp.testing.assert_allclose(out.asnumpy(), xb.asnumpy()[:, 2:5, 1:5, :])
+    with pytest.raises(ValueError, match="exceeds"):
+        transforms.CropResize(5, 5, 4, 4)(xb)
