@@ -566,3 +566,43 @@ def test_op_shuffle_is_permutation():
     x = onp.arange(20, dtype="f")
     out = nd.shuffle(nd.array(x)).asnumpy()
     assert sorted(out.tolist()) == x.tolist()
+
+
+def test_op_gather_nd_grad_scatters():
+    data = nd.array(onp.arange(12, dtype="f").reshape(3, 4))
+    data.attach_grad()
+    idx = nd.array(onp.array([[0, 2], [1, 3]], "f"))  # rows, cols pairs
+    with autograd.record():
+        out = nd.gather_nd(data, idx)
+        loss = nd.sum(out * nd.array([2.0, 3.0]))
+    loss.backward()
+    g = data.grad.asnumpy()
+    expect = onp.zeros((3, 4), "f")
+    expect[0, 1] = 2.0
+    expect[2, 3] = 3.0
+    onp.testing.assert_allclose(g, expect)
+
+
+def test_op_take_along_axis_grad():
+    data = nd.array(onp.arange(6, dtype="f").reshape(2, 3))
+    data.attach_grad()
+    idx = nd.array(onp.array([[2], [0]], "f"))
+    with autograd.record():
+        out = nd.take_along_axis(data, idx, axis=1)
+        loss = nd.sum(out)
+    loss.backward()
+    expect = onp.zeros((2, 3), "f")
+    expect[0, 2] = 1.0
+    expect[1, 0] = 1.0
+    onp.testing.assert_allclose(data.grad.asnumpy(), expect)
+
+
+def test_op_topk_value_grad_routes_to_argmax_slots():
+    data = nd.array(onp.array([[1.0, 5.0, 3.0], [4.0, 2.0, 6.0]], "f"))
+    data.attach_grad()
+    with autograd.record():
+        vals = nd.topk(data, k=1, ret_typ="value")
+        loss = nd.sum(vals)
+    loss.backward()
+    expect = onp.array([[0, 1, 0], [0, 0, 1]], "f")
+    onp.testing.assert_allclose(data.grad.asnumpy(), expect)
