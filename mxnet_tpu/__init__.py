@@ -49,6 +49,10 @@ from . import module
 from . import module as mod
 from . import model
 from . import callback
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import library  # noqa: F401
 from . import recordio
 from . import image  # noqa: F401
 from . import rnn  # noqa: F401

@@ -13,7 +13,6 @@ import os
 import subprocess
 
 _here = os.path.dirname(os.path.abspath(__file__))
-_so_path = os.path.join(_here, "librecordio.so")
 _src_dir = os.path.join(os.path.dirname(os.path.dirname(_here)), "native")
 
 lib = None       # librecordio: frame parsing + jpeg pipeline
@@ -49,11 +48,11 @@ def _ensure_built(so_name, src_name, extra_flags=()):
 
 def _load():
     global lib
-    if _ensure_built("librecordio.so", "recordio.cc",
-                     ("-ljpeg",)) is None:
+    so = _ensure_built("librecordio.so", "recordio.cc", ("-ljpeg",))
+    if so is None:
         return
     try:
-        L = ctypes.CDLL(_so_path)
+        L = ctypes.CDLL(so)
     except OSError:
         return
     L.rio_open.restype = ctypes.c_void_p

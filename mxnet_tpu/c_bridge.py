@@ -118,10 +118,17 @@ class CPredictor:
                   for k, v in (input_shapes or {}).items()}
         self._input_names = sorted(shapes)
         self._exec = self._sym.simple_bind(grad_req="null", **shapes)
-        loaded = {**arg_params, **aux_params}
-        for name, arr in zip(self._exec.arg_names, self._exec.arg_arrays):
-            if name in loaded:
-                arr[:] = loaded[name]
+        # aux states (BatchNorm moving stats) load alongside args —
+        # leaving them at bind-time defaults silently corrupts inference;
+        # copy_params_from also rejects shape-mismatched checkpoints at
+        # load time
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        # output shapes are known at bind time (simple_bind retains its
+        # inference result), so C callers can size buffers before the
+        # first Forward — reference c_predict_api.cc keeps out_shapes on
+        # the handle from creation
+        self._out_shapes = self._exec.output_shapes
         self._outputs = None
 
     def set_input(self, key, buf, shape=None):
@@ -140,12 +147,16 @@ class CPredictor:
         return None
 
     def num_outputs(self):
-        self._ensure_forward()
-        return len(self._outputs)
+        if self._outputs is not None:
+            return len(self._outputs)
+        return len(self._out_shapes)
 
     def output_shape(self, index):
-        self._ensure_forward()
-        return tuple(int(s) for s in self._outputs[index].shape)
+        """Known from bind-time shape inference — valid before forward()
+        (reference MXPredGetOutputShape works right after MXPredCreate)."""
+        if self._outputs is not None:
+            return tuple(int(s) for s in self._outputs[index].shape)
+        return tuple(int(s) for s in self._out_shapes[index])
 
     def output_bytes(self, index):
         """Output `index` as float32 little-endian bytes (the C predict
@@ -159,14 +170,22 @@ class CPredictor:
             raise MXNetError("call forward() before reading outputs")
 
     def reshape(self, input_shapes):
-        """MXPredReshape: rebind with new input shapes, keeping weights."""
-        old = dict(zip(self._exec.arg_names, self._exec.arg_arrays))
+        """MXPredReshape: rebind with new input shapes, keeping weights
+        AND aux states (a rebind that resets BN running stats would serve
+        garbage after the first reshape)."""
+        old_args = dict(zip(self._exec.arg_names, self._exec.arg_arrays))
+        old_aux = dict(self._exec.aux_dict)
         shapes = {k: tuple(int(d) for d in v)
                   for k, v in input_shapes.items()}
         self._exec = self._sym.simple_bind(grad_req="null", **shapes)
         for name, arr in zip(self._exec.arg_names, self._exec.arg_arrays):
-            if name in old and name not in shapes and \
-                    tuple(old[name].shape) == tuple(arr.shape):
-                arr[:] = old[name]
+            if name in old_args and name not in shapes and \
+                    tuple(old_args[name].shape) == tuple(arr.shape):
+                arr[:] = old_args[name]
+        for name, arr in self._exec.aux_dict.items():
+            if name in old_aux and tuple(old_aux[name].shape) == \
+                    tuple(arr.shape):
+                arr[:] = old_aux[name]
+        self._out_shapes = self._exec.output_shapes
         self._outputs = None
         return None

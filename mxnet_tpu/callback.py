@@ -1,5 +1,13 @@
-"""Training callbacks (reference: python/mxnet/callback.py —
-Speedometer, do_checkpoint, LogValidationMetricsCallback, ProgressBar)."""
+"""Training-loop callbacks for ``Module.fit`` / ``model.fit``.
+
+Reference surface: python/mxnet/callback.py (Speedometer, do_checkpoint,
+module_checkpoint, log_train_metric, LogValidationMetricsCallback,
+ProgressBar). The call contracts are fixed by the fit loop — epoch-end
+callbacks receive ``(epoch, symbol, arg_params, aux_params)``, batch-end
+callbacks a ``BatchEndParam`` namedtuple — but the machinery here is this
+package's own: one periodic-trigger helper shared by everything periodic,
+metric formatting in one place, and wall-clock via ``perf_counter``.
+"""
 from __future__ import annotations
 
 import logging
@@ -9,107 +17,121 @@ __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
            "log_train_metric", "LogValidationMetricsCallback", "ProgressBar"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Reference: callback.py module_checkpoint."""
-    period = int(max(1, period))
+def _fires(index, period):
+    """True on every `period`-th 1-based tick of a 0-based index."""
+    return (index + 1) % period == 0
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+
+def _metric_pairs(metric):
+    """(name, value) pairs of an EvalMetric, or () when there is none."""
+    return tuple(metric.get_name_value()) if metric is not None else ()
+
+
+def _fmt_pairs(pairs):
+    return "\t".join(f"{n}={v:f}" for n, v in pairs)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving `mod` every `period` epochs
+    (reference: callback.py module_checkpoint)."""
+    period = max(1, int(period))
+
+    def _callback(epoch, sym=None, arg=None, aux=None):
+        if _fires(epoch, period):
+            mod.save_checkpoint(prefix, epoch + 1, save_optimizer_states)
 
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Reference: callback.py do_checkpoint."""
+    """Epoch-end callback writing `prefix`-symbol.json / -NNNN.params
+    every `period` epochs (reference: callback.py do_checkpoint)."""
     from .model import save_checkpoint
 
-    period = int(max(1, period))
+    period = max(1, int(period))
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def _callback(epoch, sym, arg, aux):
+        if _fires(epoch, period):
+            save_checkpoint(prefix, epoch + 1, sym, arg, aux)
 
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
-    """Reference: callback.py log_train_metric."""
+    """Batch-end callback logging the running training metric every
+    `period` batches (reference: callback.py log_train_metric)."""
 
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.nbatch % period != 0:
+            return
+        pairs = _metric_pairs(param.eval_metric)
+        if not pairs:
+            return
+        logging.info("Iter[%d] Batch[%d] %s", param.epoch, param.nbatch,
+                     _fmt_pairs((f"Train-{n}", v) for n, v in pairs))
+        if auto_reset:
+            param.eval_metric.reset()
 
     return _callback
 
 
 class Speedometer:
-    """Throughput logging (reference: callback.py Speedometer)."""
+    """Batch-end callback printing samples/sec (and optionally the
+    running metric) every `frequent` batches (reference: callback.py
+    Speedometer)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._mark = None       # perf_counter at the last report/epoch start
+        self._prev_batch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
+        if param.nbatch < self._prev_batch:
+            self._mark = None   # new epoch: timing window restarts
+        self._prev_batch = param.nbatch
+        if self._mark is None:
+            self._mark = time.perf_counter()
+            return
+        if param.nbatch % self.frequent != 0:
+            return
+        elapsed = time.perf_counter() - self._mark
+        speed = (self.frequent * self.batch_size / elapsed) if elapsed \
+            else float("inf")
+        pairs = _metric_pairs(param.eval_metric)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+                         param.epoch, param.nbatch, speed, _fmt_pairs(pairs))
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, speed)
+        self._mark = time.perf_counter()
 
 
 class LogValidationMetricsCallback:
-    """Reference: callback.py LogValidationMetricsCallback."""
+    """Eval-end callback logging every validation metric
+    (reference: callback.py LogValidationMetricsCallback)."""
 
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        for name, value in param.eval_metric.get_name_value():
+        pairs = _metric_pairs(param.eval_metric)
+        for name, value in pairs:
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
                          value)
 
 
 class ProgressBar:
-    """Reference: callback.py ProgressBar."""
+    """Batch-end callback rendering a text progress bar
+    (reference: callback.py ProgressBar)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.total = max(1, int(total))
+        self.length = int(length)
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = int(round(100.0 * count / float(self.total)))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        done = int(round(self.length * frac))
+        bar = "=" * done + "-" * (self.length - done)
+        logging.info("[%s] %d%%\r", bar, int(round(100 * frac)))

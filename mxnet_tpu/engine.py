@@ -83,18 +83,41 @@ class Engine:
             *[v.id for v in const_vars])
         mv = (ctypes.c_int64 * max(len(mutable_vars), 1))(
             *[v.id for v in mutable_vars])
+        # writer var ids only: WaitForVar barriers WRITERS of the var
+        # (its sync op is itself a reader, and readers run concurrently),
+        # so only ops holding the var mutable are provably finished when
+        # a wait on it returns — GC'ing a reader's keepalive early would
+        # free a trampoline the worker may still call
+        writer_ids = frozenset(v.id for v in mutable_vars)
         with self._lock:
             op_id = self._lib.eng_push_lane(
                 self._h, ctypes.cast(cb, ctypes.c_void_p), None, cv,
                 len(const_vars), mv, len(mutable_vars), int(priority),
                 int(lane))
             holder["op_id"] = op_id
-            self._live_cbs[op_id] = cb
+            # keepalive carries the op's WRITER var set so wait_for_var
+            # can GC it: after the wait returns, every writer of that var
+            # has completed AND its trampoline frame has returned (the
+            # native engine marks completion after the callback returns),
+            # so steady-state pipelines (IO iterators, nd.save) don't
+            # grow _live_cbs unboundedly between wait_all barriers
+            self._live_cbs[op_id] = (cb, writer_ids)
         return op_id
 
     def wait_for_var(self, v):
         """Block until all ops touching v finish; re-raise its poison."""
+        # snapshot BEFORE the barrier: an op pushed concurrently with the
+        # wait may still be running when it returns — only ops registered
+        # before the wait are provably done (same rule as wait_all)
+        with self._lock:
+            dead = [oid for oid, (_, var_ids) in self._live_cbs.items()
+                    if v.id in var_ids]
         err_op = self._lib.eng_wait_for_var(self._h, v.id)
+        # those ops have completed and their trampolines returned
+        # (Complete runs after op->fn) — drop the keepalives
+        with self._lock:
+            for oid in dead:
+                self._live_cbs.pop(oid, None)
         if err_op >= 0:
             with self._lock:
                 exc = self._exceptions.get(err_op)

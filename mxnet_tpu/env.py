@@ -93,7 +93,8 @@ KNOBS = {
         "accepted", "-", "XLA buffer aliasing (donated args)"),
     "MXNET_EXEC_MATCH_RANGE": ("accepted", "-", "XLA memory planner"),
     "MXNET_BACKWARD_DO_MIRROR": (
-        "accepted", "-", "use jax.checkpoint/remat for memory-vs-compute"),
+        "wired", "gluon CachedOp / Executor",
+        "jax.checkpoint remat: recompute activations in backward"),
     "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": ("accepted", "-", "XLA fusion"),
     "MXNET_KVSTORE_REDUCTION_NTHREADS": (
         "accepted", "-", "reduction is one compiled XLA all-reduce"),

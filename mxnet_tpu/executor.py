@@ -26,7 +26,7 @@ _LOSS_HEADS = ("softmax_output", "make_loss", "linear_regression_output",
 
 class Executor:
     def __init__(self, symbol, arg_names, arg_arrays, grad_arrays, grad_req,
-                 ctx=None, aux_names=(), aux_arrays=()):
+                 ctx=None, aux_names=(), aux_arrays=(), output_shapes=None):
         self._symbol = symbol
         self.arg_names = list(arg_names)
         self.arg_arrays = list(arg_arrays)
@@ -36,6 +36,11 @@ class Executor:
         # differentiated (reference: executor.h aux_states)
         self.aux_names = list(aux_names)
         self.aux_arrays = list(aux_arrays)
+        # bind-time inferred output shapes (reference GraphExecutor keeps
+        # them from bind) — lets predictors size buffers before forward
+        # without re-running whole-graph inference
+        self.output_shapes = (None if output_shapes is None
+                              else [tuple(s) for s in output_shapes])
         self.outputs = []
         self._ctx = ctx
         self._fwd_jit = None
@@ -60,14 +65,25 @@ class Executor:
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
         """Reference: executor.py copy_params_from."""
+        def _check(name, src, dst):
+            # copyto replaces the payload wholesale, so a mismatched
+            # checkpoint must fail HERE with a clear error, not later as
+            # an opaque jit trace error at first forward
+            if tuple(src.shape) != tuple(dst.shape):
+                raise ValueError(
+                    f"param '{name}' has shape {tuple(src.shape)} but the "
+                    f"executor binds it as {tuple(dst.shape)}")
+
         for name, array in arg_params.items():
             if name in self.arg_dict:
+                _check(name, array, self.arg_dict[name])
                 array.copyto(self.arg_dict[name])
             elif not allow_extra_params:
                 raise ValueError(f"Found name '{name}' that is not in the "
                                  "arguments")
         for name, array in (aux_params or {}).items():
             if name in self.aux_dict:
+                _check(name, array, self.aux_dict[name])
                 array.copyto(self.aux_dict[name])
             elif not allow_extra_params:
                 raise ValueError(f"Found name '{name}' that is not in the "
@@ -175,10 +191,19 @@ class Executor:
                         total = total + sum(jnp.sum(o.data) for o in outs)
                 return total
 
+        from . import env
+
+        if env.get_bool("MXNET_BACKWARD_DO_MIRROR"):
+            # reference mirror pass (src/nnvm/gradient.cc:275) — remat:
+            # backward recomputes activations instead of keeping them
+            loss_fn = jax.checkpoint(loss_fn)
+            fwd_for_vjp = jax.checkpoint(lambda v: fwd_only(v, True))
+        else:
+            fwd_for_vjp = lambda v: fwd_only(v, True)  # noqa: E731
         self._grad_jit = jax.jit(jax.grad(loss_fn))
 
         def head_vjp(vals, cots):
-            _, vjp_fn = jax.vjp(lambda v: fwd_only(v, True), vals)
+            _, vjp_fn = jax.vjp(fwd_for_vjp, vals)
             return vjp_fn(cots)[0]
 
         self._head_vjp_jit = jax.jit(head_vjp)
@@ -231,6 +256,7 @@ class Executor:
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new shapes (reference: graph_executor.cc:876).
         jit re-specializes per shape automatically; just resize buffers."""
+        changed = False
         for name, shape in kwargs.items():
             if name in self.arg_dict:
                 i = self.arg_names.index(name)
@@ -238,4 +264,14 @@ class Executor:
                 if self.grad_arrays is not None and \
                         self.grad_arrays[i] is not None:
                     self.grad_arrays[i] = nd.zeros(shape)
+                changed = True
+        if changed and self.output_shapes is not None:
+            # stale bind-time output shapes would mis-size consumer
+            # buffers; re-derive from the resized inputs
+            try:
+                _, out_shapes, _ = self._symbol.infer_shape(
+                    **{n: tuple(a.shape) for n, a in self.arg_dict.items()})
+                self.output_shapes = [tuple(s) for s in out_shapes]
+            except Exception:
+                self.output_shapes = None
         return self

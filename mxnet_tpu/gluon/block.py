@@ -248,10 +248,19 @@ class CachedOp:
     def __init__(self, block, static_alloc=False, static_shape=False,
                  inline_limit=2, forward_bulk_size=None,
                  backward_bulk_size=None):
+        from .. import env
+
         self._block = block
         self._param_list = None  # list[Parameter], fixed order
         self._out_treedefs = {}
-        self._jitted = jax.jit(self._pure, static_argnums=(0, 1))
+        fn = self._pure
+        # MXNET_BACKWARD_DO_MIRROR=1 (reference: src/nnvm/gradient.cc:275
+        # mirror pass) — on TPU the memory-vs-compute lever is remat:
+        # jax.checkpoint drops this op's forward activations and
+        # recomputes them during backward
+        if env.get_bool("MXNET_BACKWARD_DO_MIRROR"):
+            fn = jax.checkpoint(fn, static_argnums=(0, 1))
+        self._jitted = jax.jit(fn, static_argnums=(0, 1))
 
     def _ensure_params(self):
         if self._param_list is None:

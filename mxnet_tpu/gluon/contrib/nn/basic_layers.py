@@ -112,8 +112,10 @@ class SyncBatchNorm(BatchNorm):
     like BatchNorm."""
 
     def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
-                 epsilon=1e-5, axis_name="dp", **kwargs):
-        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                 epsilon=1e-5, axis_name="dp", axis=1, **kwargs):
+        # axis=-1 supports NHWC nets (TPU-preferred layout), matching
+        # the plain BatchNorm's axis parameter
+        super().__init__(axis=axis, momentum=momentum, epsilon=epsilon,
                          in_channels=in_channels, **kwargs)
         self._num_devices = num_devices
         self._axis_name = axis_name
@@ -131,7 +133,8 @@ class SyncBatchNorm(BatchNorm):
             # tape only records registered ops, so stay on that path)
             return super().hybrid_forward(F, x, gamma, beta, running_mean,
                                           running_var)
-        red = tuple(i for i in range(len(x.shape)) if i != self._axis)
+        ax = self._axis % len(x.shape)  # normalize -1 (NHWC) to positive
+        red = tuple(i for i in range(len(x.shape)) if i != ax)
         xd = x.data
         mean = jnp.mean(xd, axis=red)
         sq = jnp.mean(xd * xd, axis=red)

@@ -28,26 +28,38 @@ def _bn_axis(layout):
     return -1 if layout == "NHWC" else 1
 
 
+def _make_norm(ax, norm_layer=None, norm_kwargs=None, **extra):
+    """Instantiate a block's norm layer: BatchNorm by default; pass
+    norm_layer=gluon.contrib.nn.SyncBatchNorm (+ norm_kwargs) for
+    cross-device batch stats under SPMD training."""
+    kw = dict(norm_kwargs or {})
+    kw.setdefault("axis", ax)
+    kw.update(extra)
+    return (norm_layer or BatchNorm)(**kw)
+
+
 class BasicBlockV1(HybridBlock):
     """Reference: resnet.py BasicBlockV1."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        norm = lambda **extra: _make_norm(ax, norm_layer, norm_kwargs,
+                                          **extra)
         self.body = HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
-        self.body.add(BatchNorm(axis=ax))
+        self.body.add(norm())
         self.body.add(Activation("relu"))
         self.body.add(_conv3x3(channels, 1, channels, layout))
-        self.body.add(BatchNorm(axis=ax))
+        self.body.add(norm())
         if downsample:
             self.downsample = HybridSequential(prefix="")
             self.downsample.add(Conv2D(channels, kernel_size=1,
                                        strides=stride, use_bias=False,
                                        in_channels=in_channels,
                                        layout=layout))
-            self.downsample.add(BatchNorm(axis=ax))
+            self.downsample.add(norm())
         else:
             self.downsample = None
 
@@ -63,27 +75,29 @@ class BottleneckV1(HybridBlock):
     """Reference: resnet.py BottleneckV1."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        norm = lambda **extra: _make_norm(ax, norm_layer, norm_kwargs,
+                                          **extra)
         self.body = HybridSequential(prefix="")
         self.body.add(Conv2D(channels // 4, kernel_size=1, strides=stride,
                              layout=layout))
-        self.body.add(BatchNorm(axis=ax))
+        self.body.add(norm())
         self.body.add(Activation("relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
-        self.body.add(BatchNorm(axis=ax))
+        self.body.add(norm())
         self.body.add(Activation("relu"))
         self.body.add(Conv2D(channels, kernel_size=1, strides=1,
                              layout=layout))
-        self.body.add(BatchNorm(axis=ax))
+        self.body.add(norm())
         if downsample:
             self.downsample = HybridSequential(prefix="")
             self.downsample.add(Conv2D(channels, kernel_size=1,
                                        strides=stride, use_bias=False,
                                        in_channels=in_channels,
                                        layout=layout))
-            self.downsample.add(BatchNorm(axis=ax))
+            self.downsample.add(norm())
         else:
             self.downsample = None
 
@@ -99,12 +113,12 @@ class BasicBlockV2(HybridBlock):
     """Reference: resnet.py BasicBlockV2 (pre-activation)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = BatchNorm(axis=ax)
+        self.bn1 = _make_norm(ax, norm_layer, norm_kwargs)
         self.conv1 = _conv3x3(channels, stride, in_channels, layout)
-        self.bn2 = BatchNorm(axis=ax)
+        self.bn2 = _make_norm(ax, norm_layer, norm_kwargs)
         self.conv2 = _conv3x3(channels, 1, channels, layout)
         if downsample:
             self.downsample = Conv2D(channels, 1, stride, use_bias=False,
@@ -130,15 +144,15 @@ class BottleneckV2(HybridBlock):
     """Reference: resnet.py BottleneckV2."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = BatchNorm(axis=ax)
+        self.bn1 = _make_norm(ax, norm_layer, norm_kwargs)
         self.conv1 = Conv2D(channels // 4, kernel_size=1, strides=1,
                             use_bias=False, layout=layout)
-        self.bn2 = BatchNorm(axis=ax)
+        self.bn2 = _make_norm(ax, norm_layer, norm_kwargs)
         self.conv2 = _conv3x3(channels // 4, stride, channels // 4, layout)
-        self.bn3 = BatchNorm(axis=ax)
+        self.bn3 = _make_norm(ax, norm_layer, norm_kwargs)
         self.conv3 = Conv2D(channels, kernel_size=1, strides=1,
                             use_bias=False, layout=layout)
         if downsample:
@@ -168,7 +182,7 @@ class ResNetV1(HybridBlock):
     """Reference: resnet.py ResNetV1."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         assert layout in ("NCHW", "NHWC"), layout
@@ -181,27 +195,31 @@ class ResNetV1(HybridBlock):
             else:
                 self.features.add(Conv2D(channels[0], 7, 2, 3,
                                          use_bias=False, layout=layout))
-                self.features.add(BatchNorm(axis=ax))
+                self.features.add(_make_norm(ax, norm_layer, norm_kwargs))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i], layout=layout))
+                    in_channels=channels[i], layout=layout,
+                    norm_layer=norm_layer, norm_kwargs=norm_kwargs))
             self.features.add(GlobalAvgPool2D(layout=layout))
             self.output = Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0, layout="NCHW"):
+                    in_channels=0, layout="NCHW", norm_layer=None,
+                    norm_kwargs=None):
         layer = HybridSequential(prefix=f"stage{stage_index}_")
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
                             in_channels=in_channels, layout=layout,
+                            norm_layer=norm_layer, norm_kwargs=norm_kwargs,
                             prefix=""))
             for _ in range(layers - 1):
                 layer.add(block(channels, 1, False, in_channels=channels,
-                                layout=layout, prefix=""))
+                                layout=layout, norm_layer=norm_layer,
+                                norm_kwargs=norm_kwargs, prefix=""))
         return layer
 
     def hybrid_forward(self, F, x):
@@ -214,7 +232,7 @@ class ResNetV2(HybridBlock):
     """Reference: resnet.py ResNetV2."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         assert layout in ("NCHW", "NHWC"), layout
@@ -222,13 +240,14 @@ class ResNetV2(HybridBlock):
         ax = _bn_axis(layout)
         with self.name_scope():
             self.features = HybridSequential(prefix="")
-            self.features.add(BatchNorm(axis=ax, scale=False, center=False))
+            self.features.add(_make_norm(ax, norm_layer, norm_kwargs,
+                                         scale=False, center=False))
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
                 self.features.add(Conv2D(channels[0], 7, 2, 3,
                                          use_bias=False, layout=layout))
-                self.features.add(BatchNorm(axis=ax))
+                self.features.add(_make_norm(ax, norm_layer, norm_kwargs))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))
             in_channels = channels[0]
@@ -236,9 +255,10 @@ class ResNetV2(HybridBlock):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels, layout=layout))
+                    in_channels=in_channels, layout=layout,
+                    norm_layer=norm_layer, norm_kwargs=norm_kwargs))
                 in_channels = channels[i + 1]
-            self.features.add(BatchNorm(axis=ax))
+            self.features.add(_make_norm(ax, norm_layer, norm_kwargs))
             self.features.add(Activation("relu"))
             self.features.add(GlobalAvgPool2D(layout=layout))
             self.features.add(Flatten())

@@ -11,9 +11,7 @@ transfer stays uint8, 4x smaller than shipping float32.
 from __future__ import annotations
 
 import os
-import queue
 import struct
-import threading
 
 import numpy as onp
 
@@ -148,9 +146,20 @@ class ImageRecordIter(DataIter):
         self._offsets = onp.array(offsets, dtype=onp.int64)
         self._fp = open(path_imgrec, "rb")
         self._order = onp.arange(len(self._offsets))
-        self._queue = None
-        self._worker = None
-        self._epoch_done = False
+        # decode pipeline state: batch decodes are ENGINE ops on the IO
+        # lane (reference iter_image_recordio_2.cc hands decoded batches
+        # to the engine's IO workers). _file_var serializes record reads
+        # on the shared fp; one var per prefetch slot orders producer
+        # vs consumer on that slot.
+        from .. import engine
+
+        self._engine = engine.get()
+        self._depth = self.prefetch_buffer
+        self._slot_vars = []
+        self._slots = [None] * self._depth
+        self._nbatch = 0
+        self._next_emit = 0
+        self._next_push = 0
         self.reset()
 
     @property
@@ -189,42 +198,64 @@ class ImageRecordIter(DataIter):
             idxs = onp.concatenate([idxs, filler])
         return idxs, pad
 
-    def _produce(self, epoch_order):
-        """Worker thread: decode batches into the queue."""
+    def _make_label(self, lab):
+        """Fixed-width label row from a record header (subclass hook)."""
+        if lab.size < self.label_width:
+            lab = onp.pad(lab, (0, self.label_width - lab.size))
+        return lab[:self.label_width]
+
+    def _augment_plan(self, bs):
+        """Per-batch crop/mirror draws. Pulled OUT of the decode ops so
+        augmentation RNG is consumed in epoch order no matter how the
+        engine schedules the ops. cy/cx: -1 = center; else fraction of
+        free space /10000."""
+        crops = onp.full((bs, 3), -1, dtype=onp.int32)
+        crops[:, 2] = 0
+        if self.rand_crop:
+            crops[:, 0] = self._rng.randint(0, 10001, bs)
+            crops[:, 1] = self._rng.randint(0, 10001, bs)
+        if self.rand_mirror:
+            crops[:, 2] = self._rng.randint(0, 2, bs)
+        return crops
+
+    def _decode_job(self, idxs, pad, crops, slot):
+        """One engine op: read records, decode+augment, fill the slot."""
         C, H, W = self.data_shape
-        bs = self.batch_size
-        n = len(epoch_order)
-        nbatch = n // bs if not self.round_batch else (n + bs - 1) // bs
-        try:
-            for b in range(nbatch):
-                idxs, pad = self._pad_idxs(epoch_order[b * bs:(b + 1) * bs],
-                                           epoch_order, bs)
-                blobs, labels = [], []
-                for i in idxs:
-                    rec = self._read_record(int(self._offsets[i]))
-                    header, blob = rio.unpack(rec)
-                    lab = onp.atleast_1d(
-                        onp.asarray(header.label, dtype=onp.float32))
-                    if lab.size < self.label_width:
-                        lab = onp.pad(lab, (0, self.label_width - lab.size))
-                    labels.append(lab[:self.label_width])
-                    blobs.append(blob)
-                # cy/cx: -1 = center; else fraction of free space /10000
-                crops = onp.full((bs, 3), -1, dtype=onp.int32)
-                crops[:, 2] = 0
-                if self.rand_crop:
-                    crops[:, 0] = self._rng.randint(0, 10001, bs)
-                    crops[:, 1] = self._rng.randint(0, 10001, bs)
-                if self.rand_mirror:
-                    crops[:, 2] = self._rng.randint(0, 2, bs)
-                batch_u8 = self._decode(blobs, H, W, crops)
-                label = onp.stack(labels)
-                if self.label_width == 1:
-                    label = label[:, 0]
-                self._queue.put((batch_u8, label, pad))
-            self._queue.put(None)
-        except BaseException as e:  # surface worker failures in next()
-            self._queue.put(("error", e))
+        blobs, labels = [], []
+        for i in idxs:
+            rec = self._read_record(int(self._offsets[i]))
+            header, blob = rio.unpack(rec)
+            lab = onp.atleast_1d(
+                onp.asarray(header.label, dtype=onp.float32))
+            labels.append(self._make_label(lab))
+            blobs.append(blob)
+        batch_u8 = self._decode(blobs, H, W, crops)
+        label = onp.stack(labels)
+        if self.label_width == 1 and label.ndim == 2:
+            label = label[:, 0]
+        self._slots[slot] = (batch_u8, label, pad)
+
+    def _push_decode(self):
+        from .. import engine
+
+        b = self._next_push
+        idxs, pad, crops = self._plan[b]
+        slot = b % self._depth
+        self._engine.push(
+            lambda idxs=idxs, pad=pad, crops=crops, slot=slot:
+                self._decode_job(idxs, pad, crops, slot),
+            mutable_vars=(self._file_var, self._slot_vars[slot]),
+            lane=engine.LANE_IO)
+        self._next_push += 1
+
+    def _drain(self):
+        """Wait out in-flight decode ops (errors from an abandoned epoch
+        are dropped — reset starts fresh)."""
+        for v in self._slot_vars:
+            try:
+                self._engine.wait_for_var(v)
+            except BaseException:
+                pass
 
     def _decode(self, blobs, H, W, crops):
         from .. import _native
@@ -255,36 +286,43 @@ class ImageRecordIter(DataIter):
         return _decode_batch_python(blobs, H, W, resize_short, pcrops)
 
     def reset(self):
-        if self._worker is not None and self._worker.is_alive():
-            # drain so the worker can exit (stop on end or error sentinel)
-            while True:
-                item = self._queue.get()
-                if item is None or (isinstance(item, tuple) and
-                                    len(item) == 2 and item[0] == "error"):
-                    break
-            self._worker.join()
+        self._drain()
+        # FRESH vars each epoch: a decode error poisons its vars, and
+        # poison has no un-poison — reusing the vars would make every
+        # later epoch re-raise the stale error
+        self._file_var = self._engine.new_variable()
+        self._slot_vars = [self._engine.new_variable()
+                           for _ in range(self._depth)]
         order = self._order.copy()
         if self.shuffle:
             self._rng.shuffle(order)
-        self._queue = queue.Queue(maxsize=self.prefetch_buffer)
-        self._worker = threading.Thread(target=self._produce, args=(order,),
-                                        daemon=True)
-        self._worker.start()
-        self._epoch_done = False
+        bs = self.batch_size
+        n = len(order)
+        self._nbatch = (n + bs - 1) // bs if self.round_batch else n // bs
+        # the epoch plan (batch indices + augmentation draws) is built
+        # up front, in order; the engine ops only do IO + decode
+        self._plan = []
+        for b in range(self._nbatch):
+            idxs, pad = self._pad_idxs(order[b * bs:(b + 1) * bs], order, bs)
+            self._plan.append((idxs, pad, self._augment_plan(bs)))
+        self._slots = [None] * self._depth
+        self._next_emit = 0
+        self._next_push = 0
+        while self._next_push < min(self._depth, self._nbatch):
+            self._push_decode()
 
     def next(self):
         from .. import nd
 
-        if self._epoch_done:
+        if self._next_emit >= self._nbatch:
             raise StopIteration
-        item = self._queue.get()
-        if item is None:
-            self._epoch_done = True
-            raise StopIteration
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
-            self._epoch_done = True
-            raise item[1]
-        batch_u8, label, pad = item
+        slot = self._next_emit % self._depth
+        self._engine.wait_for_var(self._slot_vars[slot])  # re-raises errors
+        batch_u8, label, pad = self._slots[slot]
+        self._slots[slot] = None
+        self._next_emit += 1
+        if self._next_push < self._nbatch:
+            self._push_decode()  # refill the slot window
         # device-side normalize: uint8 HWC → float CHW, (x-mean)/std*scale;
         # XLA fuses this into the consumer
         x = nd.array(batch_u8)
@@ -307,37 +345,17 @@ class ImageDetRecordIter(ImageRecordIter):
                  label_pad_width=35, label_pad_value=-1.0, **kwargs):
         self._pad_value = label_pad_value
         kwargs.setdefault("label_width", label_pad_width)
+        # geometric augmentation would have to transform the boxes too;
+        # like before, the det iterator serves center-crop, no-mirror
+        kwargs["rand_crop"] = False
+        kwargs["rand_mirror"] = False
         super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
 
-    def _produce(self, epoch_order):
-        # identical pipeline; labels pad with label_pad_value instead of 0
-        C, H, W = self.data_shape
-        bs = self.batch_size
-        n = len(epoch_order)
-        nbatch = (n + bs - 1) // bs if self.round_batch else n // bs
-        try:
-            for b in range(nbatch):
-                idxs, pad = self._pad_idxs(epoch_order[b * bs:(b + 1) * bs],
-                                           epoch_order, bs)
-                blobs, labels = [], []
-                for i in idxs:
-                    rec = self._read_record(int(self._offsets[i]))
-                    header, blob = rio.unpack(rec)
-                    lab = onp.atleast_1d(
-                        onp.asarray(header.label, dtype=onp.float32))
-                    out = onp.full(self.label_width, self._pad_value,
-                                   dtype=onp.float32)
-                    out[:min(lab.size, self.label_width)] = \
-                        lab[:self.label_width]
-                    labels.append(out)
-                    blobs.append(blob)
-                crops = onp.full((bs, 3), -1, dtype=onp.int32)
-                crops[:, 2] = 0
-                batch_u8 = self._decode(blobs, H, W, crops)
-                self._queue.put((batch_u8, onp.stack(labels), pad))
-            self._queue.put(None)
-        except BaseException as e:
-            self._queue.put(("error", e))
+    def _make_label(self, lab):
+        # pad with label_pad_value (not 0 — boxes use -1 sentinel rows)
+        out = onp.full(self.label_width, self._pad_value, dtype=onp.float32)
+        out[:min(lab.size, self.label_width)] = lab[:self.label_width]
+        return out
 
 
 class LibSVMIter(DataIter):

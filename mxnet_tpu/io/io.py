@@ -10,7 +10,6 @@ like the reference's dmlc::ThreadedIter (src/io/iter_prefetcher.h:142).
 """
 from __future__ import annotations
 
-import threading
 from collections import namedtuple
 
 import numpy as onp
@@ -221,10 +220,19 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread double buffering (reference: io.py PrefetchingIter,
-    C++ analog src/io/iter_prefetcher.h:142)."""
+    """Engine-scheduled double buffering (reference: io.py PrefetchingIter,
+    C++ analog src/io/iter_prefetcher.h:142).
+
+    Each sub-iterator owns an engine variable; fetching its next batch is
+    an op pushed to the engine's IO lane with that variable mutable —
+    exactly the reference's prefetcher op (iter_prefetcher.h pushes to
+    the engine's IO thread pool). The fetch of batch k+1 overlaps the
+    consumption of batch k; ``MXNET_ENGINE_TYPE=NaiveEngine`` makes every
+    fetch synchronous at push (observable serialization)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
+        from .. import engine
+
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -232,31 +240,34 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = iters[0].batch_size
-        self.current_batch = [None for _ in iters]
+        self.current_batch = None
         self.next_batch = [None for _ in iters]
-        self.started = True
-        self.data_ready = [threading.Event() for _ in iters]
-        self.data_taken = [threading.Event() for _ in iters]
-        for e in self.data_taken:
-            e.set()
+        self._engine = engine.get()
+        self._vars = [self._engine.new_variable() for _ in iters]
+        self._push_fetches()
 
-        def prefetch(i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
+    def _fresh_vars(self):
+        """Poison is permanent on a var — after an error (or at reset)
+        the pipeline continues on fresh ones."""
+        self._vars = [self._engine.new_variable() for _ in self.iters]
+
+    def _push_fetches(self):
+        """Schedule one fetch op per sub-iterator on the IO lane."""
+        from .. import engine
+
+        for i in range(len(self.iters)):
+            def fetch(i=i):
                 try:
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch, args=[i], daemon=True)
-            for i in range(len(iters))]
-        for t in self.prefetch_threads:
-            t.start()
+            self._engine.push(fetch, mutable_vars=(self._vars[i],),
+                              lane=engine.LANE_IO)
+
+    def _wait_fetches(self):
+        for v in self._vars:
+            self._engine.wait_for_var(v)
 
     @property
     def provide_data(self):
@@ -274,34 +285,26 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        for v in self._vars:  # drain in-flight fetches before rewinding;
+            try:              # stale errors die with the abandoned epoch
+                self._engine.wait_for_var(v)
+            except BaseException:
+                pass
+        self._fresh_vars()
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._push_fetches()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
+        self._wait_fetches()
         if self.next_batch[0] is None:
             return False
         self.current_batch = DataBatch(
             sum([b.data for b in self.next_batch], []),
             sum([(b.label or []) for b in self.next_batch], []),
             self.next_batch[0].pad, self.next_batch[0].index)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._push_fetches()  # overlap the NEXT fetch with consumption
         return True
 
     def next(self):

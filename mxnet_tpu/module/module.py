@@ -35,6 +35,7 @@ class Module(BaseModule):
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
         self._context = context
+        self._group2ctxs = self._check_group2ctxs(group2ctxs, context)
         self._exec = None
         self._optimizer = None
         self._updater = None
@@ -43,6 +44,42 @@ class Module(BaseModule):
         self._aux_params = {}
         self._data_shapes = None
         self._label_shapes = None
+
+    @staticmethod
+    def _check_group2ctxs(group2ctxs, context):
+        """Reference: graph_executor.cc:1915 places each ctx_group on its
+        mapped device. One XLA computation cannot pin sub-graphs to
+        arbitrary per-group devices — the TPU-native expression of model
+        parallelism is mesh shardings (mxnet_tpu.parallel param_rules /
+        SPMDTrainer). A trivial mapping (every group on the bind context)
+        is honored; anything else fails LOUDLY instead of silently
+        training on one device (reference c_api_executor.cc:314-338)."""
+        if not group2ctxs:
+            return None
+        if isinstance(group2ctxs, dict):
+            flat = {}
+            for g, c in group2ctxs.items():
+                cs = c if isinstance(c, (list, tuple)) else [c]
+                flat[g] = list(cs)
+            distinct = {str(c) for cs in flat.values() for c in cs}
+            if context is None:
+                from ..context import current_context
+
+                base_ctxs = [current_context()]  # bind default
+            elif isinstance(context, (list, tuple)):
+                base_ctxs = list(context)
+            else:
+                base_ctxs = [context]
+            base = {str(c) for c in base_ctxs}
+            if distinct <= base and all(len(c) == 1 for c in flat.values()):
+                return flat  # every group already on the bind context
+        raise MXNetError(
+            "group2ctxs placement is not supported by the single-"
+            "computation Module: ctx_group placement maps to XLA mesh "
+            "shardings on TPU — use mxnet_tpu.parallel.SPMDTrainer("
+            "param_rules=...) (or bind every group to the module's own "
+            "context). Refusing to silently ignore a model-parallel "
+            "placement request.")
 
     @property
     def data_names(self):

@@ -1,0 +1,64 @@
+"""Automatic symbol naming (reference: python/mxnet/name.py).
+
+Every symbolic node gets a unique name at composition time. By default
+names are ``{ophint}{n}`` from a per-manager counter; a ``Prefix``
+manager namespaces everything created inside its ``with`` block, which is
+what makes reference checkpoints loadable: Gluon/Module both rely on
+stable, prefix-scoped parameter names.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+# thread-local manager stack so symbol composition in worker threads
+# (e.g. data pipelines building aug graphs) can't corrupt the main
+# thread's counters
+_scope = threading.local()
+
+
+def current():
+    """The innermost active manager (a default one if none entered)."""
+    stack = getattr(_scope, "stack", None)
+    if not stack:
+        _scope.stack = stack = [NameManager()]
+    return stack[-1]
+
+
+class NameManager:
+    """Counter-based auto-namer; also a re-entrant context manager
+    (reference: name.py NameManager)."""
+
+    def __init__(self):
+        self._counts = {}
+
+    def get(self, name, hint):
+        """Return `name` if explicit, else the next ``{hint}{n}``."""
+        if name:
+            return name
+        n = self._counts.get(hint, 0)
+        self._counts[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        if not getattr(_scope, "stack", None):
+            _scope.stack = [NameManager()]
+        _scope.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
+
+
+class Prefix(NameManager):
+    """Prepends `prefix` to every generated AND explicit name inside its
+    scope (reference: name.py Prefix — explicit names are prefixed too,
+    which is what nests checkpoint namespaces)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

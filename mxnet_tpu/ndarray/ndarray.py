@@ -562,8 +562,14 @@ def save(fname, data):
     """Save list or dict of NDArrays in the reference's magic-versioned
     binary format (src/ndarray/ndarray.cc NDArray::Save + the 0x112 list
     container), so checkpoints interoperate with reference-era tooling
-    in both directions."""
-    import struct
+    in both directions.
+
+    The file write is an engine op on the IO lane (reference
+    MXNDArraySave routes through the engine's WaitToRead deps): pushed
+    with a per-call mutable var, then waited — write failures surface
+    here, and ``MXNET_ENGINE_TYPE=NaiveEngine`` serializes the write
+    inline like every other engine op."""
+    from .. import engine
 
     if isinstance(data, NDArray):
         data = [data]
@@ -574,6 +580,30 @@ def save(fname, data):
         arrays = list(data.values())
     else:
         raise TypeError("save expects NDArray, list or dict")
+    global _SAVE_VAR
+    eng = engine.get()
+    # one reused var for all saves (serializing them like the reference's
+    # single output var) — a fresh native var per call would grow the
+    # engine's var table without bound. Keyed by engine instance: a var
+    # id from a replaced engine means nothing to the new one.
+    if _SAVE_VAR is None or _SAVE_VAR[0] is not eng:
+        _SAVE_VAR = (eng, eng.new_variable())
+    v = _SAVE_VAR[1]
+    eng.push(lambda: _write_ref_params(fname, names, arrays),
+             mutable_vars=(v,), lane=engine.LANE_IO)
+    try:
+        eng.wait_for_var(v)
+    except BaseException:
+        _SAVE_VAR = None  # poisoned — the next save starts clean
+        raise
+
+
+_SAVE_VAR = None
+
+
+def _write_ref_params(fname, names, arrays):
+    import struct
+
     with open(fname, "wb") as f:
         f.write(struct.pack("<QQQ", _LIST_MAGIC, 0, len(arrays)))
         for a in arrays:
@@ -601,7 +631,9 @@ def save(fname, data):
             flag = _DTYPE_TO_TYPE_FLAG[str(arr.dtype)]
             f.write(struct.pack("<I", _ND_V2_MAGIC))
             f.write(struct.pack("<i", 0))  # kDefaultStorage
-            f.write(struct.pack(f"<i{arr.ndim}i", arr.ndim, *arr.shape))
+            # TShape = Tuple<dim_t> with dim_t = int64: int32 ndim then
+            # int64 per dim (include/mxnet/tuple.h:704, c_api.h:62)
+            f.write(struct.pack(f"<i{arr.ndim}q", arr.ndim, *arr.shape))
             f.write(struct.pack("<ii", 1, 0))  # Context: cpu(0)
             f.write(struct.pack("<i", flag))
             if arr.dtype.byteorder == ">":
@@ -631,13 +663,16 @@ def _load_ref_params(buf):
                                  "reference-format load")
             (ndim,) = struct.unpack_from("<i", buf, off)
             off += 4
-            shape = struct.unpack_from(f"<{ndim}i", buf, off)
-            off += 4 * ndim
+            # dims are int64 (TShape's dim_t — tuple.h:704); reading
+            # int32 here would misparse every real reference checkpoint
+            shape = struct.unpack_from(f"<{ndim}q", buf, off)
+            off += 8 * ndim
         elif magic == _ND_V1_MAGIC:
-            (ndim,) = struct.unpack_from("<I", buf, off)
+            # V1 ("with int64_t TShape", ndarray.cc:1596): same layout
+            (ndim,) = struct.unpack_from("<i", buf, off)
             off += 4
-            shape = struct.unpack_from(f"<{ndim}I", buf, off)
-            off += 4 * ndim
+            shape = struct.unpack_from(f"<{ndim}q", buf, off)
+            off += 8 * ndim
         else:
             # oldest format: the magic word IS the ndim
             ndim = magic
@@ -650,7 +685,14 @@ def _load_ref_params(buf):
         n = int(onp.prod(shape)) if ndim else 1
         arr = onp.frombuffer(buf, dtype.newbyteorder("<"), n, off)
         off += dtype.itemsize * n
-        arrays.append(array(arr.reshape(shape).astype(dtype)))
+        host = arr.reshape(shape).astype(dtype)
+        if dtype.itemsize == 8 and not jax.config.x64_enabled:
+            # int64/float64 checkpoints stay host numpy: jnp.asarray
+            # with x64 disabled would silently truncate values past
+            # 2^24 (f64) / 2^31 (i64); ops promote to device on use
+            arrays.append(NDArray(onp.array(host)))
+        else:
+            arrays.append(array(host))
     (nkeys,) = struct.unpack_from("<Q", buf, off)
     off += 8
     names = []

@@ -20,10 +20,53 @@ from __future__ import annotations
 import numpy as onp
 
 from ..base import MXNetError
+from .ndarray import NDArray
 
 __all__ = ["edge_id", "dgl_adjacency", "dgl_subgraph",
            "csr_neighbor_uniform_sample", "csr_neighbor_non_uniform_sample",
            "dgl_graph_compact"]
+
+
+class _HostIdNDArray(NDArray):
+    """Dense 64-bit id payload kept as host numpy: routing it through
+    jnp.asarray with JAX x64 disabled would silently truncate to
+    float32/int32, corrupting edge/vertex ids above 2^24. Mutation and
+    copy stay numpy (the base methods assume a jax ``.at`` payload);
+    arithmetic that re-enters the device op registry promotes to device
+    dtype like any other host input."""
+
+    __slots__ = ()
+
+    def __setitem__(self, key, value):
+        from .. import autograd
+        from .ndarray import _unwrap_index
+
+        if autograd.is_recording():  # same contract as the base class
+            raise MXNetError(
+                "NDArray.__setitem__ is not supported when recording with "
+                "autograd (in-place writes cannot be taped)")
+        key = _unwrap_index(key)
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        arr = onp.array(self._data)
+        arr[key] = value
+        self._data = arr
+
+    def copy(self):
+        return _HostIdNDArray(onp.array(self._data))
+
+
+def _host_id_array(arr):
+    """Wrap a 64-bit id payload host-side (see _HostIdNDArray)."""
+    return _HostIdNDArray(onp.asarray(arr))
+
+
+def _host_id_csr(data, indices, indptr, shape):
+    """Id-exact CSR (see CSRNDArray.from_host)."""
+    from . import sparse as _sp
+
+    return _sp.CSRNDArray.from_host(onp.asarray(data, onp.float64),
+                                    indices, indptr, shape)
 
 
 def _csr_parts(graph):
@@ -48,8 +91,6 @@ def _make_csr(data, indices, indptr, shape, dtype=onp.float32):
 def edge_id(graph, u, v):
     """Edge ids (csr values) for vertex pairs; -1 where no edge exists
     (reference: dgl_graph.cc EdgeID / _contrib_edge_id)."""
-    from . import ndarray as _nd
-
     indptr, indices, data, _ = _csr_parts(graph)
     uu = onp.asarray(u.asnumpy() if hasattr(u, "asnumpy") else u,
                      onp.int64).ravel()
@@ -61,7 +102,7 @@ def edge_id(graph, u, v):
         hit = onp.nonzero(row == b)[0]
         if hit.size:
             out[i] = data[indptr[a] + hit[0]]
-    return _nd.array(out, dtype="float64")
+    return _host_id_array(out)
 
 
 def dgl_adjacency(graph):
@@ -105,14 +146,12 @@ def dgl_subgraph(graph, *vids, return_mapping=False):
         d, i, p, shape = _induced(indptr, indices, vv)
         subs.append(_make_csr(onp.ones(d.shape, onp.float32), i, p, shape))
         if return_mapping:
-            maps.append(_make_csr(d, i, p, shape, onp.float64))
+            maps.append(_host_id_csr(d, i, p, shape))
     return subs + maps if return_mapping else subs
 
 
 def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
                      max_num_vertices, probability=None, seed=0):
-    from . import ndarray as _nd
-
     indptr, indices, data, _ = _csr_parts(graph)
     rng = onp.random.RandomState(seed)
     prob = None
@@ -160,8 +199,8 @@ def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
         padded[-1] = len(visited)  # reference layout: count in last slot
         d, i, p, shape = _induced(indptr, indices,
                                   onp.asarray(visited, onp.int64))
-        out.append((_nd.array(padded.astype("float64"), dtype="float64"),
-                    _make_csr(d, i, p, shape, onp.float64)))
+        out.append((_host_id_array(padded.astype(onp.float64)),
+                    _host_id_csr(d, i, p, shape)))
     vs = [v for v, _ in out]
     gs = [g for _, g in out]
     return vs + gs
@@ -206,6 +245,8 @@ def dgl_graph_compact(*graphs_and_vids, return_mapping=False,
             arr = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy")
                               else v).ravel()
             sizes.append(int(arr[-1]) if arr.size else 0)
+    from . import sparse as _sp
+
     out = []
     for g, size in zip(graphs, sizes):
         indptr, indices, data, shape = _csr_parts(g)
@@ -213,5 +254,9 @@ def dgl_graph_compact(*graphs_and_vids, return_mapping=False,
         p = indptr[:k + 1]
         d = data[:p[-1]]
         i = indices[:p[-1]]
-        out.append(_make_csr(d, i, p, (k, k)))
+        if isinstance(g, _sp._HostCSRNDArray):
+            # id-exact input (sampler output) stays an id-exact host CSR
+            out.append(_host_id_csr(d, i, p, (k, k)))
+        else:
+            out.append(_make_csr(d, i, p, (k, k), d.dtype))
     return out

@@ -113,6 +113,17 @@ class CSRNDArray(BaseSparseNDArray):
     def stype(self):
         return "csr"
 
+    @classmethod
+    def from_host(cls, data, indices, indptr, shape):
+        """CSR whose payloads stay host-side numpy at full 64-bit width.
+
+        The normal constructor routes data through ``jnp.asarray``, which
+        with JAX x64 disabled truncates float64/int64 to 32-bit —
+        corrupting integer payloads (e.g. DGL edge ids) above 2^24. Graph
+        sampling is host work anyway (ops_dgl.py docstring), so this is
+        the public way to build an id-exact graph."""
+        return _HostCSRNDArray(data, indices, indptr, shape)
+
     @property
     def data(self):
         """The non-zero values (mirrors reference csr.data)."""
@@ -152,6 +163,51 @@ class CSRNDArray(BaseSparseNDArray):
         if isinstance(key, int):
             return NDArray(self.todense().data[key])
         raise MXNetError("csr supports int/slice row indexing only")
+
+
+class _HostCSRNDArray(CSRNDArray):
+    """CSRNDArray.from_host backing class: numpy payloads, int64 index
+    arrays, and a numpy densify so asnumpy()/todense() stay 64-bit exact
+    (the inherited jnp densify would truncate to float32)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, indptr, shape):
+        NDArray.__init__(self, onp.asarray(data))
+        self._indices = onp.asarray(indices, onp.int64)
+        self._indptr = onp.asarray(indptr, onp.int64)
+        self._sshape = tuple(int(s) for s in shape)
+        if len(self._sshape) != 2:
+            raise ValueError("CSRNDArray must be 2-D")
+
+    def todense(self):
+        m, n = self._sshape
+        out = onp.zeros((m, n), self._data.dtype)
+        rows = onp.repeat(onp.arange(m), onp.diff(self._indptr))
+        # += not =: duplicate (row, col) entries accumulate, matching the
+        # jnp .at[].add densify of the base class
+        onp.add.at(out, (rows, self._indices), self._data)
+        return NDArray(out)
+
+    def copy(self):
+        # the inherited copy would rebuild a device CSR via jnp.array,
+        # truncating the 64-bit payload and losing the host class
+        return _HostCSRNDArray(onp.array(self._data), self._indices,
+                               self._indptr, self._sshape)
+
+    def slice(self, begin, end):
+        m = self._sshape[0]
+        b, e = int(begin), int(end)
+        if b < 0:
+            b += m
+        if e < 0:
+            e += m
+        b = max(0, min(b, m))
+        e = max(b, min(e, m))
+        lo, hi = int(self._indptr[b]), int(self._indptr[e])
+        return _HostCSRNDArray(self._data[lo:hi], self._indices[lo:hi],
+                               self._indptr[b:e + 1] - self._indptr[b],
+                               (e - b, self._sshape[1]))
 
 
 class RowSparseNDArray(BaseSparseNDArray):

@@ -213,7 +213,7 @@ class Symbol:
         from .. import ndarray as nd
         from ..executor import Executor
 
-        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_shapes, out_shapes, aux_shapes = self.infer_shape(**kwargs)
         args = self.list_arguments()
         aux = self.list_auxiliary_states()
         missing = [a for a, s in zip(args, arg_shapes) if s is None] + \
@@ -227,7 +227,8 @@ class Symbol:
         aux_arrays = [_default_aux_array(n, s)
                       for n, s in zip(aux, aux_shapes)]
         return Executor(self, args, arg_arrays, grad_arrays, grad_req, ctx,
-                        aux_names=aux, aux_arrays=aux_arrays)
+                        aux_names=aux, aux_arrays=aux_arrays,
+                        output_shapes=out_shapes)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):
@@ -308,9 +309,16 @@ class Symbol:
                 "inputs": [[idx[i._name], i._output_index, 0]
                            for i in s._inputs],
             }
+            merged = {}
             if s._op is not None and s._kwargs:
-                node["attrs"] = {k: attr_str(v)
-                                 for k, v in s._kwargs.items()}
+                merged.update({k: attr_str(v)
+                               for k, v in s._kwargs.items()})
+            # user attrs (AttrScope stamps, __lr_mult__, ctx_group,
+            # __shape__/__aux__ on variables) ride in the same "attrs"
+            # dict, like reference nnvm JSON
+            merged.update({k: attr_str(v) for k, v in s._attrs.items()})
+            if merged:
+                node["attrs"] = merged
             nodes.append(node)
             row_ptr.append(row_ptr[-1] + s._num_outputs)
         heads = ([[idx[g._name], g._output_index, 0] for g in self._group]
@@ -353,15 +361,20 @@ class Symbol:
         return _make_node("transpose", [self], {"axes": axes})
 
 
-_var_counter = [0]
 
 
 def Variable(name=None, shape=None, dtype=None, init=None, **kwargs):
     """Reference: symbol.py Variable/var."""
+    from .. import attribute, name as _name_mod
+
     if name is None:
-        name = f"var{_var_counter[0]}"
-        _var_counter[0] += 1
+        # explicit variable names are used verbatim (reference var()
+        # never consults NameManager); only auto-names go through it
+        name = _name_mod.current().get(None, "var")
     s = Symbol(op=None, name=name)
+    scope_attrs = attribute.current().get(kwargs.pop("attr", None))
+    if scope_attrs:
+        s._attrs.update({k: str(v) for k, v in scope_attrs.items()})
     if shape is not None:
         s._attrs["__shape__"] = str(tuple(shape))
     return s
@@ -375,7 +388,6 @@ def Group(symbols):
     return Symbol(group=list(symbols), name="group")
 
 
-_node_counter = [0]
 
 
 def _num_outputs_for(opname, kwargs):
@@ -403,11 +415,18 @@ def _num_outputs_for(opname, kwargs):
 
 
 def _make_node(opname, inputs, kwargs, name=None):
+    from .. import attribute, name as _name_mod
+
     if name is None:
-        name = f"{opname.lower()}{_node_counter[0]}"
-        _node_counter[0] += 1
-    return Symbol(op=opname, name=name, inputs=inputs, kwargs=kwargs,
+        # per-hint counters + Prefix scoping (reference: every symbol
+        # creation resolves its name through NameManager.current)
+        name = _name_mod.current().get(None, opname.lower())
+    node = Symbol(op=opname, name=name, inputs=inputs, kwargs=kwargs,
                   num_outputs=_num_outputs_for(opname, kwargs))
+    scope_attrs = attribute.current().get(None)
+    if scope_attrs:
+        node._attrs.update(scope_attrs)
+    return node
 
 
 # op -> tensor-parameter inputs auto-created when omitted (reference:
@@ -465,7 +484,12 @@ def _sym_wrapper(opdef):
         _nb.default is not inspect.Parameter.empty else False
 
     def wrapper(*args, **kwargs):
-        name = kwargs.pop("name", None)
+        from .. import name as _name_mod
+
+        # resolve the node name exactly once: explicit names pass
+        # through (Prefix scopes prepend), None draws a per-hint counter
+        name = _name_mod.current().get(kwargs.pop("name", None),
+                                       opdef.name.lower())
         attr = kwargs.pop("attr", None)
         # bind positional args (Symbol or config) to signature names, then
         # split into Symbol inputs (kept in signature order) and config
@@ -484,9 +508,6 @@ def _sym_wrapper(opdef):
         auto = _AUTO_PARAMS.get(opdef.name)
         has_sym = any(isinstance(v, Symbol) for v in bound.values())
         if auto and has_sym:
-            if name is None:
-                name = f"{opdef.name.lower()}{_node_counter[0]}"
-                _node_counter[0] += 1
             no_bias = bool(bound.get("no_bias", no_bias_default))
             for key in auto:
                 if key in bound:
@@ -593,7 +614,10 @@ def load_json(json_str):
     built = []
     for n in nodes:
         if n["op"] == "null":
-            built.append(Variable(n["name"]))
+            v = Variable(n["name"])
+            v._attrs.update({k: str(a) for k, a in
+                             (n.get("attrs") or {}).items()})
+            built.append(v)
             continue
         inputs = []
         for entry in n["inputs"]:
@@ -629,13 +653,17 @@ def load_json(json_str):
                              for p in sig.parameters.values())
             known = set(sig.parameters)
             for k, v in attrs.items():
-                if accepts_kw or k in known:
+                if (accepts_kw or k in known) and not k.startswith("__"):
                     kwargs[k] = _parse_attr_value(v)
         node = Symbol(op=opname, name=n["name"], inputs=inputs,
                       kwargs=kwargs,
                       num_outputs=n.get(
                           "num_outputs",
                           _num_outputs_for(opname, kwargs)))
+        # non-parameter keys (user attrs, dunder hyperparams, backend
+        # knobs from reference files) are preserved as symbol attrs
+        node._attrs.update({k: str(v) for k, v in attrs.items()
+                            if k not in kwargs})
         _mark_aux_inputs(node)
         built.append(node)
     heads = [built[i] if h[1] == 0 else built[i][h[1]]

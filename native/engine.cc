@@ -78,7 +78,9 @@ class Engine {
     lane_cv_ = std::vector<std::condition_variable>(nlanes);
     // total thread count honors nthreads (MXNET_CPU_WORKER_NTHREADS):
     // auxiliary lanes (copy/IO) get 1 worker each like the reference's
-    // small copy pools, the compute lane keeps the rest
+    // small copy pools, the compute lane keeps the rest. Floor: every
+    // lane needs >=1 worker (a zero-worker lane would deadlock its
+    // queue), so with nthreads <= nlanes-1 the total is nlanes.
     int aux = nlanes - 1;
     int lane0 = nthreads > aux ? nthreads - aux : 1;
     for (int l = 0; l < nlanes; ++l) {

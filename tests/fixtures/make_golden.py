@@ -1,0 +1,164 @@
+"""Generate golden interop fixtures BYTE-BY-BYTE from the reference
+format specs — deliberately independent of mxnet_tpu's own writers, so a
+bug shared by this repo's writer+reader cannot hide (the reference pins
+its own loader the same way with tests/python/unittest/legacy_ndarray.v0).
+
+Specs transcribed from:
+- .params: src/ndarray/ndarray.cc NDArray::Save (V2 magic 0xF993fac9,
+  int32 stype, TShape = int32 ndim + int64 dims per include/mxnet/
+  tuple.h:704 with dim_t = int64 per c_api.h:62, Context = int32
+  dev_type + int32 dev_id per base.h:157, int32 type_flag, raw LE data),
+  list container ndarray.cc:1840 (uint64 0x112, uint64 reserved,
+  uint64 count, arrays, uint64 nnames, {uint64 len, bytes} names).
+- symbol JSON: nnvm graph JSON as written by 1.x-era mxnet (CamelCase op
+  names, stringified attrs) — docs/architecture note + legacy_json_util.cc.
+- .rec/.idx: dmlc recordio (magic 0xced7230a, lrec = cflag<<29 | len,
+  4-byte record padding, split records at magic collisions) +
+  python/mxnet/recordio.py IRHeader '<IfQQ'.
+
+Run from the repo root:  python tests/fixtures/make_golden.py
+"""
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# ---------------------------------------------------------------- params ---
+
+TYPE_FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6}
+
+
+def nd_v2_bytes(arr):
+    out = [struct.pack("<I", 0xF993FAC9),          # NDARRAY_V2_MAGIC
+           struct.pack("<i", 0),                   # kDefaultStorage
+           struct.pack("<i", arr.ndim)]
+    out += [struct.pack("<q", int(d)) for d in arr.shape]
+    out += [struct.pack("<ii", 1, 0),              # Context cpu(0)
+            struct.pack("<i", TYPE_FLAGS[str(arr.dtype)]),
+            arr.astype(arr.dtype.newbyteorder("<")).tobytes("C")]
+    return b"".join(out)
+
+
+def params_bytes(named):
+    out = [struct.pack("<QQ", 0x112, 0),           # list magic, reserved
+           struct.pack("<Q", len(named))]
+    out += [nd_v2_bytes(a) for _, a in named]
+    out.append(struct.pack("<Q", len(named)))
+    for n, _ in named:
+        b = n.encode()
+        out.append(struct.pack("<Q", len(b)) + b)
+    return b"".join(out)
+
+
+def golden_arrays():
+    return [
+        ("arg:fc_weight", np.arange(12, dtype=np.float32).reshape(4, 3)
+         * 0.25 - 1.0),
+        ("arg:fc_bias", np.array([0.5, -0.5, 1.25, 0.0], np.float32)),
+        # int64 payload with values past 2^32 — catches width bugs in
+        # both the dims and the data
+        ("aux:counters", np.array([2**40 + 7, -3, 1, 2**33], np.int64)),
+        ("arg:half", np.array([[1.5, -2.0]], np.float16)),
+        ("arg:bytes", np.arange(24, dtype=np.uint8).reshape(2, 3, 4)),
+    ]
+
+
+# ---------------------------------------------------------------- symbol ---
+
+def golden_symbol_json():
+    """A 1.x-style exported graph: data -> FullyConnected -> Activation,
+    CamelCase ops, stringified attrs, a user __lr_mult__ on the weight."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc_weight", "inputs": [],
+         "attrs": {"__lr_mult__": "2.0"}},
+        {"op": "null", "name": "fc_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc",
+         "attrs": {"num_hidden": "4", "no_bias": "False"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "act",
+         "attrs": {"act_type": "relu"},
+         "inputs": [[3, 0, 0]]},
+    ]
+    return json.dumps({
+        "nodes": nodes,
+        "arg_nodes": [0, 1, 2],
+        "node_row_ptr": [0, 1, 2, 3, 4, 5],
+        "heads": [[4, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]}}, indent=2)
+
+
+# ------------------------------------------------------------- recordio ---
+
+RIO_MAGIC = 0xCED7230A
+RIO_MAGIC_BYTES = struct.pack("<I", RIO_MAGIC)
+
+
+def rio_record(payload):
+    """One recordio frame stream for a payload, split at embedded magics
+    (dmlc/recordio.h: cflag 0 whole, 1 start, 2 middle, 3 end)."""
+    hits = []
+    start = 0
+    while True:
+        i = payload.find(RIO_MAGIC_BYTES, start)
+        if i < 0:
+            break
+        hits.append(i)
+        start = i + 4
+
+    def frame(cflag, part):
+        pad = (-len(part)) % 4
+        return (RIO_MAGIC_BYTES +
+                struct.pack("<I", (cflag << 29) | len(part)) +
+                part + b"\x00" * pad)
+
+    if not hits:
+        return frame(0, payload)
+    bounds = [0] + hits + [len(payload)]
+    out = []
+    n = len(hits) + 1
+    for k in range(n):
+        lo = bounds[k] + (4 if k else 0)
+        part = payload[lo:bounds[k + 1]]
+        out.append(frame(1 if k == 0 else (3 if k == n - 1 else 2), part))
+    return b"".join(out)
+
+
+def ir_pack(flag, label, rec_id, payload):
+    return struct.pack("<IfQQ", flag, label, rec_id, 0) + payload
+
+
+def golden_records():
+    return [
+        ir_pack(0, 3.0, 0, b"first record payload"),
+        # payload CONTAINING the magic word: forces the split encoding
+        ir_pack(0, 7.5, 1, b"AB" + RIO_MAGIC_BYTES + b"tail" +
+                RIO_MAGIC_BYTES),
+        ir_pack(0, -1.0, 2, b""),
+    ]
+
+
+def main():
+    with open(os.path.join(HERE, "golden_v2.params"), "wb") as f:
+        f.write(params_bytes([(n, a) for n, a in golden_arrays()]))
+    with open(os.path.join(HERE, "golden-symbol.json"), "w") as f:
+        f.write(golden_symbol_json())
+    offsets = []
+    blob = b""
+    for rec in golden_records():
+        offsets.append(len(blob))
+        blob += rio_record(rec)
+    with open(os.path.join(HERE, "golden.rec"), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(HERE, "golden.rec.idx"), "w") as f:
+        for i, off in enumerate(offsets):
+            f.write(f"{i}\t{off}\n")
+    print("wrote golden_v2.params, golden-symbol.json, golden.rec(.idx)")
+
+
+if __name__ == "__main__":
+    main()

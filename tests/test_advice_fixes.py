@@ -101,3 +101,108 @@ def test_writable_recordio_pickle_raises(tmp_path):
     r = recordio.MXRecordIO(str(tmp_path / "a.rec"), "r")
     r2 = pickle.loads(pickle.dumps(r))  # readable pickling still works
     assert r2.read() == b"hello"
+
+
+# ---- round-3 advisor findings -------------------------------------------
+
+def test_c_predictor_loads_bn_aux_states():
+    """CPredictor must load aux: prefixed params (BN moving stats) — a
+    predictor serving bind-time defaults (mean 0 / var 1) is silently
+    wrong for any exported model with BatchNorm (ADVICE r3 high)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.c_bridge import CPredictor
+
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn0", fix_gamma=False)
+    rng = onp.random.RandomState(3)
+    gamma = rng.rand(6).astype("f") + 0.5
+    beta = rng.randn(6).astype("f")
+    mmean = rng.randn(6).astype("f") * 2      # far from default 0
+    mvar = rng.rand(6).astype("f") * 5 + 1    # far from default 1
+    params = {"arg:bn0_gamma": nd.array(gamma),
+              "arg:bn0_beta": nd.array(beta),
+              "aux:bn0_moving_mean": nd.array(mmean),
+              "aux:bn0_moving_var": nd.array(mvar)}
+    buf = nd.save_tobuffer(params) if hasattr(nd, "save_tobuffer") else None
+    if buf is None:
+        import tempfile, os as _os
+        fd, path = tempfile.mkstemp(suffix=".params")
+        _os.close(fd)
+        nd.save(path, params)
+        with open(path, "rb") as f:
+            buf = f.read()
+        _os.unlink(path)
+    pred = CPredictor(bn.tojson(), buf, input_shapes={"data": (2, 6)})
+    x = rng.randn(2, 6).astype("f")
+    pred.set_input("data", x.tobytes())
+    pred.forward()
+    got = onp.frombuffer(pred.output_bytes(0), "f").reshape(2, 6)
+    want = gamma * (x - mmean) / onp.sqrt(mvar + 1e-3) + beta
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # reshape keeps the loaded aux states, not bind-time defaults
+    pred.reshape({"data": (4, 6)})
+    x2 = rng.randn(4, 6).astype("f")
+    pred.set_input("data", x2.tobytes())
+    pred.forward()
+    got2 = onp.frombuffer(pred.output_bytes(0), "f").reshape(4, 6)
+    want2 = gamma * (x2 - mmean) / onp.sqrt(mvar + 1e-3) + beta
+    onp.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+
+
+def test_c_predictor_output_shape_before_forward():
+    """Output shapes come from bind-time inference — available right
+    after create, like the reference MXPredGetOutputShape (ADVICE r3)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.c_bridge import CPredictor
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=7)
+    pred = CPredictor(fc.tojson(), b"", input_shapes={"data": (5, 3)})
+    assert pred.num_outputs() == 1
+    assert pred.output_shape(0) == (5, 7)  # no forward() yet
+
+
+def test_dgl_edge_ids_exact_past_2_24():
+    """64-bit edge ids survive the op outputs exactly: float32 rounds
+    16777217 to 16777216 (ADVICE r3 medium)."""
+    from mxnet_tpu.ndarray import sparse as sp
+    from mxnet_tpu.ndarray.contrib import (edge_id, dgl_subgraph,
+                                           dgl_graph_compact)
+
+    big = float(2**24 + 1)
+    data = onp.asarray([big, big + 2, big + 4, big + 6], onp.float64)
+    indices = onp.asarray([1, 0, 2, 1], onp.int64)
+    indptr = onp.asarray([0, 1, 3, 4], onp.int64)
+    # the public id-exact construction path (the plain constructor's
+    # device payload would round float64 through float32)
+    g = sp.CSRNDArray.from_host(data, indices, indptr, (3, 3))
+    out = edge_id(g, nd.array([0, 1]), nd.array([1, 2])).asnumpy()
+    assert out.dtype == onp.float64
+    assert out[0] == big          # exact, not 2^24
+    assert out[1] == big + 4
+    # densify stays exact too (inherited jnp todense would truncate)
+    dense = g.asnumpy()
+    assert dense.dtype == onp.float64
+    assert dense[0, 1] == big and dense[2, 1] == big + 6
+    subs = dgl_subgraph(g, nd.array([0, 1, 2]), return_mapping=True)
+    mapping = subs[1]
+    vals = mapping.data.asnumpy()
+    assert vals.dtype == onp.float64
+    # mapping holds parent edge id + 1 — positions, small; but its
+    # payload container must be 64-bit safe end to end
+    assert mapping._indices.dtype == onp.int64
+    assert mapping.asnumpy().dtype == onp.float64
+    # copy()/slice keep the host class and exact payload
+    cp = g.copy()
+    assert cp.asnumpy()[0, 1] == big
+    row01 = g.slice(0, 2)
+    assert row01.data.asnumpy()[0] == big
+    # id arrays stay mutable (numpy payload, not jax .at)
+    ids = edge_id(g, nd.array([0, 1]), nd.array([1, 2]))
+    ids[0] = -1.0
+    assert ids.asnumpy()[0] == -1 and ids.asnumpy()[1] == big + 4
+    # compact preserves id exactness instead of re-truncating to fp32
+    compacted = dgl_graph_compact(g, nd.array([0.0, 1.0, 2.0, 3.0]),
+                                  graph_sizes=[3])[0]
+    assert compacted.data.asnumpy().dtype == onp.float64
+    assert compacted.data.asnumpy()[0] == big

@@ -1,0 +1,83 @@
+"""MXNET_BACKWARD_DO_MIRROR (remat) wiring + group2ctxs semantics
+(reference: src/nnvm/gradient.cc:275 mirror pass; c_api_executor.cc:314
+group2ctx placement)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.module import Module
+
+
+def _grads_of_hybrid_net(monkeypatch, mirror):
+    if mirror:
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    else:
+        monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(0).randn(8, 5).astype("f"))
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).mean()
+    loss.backward()
+    # positional, not by name: gluon's block counters are process-global
+    # so the two nets get different auto prefixes
+    return [p.grad().asnumpy()
+            for _, p in sorted(net.collect_params().items())]
+
+
+def test_mirror_gradients_match_baseline(monkeypatch):
+    """Remat changes memory/compute, NEVER values."""
+    base = _grads_of_hybrid_net(monkeypatch, mirror=False)
+    mirrored = _grads_of_hybrid_net(monkeypatch, mirror=True)
+    assert len(base) == len(mirrored) and base
+    for b, m in zip(base, mirrored):
+        onp.testing.assert_allclose(m, b, rtol=1e-5, atol=1e-6)
+
+
+def test_mirror_inserts_remat_in_executor_backward(monkeypatch):
+    """The backward jaxpr carries the remat primitive when the knob is
+    set — the recompute-count proxy for 'activations are mirrored'."""
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=8)
+    out = sym.LinearRegressionOutput(sym.Activation(fc, act_type="tanh"),
+                                     sym.Variable("label"))
+    ex = out.simple_bind(data=(4, 3), label=(4, 8))
+    ex._ensure_fwd()
+    vals = [a.data for a in ex.arg_arrays + ex.aux_arrays]
+    jaxpr = str(ex._grad_jit.trace(vals).jaxpr)
+    assert "remat" in jaxpr
+    # and without the knob there is no remat
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
+    ex2 = out.simple_bind(data=(4, 3), label=(4, 8))
+    ex2._ensure_fwd()
+    assert "remat" not in str(ex2._grad_jit.trace(vals).jaxpr)
+
+
+def test_group2ctxs_nontrivial_raises():
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        fc1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    with mx.AttrScope(ctx_group="dev2"):
+        out = sym.FullyConnected(fc1, name="fc2", num_hidden=2)
+    with pytest.raises(MXNetError, match="group2ctxs"):
+        Module(out, context=mx.cpu(),
+               group2ctxs={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+
+
+def test_group2ctxs_trivial_mapping_accepted():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=2)
+    m = Module(fc, label_names=[], context=mx.cpu(0),
+               group2ctxs={"dev1": mx.cpu(0)})
+    m.bind(data_shapes=[("data", (2, 3))])
+    m.init_params()
+    m.forward(mx.io.DataBatch(data=[nd.ones((2, 3))]), is_train=False)
+    assert m.get_outputs()[0].shape == (2, 2)

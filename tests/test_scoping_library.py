@@ -1,0 +1,120 @@
+"""NameManager/Prefix, AttrScope, and mx.library dynamic op libs
+(reference: python/mxnet/name.py, attribute.py, library.py +
+tests/python/unittest/test_symbol.py name/attr cases)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_auto_names_per_hint_counter():
+    a = sym.Variable("data")
+    fc1 = sym.FullyConnected(a, num_hidden=4)
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=4)
+    # per-hint counters like the reference (NOT one global counter)
+    assert fc1.name.startswith("fully_connected")
+    assert act.name.startswith("activation")
+    int(fc2.name[len("fully_connected"):])  # numeric suffix
+    assert fc1.name != fc2.name
+
+
+def test_prefix_scopes_names():
+    with mx.name.Prefix("block1_"):
+        v = sym.Variable(None)
+        fc = sym.FullyConnected(sym.Variable("data"), name="fc",
+                                num_hidden=2)
+    assert v.name.startswith("block1_var")
+    # explicit op names are prefixed too (reference Prefix.get) — this is
+    # what namespaces checkpoints
+    assert fc.name == "block1_fc"
+    # explicit VARIABLE names are used verbatim (reference var())
+    assert "data" in fc.list_arguments()
+    # auto-created params inherit the scoped node name
+    assert "block1_fc_weight" in fc.list_arguments()
+    # outside the scope the prefix is gone
+    assert sym.Variable(None).name.startswith("var")
+
+
+def test_name_manager_nesting_restores():
+    outer = mx.name.NameManager()
+    with outer:
+        n1 = sym.Variable(None).name
+        with mx.name.Prefix("in_"):
+            n2 = sym.Variable(None).name
+        n3 = sym.Variable(None).name
+    assert n2.startswith("in_")
+    assert not n3.startswith("in_")
+    assert n1 != n3  # same manager, counter advanced
+
+
+def test_attr_scope_stamps_nodes():
+    with mx.AttrScope(ctx_group="dev1", __lr_mult__="2.0"):
+        v = sym.Variable("w")
+        fc = sym.FullyConnected(sym.Variable("data"), weight=v,
+                                num_hidden=2)
+    assert v.attr("ctx_group") == "dev1"
+    assert fc.attr("__lr_mult__") == "2.0"
+    # nested scopes merge, inner wins
+    with mx.AttrScope(a="1", b="1"):
+        with mx.AttrScope(b="2"):
+            s = sym.Variable("x")
+    assert s.attr("a") == "1" and s.attr("b") == "2"
+    # outside any scope: no stamps
+    assert sym.Variable("y").attr("ctx_group") is None
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(ValueError, match="strings"):
+        mx.AttrScope(lr_mult=2.0)
+
+
+def test_attr_scope_survives_json_roundtrip():
+    with mx.AttrScope(ctx_group="dev7"):
+        fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2)
+    loaded = sym.load_json(fc.tojson())
+    # find the fc node in the loaded graph
+    node = loaded if loaded.name == fc.name else None
+    assert node is not None, f"fc node lost: {loaded.name}"
+    assert node.attr("ctx_group") == "dev7"
+
+
+OPLIB = '''
+import jax.numpy as jnp
+
+
+def register_ops(registry):
+    @registry.register("scaled_shift", namespaces=("nd", "sym"))
+    def scaled_shift(x, scale=2.0, shift=0.0):
+        """y = x * scale + shift (test op library)."""
+        return x * scale + shift
+'''
+
+
+def test_library_load_registers_ops(tmp_path):
+    p = tmp_path / "myops.py"
+    p.write_text(OPLIB)
+    mod = mx.library.load(str(p))
+    assert mod is mx.library.load(str(p))  # idempotent
+    assert str(p) in mx.library.loaded_libraries()
+    x = nd.array(onp.arange(4, dtype="f"))
+    y = nd.scaled_shift(x, scale=3.0, shift=1.0)
+    onp.testing.assert_allclose(y.asnumpy(), onp.arange(4) * 3 + 1)
+    # symbol namespace picked the op up too
+    s = sym.scaled_shift(sym.Variable("data"), scale=2.0)
+    out = s.eval_with({"data": x})
+    onp.testing.assert_allclose(out.asnumpy(), onp.arange(4) * 2)
+
+
+def test_library_load_errors():
+    with pytest.raises(MXNetError, match="not found"):
+        mx.library.load("/nonexistent/lib.so")
+
+
+def test_library_requires_hook(tmp_path):
+    p = tmp_path / "empty.py"
+    p.write_text("x = 1\n")
+    with pytest.raises(MXNetError, match="register_ops"):
+        mx.library.load(str(p))
