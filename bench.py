@@ -338,6 +338,76 @@ def smoke_main():
                       "vs_baseline": 0.0}))
 
 
+def io_main():
+    """BENCH_MODE=io: input-pipeline throughput — synthetic ImageNet-ish
+    .rec -> ImageRecordIter decode + random-crop/mirror + batch, host
+    only (no TPU). The number to beat is the chip's consumption rate
+    from the training bench (reference: iter_image_recordio_2.cc is
+    sized to feed multiple GPUs)."""
+    import tempfile
+
+    force_cpu_platform()  # keep jnp math (mean/std normalize) off-tunnel
+    import numpy as onp
+
+    from mxnet_tpu import io as mxio, recordio
+
+    n = int(os.environ.get("BENCH_IO_IMAGES", "1024"))
+    batch = int(os.environ.get("BENCH_IO_BATCH", "128"))
+    threads = int(os.environ.get("BENCH_IO_THREADS",
+                                 str(os.cpu_count() or 4)))
+    side = 256  # stored size; decode crops to 224
+    rec = os.path.join(tempfile.mkdtemp(prefix="bench_io_"), "syn.rec")
+    from PIL import Image
+    from io import BytesIO
+
+    rng = onp.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(rec + ".idx", rec, "w")
+    # a handful of distinct JPEGs cycled n times: realistic decode cost
+    # without minutes of synthetic-data generation
+    blobs = []
+    for i in range(32):
+        img = Image.fromarray(
+            rng.randint(0, 255, (side, side, 3), "uint8"))
+        buf = BytesIO()
+        img.save(buf, format="JPEG", quality=90)
+        blobs.append(buf.getvalue())
+    for i in range(n):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 1000), i, 0),
+            blobs[i % len(blobs)]))
+    w.close()
+
+    it = mxio.ImageRecordIter(
+        rec, data_shape=(3, 224, 224), batch_size=batch,
+        path_imgidx=rec + ".idx", shuffle=True, rand_crop=True,
+        rand_mirror=True, mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.1, std_b=57.4,
+        preprocess_threads=threads, prefetch_buffer=4)
+    seen = 0
+    for b in it:  # warmup epoch (JIT of the normalize, page cache)
+        seen += b.data[0].shape[0]
+    it.reset()
+    t0 = time.perf_counter()
+    seen = 0
+    for b in it:
+        b.data[0].wait_to_read()
+        seen += b.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    imgs = seen / dt
+    print(json.dumps({
+        "metric": "image_record_iter_imgs_per_sec",
+        "value": round(imgs, 2), "unit": "img/s", "vs_baseline": 0.0,
+        "extra": {"images": seen, "batch": batch,
+                  "preprocess_threads": threads,
+                  "host_cpus": os.cpu_count(),
+                  "imgs_per_sec_per_core": round(imgs / max(
+                      1, os.cpu_count() or 1), 2),
+                  "decode": "jpeg 256->224 rand-crop+mirror+normalize",
+                  "note": "decode scales ~linearly in the native thread "
+                          "pool; a real TPU-vM host has ~100+ cores vs "
+                          "this box"}}))
+
+
 # --------------------------------------------------------------- parent ---
 
 def _attempt(platform, timeout):
@@ -372,6 +442,9 @@ def main():
         return
     if SMOKE:
         smoke_main()
+        return
+    if os.environ.get("BENCH_MODE") == "io":
+        io_main()
         return
     # worst-case budget 3*480 + 2*60 + 240 ≈ 28 min if every stage
     # times out — the goal is that a hung tunnel still ends in a

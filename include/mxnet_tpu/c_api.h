@@ -70,6 +70,78 @@ int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
                     uint32_t size /* #floats */);
 int MXPredFree(PredictorHandle handle);
 
+/* ---- Symbol API (graph construction; c_api_symbolic.cc surface) ------ */
+
+typedef void* SymbolHandle;
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+/* Creates an operator with bound params; attach inputs with
+ * MXSymbolCompose before binding. Param values are stringified like the
+ * reference ("4", "relu", "(3, 3)"). */
+int MXSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out);
+/* Composes in place: after this call `sym` is the finished graph node.
+ * keys == NULL means positional inputs. */
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args);
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+/* *out_json points at thread-local storage valid until this thread's
+ * next MXSymbolSaveToJSON. */
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+/* Name arrays point at thread-local storage valid until this thread's
+ * next MXSymbolList* call. */
+int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_size,
+                          const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t* out_size,
+                                const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_size,
+                        const char*** out_array);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- Executor API (training; c_api_executor.cc surface) -------------- */
+
+typedef void* ExecutorHandle;
+
+/* grad_req: "write" | "add" | "null". Shapes use the same CSR layout as
+ * MXPredCreate. */
+int MXExecutorSimpleBind(SymbolHandle sym, const char* grad_req,
+                         uint32_t num_input, const char** input_keys,
+                         const uint32_t* input_shape_indptr,
+                         const int64_t* input_shape_data,
+                         ExecutorHandle* out);
+/* Borrow a bound array: kind "arg" | "grad" | "aux". The handle aliases
+ * executor storage (copy into it to feed the next forward) and must be
+ * released with MXNDArrayFree. */
+int MXExecutorArgArray(ExecutorHandle exec, const char* kind,
+                       const char* name, NDArrayHandle* out);
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+/* Output array points at the same thread-local storage as
+ * MXImperativeInvoke; do not free the handles. */
+int MXExecutorOutputs(ExecutorHandle exec, int* num_outputs,
+                      NDArrayHandle** outputs);
+/* Gradients of the bound loss head(s) land in the "grad" arrays. */
+int MXExecutorBackward(ExecutorHandle exec);
+int MXExecutorFree(ExecutorHandle exec);
+
+/* ---- KVStore API (c_api.cc MXKVStore* surface) ----------------------- */
+
+typedef void* KVStoreHandle;
+
+int MXKVStoreCreate(const char* type /* "local" | "device" | ... */,
+                    KVStoreHandle* out);
+int MXKVStoreSetOptimizer(KVStoreHandle kv, const char* opt_name,
+                          uint32_t num_param, const char** keys,
+                          const char** vals);
+int MXKVStoreInit(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+/* Pulls INTO the given arrays in place. */
+int MXKVStorePull(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* outs, int priority);
+int MXKVStoreFree(KVStoreHandle kv);
+
 #ifdef __cplusplus
 }
 #endif

@@ -21,7 +21,12 @@ from .ndarray import NDArray
 from .ndarray.ndarray import _TYPE_FLAG_TO_DTYPE, _DTYPE_TO_TYPE_FLAG
 
 __all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_from_bytes",
-           "nd_to_bytes", "invoke", "wait_all", "CPredictor"]
+           "nd_to_bytes", "invoke", "wait_all", "CPredictor",
+           "sym_var", "sym_create_atomic", "sym_compose", "sym_from_json",
+           "sym_to_json", "sym_list", "exec_simple_bind", "exec_array",
+           "exec_forward", "exec_backward", "exec_outputs",
+           "kv_create", "kv_set_optimizer", "kv_init", "kv_push",
+           "kv_pull"]
 
 
 def nd_create(shape, dtype_flag):
@@ -189,3 +194,152 @@ class CPredictor:
         self._out_shapes = self._exec.output_shapes
         self._outputs = None
         return None
+
+
+# ---------------------------------------------------------------- symbol ---
+# Symbol handles on the C side are one-element lists ("cells"): the
+# reference's MXSymbolCompose mutates its handle in place
+# (src/c_api/c_api_symbolic.cc Compose), and a cell lets the bridge swap
+# the underlying Symbol while the C caller keeps one stable pointer.
+
+
+class _AtomicOp:
+    """An operator with bound params awaiting composition (reference:
+    MXSymbolCreateAtomicSymbol before MXSymbolCompose)."""
+
+    __slots__ = ("op", "params")
+
+    def __init__(self, op, params):
+        self.op = op
+        self.params = params
+
+
+def sym_var(name):
+    from . import symbol as sym_mod
+
+    return [sym_mod.Variable(name)]
+
+
+def sym_create_atomic(op_name, keys, vals):
+    from . import symbol as sym_mod
+
+    if not hasattr(sym_mod, op_name):
+        raise MXNetError(f"unknown operator '{op_name}'")
+    params = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    return [_AtomicOp(op_name, params)]
+
+
+def sym_compose(cell, name, keys, arg_cells):
+    """MXSymbolCompose: attach inputs, materializing the graph node."""
+    from . import symbol as sym_mod
+
+    node = cell[0]
+    if not isinstance(node, _AtomicOp):
+        raise MXNetError("handle was already composed")
+    fn = getattr(sym_mod, node.op)
+    inputs = [c[0] for c in arg_cells]
+    if any(isinstance(i, _AtomicOp) for i in inputs):
+        raise MXNetError("composition argument is not composed yet")
+    kwargs = dict(node.params)
+    if name:
+        kwargs["name"] = name
+    if keys:  # named inputs (reference kwarg composition)
+        for k, s in zip(keys, inputs):
+            kwargs[k] = s
+        cell[0] = fn(**kwargs)
+    else:
+        cell[0] = fn(*inputs, **kwargs)
+    return None
+
+
+def sym_from_json(js):
+    from . import symbol as sym_mod
+
+    return [sym_mod.load_json(js)]
+
+
+def sym_to_json(cell):
+    return _composed(cell).tojson()
+
+
+def _composed(cell):
+    s = cell[0]
+    if isinstance(s, _AtomicOp):
+        raise MXNetError("symbol is not composed yet (call MXSymbolCompose)")
+    return s
+
+
+def sym_list(cell, kind):
+    s = _composed(cell)
+    if kind == "arguments":
+        return list(s.list_arguments())
+    if kind == "aux":
+        return list(s.list_auxiliary_states())
+    if kind == "outputs":
+        return list(s.list_outputs())
+    raise MXNetError(f"unknown list kind '{kind}'")
+
+
+# -------------------------------------------------------------- executor ---
+
+def exec_simple_bind(cell, grad_req, input_shapes):
+    shapes = {k: tuple(int(d) for d in v) for k, v in input_shapes.items()}
+    return _composed(cell).simple_bind(grad_req=grad_req, **shapes)
+
+
+def exec_array(ex, kind, name):
+    """Borrow a bound array by name: kind arg|grad|aux. The returned
+    handle aliases the executor's storage, so MXNDArraySyncCopyFromCPU
+    into it feeds the next forward (reference: executor arg_dict)."""
+    table = {"arg": ex.arg_dict, "grad": ex.grad_dict,
+             "aux": ex.aux_dict}.get(kind)
+    if table is None:
+        raise MXNetError(f"unknown array kind '{kind}'")
+    if name not in table:
+        raise MXNetError(f"no {kind} array named '{name}'")
+    return table[name]
+
+
+def exec_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+    return None
+
+
+def exec_outputs(ex):
+    return list(ex.outputs)
+
+
+def exec_backward(ex):
+    ex.backward()
+    return None
+
+
+# --------------------------------------------------------------- kvstore ---
+
+def kv_create(kind):
+    from . import kvstore as kvs
+
+    return kvs.create(kind)
+
+
+def kv_set_optimizer(kv, opt_name, keys, vals):
+    from . import optimizer as opt_mod
+
+    params = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    kv.set_optimizer(opt_mod.create(opt_name, **params))
+    return None
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+    return None
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=priority)
+    return None
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=priority)
+    return None

@@ -580,25 +580,30 @@ def save(fname, data):
         arrays = list(data.values())
     else:
         raise TypeError("save expects NDArray, list or dict")
-    global _SAVE_VAR
     eng = engine.get()
-    # one reused var for all saves (serializing them like the reference's
-    # single output var) — a fresh native var per call would grow the
-    # engine's var table without bound. Keyed by engine instance: a var
-    # id from a replaced engine means nothing to the new one.
-    if _SAVE_VAR is None or _SAVE_VAR[0] is not eng:
-        _SAVE_VAR = (eng, eng.new_variable())
-    v = _SAVE_VAR[1]
+    # vars come from a free-list so the engine's var table stays bounded
+    # at peak save concurrency; concurrent saves get DISTINCT vars (no
+    # false ordering, and one save's failure can't poison another's op)
+    with _SAVE_POOL_LOCK:
+        v = None
+        while _SAVE_POOL:
+            e, cand = _SAVE_POOL.pop()
+            if e is eng:  # vars from a replaced engine mean nothing here
+                v = cand
+                break
+        if v is None:
+            v = eng.new_variable()
     eng.push(lambda: _write_ref_params(fname, names, arrays),
              mutable_vars=(v,), lane=engine.LANE_IO)
-    try:
-        eng.wait_for_var(v)
-    except BaseException:
-        _SAVE_VAR = None  # poisoned — the next save starts clean
-        raise
+    eng.wait_for_var(v)  # a failure leaves the poisoned var un-pooled
+    with _SAVE_POOL_LOCK:
+        _SAVE_POOL.append((eng, v))
 
 
-_SAVE_VAR = None
+import threading as _threading  # noqa: E402
+
+_SAVE_POOL_LOCK = _threading.Lock()
+_SAVE_POOL = []
 
 
 def _write_ref_params(fname, names, arrays):

@@ -614,7 +614,11 @@ def load_json(json_str):
     built = []
     for n in nodes:
         if n["op"] == "null":
-            v = Variable(n["name"])
+            # Symbol directly, NOT Variable(): the file's attrs are the
+            # whole truth — an ambient AttrScope must not stamp extra
+            # attrs onto a deserialized graph (the reference's C-API
+            # load never consults AttrScope)
+            v = Symbol(op=None, name=n["name"])
             v._attrs.update({k: str(a) for k, a in
                              (n.get("attrs") or {}).items()})
             built.append(v)

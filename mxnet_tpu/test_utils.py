@@ -142,14 +142,88 @@ def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-4, eps=1e-3):
                             names=(f"analytic[{i}]", f"numeric[{i}]"))
 
 
-def check_consistency(fn, inputs, rtol=1e-4, atol=1e-5):
-    """Run `fn` eagerly and under jax.jit and compare — the rebuild's
-    analog of the reference's CPU-vs-GPU check_consistency oracle."""
+_TOLS = {
+    # dtype -> (rtol, atol): the reference's per-dtype tolerance ladder
+    # (test_utils.py get_tols / default_rtols). bfloat16 has 8 mantissa
+    # bits, float16 has 10 — bf16 gets the loosest rungs.
+    "float64": (1e-12, 1e-14),
+    "float32": (1e-5, 1e-7),
+    "float16": (1e-2, 1e-4),
+    "bfloat16": (4e-2, 1e-3),
+    "int64": (0, 0), "int32": (0, 0), "int8": (0, 0), "uint8": (0, 0),
+    "bool": (0, 0),
+}
+
+
+def default_tols(dtype):
+    """(rtol, atol) for comparisons at `dtype` (reference: get_tols)."""
+    return _TOLS.get(str(onp.dtype(dtype) if dtype != "bfloat16"
+                         else "bfloat16"), (1e-5, 1e-7))
+
+
+def effective_dtype(x):
+    """dtype name of an NDArray/array, normalizing bfloat16."""
+    d = getattr(x, "dtype", None)
+    return "bfloat16" if "bfloat16" in str(d) else str(onp.dtype(d))
+
+
+def with_seed(seed=None):
+    """Per-test deterministic seeding with the seed printed on failure
+    (reference: common.py with_seed — the harness every reference
+    unittest runs under)."""
+    import functools
+    import os
+    import sys
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from . import random as mx_random
+
+            this = seed if seed is not None else \
+                int(os.environ.get("MXNET_TEST_SEED",
+                                   onp.random.randint(0, 2**31)))
+            onp.random.seed(this)
+            mx_random.seed(this)
+            try:
+                return fn(*args, **kwargs)
+            except BaseException:
+                print(f"*** with_seed: test failed with seed={this}; "
+                      f"reproduce with MXNET_TEST_SEED={this} ***",
+                      file=sys.stderr)
+                raise
+
+        return wrapper
+
+    return deco
+
+
+def _cast_for(dtype, arr):
+    import jax.numpy as jnp
+
+    if dtype == "bfloat16":
+        return jnp.asarray(arr).astype(jnp.bfloat16)
+    return onp.asarray(arr).astype(dtype)
+
+
+def check_consistency(fn, inputs, rtol=None, atol=None, dtype="float32",
+                      ref_fn=None, compare_with_fp32=True):
+    """Run `fn` eagerly and under jax.jit at `dtype` and compare — the
+    rebuild's analog of the reference's CPU-vs-GPU check_consistency
+    oracle (tests/python/gpu/test_operator_gpu.py re-runs the whole CPU
+    suite through it). With `ref_fn` (or for non-fp32 dtypes) the result
+    is additionally checked against the float32 eager run within the
+    dtype's tolerance rung."""
     import jax
 
     from . import nd
 
-    nds = [nd.array(onp.asarray(a, dtype="float32")) for a in inputs]
+    if rtol is None or atol is None:
+        dr, da = default_tols(dtype)
+        rtol = dr if rtol is None else rtol
+        atol = da if atol is None else atol
+    nds = [nd.NDArray(jax.numpy.asarray(_cast_for(dtype, a)))
+           if not isinstance(a, nd.NDArray) else a for a in inputs]
     eager = fn(*nds)
     eager_list = eager if isinstance(eager, (list, tuple)) else [eager]
 
@@ -160,8 +234,20 @@ def check_consistency(fn, inputs, rtol=1e-4, atol=1e-5):
 
     jitted = jax.jit(pure)(*[a.data for a in nds])
     for e, j in zip(eager_list, jitted):
-        assert_almost_equal(e, onp.asarray(j), rtol=rtol, atol=atol,
-                            names=("eager", "jit"))
+        assert_almost_equal(e, onp.asarray(j.astype(jax.numpy.float32)),
+                            rtol=rtol, atol=atol, names=("eager", "jit"))
+    if compare_with_fp32 and str(dtype) in ("float16", "bfloat16"):
+        # half-precision result must track the fp32 oracle within the
+        # ladder rung (values, not just eager/jit agreement)
+        ref = (ref_fn or fn)(*[nd.array(onp.asarray(a, dtype="float32"))
+                               if not isinstance(a, nd.NDArray) else a
+                               for a in inputs])
+        ref_list = ref if isinstance(ref, (list, tuple)) else [ref]
+        for e, r in zip(eager_list, ref_list):
+            assert_almost_equal(
+                onp.asarray(e.data.astype(jax.numpy.float32)),
+                r.asnumpy(), rtol=rtol, atol=atol,
+                names=(str(dtype), "float32_ref"))
     return eager
 
 

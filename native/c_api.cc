@@ -371,3 +371,355 @@ MXTPU_API int MXPredFree(void* handle) {
   Py_DECREF(reinterpret_cast<PyObject*>(handle));
   return 0;
 }
+
+// ------------------------------------------------------------------------
+// Symbol API (reference: src/c_api/c_api_symbolic.cc). Handles are
+// Python "cells" (1-element lists) so MXSymbolCompose can swap the
+// underlying Symbol in place while C keeps one stable pointer.
+// ------------------------------------------------------------------------
+
+namespace {
+
+// thread-local string/name-list returns (reference ret_buf convention)
+std::string& str_ret() {
+  thread_local std::string s;
+  return s;
+}
+
+std::vector<std::string>& names_store() {
+  thread_local std::vector<std::string> v;
+  return v;
+}
+
+std::vector<const char*>& names_ret() {
+  thread_local std::vector<const char*> v;
+  return v;
+}
+
+int list_to_names(PyObject* r, uint32_t* out_size, const char*** out_array) {
+  auto& store = names_store();
+  auto& ret = names_ret();
+  store.clear();
+  ret.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    if (c == nullptr) return set_py_error();
+    store.emplace_back(c);
+  }
+  for (auto& s : store) ret.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = ret.data();
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolCreateVariable(const char* name, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* r = bridge_call("sym_var", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolCreateAtomicSymbol(const char* op_name,
+                                         uint32_t num_param,
+                                         const char** keys,
+                                         const char** vals, void** out) {
+  Gil gil;
+  PyObject* k = PyList_New(num_param);
+  PyObject* v = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNN)", op_name, k, v);
+  PyObject* r = bridge_call("sym_create_atomic", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolCompose(void* sym, const char* name, uint32_t num_args,
+                              const char** keys, void** args_handles) {
+  Gil gil;
+  PyObject* keylist;
+  if (keys == nullptr) {
+    keylist = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    keylist = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(keylist, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject* cells = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject* h = reinterpret_cast<PyObject*>(args_handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(cells, i, h);
+  }
+  PyObject* args = Py_BuildValue("(OsNN)", sym, name ? name : "", keylist,
+                                 cells);
+  PyObject* r = bridge_call("sym_compose", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolCreateFromJSON(const char* json, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* r = bridge_call("sym_from_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolSaveToJSON(void* sym, const char** out_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", sym);
+  PyObject* r = bridge_call("sym_to_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  const char* c = PyUnicode_AsUTF8(r);
+  if (c == nullptr) {
+    Py_DECREF(r);
+    return set_py_error();
+  }
+  str_ret() = c;
+  Py_DECREF(r);
+  *out_json = str_ret().c_str();
+  return 0;
+}
+
+namespace {
+
+int symbol_list(void* sym, const char* kind, uint32_t* out_size,
+                const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", sym, kind);
+  PyObject* r = bridge_call("sym_list", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  int rc = list_to_names(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolListArguments(void* sym, uint32_t* out_size,
+                                    const char*** out_array) {
+  return symbol_list(sym, "arguments", out_size, out_array);
+}
+
+MXTPU_API int MXSymbolListAuxiliaryStates(void* sym, uint32_t* out_size,
+                                          const char*** out_array) {
+  return symbol_list(sym, "aux", out_size, out_array);
+}
+
+MXTPU_API int MXSymbolListOutputs(void* sym, uint32_t* out_size,
+                                  const char*** out_array) {
+  return symbol_list(sym, "outputs", out_size, out_array);
+}
+
+MXTPU_API int MXSymbolFree(void* sym) {
+  if (sym == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(sym));
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// Executor API (reference: src/c_api/c_api_executor.cc:189
+// MXExecutorSimpleBindEx). One jitted XLA computation per bind.
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXExecutorSimpleBind(void* sym, const char* grad_req,
+                                   uint32_t num_input,
+                                   const char** input_keys,
+                                   const uint32_t* input_shape_indptr,
+                                   const int64_t* input_shape_data,
+                                   void** out) {
+  Gil gil;
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLongLong(input_shape_data[j]));
+    PyObject* k = PyUnicode_FromString(input_keys[i]);
+    PyDict_SetItem(shapes, k, shp);
+    Py_DECREF(k);
+    Py_DECREF(shp);
+  }
+  PyObject* args = Py_BuildValue("(OsN)", sym, grad_req, shapes);
+  PyObject* r = bridge_call("exec_simple_bind", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXExecutorArgArray(void* exec, const char* kind,
+                                 const char* name, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", exec, kind, name);
+  PyObject* r = bridge_call("exec_array", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // new reference owned by the caller handle
+  return 0;
+}
+
+MXTPU_API int MXExecutorForward(void* exec, int is_train) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", exec, is_train);
+  PyObject* r = bridge_call("exec_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorOutputs(void* exec, int* num_outputs,
+                                void*** outputs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", exec);
+  PyObject* r = bridge_call("exec_outputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_ssize_t n = PyList_Size(r);
+  clear_invoke_ret();
+  auto& ret = invoke_ret();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    ret.push_back(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = ret.data();
+  return 0;
+}
+
+MXTPU_API int MXExecutorBackward(void* exec) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", exec);
+  PyObject* r = bridge_call("exec_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorFree(void* exec) {
+  if (exec == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(exec));
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// KVStore API (reference: src/c_api/c_api.cc MXKVStore*). Enables the
+// reference's training loop from C: init weights, push grads, pull
+// updated weights with a server-side optimizer.
+// ------------------------------------------------------------------------
+
+namespace {
+
+PyObject* int_keys(uint32_t num, const int* keys) {  // GIL held
+  PyObject* k = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i)
+    PyList_SET_ITEM(k, i, PyLong_FromLong(keys[i]));
+  return k;
+}
+
+PyObject* handle_list(uint32_t num, void** handles) {  // GIL held
+  PyObject* v = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* h = reinterpret_cast<PyObject*>(handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(v, i, h);
+  }
+  return v;
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreCreate(const char* type, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* r = bridge_call("kv_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetOptimizer(void* kv, const char* opt_name,
+                                    uint32_t num_param, const char** keys,
+                                    const char** vals) {
+  Gil gil;
+  PyObject* k = PyList_New(num_param);
+  PyObject* v = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(OsNN)", kv, opt_name, k, v);
+  PyObject* r = bridge_call("kv_set_optimizer", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreInit(void* kv, uint32_t num, const int* keys,
+                            void** vals) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", kv, int_keys(num, keys),
+                                 handle_list(num, vals));
+  PyObject* r = bridge_call("kv_init", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePush(void* kv, uint32_t num, const int* keys,
+                            void** vals, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONNi)", kv, int_keys(num, keys),
+                                 handle_list(num, vals), priority);
+  PyObject* r = bridge_call("kv_push", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePull(void* kv, uint32_t num, const int* keys,
+                            void** outs, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONNi)", kv, int_keys(num, keys),
+                                 handle_list(num, outs), priority);
+  PyObject* r = bridge_call("kv_pull", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreFree(void* kv) {
+  if (kv == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(kv));
+  return 0;
+}

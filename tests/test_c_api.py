@@ -277,3 +277,292 @@ def test_standalone_c_program(capi, tmp_path):
                           text=True, timeout=240)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "C_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Training surface: symbol compose + simple bind + forward/backward + kvstore
+# (reference: c_api_symbolic.cc, c_api_executor.cc:189, MXKVStore*)
+# ---------------------------------------------------------------------------
+
+def _train_argtypes(lib):
+    vp, c_int, u32 = ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32
+    cp = ctypes.c_char_p
+    lib.MXSymbolCreateVariable.argtypes = [cp, ctypes.POINTER(vp)]
+    lib.MXSymbolCreateAtomicSymbol.argtypes = [
+        cp, u32, ctypes.POINTER(cp), ctypes.POINTER(cp), ctypes.POINTER(vp)]
+    lib.MXSymbolCompose.argtypes = [vp, cp, u32, ctypes.POINTER(cp),
+                                    ctypes.POINTER(vp)]
+    lib.MXSymbolCreateFromJSON.argtypes = [cp, ctypes.POINTER(vp)]
+    lib.MXSymbolSaveToJSON.argtypes = [vp, ctypes.POINTER(cp)]
+    for f in (lib.MXSymbolListArguments, lib.MXSymbolListAuxiliaryStates,
+              lib.MXSymbolListOutputs):
+        f.argtypes = [vp, ctypes.POINTER(u32),
+                      ctypes.POINTER(ctypes.POINTER(cp))]
+    lib.MXSymbolFree.argtypes = [vp]
+    lib.MXExecutorSimpleBind.argtypes = [
+        vp, cp, u32, ctypes.POINTER(cp), ctypes.POINTER(u32),
+        ctypes.POINTER(i64), ctypes.POINTER(vp)]
+    lib.MXExecutorArgArray.argtypes = [vp, cp, cp, ctypes.POINTER(vp)]
+    lib.MXExecutorForward.argtypes = [vp, ctypes.c_int]
+    lib.MXExecutorOutputs.argtypes = [vp, ctypes.POINTER(c_int),
+                                      ctypes.POINTER(ctypes.POINTER(vp))]
+    lib.MXExecutorBackward.argtypes = [vp]
+    lib.MXExecutorFree.argtypes = [vp]
+    lib.MXKVStoreCreate.argtypes = [cp, ctypes.POINTER(vp)]
+    lib.MXKVStoreSetOptimizer.argtypes = [vp, cp, u32, ctypes.POINTER(cp),
+                                          ctypes.POINTER(cp)]
+    for f in (lib.MXKVStoreInit,):
+        f.argtypes = [vp, u32, ctypes.POINTER(c_int), ctypes.POINTER(vp)]
+    for f in (lib.MXKVStorePush, lib.MXKVStorePull):
+        f.argtypes = [vp, u32, ctypes.POINTER(c_int), ctypes.POINTER(vp),
+                      ctypes.c_int]
+    lib.MXKVStoreFree.argtypes = [vp]
+    return lib
+
+
+def test_symbol_compose_and_json_roundtrip(capi):
+    lib = _train_argtypes(capi)
+    vp, u32, cp = ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p
+    data = vp()
+    assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    fc = vp()
+    keys = (cp * 1)(b"num_hidden")
+    vals = (cp * 1)(b"4")
+    assert lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, keys, vals, ctypes.byref(fc)) == 0, _err(capi)
+    args = (vp * 1)(data)
+    assert lib.MXSymbolCompose(fc, b"fc", 1, None, args) == 0, _err(capi)
+    n = u32()
+    names = ctypes.POINTER(cp)()
+    assert lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                     ctypes.byref(names)) == 0
+    got = sorted(names[i].decode() for i in range(n.value))
+    assert got == ["data", "fc_bias", "fc_weight"]
+    js = cp()
+    assert lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)) == 0
+    re = vp()
+    assert lib.MXSymbolCreateFromJSON(js.value, ctypes.byref(re)) == 0
+    assert lib.MXSymbolListOutputs(re, ctypes.byref(n),
+                                   ctypes.byref(names)) == 0
+    assert n.value == 1 and names[0].decode() == "fc_output"
+    lib.MXSymbolFree(re)
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(data)
+
+
+def test_c_training_loop_via_ctypes(capi):
+    """The full training story through the flat ABI: compose an MLP,
+    simple-bind, forward/backward, kvstore sgd updates — loss drops."""
+    lib = _train_argtypes(capi)
+    vp, u32, cp, c_int = (ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+                          ctypes.c_int)
+    data = vp(); label = vp()
+    lib.MXSymbolCreateVariable(b"data", ctypes.byref(data))
+    lib.MXSymbolCreateVariable(b"softmax_label", ctypes.byref(label))
+    fc1 = vp()
+    lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", 1,
+                                   (cp * 1)(b"num_hidden"), (cp * 1)(b"16"),
+                                   ctypes.byref(fc1))
+    assert lib.MXSymbolCompose(fc1, b"fc1", 1, None,
+                               (vp * 1)(data)) == 0, _err(capi)
+    act = vp()
+    lib.MXSymbolCreateAtomicSymbol(b"Activation", 1, (cp * 1)(b"act_type"),
+                                   (cp * 1)(b"relu"), ctypes.byref(act))
+    assert lib.MXSymbolCompose(act, b"act", 1, None,
+                               (vp * 1)(fc1)) == 0, _err(capi)
+    fc2 = vp()
+    lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", 1,
+                                   (cp * 1)(b"num_hidden"), (cp * 1)(b"2"),
+                                   ctypes.byref(fc2))
+    assert lib.MXSymbolCompose(fc2, b"fc2", 1, None,
+                               (vp * 1)(act)) == 0, _err(capi)
+    sm = vp()
+    lib.MXSymbolCreateAtomicSymbol(b"SoftmaxOutput", 0, None, None,
+                                   ctypes.byref(sm))
+    assert lib.MXSymbolCompose(sm, b"softmax", 2, None,
+                               (vp * 2)(fc2, label)) == 0, _err(capi)
+
+    B, D = 64, 8
+    ikeys = (cp * 2)(b"data", b"softmax_label")
+    indptr = (u32 * 3)(0, 2, 3)
+    shp = (i64 * 3)(B, D, B)
+    ex = vp()
+    assert lib.MXExecutorSimpleBind(sm, b"write", 2, ikeys, indptr, shp,
+                                    ctypes.byref(ex)) == 0, _err(capi)
+
+    rng = onp.random.RandomState(0)
+    X = rng.randn(B, D).astype("f")
+    y = (X[:, 0] > 0).astype("f")
+
+    def arr(kind, name):
+        h = vp()
+        assert lib.MXExecutorArgArray(ex, kind.encode(), name.encode(),
+                                      ctypes.byref(h)) == 0, _err(capi)
+        return h
+
+    def put(h, a):
+        a = onp.ascontiguousarray(a)
+        assert capi.MXNDArraySyncCopyFromCPU(
+            h, a.ctypes.data_as(vp), a.nbytes) == 0, _err(capi)
+
+    wnames = ["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    weights = [arr("arg", n) for n in wnames]
+    grads = [arr("grad", n) for n in wnames]
+    put(arr("arg", "data"), X)
+    put(arr("arg", "softmax_label"), y)
+    for h, shape in zip(weights, [(16, D), (16,), (2, 16), (2,)]):
+        put(h, (rng.randn(*shape) * 0.1).astype("f"))
+
+    kv = vp()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    assert lib.MXKVStoreSetOptimizer(
+        kv, b"sgd", 1, (cp * 1)(b"learning_rate"),
+        (cp * 1)(b"0.01")) == 0, _err(capi)
+    kkeys = (c_int * 4)(0, 1, 2, 3)
+    assert lib.MXKVStoreInit(kv, 4, kkeys, (vp * 4)(*weights)) == 0, \
+        _err(capi)
+
+    def step():
+        assert lib.MXExecutorForward(ex, 1) == 0, _err(capi)
+        nout = c_int()
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXExecutorOutputs(ex, ctypes.byref(nout),
+                                     ctypes.byref(outs)) == 0
+        probs = onp.zeros((B, 2), "f")
+        assert capi.MXNDArraySyncCopyToCPU(
+            outs[0], probs.ctypes.data_as(vp), probs.nbytes) == 0
+        loss = -onp.log(probs[onp.arange(B), y.astype(int)] + 1e-9).mean()
+        assert lib.MXExecutorBackward(ex) == 0, _err(capi)
+        assert lib.MXKVStorePush(kv, 4, kkeys, (vp * 4)(*grads), 0) == 0
+        assert lib.MXKVStorePull(kv, 4, kkeys, (vp * 4)(*weights), 0) == 0
+        return loss
+
+    first = step()
+    last = None
+    for _ in range(25):
+        last = step()
+    assert last < first * 0.5, (first, last)
+    lib.MXKVStoreFree(kv)
+    lib.MXExecutorFree(ex)
+    for h in weights + grads:
+        capi.MXNDArrayFree(h)
+
+
+C_TRAIN_PROGRAM = r"""
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxnet_tpu/c_api.h"
+
+#define B 64
+#define D 8
+#define H 16
+#define CK(x) do { if ((x) != 0) { \
+  fprintf(stderr, "%s\n", MXGetLastError()); return 1; } } while (0)
+
+static unsigned lcg = 42u;
+static float frand(void) {  /* uniform in [-1, 1) */
+  lcg = lcg * 1664525u + 1013904223u;
+  return ((lcg >> 8) / 8388608.0f) - 1.0f;
+}
+
+int main(void) {
+  SymbolHandle data, label, fc1, act, fc2, sm;
+  CK(MXSymbolCreateVariable("data", &data));
+  CK(MXSymbolCreateVariable("softmax_label", &label));
+  const char* kh = "num_hidden"; const char* ka = "act_type";
+  const char* v16 = "16"; const char* v2 = "2"; const char* vr = "relu";
+  CK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, &kh, &v16, &fc1));
+  CK(MXSymbolCompose(fc1, "fc1", 1, NULL, &data));
+  CK(MXSymbolCreateAtomicSymbol("Activation", 1, &ka, &vr, &act));
+  CK(MXSymbolCompose(act, "act", 1, NULL, &fc1));
+  CK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, &kh, &v2, &fc2));
+  CK(MXSymbolCompose(fc2, "fc2", 1, NULL, &act));
+  CK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", 0, NULL, NULL, &sm));
+  SymbolHandle smargs[2]; smargs[0] = fc2; smargs[1] = label;
+  CK(MXSymbolCompose(sm, "softmax", 2, NULL, smargs));
+
+  const char* ikeys[2] = {"data", "softmax_label"};
+  uint32_t indptr[3] = {0, 2, 3};
+  int64_t shp[3] = {B, D, B};
+  ExecutorHandle ex;
+  CK(MXExecutorSimpleBind(sm, "write", 2, ikeys, indptr, shp, &ex));
+
+  float X[B * D], y[B];
+  for (int i = 0; i < B; ++i) {
+    for (int j = 0; j < D; ++j) X[i * D + j] = frand();
+    y[i] = X[i * D] > 0.0f ? 1.0f : 0.0f;
+  }
+  NDArrayHandle hx, hy;
+  CK(MXExecutorArgArray(ex, "arg", "data", &hx));
+  CK(MXExecutorArgArray(ex, "arg", "softmax_label", &hy));
+  CK(MXNDArraySyncCopyFromCPU(hx, X, sizeof(X)));
+  CK(MXNDArraySyncCopyFromCPU(hy, y, sizeof(y)));
+
+  const char* wn[4] = {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"};
+  int wsize[4] = {H * D, H, 2 * H, 2};
+  NDArrayHandle w[4], g[4];
+  for (int i = 0; i < 4; ++i) {
+    CK(MXExecutorArgArray(ex, "arg", wn[i], &w[i]));
+    CK(MXExecutorArgArray(ex, "grad", wn[i], &g[i]));
+    float buf[H * D];
+    for (int j = 0; j < wsize[i]; ++j) buf[j] = 0.1f * frand();
+    CK(MXNDArraySyncCopyFromCPU(w[i], buf, wsize[i] * sizeof(float)));
+  }
+
+  KVStoreHandle kv;
+  CK(MXKVStoreCreate("local", &kv));
+  const char* ok = "learning_rate"; const char* ov = "0.01";
+  CK(MXKVStoreSetOptimizer(kv, "sgd", 1, &ok, &ov));
+  int keys[4] = {0, 1, 2, 3};
+  CK(MXKVStoreInit(kv, 4, keys, w));
+
+  float first = -1.0f, loss = 0.0f;
+  for (int step = 0; step < 25; ++step) {
+    CK(MXExecutorForward(ex, 1));
+    int nout = 0;
+    NDArrayHandle* outs = NULL;
+    CK(MXExecutorOutputs(ex, &nout, &outs));
+    float probs[B * 2];
+    CK(MXNDArraySyncCopyToCPU(outs[0], probs, sizeof(probs)));
+    loss = 0.0f;
+    for (int i = 0; i < B; ++i)
+      loss -= logf(probs[i * 2 + (int)y[i]] + 1e-9f) / B;
+    if (first < 0.0f) first = loss;
+    CK(MXExecutorBackward(ex));
+    CK(MXKVStorePush(kv, 4, keys, g, 0));
+    CK(MXKVStorePull(kv, 4, keys, w, 0));
+  }
+  if (!(loss < first * 0.5f)) {
+    fprintf(stderr, "loss did not halve: %f -> %f\n", first, loss);
+    return 2;
+  }
+  printf("C_TRAIN_OK %f -> %f\n", first, loss);
+  MXKVStoreFree(kv);
+  MXExecutorFree(ex);
+  return 0;
+}
+"""
+
+
+def test_standalone_c_training_program(capi, tmp_path):
+    """A plain C program (no Python source) composes the MLP, binds it,
+    and trains with kvstore sgd until the loss halves — the reference's
+    'any frontend can train through the C ABI' property."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    so = build_c_api()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    csrc = tmp_path / "train.c"
+    csrc.write_text(C_TRAIN_PROGRAM)
+    exe = tmp_path / "ctrain"
+    subprocess.run(
+        ["gcc", str(csrc), "-o", str(exe), f"-I{repo}/include",
+         so, "-lm", f"-Wl,-rpath,{os.path.dirname(so)}"],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([str(exe)], env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "C_TRAIN_OK" in proc.stdout
