@@ -255,7 +255,9 @@ def child_main(platform):
         raise RuntimeError(f"bench {tag} failed at batch>=16: {last_err}")
 
     fp32_batch = int(os.environ.get("BENCH_BATCH", "128"))
-    bf16_batch = int(os.environ.get("BENCH_BF16_BATCH", "256"))
+    # bf16 halves activation memory — start the descent high: bigger
+    # batches keep the MXU fed (the OOM-halving loop finds the ceiling)
+    bf16_batch = int(os.environ.get("BENCH_BF16_BATCH", "512"))
     # resume point from a killed attempt: skip straight to its phase,
     # reusing the fp32 result the killed attempt already measured
     resume = {}

@@ -142,6 +142,27 @@ int MXKVStorePull(KVStoreHandle kv, uint32_t num, const int* keys,
                   NDArrayHandle* outs, int priority);
 int MXKVStoreFree(KVStoreHandle kv);
 
+/* ---- misc surface ---------------------------------------------------- */
+
+/* In-place reshape keeping loaded weights+aux; *out is the same handle
+ * with its refcount bumped (free both). Reference: MXPredReshape. */
+int MXPredReshape(uint32_t num_input, const char** input_keys,
+                  const uint32_t* input_shape_indptr,
+                  const int64_t* input_shape_data, PredictorHandle handle,
+                  PredictorHandle* out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int64_t* shape,
+                     NDArrayHandle* out);
+int MXNDArraySlice(NDArrayHandle handle, int64_t begin, int64_t end,
+                   NDArrayHandle* out);
+/* *out_value points at thread-local storage (same buffer as
+ * MXSymbolSaveToJSON); out_success is 0 when the attr is unset. */
+int MXSymbolGetAttr(SymbolHandle sym, const char* key,
+                    const char** out_value, int* out_success);
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value);
+int MXKVStoreGetType(KVStoreHandle kv, const char** out_type);
+int MXKVStoreGetRank(KVStoreHandle kv, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* out);
+
 #ifdef __cplusplus
 }
 #endif

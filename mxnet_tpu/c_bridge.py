@@ -217,7 +217,10 @@ class _AtomicOp:
 def sym_var(name):
     from . import symbol as sym_mod
 
-    return [sym_mod.Variable(name)]
+    # bare Symbol, NOT Variable(): the reference's MXSymbolCreateVariable
+    # never consults the python-frontend AttrScope, so a C caller on a
+    # thread that happens to be inside one must not get stamped attrs
+    return [sym_mod.Symbol(op=None, name=name)]
 
 
 def sym_create_atomic(op_name, keys, vals):
@@ -343,3 +346,36 @@ def kv_push(kv, keys, vals, priority):
 def kv_pull(kv, keys, outs, priority):
     kv.pull(list(keys), out=list(outs), priority=priority)
     return None
+
+
+# ----------------------------------------------------- misc ABI surface ---
+
+def nd_reshape(a, shape):
+    return a.reshape(tuple(int(s) for s in shape))
+
+
+def nd_slice(a, begin, end):
+    return a[int(begin):int(end)]
+
+
+def sym_get_attr(cell, key):
+    """Returns (found, value): an attr explicitly set to "" is found=1
+    with an empty value, distinct from unset (reference MXSymbolGetAttr
+    semantics)."""
+    v = _composed(cell).attr(key)
+    return (0, "") if v is None else (1, str(v))
+
+
+def sym_set_attr(cell, key, value):
+    _composed(cell)._set_attr(**{key: value})
+    return None
+
+
+def kv_meta(kv, what):
+    if what == "type":
+        return str(kv.type)
+    if what == "rank":
+        return int(kv.rank)
+    if what == "num_workers":
+        return int(kv.num_workers)
+    raise MXNetError(f"unknown kvstore meta '{what}'")

@@ -108,36 +108,100 @@ class DataLoader:
             for batch_indices in self._batch_sampler:
                 yield self._make_batch(batch_indices)
             return
-        # pipelined prefetch through the worker pool (threads or
-        # processes — same schedule)
-        futures = []
+        # pipelined prefetch through the worker pool; RESULT COLLECTION
+        # (future wait + decode) is an engine op on the IO lane, like
+        # the reference's PrefetcherIter hand-off — NaiveEngine makes it
+        # inline-synchronous, poison carries worker errors to the wait.
+        # Futures are submitted EAGERLY and independently of the engine,
+        # so worker parallelism survives even an inline engine.
+        from ... import engine as _engine
+
+        eng = _engine.get()
+        depth = max(1, self._prefetch)
+        slot_vars = self._checkout_vars(eng, depth)
+        # under an inline engine the collect op blocks at push — defer
+        # it to emit time so `depth` worker futures stay in flight
+        defer_collect = isinstance(eng, _engine.NaiveEngine)
+        slots = [None] * depth
+        pending = []  # (fut, slot) submitted but collect not yet pushed
         it = iter(self._batch_sampler)
-        try:
-            for _ in range(self._prefetch):
-                futures.append(self._pool.submit(self._submit_fn,
-                                                 list(next(it))))
-        except StopIteration:
-            pass
-        while futures:
+        state = {"submitted": 0}
+
+        def push_collect(fut, slot):
+            def collect(fut=fut, slot=slot):
+                b = fut.result()
+                if self._decode is not None:
+                    b = self._decode(b)
+                slots[slot] = b
+
+            eng.push(collect, mutable_vars=(slot_vars[slot],),
+                     lane=_engine.LANE_IO)
+
+        def submit_next():
             try:
-                batch = futures.pop(0).result()
-            except BrokenProcessPool:
-                raise RuntimeError(
-                    "DataLoader process workers died during startup. "
-                    "Like torch's DataLoader, process workers need the "
-                    "script's entry point guarded with "
-                    "`if __name__ == '__main__':` (spawn/forkserver "
-                    "re-import __main__); alternatively pass "
-                    "thread_pool=True for guard-free thread workers."
-                ) from None
-            if self._decode is not None:
-                batch = self._decode(batch)
-            try:
-                futures.append(self._pool.submit(self._submit_fn,
-                                                 list(next(it))))
+                indices = list(next(it))
             except StopIteration:
-                pass
-            yield batch
+                return False
+            fut = self._pool.submit(self._submit_fn, indices)
+            slot = state["submitted"] % depth
+            state["submitted"] += 1
+            if defer_collect:
+                pending.append((fut, slot))
+            else:
+                push_collect(fut, slot)
+            return True
+
+        for _ in range(depth):
+            if not submit_next():
+                break
+        emitted = 0
+        clean = True
+        try:
+            while emitted < state["submitted"]:
+                slot = emitted % depth
+                if defer_collect and pending and pending[0][1] == slot:
+                    push_collect(*pending.pop(0))
+                try:
+                    eng.wait_for_var(slot_vars[slot])
+                except BrokenProcessPool:
+                    clean = False
+                    raise RuntimeError(
+                        "DataLoader process workers died during startup. "
+                        "Like torch's DataLoader, process workers need "
+                        "the script's entry point guarded with "
+                        "`if __name__ == '__main__':` (spawn/forkserver "
+                        "re-import __main__); alternatively pass "
+                        "thread_pool=True for guard-free thread workers."
+                    ) from None
+                except BaseException:
+                    clean = False
+                    raise
+                batch = slots[slot]
+                slots[slot] = None
+                emitted += 1
+                submit_next()
+                yield batch
+        finally:
+            # clean vars go back to the instance pool (bounded var
+            # table); poisoned ones are dropped
+            if clean:
+                self._return_vars(eng, slot_vars)
+
+    def _checkout_vars(self, eng, depth):
+        """Per-instance var pool: concurrent iterators get distinct var
+        lists; sequential epochs reuse them instead of growing the
+        engine's var table forever."""
+        pool = getattr(self, "_var_pool", None)
+        if pool is None:
+            pool = self._var_pool = []
+        while pool:
+            e, vs = pool.pop()
+            if e is eng and len(vs) == depth:
+                return vs
+        return [eng.new_variable() for _ in range(depth)]
+
+    def _return_vars(self, eng, vs):
+        self._var_pool.append((eng, vs))
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -164,7 +164,13 @@ for _n, _f in _BINARY.items():
 # non-broadcast aliases (reference elemwise_add etc. require equal shapes)
 for _alias, _target in [("elemwise_add", jnp.add), ("elemwise_sub", jnp.subtract),
                         ("elemwise_mul", jnp.multiply), ("elemwise_div", jnp.divide),
-                        ("maximum", jnp.maximum), ("minimum", jnp.minimum)]:
+                        ("maximum", jnp.maximum), ("minimum", jnp.minimum),
+                        ("logical_and",
+                         lambda a, b: jnp.logical_and(a != 0, b != 0)),
+                        ("logical_or",
+                         lambda a, b: jnp.logical_or(a != 0, b != 0)),
+                        ("logical_xor",
+                         lambda a, b: jnp.logical_xor(a != 0, b != 0))]:
     _bcast_pair(_alias, _target)
 
 
@@ -543,3 +549,45 @@ def khatri_rao(*args):
     for m in args[1:]:
         out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
     return out
+
+
+@register()
+def hypot(lhs, rhs):
+    """sqrt(l^2+r^2) (reference: elemwise_binary_op_extended.cc)."""
+    return jnp.hypot(lhs, rhs)
+
+
+@register()
+def ldexp(lhs, rhs):
+    """l * 2^r (reference: elemwise_binary_op_extended.cc)."""
+    return lhs * jnp.exp2(rhs)
+
+
+@register()
+def digamma(data):
+    """d/dx log Gamma(x) (reference: mshadow_op digamma via gammaln')."""
+    import jax.scipy.special as jsp
+
+    return jsp.digamma(data)
+
+
+@register()
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape (reference:
+    broadcast_reduce_op_value.cc broadcast_like). With axes given, only
+    those lhs axes grow to the matching rhs axes' sizes."""
+    if lhs_axes is None and rhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    if lhs_axes is None or rhs_axes is None or \
+            len(lhs_axes) != len(rhs_axes) or not lhs_axes:
+        # reference broadcast_like enforces both-or-neither with equal
+        # non-empty lengths (broadcast_reduce_op.h BroadcastLikeShape)
+        raise ValueError(
+            "broadcast_like: lhs_axes and rhs_axes must both be given, "
+            f"non-empty, and the same length; got {lhs_axes} / {rhs_axes}")
+    la = tuple(int(a) % lhs.ndim for a in lhs_axes)
+    ra = tuple(int(a) % rhs.ndim for a in rhs_axes)
+    target = list(lhs.shape)
+    for li, ri in zip(la, ra):
+        target[li] = rhs.shape[ri]
+    return jnp.broadcast_to(lhs, tuple(target))

@@ -723,3 +723,126 @@ MXTPU_API int MXKVStoreFree(void* kv) {
   Py_DECREF(reinterpret_cast<PyObject*>(kv));
   return 0;
 }
+
+// ------------------------------------------------------------------------
+// Misc surface: predictor reshape, NDArray views, symbol attrs, kvstore
+// metadata (reference: c_predict_api.cc MXPredReshape, c_api.cc
+// MXNDArrayReshape/Slice, c_api_symbolic.cc attr entry points)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXPredReshape(uint32_t num_input, const char** input_keys,
+                            const uint32_t* input_shape_indptr,
+                            const int64_t* input_shape_data, void* handle,
+                            void** out) {
+  Gil gil;
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLongLong(input_shape_data[j]));
+    PyObject* k = PyUnicode_FromString(input_keys[i]);
+    PyDict_SetItem(shapes, k, shp);
+    Py_DECREF(k);
+    Py_DECREF(shp);
+  }
+  PyObject* r = PyObject_CallMethod(reinterpret_cast<PyObject*>(handle),
+                                    "reshape", "N", shapes);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  // the reference returns a NEW handle; ours reshapes in place, so hand
+  // back the same predictor with its refcount bumped
+  Py_INCREF(reinterpret_cast<PyObject*>(handle));
+  *out = handle;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayReshape(void* handle, int ndim, const int64_t* shape,
+                               void** out) {
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* args = Py_BuildValue("(ON)", handle, shp);
+  PyObject* r = bridge_call("nd_reshape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySlice(void* handle, int64_t begin, int64_t end,
+                             void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OLL)", handle,
+                                 static_cast<long long>(begin),
+                                 static_cast<long long>(end));
+  PyObject* r = bridge_call("nd_slice", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAttr(void* sym, const char* key,
+                              const char** out_value, int* out_success) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", sym, key);
+  PyObject* r = bridge_call("sym_get_attr", args);  // (found, value)
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  int found = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  const char* c = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+  str_ret() = c ? c : "";
+  Py_DECREF(r);
+  *out_success = found;  // presence, NOT value-emptiness
+  *out_value = str_ret().c_str();
+  return 0;
+}
+
+MXTPU_API int MXSymbolSetAttr(void* sym, const char* key,
+                              const char* value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", sym, key, value);
+  PyObject* r = bridge_call("sym_set_attr", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreGetType(void* kv, const char** out_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", kv, "type");
+  PyObject* r = bridge_call("kv_meta", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  const char* c = PyUnicode_AsUTF8(r);
+  str_ret() = c ? c : "";
+  Py_DECREF(r);
+  *out_type = str_ret().c_str();
+  return 0;
+}
+
+namespace {
+
+int kv_meta_int(void* kv, const char* what, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", kv, what);
+  PyObject* r = bridge_call("kv_meta", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreGetRank(void* kv, int* out) {
+  return kv_meta_int(kv, "rank", out);
+}
+
+MXTPU_API int MXKVStoreGetGroupSize(void* kv, int* out) {
+  return kv_meta_int(kv, "num_workers", out);
+}

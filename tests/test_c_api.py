@@ -566,3 +566,99 @@ def test_standalone_c_training_program(capi, tmp_path):
                           text=True, timeout=300)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "C_TRAIN_OK" in proc.stdout
+
+
+def test_misc_abi_surface(capi, exported_mlp):
+    """MXPredReshape keeps weights; NDArray reshape/slice views; symbol
+    attrs; kvstore metadata."""
+    lib = _train_argtypes(capi)
+    vp, u32, cp, c_int = (ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+                          ctypes.c_int)
+    lib.MXPredReshape.argtypes = [u32, ctypes.POINTER(cp),
+                                  ctypes.POINTER(u32), ctypes.POINTER(i64),
+                                  vp, ctypes.POINTER(vp)]
+    lib.MXNDArrayReshape.argtypes = [vp, c_int, ctypes.POINTER(i64),
+                                     ctypes.POINTER(vp)]
+    lib.MXNDArraySlice.argtypes = [vp, i64, i64, ctypes.POINTER(vp)]
+    lib.MXSymbolGetAttr.argtypes = [vp, cp, ctypes.POINTER(cp),
+                                    ctypes.POINTER(c_int)]
+    lib.MXSymbolSetAttr.argtypes = [vp, cp, cp]
+    lib.MXKVStoreGetType.argtypes = [vp, ctypes.POINTER(cp)]
+    lib.MXKVStoreGetRank.argtypes = [vp, ctypes.POINTER(c_int)]
+    lib.MXKVStoreGetGroupSize.argtypes = [vp, ctypes.POINTER(c_int)]
+
+    # predictor reshape keeps weights (batch 4 -> 2)
+    json_path, params_path, xval, expect = exported_mlp
+    with open(json_path) as f:
+        sym_json = f.read().encode()
+    with open(params_path, "rb") as f:
+        param_bytes = f.read()
+    keys = (cp * 1)(b"data")
+    indptr = (u32 * 2)(0, 2)
+    shp = (i64 * 2)(4, 8)
+    h = vp()
+    assert capi.MXPredCreate(sym_json, param_bytes, len(param_bytes), 1, 0,
+                             1, keys, indptr, shp, ctypes.byref(h)) == 0
+    shp2 = (i64 * 2)(2, 8)
+    h2 = vp()
+    assert lib.MXPredReshape(1, keys, indptr, shp2, h,
+                             ctypes.byref(h2)) == 0, _err(capi)
+    x2 = onp.ascontiguousarray(xval[:2])
+    assert capi.MXPredSetInput(
+        h2, b"data", x2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        x2.size) == 0
+    assert capi.MXPredForward(h2) == 0
+    res = onp.zeros((2, 3), "f")
+    assert capi.MXPredGetOutput(
+        h2, 0, res.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        res.size) == 0
+    onp.testing.assert_allclose(res, expect[:2], rtol=1e-5, atol=1e-6)
+    capi.MXPredFree(h2)
+    capi.MXPredFree(h)
+
+    # ndarray reshape + slice
+    a = vp()
+    shape = (i64 * 2)(4, 3)
+    assert capi.MXNDArrayCreate(shape, 2, 0, ctypes.byref(a)) == 0
+    data = onp.arange(12, dtype="f")
+    assert capi.MXNDArraySyncCopyFromCPU(a, data.ctypes.data_as(vp),
+                                         data.nbytes) == 0
+    r = vp()
+    newshape = (i64 * 2)(3, 4)
+    assert lib.MXNDArrayReshape(a, 2, newshape, ctypes.byref(r)) == 0
+    nd_ = ctypes.c_int()
+    oshape = (i64 * 8)()
+    assert capi.MXNDArrayGetShape(r, ctypes.byref(nd_), oshape) == 0
+    assert tuple(oshape[:2]) == (3, 4)
+    s = vp()
+    assert lib.MXNDArraySlice(a, 1, 3, ctypes.byref(s)) == 0
+    assert capi.MXNDArrayGetShape(s, ctypes.byref(nd_), oshape) == 0
+    assert tuple(oshape[:2]) == (2, 3)
+    for x in (a, r, s):
+        capi.MXNDArrayFree(x)
+
+    # symbol attrs
+    sym = vp()
+    lib.MXSymbolCreateVariable(b"w", ctypes.byref(sym))
+    assert lib.MXSymbolSetAttr(sym, b"__lr_mult__", b"2.5") == 0
+    val = cp()
+    ok = c_int()
+    assert lib.MXSymbolGetAttr(sym, b"__lr_mult__", ctypes.byref(val),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and val.value == b"2.5"
+    assert lib.MXSymbolGetAttr(sym, b"missing", ctypes.byref(val),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 0
+    lib.MXSymbolFree(sym)
+
+    # kvstore metadata
+    kv = vp()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    t = cp()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    rank = c_int(); size = c_int()
+    assert lib.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value >= 1
+    lib.MXKVStoreFree(kv)

@@ -185,3 +185,20 @@ def test_engine_keepalives_bounded_by_waits(threaded_engine):
         eng.push(lambda: None, mutable_vars=(v,))
         eng.wait_for_var(v)
     assert eng.num_live_callbacks() <= start + 1
+
+
+def test_dataloader_collection_is_engine_scheduled(naive_engine):
+    """gluon DataLoader result collection runs through the engine: with
+    NaiveEngine each batch is collected inline at push on the caller
+    thread, and batches come out in order."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = onp.arange(24, dtype="f").reshape(12, 2)
+    ds = ArrayDataset(X, onp.arange(12, dtype="f"))
+    dl = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=True)
+    got = [b[0].asnumpy() for b in dl]
+    assert len(got) == 3
+    onp.testing.assert_allclose(onp.concatenate(got), X)
+    # second epoch clean (fresh vars per __iter__)
+    got2 = [b[0].asnumpy() for b in dl]
+    assert len(got2) == 3
