@@ -17,6 +17,38 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+
+def _maybe_init_distributed():
+    """jax.distributed.initialize must run BEFORE anything touches the
+    XLA backend, and importing this package touches it (PRNG state) —
+    so when the launcher's rendezvous env is present (tools/launch.py
+    MXNET_COORDINATOR), join the cluster here, first thing. The analog
+    of the reference's implicit ps-lite bootstrap inside ``import
+    mxnet`` when DMLC_PS_ROOT_URI is set."""
+    import multiprocessing
+    import os
+
+    if not os.environ.get("MXNET_COORDINATOR"):
+        return
+    if multiprocessing.parent_process() is not None:
+        # forkserver/spawn children (DataLoader workers, ...) inherit
+        # the launcher env but must NOT re-join the cluster with the
+        # parent's process_id — the coordinator would reject or hang
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # an explicit launch.init() beat us
+    # rendezvous failures propagate: a silently un-joined worker would
+    # leave its peers hanging at their first collective
+    jax.distributed.initialize(
+        coordinator_address=os.environ["MXNET_COORDINATOR"],
+        num_processes=int(os.environ["MXNET_NUM_PROCESSES"]),
+        process_id=int(os.environ["MXNET_PROCESS_ID"]))
+
+
+_maybe_init_distributed()
+
 from . import base
 from .base import MXNetError
 from . import context

@@ -205,13 +205,16 @@ class CPredictor:
 
 class _AtomicOp:
     """An operator with bound params awaiting composition (reference:
-    MXSymbolCreateAtomicSymbol before MXSymbolCompose)."""
+    MXSymbolCreateAtomicSymbol before MXSymbolCompose). Attrs set before
+    composition (the reference's normal ordering) are held and stamped
+    onto the composed node."""
 
-    __slots__ = ("op", "params")
+    __slots__ = ("op", "params", "attrs")
 
     def __init__(self, op, params):
         self.op = op
         self.params = params
+        self.attrs = {}
 
 
 def sym_var(name):
@@ -252,6 +255,8 @@ def sym_compose(cell, name, keys, arg_cells):
         cell[0] = fn(**kwargs)
     else:
         cell[0] = fn(*inputs, **kwargs)
+    if node.attrs:  # attrs set before composition carry over
+        cell[0]._set_attr(**node.attrs)
     return None
 
 
@@ -361,13 +366,18 @@ def nd_slice(a, begin, end):
 def sym_get_attr(cell, key):
     """Returns (found, value): an attr explicitly set to "" is found=1
     with an empty value, distinct from unset (reference MXSymbolGetAttr
-    semantics)."""
-    v = _composed(cell).attr(key)
+    semantics). Works on uncomposed atomic handles too."""
+    s = cell[0]
+    v = s.attrs.get(key) if isinstance(s, _AtomicOp) else s.attr(key)
     return (0, "") if v is None else (1, str(v))
 
 
 def sym_set_attr(cell, key, value):
-    _composed(cell)._set_attr(**{key: value})
+    s = cell[0]
+    if isinstance(s, _AtomicOp):
+        s.attrs[key] = str(value)
+    else:
+        s._set_attr(**{key: value})
     return None
 
 

@@ -183,7 +183,15 @@ class DataLoader:
                 yield batch
         finally:
             # clean vars go back to the instance pool (bounded var
-            # table); poisoned ones are dropped
+            # table). An abandoned iterator (consumer break) may still
+            # have collect ops in flight — drain them first so a late
+            # failure can't poison a var AFTER it was pooled
+            if clean:
+                for v in slot_vars:
+                    try:
+                        eng.wait_for_var(v)
+                    except BaseException:
+                        clean = False
             if clean:
                 self._return_vars(eng, slot_vars)
 

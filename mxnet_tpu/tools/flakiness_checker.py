@@ -1,0 +1,64 @@
+"""Rerun a test many times under different seeds to detect flakiness.
+
+Reference: tools/flakiness_checker.py — the reference runs a nosetests
+spec N times with MXNET_TEST_SEED randomized; here the runner is pytest
+and the seed knob is the same MXNET_TEST_SEED consumed by
+``mxnet_tpu.test_utils.with_seed``.
+
+    python -m mxnet_tpu.tools.flakiness_checker \
+        tests/test_op_dtype_sweep.py::test_op_dtype -n 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+DEFAULT_TRIALS = 10
+
+
+def check_test(test_spec, trials=DEFAULT_TRIALS, seed=None, verbose=False):
+    """Run `test_spec` `trials` times; returns (failures, seeds_failed)."""
+    failures = 0
+    seeds_failed = []
+    rng = random.Random(seed)
+    for i in range(trials):
+        test_seed = rng.randrange(0, 2**31)
+        env = dict(os.environ, MXNET_TEST_SEED=str(test_seed))
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-x", "-q", test_spec],
+            env=env, capture_output=True, text=True)
+        ok = proc.returncode == 0
+        if not ok:
+            failures += 1
+            seeds_failed.append(test_seed)
+        if verbose or not ok:
+            tail = proc.stdout.strip().splitlines()
+            print(f"[{i + 1}/{trials}] seed={test_seed} "
+                  f"{'PASS' if ok else 'FAIL'}"
+                  + ("" if ok else f"  ({tail[-1] if tail else ''})"))
+    return failures, seeds_failed
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("test", help="pytest spec (file[::test])")
+    p.add_argument("-n", "--trials", type=int, default=DEFAULT_TRIALS)
+    p.add_argument("-s", "--seed", type=int, default=None,
+                   help="meta-seed for the per-trial seed sequence")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    failures, seeds = check_test(args.test, args.trials, args.seed,
+                                 args.verbose)
+    if failures:
+        print(f"FLAKY: {failures}/{args.trials} trials failed; "
+              f"reproduce with MXNET_TEST_SEED in {seeds}")
+        return 1
+    print(f"stable: {args.trials}/{args.trials} trials passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

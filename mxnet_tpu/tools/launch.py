@@ -28,18 +28,25 @@ __all__ = ["main", "init"]
 
 
 def init():
-    """Initialize jax.distributed from launcher-provided env (call
-    first in the training script; replaces the reference's implicit
-    ps-lite bootstrap in kvstore.create('dist_*'))."""
+    """Join the jax.distributed cluster from launcher-provided env.
+
+    ``import mxnet_tpu`` already does this automatically when the
+    launcher env is present (mxnet_tpu/__init__.py
+    _maybe_init_distributed — the import touches the XLA backend, so
+    the rendezvous must happen before/with it). Calling this explicitly
+    is supported for scripts that import bare jax first. Returns True
+    when the launcher env was present."""
     coord = os.environ.get("MXNET_COORDINATOR")
     if not coord:
         return False
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coord,
-        num_processes=int(os.environ["MXNET_NUM_PROCESSES"]),
-        process_id=int(os.environ["MXNET_PROCESS_ID"]))
+    if not jax.distributed.is_initialized():
+        # rendezvous failures propagate — never run un-joined
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXNET_NUM_PROCESSES"]),
+            process_id=int(os.environ["MXNET_PROCESS_ID"]))
     return True
 
 

@@ -662,3 +662,27 @@ def test_misc_abi_surface(capi, exported_mlp):
     assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
     assert rank.value == 0 and size.value >= 1
     lib.MXKVStoreFree(kv)
+
+
+def test_attr_on_uncomposed_atomic_symbol(capi):
+    """Reference ordering: SetAttr on an atomic symbol BEFORE Compose;
+    the attr must survive composition."""
+    lib = _train_argtypes(capi)
+    vp, cp, c_int = ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    fc = vp()
+    lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", 1,
+                                   (cp * 1)(b"num_hidden"), (cp * 1)(b"2"),
+                                   ctypes.byref(fc))
+    assert lib.MXSymbolSetAttr(fc, b"__lr_mult__", b"3.0") == 0, _err(capi)
+    val = cp(); ok = c_int()
+    assert lib.MXSymbolGetAttr(fc, b"__lr_mult__", ctypes.byref(val),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and val.value == b"3.0"
+    data = vp()
+    lib.MXSymbolCreateVariable(b"data", ctypes.byref(data))
+    assert lib.MXSymbolCompose(fc, b"fc", 1, None, (vp * 1)(data)) == 0
+    assert lib.MXSymbolGetAttr(fc, b"__lr_mult__", ctypes.byref(val),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and val.value == b"3.0"
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(data)

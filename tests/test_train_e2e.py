@@ -1,0 +1,127 @@
+"""Small end-to-end training convergence tests (reference:
+tests/python/train/{test_mlp,test_conv,test_dtype}.py — real convergence
+assertions on tiny data, the layer of the reference test pyramid between
+op unit tests and nightly full-model runs)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import with_seed
+
+
+def _two_moons(n=256, seed=0):
+    """Separable 2-class blobs."""
+    rs = onp.random.RandomState(seed)
+    X = rs.randn(n, 8).astype("f")
+    y = (X[:, :4].sum(1) > X[:, 4:].sum(1)).astype("f")
+    return X, y
+
+
+def _train(net, X, y, steps=40, lr=0.1, loss_fn=None):
+    loss_fn = loss_fn or gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    first = last = None
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        last = float(loss.asscalar())
+        first = first if first is not None else last
+    return first, last
+
+
+@with_seed(1)
+def test_mlp_converges():
+    """Reference: tests/python/train/test_mlp.py."""
+    X, y = _two_moons()
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    first, last = _train(net, X, y)
+    assert last < first * 0.3, (first, last)
+    # accuracy on the training set should be near-perfect
+    pred = net(nd.array(X)).asnumpy().argmax(1)
+    assert (pred == y).mean() > 0.95
+
+
+@with_seed(2)
+def test_conv_converges():
+    """Reference: tests/python/train/test_conv.py (LeNet-ish on tiny
+    synthetic images)."""
+    rs = onp.random.RandomState(2)
+    X = rs.rand(128, 1, 12, 12).astype("f")
+    # class = which quadrant carries the bright blob
+    y = rs.randint(0, 2, 128).astype("f")
+    X[y == 1, :, :6, :6] += 2.0
+    X[y == 0, :, 6:, 6:] += 2.0
+    mx.random.seed(2)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    first, last = _train(net, X, y, steps=30, lr=0.05)
+    assert last < first * 0.3, (first, last)
+
+
+@with_seed(3)
+def test_bf16_training_converges():
+    """Reference: tests/python/train/test_dtype.py (fp16 training) —
+    recast for TPU: bf16 compute on fp32 masters via SPMDTrainer."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    X, y = _two_moons(seed=3)
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, compute_dtype="bfloat16")
+    first = last = None
+    for _ in range(40):
+        loss = trainer.step(nd.array(X), nd.array(y))
+        last = float(loss.asscalar())
+        first = first if first is not None else last
+    assert last < first * 0.5, (first, last)
+    # master weights stay fp32 even though compute ran bf16
+    for _, p in net.collect_params().items():
+        assert str(trainer._param_vals[0].dtype) == "float32"
+        break
+
+
+@with_seed(4)
+def test_module_fit_converges():
+    """The symbolic path end to end: Module.fit over NDArrayIter
+    (reference: base_module.fit driving executor forward/backward)."""
+    from mxnet_tpu import sym, io
+    from mxnet_tpu.module import Module
+
+    X, y = _two_moons(seed=4)
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=2)
+    out = sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                            name="softmax")
+    mod = Module(out, context=mx.cpu())
+    train_iter = io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod.fit(train_iter, num_epoch=8,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc")
+    score = mod.score(io.NDArrayIter(X, y, batch_size=64), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.9, acc
