@@ -200,6 +200,11 @@ class Executor:
             fwd_for_vjp = jax.checkpoint(lambda v: fwd_only(v, True))
         else:
             fwd_for_vjp = lambda v: fwd_only(v, True)  # noqa: E731
+        # data parallelism over a device mesh (reference:
+        # DataParallelExecutorGroup batch split, executor_group.py:282 —
+        # here ONE computation with batch inputs sharded over 'dp';
+        # GSPMD inserts the gradient all-reduces the reference ran
+        # through kvstore device comm)
         self._grad_jit = jax.jit(jax.grad(loss_fn))
 
         def head_vjp(vals, cots):
@@ -207,6 +212,50 @@ class Executor:
             return vjp_fn(cots)[0]
 
         self._head_vjp_jit = jax.jit(head_vjp)
+
+    # ---- data parallelism over a mesh -----------------------------------
+    def _mesh(self):
+        """A 1-axis 'dp' mesh when bound to MULTIPLE contexts
+        (reference: Module(context=[...]) → executor group)."""
+        ctxs = self._ctx if isinstance(self._ctx, (list, tuple)) else None
+        if not ctxs or len(ctxs) < 2:
+            return None
+        from jax.sharding import Mesh
+
+        import numpy as onp
+
+        return Mesh(onp.array([c.jax_device for c in ctxs]), ("dp",))
+
+    def set_batch_names(self, names):
+        """Arguments sharded on the batch axis under a multi-context
+        bind (data + labels); everything else replicates. The sharding
+        list is built ONCE here — it is invariant per bind, and the
+        training hot loop places vals with it every step."""
+        self._batch_names = set(names)
+        self._shard_cache = self._build_val_shardings()
+
+    def _place_vals(self, vals, shard):
+        """Commit vals to the dp-mesh layout (batch args split over
+        'dp', the rest replicated); jit then compiles the sharded
+        computation and GSPMD inserts the collectives. Identity on a
+        single-context bind."""
+        if shard is None:
+            return vals
+        return [jax.device_put(v, s) for v, s in zip(vals, shard)]
+
+    def _val_shardings(self):
+        return getattr(self, "_shard_cache", None)
+
+    def _build_val_shardings(self):
+        mesh = self._mesh()
+        if mesh is None or not getattr(self, "_batch_names", None):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch = NamedSharding(mesh, P("dp"))
+        rep = NamedSharding(mesh, P())
+        return [batch if n in self._batch_names else rep
+                for n in self.arg_names + self.aux_names]
 
     def forward(self, is_train=False, **kwargs):
         """Reference: executor.py forward / GraphExecutor::RunOps."""
@@ -218,7 +267,9 @@ class Executor:
                     f"are {self.arg_names}")
             self.arg_dict[k]._data = v.data if isinstance(v, NDArray) \
                 else jnp.asarray(v)
-        vals = [a.data for a in self.arg_arrays + self.aux_arrays]
+        vals = self._place_vals(
+            [a.data for a in self.arg_arrays + self.aux_arrays],
+            self._val_shardings())
         if is_train and self.aux_arrays:
             outs, aux_new = self._fwd_full_jit(vals, True)
             for arr, new in zip(self.aux_arrays, aux_new):
@@ -236,7 +287,9 @@ class Executor:
         if self.grad_arrays is None or self.grad_req == "null":
             return
         self._ensure_fwd()
-        vals = [a.data for a in self.arg_arrays + self.aux_arrays]
+        shard = self._val_shardings()
+        vals = self._place_vals(
+            [a.data for a in self.arg_arrays + self.aux_arrays], shard)
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -245,9 +298,15 @@ class Executor:
             grads = self._head_vjp_jit(vals, cots)
         else:
             grads = self._grad_jit(vals)
+        mesh_active = shard is not None
         for name, garr, g in zip(self.arg_names, self.grad_arrays, grads):
             if garr is None:
                 continue
+            if mesh_active:
+                # grads land replicated over the dp mesh; the eager
+                # update path (updater/kvstore) runs on the arrays'
+                # home device — bring them back (cheap: replicated)
+                g = jax.device_put(g, garr.data.sharding)
             if self.grad_req == "add":
                 garr._data = garr.data + g
             else:

@@ -1,3 +1,4 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/__init__.py)."""
 from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
 from . import estimator  # noqa: F401

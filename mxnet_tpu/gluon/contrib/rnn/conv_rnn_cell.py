@@ -1,0 +1,183 @@
+"""Convolutional recurrent cells (reference:
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py — _BaseConvRNNCell and
+the Conv{1,2,3}D{RNN,LSTM,GRU}Cell family).
+
+State carries spatial structure: gates are computed by an input conv
+plus a state conv instead of two matmuls — on TPU both lower to XLA
+conv_general_dilated on the MXU, so a conv-LSTM step is exactly as
+MXU-friendly as a dense LSTM step of the same FLOPs.
+"""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * n
+
+
+class _ConvRNNCellBase(HybridRecurrentCell):
+    """Shared machinery: i2h/h2h convolutions producing `ngates *
+    hidden_channels` feature maps. `input_shape` = (C, *spatial) is
+    required up front (reference conv cells require it too — the state
+    shape must be known before the first step)."""
+
+    _ngates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(int(s) for s in input_shape)
+        dims = len(self._input_shape) - 1
+        if dims not in (1, 2, 3):
+            raise ValueError(
+                f"input_shape must be (C, *spatial) with 1-3 spatial "
+                f"dims, got {input_shape}")
+        self._dims = dims
+        self._hidden_channels = int(hidden_channels)
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    "h2h_kernel dims must be odd (same-size state)")
+        self._i2h_pad = tuple(k // 2 for k in self._i2h_kernel)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        in_c = self._input_shape[0]
+        out_c = self._ngates * self._hidden_channels
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(out_c, in_c) + self._i2h_kernel,
+                init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(out_c, self._hidden_channels) + self._h2h_kernel,
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(out_c,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(out_c,), init=h2h_bias_initializer)
+
+    _num_states = 1
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + \
+            self._input_shape[1:]
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._dims:]}
+                for _ in range(self._num_states)]
+
+    def _gates(self, F, inputs, prev_h, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        out_c = self._ngates * self._hidden_channels
+        i2h = F.convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=out_c)
+        h2h = F.convolution(prev_h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=out_c)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        if self._activation in ("relu", "tanh", "sigmoid", "softrelu"):
+            return F.activation(x, act_type=self._activation)
+        return getattr(F, self._activation)(x)
+
+
+class _ConvRNNCell(_ConvRNNCellBase):
+    """h' = act(conv(x) + conv(h)) (reference _ConvRNNCell)."""
+
+    _ngates = 1
+    _num_states = 1
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states[0], i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvRNNCellBase):
+    """Shi et al. ConvLSTM (reference _ConvLSTMCell; gate order i,f,g,o
+    matching the dense LSTMCell/cuDNN layout)."""
+
+    _ngates = 4
+    _num_states = 2
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states[0], i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        ig, fg, gg, og = F.split(gates, num_outputs=4, axis=1)
+        ig = F.sigmoid(ig)
+        fg = F.sigmoid(fg)
+        gg = self._act(F, gg)
+        og = F.sigmoid(og)
+        next_c = fg * states[1] + ig * gg
+        next_h = og * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_ConvRNNCellBase):
+    """Conv GRU (reference _ConvGRUCell; gate order r,z,n)."""
+
+    _ngates = 3
+    _num_states = 1
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states[0], i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        cand = self._act(F, i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
+
+
+def _specialize(base, dims, name, doc_ref):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, **kwargs):
+        if len(tuple(input_shape)) != dims + 1:
+            raise ValueError(
+                f"{name} expects input_shape=(C, {dims} spatial dims), "
+                f"got {input_shape}")
+        base.__init__(self, input_shape, hidden_channels,
+                      i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                      **kwargs)
+
+    cls = type(name, (base,), {
+        "__init__": __init__,
+        "__doc__": f"Reference: conv_rnn_cell.py {doc_ref}."})
+    return cls
+
+
+Conv1DRNNCell = _specialize(_ConvRNNCell, 1, "Conv1DRNNCell",
+                            "Conv1DRNNCell")
+Conv2DRNNCell = _specialize(_ConvRNNCell, 2, "Conv2DRNNCell",
+                            "Conv2DRNNCell")
+Conv3DRNNCell = _specialize(_ConvRNNCell, 3, "Conv3DRNNCell",
+                            "Conv3DRNNCell")
+Conv1DLSTMCell = _specialize(_ConvLSTMCell, 1, "Conv1DLSTMCell",
+                             "Conv1DLSTMCell")
+Conv2DLSTMCell = _specialize(_ConvLSTMCell, 2, "Conv2DLSTMCell",
+                             "Conv2DLSTMCell")
+Conv3DLSTMCell = _specialize(_ConvLSTMCell, 3, "Conv3DLSTMCell",
+                             "Conv3DLSTMCell")
+Conv1DGRUCell = _specialize(_ConvGRUCell, 1, "Conv1DGRUCell",
+                            "Conv1DGRUCell")
+Conv2DGRUCell = _specialize(_ConvGRUCell, 2, "Conv2DGRUCell",
+                            "Conv2DGRUCell")
+Conv3DGRUCell = _specialize(_ConvGRUCell, 3, "Conv3DGRUCell",
+                            "Conv3DGRUCell")

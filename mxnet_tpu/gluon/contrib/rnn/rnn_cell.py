@@ -1,0 +1,115 @@
+"""Contrib recurrent cells (reference:
+python/mxnet/gluon/contrib/rnn/rnn_cell.py — VariationalDropoutCell,
+LSTMPCell)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Gal & Ghahramani variational dropout: ONE dropout mask per unroll
+    for each of inputs/states/outputs, reused at every time step
+    (reference contrib rnn_cell.py VariationalDropoutCell — a fresh mask
+    per step would be ordinary DropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        # masks are PER-UNROLL: a new sequence draws new masks
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, name, p, like):
+        cached = getattr(self, name)
+        if cached is None:
+            # Dropout(ones) IS the (scaled) mask; sampled once, reused
+            cached = F.dropout(F.ones_like(like), p=p)
+            setattr(self, name, cached)
+        return cached
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs > 0.:
+            inputs = inputs * self._mask(F, "_input_mask",
+                                         self.drop_inputs, inputs)
+        if self.drop_states > 0.:
+            states = [states[0] * self._mask(F, "_state_mask",
+                                             self.drop_states, states[0])
+                      ] + list(states[1:])  # mask h only, never the cell
+        output, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs > 0.:
+            output = output * self._mask(F, "_output_mask",
+                                         self.drop_outputs, output)
+        return output, next_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs}, "
+                f"base={type(self.base_cell).__name__})")
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projection layer on the hidden state (reference
+    contrib rnn_cell.py LSTMPCell, after Sak et al. 2014): the recurrent
+    state is the PROJECTED h (size projection_size), the cell state
+    keeps hidden_size — cuts the h2h matmul from h*4h to p*4h."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = int(hidden_size)
+        self._projection_size = int(projection_size)
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        prev_r, prev_c = states
+        i2h = F.fully_connected(inputs, i2h_weight, i2h_bias,
+                                num_hidden=4 * self._hidden_size)
+        h2h = F.fully_connected(prev_r, h2h_weight, h2h_bias,
+                                num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        ig, fg, gg, og = F.split(gates, num_outputs=4, axis=-1)
+        next_c = F.sigmoid(fg) * prev_c + F.sigmoid(ig) * F.tanh(gg)
+        next_h = F.sigmoid(og) * F.tanh(next_c)
+        next_r = F.fully_connected(next_h, h2r_weight, no_bias=True,
+                                   num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

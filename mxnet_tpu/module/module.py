@@ -104,8 +104,16 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, tuple(o.shape)) for n, o in
-                zip(self.output_names, self._exec.outputs)]
+        if self._exec.outputs:
+            return [(n, tuple(o.shape)) for n, o in
+                    zip(self.output_names, self._exec.outputs)]
+        # before the first forward: simple_bind's inferred shapes
+        # (reference keeps them from bind — executor.output_shapes)
+        if not self._exec.output_shapes:
+            raise MXNetError(
+                "output shapes unavailable (bind-time inference was "
+                "invalidated by reshape) — run forward() once first")
+        return list(zip(self.output_names, self._exec.output_shapes))
 
     def _param_names(self):
         inputs = set(self._data_names) | set(self._label_names)
@@ -128,6 +136,28 @@ class Module(BaseModule):
         self._exec = self._symbol.simple_bind(
             ctx=self._context, grad_req=grad_req if for_training else "null",
             **shapes)
+        if isinstance(self._context, (list, tuple)) and \
+                len(self._context) > 1:
+            # multi-context bind = data parallelism: ONE computation with
+            # batch inputs sharded over a 'dp' mesh of those devices
+            # (reference: DataParallelExecutorGroup batch split,
+            # executor_group.py:144; GSPMD inserts the grad all-reduce).
+            # Fail HERE with a clear message, not deep inside the first
+            # forward's device_put:
+            devs = [c.jax_device for c in self._context]
+            if len({id(d) for d in devs}) != len(devs):
+                raise MXNetError(
+                    f"multi-context bind needs DISTINCT devices; "
+                    f"{self._context} map to {devs} (this host exposes "
+                    f"fewer jax devices than contexts)")
+            ndev = len(devs)
+            for d in self._data_shapes + self._label_shapes:
+                if d.shape and d.shape[0] % ndev:
+                    raise MXNetError(
+                        f"batch dim of '{d.name}' ({d.shape[0]}) must "
+                        f"divide evenly over {ndev} contexts")
+            self._exec.set_batch_names(
+                [d.name for d in self._data_shapes + self._label_shapes])
         self.binded = True
         self.for_training = for_training
 

@@ -234,3 +234,75 @@ def test_contrib_tail_camelcase_aliases():
     for name in ("Proposal", "MultiProposal", "PSROIPooling",
                  "DeformableConvolution", "DeformablePSROIPooling"):
         assert hasattr(nd.contrib, name)
+
+
+# ---- contrib rnn cells (conv + variational dropout + LSTMP) --------------
+
+def test_conv2d_lstm_cell_unroll():
+    from mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+
+    mx.random.seed(0)
+    cell = Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=5,
+                          i2h_kernel=3, h2h_kernel=3)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).rand(4, 2, 3, 8, 8)
+                 .astype("f"))  # (T, N, C, H, W) under TNC layout
+    outputs, states = cell.unroll(4, x, layout="TNC",
+                                  merge_outputs=False)
+    assert len(outputs) == 4
+    assert outputs[0].shape == (2, 5, 8, 8)
+    assert states[0].shape == (2, 5, 8, 8)  # h
+    assert states[1].shape == (2, 5, 8, 8)  # c
+
+
+def test_conv1d_gru_and_rnn_cells():
+    from mxnet_tpu.gluon.contrib.rnn import Conv1DGRUCell, Conv1DRNNCell
+
+    for cls, nstates in ((Conv1DGRUCell, 1), (Conv1DRNNCell, 1)):
+        cell = cls(input_shape=(2, 10), hidden_channels=4)
+        cell.initialize()
+        x = nd.ones((3, 2, 10))
+        states = cell.begin_state(batch_size=3)
+        assert len(states) == nstates
+        out, new_states = cell(x, states)
+        assert out.shape == (3, 4, 10)
+
+
+def test_variational_dropout_mask_constant_across_steps():
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+    from mxnet_tpu.gluon.rnn import RNNCell
+    from mxnet_tpu import autograd
+
+    mx.random.seed(3)
+    base = RNNCell(6)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    with autograd.record():  # dropout actually samples in train mode
+        cell.reset()
+        x = nd.ones((2, 4))
+        s = cell.begin_state(batch_size=2)
+        cell(x, s)
+        m1 = cell._input_mask.asnumpy()
+        cell(x, s)
+        m2 = cell._input_mask.asnumpy()
+    onp.testing.assert_array_equal(m1, m2)  # SAME mask both steps
+    with autograd.record():
+        cell.reset()  # new unroll -> new mask (overwhelmingly likely)
+        cell(x, s)
+        m3 = cell._input_mask.asnumpy()
+    assert m1.shape == m3.shape
+    assert (m1 != m3).any()
+
+
+def test_lstmp_cell_projection_shapes():
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+
+    cell = LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = nd.ones((5, 7))
+    states = cell.begin_state(batch_size=5)
+    assert states[0].shape == (5, 3)   # projected recurrent state
+    assert states[1].shape == (5, 8)   # cell state
+    out, (r, c) = cell(x, states)
+    assert out.shape == (5, 3)
+    assert r.shape == (5, 3) and c.shape == (5, 8)

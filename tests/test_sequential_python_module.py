@@ -1,0 +1,95 @@
+"""SequentialModule + PythonModule/PythonLossModule (reference:
+python/mxnet/module/{sequential_module,python_module}.py +
+tests/python/unittest/test_module.py test_module_layout chains)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, io
+from mxnet_tpu.module import (Module, PythonLossModule, SequentialModule)
+from mxnet_tpu.test_utils import with_seed
+
+
+def _data(n=128, seed=0):
+    rs = onp.random.RandomState(seed)
+    X = rs.randn(n, 6).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    return X, y
+
+
+def _features_module():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="feat_fc", num_hidden=16)
+    act = sym.Activation(fc, name="feat_act", act_type="relu")
+    return Module(act, label_names=[], context=mx.cpu())
+
+
+@with_seed(11)
+def test_sequential_module_trains():
+    X, y = _data()
+    head_in = sym.Variable("data")
+    out = sym.SoftmaxOutput(
+        sym.FullyConnected(head_in, name="head_fc", num_hidden=2),
+        sym.Variable("softmax_label"), name="softmax")
+    seq = SequentialModule()
+    seq.add(_features_module(), auto_wiring=True) \
+       .add(Module(out, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=[("data", (32, 6))],
+             label_shapes=[("softmax_label", (32,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    it = io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    for epoch in range(6):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+@with_seed(12)
+def test_python_loss_module_chain():
+    """PythonLossModule as chain head: python-computed softmax-CE grad
+    flows back into the symbolic features module."""
+    X, y = _data(seed=5)
+    scores_in = sym.Variable("data")
+    scores = sym.FullyConnected(scores_in, name="sc_fc", num_hidden=2)
+    seq = SequentialModule()
+    seq.add(Module(scores, label_names=[], context=mx.cpu()),
+            auto_wiring=True) \
+       .add(PythonLossModule(data_names=("data",),
+                             label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (64, 6))],
+             label_shapes=[("softmax_label", (64,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    it = io.NDArrayIter(X, y, batch_size=64)
+    def nll():
+        it.reset()
+        tot = n = 0
+        for batch in it:
+            seq.forward(batch, is_train=False)
+            s = seq.get_outputs()[0].asnumpy()
+            e = onp.exp(s - s.max(1, keepdims=True))
+            p = e / e.sum(1, keepdims=True)
+            lab = batch.label[0].asnumpy().astype(int)
+            tot += -onp.log(p[onp.arange(len(lab)), lab] + 1e-9).sum()
+            n += len(lab)
+        return tot / n
+
+    first = nll()
+    for _ in range(40):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    assert nll() < first * 0.6, (first, nll())
