@@ -233,15 +233,28 @@ class Executor:
         training hot loop places vals with it every step."""
         self._batch_names = set(names)
         self._shard_cache = self._build_val_shardings()
+        if self._shard_cache is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # outputs (and therefore head cotangents) are batch-sharded
+            self._batch_shard = NamedSharding(self._mesh(), P("dp"))
 
     def _place_vals(self, vals, shard):
         """Commit vals to the dp-mesh layout (batch args split over
         'dp', the rest replicated); jit then compiles the sharded
         computation and GSPMD inserts the collectives. Identity on a
-        single-context bind."""
+        single-context bind. The placement is memoized on the val
+        identities: a forward→backward pair places ONCE instead of
+        broadcasting every replicated param twice per step."""
         if shard is None:
             return vals
-        return [jax.device_put(v, s) for v, s in zip(vals, shard)]
+        cache = getattr(self, "_place_cache", None)
+        if cache is not None and len(cache[0]) == len(vals) and \
+                all(a is b for a, b in zip(cache[0], vals)):
+            return cache[1]
+        placed = [jax.device_put(v, s) for v, s in zip(vals, shard)]
+        self._place_cache = (list(vals), placed)
+        return placed
 
     def _val_shardings(self):
         return getattr(self, "_shard_cache", None)
@@ -295,6 +308,12 @@ class Executor:
                 out_grads = [out_grads]
             cots = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
+            if shard is not None:
+                # head cotangents are batch-shaped: commit them to the
+                # mesh like the outputs, or the jit sees mesh vals +
+                # single-device cots and rejects the mix
+                cots = tuple(jax.device_put(c, self._batch_shard)
+                             for c in cots)
             grads = self._head_vjp_jit(vals, cots)
         else:
             grads = self._grad_jit(vals)

@@ -118,14 +118,20 @@ class PythonLossModule(PythonModule):
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
-        if data_batch.label:
-            self._labels = data_batch.label[0]
+        # labels track THIS batch: clearing on unlabeled batches keeps
+        # backward from silently differentiating a previous batch
+        self._labels = data_batch.label[0] if data_batch.label else None
 
     def get_outputs(self, merge_multi_context=True):
         return [self._scores]
 
     def backward(self, out_grads=None):
         assert out_grads is None, "pyloss is a chain head"
+        if self._labels is None:
+            raise ValueError(
+                "PythonLossModule.backward needs labels: forward ran "
+                "without them — add it to the chain with "
+                "take_labels=True (or feed batch labels)")
         if self._grad_func is not None:
             g = self._grad_func(self._scores, self._labels)
             self._scores_grad = g if isinstance(g, nd.NDArray) \

@@ -1,2 +1,8 @@
-"""Tooling (reference: tools/ — im2rec, launch.py)."""
+"""Tooling (reference: tools/ — im2rec, launch.py, bandwidth,
+parse_log, diagnose, flakiness_checker, kill-mxnet)."""
 from . import im2rec  # noqa: F401
+from . import launch  # noqa: F401
+from . import parse_log  # noqa: F401
+from . import diagnose  # noqa: F401
+from . import flakiness_checker  # noqa: F401
+from . import kill_mxnet  # noqa: F401

@@ -1,0 +1,83 @@
+"""Kill stray mxnet_tpu worker processes (reference: tools/kill-mxnet.py
+— which pkills python jobs on every host of a dist training run).
+
+    python -m mxnet_tpu.tools.kill_mxnet [pattern]
+
+Finds processes whose command line mentions the pattern (default:
+mxnet_tpu launcher workers, i.e. MXNET_COORDINATOR in the environ) and
+SIGTERMs them; -9 escalates.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def _ancestors():
+    """Our own process-ancestor chain (never kill the shell that ran us
+    just because its command line quotes the pattern)."""
+    chain = set()
+    pid = os.getpid()
+    while pid > 1:
+        chain.add(pid)
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+    return chain
+
+
+def find_workers(pattern=None):
+    """(pid, cmdline) of candidate processes, never ourselves or our
+    ancestors."""
+    skip = _ancestors()
+    out = []
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) in skip:
+            continue
+        pid = int(pid_s)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            if pattern:
+                hit = pattern in cmd
+            else:
+                with open(f"/proc/{pid}/environ", "rb") as f:
+                    hit = b"MXNET_COORDINATOR=" in f.read()
+                hit = hit or "mxnet_tpu.tools.launch" in cmd
+        except OSError:
+            continue
+        if hit:
+            out.append((pid, cmd.strip()))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("pattern", nargs="?", default=None,
+                   help="cmdline substring (default: launcher workers)")
+    p.add_argument("-9", dest="kill9", action="store_true",
+                   help="SIGKILL instead of SIGTERM")
+    p.add_argument("-n", "--dry-run", action="store_true")
+    args = p.parse_args(argv)
+    victims = find_workers(args.pattern)
+    if not victims:
+        print("no matching processes")
+        return 0
+    sig = signal.SIGKILL if args.kill9 else signal.SIGTERM
+    for pid, cmd in victims:
+        print(f"{'would kill' if args.dry_run else 'killing'} "
+              f"{pid}: {cmd[:100]}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, sig)
+            except OSError as e:
+                print(f"  failed: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
