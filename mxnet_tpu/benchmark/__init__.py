@@ -1,0 +1,3 @@
+"""Operator performance harness (reference: benchmark/opperf/)."""
+from .opperf import (run_performance_test, run_op_suite,  # noqa: F401
+                     DEFAULT_SUITE)

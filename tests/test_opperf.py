@@ -1,0 +1,32 @@
+"""Per-op perf harness (reference: benchmark/opperf/ — here a smoke of
+the measurement contract, not a perf assertion: timings exist, flops
+columns appear where defined, subsets and unknown ops behave)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.benchmark import run_performance_test, run_op_suite
+
+
+def test_run_performance_test_contract():
+    r = run_performance_test(lambda a, b: nd.dot(a, b),
+                             inputs=[(64, 64), (64, 64)],
+                             flops=2 * 64 ** 3, runs=2, warmup=1)
+    assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
+    assert r["fwd_gflops"] > 0
+    assert r["inputs"] == [[64, 64], [64, 64]]
+
+
+def test_run_performance_test_bf16_and_no_backward():
+    r = run_performance_test(lambda a: nd.exp(a), inputs=[(32, 32)],
+                             dtype="bfloat16", run_backward=False,
+                             runs=2, warmup=1)
+    assert r["dtype"] == "bfloat16"
+    assert "fwd_bwd_ms" not in r
+
+
+def test_suite_subset_and_unknown():
+    out = run_op_suite(["dot", "softmax"], runs=2, warmup=1)
+    assert [r["op"] for r in out] == ["dot", "softmax"]
+    with pytest.raises(ValueError, match="unknown suite ops"):
+        run_op_suite(["definitely_not_an_op"])
