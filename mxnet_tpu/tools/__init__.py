@@ -4,5 +4,6 @@ from . import im2rec  # noqa: F401
 from . import launch  # noqa: F401
 from . import parse_log  # noqa: F401
 from . import diagnose  # noqa: F401
-from . import flakiness_checker  # noqa: F401
-from . import kill_mxnet  # noqa: F401
+# flakiness_checker / kill_mxnet / amalgamate are CLI entry points —
+# importing them eagerly would trip runpy's double-import warning under
+# `python -m mxnet_tpu.tools.<name>`; reach them as submodules
