@@ -85,6 +85,41 @@ CASES = {
     "np_matmul": (lambda a, b: mx.np.matmul(a, b), [(3, 4), (4, 2)]),
     "np_einsum": (lambda a, b: mx.np.einsum("ij,jk->ik", a, b),
                   [(2, 3), (3, 2)]),
+    # more elemwise/special
+    "hypot": (lambda a, b: nd.hypot(a, b), [(3, 4), (3, 4)]),
+    "erf": (lambda a: nd.erf(a), [(5,)]),
+    "log1p": (lambda a: nd.log1p(nd.abs(a)), [(6,)]),
+    "sign": (lambda a: nd.sign(a), [(4, 4)]),
+    "square": (lambda a: nd.square(a), [(4, 4)]),
+    "smooth_l1": (lambda a: nd.smooth_l1(a, scalar=1.0), [(3, 5)]),
+    "hard_sigmoid": (lambda a: nd.hard_sigmoid(a), [(2, 6)]),
+    "softsign": (lambda a: nd.softsign(a), [(2, 6)]),
+    # more reductions/shape
+    "prod": (lambda a: nd.prod(nd.abs(a) + 0.5, axis=1), [(3, 4)]),
+    "min_axis": (lambda a: nd.min(a, axis=0), [(4, 3)]),
+    "repeat": (lambda a: nd.repeat(a, repeats=3, axis=0), [(2, 3)]),
+    "expand_squeeze": (
+        lambda a: nd.squeeze(nd.expand_dims(a, axis=1), axis=1),
+        [(4, 5)]),
+    "flip": (lambda a: nd.flip(a, axis=1), [(3, 4)]),
+    "depth_to_space": (lambda a: nd.depth_to_space(a, block_size=2),
+                       [(1, 8, 3, 3)]),
+    "one_hot": (lambda i: nd.one_hot(i, depth=5), [(6,)]),
+    "pick": (lambda a, i: nd.pick(a, i, axis=1), [(4, 5), (4,)]),
+    "gather_nd": (lambda a, i: nd.gather_nd(a, i), [(4, 5), (2, 3)]),
+    "diag": (lambda a: nd.diag(a), [(4, 4)]),
+    # more NN
+    "global_avg_pool": (
+        lambda x: nd.Pooling(x, pool_type="avg", global_pool=True,
+                             kernel=(1, 1)),
+        [(2, 3, 6, 6)]),
+    "instance_norm": (
+        lambda x, g, b: nd.InstanceNorm(x, g, b), [(2, 3, 7), (3,), (3,)]),
+    "l2_normalization": (
+        lambda x: nd.L2Normalization(x, mode="instance"), [(4, 6)]),
+    "group_norm": (
+        lambda x, g, b: nd.GroupNorm(x, g, b, num_groups=2),
+        [(2, 4, 5), (2,), (2,)]),
 }
 
 DTYPES = ["float32", "float16", "bfloat16"]
@@ -93,6 +128,14 @@ DTYPES = ["float32", "float16", "bfloat16"]
 def _gen(rng, shape, name):
     if name in ("take", "embedding") and shape == (6,):
         return rng.randint(0, 10, shape).astype("f")
+    if name == "one_hot" and shape == (6,):
+        return rng.randint(0, 5, shape).astype("f")
+    if name == "pick" and shape == (4,):
+        return rng.randint(0, 5, shape).astype("f")
+    if name == "gather_nd" and shape == (2, 3):
+        # row 0: indices into dim0 (<4), row 1: into dim1 (<5)
+        return onp.stack([rng.randint(0, 4, 3),
+                          rng.randint(0, 5, 3)]).astype("f")
     if name == "sequence_mask" and shape == (3,):
         return onp.array([2.0, 5.0, 1.0], "f")
     return rng.randn(*shape).astype("f")
@@ -137,7 +180,8 @@ def test_op_dtype(case, dtype):
     # test_operator_gpu.py check_consistency tol tables)
     contraction = {"dot", "batch_dot", "linalg_gemm2", "fully_connected",
                    "convolution", "np_matmul", "np_einsum",
-                   "batch_norm_infer", "layer_norm"}
+                   "batch_norm_infer", "layer_norm", "instance_norm",
+                   "group_norm", "l2_normalization", "prod"}
     if case in contraction and dtype in ("float16", "bfloat16"):
         kwargs = {"rtol": 6e-2, "atol": 2e-2} if dtype == "bfloat16" \
             else {"rtol": 2e-2, "atol": 5e-3}
