@@ -163,6 +163,16 @@ int MXKVStoreGetType(KVStoreHandle kv, const char** out_type);
 int MXKVStoreGetRank(KVStoreHandle kv, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle kv, int* out);
 
+/* Reference-format .params file IO. keys == NULL saves a bare list.
+ * Load returns thread-local storage: the handle array is owned by the
+ * library until this thread's next MXNDArrayLoad (do not free), and
+ * name pointers share the MXSymbolList* buffer lifetime. */
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* handles,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names);
+
 #ifdef __cplusplus
 }
 #endif

@@ -21,12 +21,14 @@ from .ndarray import NDArray
 from .ndarray.ndarray import _TYPE_FLAG_TO_DTYPE, _DTYPE_TO_TYPE_FLAG
 
 __all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_from_bytes",
-           "nd_to_bytes", "invoke", "wait_all", "CPredictor",
+           "nd_to_bytes", "nd_reshape", "nd_slice", "nd_save", "nd_load",
+           "invoke", "wait_all", "CPredictor",
            "sym_var", "sym_create_atomic", "sym_compose", "sym_from_json",
-           "sym_to_json", "sym_list", "exec_simple_bind", "exec_array",
+           "sym_to_json", "sym_list", "sym_get_attr", "sym_set_attr",
+           "exec_simple_bind", "exec_array",
            "exec_forward", "exec_backward", "exec_outputs",
            "kv_create", "kv_set_optimizer", "kv_init", "kv_push",
-           "kv_pull"]
+           "kv_pull", "kv_meta"]
 
 
 def nd_create(shape, dtype_flag):
@@ -389,3 +391,22 @@ def kv_meta(kv, what):
     if what == "num_workers":
         return int(kv.num_workers)
     raise MXNetError(f"unknown kvstore meta '{what}'")
+
+
+def nd_save(fname, keys, vals):
+    """MXNDArraySave: write the reference-format .params file. Pairs,
+    not a dict — the reference writes duplicate names sequentially and
+    a dict would silently drop all but the last."""
+    if keys:
+        nd.save(fname, list(zip(keys, vals)))
+    else:
+        nd.save(fname, list(vals))
+    return None
+
+
+def nd_load(fname):
+    """MXNDArrayLoad: returns (names, arrays); names empty for lists."""
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        return list(loaded.keys()), list(loaded.values())
+    return [], list(loaded)

@@ -573,7 +573,14 @@ def save(fname, data):
 
     if isinstance(data, NDArray):
         data = [data]
-    if isinstance(data, (list, tuple)):
+    if isinstance(data, (list, tuple)) and data and all(
+            isinstance(x, tuple) and len(x) == 2 for x in data):
+        # (name, array) pairs — unlike a dict this keeps DUPLICATE
+        # names, which the reference's list container permits (the C
+        # MXNDArraySave writes entries sequentially)
+        names = [str(k) for k, _ in data]
+        arrays = [v for _, v in data]
+    elif isinstance(data, (list, tuple)):
         names, arrays = [], list(data)
     elif isinstance(data, dict):
         names = [str(k) for k in data]

@@ -846,3 +846,81 @@ MXTPU_API int MXKVStoreGetRank(void* kv, int* out) {
 MXTPU_API int MXKVStoreGetGroupSize(void* kv, int* out) {
   return kv_meta_int(kv, "num_workers", out);
 }
+
+// ------------------------------------------------------------------------
+// NDArray file IO (reference: c_api.cc MXNDArraySave/MXNDArrayLoad) —
+// completes the C training story: a C frontend can checkpoint and
+// restore what it trained.
+// ------------------------------------------------------------------------
+
+namespace {
+
+// thread-local handle storage for MXNDArrayLoad results (the reference
+// ret_buf convention; handles are OWNED here until the next load)
+std::vector<void*>& load_ret() {
+  thread_local std::vector<void*> v;
+  return v;
+}
+
+void clear_load_ret() {  // GIL held
+  for (void* h : load_ret()) Py_DECREF(reinterpret_cast<PyObject*>(h));
+  load_ret().clear();
+}
+
+}  // namespace
+
+MXTPU_API int MXNDArraySave(const char* fname, uint32_t num,
+                            void** handles, const char** keys) {
+  Gil gil;
+  PyObject* ks;
+  if (keys == nullptr) {
+    ks = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    ks = PyList_New(num);
+    for (uint32_t i = 0; i < num; ++i) {
+      PyObject* s = PyUnicode_FromString(keys[i]);
+      if (s == nullptr) {  // invalid UTF-8 key: error, not a NULL slot
+        Py_DECREF(ks);
+        return set_py_error();
+      }
+      PyList_SET_ITEM(ks, i, s);
+    }
+  }
+  PyObject* args = Py_BuildValue("(sNN)", fname, ks,
+                                 handle_list(num, handles));
+  PyObject* r = bridge_call("nd_save", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                            void*** out_arr, uint32_t* out_name_size,
+                            const char*** out_names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* r = bridge_call("nd_load", args);  // (names, arrays)
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  PyObject* names = PyTuple_GET_ITEM(r, 0);
+  PyObject* arrays = PyTuple_GET_ITEM(r, 1);
+  int rc = list_to_names(names, out_name_size, out_names);
+  if (rc != 0) {
+    Py_DECREF(r);
+    return rc;
+  }
+  clear_load_ret();
+  auto& ret = load_ret();
+  Py_ssize_t n = PyList_Size(arrays);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(o);
+    ret.push_back(o);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<uint32_t>(n);
+  *out_arr = ret.data();
+  return 0;
+}
