@@ -397,6 +397,9 @@ def nd_save(fname, keys, vals):
     """MXNDArraySave: write the reference-format .params file. Pairs,
     not a dict — the reference writes duplicate names sequentially and
     a dict would silently drop all but the last."""
+    if keys and len(keys) != len(vals):
+        raise MXNetError(
+            f"MXNDArraySave: {len(keys)} keys for {len(vals)} arrays")
     if keys:
         nd.save(fname, list(zip(keys, vals)))
     else:
@@ -405,8 +408,11 @@ def nd_save(fname, keys, vals):
 
 
 def nd_load(fname):
-    """MXNDArrayLoad: returns (names, arrays); names empty for lists."""
-    loaded = nd.load(fname)
-    if isinstance(loaded, dict):
-        return list(loaded.keys()), list(loaded.values())
-    return [], list(loaded)
+    """MXNDArrayLoad: (names, arrays) with duplicates PRESERVED — the
+    reference returns parallel arrays, unlike python mx.nd.load's
+    dict view."""
+    from .ndarray import ndarray as _impl
+
+    with open(fname, "rb") as f:
+        names, arrays = _impl._load_ref_pairs(f.read())
+    return list(names), list(arrays)

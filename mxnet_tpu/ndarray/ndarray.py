@@ -574,7 +574,8 @@ def save(fname, data):
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)) and data and all(
-            isinstance(x, tuple) and len(x) == 2 for x in data):
+            isinstance(x, tuple) and len(x) == 2 and
+            isinstance(x[0], str) for x in data):
         # (name, array) pairs — unlike a dict this keeps DUPLICATE
         # names, which the reference's list container permits (the C
         # MXNDArraySave writes entries sequentially)
@@ -658,6 +659,18 @@ def _write_ref_params(fname, names, arrays):
 
 
 def _load_ref_params(buf):
+    """Dict (or bare list) view — duplicate names collapse, like the
+    reference's python mx.nd.load."""
+    names, arrays = _load_ref_pairs(buf)
+    if not names:
+        return arrays
+    # reference save_checkpoint prefixes arg:/aux: — strip like mx.mod
+    return {n: a for n, a in zip(names, arrays)}
+
+
+def _load_ref_pairs(buf):
+    """(names, arrays) with duplicates PRESERVED — the C MXNDArrayLoad
+    contract (parallel arrays, all entries)."""
     import struct
 
     off = 16  # past list magic + reserved
@@ -713,10 +726,7 @@ def _load_ref_params(buf):
         off += 8
         names.append(buf[off:off + ln].decode())
         off += ln
-    if not names:
-        return arrays
-    # reference save_checkpoint prefixes arg:/aux: — strip like mx.mod
-    return {n: a for n, a in zip(names, arrays)}
+    return names, arrays
 
 
 def load(fname):

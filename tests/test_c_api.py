@@ -749,5 +749,21 @@ def test_c_ndarray_save_duplicate_keys(capi, tmp_path):
         buf = f.read()
     (count,) = _struct.unpack_from("<Q", buf, 16)
     assert count == 2  # both entries on disk
+    # and MXNDArrayLoad returns BOTH entries (parallel arrays, unlike
+    # the python dict view)
+    u32 = ctypes.c_uint32
+    n = u32(); nn = u32()
+    la = ctypes.POINTER(vp)()
+    ln = ctypes.POINTER(cp)()
+    assert lib.MXNDArrayLoad(fname.encode(), ctypes.byref(n),
+                             ctypes.byref(la), ctypes.byref(nn),
+                             ctypes.byref(ln)) == 0, _err(capi)
+    assert n.value == 2 and nn.value == 2
+    assert ln[0] == b"w" and ln[1] == b"w"
+    back = onp.zeros(2, "f")
+    capi.MXNDArraySyncCopyToCPU(la[0], back.ctypes.data_as(vp), back.nbytes)
+    onp.testing.assert_allclose(back, [1.0, 1.0])
+    capi.MXNDArraySyncCopyToCPU(la[1], back.ctypes.data_as(vp), back.nbytes)
+    onp.testing.assert_allclose(back, [2.0, 2.0])
     for a in arrs:
         capi.MXNDArrayFree(a)

@@ -204,3 +204,58 @@ def test_with_seed_restores_determinism():
     onp.testing.assert_allclose(onp.random.rand(4), a)
     onp.testing.assert_allclose(
         mx.nd.random.uniform(shape=(4,)).asnumpy(), mxa)
+
+
+# ---- backward at reduced precision ---------------------------------------
+
+BWD_CASES = {
+    "fully_connected": (
+        lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=6),
+        [(4, 8), (6, 8), (6,)]),
+    "convolution": (
+        lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3),
+                                       num_filter=4, pad=(1, 1)),
+        [(2, 3, 8, 8), (4, 3, 3, 3), (4,)]),
+    "tanh": (lambda a: nd.tanh(a), [(3, 5)]),
+    "softmax": (lambda a: nd.softmax(a, axis=-1), [(4, 7)]),
+    "layer_norm": (
+        lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1),
+        [(4, 6), (6,), (6,)]),
+    "dot": (lambda a, b: nd.dot(a, b), [(4, 3), (3, 5)]),
+}
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+@pytest.mark.parametrize("case", sorted(BWD_CASES))
+@with_seed(0)
+def test_op_backward_dtype(case, dtype):
+    """Gradients computed at half precision track the fp32 gradients
+    within the contraction rung (reference: fp16 training tests,
+    tests/python/train/test_dtype.py — backward is where precision
+    loss actually bites)."""
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fn, shapes = BWD_CASES[case]
+    rng = onp.random.RandomState(zlib.crc32(case.encode()) % (2**31))
+    inputs = [rng.randn(*s).astype("f") for s in shapes]
+
+    def grads_at(cast):
+        def scalar(*ds):
+            out = fn(*[nd.NDArray(d) for d in ds])
+            return jnp.sum(out.data.astype(jnp.float32) ** 2)
+
+        datas = [jnp.asarray(a).astype(cast) for a in inputs]
+        gs = jax.jit(jax.grad(scalar, argnums=tuple(
+            range(len(datas)))))(*datas)
+        return [onp.asarray(g.astype(jnp.float32)) for g in gs]
+
+    ref = grads_at(jnp.float32)
+    got = grads_at(jnp.dtype(dtype))
+    rtol, atol = (6e-2, 2e-2) if dtype == "bfloat16" else (2e-2, 5e-3)
+    for i, (g, r) in enumerate(zip(got, ref)):
+        onp.testing.assert_allclose(
+            g, r, rtol=rtol, atol=atol * max(1.0, onp.abs(r).max()),
+            err_msg=f"{case} grad[{i}] at {dtype}")
