@@ -410,9 +410,19 @@ def nd_save(fname, keys, vals):
 def nd_load(fname):
     """MXNDArrayLoad: (names, arrays) with duplicates PRESERVED — the
     reference returns parallel arrays, unlike python mx.nd.load's
-    dict view."""
+    dict view. Magic-checked: non-reference formats (the npz container
+    earlier package versions wrote) go through the ordinary loader
+    instead of being misparsed as a header."""
+    import struct
+
     from .ndarray import ndarray as _impl
 
     with open(fname, "rb") as f:
-        names, arrays = _impl._load_ref_pairs(f.read())
-    return list(names), list(arrays)
+        buf = f.read()
+    if len(buf) >= 8 and             struct.unpack_from("<Q", buf, 0)[0] == _impl._LIST_MAGIC:
+        names, arrays = _impl._load_ref_pairs(buf)
+        return list(names), list(arrays)
+    loaded = nd.load_frombuffer(buf)  # npz fallback (magic-checked)
+    if isinstance(loaded, dict):
+        return list(loaded.keys()), list(loaded.values())
+    return [], list(loaded)

@@ -44,11 +44,18 @@ def nd_v2_bytes(arr):
 
 
 def params_bytes(named):
+    return container_bytes([nd_v2_bytes(a) for _, a in named],
+                           [n for n, _ in named])
+
+
+def container_bytes(entries, names):
+    """The 0x112 list container (ndarray.cc:1840) — the ONE framing
+    implementation every era shares."""
     out = [struct.pack("<QQ", 0x112, 0),           # list magic, reserved
-           struct.pack("<Q", len(named))]
-    out += [nd_v2_bytes(a) for _, a in named]
-    out.append(struct.pack("<Q", len(named)))
-    for n, _ in named:
+           struct.pack("<Q", len(entries))]
+    out += entries
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
         b = n.encode()
         out.append(struct.pack("<Q", len(b)) + b)
     return b"".join(out)
@@ -142,6 +149,52 @@ def golden_records():
     ]
 
 
+# -------------------------------------------------- legacy .params eras ---
+
+def nd_v1_bytes(arr):
+    """V1 (0xF993fac8): no stype field; TShape still int32 ndim + int64
+    dims (ndarray.cc:1596 'with int64_t mxnet::TShape',
+    LegacyTShapeLoad -> shape->Load)."""
+    out = [struct.pack("<I", 0xF993FAC8),
+           struct.pack("<i", arr.ndim)]
+    out += [struct.pack("<q", int(d)) for d in arr.shape]
+    out += [struct.pack("<ii", 1, 0),
+            struct.pack("<i", TYPE_FLAGS[str(arr.dtype)]),
+            arr.astype(arr.dtype.newbyteorder("<")).tobytes("C")]
+    return b"".join(out)
+
+
+def nd_ancient_bytes(arr):
+    """Oldest era: the leading uint32 IS the ndim, dims are uint32
+    (LegacyTShapeLoad default branch, ndarray.cc:1683-1697)."""
+    out = [struct.pack("<I", arr.ndim)]
+    out += [struct.pack("<I", int(d)) for d in arr.shape]
+    out += [struct.pack("<ii", 1, 0),
+            struct.pack("<i", TYPE_FLAGS[str(arr.dtype)]),
+            arr.astype(arr.dtype.newbyteorder("<")).tobytes("C")]
+    return b"".join(out)
+
+
+
+
+
+def write_legacy():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3) * 0.5
+    b = np.array([7, 8, 9], np.int32)
+    with open(os.path.join(HERE, "golden_v1.params"), "wb") as f:
+        f.write(container_bytes(
+            [nd_v1_bytes(a), nd_v1_bytes(b)], ["w", "idx"]))
+    with open(os.path.join(HERE, "golden_legacy.params"), "wb") as f:
+        f.write(container_bytes(
+            [nd_ancient_bytes(a), nd_ancient_bytes(b)], ["w", "idx"]))
+    # bare LIST file (no names): reference NDArray::Load permits
+    # keys.size()==0 (ndarray.cc:1864)
+    with open(os.path.join(HERE, "golden_list.params"), "wb") as f:
+        f.write(container_bytes([nd_v2_bytes(a)], []))
+    print("wrote golden_v1.params, golden_legacy.params, "
+          "golden_list.params")
+
+
 def main():
     with open(os.path.join(HERE, "golden_v2.params"), "wb") as f:
         f.write(params_bytes([(n, a) for n, a in golden_arrays()]))
@@ -158,6 +211,7 @@ def main():
         for i, off in enumerate(offsets):
             f.write(f"{i}\t{off}\n")
     print("wrote golden_v2.params, golden-symbol.json, golden.rec(.idx)")
+    write_legacy()
 
 
 if __name__ == "__main__":
