@@ -72,7 +72,9 @@ def build_trainer(mesh, classes=1000, dtype=None, layout=None):
         mesh=mesh, compute_dtype=dtype)
 
 
-def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
+def setup_train(batch, image_size, classes, dtype=None):
+    """One-chip trainer + synthetic batch — shared by the timed run and
+    the profile capture so both measure the identical program."""
     import jax
     import numpy as onp
 
@@ -85,6 +87,13 @@ def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
              else (batch, 3, image_size, image_size))
     x = nd.array(rng.rand(*shape).astype("f"))
     y = nd.array(rng.randint(0, classes, batch).astype("f"))
+    return trainer, x, y
+
+
+def run(batch, image_size, classes, warmup=2, iters=8, dtype=None):
+    import jax
+
+    trainer, x, y = setup_train(batch, image_size, classes, dtype)
     # Sync via device_get of the scalar loss, NOT wait_to_read: on the
     # tunneled axon platform block_until_ready returns before the device
     # finishes, so only a host readback is a faithful barrier (verified:
@@ -348,20 +357,11 @@ def profile_main():
     the top self-time ops from the .trace.json.gz inside."""
     import jax
 
-    from mxnet_tpu import nd, parallel
-
     outdir = os.environ.get("BENCH_PROFILE_DIR", "bench_profile")
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     dtype = os.environ.get("BENCH_PROFILE_DTYPE", "bfloat16")
-    import numpy as onp
-
-    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = build_trainer(mesh, 1000, dtype=dtype)
-    rng = onp.random.RandomState(0)
-    shape = ((batch, 224, 224, 3) if LAYOUT == "NHWC"
-             else (batch, 3, 224, 224))
-    x = nd.array(rng.rand(*shape).astype("f"))
-    y = nd.array(rng.randint(0, 1000, batch).astype("f"))
+    image_size = int(os.environ.get("BENCH_PROFILE_IMAGE", "224"))
+    trainer, x, y = setup_train(batch, image_size, 1000, dtype)
     lval = trainer.step(x, y)  # compile OUTSIDE the trace
     _ = jax.device_get(lval.data)
     with jax.profiler.trace(outdir):

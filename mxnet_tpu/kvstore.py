@@ -171,6 +171,40 @@ class KVStore:
                 return 1
         return 1
 
+    _dead_probe_seq = 0
+
+    def num_dead_node(self, node_id=0):
+        """Reference: kvstore.h:380 get_num_dead_node (ps-lite dead-node
+        query). jax.distributed has no per-node heartbeat — a dead peer
+        fails collectives outright — so this probes the COORDINATOR with
+        a real key-value round trip: reachable cluster → 0; unreachable
+        coordinator → every peer but us is unaccounted for (reference
+        semantics: dead count among the queried group)."""
+        if not self._type.startswith("dist"):
+            return 0
+        try:
+            import jax
+
+            n = jax.process_count()  # configured size (cached from init)
+        except Exception:
+            return 0
+        if n <= 1:
+            return 0
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is None:
+                return 0
+            # unique key per probe: set() is write-once per key
+            KVStore._dead_probe_seq += 1
+            client.key_value_set(
+                f"mxtpu/dead_probe/{self.rank}/{KVStore._dead_probe_seq}",
+                "1")
+            return 0
+        except Exception:
+            return max(0, n - 1)
+
     def _normalize(self, key, value):
         single = not isinstance(key, (list, tuple))
         keys = [key] if single else list(key)

@@ -206,3 +206,14 @@ def test_dgl_edge_ids_exact_past_2_24():
                                   graph_sizes=[3])[0]
     assert compacted.data.asnumpy().dtype == onp.float64
     assert compacted.data.asnumpy()[0] == big
+
+
+def test_kvstore_num_dead_node():
+    """Reference kvstore.h:380 surface: local stores report 0; a live
+    dist cluster reports 0 (jax.distributed has no partial-failure
+    tracking — collectives fail outright instead)."""
+    from mxnet_tpu import kvstore as kvs
+
+    kv = kvs.create("local")
+    assert kv.num_dead_node() == 0
+    assert kv.num_dead_node(3) == 0
