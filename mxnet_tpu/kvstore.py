@@ -190,19 +190,28 @@ class KVStore:
             return 0
         if n <= 1:
             return 0
-        try:
+        try:  # private API: absence means "can't probe", NOT "all dead"
             from jax._src import distributed
 
             client = distributed.global_state.client
-            if client is None:
-                return 0
-            # unique key per probe: set() is write-once per key
+        except Exception:
+            return 0
+        if client is None:
+            return 0
+        try:
+            # unique key per probe (set() is write-once per key), deleted
+            # right after so a monitoring loop does not grow the
+            # coordinator's KV store without bound
             KVStore._dead_probe_seq += 1
-            client.key_value_set(
-                f"mxtpu/dead_probe/{self.rank}/{KVStore._dead_probe_seq}",
-                "1")
+            key = f"mxtpu/dead_probe/{self.rank}/{KVStore._dead_probe_seq}"
+            client.key_value_set(key, "1")
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass  # old jax without delete: keys leak only per-probe
             return 0
         except Exception:
+            # a real coordinator RPC failure: peers unaccounted for
             return max(0, n - 1)
 
     def _normalize(self, key, value):
