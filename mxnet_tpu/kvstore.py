@@ -171,7 +171,11 @@ class KVStore:
                 return 1
         return 1
 
-    _dead_probe_seq = 0
+    # itertools.count: next() is a single bytecode, safe under the GIL —
+    # concurrent probes (monitoring thread + trainer) must never collide
+    # on the same write-once key
+    import itertools as _itertools
+    _dead_probe_seq = _itertools.count(1)
 
     def num_dead_node(self, node_id=0):
         """Reference: kvstore.h:380 get_num_dead_node (ps-lite dead-node
@@ -202,8 +206,8 @@ class KVStore:
             # unique key per probe (set() is write-once per key), deleted
             # right after so a monitoring loop does not grow the
             # coordinator's KV store without bound
-            KVStore._dead_probe_seq += 1
-            key = f"mxtpu/dead_probe/{self.rank}/{KVStore._dead_probe_seq}"
+            seq = next(KVStore._dead_probe_seq)
+            key = f"mxtpu/dead_probe/{self.rank}/{seq}"
             client.key_value_set(key, "1")
             try:
                 client.key_value_delete(key)

@@ -219,7 +219,8 @@ class NDArray:
 
     # ---- indexing ---------------------------------------------------------
     def __getitem__(self, key):
-        key = _unwrap_index(key)
+        _check_oob(key, self._data.shape)
+        key = _int_index(_unwrap_index(key))
         return _invoke1("_slice_take", self, key=key) if _index_has_array(key) \
             else _invoke1("_static_slice", self, key=key)
 
@@ -230,7 +231,8 @@ class NDArray:
             raise MXNetError(
                 "NDArray.__setitem__ is not supported when recording with "
                 "autograd (in-place writes cannot be taped)")
-        key = _unwrap_index(key)
+        _check_oob(key, self._data.shape)
+        key = _int_index(_unwrap_index(key))
         if isinstance(value, NDArray):
             value = value.data
         self._data = self._data.at[key].set(value)
@@ -431,6 +433,47 @@ def _unwrap_index(key):
     if isinstance(key, tuple):
         return tuple(_unwrap_index(k) for k in key)
     return key
+
+
+def _int_index(key):
+    """Float index arrays → int32: MXNet's default dtype is float32, so
+    reference code indexes with float NDArrays routinely; jax requires
+    integer indexers."""
+    if isinstance(key, (jax.Array, onp.ndarray)) and \
+            jnp.issubdtype(key.dtype, jnp.floating):
+        return key.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_int_index(k) for k in key)
+    return key
+
+
+def _check_oob(key, shape):
+    """Raise IndexError for out-of-range INTEGER indices: jnp clips them
+    on read and silently drops the update on write, where MXNet/numpy
+    raise. Also what terminates Python's iteration protocol (`for row
+    in a` probes growing ints until IndexError). Conservative: stops at
+    the first complex indexer (arrays, bools, Ellipsis) — those keep
+    jax semantics."""
+    keys = key if isinstance(key, tuple) else (key,)
+    axis = 0
+    for k in keys:
+        if k is Ellipsis or isinstance(k, (bool, onp.bool_)) or \
+                isinstance(k, (jax.Array, onp.ndarray)) or \
+                hasattr(k, "asnumpy"):
+            return
+        if k is None:
+            continue  # newaxis consumes no axis
+        if isinstance(k, (int, onp.integer)):
+            if axis >= len(shape):
+                raise IndexError(
+                    f"too many indices for array of dimension "
+                    f"{len(shape)}")
+            n = shape[axis]
+            if k < -n or k >= n:
+                raise IndexError(
+                    f"index {k} is out of bounds for axis {axis} with "
+                    f"size {n}")
+        axis += 1  # ints and slices each consume one axis
 
 
 def _index_has_array(key):
