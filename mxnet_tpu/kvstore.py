@@ -28,6 +28,7 @@ is kept but aggregation is executed by XLA:
 """
 from __future__ import annotations
 
+import itertools
 import pickle
 
 from .base import MXNetError
@@ -171,11 +172,10 @@ class KVStore:
                 return 1
         return 1
 
-    # itertools.count: next() is a single bytecode, safe under the GIL —
-    # concurrent probes (monitoring thread + trainer) must never collide
-    # on the same write-once key
-    import itertools as _itertools
-    _dead_probe_seq = _itertools.count(1)
+    # itertools.count: next() is atomic under the GIL — concurrent
+    # probes (monitoring thread + trainer) must never collide on the
+    # same write-once key
+    _dead_probe_seq = itertools.count(1)
 
     def num_dead_node(self, node_id=0):
         """Reference: kvstore.h:380 get_num_dead_node (ps-lite dead-node

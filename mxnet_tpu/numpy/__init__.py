@@ -125,7 +125,23 @@ class ndarray(NDArray):
     def __or__(self, o): return bitwise_or(self, o)
     def __xor__(self, o): return bitwise_xor(self, o)
 
+    def _reject_float_index(self, key):
+        """numpy semantics: float indexers RAISE (the legacy nd namespace
+        coerces them, matching reference mx.nd behavior) — a float
+        computation leaking into an index position must not be masked."""
+        ks = key if isinstance(key, tuple) else (key,)
+        import jax.numpy as jnp
+
+        for k in ks:
+            data = getattr(k, "data", k)
+            if hasattr(data, "dtype") and \
+                    jnp.issubdtype(data.dtype, jnp.floating):
+                raise IndexError(
+                    "arrays used as indices must be of integer or "
+                    "boolean type, not float")
+
     def __getitem__(self, key):
+        self._reject_float_index(key)
         if _has_bool_mask(key):
             if _is_tracer(self._data):
                 raise MXNetError(
@@ -139,6 +155,7 @@ class ndarray(NDArray):
         return super().__getitem__(key)
 
     def __setitem__(self, key, value):
+        self._reject_float_index(key)
         if _has_bool_mask(key):
             from .. import autograd
 

@@ -1,0 +1,226 @@
+"""mx.np semantics, second suite (reference:
+tests/python/unittest/test_numpy_op.py, 71 fns — the de-facto spec for
+the numpy-compatible namespace: dispatch, dtype promotion, shape
+semantics, ufuncs, manipulation, linalg, random)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+np = mx.np
+RS = onp.random.RandomState(7)
+
+
+def _a(*shape):
+    return RS.randn(*shape).astype("f")
+
+
+def test_array_creation_matches_numpy():
+    for src in ([1, 2, 3], [[1.5, 2.5]], 3.0):
+        assert_almost_equal(np.array(src), onp.array(src, dtype="f"))
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert (np.ones(4).asnumpy() == 1).all()
+    assert_almost_equal(np.full((2,), 9.0), onp.full(2, 9.0, "f"))
+
+
+def test_arange_linspace_eye():
+    assert_almost_equal(np.arange(2, 10, 3), onp.arange(2, 10, 3, "f"))
+    assert_almost_equal(np.linspace(0, 1, 5), onp.linspace(0, 1, 5),
+                        rtol=1e-6)
+    assert_almost_equal(np.eye(3), onp.eye(3))
+
+
+def test_ufunc_binary_broadcast():
+    a, b = _a(3, 1), _a(1, 4)
+    assert_almost_equal(np.add(np.array(a), np.array(b)), a + b)
+    assert_almost_equal(np.multiply(np.array(a), np.array(b)), a * b)
+    assert_almost_equal(np.subtract(np.array(a), np.array(b)), a - b)
+
+
+def test_power_mod_floor_divide():
+    a = onp.abs(_a(5)) + 0.5
+    b = onp.abs(_a(5)) + 0.5
+    assert_almost_equal(np.power(np.array(a), np.array(b)), a ** b,
+                        rtol=1e-5)
+    assert_almost_equal(np.mod(np.array(a), np.array(b)),
+                        onp.mod(a, b), rtol=1e-5)
+    assert_almost_equal(np.floor_divide(np.array(a), np.array(b)),
+                        onp.floor_divide(a, b))
+
+
+def test_trig_family():
+    x = _a(6)
+    for name in ("sin", "cos", "tan", "arctan", "sinh", "cosh"):
+        assert_almost_equal(getattr(np, name)(np.array(x)),
+                            getattr(onp, name)(x), rtol=1e-5, atol=1e-6)
+    y = onp.clip(x, -0.99, 0.99)
+    assert_almost_equal(np.arcsin(np.array(y)), onp.arcsin(y), rtol=1e-5)
+
+
+def test_reductions_axis_keepdims():
+    x = _a(3, 4, 5)
+    a = np.array(x)
+    assert_almost_equal(np.sum(a, axis=(0, 2)), x.sum(axis=(0, 2)),
+                        rtol=1e-5)
+    assert_almost_equal(np.mean(a, axis=1, keepdims=True),
+                        x.mean(axis=1, keepdims=True), rtol=1e-5)
+    assert_almost_equal(np.var(a, axis=0), x.var(axis=0), rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(np.std(a), x.std(), rtol=1e-4)
+    assert float(np.max(a)) == x.max()
+    assert int(np.argmin(a.reshape(-1))) == int(x.argmin())
+
+
+def test_manipulation_suite():
+    x = _a(2, 3, 4)
+    a = np.array(x)
+    assert_almost_equal(np.transpose(a, (2, 0, 1)),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(np.swapaxes(a, 0, 2), x.swapaxes(0, 2))
+    assert_almost_equal(np.moveaxis(a, 0, -1), onp.moveaxis(x, 0, -1))
+    assert np.ravel(a).shape == (24,)
+    assert_almost_equal(np.stack([a, a], axis=1).asnumpy()[:, 0], x)
+    got = np.concatenate([a, a], axis=2)
+    assert got.shape == (2, 3, 8)
+
+
+def test_split_array_functions():
+    x = _a(6, 4)
+    parts = np.split(np.array(x), 3, axis=0)
+    assert len(parts) == 3 and parts[1].shape == (2, 4)
+    v = np.vsplit(np.array(x), 2)
+    assert v[0].shape == (3, 4)
+    h = np.hsplit(np.array(x), 2)
+    assert h[0].shape == (6, 2)
+
+
+def test_where_and_comparisons_bool_dtype():
+    a, b = _a(5), _a(5)
+    cond = np.array(a) > np.array(b)
+    assert "bool" in str(cond.dtype)
+    got = np.where(cond, np.array(a), np.array(b))
+    assert_almost_equal(got, onp.where(a > b, a, b))
+
+
+def test_dtype_promotion_f32_wins():
+    a = np.array([1, 2], dtype="int32")
+    b = np.array([0.5, 0.5], dtype="float32")
+    assert "float" in str((a + b).dtype)
+
+
+def test_linalg_namespace():
+    x = _a(4, 4)
+    spd = x @ x.T + 4 * onp.eye(4, dtype="f")
+    assert_almost_equal(np.linalg.norm(np.array(x)),
+                        onp.linalg.norm(x), rtol=1e-5)
+    L = np.linalg.cholesky(np.array(spd)).asnumpy()
+    assert_almost_equal(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    inv = np.linalg.inv(np.array(spd)).asnumpy()
+    assert_almost_equal(inv @ spd, onp.eye(4), rtol=1e-3, atol=1e-3)
+    sign, logdet = onp.linalg.slogdet(spd.astype("float64"))
+    got = np.linalg.slogdet(np.array(spd))
+    assert_almost_equal(float(got[1].asnumpy()
+                              if hasattr(got[1], "asnumpy") else got[1]),
+                        logdet, rtol=1e-4)
+
+
+@with_seed(3)
+def test_random_namespace_statistics():
+    u = np.random.uniform(0, 1, size=(20000,)).asnumpy()
+    assert 0.47 < u.mean() < 0.53
+    n = np.random.normal(2.0, 0.5, size=(20000,)).asnumpy()
+    assert 1.95 < n.mean() < 2.05 and 0.45 < n.std() < 0.55
+    r = np.random.randint(0, 5, size=(1000,)).asnumpy()
+    assert set(onp.unique(r)) <= {0, 1, 2, 3, 4}
+
+
+def test_boolean_mask_indexing():
+    x = _a(6)
+    a = np.array(x)
+    m = a > 0
+    got = a[m].asnumpy()
+    assert_almost_equal(got, x[x > 0])
+
+
+def test_np_ndarray_methods():
+    x = _a(3, 4)
+    a = np.array(x)
+    assert_almost_equal(a.T, x.T)
+    assert_almost_equal(a.flatten(), x.flatten())
+    assert a.astype("int32").dtype == onp.int32
+    assert_almost_equal(a.clip(-0.2, 0.2), x.clip(-0.2, 0.2))
+    assert abs(float(a.mean()) - x.mean()) < 1e-5
+
+
+def test_interop_with_nd():
+    from mxnet_tpu import nd
+
+    a = nd.array(_a(2, 2))
+    b = a.as_np_ndarray()
+    assert type(b).__module__.startswith("mxnet_tpu")
+    c = b.as_nd_ndarray() if hasattr(b, "as_nd_ndarray") else a
+    assert_almost_equal(c, a.asnumpy())
+
+
+def test_np_tile_repeat_roll():
+    x = _a(2, 3)
+    a = np.array(x)
+    assert_almost_equal(np.tile(a, (2, 1)), onp.tile(x, (2, 1)))
+    assert_almost_equal(np.repeat(a, 2, axis=1), onp.repeat(x, 2, 1))
+    assert_almost_equal(np.roll(a, 1, axis=0), onp.roll(x, 1, 0))
+
+
+def test_np_sort_argsort_unique():
+    x = onp.array([3.0, 1.0, 2.0, 1.0], "f")
+    assert_almost_equal(np.sort(np.array(x)), onp.sort(x))
+    got = np.unique(np.array(x))
+    assert_almost_equal(got, onp.unique(x))
+
+
+def test_np_einsum_paths():
+    a, b = _a(3, 4), _a(4, 5)
+    assert_almost_equal(np.einsum("ij,jk->ik", np.array(a), np.array(b)),
+                        a @ b, rtol=1e-5)
+    c = _a(3, 4)
+    assert_almost_equal(np.einsum("ij,ij->", np.array(a), np.array(c)),
+                        (a * c).sum(), rtol=1e-4)
+
+
+def test_np_outer_inner_dotfamily():
+    a, b = _a(4), _a(4)
+    assert_almost_equal(np.outer(np.array(a), np.array(b)),
+                        onp.outer(a, b), rtol=1e-5)
+    assert_almost_equal(np.dot(np.array(a), np.array(b)),
+                        onp.dot(a, b), rtol=1e-5)
+
+
+def test_np_pad_and_flip():
+    x = _a(2, 3)
+    assert_almost_equal(np.pad(np.array(x), ((1, 1), (0, 0))),
+                        onp.pad(x, ((1, 1), (0, 0))))
+    assert_almost_equal(np.flip(np.array(x), axis=1), x[:, ::-1])
+
+
+def test_np_gradient_through_ops():
+    from mxnet_tpu import autograd
+
+    a = np.array(_a(3))
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(np.exp(a) * a)
+    y.backward()
+    want = onp.exp(a.asnumpy()) * (1 + a.asnumpy())
+    assert_almost_equal(a.grad, want, rtol=1e-5)
+
+
+def test_np_float_index_raises_unlike_nd():
+    """numpy semantics: float indexers raise; the legacy nd namespace
+    coerces them (reference behavior split)."""
+    a = np.array(_a(4))
+    with pytest.raises(IndexError, match="integer or boolean"):
+        a[np.array([0.5, 1.0])]
+    with pytest.raises(IndexError, match="integer or boolean"):
+        a[np.array([0.0])] = 1.0
+    # integer indexers fine
+    assert a[np.array([1], dtype="int32")].shape == (1,)
