@@ -69,13 +69,52 @@ class _NameScope:
         _SCOPE.current = self._old
 
 
+class HookHandle:
+    """Detachable registration (reference: gluon/utils.py HookHandle)."""
+
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._hook = hook
+
+    def detach(self):
+        try:
+            self._hooks.remove(self._hook)
+        except ValueError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
 class Block:
     """Base building block (reference: gluon/block.py:228)."""
 
     def __init__(self, prefix=None, params=None):
         hint = type(self).__name__.lower()
         self._prefix = prefix if prefix is not None else _gen_prefix(hint)
-        self._params = ParameterDict(self._prefix, shared=params)
+        # Parameter NAMES may live under a different prefix than the
+        # block (reference _BlockScope.create): with shared `params`,
+        # this block's params are created under the SHARED dict's prefix
+        # so lookups hit the shared entries; children of a sharing
+        # parent inherit the parent's param-prefix remapping + _shared.
+        parent = _SCOPE.current
+        if params is not None:
+            self._params = ParameterDict(params.prefix, shared=params)
+        elif parent is not None and \
+                parent.params.prefix != parent.prefix and \
+                self._prefix.startswith(parent.prefix):
+            local = self._prefix[len(parent.prefix):]
+            self._params = ParameterDict(parent.params.prefix + local,
+                                         shared=parent.params._shared)
+        elif parent is not None and parent.params._shared is not None \
+                and self._prefix.startswith(parent.prefix):
+            self._params = ParameterDict(self._prefix,
+                                         shared=parent.params._shared)
+        else:
+            self._params = ParameterDict(self._prefix)
         self._children = OrderedDict()
         self._reg_params = {}
         self._counters = {}
@@ -132,9 +171,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
+        return HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
+        return HookHandle(self._forward_pre_hooks, hook)
 
     def apply(self, fn):
         for child in self._children.values():
@@ -229,10 +270,10 @@ class Block:
         print("\n".join(rows))
 
     def __call__(self, *args, **kwargs):
-        for hook in self._forward_pre_hooks:
+        for hook in list(self._forward_pre_hooks):
             hook(self, args)
         out = self.forward(*args, **kwargs)
-        for hook in self._forward_hooks:
+        for hook in list(self._forward_hooks):
             hook(self, args, out)
         return out
 
@@ -401,10 +442,10 @@ class HybridBlock(Block):
             if all(isinstance(a, NDArray) for a in args):
                 if self._cached_op is None:
                     self._build_cache()
-                for hook in self._forward_pre_hooks:
+                for hook in list(self._forward_pre_hooks):
                     hook(self, args)
                 out = self._cached_op(*args)
-                for hook in self._forward_hooks:
+                for hook in list(self._forward_hooks):
                     hook(self, args, out)
                 return out
         return super().__call__(*args, **kwargs)

@@ -80,11 +80,16 @@ class Parameter:
         if self._shape is None:
             self._shape = tuple(new_shape)
             return
+        # per-dim MERGE, 0 = unknown on EITHER side (reference
+        # parameter.py get() inferred_shape): a sharing block created
+        # with in_units=0 must not clobber the shared param's known dims
         assert len(self._shape) == len(new_shape) and all(
-            j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            i == 0 or j == 0 or i == j
+            for i, j in zip(new_shape, self._shape)), \
             f"Expected shape {self._shape} is incompatible with given shape " \
             f"{new_shape} for Parameter {self.name}"
-        self._shape = tuple(new_shape)
+        self._shape = tuple(j if i == 0 else i
+                            for i, j in zip(new_shape, self._shape))
 
     def _shape_complete(self):
         return self._shape is not None and all(s > 0 for s in self._shape)

@@ -129,10 +129,17 @@ class ndarray(NDArray):
         """numpy semantics: float indexers RAISE (the legacy nd namespace
         coerces them, matching reference mx.nd behavior) — a float
         computation leaking into an index position must not be masked."""
-        ks = key if isinstance(key, tuple) else (key,)
-        import jax.numpy as jnp
+        import builtins
 
+        ks = key if isinstance(key, tuple) else (key,)
         for k in ks:
+            # builtins.any: this module's np.any() shadows the builtin
+            if isinstance(k, float) or (
+                    isinstance(k, list) and
+                    builtins.any(isinstance(e, float) for e in k)):
+                raise IndexError(
+                    "only integers, slices, ellipsis and integer or "
+                    "boolean arrays are valid indices, not float")
             data = getattr(k, "data", k)
             if hasattr(data, "dtype") and \
                     jnp.issubdtype(data.dtype, jnp.floating):

@@ -155,12 +155,22 @@ def test_np_ndarray_methods():
 
 def test_interop_with_nd():
     from mxnet_tpu import nd
+    from mxnet_tpu.numpy import ndarray as np_ndarray_cls
 
     a = nd.array(_a(2, 2))
     b = a.as_np_ndarray()
-    assert type(b).__module__.startswith("mxnet_tpu")
-    c = b.as_nd_ndarray() if hasattr(b, "as_nd_ndarray") else a
+    assert isinstance(b, np_ndarray_cls)      # a REAL np ndarray
+    assert not type(b) is type(a)             # not the legacy nd type
+    c = b.as_nd_ndarray()
+    assert isinstance(c, nd.NDArray)
     assert_almost_equal(c, a.asnumpy())
+    # gradients flow across the view boundary
+    a2 = nd.array(_a(3))
+    a2.attach_grad()
+    with mx.autograd.record():
+        loss = np.sum(a2.as_np_ndarray() * 2.0)
+    loss.backward()
+    assert_almost_equal(a2.grad, onp.full(3, 2.0))
 
 
 def test_np_tile_repeat_roll():
@@ -222,5 +232,9 @@ def test_np_float_index_raises_unlike_nd():
         a[np.array([0.5, 1.0])]
     with pytest.raises(IndexError, match="integer or boolean"):
         a[np.array([0.0])] = 1.0
+    with pytest.raises(IndexError):
+        a[1.5]
+    with pytest.raises(IndexError):
+        a[[0.5, 1.0]]
     # integer indexers fine
     assert a[np.array([1], dtype="int32")].shape == (1,)
