@@ -1,0 +1,214 @@
+"""Module API, second suite (reference:
+tests/python/unittest/test_module.py, 23 fns — lifecycle guards,
+set/get params, predict, checkpoint epochs, reshape, fit with eval)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, io
+from mxnet_tpu.module import Module
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def _mlp(prefix="m2"):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name=f"{prefix}_fc1", num_hidden=8)
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name=f"{prefix}_fc2", num_hidden=2)
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _data(n=64, seed=0):
+    rs = onp.random.RandomState(seed)
+    X = rs.randn(n, 6).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    return X, y
+
+
+def _fit_module(prefix="m2", epochs=3, seed=0):
+    X, y = _data(seed=seed)
+    mod = Module(_mlp(prefix), context=mx.cpu())
+    it = io.NDArrayIter(X, y, batch_size=32)
+    # Xavier + a healthy lr: fit's default Uniform(0.01) init plus the
+    # reference's rescale_grad=1/batch makes convergence glacial
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    return mod, X, y
+
+
+def test_lifecycle_guards():
+    mod = Module(_mlp("lg"), context=mx.cpu())
+    with pytest.raises(AssertionError):
+        mod.forward(io.DataBatch(data=[nd.zeros((2, 6))]))
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    with pytest.raises(AssertionError):  # params not initialized yet
+        mod.forward(io.DataBatch(data=[nd.zeros((2, 6))]))
+    mod.init_params()
+    mod.forward(io.DataBatch(data=[nd.zeros((2, 6))]), is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 2)
+
+
+def test_get_set_params_roundtrip():
+    mod, X, _ = _fit_module("gs")
+    args, auxs = mod.get_params()
+    assert args and all(hasattr(v, "asnumpy") for v in args.values())
+    mod2 = Module(_mlp("gs"), context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (32, 6))],
+              label_shapes=[("softmax_label", (32,))])
+    mod2.set_params(args, auxs)
+    b = io.DataBatch(data=[nd.array(X[:32])])
+    mod.forward(b, is_train=False)
+    mod2.forward(b, is_train=False)
+    assert_almost_equal(mod2.get_outputs()[0].asnumpy(),
+                        mod.get_outputs()[0].asnumpy(), rtol=1e-6)
+
+
+def test_set_params_rejects_missing():
+    mod = Module(_mlp("sm"), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    with pytest.raises(RuntimeError, match="not presented"):
+        mod.set_params({}, {}, allow_missing=False)
+
+
+@with_seed(4)
+def test_predict_returns_concatenated():
+    mod, X, y = _fit_module("pr")
+    out = mod.predict(io.NDArrayIter(X, y, batch_size=16))
+    assert out.shape == (64, 2)
+    probs = out.asnumpy()
+    assert onp.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+@with_seed(4)
+def test_score_accuracy_reasonable():
+    mod, X, y = _fit_module("sc", epochs=10)
+    acc = dict(mod.score(io.NDArrayIter(X, y, batch_size=32), "acc"))
+    assert acc["accuracy"] > 0.8
+
+
+def test_checkpoint_epoch_naming(tmp_path):
+    mod, _, _ = _fit_module("ck")
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    mod.save_checkpoint(prefix, 12)
+    assert os.path.isfile(prefix + "-0003.params")
+    assert os.path.isfile(prefix + "-0012.params")
+    assert os.path.isfile(prefix + "-symbol.json")
+    m2 = Module.load(prefix, 12, context=mx.cpu())
+    m2.bind(data_shapes=[("data", (4, 6))], for_training=False)
+    m2.init_params()
+    # the round trip must restore the TRAINED params, not re-init
+    want_args, _ = mod.get_params()
+    got_args, _ = m2.get_params()
+    for k, v in want_args.items():
+        assert_almost_equal(got_args[k].asnumpy(), v.asnumpy(),
+                            rtol=1e-6, atol=1e-7)
+
+
+def test_executor_reshape_through_module():
+    mod, X, _ = _fit_module("rs")
+    # different batch size at inference: forward re-specializes
+    b = io.DataBatch(data=[nd.array(X[:10])])
+    mod.forward(b, is_train=False)
+    assert mod.get_outputs()[0].shape == (10, 2)
+    b = io.DataBatch(data=[nd.array(X[:32])])
+    mod.forward(b, is_train=False)
+    assert mod.get_outputs()[0].shape == (32, 2)
+
+
+def test_fit_with_eval_data_and_callbacks():
+    X, y = _data(seed=7)
+    Xe, ye = _data(n=32, seed=8)
+    seen = {"epochs": 0, "batches": 0}
+
+    def epoch_cb(epoch, sym_, arg, aux):
+        seen["epochs"] += 1
+
+    def batch_cb(param):
+        seen["batches"] += 1
+
+    mod = Module(_mlp("cb"), context=mx.cpu())
+    mod.fit(io.NDArrayIter(X, y, batch_size=32),
+            eval_data=io.NDArrayIter(Xe, ye, batch_size=32),
+            num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            epoch_end_callback=epoch_cb, batch_end_callback=batch_cb)
+    assert seen["epochs"] == 2
+    assert seen["batches"] == 4  # 2 batches/epoch x 2 epochs
+
+
+def test_output_and_data_names():
+    mod = Module(_mlp("nm"), context=mx.cpu())
+    assert mod.data_names == ["data"]
+    assert mod.label_names == ["softmax_label"]
+    assert mod.output_names == ["softmax_output"]
+
+
+def test_inference_only_module_no_labels():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, name="io_fc", num_hidden=3)
+    mod = Module(out, label_names=[], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (5, 4))], for_training=False)
+    mod.init_params()
+    mod.forward(io.DataBatch(data=[nd.ones((5, 4))]), is_train=False)
+    assert mod.get_outputs()[0].shape == (5, 3)
+
+
+def test_init_optimizer_guard_and_force():
+    mod, X, y = _fit_module("op")
+    opt_before = mod._optimizer
+    # re-init WITHOUT force: guarded no-op — same optimizer object
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.9})
+    assert mod._optimizer is opt_before
+    # WITH force: a fresh optimizer carrying the new hyperparams
+    mod.init_optimizer(optimizer="sgd", force_init=True,
+                       optimizer_params={"learning_rate": 0.9})
+    assert mod._optimizer is not opt_before
+    assert mod._optimizer.lr == 0.9
+    b = io.NDArrayIter(X, y, batch_size=32)
+    for batch in b:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        break
+
+
+@with_seed(11)
+def test_bucketing_module_multiple_buckets():
+    from mxnet_tpu.module import BucketingModule
+
+    def gen(bucket_key):
+        # param shapes must be bucket-INDEPENDENT (like variable-length
+        # RNN unrolls): reduce over the bucket-sized axis before the FC
+        data = sym.Variable("data")
+        pooled = sym.mean(data, axis=1, keepdims=True)
+        fc = sym.FullyConnected(pooled, name="bk_fc", num_hidden=2)
+        out = sym.SoftmaxOutput(fc, sym.Variable("softmax_label"),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = BucketingModule(gen, default_bucket_key=8, context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 8))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    rs = onp.random.RandomState(0)
+    for key, width in ((8, 8), (4, 4), (8, 8)):
+        batch = io.DataBatch(
+            data=[nd.array(rs.rand(4, width).astype("f"))],
+            label=[nd.array(rs.randint(0, 2, 4).astype("f"))],
+            bucket_key=key,
+            provide_data=[io.DataDesc("data", (4, width))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+    assert bm.get_outputs()[0].shape == (4, 2)
