@@ -1,0 +1,161 @@
+"""Optimizer update rules against closed-form numpy math (reference:
+tests/python/unittest/test_optimizer.py — each rule's single-step
+update compared exactly, plus wd/rescale/clip plumbing and schedules)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+W0 = onp.array([0.5, -1.0, 2.0, 0.1], "f")
+G0 = onp.array([0.2, -0.4, 0.6, -0.8], "f")
+
+
+def _step(optimizer, w=W0, g=G0, steps=1):
+    """Run `steps` updates through the Updater machinery (the kvstore
+    server path) and return the resulting weight."""
+    upd = opt.get_updater(optimizer)
+    wn = nd.array(w.copy())
+    for _ in range(steps):
+        upd(0, nd.array(g.copy()), wn)
+    return wn.asnumpy()
+
+
+def test_sgd_plain():
+    got = _step(opt.SGD(learning_rate=0.1, wd=0.0))
+    assert_almost_equal(got, W0 - 0.1 * G0, rtol=1e-6)
+
+
+def test_sgd_weight_decay():
+    wd = 0.01
+    got = _step(opt.SGD(learning_rate=0.1, wd=wd))
+    assert_almost_equal(got, W0 - 0.1 * (G0 + wd * W0), rtol=1e-6)
+
+
+def test_sgd_momentum_two_steps():
+    lr, mom = 0.1, 0.9
+    got = _step(opt.SGD(learning_rate=lr, momentum=mom, wd=0.0), steps=2)
+    m = -lr * G0
+    w = W0 + m
+    m = mom * m - lr * G0
+    w = w + m
+    assert_almost_equal(got, w, rtol=1e-6)
+
+
+def test_sgd_rescale_and_clip():
+    o = opt.SGD(learning_rate=1.0, wd=0.0, rescale_grad=0.5,
+                clip_gradient=0.2)
+    got = _step(o)
+    g = onp.clip(G0 * 0.5, -0.2, 0.2)
+    assert_almost_equal(got, W0 - g, rtol=1e-6)
+
+
+def test_adam_first_step_formula():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _step(opt.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                         epsilon=eps, wd=0.0))
+    m = (1 - b1) * G0
+    v = (1 - b2) * G0 * G0
+    mhat = m / (1 - b1)
+    vhat = v / (1 - b2)
+    want = W0 - lr * mhat / (onp.sqrt(vhat) + eps)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_adagrad_accumulates():
+    lr, eps = 0.5, 1e-7
+    got = _step(opt.AdaGrad(learning_rate=lr, eps=eps, wd=0.0), steps=2)
+    h = G0 * G0
+    w = W0 - lr * G0 / onp.sqrt(h + eps)
+    h = h + G0 * G0
+    w = w - lr * G0 / onp.sqrt(h + eps)
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_rmsprop_formula():
+    lr, rho, eps = 0.01, 0.9, 1e-8
+    got = _step(opt.RMSProp(learning_rate=lr, gamma1=rho, epsilon=eps,
+                            wd=0.0, centered=False))
+    e = (1 - rho) * G0 * G0
+    want = W0 - lr * G0 / onp.sqrt(e + eps)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_signum_sign_update():
+    lr = 0.1
+    got = _step(opt.Signum(learning_rate=lr, momentum=0.0, wd=0.0))
+    assert_almost_equal(got, W0 - lr * onp.sign(G0), rtol=1e-6)
+
+
+def test_lr_scheduler_factor():
+    sched = opt.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                             base_lr=1.0)
+    # drops AFTER each `step` updates (reference: count+step threshold)
+    assert sched(1) == 1.0
+    assert sched(3) == 0.5
+    assert sched(5) == 0.25
+
+
+def test_lr_scheduler_warmup_cosine():
+    sched = opt.lr_scheduler.CosineScheduler(
+        max_update=10, base_lr=1.0, final_lr=0.0, warmup_steps=2)
+    assert sched(0) < sched(1) <= 1.0  # warmup climbs
+    assert sched(10) <= sched(5) <= 1.0  # cosine decays
+
+
+def test_optimizer_registry_create():
+    for name in ("sgd", "adam", "adagrad", "rmsprop", "adadelta",
+                 "adamax", "nadam", "ftrl", "nag", "signum", "lamb"):
+        o = opt.create(name, learning_rate=0.1)
+        assert isinstance(o, opt.Optimizer), name
+
+
+def test_lr_wd_mult_apply():
+    o = opt.SGD(learning_rate=1.0, wd=0.1)
+    o.set_lr_mult({"w": 0.5})
+    o.set_wd_mult({"w": 0.0})
+    idx = 0
+    o._index_update_count = {}
+    # through the updater with named index mapping
+    upd = opt.get_updater(o)
+    wn = nd.array(W0.copy())
+    # map integer index to the named mult via idx2name
+    o.idx2name = {0: "w"}
+    upd(0, nd.array(G0.copy()), wn)
+    assert_almost_equal(wn.asnumpy(), W0 - 0.5 * G0, rtol=1e-6)
+
+
+def test_updater_states_roundtrip():
+    import pickle
+
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = nd.array(W0.copy())
+    upd(0, nd.array(G0.copy()), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    # continuing from restored momentum must equal continuing original
+    w1 = nd.array(w.asnumpy().copy())
+    w2 = nd.array(w.asnumpy().copy())
+    upd(0, nd.array(G0.copy()), w1)
+    upd2(0, nd.array(G0.copy()), w2)
+    assert_almost_equal(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_multi_precision_fp16_masters():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9,
+                multi_precision=True)
+    upd = opt.get_updater(o)
+    w16 = nd.array(W0.copy()).astype("float16")
+    for _ in range(3):
+        upd(0, nd.array(G0.copy()).astype("float16"), w16)
+    # fp32 reference trajectory
+    m = onp.zeros_like(W0)
+    w = W0.copy()
+    for _ in range(3):
+        m = 0.9 * m - 0.1 * G0
+        w = w + m
+    assert_almost_equal(w16.asnumpy().astype("f"), w, rtol=2e-2,
+                        atol=2e-3)
