@@ -620,18 +620,32 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     ``lax.scan`` over time — TPU-friendly log-space dynamic programming,
     differentiable end-to-end through JAX autodiff (no hand-written
     gradient kernel needed). data: (T, N, C) activations (softmax applied
-    internally), label: (N, L); blank index 0 ("first").
+    internally), label: (N, L). ``blank_label='first'`` reserves class 0
+    for blank (labels 1..C-1, padding 0); ``'last'`` reserves class C-1
+    (labels 0..C-2, padding -1) — ctc_loss-inl.h:174-186.
     """
+    if blank_label not in ("first", "last"):
+        raise ValueError(
+            f"blank_label must be 'first' or 'last', got {blank_label!r}")
     T, N, C = data.shape
     L = label.shape[1]
+    blank = 0 if blank_label == "first" else C - 1
+    pad = 0 if blank_label == "first" else -1
     logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
+    valid = lab != pad
+    # pack non-pad labels contiguously (ctc_loss-inl.h
+    # LabelTensorToPackedVector): a stable sort on the pad mask moves
+    # valid entries to the front without dynamic shapes
+    order = jnp.argsort(jnp.logical_not(valid), axis=1, stable=True)
+    lab = jnp.take_along_axis(lab, order, axis=1)
+    valid = jnp.take_along_axis(valid, order, axis=1)
     # extended label sequence with interleaved blanks: length 2L+1
-    ext = jnp.zeros((N, 2 * L + 1), dtype=jnp.int32)
-    ext = ext.at[:, 1::2].set(lab)
+    ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(valid, lab, blank))
     neg_inf = -1e30
     alpha0 = jnp.full((N, 2 * L + 1), neg_inf)
-    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
     alpha0 = alpha0.at[:, 1].set(
         jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
     same_as_prev2 = jnp.concatenate(
@@ -661,11 +675,18 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     if use_label_lengths and label_lengths is not None:
         ll = label_lengths.astype(jnp.int32)
     else:
-        ll = jnp.sum((lab != 0).astype(jnp.int32), axis=1)
-        ll = jnp.where(ll == 0, L, ll)
+        ll = jnp.sum(valid.astype(jnp.int32), axis=1)
+        if blank_label == "first":
+            # all-zero rows are ambiguous in 'first' mode (0 is both pad
+            # and blank); the reference treats them as full-length labels.
+            # In 'last' mode pad is -1, so ll==0 really means empty target.
+            ll = jnp.where(ll == 0, L, ll)
     last = jnp.take_along_axis(final, (2 * ll)[:, None], axis=1)[:, 0]
     prev = jnp.take_along_axis(final, jnp.maximum(2 * ll - 1, 0)[:, None],
                                axis=1)[:, 0]
+    # empty target: the only path is all-blank — alpha[T-1, 0] alone
+    # (otherwise prev would double-count position 0)
+    prev = jnp.where(ll > 0, prev, neg_inf)
     m = jnp.maximum(last, prev)
     return -(m + jnp.log(jnp.exp(last - m) + jnp.exp(prev - m)))
 

@@ -1,0 +1,365 @@
+"""Operator spec suite 4: ops with no direct coverage in suites 1-3.
+
+Oracles: torch (CPU) for ctc_loss, numpy replications of the reference
+update-rule formulas (src/operator/optimizer_op-inl.h) for the optimizer
+ops, closed-form/numpy for the rest. Modeled on the reference's
+tests/python/unittest/test_operator.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+# ------------------------------------------------------------------ ctc ---
+
+def _torch_ctc(acts, labels, in_lens, lab_lens, blank):
+    import torch
+    import torch.nn.functional as F
+
+    lp = F.log_softmax(torch.tensor(acts), dim=-1)
+    return F.ctc_loss(
+        lp, torch.tensor(labels), torch.tensor(in_lens),
+        torch.tensor(lab_lens), blank=blank, reduction="none",
+        zero_infinity=False).numpy()
+
+
+@with_seed(0)
+def test_ctc_loss_matches_torch_blank_first():
+    T, N, C, L = 10, 4, 6, 3
+    rng = onp.random.RandomState(0)
+    acts = rng.randn(T, N, C).astype("f")
+    # blank_label='first': classes are 1..C-1, padding value 0
+    labels = rng.randint(1, C, (N, L)).astype("f")
+    out = nd.ctc_loss(nd.array(acts), nd.array(labels))
+    want = _torch_ctc(acts, labels.astype("i8"), [T] * N, [L] * N, blank=0)
+    assert_almost_equal(_np(out), want, rtol=1e-4, atol=1e-4)
+
+
+@with_seed(1)
+def test_ctc_loss_matches_torch_blank_last():
+    T, N, C, L = 8, 3, 5, 3
+    rng = onp.random.RandomState(1)
+    acts = rng.randn(T, N, C).astype("f")
+    # blank_label='last': classes are 0..C-2, padding value -1
+    labels = rng.randint(0, C - 1, (N, L)).astype("f")
+    labels[1, 2] = -1  # row 1 has only 2 labels
+    out = nd.ctc_loss(nd.array(acts), nd.array(labels), blank_label="last")
+    want = _torch_ctc(acts, labels.astype("i8"), [T] * N, [L, 2, L],
+                      blank=C - 1)
+    assert_almost_equal(_np(out), want, rtol=1e-4, atol=1e-4)
+
+
+@with_seed(4)
+def test_ctc_loss_empty_target_and_bad_blank():
+    T, N, C, L = 7, 2, 5, 3
+    rng = onp.random.RandomState(4)
+    acts = rng.randn(T, N, C).astype("f")
+    labels = rng.randint(0, C - 1, (N, L)).astype("f")
+    labels[0, :] = -1  # row 0: empty target -> loss is -sum_t log p_blank
+    labels[1, 1] = -1  # row 1: MID-sequence pad -> packed to [l0, l2]
+    out = nd.ctc_loss(nd.array(acts), nd.array(labels), blank_label="last")
+    packed_row1 = labels[1][labels[1] >= 0].astype("i8")
+    want = _torch_ctc(
+        acts, onp.stack([onp.zeros(L, "i8"),
+                         onp.pad(packed_row1, (0, L - len(packed_row1)))]),
+        [T] * N, [0, len(packed_row1)], blank=C - 1)
+    assert_almost_equal(_np(out), want, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        nd.ctc_loss(nd.array(acts), nd.array(labels), blank_label="middle")
+
+
+@with_seed(2)
+def test_ctc_loss_variable_lengths():
+    T, N, C, L = 12, 3, 7, 4
+    rng = onp.random.RandomState(2)
+    acts = rng.randn(T, N, C).astype("f")
+    labels = rng.randint(1, C, (N, L)).astype("f")
+    dlen = onp.array([12, 9, 7], "f")
+    llen = onp.array([4, 2, 3], "f")
+    out = nd.ctc_loss(nd.array(acts), nd.array(labels),
+                      data_lengths=nd.array(dlen),
+                      label_lengths=nd.array(llen),
+                      use_data_lengths=True, use_label_lengths=True)
+    want = _torch_ctc(acts, labels.astype("i8"), dlen.astype("i8"),
+                      llen.astype("i8"), blank=0)
+    assert_almost_equal(_np(out), want, rtol=1e-4, atol=1e-4)
+
+
+@with_seed(3)
+def test_ctc_loss_gradient_matches_torch():
+    import torch
+    import torch.nn.functional as F
+
+    T, N, C, L = 6, 2, 5, 2
+    rng = onp.random.RandomState(3)
+    acts = rng.randn(T, N, C).astype("f")
+    labels = rng.randint(1, C, (N, L)).astype("f")
+    x = nd.array(acts)
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.ctc_loss(x, nd.array(labels))
+        loss.backward(nd.ones_like(loss))
+    t = torch.tensor(acts, requires_grad=True)
+    tl = F.ctc_loss(F.log_softmax(t, dim=-1), torch.tensor(
+        labels.astype("i8")), [T] * N, [L] * N, blank=0, reduction="sum")
+    tl.backward()
+    assert_almost_equal(_np(x.grad), t.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+# -------------------------------------------------------------- resizing ---
+
+def test_bilinear_resize2d_identity_and_upscale():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(2, 3, 5, 7).astype("f")
+    same = nd.bilinear_resize2d(nd.array(x), height=5, width=7)
+    assert_almost_equal(_np(same), x, rtol=1e-6, atol=1e-6)
+    up = nd.bilinear_resize2d(nd.array(x), height=10, width=14)
+    assert up.shape == (2, 3, 10, 14)
+    # corners are exact under align_corners=True
+    got = _np(up)
+    assert_almost_equal(got[..., 0, 0], x[..., 0, 0], rtol=1e-5, atol=1e-6)
+    assert_almost_equal(got[..., -1, -1], x[..., -1, -1],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_resize2d_scale_mode():
+    x = nd.array(onp.arange(24, dtype="f").reshape(1, 1, 4, 6))
+    out = nd.bilinear_resize2d(x, scale_height=2.0, scale_width=0.5,
+                               mode="scale")
+    assert out.shape == (1, 1, 8, 3)
+
+
+def test_adaptive_avg_pooling2d_global_and_even():
+    rng = onp.random.RandomState(1)
+    x = rng.rand(2, 4, 6, 6).astype("f")
+    g = nd.contrib.adaptive_avg_pooling2d(nd.array(x), output_size=1)
+    assert_almost_equal(_np(g)[..., 0, 0], x.mean((2, 3)),
+                        rtol=1e-5, atol=1e-6)
+    h = nd.contrib.adaptive_avg_pooling2d(nd.array(x), output_size=3)
+    want = x.reshape(2, 4, 3, 2, 3, 2).mean((3, 5))
+    assert_almost_equal(_np(h), want, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------- optimizer rules ---
+
+def _opt_data(shape=(7, 3), seed=0, n_extra=0):
+    rng = onp.random.RandomState(seed)
+    return [rng.randn(*shape).astype("f") for _ in range(2 + n_extra)]
+
+
+def test_rmsprop_update_formula():
+    w, g, n = _opt_data(n_extra=1)
+    n = onp.square(n)
+    lr, gamma1, eps, wd = 0.02, 0.9, 1e-8, 0.01
+    w2, n2 = nd.rmsprop_update(nd.array(w), nd.array(g), nd.array(n), lr,
+                               gamma1=gamma1, epsilon=eps, wd=wd)
+    ge = g + wd * w
+    n_want = (1 - gamma1) * ge ** 2 + gamma1 * n
+    w_want = w - lr * ge / onp.sqrt(n_want + eps)
+    assert_almost_equal(_np(n2), n_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(w2), w_want, rtol=1e-5, atol=1e-6)
+
+
+def test_rmspropalex_update_formula():
+    w, g, n, gbar, delta = _opt_data(n_extra=3)
+    n = onp.square(n)
+    # a consistent EMA state keeps n - gbar^2 >= 0 (as in real trajectories)
+    gbar = onp.zeros_like(gbar)
+    delta = onp.zeros_like(delta)
+    lr, g1, g2, eps = 0.01, 0.95, 0.9, 1e-8
+    outs = nd.rmspropalex_update(
+        nd.array(w), nd.array(g), nd.array(n), nd.array(gbar),
+        nd.array(delta), lr, gamma1=g1, gamma2=g2, epsilon=eps)
+    n_want = (1 - g1) * g ** 2 + g1 * n
+    g_want = (1 - g1) * g + g1 * gbar
+    d_want = g2 * delta - lr * g / onp.sqrt(n_want - g_want ** 2 + eps)
+    assert_almost_equal(_np(outs[1]), n_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(outs[2]), g_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(outs[3]), d_want, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(outs[0]), w + d_want, rtol=1e-4, atol=1e-5)
+
+
+def test_ftrl_update_formula():
+    w, g, z, n = _opt_data(n_extra=2)
+    n = onp.square(n)
+    lr, l1, beta, wd = 0.1, 0.05, 1.0, 0.01
+    w2, z2, n2 = nd.ftrl_update(nd.array(w), nd.array(g), nd.array(z),
+                                nd.array(n), lr, lamda1=l1, beta=beta, wd=wd)
+    n_want = n + g ** 2
+    z_want = z + g - (onp.sqrt(n_want) - onp.sqrt(n)) / lr * w
+    w_want = onp.where(
+        onp.abs(z_want) <= l1, 0.0,
+        -(z_want - onp.sign(z_want) * l1)
+        / ((beta + onp.sqrt(n_want)) / lr + wd))
+    assert_almost_equal(_np(z2), z_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(n2), n_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(w2), w_want, rtol=1e-5, atol=1e-6)
+    # sparsifying property: small |z| coordinates land exactly at zero
+    assert (onp.abs(_np(w2))[onp.abs(z_want) <= l1] == 0).all()
+
+
+def test_ftml_update_formula():
+    w, g, d, v, z = _opt_data(n_extra=3)
+    v = onp.square(v)
+    lr, b1, b2, eps, t = 0.05, 0.6, 0.999, 1e-8, 3
+    outs = nd.ftml_update(nd.array(w), nd.array(g), nd.array(d),
+                          nd.array(v), nd.array(z), lr, beta1=b1, beta2=b2,
+                          epsilon=eps, t=t)
+    v_want = b2 * v + (1 - b2) * g ** 2
+    d_want = (1 - b1 ** t) / lr * (onp.sqrt(v_want / (1 - b2 ** t)) + eps)
+    sigma = d_want - b1 * d
+    z_want = b1 * z + (1 - b1) * g - sigma * w
+    assert_almost_equal(_np(outs[1]), d_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(outs[2]), v_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(outs[3]), z_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(outs[0]), -z_want / d_want, rtol=1e-5, atol=1e-6)
+
+
+def test_nag_mom_update_formula():
+    w, g, m = _opt_data(n_extra=1)
+    lr, mom, wd = 0.1, 0.9, 0.01
+    w2, m2 = nd.nag_mom_update(nd.array(w), nd.array(g), nd.array(m), lr,
+                               momentum=mom, wd=wd)
+    ge = g + wd * w
+    m_want = mom * m + ge
+    w_want = w - lr * (ge + mom * m_want)
+    assert_almost_equal(_np(m2), m_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(w2), w_want, rtol=1e-5, atol=1e-6)
+
+
+def test_signsgd_signum_formulas():
+    w, g, m = _opt_data(n_extra=1)
+    lr = 0.01
+    w2 = nd.signsgd_update(nd.array(w), nd.array(g), lr)
+    assert_almost_equal(_np(w2), w - lr * onp.sign(g), rtol=1e-6, atol=1e-7)
+    mom, wd_lh = 0.9, 0.1
+    w3, m3 = nd.signum_update(nd.array(w), nd.array(g), nd.array(m), lr,
+                              momentum=mom, wd_lh=wd_lh)
+    m_want = mom * m - (1 - mom) * g
+    assert_almost_equal(_np(m3), m_want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(w3), (1 - lr * wd_lh) * w + lr * onp.sign(m_want),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_update_ops_clip_and_rescale():
+    w, g = _opt_data()
+    w2 = nd.sgd_update(nd.array(w), nd.array(g), 1.0, rescale_grad=0.5,
+                       clip_gradient=0.1)
+    want = w - onp.clip(0.5 * g, -0.1, 0.1)
+    assert_almost_equal(_np(w2), want, rtol=1e-6, atol=1e-7)
+
+
+# --------------------------------------------------- amp / grad plumbing ---
+
+def test_multi_sum_sq_and_all_finite():
+    rng = onp.random.RandomState(2)
+    arrs = [rng.randn(4, 5).astype("f"), rng.randn(7).astype("f")]
+    outs = nd.multi_sum_sq(*[nd.array(a) for a in arrs])
+    for o, a in zip(outs, arrs):
+        assert_almost_equal(_np(o), [(a ** 2).sum()], rtol=1e-5, atol=1e-6)
+    ok = nd.all_finite(*[nd.array(a) for a in arrs])
+    assert _np(ok)[0] == 1.0
+    arrs[1][3] = onp.inf
+    bad = nd.all_finite(*[nd.array(a) for a in arrs])
+    assert _np(bad)[0] == 0.0
+    nan = nd.all_finite(nd.array(onp.array([onp.nan], "f")))
+    assert _np(nan)[0] == 0.0
+
+
+def test_amp_multicast_widest_type():
+    a = nd.array(onp.ones((2, 2), "f")).astype("float16")
+    b = nd.array(onp.ones((2, 2), "f"))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    assert all(o.dtype == onp.float32 for o in outs)
+
+
+# ------------------------------------------------------------- indexing ---
+
+def test_batch_take_rows():
+    x = onp.arange(12, dtype="f").reshape(4, 3)
+    idx = onp.array([2, 0, 1, 2], "f")
+    out = nd.batch_take(nd.array(x), nd.array(idx))
+    assert_almost_equal(_np(out), x[onp.arange(4), idx.astype(int)],
+                        rtol=0, atol=0)
+
+
+def test_index_copy_semantics():
+    old = nd.zeros((5, 3))
+    new = nd.array(onp.arange(6, dtype="f").reshape(2, 3))
+    out = nd.contrib.index_copy(old, nd.array(onp.array([1, 3], "f")), new)
+    want = onp.zeros((5, 3), "f")
+    want[[1, 3]] = _np(new)
+    assert_almost_equal(_np(out), want, rtol=0, atol=0)
+
+
+def test_split_v2_sections_indices_squeeze():
+    x = onp.arange(24, dtype="f").reshape(6, 4)
+    parts = nd.split_v2(nd.array(x), 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 4)
+    assert_almost_equal(_np(parts[1]), x[2:4], rtol=0, atol=0)
+    uneven = nd.split_v2(nd.array(x), (1, 4), axis=0)
+    assert [p.shape[0] for p in uneven] == [1, 3, 2]
+    assert_almost_equal(_np(uneven[1]), x[1:4], rtol=0, atol=0)
+    sq = nd.split_v2(nd.array(x), 6, axis=0, squeeze_axis=True)
+    assert sq[0].shape == (4,)
+
+
+def test_mean_all_scalar():
+    rng = onp.random.RandomState(3)
+    x = rng.rand(3, 4, 5).astype("f")
+    out = nd.mean_all(nd.array(x))
+    assert out.shape == ()
+    assert_almost_equal(_np(out), x.mean(), rtol=1e-6, atol=1e-7)
+
+
+# ----------------------------------------------------------- svm_output ---
+
+def test_svm_output_forward_identity_and_hinge_grad():
+    # Reference svm_output-inl.h L1_SVM/L2_SVM: one-vs-rest hinge — the
+    # true-class logit is pushed above +margin, every other logit below
+    # -margin, each element independently.
+    rng = onp.random.RandomState(4)
+    x = rng.randn(4, 5).astype("f")
+    y = onp.array([0, 2, 4, 1], "f")
+    margin, reg = 0.7, 1.3
+    for use_linear in (True, False):
+        xv = nd.array(x)
+        xv.attach_grad()
+        with autograd.record():
+            out = nd.svm_output(xv, nd.array(y), margin=margin,
+                                regularization_coefficient=reg,
+                                use_linear=use_linear)
+            out.backward(nd.ones_like(out))
+        assert_almost_equal(_np(out), x, rtol=1e-6, atol=1e-7)
+        onehot = onp.eye(5, dtype="f")[y.astype(int)]
+        signed = onp.where(onehot > 0, x, -x)
+        sgn = onp.where(onehot > 0, -1.0, 1.0)
+        if use_linear:
+            want = onp.where(margin - signed > 0, sgn, 0.0) * reg
+        else:
+            want = onp.where(margin - signed > 0,
+                             2.0 * (margin - signed) * sgn, 0.0) * reg
+        assert_almost_equal(_np(xv.grad), want, rtol=1e-5, atol=1e-6)
+
+
+@with_seed(5)
+def test_sample_ops_per_row_params():
+    mu = nd.array(onp.array([[0.0], [10.0]], "f").reshape(2))
+    sig = nd.array(onp.array([1.0, 2.0], "f"))
+    s = nd.sample_normal(mu=mu, sigma=sig, shape=(4000,))
+    assert s.shape == (2, 4000)
+    m = _np(s).mean(1)
+    assert abs(m[0]) < 0.2 and abs(m[1] - 10) < 0.4
+    u = nd.sample_uniform(low=nd.array(onp.array([0.0, 5.0], "f")),
+                          high=nd.array(onp.array([1.0, 6.0], "f")),
+                          shape=(1000,))
+    un = _np(u)
+    assert un[0].min() >= 0 and un[0].max() <= 1
+    assert un[1].min() >= 5 and un[1].max() <= 6

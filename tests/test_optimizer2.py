@@ -115,8 +115,6 @@ def test_lr_wd_mult_apply():
     o = opt.SGD(learning_rate=1.0, wd=0.1)
     o.set_lr_mult({"w": 0.5})
     o.set_wd_mult({"w": 0.0})
-    idx = 0
-    o._index_update_count = {}
     # through the updater with named index mapping
     upd = opt.get_updater(o)
     wn = nd.array(W0.copy())
