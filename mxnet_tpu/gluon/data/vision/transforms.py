@@ -9,6 +9,7 @@ import numpy as onp
 
 from .... import ndarray as nd
 from ....ndarray import NDArray
+from ....ndarray import ops_image as _ops_image
 from ...block import Block, HybridBlock
 from ...nn import Sequential, HybridSequential
 
@@ -138,6 +139,13 @@ class RandomFlipTopBottom(_RandomFlip):
 
 
 class _RandomJitter(Block):
+    """Host-drawn alpha + the shared jitter math from ops_image (one
+    source of truth for the BT.601 / YIQ constants and blend formulas —
+    the registered `nd.image.random_*` ops use the same helpers with
+    device-side draws)."""
+
+    _impl = None  # staticmethod(jnp_array, alpha) -> jnp_array
+
     def __init__(self, val):
         super().__init__()
         self._val = val
@@ -147,58 +155,35 @@ class _RandomJitter(Block):
 
         return 1.0 + pyrandom.uniform(-self._val, self._val)
 
+    def forward(self, x):
+        f = x.astype("float32")
+        out = NDArray(type(self)._impl(f.data, self._alpha()))
+        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
+            else out
+
 
 class RandomBrightness(_RandomJitter):
-    def forward(self, x):
-        return nd.clip(x.astype("float32") * self._alpha(), 0, 255).astype(
-            x.dtype) if x.dtype == onp.uint8 else x * self._alpha()
+    _impl = staticmethod(_ops_image._brightness)
 
 
 class RandomContrast(_RandomJitter):
-    def forward(self, x):
-        alpha = self._alpha()
-        f = x.astype("float32")
-        gray = nd.mean(f)
-        out = f * alpha + gray * (1 - alpha)
-        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
-            else out
+    _impl = staticmethod(_ops_image._contrast)
 
 
 class RandomSaturation(_RandomJitter):
-    def forward(self, x):
-        alpha = self._alpha()
-        f = x.astype("float32")
-        coef = nd.array(onp.array([0.299, 0.587, 0.114], dtype=onp.float32))
-        gray = nd.sum(f * coef.reshape((1, 1, 3)), axis=2, keepdims=True)
-        out = f * alpha + gray * (1 - alpha)
-        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
-            else out
+    _impl = staticmethod(_ops_image._saturation)
 
 
 class RandomHue(_RandomJitter):
     """YIQ-rotation hue jitter (reference: transforms.py RandomHue /
-    image.py HueJitterAug matrices)."""
+    image.py HueJitterAug matrices; math in ops_image._hue)."""
 
-    _tyiq = onp.array([[0.299, 0.587, 0.114],
-                       [0.596, -0.274, -0.321],
-                       [0.211, -0.523, 0.311]], "float32")
-    _ityiq = onp.array([[1.0, 0.956, 0.621],
-                        [1.0, -0.272, -0.647],
-                        [1.0, -1.107, 1.705]], "float32")
+    _impl = staticmethod(_ops_image._hue)
 
-    def forward(self, x):
+    def _alpha(self):
         import random as pyrandom
 
-        alpha = pyrandom.uniform(-self._val, self._val)
-        u = onp.cos(alpha * onp.pi)
-        w = onp.sin(alpha * onp.pi)
-        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
-                       "float32")
-        t = onp.dot(onp.dot(self._ityiq, bt), self._tyiq).T
-        f = x.astype("float32")
-        out = nd.dot(f, nd.array(t))
-        return nd.clip(out, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
-            else out
+        return pyrandom.uniform(-self._val, self._val)  # rotation, not 1+u
 
 
 class RandomColorJitter(Block):
@@ -266,12 +251,8 @@ class CropResize(Block):
 
 
 class RandomLighting(Block):
-    """AlexNet-style PCA noise (reference: transforms.py RandomLighting)."""
-
-    _eigval = onp.array([55.46, 4.794, 1.148], dtype=onp.float32)
-    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
-                         [-0.5808, -0.0045, -0.8140],
-                         [-0.5836, -0.6948, 0.4203]], dtype=onp.float32)
+    """AlexNet-style PCA noise (reference: transforms.py RandomLighting;
+    eigen-basis shared with ops_image._adjust)."""
 
     def __init__(self, alpha_std=0.05):
         super().__init__()
@@ -279,7 +260,6 @@ class RandomLighting(Block):
 
     def forward(self, x):
         alpha = onp.random.normal(0, self._alpha_std, 3).astype(onp.float32)
-        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
-        f = x.astype("float32") + nd.array(rgb.reshape(1, 1, 3))
+        f = NDArray(_ops_image._adjust(x.astype("float32").data, alpha))
         return nd.clip(f, 0, 255).astype(x.dtype) if x.dtype == onp.uint8 \
             else f

@@ -204,6 +204,9 @@ _CONTRIB_OPS = [
     "fft", "ifft", "count_sketch", "deformable_convolution",
     "proposal", "multi_proposal", "psroi_pooling",
     "deformable_psroi_pooling", "mrcnn_mask_target",
+    "quadratic", "allclose", "div_sqrt_dim", "gradientmultiplier",
+    "round_ste", "sign_ste", "reset_arrays", "box_encode", "box_decode",
+    "rroi_align", "multi_lars",
 ]
 
 # CamelCase contrib aliases (reference registered names)
@@ -232,6 +235,22 @@ def _install():
 
 
 _install()
+
+
+_reset_arrays_pure = reset_arrays  # noqa: F821  (installed by _install)
+
+
+def reset_arrays(*arrays, num_arrays=0):  # noqa: F811
+    """In-place variant matching the reference's mutate-inputs contract
+    (contrib/reset_arrays.cc): call sites discard the return and expect
+    the inputs zeroed, so rebind each NDArray's buffer to the zeroed
+    result."""
+    outs = _reset_arrays_pure(*arrays, num_arrays=num_arrays)
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    for arr, out in zip(arrays, outs):
+        arr._data = out.data
+    return outs
 
 # DGL graph-sampling ops (host-side CSR work; reference:
 # src/operator/contrib/dgl_graph.cc). Exposed with the reference's

@@ -286,6 +286,25 @@ def l2_normalization(data, eps=1e-10, mode="instance"):
 # ------------------------------------------------------------ shape -------
 
 @register()
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape, optionally only over axis ranges
+    (reference: elemwise_unary_op_basic.cc:440-457 GetReshapeLikeParams):
+    out.shape = lhs.shape[:lhs_begin] + rhs.shape[rhs_begin:rhs_end]
+    + lhs.shape[lhs_end:]."""
+    def canon(v, nd, default):
+        v = default if v is None else int(v)
+        return v + nd if v < 0 else v
+
+    lb = canon(lhs_begin, lhs.ndim, 0)
+    le = canon(lhs_end, lhs.ndim, lhs.ndim)
+    rb = canon(rhs_begin, rhs.ndim, 0)
+    re_ = canon(rhs_end, rhs.ndim, rhs.ndim)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+@register()
 def reshape(data, shape=None, reverse=False):
     """MXNet reshape with special codes 0/-1/-2/-3/-4
     (reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape)."""

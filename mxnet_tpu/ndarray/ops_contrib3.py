@@ -1,0 +1,197 @@
+"""Contrib operator long tail, third batch.
+
+TPU-native equivalents of the remaining src/operator/contrib/ single-op
+files: quadratic_op.cc, allclose_op.cc, transformer.cc (div_sqrt_dim),
+gradient_multiplier_op.cc, stes_op.cc (straight-through estimators),
+reset_arrays.cc, bounding_box.cc (box_encode/box_decode), rroi_align.cc.
+Elementwise math lowers to jnp (XLA fuses); rroi_align is a vmapped
+bilinear gather like roi_align in ops_contrib.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register()
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Reference: contrib/quadratic_op.cc — a*x^2 + b*x + c."""
+    return a * data * data + b * data + c
+
+
+@register(differentiable=False)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """Reference: contrib/allclose_op.cc — scalar 1.0/0.0."""
+    ok = jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register()
+def div_sqrt_dim(data):
+    """Reference: contrib/transformer.cc _contrib_div_sqrt_dim —
+    out = data / sqrt(data.shape[-1]) (attention-score scaling)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# --- straight-through / gradient-shaping ops ------------------------------
+
+def _ste(fwd):
+    """Identity-gradient wrapper (reference stes_op.cc: the backward is
+    CloneGradient of the output grad)."""
+    f = jax.custom_vjp(lambda x: fwd(x))
+    f.defvjp(lambda x: (fwd(x), None), lambda _, g: (g,))
+    return f
+
+
+_round_ste = _ste(jnp.round)
+_sign_ste = _ste(jnp.sign)
+
+
+@register()
+def round_ste(data):
+    """Reference: contrib/stes_op.cc _contrib_round_ste."""
+    return _round_ste(data)
+
+
+@register()
+def sign_ste(data):
+    """Reference: contrib/stes_op.cc _contrib_sign_ste."""
+    return _sign_ste(data)
+
+
+def _grad_mult(scalar):
+    f = jax.custom_vjp(lambda x: x)
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: ((g * scalar).astype(g.dtype),))
+    return f
+
+
+@register()
+def gradientmultiplier(data, scalar=1.0):
+    """Reference: contrib/gradient_multiplier_op.cc — forward identity,
+    backward scales the gradient (gradient-reversal layers use
+    scalar=-lambda)."""
+    return _grad_mult(float(scalar))(data)
+
+
+@register(differentiable=False)
+def reset_arrays(*arrays, num_arrays=0):
+    """Reference: contrib/reset_arrays.cc — zero every input array. The
+    pure body returns zeroed copies; the `nd.contrib.reset_arrays`
+    wrapper (contrib.py) rebinds the input NDArrays' buffers so MXNet
+    call sites that rely on the in-place side effect work."""
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+# --- bounding-box target coding (reference bounding_box.cc) ----------------
+
+@register(differentiable=False)
+def box_encode(samples, matches, anchors, refs, means=None, stds=None):
+    """Encode matched reference boxes as normalized center offsets
+    (reference bounding_box-inl.h box_encode). samples (B,N) in
+    {+1,-1,0}; matches (B,N) indices into refs; anchors (B,N,4) and
+    refs (B,M,4) corner-format. Returns (targets, masks), both (B,N,4).
+    """
+    means = jnp.asarray([0.0, 0.0, 0.0, 0.0] if means is None else means,
+                        anchors.dtype)
+    stds = jnp.asarray([0.1, 0.1, 0.2, 0.2] if stds is None else stds,
+                       anchors.dtype)
+    m = jnp.take_along_axis(
+        refs, matches.astype(jnp.int32)[..., None], axis=1)  # (B,N,4)
+    ref_w = m[..., 2] - m[..., 0]
+    ref_h = m[..., 3] - m[..., 1]
+    ref_x = m[..., 0] + ref_w * 0.5
+    ref_y = m[..., 1] + ref_h * 0.5
+    a_w = anchors[..., 2] - anchors[..., 0]
+    a_h = anchors[..., 3] - anchors[..., 1]
+    a_x = anchors[..., 0] + a_w * 0.5
+    a_y = anchors[..., 1] + a_h * 0.5
+    t = jnp.stack([(ref_x - a_x) / a_w, (ref_y - a_y) / a_h,
+                   jnp.log(ref_w / a_w), jnp.log(ref_h / a_h)], axis=-1)
+    t = (t - means) / stds
+    valid = (samples > 0.5)[..., None]
+    masks = jnp.broadcast_to(valid, t.shape).astype(anchors.dtype)
+    return jnp.where(valid, t, 0.0), masks
+
+
+@register(differentiable=False)
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="corner"):
+    """Decode predicted center offsets back to corner boxes (reference
+    bounding_box-inl.h box_decode). data (B,N,4); anchors (1,N,4) in
+    `format` ('corner' or 'center')."""
+    a = anchors
+    if format == "corner":
+        a_w = a[..., 2] - a[..., 0]
+        a_h = a[..., 3] - a[..., 1]
+        a_x = a[..., 0] + a_w * 0.5
+        a_y = a[..., 1] + a_h * 0.5
+    else:
+        a_x, a_y, a_w, a_h = (a[..., 0], a[..., 1], a[..., 2], a[..., 3])
+    ox = data[..., 0] * std0 * a_w + a_x
+    oy = data[..., 1] * std1 * a_h + a_y
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * a_w * 0.5
+    oh = jnp.exp(dh) * a_h * 0.5
+    return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+
+@register()
+def rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sampling_ratio=-1):
+    """Rotated ROIAlign (reference: contrib/rroi_align.cc). rois (R,6):
+    [batch_idx, cx, cy, w, h, theta_degrees]; data (N,C,H,W); output
+    (R,C,ph,pw) — the average of bilinear samples on a grid rotated by
+    theta about the box center. sampling_ratio -1 → 2 per axis (static
+    for XLA, matching roi_align's policy above)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    s = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+    N, C, H, W = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        th = roi[5] * (jnp.pi / 180.0)
+        cos_t, sin_t = jnp.cos(th), jnp.sin(th)
+        bin_h, bin_w = rh / ph, rw / pw
+        # unrotated sample offsets wrt the box center
+        yy = (-rh / 2.0 + bin_h * (jnp.arange(ph)[:, None]
+              + (jnp.arange(s)[None, :] + 0.5) / s)).reshape(-1)  # (ph*s,)
+        xx = (-rw / 2.0 + bin_w * (jnp.arange(pw)[:, None]
+              + (jnp.arange(s)[None, :] + 0.5) / s)).reshape(-1)  # (pw*s,)
+        yy2 = yy[:, None] * jnp.ones_like(xx)[None, :]
+        xx2 = jnp.ones_like(yy)[:, None] * xx[None, :]
+        # rotate about the center, then translate (rroi_align.cc:70-72)
+        x = xx2 * cos_t + yy2 * sin_t + cx
+        y = yy2 * cos_t - xx2 * sin_t + cy
+        oob = (y < -1.0) | (y > H) | (x < -1.0) | (x > W)
+        y = jnp.clip(y, 0.0, H - 1)
+        x = jnp.clip(x, 0.0, W - 1)
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        img = jnp.take(data, b, axis=0)  # (C, Hs, Ws)
+
+        def gather(yi, xi):
+            return img[:, yi.astype(jnp.int32), xi.astype(jnp.int32)]
+
+        val = (gather(y0, x0) * (1 - ly) * (1 - lx)
+               + gather(y0, x1) * (1 - ly) * lx
+               + gather(y1, x0) * ly * (1 - lx)
+               + gather(y1, x1) * ly * lx)
+        val = jnp.where(oob[None], 0.0, val)  # (C, ph*s, pw*s)
+        return jnp.mean(
+            val.reshape(C, ph, s, pw, s), axis=(2, 4))
+
+    return jax.vmap(one)(rois)

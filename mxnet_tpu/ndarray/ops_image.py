@@ -1,0 +1,202 @@
+"""`mx.nd.image` operator namespace.
+
+TPU-native equivalents of the reference image ops
+(src/operator/image/image_random.cc `_image_*`, crop.cc `_image_crop`,
+resize.cc `_image_resize`) that back `gluon.data.vision.transforms`.
+Layout conventions follow the reference: `to_tensor` maps HWC→CHW,
+`normalize` operates on CHW/NCHW, everything else operates on HWC (or
+batched NHWC) with channels last. Random ops draw from the ambient key
+provider (mxnet_tpu.random) so they are pure under jit, like
+ops_random.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ITU-R BT.601 luma (reference image_random-inl.h RGB2GrayConvert)
+_GRAY = (0.299, 0.587, 0.114)
+# YIQ transform pair used by the reference's hue adjustment
+_TYIQ = ((0.299, 0.587, 0.114),
+         (0.596, -0.274, -0.321),
+         (0.211, -0.523, 0.311))
+_ITYIQ = ((1.0, 0.956, 0.621),
+          (1.0, -0.272, -0.647),
+          (1.0, -1.107, 1.705))
+# AlexNet PCA lighting basis (reference AdjustLightingParam defaults)
+_EIG_VAL = (55.46, 4.794, 1.148)
+_EIG_VEC = ((-0.5675, 0.7192, 0.4009),
+            (-0.5808, -0.0045, -0.8140),
+            (-0.5836, -0.6948, 0.4203))
+
+
+def _key():
+    from .. import random as mxrandom
+
+    return mxrandom.next_key()
+
+
+def _gray(hwc):
+    w = jnp.asarray(_GRAY, hwc.dtype)
+    return jnp.sum(hwc * w, axis=-1, keepdims=True)
+
+
+@register(name="image_to_tensor")
+def to_tensor(data):
+    """HWC (or NHWC) [0,255] → CHW (NCHW) float32 in [0,1]."""
+    x = data.astype(jnp.float32) / 255.0
+    axes = (2, 0, 1) if data.ndim == 3 else (0, 3, 1, 2)
+    return jnp.transpose(x, axes)
+
+
+@register(name="image_normalize")
+def normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW or NCHW input."""
+    mean = jnp.atleast_1d(jnp.asarray(mean, data.dtype))
+    std = jnp.atleast_1d(jnp.asarray(std, data.dtype))
+    cshape = [1] * data.ndim
+    cshape[0 if data.ndim == 3 else 1] = -1
+    return (data - mean.reshape(cshape)) / std.reshape(cshape)
+
+
+@register(name="image_flip_left_right")
+def flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register(name="image_flip_top_bottom")
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register(name="image_random_flip_left_right", differentiable=False)
+def random_flip_left_right(data):
+    coin = jax.random.bernoulli(_key())
+    return jnp.where(coin, jnp.flip(data, axis=-2), data)
+
+
+@register(name="image_random_flip_top_bottom", differentiable=False)
+def random_flip_top_bottom(data):
+    coin = jax.random.bernoulli(_key())
+    return jnp.where(coin, jnp.flip(data, axis=-3), data)
+
+
+def _brightness(data, alpha):
+    return data * alpha
+
+
+def _contrast(data, alpha):
+    # blend with the image's mean luma (reference ContrastImpl)
+    mean_gray = jnp.mean(_gray(data), axis=(-3, -2), keepdims=True)
+    return data * alpha + mean_gray * (1.0 - alpha)
+
+
+def _saturation(data, alpha):
+    # blend with the per-pixel luma (reference SaturationImpl)
+    return data * alpha + _gray(data) * (1.0 - alpha)
+
+
+def _hue(data, alpha):
+    """Rotate chroma in YIQ space by pi*alpha (reference HueImpl)."""
+    u = jnp.cos(alpha * jnp.pi)
+    w = jnp.sin(alpha * jnp.pi)
+    rot = jnp.array([[1.0, 0.0, 0.0],
+                     [0.0, 1.0, 0.0],
+                     [0.0, 0.0, 1.0]], data.dtype)
+    rot = rot.at[1, 1].set(u).at[1, 2].set(-w)
+    rot = rot.at[2, 1].set(w).at[2, 2].set(u)
+    t = jnp.asarray(_ITYIQ, data.dtype) @ rot @ jnp.asarray(_TYIQ,
+                                                            data.dtype)
+    return data @ t.T
+
+
+def _unif(lo, hi):
+    return jax.random.uniform(_key(), (), minval=lo, maxval=hi)
+
+
+@register(name="image_random_brightness", differentiable=False)
+def random_brightness(data, min_factor=0.0, max_factor=0.0):
+    return _brightness(data, _unif(min_factor, max_factor))
+
+
+@register(name="image_random_contrast", differentiable=False)
+def random_contrast(data, min_factor=0.0, max_factor=0.0):
+    return _contrast(data, _unif(min_factor, max_factor))
+
+
+@register(name="image_random_saturation", differentiable=False)
+def random_saturation(data, min_factor=0.0, max_factor=0.0):
+    return _saturation(data, _unif(min_factor, max_factor))
+
+
+@register(name="image_random_hue", differentiable=False)
+def random_hue(data, min_factor=0.0, max_factor=0.0):
+    return _hue(data, _unif(min_factor, max_factor))
+
+
+@register(name="image_random_color_jitter", differentiable=False)
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    """Apply the four jitters in sequence, each with its own draw
+    (reference RandomColorJitter composes the same four)."""
+    if brightness > 0:
+        data = _brightness(data, _unif(1 - brightness, 1 + brightness))
+    if contrast > 0:
+        data = _contrast(data, _unif(1 - contrast, 1 + contrast))
+    if saturation > 0:
+        data = _saturation(data, _unif(1 - saturation, 1 + saturation))
+    if hue > 0:
+        data = _hue(data, _unif(-hue, hue))
+    return data
+
+
+def _adjust(data, a):
+    """AlexNet-style PCA lighting: add eigvec @ (alpha * eigval) per
+    channel (reference AdjustLightingImpl)."""
+    a = jnp.asarray(a, jnp.float32) * jnp.asarray(_EIG_VAL, jnp.float32)
+    offset = jnp.asarray(_EIG_VEC, jnp.float32) @ a
+    return data + offset.astype(data.dtype)
+
+
+@register(name="image_adjust_lighting")
+def adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    return _adjust(data, alpha)
+
+
+@register(name="image_random_lighting", differentiable=False)
+def random_lighting(data, alpha_std=0.05):
+    return _adjust(data, jax.random.normal(_key(), (3,)) * alpha_std)
+
+
+@register(name="image_crop")
+def image_crop(data, x=0, y=0, width=1, height=1):
+    """Spatial crop at (x, y) of size (width, height) on HWC/NHWC
+    (reference crop.cc `_image_crop`)."""
+    return jax.lax.slice_in_dim(
+        jax.lax.slice_in_dim(data, y, y + height, axis=data.ndim - 3),
+        x, x + width, axis=data.ndim - 2)
+
+
+@register(name="image_resize")
+def image_resize(data, size=0, keep_ratio=False, interp=1):
+    """Bilinear (interp=1) or nearest (interp=0) resize on HWC/NHWC
+    (reference resize.cc). `size`: int (shorter side if keep_ratio, else
+    square) or (w, h)."""
+    hax = data.ndim - 3
+    h, w = data.shape[hax], data.shape[hax + 1]
+    if isinstance(size, (tuple, list)):
+        new_w, new_h = int(size[0]), int(size[1])
+    elif keep_ratio:
+        if h < w:
+            new_h, new_w = int(size), max(1, round(int(size) * w / h))
+        else:
+            new_w, new_h = int(size), max(1, round(int(size) * h / w))
+    else:
+        new_h = new_w = int(size)
+    shape = list(data.shape)
+    shape[hax], shape[hax + 1] = new_h, new_w
+    method = "linear" if interp else "nearest"
+    out = jax.image.resize(data.astype(jnp.float32), shape, method=method)
+    return out.astype(data.dtype)

@@ -244,10 +244,22 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
 
 
 @register()
-def softmax(data, axis=-1, temperature=None, length=None):
-    """Reference: src/operator/nn/softmax.cc (with optional length masking)."""
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
+            dtype=None):
+    """Reference: src/operator/nn/softmax.cc — optional length masking
+    (`use_length`), temperature, and output `dtype` (the reference
+    accumulates in fp32 when dtype='float32' on half inputs; under XLA
+    the jax.nn.softmax reduction is already fp32-accumulated, so dtype
+    only selects the output type)."""
+    if dtype is not None:
+        data = data.astype(jnp.dtype(dtype))
     if temperature is not None and temperature != 1.0:
         data = data / temperature
+    if length is not None and not use_length:
+        # the reference softmax.cc CHECKs use_length when length is given;
+        # silently unmasking would be a loud-data/quiet-bug situation
+        raise ValueError("softmax: `length` provided without "
+                         "use_length=True")
     if length is not None:
         pos = jnp.arange(data.shape[axis])
         shape = [1] * data.ndim
@@ -261,7 +273,9 @@ def softmax(data, axis=-1, temperature=None, length=None):
 
 
 @register()
-def log_softmax(data, axis=-1, temperature=None):
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    if dtype is not None:
+        data = data.astype(jnp.dtype(dtype))
     if temperature is not None and temperature != 1.0:
         data = data / temperature
     return jax.nn.log_softmax(data, axis=axis)

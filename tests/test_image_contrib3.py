@@ -1,0 +1,270 @@
+"""nd.image namespace + contrib batch-3 ops (quadratic/allclose/STE/
+box coding/rroi_align/reshape_like/softmax params).
+
+Reference models: src/operator/image/image_random.cc tests
+(tests/python/unittest/test_gluon_data_vision.py) and
+tests/python/unittest/test_operator.py (quadratic_function,
+allclose_function, support_vector_machine_*).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+# ------------------------------------------------------------- nd.image ---
+
+def test_to_tensor_and_normalize():
+    rng = onp.random.RandomState(0)
+    img = rng.randint(0, 255, (5, 7, 3)).astype("uint8")
+    t = nd.image.to_tensor(nd.array(img))
+    assert t.shape == (3, 5, 7) and t.dtype == onp.float32
+    assert_almost_equal(_np(t), img.transpose(2, 0, 1) / 255.0,
+                        rtol=1e-6, atol=1e-6)
+    norm = nd.image.normalize(t, mean=(0.1, 0.2, 0.3), std=(0.5, 0.6, 0.7))
+    want = (img.transpose(2, 0, 1) / 255.0
+            - onp.array([0.1, 0.2, 0.3]).reshape(3, 1, 1)) \
+        / onp.array([0.5, 0.6, 0.7]).reshape(3, 1, 1)
+    assert_almost_equal(_np(norm), want, rtol=1e-5, atol=1e-6)
+    # batched NHWC -> NCHW
+    b = nd.image.to_tensor(nd.array(rng.randint(0, 255, (2, 5, 7, 3))
+                                    .astype("uint8")))
+    assert b.shape == (2, 3, 5, 7)
+
+
+def test_flips_are_involutions():
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.rand(4, 6, 3).astype("f"))
+    lr = nd.image.flip_left_right(x)
+    assert_almost_equal(_np(lr), _np(x)[:, ::-1], rtol=0, atol=0)
+    assert_almost_equal(_np(nd.image.flip_left_right(lr)), _np(x),
+                        rtol=0, atol=0)
+    tb = nd.image.flip_top_bottom(x)
+    assert_almost_equal(_np(tb), _np(x)[::-1], rtol=0, atol=0)
+
+
+@with_seed(7)
+def test_random_image_ops_reproducible_and_bounded():
+    rng = onp.random.RandomState(2)
+    x = nd.array(rng.rand(8, 8, 3).astype("f"))
+    mx.random.seed(11)
+    a = _np(nd.image.random_brightness(x, 0.5, 1.5))
+    mx.random.seed(11)
+    b = _np(nd.image.random_brightness(x, 0.5, 1.5))
+    assert_almost_equal(a, b, rtol=0, atol=0)
+    # brightness is a pure scale: ratio constant across pixels
+    ratio = a / _np(x)
+    assert onp.allclose(ratio, ratio.flat[0], rtol=1e-5)
+    assert 0.5 - 1e-5 <= ratio.flat[0] <= 1.5 + 1e-5
+    # random flip either flips or not
+    mx.random.seed(3)
+    f = _np(nd.image.random_flip_left_right(x))
+    assert (onp.allclose(f, _np(x)) or onp.allclose(f, _np(x)[:, ::-1]))
+
+
+def test_hue_and_lighting_identity_at_zero():
+    rng = onp.random.RandomState(3)
+    x = nd.array(rng.rand(4, 4, 3).astype("f"))
+    out = nd.image.random_hue(x, 0.0, 0.0)  # alpha=0 -> identity rotation
+    # the truncated 3-decimal tyiq/ityiq pair (same constants as the
+    # reference) is only approximately inverse — ~1.5% residual
+    assert_almost_equal(_np(out), _np(x), rtol=0.03, atol=0.02)
+    lit = nd.image.adjust_lighting(x, alpha=(0.0, 0.0, 0.0))
+    assert_almost_equal(_np(lit), _np(x), rtol=0, atol=0)
+
+
+def test_saturation_and_contrast_grayscale_blend():
+    rng = onp.random.RandomState(4)
+    x = _np(nd.array(rng.rand(5, 5, 3).astype("f")))
+    # alpha=0 saturation -> per-pixel BT.601 luma in every channel
+    out = _np(nd.image.random_saturation(nd.array(x), 0.0, 0.0))
+    gray = (x * onp.array([0.299, 0.587, 0.114])).sum(-1, keepdims=True)
+    assert_almost_equal(out, onp.broadcast_to(gray, x.shape),
+                        rtol=1e-5, atol=1e-6)
+    # alpha=0 contrast -> image-mean luma everywhere
+    outc = _np(nd.image.random_contrast(nd.array(x), 0.0, 0.0))
+    assert_almost_equal(outc, onp.full_like(x, gray.mean()),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_image_crop_and_resize():
+    x = onp.arange(2 * 6 * 8 * 3, dtype="f").reshape(2, 6, 8, 3)
+    c = nd.image.crop(nd.array(x), x=2, y=1, width=4, height=3)
+    assert_almost_equal(_np(c), x[:, 1:4, 2:6], rtol=0, atol=0)
+    r = nd.image.resize(nd.array(x), size=(4, 3))  # (w, h)
+    assert r.shape == (2, 3, 4, 3)
+    rk = nd.image.resize(nd.array(x), size=4, keep_ratio=True)
+    assert rk.shape == (2, 4, 5, 3)  # shorter side 6 -> 4, 8 -> 5
+
+
+# ------------------------------------------------------------- contrib3 ---
+
+def test_quadratic_value_and_gradient():
+    x = nd.array(onp.array([1.0, -2.0, 0.5], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.quadratic(x, a=2.0, b=-3.0, c=1.0)
+        y.backward(nd.ones_like(y))
+    assert_almost_equal(_np(y), 2 * _np(x) ** 2 - 3 * _np(x) + 1,
+                        rtol=1e-6, atol=1e-7)
+    assert_almost_equal(_np(x.grad), 4 * _np(x) - 3, rtol=1e-6, atol=1e-7)
+
+
+def test_allclose_op():
+    a = nd.array(onp.array([1.0, 2.0], "f"))
+    b = nd.array(onp.array([1.0, 2.0 + 1e-7], "f"))
+    assert _np(nd.contrib.allclose(a, b))[0] == 1.0
+    c = nd.array(onp.array([1.0, 2.5], "f"))
+    assert _np(nd.contrib.allclose(a, c))[0] == 0.0
+
+
+def test_div_sqrt_dim():
+    x = nd.array(onp.ones((2, 16), "f"))
+    assert_almost_equal(_np(nd.contrib.div_sqrt_dim(x)),
+                        onp.ones((2, 16)) / 4.0, rtol=1e-6, atol=1e-7)
+
+
+def test_ste_ops_identity_gradient():
+    v = nd.array(onp.array([0.4, -1.2, 2.6], "f"))
+    v.attach_grad()
+    with autograd.record():
+        o = nd.contrib.round_ste(v)
+        o.backward(nd.array(onp.array([3.0, 5.0, 7.0], "f")))
+    assert_almost_equal(_np(o), onp.round(_np(v)), rtol=0, atol=0)
+    assert_almost_equal(_np(v.grad), [3.0, 5.0, 7.0], rtol=0, atol=0)
+    s = nd.array(onp.array([-0.3, 0.8], "f"))
+    s.attach_grad()
+    with autograd.record():
+        o2 = nd.contrib.sign_ste(s)
+        o2.backward(nd.ones_like(o2))
+    assert_almost_equal(_np(o2), [-1.0, 1.0], rtol=0, atol=0)
+    assert_almost_equal(_np(s.grad), [1.0, 1.0], rtol=0, atol=0)
+
+
+def test_gradient_multiplier_reversal():
+    v = nd.array(onp.array([2.0], "f"))
+    v.attach_grad()
+    with autograd.record():
+        o = nd.contrib.gradientmultiplier(v, scalar=-1.0)  # GRL
+        o.backward(nd.array(onp.array([4.0], "f")))
+    assert_almost_equal(_np(o), [2.0], rtol=0, atol=0)
+    assert_almost_equal(_np(v.grad), [-4.0], rtol=0, atol=0)
+
+
+def test_reset_arrays():
+    a = nd.array(onp.ones((2, 3), "f"))
+    b = nd.array(onp.ones((4,), "f"))
+    oa, ob = nd.contrib.reset_arrays(a, b, num_arrays=2)
+    assert _np(oa).sum() == 0 and _np(ob).sum() == 0
+    assert oa.shape == a.shape and ob.shape == b.shape
+    # reference contract: call sites discard the return and expect the
+    # INPUTS zeroed (contrib/reset_arrays.cc mutates in place)
+    assert _np(a).sum() == 0 and _np(b).sum() == 0
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = onp.array([[[0.0, 0.0, 2.0, 2.0],
+                          [1.0, 1.0, 3.0, 4.0]]], "f")
+    refs = onp.array([[[0.5, 0.5, 2.5, 2.5],
+                       [1.0, 2.0, 3.0, 3.0]]], "f")
+    samples = onp.array([[1.0, -1.0]], "f")  # second anchor negative
+    matches = onp.array([[0, 1]], "f")
+    t, m = nd.contrib.box_encode(
+        nd.array(samples), nd.array(matches), nd.array(anchors),
+        nd.array(refs), nd.array(onp.zeros(4, "f")),
+        nd.array(onp.array([0.1, 0.1, 0.2, 0.2], "f")))
+    # masked-out anchor encodes to zeros with zero mask
+    assert_almost_equal(_np(m)[0, 1], onp.zeros(4), rtol=0, atol=0)
+    assert_almost_equal(_np(t)[0, 1], onp.zeros(4), rtol=0, atol=0)
+    # hand-computed target for the positive anchor
+    want0 = onp.array([(1.5 - 1.0) / 2.0 / 0.1, (1.5 - 1.0) / 2.0 / 0.1,
+                       onp.log(2.0 / 2.0) / 0.2, onp.log(2.0 / 2.0) / 0.2])
+    assert_almost_equal(_np(t)[0, 0], want0, rtol=1e-5, atol=1e-5)
+    # decode(encode(x)) == x for the positive anchor (stds folded in)
+    dec = nd.contrib.box_decode(
+        t * nd.array(onp.array([0.1, 0.1, 0.2, 0.2], "f")),
+        nd.array(anchors), std0=1, std1=1, std2=1, std3=1)
+    assert_almost_equal(_np(dec)[0, 0], refs[0, 0], rtol=1e-5, atol=1e-5)
+
+
+def test_box_decode_center_format_and_clip():
+    anchors = onp.array([[[1.0, 1.0, 2.0, 2.0]]], "f")  # cx,cy,w,h
+    data = onp.array([[[0.0, 0.0, 10.0, 10.0]]], "f")  # huge dw/dh
+    out = nd.contrib.box_decode(nd.array(data), nd.array(anchors),
+                                format="center", clip=1.0)
+    # dw clipped to 1.0 -> half-width = e * 1
+    e = onp.exp(1.0)
+    assert_almost_equal(_np(out)[0, 0],
+                        [1 - e, 1 - e, 1 + e, 1 + e], rtol=1e-5, atol=1e-5)
+
+
+def test_rroi_align_axis_aligned_matches_mean():
+    data = onp.arange(64, dtype="f").reshape(1, 1, 8, 8)
+    # 4x4 box centered at (4,4), no rotation, 2x2 bins
+    rois = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], "f")
+    out = nd.contrib.rroi_align(nd.array(data), nd.array(rois),
+                                pooled_size=(2, 2), spatial_scale=1.0,
+                                sampling_ratio=2)
+    # each 2x2 output bin averages a 2x2-sample grid inside [2,6)x[2,6)
+    got = _np(out)[0, 0]
+    assert got.shape == (2, 2)
+    assert got[0, 0] < got[0, 1] and got[0, 0] < got[1, 0]
+    # 90-degree rotation of a symmetric box permutes bins
+    rois90 = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 90.0]], "f")
+    out90 = _np(nd.contrib.rroi_align(nd.array(data), nd.array(rois90),
+                                      pooled_size=(2, 2),
+                                      spatial_scale=1.0,
+                                      sampling_ratio=2))[0, 0]
+    # rotating the sampling grid by 90deg maps (ph,pw) bins onto each
+    # other: the multiset of bin values is preserved on this symmetric
+    # center box
+    assert_almost_equal(onp.sort(out90.ravel()), onp.sort(got.ravel()),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_rroi_align_out_of_bounds_zero():
+    data = onp.ones((1, 1, 4, 4), "f")
+    rois = onp.array([[0, 40.0, 40.0, 2.0, 2.0, 0.0]], "f")  # far outside
+    out = nd.contrib.rroi_align(nd.array(data), nd.array(rois),
+                                pooled_size=(1, 1))
+    assert _np(out).sum() == 0.0
+
+
+# ------------------------------------------------- reshape_like/softmax ---
+
+def test_reshape_like_full_and_ranges():
+    lhs = nd.array(onp.arange(24, dtype="f").reshape(2, 3, 4))
+    rhs = nd.array(onp.ones((6, 4), "f"))
+    assert nd.reshape_like(lhs, rhs).shape == (6, 4)
+    # partial: reshape lhs axes [0,2) like rhs axes [0,1)
+    rhs2 = nd.array(onp.ones((6, 2, 2), "f"))
+    out = nd.reshape_like(lhs, rhs2, lhs_begin=0, lhs_end=2,
+                          rhs_begin=0, rhs_end=1)
+    assert out.shape == (6, 4)
+    # negative indices
+    out2 = nd.reshape_like(lhs, rhs2, lhs_begin=-3, lhs_end=-1,
+                           rhs_begin=0, rhs_end=1)
+    assert out2.shape == (6, 4)
+
+
+def test_softmax_use_length_and_dtype():
+    x = onp.ones((2, 4), "f")
+    out = _np(nd.softmax(nd.array(x), length=nd.array(
+        onp.array([1, 3], "f")), use_length=True))
+    assert_almost_equal(out[0], [1, 0, 0, 0], rtol=1e-6, atol=1e-6)
+    assert_almost_equal(out[1], [1 / 3, 1 / 3, 1 / 3, 0],
+                        rtol=1e-5, atol=1e-6)
+    h = nd.array(x).astype("float16")
+    assert nd.softmax(h, dtype="float32").dtype == onp.float32
+    assert nd.log_softmax(h, dtype="float32").dtype == onp.float32
+    assert nd.softmax(h).dtype == onp.float16
+    # length without use_length must be loud, not silently unmasked
+    # (reference softmax.cc CHECKs use_length)
+    with pytest.raises(ValueError):
+        nd.softmax(nd.array(x), length=nd.array(onp.array([1, 3], "f")))
