@@ -1,0 +1,3 @@
+print("start", flush=True)
+import mxnet_tpu as mx
+print("import ok", flush=True)

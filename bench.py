@@ -63,7 +63,11 @@ def build_trainer(mesh, classes=1000, dtype=None, layout=None):
     from mxnet_tpu import parallel
 
     mx.random.seed(0)
-    net = vision.resnet50_v1(classes=classes, layout=layout or LAYOUT)
+    # MLPerf-style space-to-depth stem: bit-equivalent to the 7x7/2 conv
+    # (tests/test_s2d_stem.py) but MXU-friendly; BENCH_STEM_S2D=0 reverts
+    net = vision.resnet50_v1(
+        classes=classes, layout=layout or LAYOUT,
+        stem_s2d=os.environ.get("BENCH_STEM_S2D", "1") == "1")
     net.initialize(mx.init.Xavier())
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     return parallel.SPMDTrainer(
@@ -376,6 +380,121 @@ def profile_main():
                   "device": jax.devices()[0].device_kind}}))
 
 
+def rawjax_main():
+    """BENCH_MODE=rawjax: a hand-written ResNet-50 bf16 training step in
+    bare JAX (no framework) — the platform ceiling for this model+chip.
+    Comparing its img/s against the default bench isolates framework
+    overhead from XLA/hardware limits."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    import numpy as onp
+
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    rng = onp.random.RandomState(0)
+    cdt = jnp.bfloat16
+
+    # ---- parameters (fp32 masters), NHWC, bottleneck v1 ----
+    params = {}
+
+    def conv_p(name, cin, cout, k):
+        params[name + ":w"] = jnp.asarray(
+            rng.randn(cout, k, k, cin).astype("f") * (2.0 / (k * k * cin)) ** 0.5)
+
+    def bn_p(name, c):
+        params[name + ":g"] = jnp.ones((c,), jnp.float32)
+        params[name + ":b"] = jnp.zeros((c,), jnp.float32)
+
+    stages = [(64, 256, 3), (128, 512, 4), (256, 1024, 6), (512, 2048, 3)]
+    conv_p("stem", 3, 64, 7)
+    bn_p("stem", 64)
+    cin = 64
+    for si, (mid, out, n) in enumerate(stages):
+        for bi in range(n):
+            pre = f"s{si}b{bi}"
+            conv_p(pre + "c1", cin, mid, 1)
+            bn_p(pre + "c1", mid)
+            conv_p(pre + "c2", mid, mid, 3)
+            bn_p(pre + "c2", mid)
+            conv_p(pre + "c3", mid, out, 1)
+            bn_p(pre + "c3", out)
+            if bi == 0:
+                conv_p(pre + "ds", cin, out, 1)
+                bn_p(pre + "ds", out)
+            cin = out
+    params["fc:w"] = jnp.asarray(rng.randn(2048, 1000).astype("f") * 0.02)
+    params["fc:b"] = jnp.zeros((1000,), jnp.float32)
+
+    def conv(x, w, stride=1):
+        return lax.conv_general_dilated(
+            x, jnp.transpose(w, (1, 2, 3, 0)).astype(cdt),
+            (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def bn_relu(x, g, b, relu=True):
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=(0, 1, 2))
+        v = jnp.var(xf, axis=(0, 1, 2))
+        y = (xf - m) * lax.rsqrt(v + 1e-5) * g + b
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(cdt)
+
+    def fwd(p, x, y):
+        h = conv(x, p["stem:w"], 2)
+        h = bn_relu(h, p["stem:g"], p["stem:b"])
+        h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        for si, (mid, out, n) in enumerate(stages):
+            for bi in range(n):
+                pre = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                r = h
+                h2 = bn_relu(conv(h, p[pre + "c1:w"], stride),
+                             p[pre + "c1:g"], p[pre + "c1:b"])
+                h2 = bn_relu(conv(h2, p[pre + "c2:w"]),
+                             p[pre + "c2:g"], p[pre + "c2:b"])
+                h2 = bn_relu(conv(h2, p[pre + "c3:w"]),
+                             p[pre + "c3:g"], p[pre + "c3:b"], relu=False)
+                if bi == 0:
+                    r = bn_relu(conv(r, p[pre + "ds:w"], stride),
+                                p[pre + "ds:g"], p[pre + "ds:b"],
+                                relu=False)
+                h = jnp.maximum(h2 + r, 0.0).astype(cdt)
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+        logits = h @ p["fc:w"] + p["fc:b"]
+        logp = jax.nn.log_softmax(logits)
+        oh = jax.nn.one_hot(y, 1000)
+        return -jnp.mean(jnp.sum(logp * oh, axis=-1))
+
+    def step(p, mom, x, y):
+        loss, g = jax.value_and_grad(fwd)(p, x, y)
+        mom = {k: 0.9 * mom[k] - 0.05 * g[k] for k in p}
+        p = {k: p[k] + mom[k] for k in p}
+        return loss, p, mom
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype("f")).astype(cdt)
+    y = jnp.asarray(rng.randint(0, 1000, batch))
+    loss, params, mom = jstep(params, mom, x, y)
+    _ = jax.device_get(loss)
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, mom = jstep(params, mom, x, y)
+    lv = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    imgs = batch * iters / dt
+    print(json.dumps({
+        "metric": "rawjax_resnet50_train_imgs_per_sec_bf16",
+        "value": round(imgs, 2), "unit": "img/s",
+        "vs_baseline": round(imgs / BASELINE_IMGS_PER_SEC, 3),
+        "extra": {"batch": batch, "loss": round(lv, 3),
+                  "mfu_pct": mfu_pct(imgs),
+                  "note": "no-framework ceiling for the same model"}}))
+
+
 def io_main():
     """BENCH_MODE=io: input-pipeline throughput — synthetic ImageNet-ish
     .rec -> ImageRecordIter decode + random-crop/mirror + batch, host
@@ -483,6 +602,9 @@ def main():
         return
     if os.environ.get("BENCH_MODE") == "io":
         io_main()
+        return
+    if os.environ.get("BENCH_MODE") == "rawjax":
+        rawjax_main()
         return
     if os.environ.get("BENCH_MODE") == "profile":
         profile_main()

@@ -91,6 +91,11 @@ from . import rnn  # noqa: F401
 from . import env  # noqa: F401
 from . import tools  # noqa: F401
 from . import contrib  # noqa: F401
+from . import util  # noqa: F401
+from . import log  # noqa: F401
+from . import registry  # noqa: F401
+from . import kvstore_server  # noqa: F401  (exits server-role processes)
+from . import monitor as mon  # noqa: F401
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401

@@ -270,6 +270,79 @@ class Executor:
         return [batch if n in self._batch_names else rep
                 for n in self.arg_names + self.aux_names]
 
+    # ---- monitor taps ----------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Tap every op output by name during forward (reference:
+        executor.py set_monitor_callback → MXExecutorSetMonitorCallback,
+        graph_executor.cc:1343-1382). With ``monitor_all``, bound input
+        variables are reported too. The taps are ONE extra jitted
+        computation returning the cached node outputs — fusion of the
+        main forward is untouched."""
+        self._mon_cb = callback
+        self._mon_all = bool(monitor_all)
+        self._mon_jit = None
+
+    def _ensure_monitor(self):
+        if getattr(self, "_mon_jit", None) is not None:
+            return
+        symbol = self._symbol
+        names_in = self.arg_names + self.aux_names
+        # dedup multi-output views on the same identity key _eval_nodes
+        # caches on, preserving topological order
+        taps, seen = [], set()
+        for node in symbol._walk():
+            if node._op is None:
+                continue
+            key = (node._op, id(node._inputs), id(node._kwargs))
+            if key in seen:
+                continue
+            seen.add(key)
+            taps.append(node)
+        mon_names = []
+        if getattr(self, "_mon_all", False):
+            mon_names.extend(names_in)
+        for node in taps:
+            n_out = getattr(node, "_num_outputs", 1) or 1
+            if n_out > 1:  # match Symbol.list_outputs: _output0.._outputN
+                mon_names.extend(f"{node._name}_output{i}"
+                                 for i in range(n_out))
+            else:
+                mon_names.append(f"{node._name}_output")
+        self._mon_names = mon_names
+
+        def mon_fwd(vals, train):
+            from . import autograd
+
+            with autograd.pause(train_mode=train):
+                feed = {n: NDArray(v) for n, v in zip(names_in, vals)}
+                cache = {}
+                outs = []
+                if getattr(self, "_mon_all", False):
+                    outs.extend(vals)
+                for node in taps:
+                    out = node._eval_nodes(feed, cache)
+                    key = (node._op, id(node._inputs), id(node._kwargs))
+                    out = cache.get(key, out)
+                    seq = out if isinstance(out, (list, tuple)) else [out]
+                    outs.extend(o.data for o in seq)
+            return tuple(outs)
+
+        self._mon_jit = jax.jit(mon_fwd, static_argnums=(1,))
+
+    def _run_monitor(self, vals, is_train):
+        cb = getattr(self, "_mon_cb", None)
+        if cb is None:
+            return
+        # a Monitor only collects between tic/toc every `interval` steps;
+        # skip the tap computation entirely on inactive steps
+        active = getattr(cb, "mx_monitor_active", None)
+        if active is not None and not active():
+            return
+        self._ensure_monitor()
+        tapped = self._mon_jit(vals, bool(is_train))
+        for name, val in zip(self._mon_names, tapped):
+            cb(name, NDArray(val))
+
     def forward(self, is_train=False, **kwargs):
         """Reference: executor.py forward / GraphExecutor::RunOps."""
         self._ensure_fwd()
@@ -290,6 +363,7 @@ class Executor:
         else:
             outs = self._fwd_jit(vals, bool(is_train))
         self.outputs = [NDArray(o) for o in outs]
+        self._run_monitor(vals, is_train)
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):

@@ -28,6 +28,73 @@ def _bn_axis(layout):
     return -1 if layout == "NHWC" else 1
 
 
+def _stem_conv(channels, stem_s2d, **kw):
+    """The full-size stem: plain 7x7/2 conv, or its space-to-depth
+    equivalent when stem_s2d is set."""
+    return _S2DStemConv(channels, **kw) if stem_s2d \
+        else Conv2D(channels, 7, 2, 3, **kw)
+
+
+class _S2DStemConv(Conv2D):
+    """The stem 7x7/2 conv computed as a 4x4/1 conv over a 2x
+    space-to-depth input — bit-equivalent, but MXU-friendly: the MXU
+    tiles poorly on a 3-channel stride-2 conv (3/128 lanes busy), while
+    the s2d form feeds 12 channels with unit stride (the MLPerf-ResNet
+    TPU stem). Parameters are IDENTICAL to the plain Conv2D (same name,
+    shape, checkpoint bytes); the reshuffle is recomputed inside the
+    step, where XLA folds it.
+
+    Derivation: o(i,j) = sum_{u,v<7} w[u,v] x[2i+u-3, 2j+v-3]. Substitute
+    h = 2I + r (r the parity): with w padded by one leading zero to 8 and
+    split as u+1 = 2q + r, the sum becomes a 4-tap unit-stride conv over
+    the (I, r)-packed input with asymmetric pad (2, 1) — implemented as
+    pad-by-(4, 2) in the original resolution.
+    """
+
+    def __init__(self, channels, layout="NCHW", **kwargs):
+        super().__init__(channels, 7, 2, 3, layout=layout, **kwargs)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        nhwc = self._channel_last
+        O = self._channels
+        if nhwc:
+            N, H, W, C = x.shape
+        else:
+            N, C, H, W = x.shape
+        # left pad 4 always; right pad rounds the padded size up to even
+        # so odd inputs (which the plain 7x7/2 conv accepts) still pack —
+        # output stays ceil(H/2), matching the plain conv
+        rh, rw = 2 + (H % 2), 2 + (W % 2)
+        Ip, Jp = (H + 4 + rh) // 2, (W + 4 + rw) // 2
+        if nhwc:
+            x = F.pad(x, mode="constant",
+                      pad_width=(0, 0, 4, rh, 4, rw, 0, 0))
+            xs = F.reshape(x, (N, Ip, 2, Jp, 2, C))
+            xs = F.transpose(xs, axes=(0, 1, 3, 5, 2, 4))
+            xs = F.reshape(xs, (N, Ip, Jp, C * 4))
+            w = F.transpose(weight, axes=(0, 3, 1, 2))  # (O,C,7,7)
+        else:
+            x = F.pad(x, mode="constant",
+                      pad_width=(0, 0, 0, 0, 4, rh, 4, rw))
+            xs = F.reshape(x, (N, C, Ip, 2, Jp, 2))
+            xs = F.transpose(xs, axes=(0, 1, 3, 5, 2, 4))
+            xs = F.reshape(xs, (N, C * 4, Ip, Jp))
+            w = weight
+        # one leading zero makes kernel index u+1 = 2q + r split cleanly
+        w = F.pad(w, mode="constant", pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
+        w = F.reshape(w, (O, C, 4, 2, 4, 2))
+        w = F.transpose(w, axes=(0, 1, 3, 5, 2, 4))  # (O,C,ry,rx,qy,qx)
+        w = F.reshape(w, (O, C * 4, 4, 4))
+        if nhwc:
+            w = F.transpose(w, axes=(0, 2, 3, 1))  # (O,4,4,C*4)
+        out = F.convolution(xs, w, bias, kernel=(4, 4), stride=(1, 1),
+                            dilate=(1, 1), pad=(0, 0), num_filter=O,
+                            no_bias=bias is None, layout=self._layout)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
 def _make_norm(ax, norm_layer=None, norm_kwargs=None, **extra):
     """Instantiate a block's norm layer: BatchNorm by default; pass
     norm_layer=gluon.contrib.nn.SyncBatchNorm (+ norm_kwargs) for
@@ -182,7 +249,8 @@ class ResNetV1(HybridBlock):
     """Reference: resnet.py ResNetV1."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None,
+                 stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         assert layout in ("NCHW", "NHWC"), layout
@@ -193,8 +261,8 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False, layout=layout))
+                self.features.add(_stem_conv(channels[0], stem_s2d,
+                                             use_bias=False, layout=layout))
                 self.features.add(_make_norm(ax, norm_layer, norm_kwargs))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))
@@ -232,7 +300,8 @@ class ResNetV2(HybridBlock):
     """Reference: resnet.py ResNetV2."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", norm_layer=None, norm_kwargs=None, **kwargs):
+                 layout="NCHW", norm_layer=None, norm_kwargs=None,
+                 stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         assert layout in ("NCHW", "NHWC"), layout
@@ -245,8 +314,8 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False, layout=layout))
+                self.features.add(_stem_conv(channels[0], stem_s2d,
+                                             use_bias=False, layout=layout))
                 self.features.add(_make_norm(ax, norm_layer, norm_kwargs))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))

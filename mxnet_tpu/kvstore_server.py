@@ -1,0 +1,56 @@
+"""KVStore server entry point (reference: python/mxnet/kvstore_server.py).
+
+The reference's dist training topology has dedicated server/scheduler
+processes (ps-lite) that own the global weights; workers push gradients
+and pull weights. The TPU-native design has NO parameter servers: every
+process is a worker, global state is sharded/replicated across the mesh,
+and aggregation is an XLA all-reduce over ICI/DCN (see kvstore.py
+dist_sync and parallel/spmd.py).
+
+This module keeps launcher compatibility: scripts started with
+DMLC_ROLE=server or =scheduler (reference launchers set these on the
+extra processes) exit cleanly instead of importing mxnet and silently
+training a duplicate worker — mirroring `_init_kvstore_server_module`'s
+behavior of never returning control to the user script on non-worker
+roles.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """API-parity shim for the reference's blocking server loop."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def _controller(self):
+        def server_controller(cmd_id, cmd_body, _):
+            logging.info("kvstore server command (%s, %s) ignored: the "
+                         "TPU backend has no parameter-server role",
+                         cmd_id, cmd_body)
+
+        return server_controller
+
+    def run(self):
+        logging.info(
+            "KVStoreServer.run(): no-op — aggregation runs as XLA "
+            "collectives inside the worker step; there is no server "
+            "process to host")
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    if role in ("server", "scheduler"):
+        logging.warning(
+            "DMLC_ROLE=%s: the TPU backend needs no %s processes "
+            "(collectives replace ps-lite); exiting", role, role)
+        sys.exit(0)
+
+
+_init_kvstore_server_module()

@@ -238,6 +238,17 @@ class Module(BaseModule):
                 if isinstance(kvstore, str) else kvstore
         self.optimizer_initialized = True
 
+    # ---- monitor ---------------------------------------------------------
+    def install_monitor(self, mon):
+        """Reference: module.py install_monitor → executor-group monitor
+        callback. Accepts a Monitor (tic/toc protocol) or a bare
+        ``callback(name, NDArray)``."""
+        assert self.binded, "call bind() before install_monitor"
+        if hasattr(mon, "install_to_executor"):
+            mon.install_to_executor(self._exec)
+        else:
+            self._exec.set_monitor_callback(mon)
+
     # ---- step ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         """Reference: module.py forward."""

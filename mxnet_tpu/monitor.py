@@ -60,10 +60,28 @@ class Monitor:
         block.forward = wrapped
         self._hooks.append(("block", block, orig))
 
+    def install_to_executor(self, exe, monitor_all=False):
+        """Attach to an Executor's per-op-output taps (reference:
+        monitor.py install → executor set_monitor_callback)."""
+        mon = self
+
+        def cb(name, arr):
+            if mon.activated and mon.re_pattern.match(name):
+                mon.queue.append((mon.step, name, mon.stat_func(arr)))
+
+        # lets the executor skip the tap computation on steps where the
+        # interval gate is closed (no tic since the last toc)
+        cb.mx_monitor_active = lambda: mon.activated
+        exe.set_monitor_callback(cb, monitor_all=monitor_all)
+        self._hooks.append(("exe_cb", exe))
+        return exe
+
     def uninstall(self):
         for h in self._hooks:
             if h[0] == "block":
                 h[1].forward = h[2]
+            elif h[0] == "exe_cb":
+                h[1].set_monitor_callback(None)
         self._hooks = []
 
     def tic(self):

@@ -206,7 +206,7 @@ _CONTRIB_OPS = [
     "deformable_psroi_pooling", "mrcnn_mask_target",
     "quadratic", "allclose", "div_sqrt_dim", "gradientmultiplier",
     "round_ste", "sign_ste", "reset_arrays", "box_encode", "box_decode",
-    "rroi_align", "multi_lars",
+    "rroi_align", "multi_lars", "hawkesll",
 ]
 
 # CamelCase contrib aliases (reference registered names)

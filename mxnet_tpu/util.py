@@ -1,0 +1,129 @@
+"""General utilities (reference: python/mxnet/util.py).
+
+The numpy-semantics switches (np_shape / np_array / use_np*) share one
+state with ``mxnet_tpu.numpy_extension`` — this module adds the
+context-manager/decorator forms and the small filesystem/introspection
+helpers the reference exposes at ``mx.util``.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+from . import numpy_extension as _npx
+
+__all__ = ["makedirs", "set_np_shape", "is_np_shape", "np_shape",
+           "use_np_shape", "np_array", "is_np_array", "use_np_array",
+           "use_np", "set_np", "reset_np", "set_module", "wraps_safely",
+           "get_gpu_count", "get_gpu_memory"]
+
+
+def makedirs(d):
+    """mkdir -p (reference: util.py makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    """Number of accelerator devices (TPU chips here; reference counts
+    CUDA GPUs)."""
+    import jax
+
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """(free, total) accelerator memory in bytes, when the backend
+    exposes it (reference: cudaMemGetInfo)."""
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if gpu_dev_id >= len(devs):
+        raise ValueError(f"no accelerator device {gpu_dev_id}")
+    stats = devs[gpu_dev_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total
+
+
+# ---- numpy-semantics switches (shared state with numpy_extension) --------
+
+def set_np_shape(active):
+    """Enable/disable NumPy shape semantics (zero-dim/zero-size arrays).
+    Returns the previous state (reference: util.py set_np_shape)."""
+    prev = _npx._NP_SHAPE
+    _npx._NP_SHAPE = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _npx.is_np_shape()
+
+
+def is_np_array():
+    return _npx.is_np_array()
+
+
+class _Scope:
+    """Context manager + decorator toggling one switch (reference
+    _NumpyShapeScope/_NumpyArrayScope)."""
+
+    def __init__(self, attr, active):
+        self._attr = attr
+        self._active = bool(active)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_npx, self._attr)
+        setattr(_npx, self._attr, self._active)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_npx, self._attr, self._prev)
+        return False
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with _Scope(self._attr, self._active):
+                return func(*args, **kwargs)
+
+        return wrapper
+
+
+def np_shape(active=True):
+    """``with np_shape():`` or ``@np_shape()`` (reference util.np_shape)."""
+    return _Scope("_NP_SHAPE", active)
+
+
+def np_array(active=True):
+    return _Scope("_NP_ARRAY", active)
+
+
+use_np_shape = _npx.use_np_shape
+use_np_array = _npx.use_np_array
+use_np = _npx.use_np
+set_np = _npx.set_np
+reset_np = _npx.reset_np
+
+
+def wraps_safely(wrapped, assigned=functools.WRAPPER_ASSIGNMENTS):
+    """functools.wraps tolerating missing attributes (reference:
+    util.py wraps_safely)."""
+    present = [a for a in assigned if hasattr(wrapped, a)]
+    return functools.wraps(wrapped, assigned=present)
+
+
+def set_module(module):
+    """Decorator overriding __module__ for doc tooling (reference:
+    util.py set_module)."""
+
+    def deco(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+
+    return deco

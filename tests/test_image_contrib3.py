@@ -236,6 +236,63 @@ def test_rroi_align_out_of_bounds_zero():
     assert _np(out).sum() == 0.0
 
 
+def test_hawkesll_reference_values():
+    # oracle values from the reference's own unit test
+    # (tests/python/unittest/test_contrib_hawkesll.py)
+    N, T, K = 4, 4, 3
+    mu = nd.array(onp.tile(onp.array([1.5, 2.0, 3.0], "f"), (N, 1)))
+    alpha = nd.array(onp.array([0.2, 0.3, 0.4], "f"))
+    beta = nd.array(onp.array([1.0, 2.0, 3.0], "f"))
+    lags = nd.array(onp.array([[6, 7, 8, 9], [1, 2, 3, 4],
+                               [3, 4, 5, 6], [8, 9, 10, 11]], "f"))
+    marks = nd.array(onp.zeros((N, T), "i4"))
+    ll, st = nd.contrib.hawkesll(
+        mu, alpha, beta, nd.zeros((N, K)), lags, marks,
+        nd.array(onp.array([1, 2, 3, 4], "f")),
+        nd.array(onp.full(N, 100.0, "f")))
+    assert_almost_equal(
+        _np(ll), [-649.79453489, -649.57118596, -649.38025115,
+                  -649.17811484], rtol=1e-5, atol=1e-2)
+    assert st.shape == (N, K)
+
+
+def test_hawkesll_multivariate_and_gradient():
+    N, K = 2, 3
+    mu = nd.array(onp.tile(onp.array([1.5, 2.0, 3.0], "f"), (N, 1)))
+    alpha = nd.array(onp.array([0.2, 0.3, 0.4], "f"))
+    beta = nd.array(onp.array([2.0, 2.0, 2.0], "f"))
+    lags = nd.array(onp.array([[6, 7, 8, 9, 3, 2, 5, 1, 7],
+                               [1, 2, 3, 4, 2, 1, 2, 1, 4]], "f"))
+    marks = nd.array(onp.array([[0, 1, 2, 1, 0, 2, 1, 0, 2],
+                                [1, 2, 0, 0, 0, 2, 2, 1, 0]], "i4"))
+    vl = nd.array(onp.array([7, 9], "f"))
+    mt = nd.array(onp.full(N, 100.0, "f"))
+    ll, _ = nd.contrib.hawkesll(mu, alpha, beta, nd.zeros((N, K)), lags,
+                                marks, vl, mt)
+    assert_almost_equal(_np(ll), [-647.01240372, -646.28617272],
+                        rtol=1e-5, atol=1e-2)
+    # gradient wrt mu: finite-difference check on the summed ll
+    mu.attach_grad()
+    with autograd.record():
+        ll, _ = nd.contrib.hawkesll(mu, alpha, beta, nd.zeros((N, K)),
+                                    lags, marks, vl, mt)
+        s = nd.sum(ll)
+        s.backward()
+    g = _np(mu.grad)
+    eps = 1e-2
+    mu_np = _np(mu)
+    for (i, k) in [(0, 0), (1, 2)]:
+        up, dn = mu_np.copy(), mu_np.copy()
+        up[i, k] += eps
+        dn[i, k] -= eps
+        lu, _ = nd.contrib.hawkesll(nd.array(up), alpha, beta,
+                                    nd.zeros((N, K)), lags, marks, vl, mt)
+        ld, _ = nd.contrib.hawkesll(nd.array(dn), alpha, beta,
+                                    nd.zeros((N, K)), lags, marks, vl, mt)
+        fd = (_np(nd.sum(lu)) - _np(nd.sum(ld))) / (2 * eps)
+        assert abs(g[i, k] - fd) < 0.05 * max(1.0, abs(fd)), (i, k, g[i, k], fd)
+
+
 # ------------------------------------------------- reshape_like/softmax ---
 
 def test_reshape_like_full_and_ranges():

@@ -1,0 +1,87 @@
+"""Space-to-depth stem equivalence: the MLPerf-style TPU stem
+(`stem_s2d=True`) must be bit-equivalent to the plain 7x7/2 conv —
+same parameters, same outputs, same gradients.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon.model_zoo.vision.resnet import _S2DStemConv
+from mxnet_tpu.gluon.nn import Conv2D
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy())
+
+
+@pytest.mark.parametrize("layout,hw", [("NCHW", (32, 32)),
+                                       ("NHWC", (32, 32)),
+                                       ("NCHW", (33, 35)),  # odd sizes
+                                       ("NHWC", (33, 35))])
+def test_s2d_stem_matches_plain_conv(layout, hw):
+    rng = onp.random.RandomState(0)
+    h, w = hw
+    shape = (2, 3, h, w) if layout == "NCHW" else (2, h, w, 3)
+    x = nd.array(rng.randn(*shape).astype("f"))
+
+    plain = Conv2D(8, 7, 2, 3, use_bias=False, layout=layout)
+    plain.initialize(mx.init.Xavier())
+    with autograd.pause():
+        want = plain(x)
+    s2d = _S2DStemConv(8, use_bias=False, layout=layout)
+    s2d.initialize()
+    with autograd.pause():
+        s2d(x)  # finish deferred init
+    # identical parameter shape -> copy the plain weights over
+    s2d.weight.set_data(plain.weight.data())
+    with autograd.pause():
+        got = s2d(x)
+    assert got.shape == want.shape
+    assert_almost_equal(_np(got), _np(want), rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_stem_gradients_match():
+    rng = onp.random.RandomState(1)
+    x_np = rng.randn(2, 3, 16, 16).astype("f")
+
+    plain = Conv2D(4, 7, 2, 3, use_bias=False, layout="NCHW")
+    plain.initialize(mx.init.Xavier())
+    x1 = nd.array(x_np)
+    x1.attach_grad()
+    with autograd.record():
+        o1 = plain(x1)
+        o1.backward(nd.ones_like(o1))
+    s2d = _S2DStemConv(4, use_bias=False, layout="NCHW")
+    s2d.initialize()
+    with autograd.pause():
+        s2d(nd.array(x_np))
+    s2d.weight.set_data(plain.weight.data())
+    x2 = nd.array(x_np)
+    x2.attach_grad()
+    with autograd.record():
+        o2 = s2d(x2)
+        o2.backward(nd.ones_like(o2))
+    assert_almost_equal(_np(x2.grad), _np(x1.grad), rtol=1e-4, atol=1e-4)
+    assert_almost_equal(_np(s2d.weight.grad()), _np(plain.weight.grad()),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_stem_s2d_checkpoint_compatible(tmp_path):
+    # a checkpoint written by the plain model loads into the s2d model
+    # and produces the same logits (same param names and shapes)
+    mx.random.seed(0)
+    a = vision.resnet18_v1(classes=10)
+    a.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(2).rand(1, 3, 32, 32).astype("f"))
+    with autograd.pause():
+        ya = a(x)
+    f = str(tmp_path / "w.params")
+    a.save_parameters(f)
+    b = vision.resnet18_v1(classes=10, stem_s2d=True)
+    b.load_parameters(f)
+    with autograd.pause():
+        yb = b(x)
+    assert_almost_equal(_np(yb), _np(ya), rtol=1e-3, atol=1e-3)
