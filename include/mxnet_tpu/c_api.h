@@ -173,6 +173,22 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size,
                   NDArrayHandle** out_arr, uint32_t* out_name_size,
                   const char*** out_names);
 
+/* Data iterators (reference: c_api.cc MXDataIter* over src/io/ iters).
+ * Params are string key/value pairs; tuple values use Python literal
+ * syntax, e.g. data_shape=(3,224,224). GetData/GetLabel return NEW
+ * NDArray handles owned by the caller (MXNDArrayFree). */
+typedef void* DataIterHandle;
+int MXListDataIters(uint32_t* out_size, const char*** out_names);
+int MXDataIterCreateIter(const char* name, uint32_t num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle it);
+int MXDataIterNext(DataIterHandle it, int* out);
+int MXDataIterBeforeFirst(DataIterHandle it);
+int MXDataIterGetData(DataIterHandle it, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle it, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle it, int* out);
+
 #ifdef __cplusplus
 }
 #endif

@@ -426,3 +426,76 @@ def nd_load(fname):
     if isinstance(loaded, dict):
         return list(loaded.keys()), list(loaded.values())
     return [], list(loaded)
+
+
+# ---- data iterators (reference: c_api.cc MXDataIterCreateIter family,
+# src/io/iter_*.cc registrations) ---------------------------------------
+
+_DATA_ITERS = ("MNISTIter", "ImageRecordIter", "CSVIter", "LibSVMIter")
+
+
+def io_list():
+    return list(_DATA_ITERS)
+
+
+def _parse_io_param(v):
+    """Iterator params arrive as strings over the C ABI; tuples/ints/
+    floats/bools use Python literal syntax (the reference parses dmlc
+    Parameter strings the same way)."""
+    import ast
+
+    if v in ("True", "true"):
+        return True
+    if v in ("False", "false"):
+        return False
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def io_create(name, keys, vals):
+    from . import io as mxio
+
+    if name not in _DATA_ITERS:
+        raise MXNetError(
+            f"unknown data iter '{name}'; available: {_DATA_ITERS}")
+    kwargs = {k: _parse_io_param(v) for k, v in zip(keys, vals)}
+    it = getattr(mxio, name)(**kwargs)
+    it._c_batch = None
+    return it
+
+
+def io_next(it):
+    try:
+        it._c_batch = next(it)
+        return 1
+    except StopIteration:
+        it._c_batch = None
+        return 0
+
+
+def io_before_first(it):
+    it.reset()
+    it._c_batch = None
+
+
+def _io_cur(it):
+    if it._c_batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return it._c_batch
+
+
+def io_data(it):
+    return _io_cur(it).data[0]
+
+
+def io_label(it):
+    lab = _io_cur(it).label
+    if not lab:
+        raise MXNetError("iterator has no label array")
+    return lab[0]
+
+
+def io_pad(it):
+    return int(getattr(_io_cur(it), "pad", 0) or 0)

@@ -48,7 +48,10 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     """Configured logger (idempotent per name); file handlers are
     uncolored (reference: log.py get_logger)."""
     logger = logging.getLogger(name)
-    if getattr(logger, "_mx_init_done", False):
+    # name=None is the ROOT logger: return it untouched (the reference
+    # guards the same way) — attaching a handler there would reformat
+    # every library's propagated records
+    if name is None or getattr(logger, "_mx_init_done", False):
         return logger
     logger._mx_init_done = True
     if filename:

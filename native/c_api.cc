@@ -924,3 +924,92 @@ MXTPU_API int MXNDArrayLoad(const char* fname, uint32_t* out_size,
   *out_arr = ret.data();
   return 0;
 }
+
+// ---- data iterators (reference: c_api.cc MXDataIter* family) -----------
+
+MXTPU_API int MXListDataIters(uint32_t* out_size, const char*** out_names) {
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = bridge_call("io_list", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  int rc = list_to_names(r, out_size, out_names);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXDataIterCreateIter(const char* name, uint32_t num_param,
+                                   const char** keys, const char** vals,
+                                   void** out) {
+  Gil gil;
+  PyObject* k = PyList_New(num_param);
+  PyObject* v = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNN)", name, k, v);
+  PyObject* r = bridge_call("io_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXDataIterFree(void* it) {
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(it));
+  return 0;
+}
+
+MXTPU_API int MXDataIterNext(void* it, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(it));
+  PyObject* r = bridge_call("io_next", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDataIterBeforeFirst(void* it) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(it));
+  PyObject* r = bridge_call("io_before_first", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int io_get(void* it, const char* fn, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(it));
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // new NDArray handle owned by the caller
+  return 0;
+}
+}  // namespace
+
+MXTPU_API int MXDataIterGetData(void* it, void** out) {
+  return io_get(it, "io_data", out);
+}
+
+MXTPU_API int MXDataIterGetLabel(void* it, void** out) {
+  return io_get(it, "io_label", out);
+}
+
+MXTPU_API int MXDataIterGetPadNum(void* it, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(it));
+  PyObject* r = bridge_call("io_pad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}

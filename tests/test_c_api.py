@@ -767,3 +767,77 @@ def test_c_ndarray_save_duplicate_keys(capi, tmp_path):
     onp.testing.assert_allclose(back, [2.0, 2.0])
     for a in arrs:
         capi.MXNDArrayFree(a)
+
+
+def test_data_iter_c_abi(capi, tmp_path):
+    """MXListDataIters + CSVIter through the C handle API (reference:
+    c_api.cc MXDataIterCreateIter family)."""
+    vp, c_int, u32 = ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32
+    lib = capi
+    lib.MXListDataIters.argtypes = [
+        ctypes.POINTER(u32), ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    lib.MXDataIterCreateIter.argtypes = [
+        ctypes.c_char_p, u32, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(vp)]
+    lib.MXDataIterFree.argtypes = [vp]
+    lib.MXDataIterNext.argtypes = [vp, ctypes.POINTER(c_int)]
+    lib.MXDataIterBeforeFirst.argtypes = [vp]
+    lib.MXDataIterGetData.argtypes = [vp, ctypes.POINTER(vp)]
+    lib.MXDataIterGetLabel.argtypes = [vp, ctypes.POINTER(vp)]
+    lib.MXDataIterGetPadNum.argtypes = [vp, ctypes.POINTER(c_int)]
+
+    n = u32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(names)) == 0
+    listed = [names[i].decode() for i in range(n.value)]
+    assert "CSVIter" in listed and "ImageRecordIter" in listed
+
+    data = onp.arange(24, dtype="f").reshape(8, 3)
+    labels = onp.arange(8, dtype="f")
+    dcsv = tmp_path / "d.csv"
+    lcsv = tmp_path / "l.csv"
+    dcsv.write_text("\n".join(",".join(str(v) for v in row)
+                              for row in data) + "\n")
+    lcsv.write_text("\n".join(str(v) for v in labels) + "\n")
+
+    keys = (ctypes.c_char_p * 4)(b"data_csv", b"data_shape",
+                                 b"label_csv", b"batch_size")
+    vals = (ctypes.c_char_p * 4)(str(dcsv).encode(), b"(3,)",
+                                 str(lcsv).encode(), b"4")
+    it = vp()
+    rc = lib.MXDataIterCreateIter(b"CSVIter", 4, keys, vals,
+                                  ctypes.byref(it))
+    assert rc == 0, _err(lib)
+
+    seen_rows = []
+    for _epoch in range(2):  # BeforeFirst resets for a second epoch
+        while True:
+            has = c_int()
+            assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+            if not has.value:
+                break
+            d = vp()
+            assert lib.MXDataIterGetData(it, ctypes.byref(d)) == 0, _err(lib)
+            ndim = c_int()
+            shape = (i64 * 8)()
+            assert lib.MXNDArrayGetShape(d, ctypes.byref(ndim), shape) == 0
+            dims = tuple(shape[i] for i in range(ndim.value))
+            assert dims == (4, 3)
+            buf = (ctypes.c_float * 12)()
+            assert lib.MXNDArraySyncCopyToCPU(
+                d, ctypes.cast(buf, vp), ctypes.sizeof(buf)) == 0
+            seen_rows.append(onp.array(buf).reshape(4, 3).copy())
+            lab = vp()
+            assert lib.MXDataIterGetLabel(it, ctypes.byref(lab)) == 0, \
+                _err(lib)
+            pad = c_int()
+            assert lib.MXDataIterGetPadNum(it, ctypes.byref(pad)) == 0
+            assert pad.value == 0
+            lib.MXNDArrayFree(d)
+            lib.MXNDArrayFree(lab)
+        assert lib.MXDataIterBeforeFirst(it) == 0
+    got = onp.concatenate(seen_rows)
+    assert got.shape == (16, 3)
+    onp.testing.assert_allclose(got[:8], data, rtol=1e-6)
+    onp.testing.assert_allclose(got[8:], data, rtol=1e-6)  # epoch 2
+    lib.MXDataIterFree(it)

@@ -69,6 +69,26 @@ def test_s2d_stem_gradients_match():
                         rtol=1e-4, atol=1e-4)
 
 
+def test_s2d_stem_exports_via_sym_trace(tmp_path):
+    # F=sym has no static shapes; the stem must fall back to the plain
+    # 7x7/2 form so export/SymbolBlock keep working
+    mx.random.seed(1)
+    net = vision.resnet18_v1(classes=4, stem_s2d=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(3).rand(1, 3, 32, 32).astype("f"))
+    with autograd.pause():
+        y = net(x)
+    net.export(str(tmp_path / "m"))
+    from mxnet_tpu.gluon import SymbolBlock
+
+    sb = SymbolBlock.imports(str(tmp_path / "m-symbol.json"), ["data"],
+                             str(tmp_path / "m-0000.params"))
+    with autograd.pause():
+        y2 = sb(x)
+    assert_almost_equal(_np(y2), _np(y), rtol=1e-3, atol=1e-3)
+
+
 def test_resnet_stem_s2d_checkpoint_compatible(tmp_path):
     # a checkpoint written by the plain model loads into the s2d model
     # and produces the same logits (same param names and shapes)
