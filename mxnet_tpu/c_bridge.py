@@ -439,19 +439,11 @@ def io_list():
 
 
 def _parse_io_param(v):
-    """Iterator params arrive as strings over the C ABI; tuples/ints/
-    floats/bools use Python literal syntax (the reference parses dmlc
-    Parameter strings the same way)."""
-    import ast
-
-    if v in ("True", "true"):
-        return True
-    if v in ("False", "false"):
-        return False
-    try:
-        return ast.literal_eval(v)
-    except (ValueError, SyntaxError):
-        return v
+    """Iterator params: same literal parsing as _parse_param, plus the
+    dmlc-style lowercase booleans the reference's iter params accept."""
+    if v in ("true", "false"):
+        return v == "true"
+    return _parse_param(v)
 
 
 def io_create(name, keys, vals):

@@ -1,4 +1,6 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/__init__.py)."""
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
+from . import cnn  # noqa: F401
+from . import data  # noqa: F401
 from . import estimator  # noqa: F401

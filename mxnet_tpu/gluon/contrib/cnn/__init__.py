@@ -1,0 +1,2 @@
+"""Contrib CNN layers (reference: python/mxnet/gluon/contrib/cnn)."""
+from .conv_layers import DeformableConvolution  # noqa: F401

@@ -1,0 +1,87 @@
+"""Deformable convolution Gluon layer (reference:
+python/mxnet/gluon/contrib/cnn/conv_layers.py DeformableConvolution).
+
+One layer owning BOTH convolutions of Deformable ConvNets v1: a regular
+conv producing the per-tap (dy, dx) offsets (zero-initialized so
+training starts at the regular grid) and the deformable conv consuming
+them (ops_contrib2.deformable_convolution — bilinear gathers on the
+MXU-fed im2col).
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn.basic_layers import Activation
+
+__all__ = ["DeformableConvolution"]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class DeformableConvolution(HybridBlock):
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout == "NCHW", \
+            "deformable_convolution runs NCHW (reference kernel layout)"
+        kernel_size = _pair(kernel_size)
+        self._channels = channels
+        self._kwargs_offset = {
+            "kernel": kernel_size, "stride": _pair(strides),
+            "dilate": _pair(dilation), "pad": _pair(padding),
+            "num_filter": 2 * kernel_size[0] * kernel_size[1]
+            * num_deformable_group,
+            "num_group": groups, "no_bias": not offset_use_bias,
+            "layout": layout}
+        self._kwargs_conv = {
+            "kernel": kernel_size, "stride": _pair(strides),
+            "dilate": _pair(dilation), "pad": _pair(padding),
+            "num_filter": channels, "num_group": groups,
+            "num_deformable_group": num_deformable_group,
+            "no_bias": not use_bias, "layout": layout}
+        ic = in_channels // groups if in_channels else 0
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, ic) + kernel_size,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer) \
+                if use_bias else None
+            self.offset_weight = self.params.get(
+                "offset_weight",
+                shape=(self._kwargs_offset["num_filter"], ic) + kernel_size,
+                init=offset_weight_initializer, allow_deferred_init=True)
+            self.offset_bias = self.params.get(
+                "offset_bias", shape=(self._kwargs_offset["num_filter"],),
+                init=offset_bias_initializer) if offset_use_bias else None
+            self.act = Activation(activation) if activation else None
+
+    def infer_param_shapes(self, x, *args):
+        groups = self._kwargs_conv["num_group"]
+        ic = x.shape[1] // groups
+        k = self._kwargs_conv["kernel"]
+        self.weight.shape = (self._channels, ic) + k
+        self.offset_weight.shape = (
+            self._kwargs_offset["num_filter"], ic) + k
+
+    def hybrid_forward(self, F, x, weight, offset_weight, bias=None,
+                       offset_bias=None):
+        offset = F.convolution(x, offset_weight, offset_bias,
+                               no_bias=offset_bias is None,
+                               **{k: v for k, v in
+                                  self._kwargs_offset.items()
+                                  if k != "no_bias"})
+        out = F.contrib.deformable_convolution(
+            x, offset, weight, bias,
+            **{k: v for k, v in self._kwargs_conv.items()
+               if k != "no_bias"}, no_bias=bias is None)
+        if self.act is not None:
+            out = self.act(out)
+        return out

@@ -1,0 +1,3 @@
+"""Contrib datasets/samplers (reference: python/mxnet/gluon/contrib/data)."""
+from .sampler import IntervalSampler  # noqa: F401
+from . import text  # noqa: F401

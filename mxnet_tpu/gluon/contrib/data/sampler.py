@@ -1,0 +1,28 @@
+"""Contrib samplers (reference: gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data import sampler as _sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(_sampler.Sampler):
+    """Sample [0, length) at fixed `interval` strides; with rollover the
+    skipped phases follow (0, k, 2k, ..., 1, k+1, ...)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError(
+                f"interval {interval} must be <= length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for start in range(self._interval if self._rollover else 1):
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return (self._length + self._interval - 1) // self._interval

@@ -1,0 +1,87 @@
+"""Language-model datasets (reference: gluon/contrib/data/text.py
+WikiText2/WikiText103).
+
+The reference downloads the corpus zips from the MXNet S3 bucket; TPU
+training hosts are commonly egress-free, so these classes read an
+already-present token file under `root` (same file names the reference
+unpacks: wiki.{train,valid,test}.tokens) and raise a clear error naming
+the expected path when it is absent. Parsing semantics match the
+reference: whitespace tokens per non-empty line, <eos> appended, stream
+flattened, (data, label) = (w[:-1], w[1:]) reshaped to seq_len rows.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from ...data import dataset as _dataset
+from ....contrib.text.vocab import Vocabulary
+from ....contrib.text.utils import count_tokens_from_str
+
+EOS_TOKEN = "<eos>"
+
+__all__ = ["WikiText2", "WikiText103"]
+
+
+class _WikiText(_dataset.Dataset):
+    _files = {"train": "wiki.train.tokens", "validation": "wiki.valid.tokens",
+              "test": "wiki.test.tokens"}
+
+    def __init__(self, root, segment="train", vocab=None, seq_len=35):
+        if segment not in self._files:
+            raise ValueError(f"segment must be one of {list(self._files)}")
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self.vocabulary = vocab
+        self._get_data()
+
+    @property
+    def frequencies(self):
+        return self._frequencies
+
+    def _get_data(self):
+        path = os.path.join(self._root, self._files[self._segment])
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path} not found. This environment has no dataset "
+                f"egress: place the extracted WikiText token file there "
+                f"(the reference unpacks the same name from "
+                f"{type(self).__name__.lower()}-v1.zip)")
+        with open(path, encoding="utf8") as fin:
+            content = fin.read()
+        self._frequencies = count_tokens_from_str(content)
+        if self.vocabulary is None:
+            self.vocabulary = Vocabulary(
+                self._frequencies, reserved_tokens=[EOS_TOKEN])
+        lines = [ln.strip().split() for ln in content.splitlines()]
+        stream = []
+        for line in lines:
+            if line:
+                stream.extend(line)
+                stream.append(EOS_TOKEN)
+        idx = self.vocabulary.to_indices(stream)
+        data = onp.asarray(idx[:-1], dtype=onp.int32)
+        label = onp.asarray(idx[1:], dtype=onp.int32)
+        n = (len(data) // self._seq_len) * self._seq_len
+        from .... import nd
+
+        self._data = nd.array(
+            data[:n].reshape(-1, self._seq_len), dtype="int32")
+        self._label = nd.array(
+            label[:n].reshape(-1, self._seq_len), dtype="int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference: contrib/data/text.py WikiText2)."""
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference: contrib/data/text.py WikiText103)."""

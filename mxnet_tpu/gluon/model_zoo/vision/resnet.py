@@ -55,7 +55,9 @@ class _S2DStemConv(Conv2D):
         super().__init__(channels, 7, 2, 3, layout=layout, **kwargs)
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        if not hasattr(x, "shape"):
+        from .... import symbol as _sym
+
+        if isinstance(x, _sym.Symbol):
             # F=sym trace (export/ONNX): symbols carry no static shape for
             # the packing reshapes — emit the equivalent plain 7x7/2 conv
             return super().hybrid_forward(F, x, weight, bias)

@@ -555,15 +555,38 @@ def _populate():
 
 _populate()
 
-# `mx.sym.linalg` namespace (reference: python/mxnet/symbol/linalg.py)
+# `mx.sym.linalg` / `mx.sym.image` namespaces (reference:
+# python/mxnet/symbol/{linalg,image}.py — prefix-stripped autogen)
 import types as _types  # noqa: E402
 
-linalg = _types.ModuleType(__name__ + ".linalg")
-for _lname in _registry.list_ops():
-    if _lname.startswith("linalg_"):
-        setattr(linalg, _lname[len("linalg_"):],
-                _sym_wrapper(_registry.get_op(_lname)))
-_sys.modules[linalg.__name__] = linalg
+
+def _sym_prefix_namespace(short):
+    mod = _types.ModuleType(__name__ + "." + short)
+    pre = short + "_"
+    for name in _registry.list_ops():
+        if name.startswith(pre):
+            setattr(mod, name[len(pre):],
+                    _sym_wrapper(_registry.get_op(name)))
+    _sys.modules[mod.__name__] = mod
+    return mod
+
+
+linalg = _sym_prefix_namespace("linalg")
+image = _sym_prefix_namespace("image")
+
+# `mx.sym.contrib` namespace (reference: python/mxnet/symbol/contrib.py):
+# same op set as nd.contrib, emitting graph nodes
+contrib = _types.ModuleType(__name__ + ".contrib")
+from ..ndarray.contrib import _CONTRIB_OPS, _CONTRIB_ALIASES  # noqa: E402
+
+for _cname in _CONTRIB_OPS:
+    _cdef = _registry.get_op(_cname) or _registry.get_op(_cname.lower())
+    if _cdef is None:  # fail-fast like nd.contrib._install
+        raise RuntimeError(f"contrib op '{_cname}' listed but unregistered")
+    setattr(contrib, _cname, _sym_wrapper(_cdef))
+for _alias, _target in _CONTRIB_ALIASES.items():
+    setattr(contrib, _alias, getattr(contrib, _target))
+_sys.modules[contrib.__name__] = contrib
 
 
 def zeros(shape, dtype="float32", **kwargs):

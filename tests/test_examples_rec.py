@@ -1,0 +1,19 @@
+"""The north-star configuration as a test: .rec -> native JPEG decode ->
+ImageRecordIter augment -> SPMDTrainer compiled step (reference:
+example/image-classification/train_imagenet.py)."""
+import os
+import subprocess
+import sys
+
+
+def test_train_imagenet_rec_example_runs():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "examples",
+                      "train_imagenet_rec.py"),
+         "--images", "64", "--batch", "8", "--image-size", "32",
+         "--depth", "18", "--steps", "3", "--threads", "2"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "pipeline" in out.stdout and "img/s" in out.stdout, out.stdout

@@ -1,0 +1,104 @@
+"""gluon.contrib.cnn.DeformableConvolution, contrib.data
+(IntervalSampler, WikiText), and the sym.contrib/sym.image namespaces
+(reference: gluon/contrib/{cnn,data}, python/mxnet/symbol/contrib.py).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy())
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    from mxnet_tpu.gluon.nn import Conv2D
+
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(2, 3, 8, 8).astype("f"))
+    d = DeformableConvolution(4, kernel_size=3, padding=1, use_bias=False)
+    d.initialize(mx.init.Xavier())
+    with autograd.pause():
+        out = d(x)
+    assert out.shape == (2, 4, 8, 8)
+    # offset conv is zero-initialized -> exactly a regular convolution
+    c = Conv2D(4, 3, 1, 1, use_bias=False, in_channels=3)
+    c.initialize()
+    c.weight.set_data(d.weight.data())
+    with autograd.pause():
+        want = c(x)
+    assert_almost_equal(_np(out), _np(want), rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_convolution_trains():
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    from mxnet_tpu import gluon
+
+    rng = onp.random.RandomState(1)
+    net = DeformableConvolution(2, kernel_size=3, padding=1,
+                                activation="relu")
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    x = nd.array(rng.rand(1, 2, 6, 6).astype("f"))
+    with autograd.record():
+        loss = nd.sum(net(x) ** 2)
+        loss.backward()
+    tr.step(1)
+    # offsets receive gradient (the deformable path is differentiable
+    # through the bilinear sampling)
+    assert net.offset_weight.grad() is not None
+    assert onp.isfinite(_np(net.offset_weight.grad())).all()
+
+
+def test_interval_sampler_matches_reference_doc():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    assert list(IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, interval=3, rollover=False)) == \
+        [0, 3, 6, 9, 12]
+    assert len(IntervalSampler(13, 3)) == 13
+    with pytest.raises(ValueError):
+        IntervalSampler(2, 5)
+
+
+def test_wikitext_local_file(tmp_path):
+    from mxnet_tpu.gluon.contrib.data.text import WikiText2
+
+    corpus = (" = Heading = \n\n the cat sat on the mat \n"
+              " the dog sat too \n")
+    (tmp_path / "wiki.train.tokens").write_text(corpus)
+    ds = WikiText2(root=str(tmp_path), segment="train", seq_len=5)
+    assert len(ds) >= 1
+    d, l = ds[0]
+    assert d.shape == (5,) and l.shape == (5,)
+    # label stream is the data stream shifted by one
+    assert _np(d)[1:].tolist() == _np(l)[:-1].tolist()
+    # eos terminates every non-empty line
+    eos = ds.vocabulary.token_to_idx["<eos>"]
+    flat = _np(ds._data).ravel().tolist()
+    assert eos in flat
+    # missing file raises with the expected path named
+    with pytest.raises(FileNotFoundError, match="wiki.valid.tokens"):
+        WikiText2(root=str(tmp_path), segment="validation")
+
+
+def test_sym_contrib_and_image_namespaces():
+    from mxnet_tpu import sym
+
+    x = sym.Variable("x")
+    node = sym.contrib.quadratic(x, a=1.0, b=1.0, c=1.0)
+    ex = node.bind(args={"x": nd.array(onp.array([2.0], "f"))})
+    out = ex.forward()[0]
+    assert float(_np(out)[0]) == 7.0
+    img = sym.Variable("img")
+    flip = sym.image.flip_left_right(img)
+    x_img = nd.array(onp.arange(6, dtype="f").reshape(1, 3, 2, 1))
+    got = flip.bind(args={"img": x_img}).forward()[0]
+    assert_almost_equal(_np(got), _np(x_img)[:, :, ::-1], rtol=0, atol=0)
+    assert hasattr(sym.contrib, "ROIAlign")  # CamelCase aliases ride along
