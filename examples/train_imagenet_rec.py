@@ -124,8 +124,13 @@ def main():
         it.reset()
         if args.steps and step >= args.steps + 1:
             break
+    if loss is None or t0 is None:
+        raise SystemExit(
+            f"no full batch of {args.batch} was produced — the dataset "
+            f"has fewer than 2x batch_size usable images; lower --batch "
+            f"or raise --images")
     loss.wait_to_read()
-    dt = time.perf_counter() - t0 if t0 else float("nan")
+    dt = time.perf_counter() - t0
     print(f"steps={step} loss={float(loss.asscalar()):.4f} "
           f"pipeline {imgs / dt:.1f} img/s (decode+augment+train)")
 

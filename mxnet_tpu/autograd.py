@@ -33,14 +33,25 @@ __all__ = [
 
 
 class _TapeNode:
-    """One recorded op: a vjp closure linking input/output NDArrays."""
+    """One recorded op: a vjp closure linking input/output NDArrays.
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "n_arrays")
+    ``fun`` keeps the primal pure function (jnp in → jnp out) when the
+    dispatch layer has one — higher-order grad re-derives the vjp from
+    it as a NEW taped op (jax.vjp of jax.vjp); opaque custom backwards
+    (Function) leave it None and stop at first order, like the
+    reference's CustomFunction."""
 
-    def __init__(self, vjp_fn, inputs, outputs):
+    __slots__ = ("vjp_fn", "inputs", "outputs", "fun", "primals")
+
+    def __init__(self, vjp_fn, inputs, outputs, fun=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[NDArray] (array inputs only)
         self.outputs = outputs  # list[NDArray]
+        self.fun = fun
+        # record-time input buffers: lets the create_graph walk detect
+        # in-place rebinding (out= aliasing) where recomputing from the
+        # CURRENT .data would silently use post-mutation values
+        self.primals = tuple(a._data for a in self.inputs)
 
 
 class _AutogradState(threading.local):
@@ -116,9 +127,10 @@ def predict_mode():
     return _scope(training=False)
 
 
-def _record_op(vjp_fn, array_inputs, outputs):
+def _record_op(vjp_fn, array_inputs, outputs, fun=None):
     """Append a tape node (called by the op-dispatch layer)."""
-    _STATE.tape.append(_TapeNode(vjp_fn, list(array_inputs), list(outputs)))
+    _STATE.tape.append(
+        _TapeNode(vjp_fn, list(array_inputs), list(outputs), fun))
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -208,31 +220,118 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         _STATE.tape = []
 
 
+def _backward_recorded(heads, head_grads, train_mode):
+    """Backward pass whose gradient computations are THEMSELVES recorded
+    as taped ops: every vjp application is re-derived from the node's
+    primal function and dispatched through apply_pure, so the returned
+    gradients carry tape provenance and can be differentiated again
+    (arbitrary order — jax.vjp of jax.vjp). Returns {id: NDArray}."""
+    from . import ndarray as nd
+    from .ndarray import NDArray
+    from .ndarray.registry import apply_pure
+
+    grads = {}
+    for i, h in enumerate(heads):
+        hg = None if head_grads is None else head_grads[i]
+        if hg is None:
+            hg = nd.ones(h.shape, dtype=h.dtype)
+        elif not isinstance(hg, NDArray):
+            hg = nd.array(hg)
+        grads[id(h)] = hg if id(h) not in grads else grads[id(h)] + hg
+
+    snapshot = list(_STATE.tape)  # the walk appends grad-op nodes
+    with _scope(recording=True, training=train_mode):
+        for node in reversed(snapshot):
+            cots, any_grad = [], False
+            for o in node.outputs:
+                g = grads.get(id(o))
+                if g is None:
+                    cots.append(nd.zeros(o.shape, dtype=o.dtype))
+                else:
+                    any_grad = True
+                    cots.append(g)
+            if not any_grad:
+                continue
+            n_in = len(node.inputs)
+            single_out = len(node.outputs) == 1
+            fresh = all(inp._data is pr for inp, pr in
+                        zip(node.inputs, node.primals))
+            if node.fun is not None and fresh:
+                def grad_op(*xs, _fun=node.fun, _n=n_in,
+                            _single=single_out):
+                    primals, cts = xs[:_n], xs[_n:]
+                    _, vjp = jax.vjp(_fun, *primals)
+                    gs = vjp(cts[0] if _single else tuple(cts))
+                    return tuple(gs) if len(gs) > 1 else gs[0]
+
+                in_grads = apply_pure(grad_op, list(node.inputs) + cots)
+            else:
+                # opaque custom backward (Function) or an input rebound
+                # in place since record time (out= aliasing): use the
+                # record-time vjp — exact values, but the graph stops
+                # here, so higher orders through this node are zero
+                import warnings
+
+                warnings.warn(
+                    "create_graph=True: gradient graph truncated at a "
+                    + ("custom Function backward" if node.fun is None
+                       else "node whose input was rebound in place "
+                            "(out= aliasing)")
+                    + "; higher-order terms through it are dropped",
+                    stacklevel=2)
+                raw = node.vjp_fn(cots[0].data if single_out
+                                  else tuple(c.data for c in cots))
+                in_grads = [None if g is None else NDArray(jnp.asarray(g))
+                            for g in raw]
+            if not isinstance(in_grads, (list, tuple)):
+                in_grads = [in_grads]
+            for inp, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                prev = grads.get(id(inp))
+                grads[id(inp)] = g if prev is None else prev + g
+    return grads
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Functional gradient: returns grads of heads w.r.t. variables.
 
-    Reference: python/mxnet/autograd.py:273. ``create_graph`` (higher-order
-    grad) is supported by recomputing with ``jax.grad`` composition.
+    Reference: python/mxnet/autograd.py:273. With ``create_graph=True``
+    the returned arrays are themselves on the tape (each vjp application
+    is re-recorded as a differentiable op), so ``backward()`` on them —
+    or another ``grad()`` — yields higher-order derivatives.
     """
-    from .ndarray import NDArray, array
+    from .ndarray import NDArray
 
     if isinstance(variables, NDArray):
         variables = [variables]
         single = True
     else:
         single = False
-    # temporarily attach fresh grad buffers (restore marks AND grad_req)
+    from . import ndarray as nd
+
+    if retain_graph is None:
+        retain_graph = create_graph
+    if isinstance(heads, NDArray):
+        heads_list = [heads]
+        if head_grads is not None and not isinstance(head_grads,
+                                                     (list, tuple)):
+            head_grads = [head_grads]
+    else:
+        heads_list = list(heads)
+    if create_graph:
+        grads = _backward_recorded(heads_list, head_grads, train_mode)
+        bufs = [grads[id(v)] if id(v) in grads
+                else nd.zeros(v.shape, dtype=v.dtype) for v in variables]
+        return bufs[0] if single else bufs
+    # first-order: accumulate into fresh buffers via the plain walk
     saved = [(v._grad if hasattr(v, "_grad") else None,
               getattr(v, "_ag_marked", False),
               getattr(v, "_grad_req", "null")) for v in variables]
-    from . import ndarray as nd
-
     bufs = [nd.zeros(v.shape, dtype=v.dtype) for v in variables]
     mark_variables(variables, bufs)
     backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
-    if retain_graph is None:
-        retain_graph = create_graph
     if not retain_graph:
         _STATE.tape = []
     for v, (g, m, req) in zip(variables, saved):

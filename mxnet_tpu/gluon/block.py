@@ -358,7 +358,16 @@ class CachedOp:
                 pv_grads, iv_grads = _vjp(cots)
                 return list(pv_grads) + list(iv_grads)
 
-            autograd._record_op(tape_vjp, pnds + list(args), outs)
+            def tape_fun(*xs, _npv=len(pnds), _ver=_amp_ver,
+                         _train=train, _key=key, _self=self):
+                # primal for higher-order grads: replay the cached jit
+                # (same RNG key -> same dropout mask as the recording)
+                pv, iv = list(xs[:_npv]), list(xs[_npv:])
+                out_d, _mut = _self._jitted(_ver, _train, pv, _key, iv)
+                return tuple(out_d) if len(out_d) > 1 else out_d[0]
+
+            autograd._record_op(tape_vjp, pnds + list(args), outs,
+                                fun=tape_fun)
         else:
             out_datas, mutated = self._jitted(_amp_ver, train, param_vals,
                                               key, input_datas)

@@ -187,7 +187,8 @@ class NDArray:
         from .. import autograd
 
         if autograd.is_recording():
-            autograd._record_op(lambda g: (g,), [self], [out])
+            autograd._record_op(lambda g: (g,), [self], [out],
+                                fun=lambda x: x)
         return out
 
     def as_np_ndarray(self):
@@ -571,6 +572,75 @@ def moveaxis(data, source, destination):
 
 def concatenate(arrays, axis=0):
     return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis))
+
+
+def to_dlpack_for_read(data):
+    """DLPack capsule over the array's buffer (reference:
+    python/mxnet/ndarray/ndarray.py to_dlpack_for_read over
+    MXNDArrayToDLPack). Waits for pending writes first — JAX's dispatch
+    is this build's dependency engine."""
+    data.wait_to_read()
+    return data.data.__dlpack__()
+
+
+def to_dlpack_for_write(data):
+    """Reference: to_dlpack_for_write. XLA buffers are immutable, so the
+    write capsule wraps a fresh COPY — the consumer mutates that copy
+    freely without corrupting the (aliasing-assuming) source buffer.
+    Read the result back with from_dlpack."""
+    import jax.numpy as jnp
+
+    data.wait_to_read()
+    return jnp.array(data.data, copy=True).__dlpack__()
+
+
+class _CapsuleShim:
+    """Adapter: jax.dlpack.from_dlpack consumes protocol OBJECTS, while
+    the reference API (and torch.utils.dlpack.to_dlpack) hands around
+    raw PyCapsules. The capsule itself doesn't carry a queryable device,
+    so raw capsules are assumed host-resident — exactly where capsule
+    interop (numpy/torch-cpu) happens; device arrays arrive as protocol
+    objects and skip this shim."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(obj):
+    """NDArray over an external DLPack tensor (reference: from_dlpack
+    over MXNDArrayFromDLPack). Accepts protocol objects (torch/cupy/
+    numpy arrays) or raw capsules."""
+    import jax
+
+    if not hasattr(obj, "__dlpack__"):
+        obj = _CapsuleShim(obj)
+    return NDArray(jax.dlpack.from_dlpack(obj))
+
+
+def from_numpy(ndarray, zero_copy=True):
+    """Reference: from_numpy — zero-copy CPU bridge when possible; the
+    source is marked non-writeable first (as the reference does) so
+    host-side mutation can't corrupt the shared XLA buffer."""
+    import numpy as onp
+
+    arr = onp.ascontiguousarray(ndarray)
+    if zero_copy:
+        if arr is ndarray:  # caller still holds this buffer: lock it
+            try:
+                arr.flags.writeable = False
+            except ValueError:
+                return array(arr)  # can't lock it: don't share it
+        try:
+            return from_dlpack(arr)
+        except (TypeError, RuntimeError, BufferError):
+            pass  # not dlpack-compatible: plain copy below
+    return array(arr)
 
 
 def waitall():

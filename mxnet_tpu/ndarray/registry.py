@@ -209,10 +209,11 @@ def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
             # the tape must reference `out` itself so downstream grads
             # keyed by id(out) flow back through this node
             out._data = jnp.asarray(result, out._data.dtype)
-            autograd._record_op(vjp_fn, list(arr_args), [out])
+            autograd._record_op(vjp_fn, list(arr_args), [out],
+                                fun=normalized)
             return out
         outs = [_wrap(r) for r in (result if multi else (result,))]
-        autograd._record_op(vjp_fn, list(arr_args), outs)
+        autograd._record_op(vjp_fn, list(arr_args), outs, fun=normalized)
         return outs if multi else outs[0]
 
     result = pure_fn(*datas)

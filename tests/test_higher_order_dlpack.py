@@ -1,0 +1,192 @@
+"""Higher-order autograd (reference: tests/python/unittest/
+test_higher_order_grad.py) and DLPack interop (test_dlpack in
+test_ndarray.py) — the torch-CPU bridge is the external consumer.
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy())
+
+
+def test_second_order_polynomial():
+    x = nd.array(onp.array([2.0, -1.0, 0.5], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g = autograd.grad(y, x, create_graph=True)  # 3x^2
+        g.backward(nd.ones_like(g))
+    assert_almost_equal(_np(x.grad), 6 * _np(x), rtol=1e-5, atol=1e-6)
+
+
+def test_third_order_via_nested_grad():
+    x = nd.array(onp.array([1.5], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad(y, x, create_graph=True)   # 4x^3
+        g2 = autograd.grad(g1, x, create_graph=True)  # 12x^2
+        g2.backward()
+    assert_almost_equal(_np(x.grad), [24 * 1.5], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,d2", [
+    ("sigmoid", lambda v: (lambda s: s * (1 - s) * (1 - 2 * s))(
+        1 / (1 + math.exp(-v)))),
+    ("tanh", lambda v: -2 * math.tanh(v) * (1 - math.tanh(v) ** 2)),
+    ("log", lambda v: -1.0 / v ** 2),
+    ("exp", lambda v: math.exp(v)),
+])
+def test_second_order_unary_ops(op, d2):
+    # reference test_higher_order_grad runs exactly this family
+    v = 0.7
+    x = nd.array(onp.array([v], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = getattr(nd, op)(x)
+        g = autograd.grad(y, x, create_graph=True)
+        g.backward()
+    assert_almost_equal(_np(x.grad), [d2(v)], rtol=1e-4, atol=1e-5)
+
+
+def test_second_order_through_matmul_loss():
+    # hessian-vector-product style: d/dw of ||dL/dw||^2
+    rng = onp.random.RandomState(0)
+    w = nd.array(rng.rand(3, 3).astype("f"))
+    x = nd.array(rng.rand(4, 3).astype("f"))
+    w.attach_grad()
+    with autograd.record():
+        loss = nd.sum(nd.dot(x, w) ** 2)
+        g = autograd.grad(loss, w, create_graph=True)
+        gnorm = nd.sum(g * g)
+        gnorm.backward()
+    # analytic: L = ||Xw||^2, g = 2 X^T X w, d||g||^2/dw = 8 (X^T X)^2 w
+    A = _np(x).T @ _np(x)
+    want = 8 * A @ A @ _np(w)
+    assert_almost_equal(_np(w.grad), want, rtol=1e-3, atol=1e-4)
+
+
+def test_second_order_through_hybridized_block():
+    # cached-op tape nodes carry their primal: Hessian-vector products
+    # work through net.hybridize() (reference higher_order through
+    # CachedOp)
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="tanh"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(0).rand(3, 2).astype("f"))
+    with autograd.record():
+        y = net(x)  # build cache
+    dense0_w = net[0].weight
+    dense0_w_nd = dense0_w._ndarray
+    dense0_w_nd.attach_grad()
+    with autograd.record():
+        y = net(x)
+        loss = nd.sum(y * y)
+        g = autograd.grad(loss, dense0_w_nd, create_graph=True)
+        gn = nd.sum(g * g)
+        gn.backward()
+    hvp = _np(dense0_w_nd.grad)
+    assert onp.isfinite(hvp).all() and (hvp != 0).any()
+    # finite-difference check of d||g||^2/dw along one coordinate
+    eps = 1e-3
+    wv = _np(dense0_w_nd)
+
+    def gnorm_at(delta):
+        dense0_w.set_data(nd.array(wv + delta))
+        xx = nd.array(_np(x))
+        with autograd.record():
+            yy = net(xx)
+            ll = nd.sum(yy * yy)
+            gg = autograd.grad(ll, dense0_w._ndarray, create_graph=True)
+        return float(nd.sum(gg * gg).asscalar())
+
+    d = onp.zeros_like(wv)
+    d[0, 0] = eps
+    fd = (gnorm_at(d) - gnorm_at(-d)) / (2 * eps)
+    dense0_w.set_data(nd.array(wv))
+    assert abs(hvp[0, 0] - fd) < 0.05 * max(1.0, abs(fd)), (hvp[0, 0], fd)
+
+
+def test_create_graph_warns_on_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    f = Square()
+    x = nd.array(onp.array([3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+        with pytest.warns(UserWarning, match="truncated"):
+            g = autograd.grad(y, x, create_graph=True)
+    # first order still exact through the opaque backward
+    assert_almost_equal(_np(g), [6.0], rtol=1e-6, atol=1e-7)
+
+
+def test_grad_without_create_graph_unchanged():
+    x = nd.array(onp.array([3.0], "f"))
+    with autograd.record():
+        y = x * x
+        g = autograd.grad(y, x)
+    assert_almost_equal(_np(g), [6.0], rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------------------------ dlpack
+
+def test_dlpack_to_torch_and_back():
+    torch = pytest.importorskip("torch")
+
+    a = nd.array(onp.arange(6, dtype="f").reshape(2, 3))
+    cap = nd.to_dlpack_for_read(a)
+    t = torch.utils.dlpack.from_dlpack(cap)
+    assert tuple(t.shape) == (2, 3)
+    onp.testing.assert_allclose(t.numpy(), _np(a))
+    # torch -> mx via the protocol object
+    tt = torch.arange(4, dtype=torch.float32).reshape(2, 2) + 1
+    b = nd.from_dlpack(tt)
+    assert isinstance(b, nd.NDArray)
+    onp.testing.assert_allclose(_np(b), tt.numpy())
+    # torch -> capsule -> mx (reference API shape)
+    cap2 = torch.utils.dlpack.to_dlpack(
+        torch.full((3,), 7.0))
+    c = nd.from_dlpack(cap2)
+    onp.testing.assert_allclose(_np(c), [7.0] * 3)
+
+
+def test_dlpack_write_capsule_is_isolated():
+    torch = pytest.importorskip("torch")
+
+    a = nd.array(onp.ones((2, 2), "f"))
+    t = torch.utils.dlpack.from_dlpack(nd.to_dlpack_for_write(a))
+    t.zero_()  # consumer writes land in the COPY, not the XLA buffer
+    onp.testing.assert_allclose(_np(a), onp.ones((2, 2)))
+    assert float(t.sum()) == 0.0
+
+
+def test_from_numpy_locks_shared_source():
+    src = onp.arange(8, dtype="f").reshape(2, 4)
+    b = nd.from_numpy(src)
+    onp.testing.assert_allclose(_np(b), onp.arange(8).reshape(2, 4))
+    if not src.flags.writeable:
+        # zero-copy path taken: mutation of the source must be refused
+        with pytest.raises(ValueError):
+            src[0, 0] = 99.0
+    c = nd.from_numpy(onp.ones(3, "f"), zero_copy=False)
+    onp.testing.assert_allclose(_np(c), [1, 1, 1])
+    # results feed straight into ops
+    assert nd.sum(b).asscalar() == 28.0
