@@ -240,7 +240,11 @@ def _backward_recorded(heads, head_grads, train_mode):
         grads[id(h)] = hg if id(h) not in grads else grads[id(h)] + hg
 
     snapshot = list(_STATE.tape)  # the walk appends grad-op nodes
-    with _scope(recording=True, training=train_mode):
+    # force recording WITHOUT _scope: entering record() from a
+    # non-recording state would wipe the very tape being walked
+    prev_r, prev_t = _STATE.recording, _STATE.training
+    _STATE.recording, _STATE.training = True, bool(train_mode)
+    try:
         for node in reversed(snapshot):
             cots, any_grad = [], False
             for o in node.outputs:
@@ -254,9 +258,7 @@ def _backward_recorded(heads, head_grads, train_mode):
                 continue
             n_in = len(node.inputs)
             single_out = len(node.outputs) == 1
-            fresh = all(inp._data is pr for inp, pr in
-                        zip(node.inputs, node.primals))
-            if node.fun is not None and fresh:
+            if node.fun is not None:
                 def grad_op(*xs, _fun=node.fun, _n=n_in,
                             _single=single_out):
                     primals, cts = xs[:_n], xs[_n:]
@@ -264,21 +266,23 @@ def _backward_recorded(heads, head_grads, train_mode):
                     gs = vjp(cts[0] if _single else tuple(cts))
                     return tuple(gs) if len(gs) > 1 else gs[0]
 
-                in_grads = apply_pure(grad_op, list(node.inputs) + cots)
+                # inputs rebound in place since record time (out=
+                # aliasing, CachedOp BN running-stat write-back) replay
+                # with their RECORD-TIME buffer as a constant — exact
+                # first-order values; higher-order terms keep flowing
+                # through every still-fresh input (the trained weights)
+                ins = [inp if inp._data is pr else NDArray(pr)
+                       for inp, pr in zip(node.inputs, node.primals)]
+                in_grads = apply_pure(grad_op, ins + cots)
             else:
-                # opaque custom backward (Function) or an input rebound
-                # in place since record time (out= aliasing): use the
-                # record-time vjp — exact values, but the graph stops
-                # here, so higher orders through this node are zero
+                # opaque custom Function backward: exact values, but the
+                # graph stops here — higher orders through it are zero
                 import warnings
 
                 warnings.warn(
                     "create_graph=True: gradient graph truncated at a "
-                    + ("custom Function backward" if node.fun is None
-                       else "node whose input was rebound in place "
-                            "(out= aliasing)")
-                    + "; higher-order terms through it are dropped",
-                    stacklevel=2)
+                    "custom Function backward; higher-order terms "
+                    "through it are dropped", stacklevel=2)
                 raw = node.vjp_fn(cots[0].data if single_out
                                   else tuple(c.data for c in cots))
                 in_grads = [None if g is None else NDArray(jnp.asarray(g))
@@ -290,6 +294,8 @@ def _backward_recorded(heads, head_grads, train_mode):
                     continue
                 prev = grads.get(id(inp))
                 grads[id(inp)] = g if prev is None else prev + g
+    finally:
+        _STATE.recording, _STATE.training = prev_r, prev_t
     return grads
 
 
@@ -324,6 +330,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         grads = _backward_recorded(heads_list, head_grads, train_mode)
         bufs = [grads[id(v)] if id(v) in grads
                 else nd.zeros(v.shape, dtype=v.dtype) for v in variables]
+        if not retain_graph:  # explicit retain_graph=False wins
+            _STATE.tape = []
         return bufs[0] if single else bufs
     # first-order: accumulate into fresh buffers via the plain walk
     saved = [(v._grad if hasattr(v, "_grad") else None,

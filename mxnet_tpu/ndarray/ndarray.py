@@ -631,15 +631,18 @@ def from_numpy(ndarray, zero_copy=True):
 
     arr = onp.ascontiguousarray(ndarray)
     if zero_copy:
+        locked = False
         if arr is ndarray:  # caller still holds this buffer: lock it
             try:
                 arr.flags.writeable = False
+                locked = True
             except ValueError:
                 return array(arr)  # can't lock it: don't share it
         try:
             return from_dlpack(arr)
         except (TypeError, RuntimeError, BufferError):
-            pass  # not dlpack-compatible: plain copy below
+            if locked:  # no buffer is shared after all: unlock
+                arr.flags.writeable = True
     return array(arr)
 
 

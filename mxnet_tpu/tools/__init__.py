@@ -12,7 +12,8 @@ from __future__ import annotations
 import importlib
 
 _SUBMODULES = ("im2rec", "launch", "bandwidth", "parse_log", "diagnose",
-               "flakiness_checker", "kill_mxnet", "amalgamate")
+               "flakiness_checker", "kill_mxnet", "amalgamate",
+               "trace_top")
 
 __all__ = list(_SUBMODULES)
 

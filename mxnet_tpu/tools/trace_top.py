@@ -1,0 +1,101 @@
+"""Top self-time ops from a profiler capture.
+
+Consumes the Chrome-trace half of an XPlane capture (the
+`*.trace.json.gz` jax.profiler writes under
+<logdir>/plugins/profile/<run>/) and prints a per-op self-time table —
+the "attack the top sinks" half of the profile→optimize loop without
+needing TensorBoard on the host. Reference analog: the profiler
+aggregate-stats dump (src/profiler/aggregate_stats.cc PrintStats).
+
+  python -m mxnet_tpu.tools.trace_top bench_profile [-n 25] [--by name]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def find_trace(path):
+    """Accept a logdir, a plugins/profile run dir, or the trace file."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "**", "*.trace.json.gz"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json.gz under {path}")
+    return hits[-1]  # newest run
+
+
+def load_events(trace_file):
+    op = gzip.open if trace_file.endswith(".gz") else open
+    with op(trace_file, "rt") as f:
+        doc = json.load(f)
+    return doc.get("traceEvents", [])
+
+
+def device_op_events(events):
+    """Complete ('X') events on device lanes (TPU/XLA op tracks)."""
+    # pid/tid -> names from metadata events
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e.get("pid")] = e.get("args", {}).get("name", "")
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        pname = names.get(e.get("pid"), "")
+        # device tracks: "/device:TPU:0" / "TPU:x" / "XLA Ops" style
+        if "TPU" in pname or "device" in pname.lower() \
+                or "XLA" in pname:
+            out.append(e)
+    return out or [e for e in events
+                   if e.get("ph") == "X" and "dur" in e]
+
+
+def _family(name):
+    """Collapse fusion noise: 'fusion.123' -> 'fusion',
+    '%convolution.42' -> 'convolution'."""
+    base = name.lstrip("%").split("(")[0]
+    head = base.split(".")[0].split(":")[-1]
+    return head or base
+
+
+def summarize(events, by="family"):
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        key = e["name"] if by == "name" else _family(e["name"])
+        tot[key] += e["dur"]  # microseconds
+        cnt[key] += 1
+    return tot, cnt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logdir", help="profile logdir / run dir / trace file")
+    ap.add_argument("-n", type=int, default=20, help="rows to print")
+    ap.add_argument("--by", choices=("family", "name"), default="family",
+                    help="aggregate by op family (default) or full name")
+    args = ap.parse_args(argv)
+
+    trace = find_trace(args.logdir)
+    events = device_op_events(load_events(trace))
+    tot, cnt = summarize(events, args.by)
+    grand = sum(tot.values()) or 1
+    print(f"# {trace}")
+    print(f"# {len(events)} device events, "
+          f"{grand / 1e3:.2f} ms total self time")
+    print(f"{'self_ms':>10} {'%':>6} {'count':>7}  op")
+    for key, us in tot.most_common(args.n):
+        print(f"{us / 1e3:10.3f} {100.0 * us / grand:6.2f} "
+              f"{cnt[key]:7d}  {key}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

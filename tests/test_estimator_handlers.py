@@ -1,0 +1,112 @@
+"""Estimator event handlers (reference:
+tests/python/unittest/test_gluon_event_handler.py): checkpointing with
+rotation + save-best, early stopping, validation cadence, logging, and
+custom handler hooks.
+"""
+import glob
+import logging
+import os
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.contrib.estimator import Estimator
+from mxnet_tpu.gluon.contrib.estimator.event_handler import (
+    BatchEnd, CheckpointHandler, EarlyStoppingHandler, EpochEnd,
+    LoggingHandler, TrainBegin, TrainEnd, ValidationHandler)
+
+
+def _data(n=32, d=8, classes=2, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = nd.array(rng.rand(n, d).astype("f"))
+    y = nd.array(rng.randint(0, classes, n).astype("f"))
+    return gluon.data.DataLoader(
+        gluon.data.ArrayDataset(x, y), batch_size=8)
+
+
+def _estimator(d=8, classes=2):
+    net = gluon.nn.Dense(classes, in_units=d)
+    net.initialize(mx.init.Xavier())
+    return Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+
+
+def test_checkpoint_handler_rotation_and_best(tmp_path):
+    est = _estimator()
+    ck = CheckpointHandler(str(tmp_path), model_prefix="m",
+                           monitor=est.train_metrics[-1],  # loss
+                           save_best=True, max_checkpoints=2)
+    est.fit(_data(), epochs=4, event_handlers=[ck])
+    epochs = sorted(glob.glob(str(tmp_path / "m-epoch*.params")))
+    assert len(epochs) == 2  # rotation keeps only the newest two
+    assert epochs[-1].endswith("epoch4.params")
+    assert os.path.isfile(str(tmp_path / "m-best.params"))
+    # the checkpoint loads back into a fresh net
+    net2 = gluon.nn.Dense(2, in_units=8)
+    net2.load_parameters(str(tmp_path / "m-best.params"))
+
+
+def test_early_stopping_stops_training():
+    est = _estimator()
+
+    class PlateauMetric:
+        name = "val_acc"
+
+        def get(self):
+            return self.name, 0.5  # never improves after epoch 1
+
+    stopper = EarlyStoppingHandler(PlateauMetric(), patience=1)
+    epochs_seen = []
+
+    class Counter(EpochEnd):
+        def epoch_end(self, estimator, *args, **kwargs):
+            epochs_seen.append(1)
+
+    est.fit(_data(), epochs=10, event_handlers=[stopper, Counter()])
+    assert stopper.stop_training
+    # first epoch sets best, two non-improving epochs exhaust patience=1
+    assert len(epochs_seen) < 10
+
+
+def test_validation_handler_runs_eval():
+    est = _estimator()
+    from mxnet_tpu.metric import Accuracy
+
+    val_metric = Accuracy(name="val_accuracy")
+    vh = ValidationHandler(_data(seed=1), eval_fn=est.evaluate,
+                           val_metrics=[val_metric], epoch_period=1)
+    est.fit(_data(), epochs=2, event_handlers=[vh])
+    name, value = val_metric.get()
+    assert 0.0 <= value <= 1.0
+
+
+def test_logging_handler_emits_records(caplog):
+    est = _estimator()
+    with caplog.at_level(logging.INFO):
+        est.fit(_data(), epochs=1,
+                event_handlers=[LoggingHandler()])
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "poch" in text or "loss" in text.lower(), text
+
+
+def test_custom_handler_hook_order():
+    est = _estimator()
+    calls = []
+
+    class Tracker(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+        def train_begin(self, estimator, *args, **kwargs):
+            calls.append("train_begin")
+
+        def batch_end(self, estimator, *args, **kwargs):
+            calls.append("batch")
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            calls.append("epoch_end")
+
+        def train_end(self, estimator, *args, **kwargs):
+            calls.append("train_end")
+
+    est.fit(_data(), epochs=2, event_handlers=[Tracker()])
+    assert calls[0] == "train_begin" and calls[-1] == "train_end"
+    assert calls.count("epoch_end") == 2
+    assert calls.count("batch") == 8  # 4 batches/epoch x 2

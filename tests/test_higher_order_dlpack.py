@@ -117,6 +117,59 @@ def test_second_order_through_hybridized_block():
     assert abs(hvp[0, 0] - fd) < 0.05 * max(1.0, abs(fd)), (hvp[0, 0], fd)
 
 
+def test_second_order_through_hybridized_batchnorm():
+    # BN running-stat write-back rebinds aux buffers after recording;
+    # the create_graph walk must still differentiate through the WEIGHTS
+    # (stale stats replay as record-time constants), with no truncation
+    import warnings
+
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.BatchNorm(axis=-1),
+            gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(1).rand(6, 3).astype("f"))
+    with autograd.record():
+        net(x)  # build cache
+    w_nd = net[0].weight._ndarray
+    w_nd.attach_grad()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any truncation warning fails
+        with autograd.record():
+            y = net(x)
+            loss = nd.sum(y * y)
+            g = autograd.grad(loss, w_nd, create_graph=True)
+            gn = nd.sum(g * g)
+            gn.backward()
+    hvp = _np(w_nd.grad)
+    assert onp.isfinite(hvp).all() and (hvp != 0).any()
+
+
+def test_create_graph_outside_record_scope_keeps_tape():
+    x = nd.array(onp.array([3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    # grad AFTER the scope closed: the retained tape must survive
+    g = autograd.grad(y, x, create_graph=True)
+    assert_almost_equal(_np(g), [6.0], rtol=1e-6, atol=1e-7)
+    g.backward()
+    assert_almost_equal(_np(x.grad), [2.0], rtol=1e-6, atol=1e-7)
+
+
+def test_create_graph_retain_graph_false_clears_tape():
+    from mxnet_tpu.autograd import _STATE
+
+    x = nd.array(onp.array([2.0], "f"))
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x, create_graph=True, retain_graph=False)
+    assert_almost_equal(_np(g), [4.0], rtol=1e-6, atol=1e-7)
+    assert _STATE.tape == []  # explicit release honored
+
+
 def test_create_graph_warns_on_custom_function():
     class Square(autograd.Function):
         def forward(self, x):

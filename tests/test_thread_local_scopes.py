@@ -1,0 +1,59 @@
+"""Per-thread scope isolation (reference:
+tests/python/unittest/test_thread_local.py — Context, AttrScope,
+NameManager must not leak across threads)."""
+import threading
+
+import mxnet_tpu as mx
+from mxnet_tpu import context, sym
+from mxnet_tpu.attribute import AttrScope
+from mxnet_tpu.name import NameManager, Prefix
+
+
+def test_context_scope_is_thread_local():
+    results = {}
+
+    def worker():
+        # the spawned thread sees the default, not the main thread's with
+        results["inner"] = context.current_context().device_type
+
+    with context.Context("cpu", 1):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        results["outer"] = context.current_context()
+    assert results["outer"].device_type == "cpu"
+    assert results["outer"].device_id == 1
+    assert results["inner"] in ("cpu", "tpu", "gpu")
+
+
+def test_attr_scope_is_thread_local():
+    seen = {}
+
+    def worker():
+        s = sym.Variable("b")
+        seen["thread_attrs"] = s.attr("group")
+
+    with AttrScope(group="4"):
+        a = sym.Variable("a")
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert a.attr("group") == "4"
+    assert seen["thread_attrs"] is None  # no leak into the worker
+
+
+def test_name_manager_is_thread_local():
+    out = {}
+
+    def worker():
+        with NameManager():
+            s = sym.FullyConnected(sym.Variable("d"), num_hidden=1)
+            out["thread_name"] = s.name
+
+    with Prefix("main_"):
+        s_main = sym.FullyConnected(sym.Variable("d"), num_hidden=1)
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert s_main.name.startswith("main_")
+    assert not out["thread_name"].startswith("main_")
