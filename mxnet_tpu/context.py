@@ -12,7 +12,8 @@ import threading
 
 import jax
 
-__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus",
+           "num_tpus", "gpu_memory_info"]
 
 
 class Context:
@@ -109,6 +110,16 @@ def num_tpus():
 def num_gpus():
     """Reference: mxnet.context.num_gpus — here the number of TPU chips."""
     return num_tpus()
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) accelerator memory in bytes (reference:
+    context.gpu_memory_info over cudaMemGetInfo; here PJRT's per-device
+    HBM accounting via the Storage interface). Raises on an invalid
+    device id, matching the reference (and util.get_gpu_memory)."""
+    from .util import get_gpu_memory
+
+    return get_gpu_memory(device_id)
 
 
 def current_context():

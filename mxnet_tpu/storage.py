@@ -15,7 +15,7 @@ import os
 
 import numpy as onp
 
-__all__ = ["Storage", "get"]
+__all__ = ["Storage", "get", "device_memory_info"]
 
 
 class _Handle:
@@ -122,6 +122,26 @@ class Storage:
 
 
 _storage = None
+
+
+def device_memory_info(ctx=None):
+    """(free, total, stats) for an accelerator's HBM through the Storage
+    interface (reference: Storage::Get()->... / cudaMemGetInfo behind
+    mx.context.gpu_memory_info). The pool itself is PJRT's — this fronts
+    its per-device accounting: bytes_in_use, peak_bytes_in_use,
+    bytes_limit and friends from the PJRT allocator."""
+    import jax
+
+    if ctx is None:
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        dev = devs[0] if devs else jax.devices()[0]
+    else:
+        dev = getattr(ctx, "jax_device", ctx)
+    stats = dict(dev.memory_stats() or {})
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    free = max(total - used, 0) if total else 0
+    return free, total, stats
 
 
 def get():

@@ -37,16 +37,17 @@ def get_gpu_count():
 
 def get_gpu_memory(gpu_dev_id=0):
     """(free, total) accelerator memory in bytes, when the backend
-    exposes it (reference: cudaMemGetInfo)."""
+    exposes it (reference: cudaMemGetInfo). Single source of truth for
+    the math: storage.device_memory_info."""
     import jax
+
+    from .storage import device_memory_info
 
     devs = [d for d in jax.devices() if d.platform != "cpu"]
     if gpu_dev_id >= len(devs):
         raise ValueError(f"no accelerator device {gpu_dev_id}")
-    stats = devs[gpu_dev_id].memory_stats() or {}
-    total = stats.get("bytes_limit", 0)
-    used = stats.get("bytes_in_use", 0)
-    return total - used, total
+    free, total, _ = device_memory_info(devs[gpu_dev_id])
+    return free, total
 
 
 # ---- numpy-semantics switches (shared state with numpy_extension) --------

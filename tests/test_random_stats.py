@@ -1,0 +1,125 @@
+"""Statistical validation of the random samplers (reference:
+tests/python/unittest/test_random.py — KS / chi-square goodness-of-fit
+per distribution, not just moments). Seeds are fixed; alpha=1e-3 keeps
+the false-failure rate negligible.
+"""
+import numpy as onp
+import pytest
+import scipy.stats as st
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = 20000
+ALPHA = 1e-3
+
+
+def _sample(fn, **kw):
+    mx.random.seed(1234)
+    return onp.asarray(fn(shape=(N,), **kw).asnumpy())
+
+
+def test_uniform_ks():
+    s = _sample(nd.random_uniform, low=-2.0, high=3.0)
+    p = st.kstest(s, st.uniform(loc=-2.0, scale=5.0).cdf).pvalue
+    assert p > ALPHA, p
+    assert s.min() >= -2.0 and s.max() <= 3.0
+
+
+def test_normal_ks():
+    s = _sample(nd.random_normal, loc=1.5, scale=2.0)
+    p = st.kstest(s, st.norm(loc=1.5, scale=2.0).cdf).pvalue
+    assert p > ALPHA, p
+
+
+def test_exponential_ks():
+    s = _sample(nd.random_exponential, lam=2.5)
+    p = st.kstest(s, st.expon(scale=1 / 2.5).cdf).pvalue
+    assert p > ALPHA, p
+
+
+def test_gamma_ks():
+    s = _sample(nd.random_gamma, alpha=3.0, beta=2.0)
+    p = st.kstest(s, st.gamma(a=3.0, scale=2.0).cdf).pvalue
+    assert p > ALPHA, p
+
+
+def test_gumbel_ks():
+    s = _sample(nd.random_gumbel, loc=0.5, scale=1.5)
+    p = st.kstest(s, st.gumbel_r(loc=0.5, scale=1.5).cdf).pvalue
+    assert p > ALPHA, p
+
+
+def test_poisson_chisquare():
+    lam = 4.0
+    s = _sample(nd.random_poisson, lam=lam).astype(int)
+    kmax = 15
+    obs = onp.bincount(onp.clip(s, 0, kmax), minlength=kmax + 1)
+    pmf = st.poisson(lam).pmf(onp.arange(kmax))
+    exp = onp.append(pmf, 1 - pmf.sum()) * N
+    keep = exp > 5
+    chi = ((obs[keep] - exp[keep]) ** 2 / exp[keep]).sum()
+    p = 1 - st.chi2(keep.sum() - 1).cdf(chi)
+    assert p > ALPHA, p
+
+
+def test_randint_chisquare():
+    s = _sample(nd.random_randint, low=0, high=10).astype(int)
+    obs = onp.bincount(s, minlength=10)
+    p = st.chisquare(obs).pvalue
+    assert p > ALPHA, p
+    assert s.min() >= 0 and s.max() <= 9
+
+
+def test_negative_binomial_moments():
+    k, prob = 5, 0.4
+    s = _sample(nd.random_negative_binomial, k=k, p=prob)
+    want_mean = k * (1 - prob) / prob
+    want_var = k * (1 - prob) / prob ** 2
+    assert abs(s.mean() - want_mean) < 0.05 * want_mean
+    assert abs(s.var() - want_var) < 0.1 * want_var
+
+
+def test_multinomial_chisquare():
+    mx.random.seed(99)
+    probs = nd.array(onp.array([0.1, 0.2, 0.3, 0.4], "f"))
+    s = onp.asarray(nd.sample_multinomial(probs, shape=(N,)).asnumpy())
+    obs = onp.bincount(s.astype(int).ravel(), minlength=4)
+    p = st.chisquare(obs, f_exp=onp.array([0.1, 0.2, 0.3, 0.4]) * obs.sum()
+                     ).pvalue
+    assert p > ALPHA, p
+
+
+def test_sample_normal_per_row_ks():
+    mx.random.seed(5)
+    mu = nd.array(onp.array([0.0, 10.0], "f"))
+    sig = nd.array(onp.array([1.0, 3.0], "f"))
+    s = onp.asarray(nd.sample_normal(mu=mu, sigma=sig,
+                                     shape=(N // 2,)).asnumpy())
+    for row, (m, sd) in enumerate([(0.0, 1.0), (10.0, 3.0)]):
+        p = st.kstest(s[row], st.norm(loc=m, scale=sd).cdf).pvalue
+        assert p > ALPHA, (row, p)
+
+
+def test_dropout_keep_fraction():
+    from mxnet_tpu import autograd
+
+    mx.random.seed(3)
+    x = nd.ones((200, 200))
+    with autograd.record(train_mode=True):
+        out = nd.dropout(x, p=0.3)
+    o = onp.asarray(out.asnumpy())
+    kept = (o != 0).mean()
+    assert abs(kept - 0.7) < 0.02
+    # kept values are scaled by 1/(1-p)
+    onp.testing.assert_allclose(o[o != 0], 1 / 0.7, rtol=1e-5)
+
+
+def test_seed_reproducibility_and_divergence():
+    mx.random.seed(42)
+    a = onp.asarray(nd.random_normal(shape=(100,)).asnumpy())
+    mx.random.seed(42)
+    b = onp.asarray(nd.random_normal(shape=(100,)).asnumpy())
+    onp.testing.assert_array_equal(a, b)
+    c = onp.asarray(nd.random_normal(shape=(100,)).asnumpy())
+    assert not onp.array_equal(b, c)  # stream advances
