@@ -201,9 +201,18 @@ class Symbol:
         return ([var_shapes.get(a) for a in args], out_shapes, [])
 
     def infer_type(self, **kwargs):
+        """Reference: symbol.py infer_type — forward FInferType pass
+        (symbol/infer.py infer_types); unknown arguments default to
+        float32 like the reference's executor bind."""
+        from .infer import infer_types
+
+        known = {k: onp.dtype(v) for k, v in kwargs.items()}
+        var_types, out_types = infer_types(self, known)
         args = self.list_arguments()
-        return ([onp.float32] * len(args),
-                [onp.float32] * max(self._num_outputs, 1), [])
+        aux = self.list_auxiliary_states()
+        return ([var_types.get(a, onp.dtype(onp.float32)) for a in args],
+                out_types,
+                [var_types.get(a, onp.dtype(onp.float32)) for a in aux])
 
     # ---- binding ---------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", **kwargs):

@@ -197,3 +197,106 @@ def infer_shapes(symbol, known, allow_unknown=False):
             s = s[h._output_index]
         out_shapes.append(s)
     return var_shapes, out_shapes
+
+
+# ---- dtype inference -------------------------------------------------------
+
+def _canon(d):
+    # runtime-truthful: under jax's default x64-off config, 64-bit tags
+    # execute as their 32-bit types — report what execution produces
+    return onp.dtype(jax.dtypes.canonicalize_dtype(onp.dtype(d)))
+
+
+# ops whose output dtype is fixed rather than promoted from inputs
+# (reference: per-op FInferType registrations)
+_FIXED_OUT_DTYPE = {
+    "argmax": onp.float32, "argmin": onp.float32,
+    "shape_array": onp.int64, "size_array": onp.int64,
+    "dequantize": onp.float32,
+}
+
+# ops whose non-data variable inputs have a fixed default dtype instead
+# of the same-type sibling constraint (reference FInferType specifics)
+_PARAM_DTYPE_DEFAULTS = {"embedding": {1: onp.float32}}
+
+
+def _node_out_dtype(op, kw, in_dtypes):
+    if op in ("cast", "amp_cast"):
+        return _canon(kw.get("dtype", "float32"))
+    if op in _FIXED_OUT_DTYPE:
+        return _canon(_FIXED_OUT_DTYPE[op])
+    if op in ("quantize", "quantize_v2"):
+        # (q, min, max): quantized payload in out_type, fp32 ranges
+        q = _canon(kw.get("out_type",
+                          "uint8" if op == "quantize" else "int8"))
+        return [q, onp.dtype(onp.float32), onp.dtype(onp.float32)]
+    if op == "requantize":
+        return [_canon(kw.get("out_type", "int8")),
+                onp.dtype(onp.float32), onp.dtype(onp.float32)]
+    if op in ("_sym_zeros", "_sym_ones"):
+        return _canon(kw.get("dtype", "float32"))
+    if op == "embedding":
+        return in_dtypes.get(1, onp.dtype(onp.float32))  # weight dtype
+    if not in_dtypes:
+        return onp.dtype(onp.float32)
+    import jax.numpy as jnp
+
+    return onp.dtype(jnp.result_type(*[onp.dtype(d)
+                                       for d in in_dtypes.values()]))
+
+
+def infer_types(symbol, known):
+    """Forward dtype propagation (reference:
+    infer_graph_attr_pass.cc with FInferType; most ops are
+    ElemwiseType — same dtype in, promoted dtype out). `known` maps
+    variable names to dtypes; unknown parameter variables inherit the
+    promoted dtype of their node's known siblings (the reference's
+    bidirectional same-type constraint, forward half).
+    """
+    var_types = {k: onp.dtype(v) for k, v in known.items()}
+    node_out = {}
+    for node in symbol._walk():
+        if node._group is not None:
+            continue
+        if node._op is None:
+            if node._name in var_types:
+                node_out[id(node)] = var_types[node._name]
+            continue
+        in_dtypes = {}
+        for i, inp in enumerate(node._inputs):
+            d = node_out.get(id(inp))
+            if d is not None:
+                in_dtypes[i] = d
+        # op-specific parameter defaults first (embedding weight is fp32
+        # regardless of the integer index dtype), then the promoted
+        # same-type sibling constraint for the rest
+        defaults = _PARAM_DTYPE_DEFAULTS.get(node._op, {})
+        for i, inp in enumerate(node._inputs):
+            if i not in in_dtypes and inp._op is None and i in defaults:
+                var_types.setdefault(inp._name, onp.dtype(defaults[i]))
+                node_out[id(inp)] = var_types[inp._name]
+                in_dtypes[i] = var_types[inp._name]
+        if in_dtypes and len(in_dtypes) < len(node._inputs):
+            import jax.numpy as jnp
+
+            sib = onp.dtype(jnp.result_type(
+                *[onp.dtype(d) for d in in_dtypes.values()]))
+            for i, inp in enumerate(node._inputs):
+                if i not in in_dtypes and inp._op is None:
+                    var_types.setdefault(inp._name, sib)
+                    node_out[id(inp)] = var_types[inp._name]
+                    in_dtypes[i] = var_types[inp._name]
+        out_d = _node_out_dtype(node._op, node._kwargs, in_dtypes)
+        node_out[id(node)] = out_d
+    heads = symbol._group if symbol._group else [symbol]
+    out_types = []
+    for h in heads:
+        d = node_out.get(id(h), onp.dtype(onp.float32))
+        # one dtype per list_outputs() entry (multi-output nodes list
+        # every output, so the dtype list expands in lockstep)
+        n = getattr(h, "_num_outputs", 1) or 1
+        if isinstance(d, list):
+            out_types.extend(list(d[:n]) + [d[-1]] * max(0, n - len(d)))
+        else:
+            out_types.extend([d] * n)
+    return var_types, out_types

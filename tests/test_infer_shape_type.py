@@ -1,0 +1,102 @@
+"""Symbol shape/type inference (reference:
+tests/python/unittest/test_infer_shape.py + test_infer_type.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_mlp_infer_shape_fills_parameters():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    out = sym.FullyConnected(fc1, num_hidden=3, name="fc2")
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(4, 7))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (10, 7)
+    assert shapes["fc1_bias"] == (10,)
+    assert shapes["fc2_weight"] == (3, 10)
+    assert out_shapes == [(4, 3)]
+    assert aux_shapes == []
+
+
+def test_conv_bn_infer_shape_with_aux():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv")
+    b = sym.BatchNorm(c, name="bn")
+    arg_shapes, out_shapes, aux_shapes = b.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(b.list_arguments(), arg_shapes))
+    assert shapes["conv_weight"] == (8, 3, 3, 3)
+    assert shapes["bn_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 8, 8)]
+    aux = dict(zip(b.list_auxiliary_states(), aux_shapes))
+    assert aux["bn_moving_mean"] == (8,)
+
+
+def test_infer_shape_partial_tolerates_unknowns():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.broadcast_add(a, b)
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(a=(2, 3))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["a"] == (2, 3)
+    assert shapes.get("b") is None
+    assert out_shapes == [None]
+
+
+def test_infer_type_propagates_through_mlp():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_types, out_types, aux_types = out.infer_type(data=onp.float16)
+    types = dict(zip(out.list_arguments(), arg_types))
+    # parameters take the data dtype (reference same-type constraint)
+    assert types["fc_weight"] == onp.float16
+    assert types["fc_bias"] == onp.float16
+    assert out_types == [onp.float16]
+
+
+def test_infer_type_cast_and_promotion():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.cast(a, dtype="float16")
+    out = sym.broadcast_add(c, b)
+    arg_types, out_types, _ = out.infer_type(a=onp.float32, b=onp.float16)
+    assert out_types == [onp.float16]  # f16 + f16
+    mixed = sym.broadcast_add(sym.cast(a, dtype="float16"), b)
+    _, tm, _ = mixed.infer_type(a=onp.float16, b=onp.float32)
+    assert tm == [onp.float32]  # f16 + f32 promotes to f32
+    # runtime-truthful: with jax x64 off, cast-to-f64 executes as f32,
+    # and inference reports the executed dtype
+    _, t64, _ = sym.cast(a, dtype="float64").infer_type(a=onp.float32)
+    assert t64 == [onp.float32]
+    _, t16, _ = sym.cast(out, dtype="float16").infer_type(
+        a=onp.float32, b=onp.float16)
+    assert t16 == [onp.float16]
+
+
+def test_infer_type_defaults_and_indices():
+    data = sym.Variable("data")
+    am = sym.argmax(data, axis=1)
+    _, out_types, _ = am.infer_type(data=onp.float16)
+    assert out_types == [onp.float32]  # reference: indices as fp32
+    fc = sym.FullyConnected(data, num_hidden=2)
+    arg_types, _, _ = fc.infer_type()
+    assert all(t == onp.float32 for t in arg_types)  # default
+
+
+def test_infer_type_embedding_and_quantize_outputs():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name="emb")
+    arg_types, out_types, _ = emb.infer_type(data=onp.int32)
+    types = dict(zip(emb.list_arguments(), arg_types))
+    # integer indices do NOT drag the weight to int: fp32 default
+    assert types["emb_weight"] == onp.float32
+    assert out_types == [onp.float32]
+    # quantize family: one dtype per listed output, uint8 payload default
+    q = sym.quantize(sym.Variable("x"), sym.Variable("mn"),
+                     sym.Variable("mx"))
+    _, qt, _ = q.infer_type(x=onp.float32, mn=onp.float32, mx=onp.float32)
+    # one dtype per list_outputs entry, payload dtype first (uint8 is
+    # the reference quantize default out_type)
+    assert len(qt) == len(q.list_outputs())
+    assert qt[0] == onp.uint8
