@@ -79,6 +79,79 @@ class ndarray(NDArray):
             return self.__repr__()
         return str(self.asnumpy())
 
+    # ---- NumPy dispatch protocols (reference:
+    # python/mxnet/numpy/multiarray.py __array_ufunc__/__array_function__
+    # + tests/python/unittest/test_numpy_interoperability.py) -----------
+
+    def __array__(self, dtype=None, copy=None):
+        if copy is False:
+            # NumPy 2 contract: copy=False must raise when a copy is
+            # unavoidable — host export of a device buffer always copies
+            raise ValueError(
+                "cannot expose a device array without a copy "
+                "(asarray(..., copy=False))")
+        arr = self.asnumpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    @staticmethod
+    def _tohost(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if isinstance(x, (list, tuple)):
+            return type(x)(ndarray._tohost(v) for v in x)
+        return x
+
+    @staticmethod
+    def _wrapout(out):
+        if isinstance(out, onp.ndarray):
+            return array(out)
+        if isinstance(out, tuple):  # multi-output (modf, frexp, ...)
+            return tuple(ndarray._wrapout(o) for o in out)
+        return out
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        """onp.add(a, b), onp.sin(a)... dispatch to the mx.np op of the
+        same name when registered, keeping results on device; ufunc
+        kwargs (where=, casting=, ...), reduce/accumulate/outer methods,
+        and unknown ufuncs compute via numpy on host and re-wrap."""
+        if kwargs.get("out") is not None:
+            return NotImplemented
+        if method == "__call__" and not kwargs:
+            # kwargs force the host path: mx wrappers accept **kw
+            # permissively, so a TypeError probe can't detect an
+            # unsupported where=/dtype= — don't risk dropping them
+            mxfn = globals().get(ufunc.__name__)
+            if mxfn is not None and callable(mxfn):
+                try:
+                    return mxfn(*inputs)
+                except TypeError:
+                    pass  # signature mismatch: host fallback below
+        vals = [self._tohost(x) for x in inputs]
+        return self._wrapout(getattr(ufunc, method)(*vals, **kwargs))
+
+    # numpy kwargs whose silent loss corrupts results if the mx namesake
+    # accepts-and-ignores them: presence forces the host path
+    _AF_HOST_KWARGS = ("order", "where", "casting", "subok", "like")
+
+    def __array_function__(self, func, types, args, kwargs):
+        """onp.mean(a), onp.concatenate([...])... route to the mx.np
+        function of the same name (device-resident result); otherwise
+        fall back to numpy over host copies, wrapped back."""
+        mxfn = globals().get(func.__name__)
+        # NB: bare any()/all() here would resolve to THIS MODULE's
+        # mx.np.any — the numpy namespace shadows the builtins
+        risky = builtins.any(kwargs.get(k) not in (None, "C")
+                             for k in self._AF_HOST_KWARGS)
+        if mxfn is not None and callable(mxfn) and mxfn is not func \
+                and not risky:
+            try:
+                return mxfn(*args, **kwargs)
+            except TypeError:
+                pass
+        out = func(*self._tohost(args),
+                   **{k: self._tohost(v) for k, v in kwargs.items()})
+        return self._wrapout(out)
+
     # numpy comparison semantics: bool results (the parent returns
     # mxnet-style float 0/1 masks)
     def _cmp(self, other, fn):

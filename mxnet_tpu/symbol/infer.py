@@ -265,6 +265,9 @@ def infer_types(symbol, known):
         in_dtypes = {}
         for i, inp in enumerate(node._inputs):
             d = node_out.get(id(inp))
+            if isinstance(d, list):  # multi-output producer: pick ours
+                d = d[inp._output_index] if inp._output_index < len(d) \
+                    else d[-1]
             if d is not None:
                 in_dtypes[i] = d
         # op-specific parameter defaults first (embedding weight is fp32

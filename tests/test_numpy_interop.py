@@ -1,0 +1,104 @@
+"""NumPy dispatch-protocol interoperability (reference:
+tests/python/unittest/test_numpy_interoperability.py — onp functions
+called ON mx.np arrays route to device ops and return mx.np arrays)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+
+
+def test_array_protocol():
+    a = mxnp.array([[1.0, 2.0], [3.0, 4.0]])
+    host = onp.asarray(a)
+    assert isinstance(host, onp.ndarray)
+    onp.testing.assert_allclose(host, [[1, 2], [3, 4]])
+    assert onp.asarray(a, dtype="float64").dtype == onp.float64
+
+
+def test_ufunc_dispatch_stays_on_device():
+    a = mxnp.array([1.0, 2.0, 3.0])
+    out = onp.add(a, 1)
+    assert isinstance(out, mxnp.ndarray)
+    onp.testing.assert_allclose(out.asnumpy(), [2, 3, 4])
+    s = onp.sin(a)
+    assert isinstance(s, mxnp.ndarray)
+    onp.testing.assert_allclose(s.asnumpy(), onp.sin([1.0, 2.0, 3.0]),
+                                rtol=1e-6)
+    m = onp.multiply(a, a)
+    assert isinstance(m, mxnp.ndarray)
+    onp.testing.assert_allclose(m.asnumpy(), [1, 4, 9])
+
+
+def test_array_function_dispatch():
+    a = mxnp.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(onp.mean(a)) == 2.5
+    c = onp.concatenate([a, a], axis=0)
+    assert isinstance(c, mxnp.ndarray) and c.shape == (4, 2)
+    d = onp.dot(a, a)
+    assert isinstance(d, mxnp.ndarray)
+    onp.testing.assert_allclose(d.asnumpy(), onp.dot(a.asnumpy(),
+                                                     a.asnumpy()))
+    w = onp.where(a > 2, a, mxnp.zeros_like(a))
+    onp.testing.assert_allclose(w.asnumpy(), [[0, 0], [3, 4]])
+
+
+def test_host_fallback_for_unregistered_functions():
+    a = mxnp.array([3.0, 1.0, 2.0])
+    # functions with no mx.np counterpart run on host and wrap back
+    out = onp.partition(a, 1)
+    onp.testing.assert_allclose(onp.asarray(out)[:2], [1, 2])
+
+
+def test_ufunc_kwargs_and_methods_via_host():
+    a = mxnp.array([1.0, 2.0, 3.0, 4.0])
+    # where= must not be silently dropped
+    mask = onp.array([True, False, True, False])
+    out = onp.add(a, 10.0, where=mask)
+    got = onp.asarray(out)
+    assert got[0] == 11.0 and got[2] == 13.0
+    # ufunc methods route through the host fallback
+    assert float(onp.asarray(onp.add.reduce(a))) == 10.0
+    acc = onp.asarray(onp.maximum.accumulate(mxnp.array([1.0, 3.0, 2.0])))
+    onp.testing.assert_allclose(acc, [1, 3, 3])
+    outer = onp.multiply.outer(mxnp.array([1.0, 2.0]),
+                               mxnp.array([3.0, 4.0]))
+    onp.testing.assert_allclose(onp.asarray(outer), [[3, 4], [6, 8]])
+
+
+def test_multi_output_and_order_fallbacks():
+    a = mxnp.array([1.5, 2.25])
+    frac, whole = onp.modf(a)  # tuple preserved, not stacked
+    onp.testing.assert_allclose(onp.asarray(frac), [0.5, 0.25])
+    onp.testing.assert_allclose(onp.asarray(whole), [1.0, 2.0])
+    m = mxnp.array([[1.0, 2.0], [3.0, 4.0]])
+    # order='F' must not silently produce a C-order reshape
+    onp.testing.assert_allclose(onp.asarray(onp.ravel(m, order="F")),
+                                [1, 3, 2, 4])
+
+
+def test_asarray_copy_false_raises():
+    a = mxnp.array([1.0])
+    with pytest.raises(ValueError):
+        onp.asarray(a, copy=False)
+
+
+def test_infer_type_through_quantize_consumer():
+    from mxnet_tpu import sym
+
+    q = sym.quantize(sym.Variable("x"), sym.Variable("mn"),
+                     sym.Variable("mx"))
+    deq = sym.dequantize(q, sym.Variable("mn2"), sym.Variable("mx2"))
+    _, out_t, _ = deq.infer_type(x=onp.float32, mn=onp.float32,
+                                 mx=onp.float32, mn2=onp.float32,
+                                 mx2=onp.float32)
+    assert out_t == [onp.float32]
+
+
+def test_mixed_operands_and_testing_helpers():
+    a = mxnp.array([1.0, 2.0])
+    b = onp.array([10.0, 20.0], "f")
+    out = onp.add(a, b)
+    onp.testing.assert_allclose(onp.asarray(out), [11, 22])
+    # assert_allclose works directly on mx arrays via __array__
+    onp.testing.assert_allclose(a, [1.0, 2.0])
