@@ -131,17 +131,28 @@ class ndarray(NDArray):
 
     # numpy kwargs whose silent loss corrupts results if the mx namesake
     # accepts-and-ignores them: presence forces the host path
-    _AF_HOST_KWARGS = ("order", "where", "casting", "subok", "like")
+    _AF_HOST_KWARGS = ("order", "where", "casting", "subok", "like",
+                       "initial", "out")
+
+    @classmethod
+    def _kwargs_force_host(cls, kwargs):
+        # NB: bare any()/`in (None, "C")` here would be wrong twice over:
+        # any() resolves to THIS MODULE's mx.np.any (the numpy namespace
+        # shadows builtins), and `in` bool()s elementwise == results for
+        # array-valued kwargs like where=mask
+        for k in cls._AF_HOST_KWARGS:
+            v = kwargs.get(k)
+            if v is None or (isinstance(v, str) and v == "C"):
+                continue
+            return True
+        return False
 
     def __array_function__(self, func, types, args, kwargs):
         """onp.mean(a), onp.concatenate([...])... route to the mx.np
         function of the same name (device-resident result); otherwise
         fall back to numpy over host copies, wrapped back."""
         mxfn = globals().get(func.__name__)
-        # NB: bare any()/all() here would resolve to THIS MODULE's
-        # mx.np.any — the numpy namespace shadows the builtins
-        risky = builtins.any(kwargs.get(k) not in (None, "C")
-                             for k in self._AF_HOST_KWARGS)
+        risky = self._kwargs_force_host(kwargs)
         if mxfn is not None and callable(mxfn) and mxfn is not func \
                 and not risky:
             try:

@@ -20,8 +20,10 @@ from .spmd import (all_reduce, group_all_reduce, SPMDTrainer, shard_batch,
                    replicate, shard_params)
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
+from .moe import moe_ffn, switch_router
 
-__all__ = ["make_mesh", "current_mesh", "mesh_scope", "device_count",
+__all__ = ["moe_ffn", "switch_router",
+           "make_mesh", "current_mesh", "mesh_scope", "device_count",
            "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
            "replicate", "shard_params", "ring_attention",
            "ulysses_attention"]

@@ -77,6 +77,21 @@ def test_multi_output_and_order_fallbacks():
                                 [1, 3, 2, 4])
 
 
+def test_array_function_reduce_kwargs_go_host():
+    a = mxnp.array([1.0, 2.0])
+    # initial= must not be silently swallowed by the device wrapper
+    assert float(onp.asarray(onp.sum(a, initial=10.0))) == 13.0
+    # array-valued where= must neither crash the guard nor be dropped
+    m = mxnp.array([[1.0, 2.0], [3.0, 4.0]])
+    mask = onp.array([[True, False], [True, True]])
+    got = float(onp.asarray(onp.mean(m, where=mask)))
+    assert abs(got - (1 + 3 + 4) / 3) < 1e-6
+    # out= host array routes through numpy and fills the buffer
+    buf = onp.empty((), "f")
+    onp.mean(a, out=buf)
+    assert float(buf) == 1.5
+
+
 def test_asarray_copy_false_raises():
     a = mxnp.array([1.0])
     with pytest.raises(ValueError):
