@@ -151,6 +151,16 @@ class ndarray(NDArray):
         """onp.mean(a), onp.concatenate([...])... route to the mx.np
         function of the same name (device-resident result); otherwise
         fall back to numpy over host copies, wrapped back."""
+        out_buf = kwargs.get("out")
+        if isinstance(out_buf, NDArray):
+            # numpy's out= contract is in-place fill; XLA buffers are
+            # immutable, so compute then rebind the handle's payload
+            kwargs = {k: v for k, v in kwargs.items() if k != "out"}
+            result = self.__array_function__(func, types, args, kwargs)
+            out_buf._data = jnp.asarray(
+                result.data if isinstance(result, NDArray) else result,
+                out_buf._data.dtype)
+            return out_buf
         mxfn = globals().get(func.__name__)
         risky = self._kwargs_force_host(kwargs)
         if mxfn is not None and callable(mxfn) and mxfn is not func \

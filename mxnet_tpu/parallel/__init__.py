@@ -21,8 +21,9 @@ from .spmd import (all_reduce, group_all_reduce, SPMDTrainer, shard_batch,
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .moe import moe_ffn, switch_router
+from .pipeline import pipeline_apply
 
-__all__ = ["moe_ffn", "switch_router",
+__all__ = ["moe_ffn", "switch_router", "pipeline_apply",
            "make_mesh", "current_mesh", "mesh_scope", "device_count",
            "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
            "replicate", "shard_params", "ring_attention",

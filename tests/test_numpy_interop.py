@@ -90,6 +90,10 @@ def test_array_function_reduce_kwargs_go_host():
     buf = onp.empty((), "f")
     onp.mean(a, out=buf)
     assert float(buf) == 1.5
+    # out= mx array: payload rebinding honors the in-place contract
+    mbuf = mxnp.zeros(())
+    ret = onp.mean(a, out=mbuf)
+    assert ret is mbuf and float(onp.asarray(mbuf)) == 1.5
 
 
 def test_asarray_copy_false_raises():
