@@ -154,12 +154,24 @@ class ndarray(NDArray):
         out_buf = kwargs.get("out")
         if isinstance(out_buf, NDArray):
             # numpy's out= contract is in-place fill; XLA buffers are
-            # immutable, so compute then rebind the handle's payload
+            # immutable, so compute then rebind the handle's payload —
+            # with numpy's own shape/casting validation first
             kwargs = {k: v for k, v in kwargs.items() if k != "out"}
             result = self.__array_function__(func, types, args, kwargs)
-            out_buf._data = jnp.asarray(
-                result.data if isinstance(result, NDArray) else result,
-                out_buf._data.dtype)
+            rdata = result.data if isinstance(result, NDArray) \
+                else jnp.asarray(result)
+            if tuple(rdata.shape) != tuple(out_buf.shape):
+                raise ValueError(
+                    f"output parameter has wrong shape "
+                    f"{tuple(out_buf.shape)}; expected "
+                    f"{tuple(rdata.shape)}")
+            if not onp.can_cast(rdata.dtype, out_buf._data.dtype,
+                                "same_kind"):
+                raise TypeError(
+                    f"Cannot cast {func.__name__} output from "
+                    f"{rdata.dtype} to {out_buf._data.dtype} with "
+                    f"casting rule 'same_kind'")
+            out_buf._data = jnp.asarray(rdata, out_buf._data.dtype)
             return out_buf
         mxfn = globals().get(func.__name__)
         risky = self._kwargs_force_host(kwargs)

@@ -22,8 +22,11 @@ from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .moe import moe_ffn, switch_router
 from .pipeline import pipeline_apply
+from .checkpoint import (save_sharded, load_sharded, save_trainer,
+                         load_trainer)
 
 __all__ = ["moe_ffn", "switch_router", "pipeline_apply",
+           "save_sharded", "load_sharded", "save_trainer", "load_trainer",
            "make_mesh", "current_mesh", "mesh_scope", "device_count",
            "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
            "replicate", "shard_params", "ring_attention",

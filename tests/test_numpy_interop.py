@@ -94,6 +94,11 @@ def test_array_function_reduce_kwargs_go_host():
     mbuf = mxnp.zeros(())
     ret = onp.mean(a, out=mbuf)
     assert ret is mbuf and float(onp.asarray(mbuf)) == 1.5
+    # ...including numpy's own shape and casting validation
+    with pytest.raises(ValueError, match="wrong shape"):
+        onp.mean(a, out=mxnp.zeros((5,)))
+    with pytest.raises(TypeError, match="same_kind"):
+        onp.mean(a, out=mxnp.zeros((), dtype="int32"))
 
 
 def test_asarray_copy_false_raises():
