@@ -411,6 +411,38 @@ def _install_unary_methods():
 _install_unary_methods()
 
 
+def _install_fluent_methods():
+    """Reference NDArray exposes most data-first ops as methods too
+    (ndarray.py's fluent-method autogen over _NDARRAY_UNARY/..._FUNCS);
+    same here, straight off the registry."""
+    for name in ("argmax_channel", "argsort", "broadcast_axes",
+                 "depth_to_space", "diag", "flip", "nanprod", "nansum",
+                 "pad", "pick", "repeat", "shape_array", "size_array",
+                 "slice", "slice_like", "softmin", "sort",
+                 "space_to_depth", "split", "split_v2", "tile", "topk",
+                 "ones_like", "zeros_like"):
+        if hasattr(NDArray, name):
+            continue
+
+        def method(self, *args, _name=name, **kwargs):
+            return _invoke1(_name, self, *args, **kwargs)
+
+        method.__name__ = name
+        setattr(NDArray, name, method)
+
+    def _to_dlpack_read(self):
+        return to_dlpack_for_read(self)
+
+    def _to_dlpack_write(self):
+        return to_dlpack_for_write(self)
+
+    NDArray.to_dlpack_for_read = _to_dlpack_read
+    NDArray.to_dlpack_for_write = _to_dlpack_write
+
+
+_install_fluent_methods()
+
+
 # small helper so methods can dispatch without importing the populated module
 def _invoke1(opname, *args, **kwargs):
     opdef = _reg.get_op(opname)

@@ -382,6 +382,20 @@ def broadcast_axis(data, axis=(), size=()):
     return jnp.broadcast_to(data, tuple(tgt))
 
 
+@register()
+def broadcast_axes(data, axis=(), size=()):
+    """Registered alias of broadcast_axis (the reference registers both
+    spellings; broadcast_reduce_op_value.cc)."""
+    return broadcast_axis(data, axis, size)
+
+
+@register()
+def argmax_channel(data):
+    """Reference: broadcast_reduce_op_index.cc argmax_channel — argmax
+    over axis 1, float output (the legacy prediction-decode helper)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
 @register(name="slice")
 def _slice(data, begin, end, step=None):
     idx = []

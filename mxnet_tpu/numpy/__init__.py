@@ -165,12 +165,9 @@ class ndarray(NDArray):
                     f"output parameter has wrong shape "
                     f"{tuple(out_buf.shape)}; expected "
                     f"{tuple(rdata.shape)}")
-            if not onp.can_cast(rdata.dtype, out_buf._data.dtype,
-                                "same_kind"):
-                raise TypeError(
-                    f"Cannot cast {func.__name__} output from "
-                    f"{rdata.dtype} to {out_buf._data.dtype} with "
-                    f"casting rule 'same_kind'")
+            # NB: no casting check — numpy reductions cast to out=
+            # unsafely (np.mean(floats, out=int_buf) truncates), and
+            # this path serves reductions, not ufuncs
             out_buf._data = jnp.asarray(rdata, out_buf._data.dtype)
             return out_buf
         mxfn = globals().get(func.__name__)

@@ -21,12 +21,24 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_sharded(path, tree):
+def _listify(t):
+    """orbax records tuples as lists in the checkpoint structure; trees
+    that ride alongside a restore must match that shape exactly."""
+    if isinstance(t, (list, tuple)):
+        return [_listify(v) for v in t]
+    if isinstance(t, dict):
+        return {k: _listify(v) for k, v in t.items()}
+    return t
+
+
+def save_sharded(path, tree, overwrite=True):
     """Write a pytree of (possibly sharded) jax arrays; each process
-    writes only its local shards."""
+    writes only its local shards. ``overwrite`` (default) replaces an
+    existing checkpoint at the path — the periodic save-to-fixed-path
+    loop the reference's do_checkpoint callback runs."""
     import os
 
-    _checkpointer().save(os.path.abspath(path), tree)
+    _checkpointer().save(os.path.abspath(path), tree, force=overwrite)
 
 
 def load_sharded(path, like=None, shardings=None):
@@ -46,7 +58,7 @@ def load_sharded(path, like=None, shardings=None):
     if shardings is None:
         return _checkpointer().restore(path)
     restore_args = jax.tree_util.tree_map(
-        lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+        lambda s: ocp.ArrayRestoreArgs(sharding=s), _listify(shardings))
     return _checkpointer().restore(path, restore_args=restore_args)
 
 
@@ -84,17 +96,8 @@ def load_trainer(path, trainer):
                    for st, s in zip(target["states"], pshard)],
         "aux": [rep for _ in target["aux"]],
     }
-    def listify(t):
-        # orbax records tuples as lists in the checkpoint structure;
-        # the restore_args tree must match that shape exactly
-        if isinstance(t, (list, tuple)):
-            return [listify(v) for v in t]
-        if isinstance(t, dict):
-            return {k: listify(v) for k, v in t.items()}
-        return t
-
     restore_args = jax.tree_util.tree_map(
-        lambda s: ocp.ArrayRestoreArgs(sharding=s), listify(shardings))
+        lambda s: ocp.ArrayRestoreArgs(sharding=s), _listify(shardings))
     state = _checkpointer().restore(os.path.abspath(path),
                                     restore_args=restore_args)
     trainer._param_vals = list(state["params"])

@@ -297,3 +297,32 @@ def test_oob_int_raises_get_and_set():
         a[3] = 1.0
     # slices/arrays keep jax clipping semantics (no false positives)
     assert a[2:99].shape == (1, 4)
+
+
+def test_fluent_method_surface_matches_reference():
+    """Reference NDArray exposes data-first ops as methods (fluent
+    autogen); the same spellings must work here."""
+    import numpy as onp
+
+    from mxnet_tpu import nd
+
+    a = nd.array(onp.random.RandomState(0).rand(3, 4).astype("f"))
+    assert a.sort().shape == (3, 4)
+    assert a.topk(k=2).shape == (3, 2)
+    assert a.argsort().shape == (3, 4)
+    assert a.tile(reps=(2, 1)).shape == (6, 4)
+    assert a.flip(axis=1).shape == (3, 4)
+    assert a.pick(nd.array(onp.zeros(3, "f"))).shape == (3,)
+    assert float(a.ones_like().asnumpy().sum()) == 12.0
+    assert float(a.zeros_like().asnumpy().sum()) == 0.0
+    assert a.argmax_channel().shape == (3,)
+    assert a.broadcast_axes(axis=0, size=3).shape == (3, 4)
+    assert a.nansum().shape == ()
+    assert a.shape_array().asnumpy().tolist() == [3, 4]
+    assert int(a.size_array().asnumpy()[0]) == 12
+    parts = a.split_v2(2, axis=1)
+    assert parts[0].shape == (3, 2)
+    assert a.slice(begin=(0, 1), end=(2, 3)).shape == (2, 2)
+    assert a.softmin().shape == (3, 4)
+    assert a.repeat(repeats=2, axis=0).shape == (6, 4)
+    assert a.to_dlpack_for_read() is not None

@@ -67,6 +67,21 @@ def test_sharded_save_restore_reshards(tmp_path):
     assert back["w"].sharding.mesh.shape["dp"] == 8
 
 
+def test_save_overwrite_and_tuple_trees(tmp_path):
+    mesh = parallel.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s = NamedSharding(mesh, P())
+    tree = {"pair": (jax.device_put(jnp.ones(2), s),
+                     jax.device_put(jnp.zeros(2), s))}
+    p = str(tmp_path / "fixed")
+    parallel.save_sharded(p, tree)
+    parallel.save_sharded(p, tree)  # periodic save to a fixed path
+    back = parallel.load_sharded(p, shardings={"pair": (s, s)})
+    onp.testing.assert_array_equal(onp.asarray(back["pair"][0]),
+                                   [1, 1])
+
+
 def test_load_sharded_like(tmp_path):
     mesh = parallel.make_mesh({"dp": 2}, devices=jax.devices()[:2])
     from jax.sharding import NamedSharding, PartitionSpec as P
