@@ -177,6 +177,77 @@ class Block:
         self._forward_pre_hooks.append(hook)
         return HookHandle(self._forward_pre_hooks, hook)
 
+    def register_op_hook(self, callback, monitor_all=False):
+        """Tap every descendant block's outputs during forward
+        (reference: block.py register_op_hook over CachedOp monitor
+        callbacks). ``callback(name, array)``; with ``monitor_all``
+        inputs are reported too. While any hook is attached, hybridized
+        execution runs eagerly (the reference's monitor-mode slowdown)
+        so taps fire with concrete arrays on EVERY call — on the whole
+        subtree, including independently hybridized descendants. Returns
+        a handle whose ``detach()`` removes this hook; the tap layer per
+        block is shared, so handles detach safely in any order."""
+        entry = (callback, bool(monitor_all))
+        touched = []
+
+        def install(blk, prefix):
+            for cname, child in blk._children.items():
+                name = getattr(child, "name", None) or cname
+                install(child, prefix + name + ".")
+            label = prefix.rstrip(".") or (getattr(blk, "name", "") or
+                                           type(blk).__name__)
+            cbs = getattr(blk, "_op_hook_cbs", None)
+            if cbs is None:
+                cbs = blk._op_hook_cbs = []
+                orig = blk.forward
+
+                def tap(*args, _orig=orig, _label=label, _blk=blk, **kw):
+                    hooks = list(_blk._op_hook_cbs)
+                    for cb, mon_all in hooks:
+                        if mon_all:
+                            for i, a in enumerate(args):
+                                if hasattr(a, "data"):
+                                    cb(f"{_label}_data{i}", a)
+                    out = _orig(*args, **kw)
+                    outs = out if isinstance(out, (list, tuple)) \
+                        else [out]
+                    for cb, _mon_all in hooks:
+                        for i, o in enumerate(outs):
+                            if hasattr(o, "data"):
+                                suffix = "_output" if len(outs) == 1 \
+                                    else f"_output{i}"
+                                cb(f"{_label}{suffix}", o)
+                    return out
+
+                blk._op_hook_fwd = (tap, orig)
+                blk.forward = tap
+            cbs.append(entry)
+            # eager-path flag on EVERY block so nested hybridized
+            # children also bypass their caches while tapped
+            blk._op_hooks_active = getattr(blk, "_op_hooks_active",
+                                           0) + 1
+            touched.append(blk)
+
+        install(self, "")
+
+        class _OpHookHandle:
+            def detach(self_inner):
+                for blk in touched:
+                    cbs = getattr(blk, "_op_hook_cbs", None)
+                    if cbs is not None and entry in cbs:
+                        cbs.remove(entry)
+                        blk._op_hooks_active = max(
+                            0, getattr(blk, "_op_hooks_active", 1) - 1)
+                        if not cbs:
+                            tap, orig = blk._op_hook_fwd
+                            if blk.forward is tap:
+                                blk.forward = orig
+                            del blk._op_hook_fwd
+                            blk._op_hook_cbs = None
+                touched.clear()
+
+        return _OpHookHandle()
+
     def apply(self, fn):
         for child in self._children.values():
             child.apply(fn)
@@ -447,7 +518,10 @@ class HybridBlock(Block):
         self._cached_op = None
 
     def __call__(self, *args, **kwargs):
-        if self._active and not kwargs:
+        # op hooks force the eager path so taps fire on EVERY call, not
+        # just the trace (the reference's monitor-mode slowdown)
+        if self._active and not kwargs \
+                and not getattr(self, "_op_hooks_active", 0):
             if all(isinstance(a, NDArray) for a in args):
                 if self._cached_op is None:
                     self._build_cache()

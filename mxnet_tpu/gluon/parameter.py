@@ -177,6 +177,23 @@ class Parameter:
             g = self._ndarray._grad
             g._data = nd.zeros(g.shape, dtype=g.data.dtype).data
 
+    def reset_ctx(self, ctx):
+        """Move the parameter's buffer (and grad) to another context
+        (reference: parameter.py reset_ctx — raises for uninitialized
+        parameters rather than silently placing them elsewhere later)."""
+        import jax
+
+        dev = getattr(ctx, "jax_device", ctx)
+        if self._ndarray is None:
+            raise ValueError(
+                f"Cannot reset context for Parameter '{self.name}' "
+                f"because it has not been initialized (deferred init "
+                f"finishes on the first forward)")
+        self._ndarray._data = jax.device_put(self._ndarray._data, dev)
+        if self._ndarray._grad is not None:
+            g = self._ndarray._grad
+            g._data = jax.device_put(g._data, dev)
+
     def set_data(self, data):
         self.shape = data.shape
         if self._ndarray is None:
@@ -314,6 +331,11 @@ class ParameterDict:
     def zero_grad(self):
         for v in self.values():
             v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        """Reference: parameter.py ParameterDict.reset_ctx."""
+        for v in self.values():
+            v.reset_ctx(ctx)
 
     def setattr(self, name, value):
         for v in self.values():

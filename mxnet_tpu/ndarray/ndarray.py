@@ -389,15 +389,10 @@ class NDArray:
         return sparse.cast_storage(self, stype)
 
 
-# unary op methods generated from the registry (mxnet NDArray method parity)
-def _install_unary_methods():
-    for name in ("abs", "exp", "expm1", "log", "log1p", "log10", "log2",
-                 "sqrt", "rsqrt", "square", "cbrt", "rcbrt", "reciprocal",
-                 "sign", "round", "rint", "ceil", "floor", "trunc", "fix",
-                 "relu", "sigmoid", "tanh", "softmax", "log_softmax", "sin",
-                 "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
-                 "arcsinh", "arccosh", "arctanh", "degrees", "radians",
-                 "erf", "erfinv", "gamma", "gammaln"):
+# op methods generated from the registry (reference: ndarray.py's
+# fluent-method autogen over _NDARRAY_UNARY/..._FUNCS)
+def _install_methods(names):
+    for name in names:
         if hasattr(NDArray, name):
             continue
 
@@ -408,28 +403,23 @@ def _install_unary_methods():
         setattr(NDArray, name, method)
 
 
-_install_unary_methods()
+_install_methods((
+    "abs", "exp", "expm1", "log", "log1p", "log10", "log2",
+    "sqrt", "rsqrt", "square", "cbrt", "rcbrt", "reciprocal",
+    "sign", "round", "rint", "ceil", "floor", "trunc", "fix",
+    "relu", "sigmoid", "tanh", "softmax", "log_softmax", "sin",
+    "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+    "erf", "erfinv", "gamma", "gammaln",
+    # data-first (fluent) ops
+    "argmax_channel", "argsort", "broadcast_axes", "depth_to_space",
+    "diag", "flip", "nanprod", "nansum", "pad", "pick", "repeat",
+    "shape_array", "size_array", "slice", "slice_like", "softmin",
+    "sort", "space_to_depth", "split", "split_v2", "tile", "topk",
+    "ones_like", "zeros_like"))
 
 
-def _install_fluent_methods():
-    """Reference NDArray exposes most data-first ops as methods too
-    (ndarray.py's fluent-method autogen over _NDARRAY_UNARY/..._FUNCS);
-    same here, straight off the registry."""
-    for name in ("argmax_channel", "argsort", "broadcast_axes",
-                 "depth_to_space", "diag", "flip", "nanprod", "nansum",
-                 "pad", "pick", "repeat", "shape_array", "size_array",
-                 "slice", "slice_like", "softmin", "sort",
-                 "space_to_depth", "split", "split_v2", "tile", "topk",
-                 "ones_like", "zeros_like"):
-        if hasattr(NDArray, name):
-            continue
-
-        def method(self, *args, _name=name, **kwargs):
-            return _invoke1(_name, self, *args, **kwargs)
-
-        method.__name__ = name
-        setattr(NDArray, name, method)
-
+def _install_dlpack_methods():
     def _to_dlpack_read(self):
         return to_dlpack_for_read(self)
 
@@ -440,7 +430,7 @@ def _install_fluent_methods():
     NDArray.to_dlpack_for_write = _to_dlpack_write
 
 
-_install_fluent_methods()
+_install_dlpack_methods()
 
 
 # small helper so methods can dispatch without importing the populated module

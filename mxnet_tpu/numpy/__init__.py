@@ -165,9 +165,18 @@ class ndarray(NDArray):
                     f"output parameter has wrong shape "
                     f"{tuple(out_buf.shape)}; expected "
                     f"{tuple(rdata.shape)}")
-            # NB: no casting check — numpy reductions cast to out=
-            # unsafely (np.mean(floats, out=int_buf) truncates), and
-            # this path serves reductions, not ufuncs
+            # reductions cast to out= unsafely (np.mean(floats,
+            # out=int_buf) truncates); everything else enforces numpy's
+            # same_kind rule
+            _UNSAFE_OUT = ("mean", "sum", "prod", "std", "var",
+                           "nanmean", "nansum", "nanprod", "average")
+            if func.__name__ not in _UNSAFE_OUT and \
+                    not onp.can_cast(rdata.dtype, out_buf._data.dtype,
+                                     "same_kind"):
+                raise TypeError(
+                    f"Cannot cast {func.__name__} output from "
+                    f"{rdata.dtype} to {out_buf._data.dtype} with "
+                    f"casting rule 'same_kind'")
             out_buf._data = jnp.asarray(rdata, out_buf._data.dtype)
             return out_buf
         mxfn = globals().get(func.__name__)

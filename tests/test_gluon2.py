@@ -288,3 +288,86 @@ def test_parameter_sharing_nested_prefixes(tmp_path):
     net3 = Net(prefix="net3_", in_units=5)
     net3.load_parameters(p)
     assert_almost_equal(net3(x), net1(x).asnumpy())
+
+
+def test_register_op_hook_taps_and_detaches():
+    """Reference: block.py register_op_hook — per-op output taps in
+    eager AND hybridized execution, detachable."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    seen = []
+    handle = net.register_op_hook(lambda name, arr: seen.append(name))
+    x = nd.array(onp.ones((2, 3), "f"))
+    net(x)
+    assert any("dense" in s for s in seen), seen
+    assert any(s.endswith("_output") for s in seen)
+    n_eager = len(seen)
+    net.hybridize()
+    net(x)  # hooks force the eager path: taps fire...
+    assert len(seen) > n_eager
+    n1 = len(seen)
+    net(x)  # ...on EVERY call, not just the trace
+    assert len(seen) > n1
+    handle.detach()
+    before = len(seen)
+    net(x)  # cached path resumes, tap-free
+    net(x)
+    assert len(seen) == before  # taps removed
+
+
+def test_register_op_hook_nested_hybrid_and_order():
+    """Hooks see concrete values through independently hybridized
+    children on every call, and handles detach safely in any order."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+
+    inner = gluon.nn.HybridSequential()
+    inner.add(gluon.nn.Dense(4, activation="relu"))
+    outer = gluon.nn.HybridSequential()
+    outer.add(inner, gluon.nn.Dense(2))
+    outer.initialize(mx.init.Xavier())
+    inner.hybridize()  # child has its own cache
+    x = nd.array(onp.ones((2, 3), "f"))
+    outer(x)  # build caches
+    values = []
+    h1 = outer.register_op_hook(
+        lambda name, arr: values.append(float(arr.asnumpy().max())))
+    names2 = []
+    h2 = outer.register_op_hook(lambda name, arr: names2.append(name))
+    outer(x)
+    outer(x)  # concrete values BOTH calls (no tracer leak via caches)
+    assert len(values) >= 4 and all(
+        isinstance(v, float) for v in values)
+    n2 = len(names2)
+    # out-of-order detach: h1 first, h2 keeps firing
+    h1.detach()
+    nv = len(values)
+    outer(x)
+    assert len(values) == nv  # h1 gone
+    assert len(names2) > n2  # h2 alive
+    h2.detach()
+    n2 = len(names2)
+    outer(x)
+    assert len(names2) == n2  # fully detached, cache path restored
+
+
+def test_parameter_reset_ctx():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import context, nd, gluon
+
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize(mx.init.Xavier())
+    out_before = net(nd.array(onp.ones((1, 2), "f"))).asnumpy()
+    net.collect_params().reset_ctx(context.cpu(0))
+    out_after = net(nd.array(onp.ones((1, 2), "f"))).asnumpy()
+    onp.testing.assert_allclose(out_after, out_before, rtol=1e-6)
