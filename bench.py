@@ -372,12 +372,28 @@ def profile_main():
         for _ in range(int(os.environ.get("BENCH_PROFILE_STEPS", "5"))):
             lval = trainer.step(x, y)
         _ = jax.device_get(lval.data)
+    # fold the top self-time table straight into the artifact so one
+    # command yields the attack-the-sinks breakdown
+    top = []
+    try:
+        from mxnet_tpu.tools import trace_top
+
+        trace = trace_top.find_trace(outdir)
+        events = trace_top.device_op_events(trace_top.load_events(trace))
+        tot, cnt = trace_top.summarize(events)
+        grand = sum(tot.values()) or 1
+        top = [{"op": k, "self_ms": round(us / 1e3, 3),
+                "pct": round(100.0 * us / grand, 2), "count": cnt[k]}
+               for k, us in tot.most_common(12)]
+    except Exception as e:  # trace parse must not discard the capture
+        print(f"[bench] trace summary failed: {e}", file=sys.stderr)
     print(json.dumps({
         "metric": "profile_trace_written", "value": 1.0, "unit": "trace",
         "vs_baseline": 0.0,
         "extra": {"dir": os.path.abspath(outdir), "batch": batch,
                   "dtype": dtype,
-                  "device": jax.devices()[0].device_kind}}))
+                  "device": jax.devices()[0].device_kind,
+                  "top_self_time": top}}))
 
 
 def rawjax_main():

@@ -202,18 +202,29 @@ class Block:
                 orig = blk.forward
 
                 def tap(*args, _orig=orig, _label=label, _blk=blk, **kw):
+                    import jax as _jax
+
+                    def concrete(v):
+                        # a hook registered BELOW a hybridized ancestor
+                        # meets tracers during that ancestor's cache
+                        # trace — skip those calls (register on the
+                        # outermost block for every-call taps) rather
+                        # than crash value-reading callbacks
+                        return hasattr(v, "data") and not isinstance(
+                            v.data, _jax.core.Tracer)
+
                     hooks = list(_blk._op_hook_cbs)
                     for cb, mon_all in hooks:
                         if mon_all:
                             for i, a in enumerate(args):
-                                if hasattr(a, "data"):
+                                if concrete(a):
                                     cb(f"{_label}_data{i}", a)
                     out = _orig(*args, **kw)
                     outs = out if isinstance(out, (list, tuple)) \
                         else [out]
                     for cb, _mon_all in hooks:
                         for i, o in enumerate(outs):
-                            if hasattr(o, "data"):
+                            if concrete(o):
                                 suffix = "_output" if len(outs) == 1 \
                                     else f"_output{i}"
                                 cb(f"{_label}{suffix}", o)

@@ -1,0 +1,126 @@
+"""Gluon layer over the expert-parallel Switch-MoE FFN
+(parallel/moe.py). NEW capability vs the reference zoo — the Gluon
+face of SURVEY §5.7's scale features, alongside SyncBatchNorm.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+
+__all__ = ["SwitchMoE"]
+
+
+class SwitchMoE(HybridBlock):
+    """Mixture-of-experts FFN block: top-1 (Switch) routing, experts
+    sharded over the mesh's ``axis_name`` axis when a mesh is active
+    (``parallel.mesh_scope`` or an explicit ``mesh=``), single-device
+    math otherwise.
+
+    forward(x) -> (out, aux_loss): add ``aux_weight * aux_loss`` to the
+    training objective for load balancing; out excludes the residual
+    (callers add ``x + out`` — dropped-over-capacity tokens then pass
+    through untouched).
+
+    Eager calls on a mesh bridge single-device buffers to the mesh and
+    back each step (re-tracing the vjp) — fine for interactive use;
+    production training should run the layer inside one compiled step
+    (SPMDTrainer / jax.jit), where inputs are tracers and the bridge is
+    bypassed entirely.
+    """
+
+    def __init__(self, num_experts, hidden_size, in_units=0,
+                 capacity_factor=1.25, axis_name="ep", mesh=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._E = int(num_experts)
+        self._H = int(hidden_size)
+        self._cf = float(capacity_factor)
+        self._axis = axis_name
+        self._mesh = mesh
+        D = int(in_units)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(D, self._E),
+                allow_deferred_init=True)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(self._E, D, self._H),
+                allow_deferred_init=True)
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(self._E, self._H), init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(self._E, self._H, D),
+                allow_deferred_init=True)
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(self._E, D), init="zeros",
+                allow_deferred_init=True)
+
+    def infer_param_shapes(self, x, *args):
+        D = x.shape[-1]
+        self.gate_weight.shape = (D, self._E)
+        self.expert_w1.shape = (self._E, D, self._H)
+        self.expert_w2.shape = (self._E, self._H, D)
+        self.expert_b2.shape = (self._E, D)
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        import jax
+
+        from ....ndarray.registry import apply_pure
+        from ....parallel.mesh import current_mesh
+        from ....parallel.moe import moe_ffn, moe_specs
+
+        mesh = self._mesh or current_mesh()
+        axis, cf = self._axis, self._cf
+        args = [x, gate_weight, expert_w1, expert_b1, expert_w2,
+                expert_b2]
+        caller_dev = None
+        if mesh is not None and axis in mesh.axis_names \
+                and mesh.shape[axis] > 1 \
+                and getattr(x, "_data", None) is not None \
+                and not isinstance(x._data, jax.core.Tracer):
+            devs = getattr(x._data.sharding, "device_set", None)
+            if devs and len(devs) == 1:
+                caller_dev = next(iter(devs))
+
+        def pure(xv, gw, w1, b1, w2, b2):
+            return moe_ffn(xv, gw, w1, b1, w2, b2, mesh=mesh,
+                           axis_name=axis, capacity_factor=cf)
+
+        if caller_dev is None:
+            out, aux = apply_pure(pure, args)
+            return out, aux
+        # eager on a mesh: record the tape node ourselves with placement
+        # shims — cotangents arrive committed to the caller's device and
+        # must ride the mesh through the vjp; gradients come back to the
+        # caller's device for the (single-device) optimizer update
+        from ....ndarray import NDArray
+        from .... import autograd
+        from jax.sharding import NamedSharding
+
+        bspec, espec, rep = moe_specs(mesh, axis)
+        specs = [bspec, rep, espec, espec, espec, espec]
+        # mesh-committed COPIES feed the computation; the caller's
+        # buffers stay on their device (mutating them would poison
+        # downstream eager math with mixed commitments)
+        datas = [jax.device_put(a.data, NamedSharding(mesh, s))
+                 for a, s in zip(args, specs)]
+        if not autograd.is_recording():
+            out_d, aux_d = pure(*datas)  # no vjp residuals at inference
+            return (NDArray(jax.device_put(out_d, caller_dev)),
+                    NDArray(jax.device_put(aux_d, caller_dev)))
+        (out_d, aux_d), vjp_fn = jax.vjp(pure, *datas)
+
+        def placed_vjp(cots, _vjp=vjp_fn):
+            co, ca = cots
+            co = jax.device_put(co, NamedSharding(mesh, bspec))
+            ca = jax.device_put(ca, NamedSharding(mesh, rep))
+            grads = _vjp((co, ca))
+            return [jax.device_put(g, caller_dev) for g in grads]
+
+        out = NDArray(jax.device_put(out_d, caller_dev))
+        aux = NDArray(jax.device_put(aux_d, caller_dev))
+        autograd._record_op(placed_vjp, list(args), [out, aux])
+        return out, aux
+
+    def __repr__(self):
+        return (f"SwitchMoE(experts={self._E}, hidden={self._H}, "
+                f"axis='{self._axis}')")

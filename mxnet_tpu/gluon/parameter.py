@@ -216,9 +216,6 @@ class Parameter:
             if had_grad and self._grad_req != "null":
                 self._attach_grad()
 
-    def reset_ctx(self, ctx):
-        pass  # single logical copy on TPU
-
     def var(self):
         from .. import symbol
 

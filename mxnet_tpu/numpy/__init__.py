@@ -154,30 +154,17 @@ class ndarray(NDArray):
         out_buf = kwargs.get("out")
         if isinstance(out_buf, NDArray):
             # numpy's out= contract is in-place fill; XLA buffers are
-            # immutable, so compute then rebind the handle's payload —
-            # with numpy's own shape/casting validation first
-            kwargs = {k: v for k, v in kwargs.items() if k != "out"}
-            result = self.__array_function__(func, types, args, kwargs)
-            rdata = result.data if isinstance(result, NDArray) \
-                else jnp.asarray(result)
-            if tuple(rdata.shape) != tuple(out_buf.shape):
-                raise ValueError(
-                    f"output parameter has wrong shape "
-                    f"{tuple(out_buf.shape)}; expected "
-                    f"{tuple(rdata.shape)}")
-            # reductions cast to out= unsafely (np.mean(floats,
-            # out=int_buf) truncates); everything else enforces numpy's
-            # same_kind rule
-            _UNSAFE_OUT = ("mean", "sum", "prod", "std", "var",
-                           "nanmean", "nansum", "nanprod", "average")
-            if func.__name__ not in _UNSAFE_OUT and \
-                    not onp.can_cast(rdata.dtype, out_buf._data.dtype,
-                                     "same_kind"):
-                raise TypeError(
-                    f"Cannot cast {func.__name__} output from "
-                    f"{rdata.dtype} to {out_buf._data.dtype} with "
-                    f"casting rule 'same_kind'")
-            out_buf._data = jnp.asarray(rdata, out_buf._data.dtype)
+            # immutable, so run the call ON HOST with a host out buffer
+            # — numpy itself applies the per-function shape and casting
+            # rules (unsafe for reductions, same_kind for concatenate
+            # et al.) — then rebind the handle's payload
+            host_out = onp.empty(tuple(out_buf.shape),
+                                 onp.dtype(out_buf._data.dtype))
+            kwargs = dict(kwargs, out=host_out)
+            func(*self._tohost(args),
+                 **{k: (v if k == "out" else self._tohost(v))
+                    for k, v in kwargs.items()})
+            out_buf._data = jnp.asarray(host_out)
             return out_buf
         mxfn = globals().get(func.__name__)
         risky = self._kwargs_force_host(kwargs)

@@ -23,7 +23,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_ffn", "switch_router"]
+__all__ = ["moe_ffn", "switch_router", "moe_specs"]
+
+
+def moe_specs(mesh, axis_name="ep", batch_axes=None):
+    """(batch_spec, expert_spec, replicated_spec) for a MoE layout on
+    ``mesh`` — the same defaulting moe_ffn applies internally."""
+    if batch_axes is None:
+        batch_axes = tuple(a for a in ("dp", axis_name)
+                           if a in mesh.axis_names)
+    return P(batch_axes), P(axis_name), P()
 
 
 def switch_router(x, gate_w, n_experts, capacity):
@@ -102,9 +111,8 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh=None, axis_name="ep",
         out, aux = _moe_local(x.reshape(B * S, D), gate_w, w1, b1, w2,
                               b2, None, cap, act)
         return out.reshape(B, S, D), aux
-    if batch_axes is None:
-        batch_axes = tuple(a for a in ("dp", axis_name)
-                           if a in mesh.axis_names)
+    bspec, espec, rep = moe_specs(mesh, axis_name, batch_axes)
+    batch_axes = bspec[0]
     shards = 1
     for a in batch_axes:
         shards *= mesh.shape[a]
@@ -122,10 +130,19 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh=None, axis_name="ep",
                 aux = lax.pmean(aux, a)
         return out.reshape(xl.shape), aux
 
-    espec = P(axis_name)
-    rep = P()
+    def place(v, spec):
+        # eager callers hand arrays committed to one device; commit them
+        # to the mesh layout first (tracers inside jit pass through —
+        # GSPMD owns their placement)
+        if isinstance(v, jax.core.Tracer):
+            return v
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(batch_axes), rep, espec, espec, espec, espec),
-        out_specs=(P(batch_axes), rep))
-    return fn(x, gate_w, w1, b1, w2, b2)
+        in_specs=(bspec, rep, espec, espec, espec, espec),
+        out_specs=(bspec, rep))
+    return fn(place(x, bspec), place(gate_w, rep), place(w1, espec),
+              place(b1, espec), place(w2, espec), place(b2, espec))

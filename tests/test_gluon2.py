@@ -368,6 +368,19 @@ def test_parameter_reset_ctx():
     net = gluon.nn.Dense(3, in_units=2)
     net.initialize(mx.init.Xavier())
     out_before = net(nd.array(onp.ones((1, 2), "f"))).asnumpy()
-    net.collect_params().reset_ctx(context.cpu(0))
+    ctx = context.cpu(0)
+    net.collect_params().reset_ctx(ctx)
+    # the buffers really moved: committed to exactly the requested device
+    for _, p in net.collect_params().items():
+        devs = p.data().data.sharding.device_set
+        assert devs == {ctx.jax_device}, devs
     out_after = net(nd.array(onp.ones((1, 2), "f"))).asnumpy()
     onp.testing.assert_allclose(out_after, out_before, rtol=1e-6)
+    # uninitialized parameters refuse loudly instead of silently
+    # materializing on the wrong device later
+    lazy = gluon.nn.Dense(2)
+    lazy.initialize()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="not been initialized"):
+        lazy.collect_params().reset_ctx(ctx)

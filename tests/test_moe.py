@@ -116,3 +116,42 @@ def test_moe_trains_under_jit_on_mesh():
         l, params = step(params, x, y)
         first = first or float(l)
     assert float(l) < first, (first, float(l))
+
+
+def test_gluon_switch_moe_layer_trains(tmp_path):
+    """The Gluon face: SwitchMoE inside a HybridBlock trains under
+    gluon.Trainer on an expert-parallel mesh."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.contrib.nn import SwitchMoE
+
+    mesh = parallel.make_mesh({"dp": 2, "ep": 4})
+    mx.random.seed(0)
+    moe = SwitchMoE(num_experts=8, hidden_size=16, capacity_factor=2.0,
+                    mesh=mesh)
+    moe.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(8, 4, 8).astype("f"))
+    y = nd.array((rng.rand(8, 4, 8) * 0.5).astype("f"))
+    out, aux = moe(x)
+    assert out.shape == x.shape and aux.shape == ()
+    tr = gluon.Trainer(moe.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    first = None
+    for _ in range(12):
+        with autograd.record():
+            o, aux = moe(x)
+            loss = nd.mean((x + o - y) ** 2) + 0.01 * aux
+        loss.backward()
+        tr.step(8)
+        first = first or float(loss.asscalar())
+    assert float(loss.asscalar()) < first, (first, float(loss.asscalar()))
+    # params round-trip like any gluon block
+    f = str(tmp_path / "moe.params")
+    moe.save_parameters(f)
+    moe2 = SwitchMoE(num_experts=8, hidden_size=16, in_units=8,
+                     capacity_factor=2.0, mesh=mesh)
+    moe2.load_parameters(f)
+    o2, _ = moe2(x)
+    with_np = onp.asarray(o2.asnumpy())
+    assert onp.isfinite(with_np).all()
