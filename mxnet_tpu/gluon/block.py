@@ -202,7 +202,7 @@ class Block:
                 orig = blk.forward
 
                 def tap(*args, _orig=orig, _label=label, _blk=blk, **kw):
-                    import jax as _jax
+                    from ..ndarray.ndarray import _is_tracer
 
                     def concrete(v):
                         # a hook registered BELOW a hybridized ancestor
@@ -210,8 +210,8 @@ class Block:
                         # trace — skip those calls (register on the
                         # outermost block for every-call taps) rather
                         # than crash value-reading callbacks
-                        return hasattr(v, "data") and not isinstance(
-                            v.data, _jax.core.Tracer)
+                        return hasattr(v, "data") and not _is_tracer(
+                            v.data)
 
                     hooks = list(_blk._op_hook_cbs)
                     for cb, mon_all in hooks:

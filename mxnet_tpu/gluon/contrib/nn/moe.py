@@ -72,11 +72,13 @@ class SwitchMoE(HybridBlock):
         axis, cf = self._axis, self._cf
         args = [x, gate_weight, expert_w1, expert_b1, expert_w2,
                 expert_b2]
+        from ....ndarray.ndarray import _is_tracer
+
         caller_dev = None
         if mesh is not None and axis in mesh.axis_names \
                 and mesh.shape[axis] > 1 \
                 and getattr(x, "_data", None) is not None \
-                and not isinstance(x._data, jax.core.Tracer):
+                and not _is_tracer(x._data):
             devs = getattr(x._data.sharding, "device_set", None)
             if devs and len(devs) == 1:
                 caller_dev = next(iter(devs))

@@ -260,3 +260,32 @@ from .ops_dgl import (  # noqa: E402,F401
     csr_neighbor_uniform_sample as dgl_csr_neighbor_uniform_sample,
     csr_neighbor_non_uniform_sample as
     dgl_csr_neighbor_non_uniform_sample)
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSRNDArray (reference: contrib/nnz.cc
+    _contrib_getnnz — axis None: total; axis 1: per-row; axis 0
+    unsupported there too). Dense inputs count non-zeros."""
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+    from .sparse import BaseSparseNDArray, CSRNDArray
+
+    if isinstance(data, CSRNDArray):
+        if axis is None:
+            # int32 like the CSR index arrays (int64 would silently
+            # truncate under the default x64-off jax config anyway)
+            return NDArray(jnp.asarray([data.nnz], jnp.int32))
+        if axis == 1:
+            ptr = data.indptr.data
+            return NDArray((ptr[1:] - ptr[:-1]).astype(jnp.int32))
+        raise NotImplementedError(
+            "getnnz with axis=0 is not supported (reference nnz.cc:124)")
+    if isinstance(data, BaseSparseNDArray):
+        raise TypeError(
+            "getnnz supports csr storage (reference nnz.cc), got "
+            f"stype '{data.stype}'")
+    x = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+    if axis is None:
+        return NDArray(jnp.sum(x != 0).reshape(1).astype(jnp.int32))
+    return NDArray(jnp.sum(x != 0, axis=axis).astype(jnp.int32))

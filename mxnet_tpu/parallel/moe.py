@@ -134,7 +134,9 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh=None, axis_name="ep",
         # eager callers hand arrays committed to one device; commit them
         # to the mesh layout first (tracers inside jit pass through —
         # GSPMD owns their placement)
-        if isinstance(v, jax.core.Tracer):
+        from ..ndarray.ndarray import _is_tracer
+
+        if _is_tracer(v):
             return v
         from jax.sharding import NamedSharding
 

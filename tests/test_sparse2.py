@@ -178,3 +178,36 @@ def test_kvstore_row_sparse_pull():
     # pulled rows match; implementation returns row-gathered values
     got = out.asnumpy()
     assert_almost_equal(got[0], w[0])
+
+
+def test_contrib_getnnz():
+    """Reference: contrib/nnz.cc _contrib_getnnz over CSR."""
+    import numpy as onp
+
+    import pytest
+
+    from mxnet_tpu import nd
+
+    dense = onp.array([[1.0, 0, 2], [0, 0, 0], [3, 4, 0]], "f")
+    csr = nd.array(dense).tostype("csr")
+    total = nd.contrib.getnnz(csr)
+    assert int(total.asnumpy()[0]) == 4
+    per_row = nd.contrib.getnnz(csr, axis=1)
+    assert per_row.asnumpy().tolist() == [2, 0, 2]
+    with pytest.raises(NotImplementedError):
+        nd.contrib.getnnz(csr, axis=0)
+    # dense fallback counts non-zeros
+    assert int(nd.contrib.getnnz(nd.array(dense)).asnumpy()[0]) == 4
+
+
+def test_getnnz_rejects_row_sparse():
+    import numpy as onp
+
+    import pytest
+
+    from mxnet_tpu import nd
+
+    rsp = sp.row_sparse_array(
+        (onp.ones((2, 3), "f"), onp.array([0, 2])), shape=(4, 3))
+    with pytest.raises(TypeError, match="csr"):
+        nd.contrib.getnnz(rsp)
