@@ -1,0 +1,94 @@
+"""Toy GAN: generator vs discriminator on a 2-D Gaussian ring
+(reference: example/gluon/dcgan.py's training pattern — two Trainers,
+detached generator samples for the D step, adversarial losses — at
+smoke scale).
+
+  python examples/train_gan_toy.py --steps 200
+  python examples/train_gan_toy.py --cpu   # skip the TPU tunnel
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def real_batch(rng, n):
+    import numpy as onp
+
+    theta = rng.rand(n) * 2 * onp.pi
+    pts = onp.stack([2.0 * onp.cos(theta), 2.0 * onp.sin(theta)], 1)
+    return (pts + rng.randn(n, 2) * 0.05).astype("f")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--latent", type=int, default=8)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the host-CPU platform (use when the TPU "
+                        "tunnel is absent or unhealthy — the env-var "
+                        "escape only works if set before python starts)")
+    args = p.parse_args()
+
+    if args.cpu:
+        from _cpu_platform import force_cpu_platform
+
+        force_cpu_platform()
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    mx.random.seed(0)
+    G = gluon.nn.HybridSequential()
+    G.add(gluon.nn.Dense(32, activation="relu"),
+          gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    D = gluon.nn.HybridSequential()
+    D.add(gluon.nn.Dense(32, activation="relu"),
+          gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(1))
+    for net in (G, D):
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    gt = gluon.Trainer(G.collect_params(), "adam",
+                       {"learning_rate": 2e-3, "beta1": 0.5})
+    dt = gluon.Trainer(D.collect_params(), "adam",
+                       {"learning_rate": 2e-3, "beta1": 0.5})
+    rng = onp.random.RandomState(0)
+    ones = nd.ones((args.batch,))
+    zeros = nd.zeros((args.batch,))
+    dl = gl = None
+    for step in range(args.steps):
+        z = nd.array(rng.randn(args.batch, args.latent).astype("f"))
+        real = nd.array(real_batch(rng, args.batch))
+        # D step: real -> 1, detached fake -> 0
+        with autograd.record():
+            fake = G(z).detach()
+            dl = (loss_fn(D(real), ones) + loss_fn(D(fake), zeros)).mean()
+        dl.backward()
+        dt.step(args.batch)
+        # G step: fool D
+        with autograd.record():
+            gl = loss_fn(D(G(z)), ones).mean()
+        gl.backward()
+        gt.step(args.batch)
+        if step % 50 == 0:
+            print(f"step {step:4d}  d_loss={float(dl.asscalar()):.3f}  "
+                  f"g_loss={float(gl.asscalar()):.3f}")
+    # generated points should land near the radius-2 ring
+    z = nd.array(rng.randn(512, args.latent).astype("f"))
+    pts = G(z).asnumpy()
+    radii = onp.sqrt((pts ** 2).sum(1))
+    dtxt = f"{float(dl.asscalar()):.3f}" if dl is not None else "n/a"
+    print(f"final: mean radius {radii.mean():.3f} (target 2.0), "
+          f"d_loss={dtxt}")
+    return radii.mean()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,3 +17,20 @@ def test_train_imagenet_rec_example_runs():
         env=env, capture_output=True, text=True, timeout=540)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "pipeline" in out.stdout and "img/s" in out.stdout, out.stdout
+
+
+def test_train_gan_toy_example_converges():
+    """Adversarial two-Trainer pattern (reference example/gluon/dcgan):
+    the generator must move its mass from the origin toward the ring."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "examples",
+                      "train_gan_toy.py"), "--steps", "150"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import re
+
+    m = re.search(r"mean radius ([0-9.]+)", out.stdout)
+    assert m, out.stdout
+    assert 0.8 < float(m.group(1)) < 3.5, out.stdout
