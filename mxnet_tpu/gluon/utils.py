@@ -3,4 +3,23 @@ from ..utils import (split_data, split_and_load, clip_global_norm, check_sha1,
                      download)
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "download"]
+           "download", "shape_is_known"]
+
+
+def shape_is_known(shape):
+    """Whether every dimension of `shape` is concrete (reference:
+    gluon/utils.py shape_is_known — unknown is -1 under np semantics,
+    0 under classic semantics)."""
+    from ..util import is_np_shape
+
+    if shape is None:
+        return False
+    unknown = -1 if is_np_shape() else 0
+    if len(shape) == 0:
+        return unknown == -1
+    for d in shape:
+        if d == unknown:
+            return False
+        assert d > unknown, \
+            f"invalid dim size {d} in shape {tuple(shape)}"
+    return True

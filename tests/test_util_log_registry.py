@@ -162,3 +162,21 @@ def test_device_memory_info_surfaces():
     else:
         with pytest.raises(ValueError):
             mx.context.gpu_memory_info(0)
+
+
+def test_gluon_shape_is_known():
+    # reference: gluon/utils.py shape_is_known under both semantics
+    from mxnet_tpu.gluon.utils import shape_is_known
+    from mxnet_tpu.util import np_shape
+
+    assert shape_is_known((2, 3))
+    assert not shape_is_known((2, 0))
+    assert not shape_is_known(None)
+    assert not shape_is_known(())
+    with np_shape(True):
+        assert shape_is_known(())
+        assert shape_is_known((2, 0))  # zero-size is legal np shape
+        assert not shape_is_known((2, -1))
+    # invalid negative dims raise like the reference, never "known"
+    with pytest.raises(AssertionError):
+        shape_is_known((2, -1))  # classic semantics: -1 is invalid
