@@ -8,4 +8,6 @@ from .lr_scheduler import LRScheduler
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum", "SignSGD",
            "FTML", "LAMB", "Updater", "get_updater", "register", "create",
-           "lr_scheduler", "LRScheduler"]
+           "lr_scheduler", "LRScheduler", "GroupAdaGrad", "contrib"]
+from . import contrib  # noqa: F401
+from .contrib import GroupAdaGrad  # noqa: F401

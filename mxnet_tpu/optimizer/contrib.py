@@ -1,0 +1,62 @@
+"""Contrib optimizers (reference: python/mxnet/optimizer/contrib.py)."""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from .optimizer import Optimizer, register
+
+__all__ = ["GroupAdaGrad"]
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with one learning-rate history PER ROW (reference:
+    optimizer/contrib.py GroupAdaGrad over group_adagrad_update):
+
+        history += mean(grad^2, axis=1, keepdims=True)
+        weight -= lr * grad / sqrt(history + eps)
+
+    Weight decay is not supported (matching the reference's assert).
+    Sparse (row_sparse) gradients update only their touched rows'
+    histories — the lazy-update semantics embedding tables rely on.
+    """
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        assert len(weight.shape) == 2, \
+            "GroupAdaGrad expects 2-D weights (rows share one rate)"
+        return nd.zeros((weight.shape[0], 1), dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        assert self._get_wd(index) == 0, \
+            "Weight decay is not supported for GroupAdaGrad"
+        history = state
+        if isinstance(grad, RowSparseNDArray):
+            import jax.numpy as jnp
+
+            rows = grad.indices.data.astype(jnp.int32)
+            vals = grad.data.data * self.rescale_grad
+            if self.clip_gradient is not None:
+                vals = jnp.clip(vals, -self.clip_gradient,
+                                self.clip_gradient)
+            hist = history.data
+            hist = hist.at[rows].add(
+                jnp.mean(jnp.square(vals), axis=1, keepdims=True))
+            history._data = hist
+            div = vals / jnp.sqrt(hist[rows] + self.float_stable_eps)
+            weight._data = weight.data.at[rows].add(-lr * div)
+            return
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        history._data = (history
+                         + nd.mean(grad * grad, axis=1,
+                                   keepdims=True)).data
+        div = grad / ((history + self.float_stable_eps) ** 0.5)
+        weight._data = (weight - lr * div).data

@@ -182,3 +182,63 @@ def test_svrg_variance_reduction_changes_grads():
         for n in plain)
     # snapshot == current params and full-grad != batch-grad => corrected
     assert changed
+
+
+def test_group_adagrad_row_wise_history():
+    """Reference: optimizer/contrib.py GroupAdaGrad — one history cell
+    per ROW; dense and row_sparse paths agree on touched rows."""
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("groupadagrad", learning_rate=0.1, eps=1e-5)
+    rng = onp.random.RandomState(0)
+    w = rng.randn(4, 3).astype("f")
+    g = rng.randn(4, 3).astype("f")
+    wn = nd.array(w.copy())
+    state = o.create_state(0, wn)
+    assert state.shape == (4, 1)
+    o.update(0, wn, nd.array(g.copy()), state)
+    hist = onp.mean(g ** 2, axis=1, keepdims=True)
+    want = w - 0.1 * g / onp.sqrt(hist + 1e-5)
+    onp.testing.assert_allclose(wn.asnumpy(), want, rtol=1e-5)
+    onp.testing.assert_allclose(state.asnumpy(), hist, rtol=1e-5)
+    # second update accumulates
+    o.update(0, wn, nd.array(g.copy()), state)
+    onp.testing.assert_allclose(state.asnumpy(), 2 * hist, rtol=1e-5)
+    # wd is rejected like the reference
+    bad = opt.create("groupadagrad", learning_rate=0.1, wd=0.1)
+    import pytest
+
+    with pytest.raises(AssertionError, match="not supported"):
+        bad.update(0, nd.array(w.copy()), nd.array(g.copy()),
+                   bad.create_state(0, nd.array(w.copy())))
+
+
+def test_group_adagrad_sparse_rows_only():
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray import sparse as sp
+
+    o = opt.create("groupadagrad", learning_rate=0.5)
+    w0 = onp.ones((5, 2), "f")
+    wn = nd.array(w0.copy())
+    state = o.create_state(0, wn)
+    vals = onp.array([[1.0, 1.0], [2.0, 2.0]], "f")
+    g = sp.row_sparse_array((vals, onp.array([1, 3])), shape=(5, 2))
+    o.update(0, wn, g, state)
+    got = wn.asnumpy()
+    st = state.asnumpy()
+    # untouched rows unchanged, histories zero
+    for r in (0, 2, 4):
+        onp.testing.assert_allclose(got[r], w0[r])
+        assert st[r, 0] == 0.0
+    # touched rows follow the dense formula
+    for r, v in ((1, 1.0), (3, 2.0)):
+        h = v * v
+        onp.testing.assert_allclose(st[r, 0], h, rtol=1e-6)
+        onp.testing.assert_allclose(
+            got[r], w0[r] - 0.5 * v / onp.sqrt(h + 1e-5), rtol=1e-5)
