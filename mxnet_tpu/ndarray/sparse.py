@@ -476,3 +476,17 @@ def adam_update_rsp(weight, grad_rsp, mean, var, lr, beta1, beta2, epsilon,
     w_rows = w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
     return (NDArray(w.at[idx].set(w_rows)), NDArray(m.at[idx].set(m_rows)),
             NDArray(v.at[idx].set(v_rows)))
+
+
+def group_adagrad_update_rsp(weight, grad_rsp, history, lr, epsilon=1e-5,
+                             rescale_grad=1.0, clip_gradient=None):
+    """Lazy sparse GroupAdaGrad (reference:
+    contrib/optimizer_op.cc GroupAdagradUpdateRspImpl): one history cell
+    per row, touched rows only. Returns (weight, history) dense."""
+    idx, vals = grad_rsp._indices, grad_rsp._data * rescale_grad
+    if clip_gradient is not None:
+        vals = jnp.clip(vals, -clip_gradient, clip_gradient)
+    w, h = weight.data, history.data
+    h = h.at[idx].add(jnp.mean(jnp.square(vals), axis=1, keepdims=True))
+    div = vals / jnp.sqrt(jnp.take(h, idx, axis=0) + epsilon)
+    return NDArray(w.at[idx].add(-lr * div)), NDArray(h)

@@ -38,19 +38,15 @@ class GroupAdaGrad(Optimizer):
             "Weight decay is not supported for GroupAdaGrad"
         history = state
         if isinstance(grad, RowSparseNDArray):
-            import jax.numpy as jnp
+            from ..ndarray.sparse import group_adagrad_update_rsp
 
-            rows = grad.indices.data.astype(jnp.int32)
-            vals = grad.data.data * self.rescale_grad
-            if self.clip_gradient is not None:
-                vals = jnp.clip(vals, -self.clip_gradient,
-                                self.clip_gradient)
-            hist = history.data
-            hist = hist.at[rows].add(
-                jnp.mean(jnp.square(vals), axis=1, keepdims=True))
-            history._data = hist
-            div = vals / jnp.sqrt(hist[rows] + self.float_stable_eps)
-            weight._data = weight.data.at[rows].add(-lr * div)
+            w2, h2 = group_adagrad_update_rsp(
+                weight, grad, history, lr,
+                epsilon=self.float_stable_eps,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data = w2.data
+            history._data = h2.data
             return
         grad = grad * self.rescale_grad
         if self.clip_gradient is not None:

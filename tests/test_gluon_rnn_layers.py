@@ -1,0 +1,119 @@
+"""gluon.rnn layer-level behavior (reference:
+tests/python/unittest/test_gluon_rnn.py — LSTM/GRU/RNN layers: shapes,
+states, bidirectional, layouts, layer-vs-cell equivalence, hybridize).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _x(T=5, N=3, C=4, seed=0, layout="TNC"):
+    rng = onp.random.RandomState(seed)
+    shape = (T, N, C) if layout == "TNC" else (N, T, C)
+    return nd.array(rng.rand(*shape).astype("f"))
+
+
+@pytest.mark.parametrize("ctor,nstate", [(rnn.RNN, 1), (rnn.GRU, 1),
+                                         (rnn.LSTM, 2)])
+def test_layer_output_and_state_shapes(ctor, nstate):
+    layer = ctor(hidden_size=6, num_layers=2)
+    layer.initialize(mx.init.Xavier())
+    x = _x()
+    out = layer(x)
+    assert out.shape == (5, 3, 6)
+    begin = layer.begin_state(batch_size=3)
+    assert len(begin) == nstate
+    out2, states = layer(x, begin)
+    assert out2.shape == (5, 3, 6)
+    assert len(states) == nstate
+    for s in states:
+        assert s.shape == (2, 3, 6)  # (layers, N, H)
+
+
+def test_bidirectional_doubles_features():
+    layer = rnn.LSTM(hidden_size=5, num_layers=1, bidirectional=True)
+    layer.initialize(mx.init.Xavier())
+    out = layer(_x())
+    assert out.shape == (5, 3, 10)
+    begin = layer.begin_state(batch_size=3)
+    _, states = layer(_x(), begin)
+    for s in states:
+        assert s.shape == (2, 3, 5)  # (layers*dirs, N, H)
+
+
+def test_ntc_layout_matches_tnc():
+    a = rnn.GRU(hidden_size=4, layout="TNC")
+    a.initialize(mx.init.Xavier())
+    b = rnn.GRU(hidden_size=4, layout="NTC")
+    b.initialize(mx.init.Xavier())
+    x_tnc = _x(seed=3)
+    out_a = a(x_tnc).asnumpy()  # materializes a's params
+    # identical parameters, different layout
+    x_ntc = nd.transpose(x_tnc, axes=(1, 0, 2))
+    b(x_ntc)  # finish deferred init
+    for n, p in b.collect_params().items():
+        key = n.split("_", 1)[-1] if "_" in n else n
+        src = [q for n2, q in a.collect_params().items()
+               if n2.endswith(key)]
+        p.set_data(src[0].data())
+    out_b = b(x_ntc).asnumpy()
+    assert_almost_equal(out_b.transpose(1, 0, 2), out_a,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_layer_matches_cell_unroll():
+    mx.random.seed(1)
+    layer = rnn.LSTM(hidden_size=4, num_layers=1)
+    layer.initialize(mx.init.Xavier())
+    x = _x(seed=4)
+    out = layer(x).asnumpy()
+    # unroll the equivalent cell with the LAYER's own parameters
+    cell = rnn.LSTMCell(4, input_size=4)
+    cell.initialize()
+    for name, p in cell.collect_params().items():
+        suffix = "_".join(name.split("_")[-2:])  # e.g. i2h_weight
+        src = [q for n2, q in layer.collect_params().items()
+               if n2.endswith(suffix)]
+        assert src, (name, list(layer.collect_params()))
+        p.set_data(src[0].data())
+    outputs, _ = cell.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(outputs.asnumpy(), out, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_trains_and_hybridizes():
+    layer = rnn.GRU(hidden_size=8, num_layers=2, dropout=0.1)
+    layer.initialize(mx.init.Xavier())
+    from mxnet_tpu import gluon
+
+    tr = gluon.Trainer(layer.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    x = _x(seed=5)
+    tgt = nd.ones((5, 3, 8)) * 0.1
+    first = None
+    for _ in range(8):
+        with autograd.record():
+            out = layer(x)
+            loss = nd.mean((out - tgt) ** 2)
+        loss.backward()
+        tr.step(3)
+        first = first or float(loss.asscalar())
+    assert float(loss.asscalar()) < first
+
+
+def test_unequal_length_masking_with_sequence_mask():
+    # variable-length batches: mask padded steps like the reference's
+    # use_sequence_length flows
+    layer = rnn.RNN(hidden_size=3)
+    layer.initialize(mx.init.Xavier())
+    x = _x(T=6, seed=6)
+    out = layer(x)
+    lens = nd.array(onp.array([6.0, 3.0, 1.0], "f"))
+    masked = nd.sequence_mask(out, sequence_length=lens,
+                              use_sequence_length=True)
+    m = masked.asnumpy()
+    assert (m[3:, 1] == 0).all() and (m[1:, 2] == 0).all()
+    assert (m[:, 0] != 0).any()
