@@ -4,22 +4,11 @@ launched via tools/launch.py (local mode) rendezvous through
 jax.distributed and assert exact aggregated values after concurrent
 push/pull (SURVEY §4: 'multi-process tests on one host with a
 mocked/loopback mesh')."""
-import os
-import subprocess
-import sys
-
 import numpy as onp
-import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from _dist_harness import run_launched_workers
 
-WORKER = r"""
-import os, sys
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path.insert(0, {repo!r})
-from mxnet_tpu.tools import launch
-assert launch.init(), "launcher env missing"
+BODY = r"""
 import numpy as onp
 import mxnet_tpu as mx
 from mxnet_tpu import nd, kv
@@ -39,17 +28,7 @@ with open(os.path.join({outdir!r}, "r" + str(rank) + ".txt"), "w") as f:
 
 
 def test_dist_sync_two_processes(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(WORKER.format(repo=REPO, outdir=str(tmp_path)))
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    proc = subprocess.run(
-        [sys.executable, "-m", "mxnet_tpu.tools.launch", "-n", "2",
-         "--launcher", "local", sys.executable, str(worker)],
-        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    run_launched_workers(tmp_path, BODY, n=2, timeout=240)
     for rank in (0, 1):
         p = tmp_path / f"r{rank}.txt"
         assert p.is_file(), f"worker {rank} produced no result"
