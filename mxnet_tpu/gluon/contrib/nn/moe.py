@@ -98,7 +98,7 @@ class SwitchMoE(HybridBlock):
         from .... import autograd
         from jax.sharding import NamedSharding
 
-        bspec, espec, rep = moe_specs(mesh, axis)
+        _axes, bspec, espec, rep = moe_specs(mesh, axis)
         specs = [bspec, rep, espec, espec, espec, espec]
         # mesh-committed COPIES feed the computation; the caller's
         # buffers stay on their device (mutating them would poison

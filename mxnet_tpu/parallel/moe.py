@@ -27,12 +27,14 @@ __all__ = ["moe_ffn", "switch_router", "moe_specs"]
 
 
 def moe_specs(mesh, axis_name="ep", batch_axes=None):
-    """(batch_spec, expert_spec, replicated_spec) for a MoE layout on
-    ``mesh`` — the same defaulting moe_ffn applies internally."""
+    """(batch_axes, batch_spec, expert_spec, replicated_spec) for a MoE
+    layout on ``mesh`` — the same defaulting moe_ffn applies
+    internally. batch_axes rides alongside because PartitionSpec
+    indexing collapses a 1-tuple of axes to its bare string."""
     if batch_axes is None:
         batch_axes = tuple(a for a in ("dp", axis_name)
                            if a in mesh.axis_names)
-    return P(batch_axes), P(axis_name), P()
+    return tuple(batch_axes), P(batch_axes), P(axis_name), P()
 
 
 def switch_router(x, gate_w, n_experts, capacity):
@@ -111,8 +113,8 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh=None, axis_name="ep",
         out, aux = _moe_local(x.reshape(B * S, D), gate_w, w1, b1, w2,
                               b2, None, cap, act)
         return out.reshape(B, S, D), aux
-    bspec, espec, rep = moe_specs(mesh, axis_name, batch_axes)
-    batch_axes = bspec[0]
+    batch_axes, bspec, espec, rep = moe_specs(mesh, axis_name,
+                                              batch_axes)
     shards = 1
     for a in batch_axes:
         shards *= mesh.shape[a]

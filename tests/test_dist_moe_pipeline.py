@@ -1,0 +1,80 @@
+"""Multi-PROCESS expert/pipeline parallelism: two OS processes form a
+global 2-device mesh and run one jitted MoE training step (the
+all-to-all dispatch/combine crossing the process boundary) and one
+pipelined forward (ppermute handoff across processes) — the multi-host
+face of parallel/moe.py and parallel/pipeline.py."""
+import pytest
+
+from _dist_harness import run_launched_workers
+
+BODY = r"""
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import mxnet_tpu
+from mxnet_tpu.parallel.moe import moe_ffn
+from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+rank = jax.process_index()
+devs = jax.devices()
+assert len(devs) == 2, devs
+mesh = Mesh(onp.array(devs), ("ep",))
+rng = onp.random.RandomState(0)
+E, D, H = 4, 8, 16
+params = (jnp.asarray(rng.randn(D, E).astype("f") * 0.5),
+          jnp.asarray(rng.randn(E, D, H).astype("f") * 0.2),
+          jnp.zeros((E, H), jnp.float32),
+          jnp.asarray(rng.randn(E, H, D).astype("f") * 0.2),
+          jnp.zeros((E, D), jnp.float32))
+x = jnp.asarray(rng.randn(8, 4, D).astype("f"))
+y = jnp.asarray(rng.randn(8, 4, D).astype("f"))
+
+@jax.jit
+def step(ps, xv, yv):
+    def loss_fn(p):
+        out, aux = moe_ffn(xv, *p, mesh=mesh, axis_name="ep",
+                           batch_axes=("ep",), capacity_factor=4.0)
+        return jnp.mean((xv + out - yv) ** 2) + 0.01 * aux
+
+    l, g = jax.value_and_grad(loss_fn)(ps)
+    return tuple(w - 0.1 * gi for w, gi in zip(ps, g)), l
+
+params, l1 = step(params, x, y)
+params, l2 = step(params, x, y)
+moe_ok = bool(jnp.isfinite(l1)) and float(l2) < float(l1)
+
+# pipeline over the same 2 processes ('pp' axis)
+mesh_pp = Mesh(onp.array(devs), ("pp",))
+sp = (jnp.asarray(rng.randn(2, D, D).astype("f") * 0.3),
+      jnp.asarray(rng.randn(2, D).astype("f") * 0.1))
+xp = jnp.asarray(rng.randn(8, D).astype("f"))
+
+def stage(p, act):
+    w, b = p
+    return jnp.tanh(act @ w + b)
+
+got = pipeline_apply(stage, sp, xp, mesh=mesh_pp, n_microbatches=4)
+act = onp.asarray(xp)
+for i in range(2):
+    act = onp.tanh(act @ onp.asarray(sp[0][i]) + onp.asarray(sp[1][i]))
+# the pipelined output is replicated (out_specs=P()): each process's
+# addressable copy must equal the full sequential stack
+vals = [onp.asarray(s.data) for s in got.addressable_shards]
+pp_ok = bool(vals) and all(
+    v.shape == act.shape and onp.allclose(v, act, rtol=2e-4, atol=2e-5)
+    for v in vals)
+
+with open(os.path.join({outdir!r}, "r" + str(rank) + ".txt"), "w") as f:
+    f.write("OK" if (moe_ok and pp_ok) else
+            "BAD moe=%s pp=%s" % (moe_ok, pp_ok))
+"""
+
+
+def test_two_process_moe_and_pipeline(tmp_path):
+    run_launched_workers(tmp_path, BODY, n=2)
+    for rank in (0, 1):
+        p = tmp_path / f"r{rank}.txt"
+        assert p.is_file(), f"worker {rank} produced no result"
+        assert p.read_text() == "OK", p.read_text()
