@@ -423,8 +423,11 @@ def array(object, dtype=None, ctx=None):
             dtype = onp.bool_
         else:
             # mx.np defaults to float32 for python scalars/sequences
-            # (reference: multiarray.py array(), default_dtype=float32)
-            dtype = _float32
+            # (reference: multiarray.py array(), default_dtype=float32) —
+            # except boolean sequences, which stay bool so they index as
+            # masks (reference: np boolean_mask / __setitem__ paths)
+            object = onp.asarray(object)
+            dtype = onp.bool_ if object.dtype == onp.bool_ else _float32
     return ndarray(_nd_mod._put(jnp.asarray(object, dtype=dtype), ctx))
 
 
@@ -820,6 +823,75 @@ def interp(x, xp, fp, left=None, right=None):
         asarray(x), asarray(xp), asarray(fp)))
 
 
+def append(arr, values, axis=None):
+    return _np(_call(lambda x, v: jnp.append(x, v, axis=axis),
+                     asarray(arr), asarray(values)))
+
+
+def polyval(p, x):
+    """reference: src/operator/numpy/np_polynomial_op.cc (npx.polyval)."""
+    return _np(_call(lambda pp, xx: jnp.polyval(pp, xx),
+                     asarray(p), asarray(x)))
+
+
+def select(condlist, choicelist, default=0):
+    conds = [asarray(c) for c in condlist]
+    choices = [asarray(c) for c in choicelist]
+    return _np(_call(
+        lambda *xs: jnp.select(list(xs[:len(conds)]), list(xs[len(conds):]),
+                               default),
+        *(conds + choices)))
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    trap = getattr(jnp, "trapezoid", None) or jnp.trapz
+    if x is None:
+        return _np(_call(lambda yy: trap(yy, dx=dx, axis=axis), asarray(y)))
+    return _np(_call(lambda yy, xx: trap(yy, xx, axis=axis),
+                     asarray(y), asarray(x)))
+
+
+def resize(a, new_shape):
+    return _np(_call(lambda x: jnp.resize(x, new_shape), asarray(a)))
+
+
+def piecewise(x, condlist, funclist, *args, **kw):
+    conds = [asarray(c) for c in condlist]
+    return _np(_call(
+        lambda xx, *cc: jnp.piecewise(xx, list(cc), funclist, *args, **kw),
+        asarray(x), *conds))
+
+
+def spacing(x):
+    x = asarray(x)
+    _eager_only("spacing", x)
+    return ndarray(jnp.asarray(onp.spacing(onp.asarray(x.data))))
+
+
+def divmod(x1, x2):  # noqa: A001 - numpy namespace shadows the builtin
+    return floor_divide(x1, x2), mod(x1, x2)
+
+
+def _window(onp_fn, M, dtype=_float32, ctx=None):
+    # Host-computed (tiny, eager creation op). reference:
+    # src/operator/numpy/np_window_op.cc (hanning/hamming/blackman).
+    w = onp_fn(int(M)).astype(_canon_dtype(dtype) or _float32) if M > 0 \
+        else onp.empty((0,), _canon_dtype(dtype) or _float32)
+    return ndarray(_nd_mod._put(jnp.asarray(w), ctx))
+
+
+def hanning(M, dtype=_float32, ctx=None):
+    return _window(onp.hanning, M, dtype, ctx)
+
+
+def hamming(M, dtype=_float32, ctx=None):
+    return _window(onp.hamming, M, dtype, ctx)
+
+
+def blackman(M, dtype=_float32, ctx=None):
+    return _window(onp.blackman, M, dtype, ctx)
+
+
 def diff(a, n=1, axis=-1):
     return _np(_call(lambda x: jnp.diff(x, n=n, axis=axis), asarray(a)))
 
@@ -975,7 +1047,11 @@ def fix(x):
 
 
 def may_share_memory(a, b):
-    return False  # functional runtime: every op produces a fresh buffer
+    # Functional runtime: every op produces a fresh buffer, so two arrays
+    # share storage only when they hold the very same handle (views alias
+    # through _alias_view, which shares _data).
+    return isinstance(a, NDArray) and isinstance(b, NDArray) and \
+        (a is b or a._data is b._data)
 
 
 shares_memory = may_share_memory

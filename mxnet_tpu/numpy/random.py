@@ -216,4 +216,41 @@ def binomial(n, p, size=None, ctx=None):
     return _wrap(jax.random.binomial(_gr.next_key(), n_, p_, _shape(size)))
 
 
+def geometric(p, size=None, ctx=None):
+    """Trials-to-first-success, support {1,2,...} (reference:
+    src/operator/numpy/random/np_geometric_op.* semantics via inverse CDF)."""
+    p_ = _p(p)
+    if not isinstance(p_, jax.Array):
+        if not 0.0 < onp.min(p_) or onp.max(p_) > 1.0:
+            raise ValueError("p must be in the interval (0, 1]")
+    u = jax.random.uniform(_gr.next_key(), _param_shape(size, p_), _f32,
+                           minval=jnp.finfo(_f32).tiny)
+    # clamp handles p=1 (log1p(-1) = -inf → ratio 0) to numpy's all-ones
+    return _wrap(jnp.maximum(
+        jnp.ceil(jnp.log(u) / jnp.log1p(-p_)), 1.0).astype(jnp.int32))
+
+
+def negative_binomial(n, p, size=None, ctx=None):
+    """Gamma-Poisson mixture: failures before the n-th success
+    (numpy semantics; reference np_negative_binomial_op)."""
+    n_, p_ = _p(n), _p(p)
+    shape = _param_shape(size, n_, p_)
+    lam = jax.random.gamma(_gr.next_key(), jnp.broadcast_to(
+        jnp.asarray(n_, _f32), shape), shape, _f32) * (1.0 - p_) / p_
+    return _wrap(jax.random.poisson(_gr.next_key(), lam, shape))
+
+
+def f(dfnum, dfden, size=None, ctx=None):
+    """F-distribution as a ratio of scaled chi-squares (numpy semantics)."""
+    d1, d2 = _p(dfnum), _p(dfden)
+    shape = _param_shape(size, d1, d2)
+    num = 2.0 * jax.random.gamma(_gr.next_key(),
+                                 jnp.broadcast_to(jnp.asarray(d1, _f32) / 2.0,
+                                                  shape), shape, _f32)
+    den = 2.0 * jax.random.gamma(_gr.next_key(),
+                                 jnp.broadcast_to(jnp.asarray(d2, _f32) / 2.0,
+                                                  shape), shape, _f32)
+    return _wrap((num / d1) / (den / d2))
+
+
 __all__ = [x for x in dir() if not x.startswith("_")]

@@ -120,7 +120,87 @@ _NPX_OPS = [
     "slice_axis", "slice_like", "shape_array", "reshape",
     "ctc_loss", "stop_gradient", "erf", "erfinv",
     "index_copy", "index_array", "boolean_mask", "upsampling", "gamma",
+    "batch_dot",
 ]
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """npx.reshape with its own special codes — distinct from nd.reshape's
+    (reference: src/operator/numpy/np_matrix_op.cc NumpyXInferShape):
+    -1 infer, -2 copy one dim, -3 skip a size-1 dim, -4 copy all remaining
+    dims, -5 merge two consecutive dims, -6 split a dim into the next two
+    target entries (either may be -1)."""
+    import jax.numpy as jnp
+
+    a = asarray(a)
+    src = list(a.shape)
+    if isinstance(newshape, int):
+        newshape = (newshape,)
+    tgt = list(newshape)
+    if reverse:
+        src, tgt = src[::-1], tgt[::-1]
+    out, si, unknown = [], 0, -1
+
+    def _src(idx):
+        if idx >= len(src):
+            raise MXNetError(
+                f"npx.reshape: target {tuple(newshape)} consumes more "
+                f"dims than source shape {a.shape} has")
+        return src[idx]
+
+    i = 0
+    while i < len(tgt):
+        d = tgt[i]
+        if d == -1:
+            if unknown >= 0:
+                raise MXNetError("One and only one dim can be inferred")
+            unknown = len(out)
+            out.append(-1)
+            si += 1
+        elif d == -2:
+            out.append(_src(si)); si += 1
+        elif d == -3:
+            if _src(si) != 1:
+                raise MXNetError(
+                    "-3 index should only be used to skip dimension size 1")
+            si += 1
+        elif d == -4:
+            out.extend(src[si:]); si = len(src)
+        elif d == -5:
+            out.append(_src(si) * _src(si + 1)); si += 2
+        elif d == -6:
+            if i + 2 >= len(tgt):
+                raise MXNetError(
+                    "-6 must be followed by two split dims")
+            d0, d1, d2 = _src(si), tgt[i + 1], tgt[i + 2]
+            if (d1 == -1 and d2 == -1) or d1 == 0 or d2 == 0:
+                raise MXNetError(
+                    f"invalid split dims ({d1}, {d2}) for -6")
+            if d1 == -1:
+                d1 = d0 // d2
+            if d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise MXNetError(
+                    f"Split dims {d1}, {d2} do not divide original dim {d0}")
+            out.extend([d1, d2]); si += 1; i += 2
+        else:
+            out.append(d); si += 1
+        i += 1
+    if unknown >= 0:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        out[unknown] = a.size // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    shape = tuple(out)
+    # route through the taped registry path so gradients flow like every
+    # other npx op (registry.invoke records the vjp edge)
+    from ..numpy import _call, _np
+
+    return _np(_call(lambda x: jnp.reshape(x, shape), a))
+
 
 _mod = sys.modules[__name__]
 for _name in _NPX_OPS:

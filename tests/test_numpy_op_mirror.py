@@ -1,0 +1,540 @@
+"""Mirror of the reference numpy-op checklist, one test per reference test
+(reference: tests/python/unittest/test_numpy_op.py — 68 test fns). Each test
+checks value parity against numpy on the same shapes the reference sweeps
+(condensed), plus gradients where the reference uses check_numeric_gradient.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import np, npx
+
+
+def close(a, b, rtol=1e-5, atol=1e-5):
+    onp.testing.assert_allclose(
+        a.asnumpy() if hasattr(a, "asnumpy") else a,
+        b.asnumpy() if hasattr(b, "asnumpy") else b, rtol=rtol, atol=atol)
+
+
+def _rand(*shape):
+    return onp.random.RandomState(0).uniform(-2, 2, shape).astype("f")
+
+
+# ---- creation / ranges ---------------------------------------------------
+
+def test_np_arange():
+    close(np.arange(10), onp.arange(10, dtype="f"))
+    close(np.arange(2, 10, 2), onp.arange(2, 10, 2, dtype="f"))
+    close(np.arange(0.5, 5.5, 0.5), onp.arange(0.5, 5.5, 0.5, dtype="f"))
+    a = np.arange(5, dtype="int32")
+    assert a.dtype == onp.int32
+
+
+def test_np_linspace():
+    close(np.linspace(0, 10, 21), onp.linspace(0, 10, 21).astype("f"))
+    v, step = np.linspace(0, 1, 5, retstep=True)
+    assert abs(step - 0.25) < 1e-6
+    close(np.linspace(0, 1, 5, endpoint=False),
+          onp.linspace(0, 1, 5, endpoint=False).astype("f"))
+
+
+def test_np_logspace():
+    close(np.logspace(0, 3, 4), onp.logspace(0, 3, 4).astype("f"), rtol=1e-4)
+    close(np.logspace(0, 2, 5, base=2.0),
+          onp.logspace(0, 2, 5, base=2.0).astype("f"), rtol=1e-4)
+
+
+def test_np_eye():
+    close(np.eye(4), onp.eye(4, dtype="f"))
+    close(np.eye(3, 5, k=1), onp.eye(3, 5, k=1, dtype="f"))
+    close(np.eye(3, 5, k=-1), onp.eye(3, 5, k=-1, dtype="f"))
+
+
+def test_np_indices():
+    got = np.indices((3, 4))
+    close(got, onp.indices((3, 4)))
+
+
+def test_np_meshgrid():
+    x, y = np.meshgrid(np.arange(3), np.arange(4))
+    ex, ey = onp.meshgrid(onp.arange(3, dtype="f"), onp.arange(4, dtype="f"))
+    close(x, ex)
+    close(y, ey)
+    xi, yi = np.meshgrid(np.arange(3), np.arange(4), indexing="ij")
+    exi, eyi = onp.meshgrid(onp.arange(3, dtype="f"),
+                            onp.arange(4, dtype="f"), indexing="ij")
+    close(xi, exi)
+    close(yi, eyi)
+
+
+def test_np_windows():
+    """reference: test_np_windows / src/operator/numpy/np_window_op.cc"""
+    for name in ("hanning", "hamming", "blackman"):
+        for M in (0, 1, 2, 5, 12):
+            close(getattr(np, name)(M), getattr(onp, name)(M).astype("f"),
+                  atol=1e-6)
+
+
+# ---- shape manipulation --------------------------------------------------
+
+def test_np_reshape():
+    a = np.arange(24)
+    close(a.reshape(2, 3, 4), onp.arange(24, dtype="f").reshape(2, 3, 4))
+    close(np.reshape(a, (4, -1)), onp.arange(24, dtype="f").reshape(4, -1))
+
+
+def test_np_flatten():
+    a = np.array(_rand(3, 4))
+    close(a.flatten(), _rand(3, 4).flatten())
+
+
+def test_np_ravel():
+    x = _rand(3, 4)
+    close(np.ravel(np.array(x)), x.ravel())
+
+
+def test_np_squeeze():
+    x = _rand(1, 3, 1, 4)
+    close(np.squeeze(np.array(x)), x.squeeze())
+    close(np.squeeze(np.array(x), axis=0), x.squeeze(0))
+
+
+def test_np_transpose():
+    x = _rand(2, 3, 4)
+    close(np.transpose(np.array(x)), x.T)
+    close(np.transpose(np.array(x), (1, 0, 2)), x.transpose(1, 0, 2))
+
+
+def test_np_swapaxes():
+    x = _rand(2, 3, 4)
+    close(np.swapaxes(np.array(x), 0, 2), x.swapaxes(0, 2))
+
+
+def test_np_moveaxis():
+    x = _rand(2, 3, 4)
+    close(np.moveaxis(np.array(x), 0, -1), onp.moveaxis(x, 0, -1))
+    close(np.moveaxis(np.array(x), [0, 1], [1, 0]),
+          onp.moveaxis(x, [0, 1], [1, 0]))
+
+
+def test_np_broadcast_to():
+    x = _rand(1, 3)
+    close(np.broadcast_to(np.array(x), (4, 3)), onp.broadcast_to(x, (4, 3)))
+
+
+def test_np_broadcast_arrays():
+    a, b = np.broadcast_arrays(np.array(_rand(1, 3)), np.array(_rand(4, 1)))
+    ea, eb = onp.broadcast_arrays(_rand(1, 3), _rand(4, 1))
+    close(a, ea)
+    close(b, eb)
+
+
+def test_np_concat():
+    x, y = _rand(2, 3), _rand(4, 3)
+    close(np.concatenate([np.array(x), np.array(y)], axis=0),
+          onp.concatenate([x, y], axis=0))
+    z = _rand(2, 3)
+    close(np.concatenate([np.array(x), np.array(z)], axis=1),
+          onp.concatenate([x, z], axis=1))
+
+
+def test_np_stack():
+    x, y = _rand(2, 3), _rand(2, 3)
+    for ax in (0, 1, 2, -1):
+        close(np.stack([np.array(x), np.array(y)], axis=ax),
+              onp.stack([x, y], axis=ax))
+
+
+def test_np_vstack():
+    x, y = _rand(2, 3), _rand(1, 3)
+    close(np.vstack([np.array(x), np.array(y)]), onp.vstack([x, y]))
+
+
+def test_np_dstack():
+    x, y = _rand(2, 3), _rand(2, 3)
+    close(np.dstack([np.array(x), np.array(y)]), onp.dstack([x, y]))
+
+
+def test_np_split():
+    x = _rand(6, 4)
+    for g, e in zip(np.split(np.array(x), 3), onp.split(x, 3)):
+        close(g, e)
+    for g, e in zip(np.split(np.array(x), [2, 5]), onp.split(x, [2, 5])):
+        close(g, e)
+
+
+def test_np_hsplit():
+    x = _rand(4, 6)
+    for g, e in zip(np.hsplit(np.array(x), 2), onp.hsplit(x, 2)):
+        close(g, e)
+
+
+def test_np_vsplit():
+    x = _rand(6, 4)
+    for g, e in zip(np.vsplit(np.array(x), 3), onp.vsplit(x, 3)):
+        close(g, e)
+
+
+def test_np_tile():
+    x = _rand(2, 3)
+    close(np.tile(np.array(x), 2), onp.tile(x, 2))
+    close(np.tile(np.array(x), (2, 1)), onp.tile(x, (2, 1)))
+
+
+def test_np_repeat():
+    x = _rand(2, 3)
+    close(np.repeat(np.array(x), 3), onp.repeat(x, 3))
+    close(np.repeat(np.array(x), 2, axis=1), onp.repeat(x, 2, axis=1))
+
+
+def test_np_roll():
+    x = _rand(3, 4)
+    close(np.roll(np.array(x), 2), onp.roll(x, 2))
+    close(np.roll(np.array(x), 1, axis=0), onp.roll(x, 1, axis=0))
+
+
+def test_np_rot90():
+    x = _rand(3, 4)
+    for k in range(4):
+        close(np.rot90(np.array(x), k), onp.rot90(x, k))
+
+
+def test_np_flip():
+    x = _rand(3, 4)
+    close(np.flip(np.array(x)), onp.flip(x))
+    close(np.flip(np.array(x), 0), onp.flip(x, 0))
+
+
+# ---- math / reductions ---------------------------------------------------
+
+def test_np_sum():
+    x = _rand(3, 4)
+    close(np.sum(np.array(x)), x.sum())
+    close(np.sum(np.array(x), axis=1, keepdims=True),
+          x.sum(1, keepdims=True))
+    # gradient
+    a = np.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(a * a)
+    y.backward()
+    close(a.grad, 2 * x)
+
+
+def test_np_prod():
+    x = _rand(3, 4)
+    close(np.prod(np.array(x)), x.prod(), rtol=1e-4)
+    close(np.prod(np.array(x), axis=0), x.prod(0), rtol=1e-4)
+
+
+def test_np_mean():
+    x = _rand(3, 4)
+    close(np.mean(np.array(x)), x.mean())
+    close(np.mean(np.array(x), axis=1), x.mean(1))
+
+
+def test_np_moment():
+    x = _rand(3, 4)
+    close(np.var(np.array(x)), x.var(), rtol=1e-4)
+    close(np.std(np.array(x), axis=0), x.std(0), rtol=1e-4)
+    close(np.var(np.array(x), axis=1, ddof=1), x.var(1, ddof=1), rtol=1e-4)
+
+
+def test_np_max_min():
+    x = _rand(3, 4)
+    close(np.max(np.array(x)), x.max())
+    close(np.min(np.array(x), axis=1), x.min(1))
+
+
+def test_np_argmin_argmax():
+    x = _rand(3, 4)
+    close(np.argmax(np.array(x)), onp.argmax(x))
+    close(np.argmin(np.array(x), axis=1), onp.argmin(x, 1))
+
+
+def test_np_cumsum():
+    x = _rand(3, 4)
+    close(np.cumsum(np.array(x)), x.cumsum())
+    close(np.cumsum(np.array(x), axis=1), x.cumsum(1))
+
+
+def test_np_around():
+    x = onp.array([0.4, 0.5, 1.5, -0.5, -1.7], "f")
+    close(np.around(np.array(x)), onp.around(x))
+    close(np.around(np.array(x * 10), decimals=-1), onp.around(x * 10, -1))
+
+
+def test_np_clip():
+    x = _rand(3, 4)
+    close(np.clip(np.array(x), -1, 1), x.clip(-1, 1))
+    close(np.clip(np.array(x), None, 0.5), x.clip(None, 0.5))
+
+
+def test_np_diff():
+    x = _rand(3, 6)
+    close(np.diff(np.array(x)), onp.diff(x))
+    close(np.diff(np.array(x), n=2, axis=1), onp.diff(x, 2, 1))
+
+
+def test_np_unary_funcs():
+    x = _rand(3, 4)
+    pos = onp.abs(x) + 0.5
+    for name in ("negative", "absolute", "sign", "rint", "ceil", "floor",
+                 "trunc", "square", "exp", "expm1", "sin", "cos", "tan",
+                 "sinh", "cosh", "tanh", "degrees", "radians"):
+        close(getattr(np, name)(np.array(x)), getattr(onp, name)(x),
+              rtol=1e-4)
+    for name in ("sqrt", "cbrt", "log", "log2", "log10", "log1p",
+                 "reciprocal"):
+        close(getattr(np, name)(np.array(pos)), getattr(onp, name)(pos),
+              rtol=1e-4)
+    sym = x / 3.0
+    for name in ("arcsin", "arccos", "arctan", "arcsinh", "arctanh"):
+        close(getattr(np, name)(np.array(sym)), getattr(onp, name)(sym),
+              rtol=1e-4, atol=1e-5)
+
+
+def test_np_binary_funcs():
+    x, y = _rand(3, 4), onp.abs(_rand(3, 4)) + 0.5
+    for name in ("add", "subtract", "multiply", "divide", "maximum",
+                 "minimum", "mod", "fmod", "copysign", "arctan2", "hypot",
+                 "logaddexp", "heaviside", "fmax", "fmin"):
+        close(getattr(np, name)(np.array(x), np.array(y)),
+              getattr(onp, name)(x, y), rtol=1e-4, atol=1e-5)
+    close(np.power(np.array(y), np.array(x)), onp.power(y, x), rtol=1e-3)
+    # broadcasting
+    close(np.add(np.array(x), np.array(y[0])), x + y[0])
+
+
+def test_np_true_divide():
+    a = np.array([4, 6], dtype="int32")
+    b = np.array([2, 4], dtype="int32")
+    r = np.true_divide(a, b)
+    close(r, onp.array([2.0, 1.5]))
+    assert r.dtype in (onp.float32, onp.float64)
+
+
+# ---- linear algebra ------------------------------------------------------
+
+def test_np_dot():
+    a, b = _rand(3, 4), _rand(4, 5)
+    close(np.dot(np.array(a), np.array(b)), a.dot(b), rtol=1e-4)
+    v, w = _rand(4), _rand(4)
+    close(np.dot(np.array(v), np.array(w)), v.dot(w), rtol=1e-4)
+    close(np.dot(np.array(a), np.array(v[:4])), a.dot(v), rtol=1e-4)
+
+
+def test_np_inner():
+    a, b = _rand(3, 4), _rand(5, 4)
+    close(np.inner(np.array(a), np.array(b)), onp.inner(a, b), rtol=1e-4)
+
+
+def test_np_outer():
+    a, b = _rand(3), _rand(4)
+    close(np.outer(np.array(a), np.array(b)), onp.outer(a, b), rtol=1e-4)
+
+
+def test_np_vdot():
+    a, b = _rand(3, 4), _rand(3, 4)
+    close(np.vdot(np.array(a), np.array(b)), onp.vdot(a, b), rtol=1e-4)
+
+
+def test_np_tensordot():
+    a, b = _rand(2, 3, 4), _rand(3, 4, 5)
+    close(np.tensordot(np.array(a), np.array(b)),
+          onp.tensordot(a, b), rtol=1e-4)
+    c = _rand(4, 3, 2)
+    close(np.tensordot(np.array(a), np.array(c), axes=([2, 1], [0, 1])),
+          onp.tensordot(a, c, axes=([2, 1], [0, 1])), rtol=1e-4)
+
+
+def test_np_einsum():
+    a, b = _rand(3, 4), _rand(4, 5)
+    close(np.einsum("ij,jk->ik", np.array(a), np.array(b)),
+          onp.einsum("ij,jk->ik", a, b), rtol=1e-4)
+    close(np.einsum("ij->i", np.array(a)), onp.einsum("ij->i", a), rtol=1e-4)
+    c = _rand(3, 3)
+    close(np.einsum("ii", np.array(c)), onp.einsum("ii", c), rtol=1e-4)
+
+
+def test_np_trace():
+    x = _rand(4, 4)
+    close(np.trace(np.array(x)), onp.trace(x), rtol=1e-4)
+    y = _rand(3, 4, 4)
+    close(np.trace(np.array(y), axis1=1, axis2=2),
+          onp.trace(y, axis1=1, axis2=2), rtol=1e-4)
+
+
+def test_np_tril():
+    x = _rand(4, 4)
+    close(np.tril(np.array(x)), onp.tril(x))
+    close(np.tril(np.array(x), k=1), onp.tril(x, 1))
+    close(np.triu(np.array(x), k=-1), onp.triu(x, -1))
+
+
+def test_np_linalg_norm():
+    x = _rand(3, 4)
+    close(np.linalg.norm(np.array(x)), onp.linalg.norm(x), rtol=1e-4)
+    close(np.linalg.norm(np.array(x), axis=1),
+          onp.linalg.norm(x, axis=1), rtol=1e-4)
+    close(np.linalg.norm(np.array(x), ord=1, axis=0),
+          onp.linalg.norm(x, ord=1, axis=0), rtol=1e-4)
+
+
+def test_np_linalg_svd():
+    x = _rand(4, 3)
+    u, s, vt = np.linalg.svd(np.array(x), full_matrices=False)
+    recon = u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy()
+    onp.testing.assert_allclose(recon, x, rtol=1e-4, atol=1e-4)
+
+
+# ---- indexing / selection ------------------------------------------------
+
+def test_np_take():
+    x = _rand(5, 4)
+    idx = onp.array([0, 3, 1])
+    close(np.take(np.array(x), np.array(idx, dtype="int32")),
+          onp.take(x, idx))
+    close(np.take(np.array(x), np.array(idx, dtype="int32"), axis=1),
+          onp.take(x, idx, axis=1))
+
+
+def test_np_nonzero():
+    x = onp.array([[1, 0, 2], [0, 3, 0]], "f")
+    g = np.nonzero(np.array(x))
+    e = onp.nonzero(x)
+    for gi, ei in zip(g, e):
+        close(gi, ei)
+
+
+def test_np_unique():
+    x = onp.array([1, 3, 2, 3, 1, 7], "f")
+    close(np.unique(np.array(x)), onp.unique(x))
+    vals, counts = np.unique(np.array(x), return_counts=True)
+    ev, ec = onp.unique(x, return_counts=True)
+    close(vals, ev)
+    close(counts, ec)
+
+
+def test_np_histogram():
+    x = _rand(100)
+    h, edges = np.histogram(np.array(x), bins=10, range=(-2, 2))
+    eh, ee = onp.histogram(x, bins=10, range=(-2, 2))
+    close(h, eh)
+    close(edges, ee, rtol=1e-5)
+
+
+def test_npi_boolean_assign():
+    """reference: test_npi_boolean_assign / np_boolean_mask_assign.cc"""
+    x = _rand(3, 4)
+    a = np.array(x)
+    mask = a > 0.5
+    a[mask] = 0.0
+    e = x.copy()
+    e[x > 0.5] = 0.0
+    close(a, e)
+    # tensor-valued assignment
+    a2 = np.array(x)
+    nsel = int((x > 0.5).sum())
+    a2[a2 > 0.5] = np.zeros((nsel,))
+    close(a2, e)
+
+
+def test_np_share_memory():
+    a = np.array(_rand(4))
+    b = a
+    assert np.shares_memory(a, b) or np.may_share_memory(a, b)
+    c = np.array(_rand(4))
+    assert not np.shares_memory(a, c)
+
+
+# ---- random --------------------------------------------------------------
+
+def test_np_rand():
+    x = np.random.rand(500)
+    v = x.asnumpy()
+    assert v.shape == (500,) and (v >= 0).all() and (v < 1).all()
+
+
+def test_np_randint():
+    x = np.random.randint(0, 10, size=(1000,))
+    v = x.asnumpy()
+    assert ((v >= 0) & (v < 10)).all()
+    assert len(onp.unique(v)) == 10
+
+
+def test_np_random():
+    u = np.random.uniform(-1, 1, size=(2000,)).asnumpy()
+    assert -1 <= u.min() and u.max() < 1 and abs(u.mean()) < 0.1
+    n = np.random.normal(3.0, 2.0, size=(4000,)).asnumpy()
+    assert abs(n.mean() - 3.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+    g = np.random.geometric(0.5, size=(2000,)).asnumpy()
+    assert 1.7 < g.mean() < 2.4
+    nb = np.random.negative_binomial(5, 0.5, size=(2000,)).asnumpy()
+    assert 4.0 < nb.mean() < 6.2
+    f = np.random.f(10.0, 20.0, size=(3000,)).asnumpy()
+    assert 0.9 < f.mean() < 1.35
+
+
+def test_np_choice():
+    x = np.random.choice(5, size=(1000,))
+    v = x.asnumpy()
+    assert set(onp.unique(v)).issubset(set(range(5)))
+    y = np.random.choice(10, size=(5,), replace=False).asnumpy()
+    assert len(onp.unique(y)) == 5
+
+
+def test_random_seed():
+    np.random.seed(42)
+    a = np.random.uniform(size=(10,)).asnumpy()
+    np.random.seed(42)
+    b = np.random.uniform(size=(10,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+
+
+# ---- npx extension ops ---------------------------------------------------
+
+def test_npx_relu():
+    x = _rand(3, 4)
+    close(npx.relu(np.array(x)), onp.maximum(x, 0))
+
+
+def test_npx_sigmoid():
+    x = _rand(3, 4)
+    close(npx.sigmoid(np.array(x)), 1 / (1 + onp.exp(-x)), rtol=1e-5)
+
+
+def test_npx_batch_dot():
+    a, b = _rand(2, 3, 4), _rand(2, 4, 5)
+    close(npx.batch_dot(np.array(a), np.array(b)),
+          onp.einsum("bij,bjk->bik", a, b), rtol=1e-4)
+    close(npx.batch_dot(np.array(a), np.array(_rand(2, 5, 4)),
+                        transpose_b=True),
+          onp.einsum("bij,bkj->bik", a, _rand(2, 5, 4)), rtol=1e-4)
+
+
+def test_npx_reshape():
+    x = _rand(2, 3, 4)
+    # npx.reshape supports -2 (copy remaining dims) semantics
+    r = npx.reshape(np.array(x), (-2, -2, 4))
+    assert r.shape == (2, 3, 4)
+    r2 = npx.reshape(np.array(x), (6, -1))
+    assert r2.shape == (6, 4)
+
+
+def test_npx_slice():
+    x = _rand(4, 5)
+    close(npx.slice(np.array(x), begin=(1, 0), end=(3, 4)), x[1:3, 0:4])
+
+
+def test_np_builtin_op_signature():
+    """Ops accept out=/where= keywords like the reference's generated
+    signatures (reference: test_np_builtin_op_signature)."""
+    x = np.array(_rand(3))
+    out = np.zeros((3,))
+    r = np.add(x, x, out=out)
+    assert r is out
+    close(out, 2 * x.asnumpy())
+    r2 = np.sin(x, out=out)
+    assert r2 is out

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Background TPU-tunnel health probe. One probe process at a time, spaced
+# widely (25 min) so a wedged tunnel isn't hammered. Logs to
+# /tmp/tunnel_probe.log. A healthy tunnel answers jax.devices() in <60s.
+LOG=/tmp/tunnel_probe.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 150 python -c "import jax; print(jax.devices())" 2>&1 | tail -1)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -qi tpu; then
+    echo "$ts HEALTHY $out" >> "$LOG"
+  else
+    echo "$ts down rc=$rc $out" >> "$LOG"
+  fi
+  sleep 1500
+done
