@@ -47,7 +47,22 @@ def _maybe_init_distributed():
         process_id=int(os.environ["MXNET_PROCESS_ID"]))
 
 
+def _maybe_enable_int64():
+    """MXNET_INT64_TENSOR_SIZE=1 builds the reference with 64-bit tensor
+    indexing and int64 arithmetic (reference: include/mxnet/libinfo.h:126,
+    flag INT64_TENSOR_SIZE; nightly test_large_array.py). The TPU analog
+    is JAX's x64 mode — it must be set before the first jax use."""
+    import os
+
+    if os.environ.get("MXNET_INT64_TENSOR_SIZE", "0").lower() in (
+            "1", "true", "on"):
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+
 _maybe_init_distributed()
+_maybe_enable_int64()
 
 from . import base
 from .base import MXNetError

@@ -41,13 +41,17 @@ class _TapeNode:
     (Function) leave it None and stop at first order, like the
     reference's CustomFunction."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "fun", "primals")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "fun", "primals", "keys")
 
-    def __init__(self, vjp_fn, inputs, outputs, fun=None):
+    def __init__(self, vjp_fn, inputs, outputs, fun=None, keys=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[NDArray] (array inputs only)
         self.outputs = outputs  # list[NDArray]
         self.fun = fun
+        # PRNG keys the primal drew at record time (stochastic ops:
+        # dropout, random_*). Higher-order replay feeds them back so the
+        # re-derived vjp sees the same masks as the recorded forward.
+        self.keys = keys
         # record-time input buffers: lets the create_graph walk detect
         # in-place rebinding (out= aliasing) where recomputing from the
         # CURRENT .data would silently use post-mutation values
@@ -127,10 +131,10 @@ def predict_mode():
     return _scope(training=False)
 
 
-def _record_op(vjp_fn, array_inputs, outputs, fun=None):
+def _record_op(vjp_fn, array_inputs, outputs, fun=None, keys=None):
     """Append a tape node (called by the op-dispatch layer)."""
     _STATE.tape.append(
-        _TapeNode(vjp_fn, list(array_inputs), list(outputs), fun))
+        _TapeNode(vjp_fn, list(array_inputs), list(outputs), fun, keys))
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -260,9 +264,15 @@ def _backward_recorded(heads, head_grads, train_mode):
             single_out = len(node.outputs) == 1
             if node.fun is not None:
                 def grad_op(*xs, _fun=node.fun, _n=n_in,
-                            _single=single_out):
+                            _single=single_out, _keys=node.keys):
+                    from . import random as _mxrandom
+
                     primals, cts = xs[:_n], xs[_n:]
-                    _, vjp = jax.vjp(_fun, *primals)
+                    # replay record-time PRNG keys so stochastic primals
+                    # (dropout...) re-derive against the SAME masks the
+                    # recorded forward used, not freshly split ones
+                    with _mxrandom.key_replayer(_keys or ()):
+                        _, vjp = jax.vjp(_fun, *primals)
                     gs = vjp(cts[0] if _single else tuple(cts))
                     return tuple(gs) if len(gs) > 1 else gs[0]
 

@@ -34,6 +34,10 @@ KNOBS = {
         "wired", "model_store", "pretrained-weight repo URL"),
     "MXNET_SEED": (
         "wired", "random", "global PRNG seed applied at import"),
+    "MXNET_INT64_TENSOR_SIZE": (
+        "wired", "__init__._maybe_enable_int64",
+        "enable 64-bit tensors (JAX x64); reference libinfo.h "
+        "INT64_TENSOR_SIZE build flag"),
     "MXNET_PROFILER_AUTOSTART": (
         "wired", "profiler", "start profiling at import when 1"),
     "MXNET_ENFORCE_DETERMINISM": (

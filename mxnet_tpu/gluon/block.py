@@ -187,7 +187,10 @@ class Block:
         subtree, including independently hybridized descendants. Returns
         a handle whose ``detach()`` removes this hook; the tap layer per
         block is shared, so handles detach safely in any order."""
-        entry = (callback, bool(monitor_all))
+        # a unique token per registration keys this hook's per-block
+        # labels: a second hook registered deeper in the tree gets its
+        # OWN prefix-relative labels, not the first registration's
+        entry = (object(), callback, bool(monitor_all))
         touched = []
 
         def install(blk, prefix):
@@ -196,12 +199,16 @@ class Block:
                 install(child, prefix + name + ".")
             label = prefix.rstrip(".") or (getattr(blk, "name", "") or
                                            type(blk).__name__)
+            labels = getattr(blk, "_op_hook_labels", None)
+            if labels is None:
+                labels = blk._op_hook_labels = {}
+            labels[entry[0]] = label
             cbs = getattr(blk, "_op_hook_cbs", None)
             if cbs is None:
                 cbs = blk._op_hook_cbs = []
                 orig = blk.forward
 
-                def tap(*args, _orig=orig, _label=label, _blk=blk, **kw):
+                def tap(*args, _orig=orig, _blk=blk, **kw):
                     from ..ndarray.ndarray import _is_tracer
 
                     def concrete(v):
@@ -213,21 +220,24 @@ class Block:
                         return hasattr(v, "data") and not _is_tracer(
                             v.data)
 
+                    # snapshot both together: detach() during the
+                    # forward (capture-once callbacks) pops the label
                     hooks = list(_blk._op_hook_cbs)
-                    for cb, mon_all in hooks:
+                    lbls = dict(_blk._op_hook_labels)
+                    for tok, cb, mon_all in hooks:
                         if mon_all:
                             for i, a in enumerate(args):
                                 if concrete(a):
-                                    cb(f"{_label}_data{i}", a)
+                                    cb(f"{lbls[tok]}_data{i}", a)
                     out = _orig(*args, **kw)
                     outs = out if isinstance(out, (list, tuple)) \
                         else [out]
-                    for cb, _mon_all in hooks:
+                    for tok, cb, _mon_all in hooks:
                         for i, o in enumerate(outs):
                             if concrete(o):
                                 suffix = "_output" if len(outs) == 1 \
                                     else f"_output{i}"
-                                cb(f"{_label}{suffix}", o)
+                                cb(f"{lbls[tok]}{suffix}", o)
                     return out
 
                 blk._op_hook_fwd = (tap, orig)
@@ -244,6 +254,7 @@ class Block:
         class _OpHookHandle:
             def detach(self_inner):
                 for blk in touched:
+                    getattr(blk, "_op_hook_labels", {}).pop(entry[0], None)
                     cbs = getattr(blk, "_op_hook_cbs", None)
                     if cbs is not None and entry in cbs:
                         cbs.remove(entry)

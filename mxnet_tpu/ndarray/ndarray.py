@@ -33,9 +33,33 @@ _DTYPE_ALIASES = {
 }
 
 
+_warned_int64 = False
+
+
+def _check_64bit(dtype):
+    """Without MXNET_INT64_TENSOR_SIZE (reference: libinfo.h:126
+    INT64_TENSOR_SIZE build flag), 64-bit dtypes degrade to 32-bit under
+    XLA's x64-off mode. Warn ONCE, loudly, with the fix — never silently."""
+    global _warned_int64
+    if _warned_int64 or "64" not in str(dtype) or jax.config.jax_enable_x64:
+        return
+    d = onp.dtype(dtype)
+    if d in (onp.int64, onp.uint64, onp.float64):
+        import warnings
+
+        _warned_int64 = True
+        warnings.warn(
+            f"dtype {d} requested but 64-bit tensor support is disabled; "
+            "values will be truncated to 32 bits. Set "
+            "MXNET_INT64_TENSOR_SIZE=1 before import to enable 64-bit "
+            "tensors (reference build flag INT64_TENSOR_SIZE, "
+            "include/mxnet/libinfo.h:126).", stacklevel=3)
+
+
 def _canon_dtype(dtype):
     if dtype is None:
         return None
+    _check_64bit(dtype)
     if isinstance(dtype, str):
         return _DTYPE_ALIASES.get(dtype, onp.dtype(dtype))
     return dtype

@@ -201,7 +201,13 @@ def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
         return r
 
     if autograd.is_recording() and differentiable and arr_args:
-        result, vjp_fn = jax.vjp(normalized, *datas)
+        from .. import random as _mxrandom
+
+        # log PRNG keys the primal draws (stochastic ops): the tape node
+        # keeps them so create_graph replay sees the same masks
+        with _mxrandom.key_logger() as _klog:
+            result, vjp_fn = jax.vjp(normalized, *datas)
+        _keys = _klog.keys or None
         multi = isinstance(result, tuple)
         if out is not None:
             if multi:
@@ -210,10 +216,11 @@ def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
             # keyed by id(out) flow back through this node
             out._data = jnp.asarray(result, out._data.dtype)
             autograd._record_op(vjp_fn, list(arr_args), [out],
-                                fun=normalized)
+                                fun=normalized, keys=_keys)
             return out
         outs = [_wrap(r) for r in (result if multi else (result,))]
-        autograd._record_op(vjp_fn, list(arr_args), outs, fun=normalized)
+        autograd._record_op(vjp_fn, list(arr_args), outs, fun=normalized,
+                            keys=_keys)
         return outs if multi else outs[0]
 
     result = pure_fn(*datas)

@@ -114,7 +114,22 @@ class ndarray(NDArray):
         same name when registered, keeping results on device; ufunc
         kwargs (where=, casting=, ...), reduce/accumulate/outer methods,
         and unknown ufuncs compute via numpy on host and re-wrap."""
-        if kwargs.get("out") is not None:
+        out_kw = kwargs.get("out")
+        if out_kw is not None:
+            # numpy passes out= as a tuple (1-tuple for single-output
+            # ufuncs); fill the caller's buffer on host and rebind
+            if isinstance(out_kw, tuple) and len(out_kw) == 1:
+                out_kw = out_kw[0]
+            if isinstance(out_kw, NDArray) and method == "__call__":
+                # seed with out's CURRENT values: where=False positions
+                # must keep them (numpy's out= contract), not read
+                # uninitialized memory
+                host_out = onp.array(out_kw.asnumpy(),
+                                     onp.dtype(out_kw._data.dtype))
+                kwargs = dict(kwargs, out=host_out)
+                ufunc(*[self._tohost(x) for x in inputs], **kwargs)
+                out_kw._data = jnp.asarray(host_out)
+                return out_kw
             return NotImplemented
         if method == "__call__" and not kwargs:
             # kwargs force the host path: mx wrappers accept **kw
@@ -152,13 +167,18 @@ class ndarray(NDArray):
         function of the same name (device-resident result); otherwise
         fall back to numpy over host copies, wrapped back."""
         out_buf = kwargs.get("out")
+        if isinstance(out_buf, tuple) and len(out_buf) == 1:
+            # numpy normalizes out= to a 1-tuple for single-output ufuncs
+            out_buf = out_buf[0]
+            kwargs = dict(kwargs, out=out_buf)
         if isinstance(out_buf, NDArray):
             # numpy's out= contract is in-place fill; XLA buffers are
             # immutable, so run the call ON HOST with a host out buffer
             # — numpy itself applies the per-function shape and casting
             # rules (unsafe for reductions, same_kind for concatenate
             # et al.) — then rebind the handle's payload
-            host_out = onp.empty(tuple(out_buf.shape),
+            # seeded with current values so where=False slots survive
+            host_out = onp.array(out_buf.asnumpy(),
                                  onp.dtype(out_buf._data.dtype))
             kwargs = dict(kwargs, out=host_out)
             func(*self._tohost(args),

@@ -58,6 +58,63 @@ def next_key():
     return sub
 
 
+class key_logger:
+    """Record the keys an op draws while tracing, delegating to whatever
+    source is active (the global stream, or an enclosing provider such as
+    CachedOp's key argument). The eager tape stores the logged keys so
+    higher-order replay (autograd create_graph) re-derives gradients
+    against the SAME random masks the forward used."""
+
+    def __init__(self):
+        self.keys = []
+        self._installed = False
+
+    def __enter__(self):
+        if _STATE.providers:
+            # an enclosing provider (CachedOp trace) owns key derivation;
+            # its keys may be tracers — do not capture them on the eager
+            # tape (CachedOp pins its own keys via tape_fun)
+            return self
+
+        def provider():
+            _STATE.key, sub = jax.random.split(_STATE.key)
+            self.keys.append(sub)
+            return sub
+
+        _STATE.providers.append(provider)
+        self._installed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._installed:
+            _STATE.providers.pop()
+
+
+class key_replayer:
+    """Feed back keys captured by a key_logger, in order. Extra draws
+    beyond the log fall through to the global stream (defensive — a
+    primal fn draws a fixed number of keys per trace)."""
+
+    def __init__(self, keys):
+        self._keys = list(keys)
+        self._i = 0
+
+    def _next(self):
+        if self._i < len(self._keys):
+            k = self._keys[self._i]
+            self._i += 1
+            return k
+        _STATE.key, sub = jax.random.split(_STATE.key)
+        return sub
+
+    def __enter__(self):
+        _STATE.providers.append(self._next)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.providers.pop()
+
+
 class key_provider:
     """Context manager installing a key source for traced regions.
 

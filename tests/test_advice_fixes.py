@@ -217,3 +217,78 @@ def test_kvstore_num_dead_node():
     kv = kvs.create("local")
     assert kv.num_dead_node() == 0
     assert kv.num_dead_node(3) == 0
+
+
+# ---- round-5 advice fixes -------------------------------------------------
+
+def test_create_graph_replays_recorded_dropout_mask():
+    """r5 advice (medium): eager stochastic ops must replay record-time
+    PRNG keys under create_graph, not draw fresh ones."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+
+    mx.random.seed(7)
+    x = nd.array(onp.ones((4, 8), "f") * 3.0)
+    x.attach_grad()
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5, mode="training")
+        s = y.sum()
+    mask = y.asnumpy() / 3.0
+    g = autograd.grad(s, x, create_graph=True)
+    onp.testing.assert_allclose(g.asnumpy(), mask, rtol=1e-6)
+
+    # second order through the stochastic op
+    mx.random.seed(11)
+    x2 = nd.array(onp.full((4, 8), 2.0, "f"))
+    x2.attach_grad()
+    with autograd.record():
+        d = nd.Dropout(x2, p=0.5, mode="training")
+        z = (d * d).sum()
+        gg = autograd.grad(z, x2, create_graph=True)
+        s2 = (gg * gg).sum()
+    m2 = d.asnumpy() / 2.0
+    onp.testing.assert_allclose(gg.asnumpy(), 2 * 2.0 * m2 * m2, rtol=1e-5)
+    s2.backward()
+    onp.testing.assert_allclose(x2.grad.asnumpy(), 8 * 2.0 * m2 ** 4,
+                                rtol=1e-4)
+
+
+def test_ufunc_out_tuple_fills_caller_buffer():
+    """r5 advice (low): numpy passes out= as a 1-tuple; the caller's
+    buffer must be updated in place, not silently dropped."""
+    import numpy as onp
+    from mxnet_tpu import np as mnp
+
+    a = mnp.array([1.0, 2.0])
+    out = mnp.zeros((2,))
+    r = onp.add(a, a, out=(out,))
+    assert r is out
+    assert out.asnumpy().tolist() == [2.0, 4.0]
+    r2 = onp.sin(a, out=out)
+    assert r2 is out
+
+
+def test_child_scope_op_hook_labels():
+    """r5 advice (low): a hook registered on a child while a parent-scope
+    hook is active reports child-scoped labels, not the parent's."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    d1, d2 = nn.Dense(4), nn.Dense(2)
+    net.add(d1, d2)
+    net.initialize()
+    parent, child = [], []
+    h1 = net.register_op_hook(lambda name, arr: parent.append(name))
+    h2 = d2.register_op_hook(lambda name, arr: child.append(name))
+    net(nd.array(onp.ones((2, 3), "f")))
+    assert child and all("." not in n for n in child), child
+    h2.detach()
+    child.clear()
+    parent.clear()
+    net(nd.array(onp.ones((2, 3), "f")))
+    assert parent and not child
+    h1.detach()

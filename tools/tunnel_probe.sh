@@ -6,7 +6,7 @@ LOG=/tmp/tunnel_probe.log
 while true; do
   ts=$(date -u +%FT%TZ)
   out=$(timeout 150 python -c "import jax; print(jax.devices())" 2>&1 | tail -1)
-  rc=$?
+  rc=${PIPESTATUS[0]}
   if [ $rc -eq 0 ] && echo "$out" | grep -qi tpu; then
     echo "$ts HEALTHY $out" >> "$LOG"
   else
