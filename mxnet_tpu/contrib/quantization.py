@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as onp
 
 __all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
-           "calib_entropy"]
+           "calib_entropy", "quantize_symbol", "quantize_model"]
 
 
 def calib_entropy(hist, hist_edges, num_quantized_bins=255):
@@ -297,3 +297,316 @@ class _QuantizedShim:
 
     def initialize(self, *args, **kwargs):
         pass
+
+
+# ---- symbol-graph quantization pass --------------------------------------
+# The reference's main quantization API operates on symbols:
+# quantize_model(sym, arg_params, aux_params, ...) rewrites the graph so
+# consecutive quantizable ops form int8 regions (quantize_graph_pass.cc),
+# with per-tensor calibrated ranges. This is that pass over this package's
+# Symbol DAG; quantized ops live in ndarray/ops_quant.py.
+
+_QUANTIZED_OPS = {
+    "convolution": "_contrib_quantized_conv",
+    "fully_connected": "_contrib_quantized_fully_connected",
+    "pooling": "_contrib_quantized_pooling",
+    "activation": "_contrib_quantized_act",
+    "flatten": "_contrib_quantized_flatten",
+    "elemwise_add": "_contrib_quantized_elemwise_add",
+    "concat": "_contrib_quantized_concat",
+    "batch_norm": "_contrib_quantized_batch_norm",
+}
+
+
+def _node_key(s):
+    """Identity key for an op node — views made by __getitem__ share
+    _inputs/_kwargs (same trick Symbol._eval_nodes uses)."""
+    return (s._op, id(s._inputs), id(s._kwargs)) if s._op is not None \
+        else id(s)
+
+
+def _out_name(s):
+    outs = s.list_outputs()
+    return outs[s._output_index if s._num_outputs > 1 else 0]
+
+
+def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
+                    calib_ranges=None, quantized_dtype="int8"):
+    """Rewrite a Symbol into int8 regions (reference:
+    src/operator/quantization/quantize_graph_pass.cc QuantizeGraph;
+    python/mxnet/contrib/quantization.py _quantize_symbol).
+
+    Returns (qsym, offline_weights) where offline_weights maps each
+    conv/fc weight variable name to the (quantized_name, min_name,
+    max_name) variables the caller must populate (offline weight
+    quantization, reference's `offline_params`).
+    """
+    from .. import symbol as S
+
+    if quantized_dtype in ("auto", None):
+        quantized_dtype = "int8"
+    if quantized_dtype != "int8":
+        raise ValueError("TPU int8 path quantizes to int8 "
+                         f"(got {quantized_dtype})")
+    calib_ranges = calib_ranges or {}
+    excluded_sym_names = set(excluded_sym_names)
+    excluded_op_names = set(excluded_op_names)
+
+    heads = sym._group if sym._group else [sym]
+    rep = {}  # node key -> {"fp32": Symbol | None, "q": (q,mn,mx) | None}
+    offline = {}
+
+    def base_rep(node):
+        k = _node_key(node)
+        if k not in rep:
+            if node._op is not None:
+                raise MXNetErrorLocal(f"unvisited node {node._name}")
+            rep[k] = {"fp32": node}  # variable
+        return rep[k]
+
+    def as_fp32(node):
+        r = base_rep(node)
+        if "fp32" not in r:
+            q, mn, mx_ = r["qout"]
+            deq = S._make_node("dequantize", [q, mn, mx_], {},
+                               name=(node._name or "t") + "_dequantize")
+            r["fp32"] = deq
+        f = r["fp32"]
+        if node._num_outputs > 1 and node._op is not None:
+            return f[node._output_index]
+        return f
+
+    def as_q(node):
+        r = base_rep(node)
+        # keyed per OUTPUT VIEW: different outputs of a multi-output
+        # producer quantize independently
+        if "qout" in r:
+            return r["qout"]
+        idx = node._output_index if node._num_outputs > 1 else 0
+        qmap = r.setdefault("q", {})
+        if idx not in qmap:
+            f = as_fp32(node)
+            kw = {"out_type": quantized_dtype}
+            rng = calib_ranges.get(_out_name(node))
+            if rng is not None:
+                kw["min_calib_range"] = float(rng[0])
+                kw["max_calib_range"] = float(rng[1])
+            n = S._make_node("quantize_v2", [f], kw,
+                             name=(node._name or "t") + f"_quantize{idx}"
+                             if node._num_outputs > 1 else
+                             (node._name or "t") + "_quantize")
+            qmap[idx] = (n[0], n[1], n[2])
+        return qmap[idx]
+
+    def weight_vars(wnode):
+        """Offline-quantized weight: three fresh variables the caller
+        fills from the fp32 params (reference: offline_params)."""
+        wname = wnode._name
+        if wname not in offline:
+            offline[wname] = (wname + "_quantized", wname + "_min",
+                              wname + "_max")
+        qn, mn, mx_ = offline[wname]
+        return S.var(qn), S.var(mn), S.var(mx_)
+
+    class MXNetErrorLocal(RuntimeError):
+        pass
+
+    def quantizable(node):
+        if node._op not in _QUANTIZED_OPS:
+            return False
+        if (node._name or "") in excluded_sym_names:
+            return False
+        if node._op in excluded_op_names:
+            return False
+        kw = node._kwargs
+        if node._op == "activation" and kw.get("act_type") != "relu":
+            return False
+        if node._op == "pooling" and kw.get("pool_type", "max") not in (
+                "max", "avg"):
+            return False
+        if node._op == "batch_norm" and (
+                kw.get("output_mean_var") or kw.get("axis", 1) != 1):
+            return False  # quantized BN is wired for channel axis 1
+        if node._op in ("convolution", "fully_connected") and \
+                node._inputs[1]._op is not None:
+            return False  # weight is computed, cannot quantize offline
+        return True
+
+    for node in sym._walk():
+        if node._op is None or node._group is not None:
+            continue
+        k = _node_key(node)
+        if k in rep:
+            continue  # a view of an already-visited base
+        if not quantizable(node):
+            ins = [as_fp32(i) for i in node._inputs]
+            newn = S.Symbol(op=node._op, name=node._name, inputs=ins,
+                            kwargs=dict(node._kwargs),
+                            num_outputs=node._num_outputs)
+            newn._attrs.update(node._attrs)
+            rep[k] = {"fp32": newn}
+            continue
+        op = node._op
+        name = node._name
+        kw = dict(node._kwargs)
+        rng = calib_ranges.get(_out_name(node))
+        if op in ("convolution", "fully_connected"):
+            dq, dmn, dmx = as_q(node._inputs[0])
+            wq, wmn, wmx = weight_vars(node._inputs[1])
+            ins = [dq, wq, dmn, dmx, wmn, wmx]
+            if len(node._inputs) > 2 and not kw.get("no_bias"):
+                ins.append(as_fp32(node._inputs[2]))
+            qn = S._make_node(_QUANTIZED_OPS[op], ins, kw,
+                              name="quantized_" + name)
+            rkw = {"out_type": "int8"}
+            if rng is not None:
+                rkw["min_calib_range"] = float(rng[0])
+                rkw["max_calib_range"] = float(rng[1])
+            rq = S._make_node("requantize", [qn[0], qn[1], qn[2]], rkw,
+                              name=name + "_requantize")
+            rep[k] = {"qout": (rq[0], rq[1], rq[2])}
+        elif op == "batch_norm":
+            dq, dmn, dmx = as_q(node._inputs[0])
+            gamma, beta, mean, var = (as_fp32(i) for i in node._inputs[1:5])
+            bkw = {"eps": kw.get("eps", 1e-3),
+                   "fix_gamma": kw.get("fix_gamma", True)}
+            if rng is not None:
+                bkw["min_calib_range"] = float(rng[0])
+                bkw["max_calib_range"] = float(rng[1])
+            qn = S._make_node(_QUANTIZED_OPS[op],
+                              [dq, gamma, beta, mean, var, dmn, dmx], bkw,
+                              name="quantized_" + name)
+            rep[k] = {"qout": (qn[0], qn[1], qn[2])}
+        elif op == "elemwise_add":
+            lq, lmn, lmx = as_q(node._inputs[0])
+            rq_, rmn, rmx = as_q(node._inputs[1])
+            qn = S._make_node(_QUANTIZED_OPS[op],
+                              [lq, rq_, lmn, lmx, rmn, rmx], {},
+                              name="quantized_" + name)
+            rep[k] = {"qout": (qn[0], qn[1], qn[2])}
+        elif op == "concat":
+            qs = [as_q(i) for i in node._inputs]
+            ins = [q for q, _, _ in qs] + [mn for _, mn, _ in qs] + \
+                [mx_ for _, _, mx_ in qs]
+            qn = S._make_node(_QUANTIZED_OPS[op], ins,
+                              {"dim": kw.get("dim", 1)},
+                              name="quantized_" + name)
+            rep[k] = {"qout": (qn[0], qn[1], qn[2])}
+        else:  # pooling / activation / flatten: data + range through
+            dq, dmn, dmx = as_q(node._inputs[0])
+            qn = S._make_node(_QUANTIZED_OPS[op], [dq, dmn, dmx], kw,
+                              name="quantized_" + name)
+            rep[k] = {"qout": (qn[0], qn[1], qn[2])}
+
+    outs = [as_fp32(h) for h in heads]
+    qsym = outs[0] if len(outs) == 1 else S.Group(outs)
+    return qsym, offline
+
+
+def _collect_layer_statistics(sym, feed, calib_data, data_names,
+                              calib_mode, num_calib_batches=None,
+                              logger=None):
+    """Run the fp32 graph over calibration batches collecting per-tensor
+    ranges (reference: quantization.py _collect_layer_statistics /
+    _LayerOutputMinMaxCollector). Returns {tensor_name: (min, max)}."""
+    import numpy as _onp
+
+    from ..ndarray import NDArray
+
+    internals = sym.get_internals()
+    nodes = [s for s in internals._group if s._op is not None]
+    stats = {}
+    samples = {}
+    _CAP = 8192
+    rng = _onp.random.RandomState(0)
+    n = 0
+    for batch in calib_data:
+        if isinstance(batch, NDArray):
+            datas = [batch]
+        elif isinstance(batch, (list, tuple)):
+            datas = list(batch)
+        else:
+            datas = list(batch.data)
+        f = dict(feed)
+        for dn_, d in zip(data_names, datas):
+            f[dn_] = d
+        cache = {}
+        for s in nodes:
+            out = s._eval_nodes(f, cache)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for nm, o in zip(s.list_outputs(), outs):
+                v = _onp.asarray(o.asnumpy(), dtype=_onp.float32).ravel()
+                mnmx = stats.get(nm)
+                cur = (float(v.min()), float(v.max()))
+                stats[nm] = cur if mnmx is None else (
+                    min(mnmx[0], cur[0]), max(mnmx[1], cur[1]))
+                if calib_mode == "entropy":
+                    av = _onp.abs(v)
+                    if av.size > _CAP:
+                        av = av[rng.choice(av.size, _CAP, replace=False)]
+                    samples.setdefault(nm, []).append(av)
+        n += 1
+        if num_calib_batches and n >= num_calib_batches:
+            break
+    if calib_mode == "entropy":
+        for nm, chunks in samples.items():
+            allv = _onp.concatenate(chunks)
+            if allv.size == 0 or float(allv.max()) == 0.0:
+                continue
+            hist, edges = _onp.histogram(allv, bins=2048)
+            t = calib_entropy(hist, edges)
+            stats[nm] = (-t, t)
+    if logger:
+        logger.info("collected ranges for %d tensors over %d batches",
+                    len(stats), n)
+    return stats
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), excluded_op_names=(),
+                   calib_mode="naive", calib_data=None,
+                   num_calib_batches=None, quantized_dtype="int8",
+                   logger=None):
+    """Post-training quantization of a symbolic model (reference:
+    python/mxnet/contrib/quantization.py quantize_model). Returns
+    (qsym, qarg_params, aux_params).
+
+    calib_mode: 'none' (ranges computed on the fly per batch), 'naive'
+    (min/max over calib_data), 'entropy' (KL threshold per tensor).
+    """
+    import numpy as _onp
+
+    calib_ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError(f"calib_mode='{calib_mode}' needs calib_data")
+        feed = {}
+        for k, v in list(arg_params.items()) + list(aux_params.items()):
+            feed[k] = v
+        calib_ranges = _collect_layer_statistics(
+            sym, feed, calib_data, data_names, calib_mode,
+            num_calib_batches, logger)
+    qsym, offline = quantize_symbol(
+        sym, excluded_sym_names=excluded_sym_names,
+        excluded_op_names=excluded_op_names, calib_ranges=calib_ranges,
+        quantized_dtype=quantized_dtype)
+    from .. import nd
+
+    qarg = dict(arg_params)
+    for wname, (qn, mnn, mxn) in offline.items():
+        w = arg_params[wname]
+        wv = w.asnumpy()
+        amax = float(_onp.abs(wv).max()) or 1e-20
+        scale = 127.0 / amax
+        qarg[qn] = nd.array(
+            _onp.clip(_onp.rint(wv * scale), -127, 127).astype("int8"),
+            dtype="int8")
+        qarg[mnn] = nd.array([-amax])
+        qarg[mxn] = nd.array([amax])
+    # drop fp32 weights ONLY if no surviving node references them
+    # (tied weights / partially-excluded sharing keep the fp32 binding)
+    still_needed = set(qsym.list_arguments())
+    for wname in offline:
+        if wname not in still_needed:
+            del qarg[wname]
+    return qsym, qarg, dict(aux_params)

@@ -91,3 +91,191 @@ def requantize(data, min_range, max_range, min_calib_range=None,
         cmn = jnp.min(real)
         cmx = jnp.max(real)
     return _quantize_raw(real, cmn, cmx, "int8")
+
+
+# ---- int8-chain quantized ops --------------------------------------------
+# Each consumes int8 data WITH its (min, max) range and produces int8 data
+# with a range, so consecutive quantized layers never round-trip through
+# fp32 — the TPU analog of the reference's quantized graph regions
+# (src/operator/quantization/quantize_graph_pass.cc). Reference per-op
+# files cited on each op.
+
+def _sym_scale(mn, mx_):
+    """Symmetric int8 scale for a (min, max) range."""
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+    return jnp.maximum(amax, 1e-20) / 127.0
+
+
+def _scalar(x):
+    return jnp.reshape(x, ()).astype(jnp.float32)
+
+
+@register(differentiable=False)
+def _contrib_quantized_act(data, min_data, max_data, act_type="relu"):
+    """Reference: quantization/quantized_activation.cc — relu directly on
+    the int8 lattice (zero-point 0 for symmetric int8), range preserved."""
+    if act_type != "relu":
+        raise ValueError("only act_type='relu' is quantized")
+    return (jnp.maximum(data, 0).astype(data.dtype),
+            _scalar(min_data), _scalar(max_data))
+
+
+@register(differentiable=False)
+def _contrib_quantized_flatten(data, min_data, max_data):
+    """Reference: quantization/quantized_flatten.cc."""
+    return (jnp.reshape(data, (data.shape[0], -1)),
+            _scalar(min_data), _scalar(max_data))
+
+
+@register(differentiable=False)
+def _contrib_quantized_pooling(data, min_data, max_data, kernel=None,
+                               pool_type="max", global_pool=False,
+                               stride=None, pad=None,
+                               pooling_convention="valid",
+                               count_include_pad=True, layout=None):
+    """Reference: quantization/quantized_pooling.cc. Max pooling operates
+    on the int8 lattice directly; avg pooling accumulates in int32 and
+    rounds back onto the SAME scale (range unchanged either way)."""
+    from .registry import get_op
+
+    pool = get_op("pooling").fn
+    if pool_type == "max":
+        out = pool(data.astype(jnp.int32), kernel=kernel, pool_type="max",
+                   global_pool=global_pool, stride=stride, pad=pad,
+                   pooling_convention=pooling_convention,
+                   layout=layout).astype(data.dtype)
+    else:
+        acc = pool(data.astype(jnp.float32), kernel=kernel,
+                   pool_type=pool_type, global_pool=global_pool,
+                   stride=stride, pad=pad,
+                   pooling_convention=pooling_convention,
+                   count_include_pad=count_include_pad, layout=layout)
+        out = jnp.clip(jnp.rint(acc), -127, 127).astype(data.dtype)
+    return out, _scalar(min_data), _scalar(max_data)
+
+
+@register(differentiable=False)
+def _contrib_quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min,
+                                    rhs_max):
+    """Reference: quantization/quantized_elemwise_add.cc — rescale both
+    addends onto the output lattice; output range = |l|max + |r|max (the
+    exact bound for a sum)."""
+    ls = _sym_scale(_scalar(lhs_min), _scalar(lhs_max))
+    rs = _sym_scale(_scalar(rhs_min), _scalar(rhs_max))
+    omax = jnp.abs(_scalar(lhs_max)) + jnp.abs(_scalar(rhs_max))
+    omax = jnp.maximum(omax,
+                       jnp.abs(_scalar(lhs_min)) + jnp.abs(_scalar(rhs_min)))
+    os_ = jnp.maximum(omax, 1e-20) / 127.0
+    acc = lhs.astype(jnp.float32) * ls + rhs.astype(jnp.float32) * rs
+    q = jnp.clip(jnp.rint(acc / os_), -127, 127).astype(jnp.int8)
+    return q, -omax, omax
+
+
+@register(differentiable=False)
+def _contrib_quantized_concat(*args, dim=1):
+    """Reference: quantization/quantized_concat.cc. Input layout follows
+    the reference: n data tensors, then n mins, then n maxes. All inputs
+    are rescaled onto the widest range before concatenation."""
+    n = len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
+    amaxs = [jnp.maximum(jnp.abs(_scalar(mn)), jnp.abs(_scalar(mx_)))
+             for mn, mx_ in zip(mins, maxs)]
+    omax = amaxs[0]
+    for a in amaxs[1:]:
+        omax = jnp.maximum(omax, a)
+    os_ = jnp.maximum(omax, 1e-20) / 127.0
+    parts = [jnp.clip(jnp.rint(d.astype(jnp.float32) * (a / 127.0) / os_),
+                      -127, 127).astype(jnp.int8)
+             for d, a in zip(datas, amaxs)]
+    return jnp.concatenate(parts, axis=dim), -omax, omax
+
+
+@register(differentiable=False)
+def _contrib_quantized_batch_norm(data, gamma, beta, moving_mean,
+                                  moving_var, min_data, max_data, eps=1e-3,
+                                  fix_gamma=False, min_calib_range=None,
+                                  max_calib_range=None):
+    """Reference: quantization/quantized_batch_norm.cc — inference BN
+    folded to a per-channel affine applied on the dequantized lattice,
+    requantized onto the calibrated output range."""
+    scale = _sym_scale(_scalar(min_data), _scalar(max_data))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = g / jnp.sqrt(moving_var + eps)
+    shp = (1, -1) + (1,) * (data.ndim - 2)
+    real = data.astype(jnp.float32) * scale
+    y = real * inv.reshape(shp) + (beta - moving_mean * inv).reshape(shp)
+    if min_calib_range is None or max_calib_range is None:
+        cmn, cmx = jnp.min(y), jnp.max(y)
+    else:
+        cmn = jnp.asarray(min_calib_range, jnp.float32)
+        cmx = jnp.asarray(max_calib_range, jnp.float32)
+    omax = jnp.maximum(jnp.abs(cmn), jnp.abs(cmx))
+    q = jnp.clip(jnp.rint(y / (jnp.maximum(omax, 1e-20) / 127.0)),
+                 -127, 127).astype(jnp.int8)
+    return q, -omax, omax
+
+
+@register(differentiable=False)
+def _contrib_quantized_conv(data, weight, min_data=None, max_data=None,
+                            min_weight=None, max_weight=None, bias=None,
+                            min_bias=None, max_bias=None, kernel=None,
+                            stride=None, dilate=None, pad=None, num_filter=0,
+                            num_group=1, no_bias=False, layout=None):
+    """Reference: quantization/quantized_conv.cc — int8×int8 conv
+    accumulating int32 on the MXU (preferred_element_type), bias folded in
+    on the int32 lattice with scale s_data*s_weight. Outputs int32 + the
+    float range it represents; a following `requantize` narrows to int8.
+    Input order diverges from the reference (bias after the ranges) so the
+    no-bias form stays purely positional for the symbol executor."""
+    from jax import lax as _lax
+
+    nd = len(kernel) if kernel is not None else data.ndim - 2
+    from .ops_nn import _conv_dims, _tup
+
+    stride_ = _tup(stride or 1, nd)
+    dilate_ = _tup(dilate or 1, nd)
+    pad_ = _tup(pad or 0, nd)
+    dn = _lax.conv_dimension_numbers(data.shape, weight.shape,
+                                     _conv_dims(nd, layout))
+    acc = _lax.conv_general_dilated(
+        data, weight, window_strides=stride_,
+        padding=[(p, p) for p in pad_], rhs_dilation=dilate_,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    ds = _sym_scale(_scalar(min_data), _scalar(max_data))
+    ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
+    if bias is not None and not no_bias:
+        from .ops_nn import _CHANNEL_LAST
+
+        bq = jnp.rint(bias.astype(jnp.float32) / (ds * ws)).astype(jnp.int32)
+        bshape = ((1,) * (nd + 1) + (-1,)) if layout in _CHANNEL_LAST \
+            else ((1, -1) + (1,) * nd)
+        acc = acc + bq.reshape(bshape)
+    # encode rule shared with `requantize`: real = acc * amax/(127*127),
+    # so amax = 127*127*ds*ws makes the decode exactly acc*ds*ws
+    omax = 127.0 * 127.0 * ds * ws
+    return acc, -omax, omax
+
+
+
+@register(differentiable=False)
+def _contrib_quantized_fully_connected(data, weight, min_data=None,
+                                       max_data=None, min_weight=None,
+                                       max_weight=None, bias=None,
+                                       min_bias=None, max_bias=None,
+                                       num_hidden=0, no_bias=False,
+                                       flatten=True):
+    """Reference: quantization/quantized_fully_connected.cc — int8 matmul
+    accumulating int32, bias on the int32 lattice."""
+    from jax import lax as _lax
+
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = _lax.dot(data, weight.T, preferred_element_type=jnp.int32)
+    ds = _sym_scale(_scalar(min_data), _scalar(max_data))
+    ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
+    if bias is not None and not no_bias:
+        bq = jnp.rint(bias.astype(jnp.float32) / (ds * ws)).astype(jnp.int32)
+        acc = acc + bq
+    omax = 127.0 * 127.0 * ds * ws
+    return acc, -omax, omax

@@ -420,6 +420,11 @@ def _num_outputs_for(opname, kwargs):
         return 2
     if opname in ("linalg_gelqf", "linalg_syevd", "linalg_slogdet"):
         return 2
+    if opname in ("quantize", "quantize_v2", "requantize") or \
+            opname.startswith("_contrib_quantized_"):
+        # every quantized-lattice op emits (data, min, max)
+        # (reference: src/operator/quantization/*.cc num_outputs=3)
+        return 3
     return 1
 
 

@@ -1,0 +1,181 @@
+"""Symbol-graph int8 quantization pass (reference:
+src/operator/quantization/quantize_graph_pass.cc, the quantized op files
+quantized_{conv,fully_connected,pooling,concat,activation,elemwise_add,
+batch_norm,flatten}.cc, and python/mxnet/contrib/quantization.py
+quantize_model — VERDICT r4 item 5)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym as S
+from mxnet_tpu.contrib.quantization import (quantize_model, quantize_net,
+                                            quantize_symbol)
+
+
+def _cnn_symbol():
+    data = S.var("data")
+    c1 = S.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                       pad=(1, 1))
+    b1 = S.BatchNorm(c1, name="bn1", fix_gamma=False)
+    a1 = S.Activation(b1, name="relu1", act_type="relu")
+    c2 = S.Convolution(a1, name="conv2", kernel=(3, 3), num_filter=8,
+                       pad=(1, 1))
+    addn = S.elemwise_add(a1, c2, name="resadd")
+    cat = S.Concat(addn, a1, name="cat1", dim=1)
+    p1 = S.Pooling(cat, name="pool1", kernel=(2, 2), stride=(2, 2),
+                   pool_type="max")
+    f1 = S.Flatten(p1, name="flat1")
+    return S.FullyConnected(f1, name="fc1", num_hidden=10)
+
+
+def _init_params(symb, data_shape):
+    onp.random.seed(0)
+    args = symb.list_arguments()
+    auxs = symb.list_auxiliary_states()
+    arg_shapes, _, aux_shapes = symb.infer_shape(data=data_shape)
+    arg_params = {n: nd.array(onp.random.randn(*shp).astype("f") * 0.2)
+                  for n, shp in zip(args, arg_shapes) if n != "data"}
+    aux_params = {n: nd.array(onp.zeros(shp, "f") if "mean" in n
+                              else onp.ones(shp, "f"))
+                  for n, shp in zip(auxs, aux_shapes)}
+    return arg_params, aux_params
+
+
+def _rel_err(a, b):
+    return float(onp.abs(a - b).max() / (onp.abs(b).max() + 1e-9))
+
+
+@pytest.fixture(scope="module")
+def cnn():
+    symb = _cnn_symbol()
+    arg_params, aux_params = _init_params(symb, (4, 3, 16, 16))
+    x = nd.array(onp.random.RandomState(7).randn(4, 3, 16, 16).astype("f"))
+    fp32 = symb.eval_with({**arg_params, **aux_params,
+                           "data": x}).asnumpy()
+    calib = [nd.array(onp.random.RandomState(i).randn(4, 3, 16, 16)
+                      .astype("f")) for i in range(3)] + [x]
+    return symb, arg_params, aux_params, x, fp32, calib
+
+
+def test_quantize_model_naive(cnn):
+    symb, arg_params, aux_params, x, fp32, calib = cnn
+    qsym, qarg, qaux = quantize_model(symb, arg_params, aux_params,
+                                      calib_mode="naive", calib_data=calib)
+    out = qsym.eval_with({**qarg, **qaux, "data": x}).asnumpy()
+    assert _rel_err(out, fp32) < 0.1
+    # offline weight quantization replaced the fp32 weights
+    assert "conv1_weight_quantized" in qarg
+    assert qarg["conv1_weight_quantized"].dtype == onp.int8
+    assert "conv1_weight" not in qarg
+
+
+def test_quantize_model_entropy_and_exclusions(cnn):
+    symb, arg_params, aux_params, x, fp32, calib = cnn
+    qsym, qarg, qaux = quantize_model(
+        symb, arg_params, aux_params,
+        excluded_sym_names=("conv1", "bn1"),
+        calib_mode="entropy", calib_data=calib)
+    out = qsym.eval_with({**qarg, **qaux, "data": x}).asnumpy()
+    assert _rel_err(out, fp32) < 0.1
+    # excluded layers keep fp32 weights; the rest quantize
+    assert "conv1_weight" in qarg
+    assert "conv1_weight_quantized" not in qarg
+    assert "conv2_weight_quantized" in qarg
+
+
+def test_quantize_model_excluded_op_names(cnn):
+    symb, arg_params, aux_params, x, fp32, calib = cnn
+    qsym, qarg, qaux = quantize_model(
+        symb, arg_params, aux_params,
+        excluded_op_names=("pooling", "elemwise_add"),
+        calib_mode="naive", calib_data=calib)
+    json = qsym.tojson()
+    assert "_contrib_quantized_pooling" not in json
+    assert "_contrib_quantized_elemwise_add" not in json
+    assert "_contrib_quantized_conv" in json
+    out = qsym.eval_with({**qarg, **qaux, "data": x}).asnumpy()
+    assert _rel_err(out, fp32) < 0.1
+
+
+def test_quantized_graph_structure(cnn):
+    """Consecutive quantizable ops form one int8 region: no
+    dequantize/quantize round trip between conv2 and the final fc."""
+    symb, arg_params, aux_params, x, fp32, calib = cnn
+    qsym, _ = quantize_symbol(symb)
+    json = qsym.tojson()
+    for op in ("_contrib_quantized_conv", "_contrib_quantized_batch_norm",
+               "_contrib_quantized_act", "_contrib_quantized_pooling",
+               "_contrib_quantized_concat", "_contrib_quantized_flatten",
+               "_contrib_quantized_elemwise_add",
+               "_contrib_quantized_fully_connected", "requantize",
+               "dequantize"):
+        assert op in json, f"{op} missing from quantized graph"
+    # exactly ONE quantize node (at the data boundary): everything
+    # downstream stays int8 until the single output dequantize
+    import json as J
+
+    nodes = J.loads(json)["nodes"]
+    n_quant = sum(1 for n in nodes if n["op"] == "quantize_v2")
+    n_deq = sum(1 for n in nodes if n["op"] == "dequantize")
+    assert n_quant == 1, n_quant
+    assert n_deq == 1, n_deq
+
+
+def test_quantized_hlo_runs_int8(cnn):
+    """The lowered program provably computes in int8 on the MXU path:
+    dot_general/convolution consume i8 operands and accumulate i32."""
+    import re
+
+    import jax
+
+    symb, arg_params, aux_params, x, fp32, calib = cnn
+    qsym, qarg, qaux = quantize_model(symb, arg_params, aux_params,
+                                      calib_mode="naive", calib_data=calib)
+    names = [n for n in sorted(set(qsym.list_arguments())
+                               | set(qsym.list_auxiliary_states()))
+             if n != "data"]
+    allp = {**qarg, **qaux}
+
+    def run(feed_vals, xd):
+        f = {n: nd.NDArray(v) for n, v in zip(names, feed_vals)}
+        f["data"] = nd.NDArray(xd)
+        return qsym.eval_with(f).data
+
+    txt = jax.jit(run).lower([allp[n].data for n in names],
+                             x.data).as_text()
+    assert re.search(r"dot_general[^\n]*xi8[^\n]*xi32", txt), \
+        "fc not int8->int32"
+    assert re.search(r"convolution[^\n]*xi8[^\n]*xi32", txt) or \
+        re.search(r"convolution(.|\n){0,400}?xi8", txt), "conv not int8"
+
+
+def test_quantize_net_resnet18_mixed_exclusions():
+    """VERDICT r4 done-criterion: quantize_net on resnet18 with mixed
+    excluded layers matches fp32 within tolerance."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(pretrained=False)
+    net.initialize(mx.init.Xavier())
+    onp.random.seed(1)
+    x = nd.array(onp.random.randn(2, 3, 64, 64).astype("f") * 0.5)
+    fp32 = net(x).asnumpy()
+    # exclude the stem conv + the classifier dense
+    excl = []
+    for blk in net.collect_params().keys():
+        pass
+    def find_names(b):
+        from mxnet_tpu.gluon import nn
+        out = []
+        for c in b._children.values():
+            if isinstance(c, (nn.Dense, nn.Conv2D)):
+                out.append(c.name)
+            out += find_names(c)
+        return out
+    names = find_names(net)
+    excl = [names[0], names[-1]]
+    calib = [x] + [nd.array(onp.random.randn(2, 3, 64, 64)
+                            .astype("f") * 0.5) for _ in range(2)]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive",
+                        exclude_layers=excl)
+    qout = qnet(x).asnumpy()
+    assert _rel_err(qout, fp32) < 0.15, _rel_err(qout, fp32)

@@ -5,9 +5,10 @@
 LOG=/tmp/tunnel_probe.log
 while true; do
   ts=$(date -u +%FT%TZ)
-  out=$(timeout 150 python -c "import jax; print(jax.devices())" 2>&1 | tail -1)
-  rc=${PIPESTATUS[0]}
-  if [ $rc -eq 0 ] && echo "$out" | grep -qi tpu; then
+  raw=$(timeout 150 python -c "import jax; print(jax.devices())" 2>&1)
+  rc=$?
+  out=$(printf '%s\n' "$raw" | tail -1)
+  if [ $rc -eq 0 ] && echo "$out" | grep -q "TpuDevice\|axon"; then
     echo "$ts HEALTHY $out" >> "$LOG"
   else
     echo "$ts down rc=$rc $out" >> "$LOG"
