@@ -189,6 +189,46 @@ int MXDataIterGetData(DataIterHandle it, NDArrayHandle* out);
 int MXDataIterGetLabel(DataIterHandle it, NDArrayHandle* out);
 int MXDataIterGetPadNum(DataIterHandle it, int* out);
 
+
+/* ---- CachedOp (reference: include/mxnet/c_api.h MXCreateCachedOp /
+ * MXInvokeCachedOp / MXFreeCachedOp; src/c_api/c_api_ndarray.cc).
+ * Inputs are positional in list_arguments()+list_auxiliary_states()
+ * order. Output handle array is thread-local like MXImperativeInvoke. */
+typedef void* CachedOpHandle;
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+/* ---- Autograd (reference: c_api.h MXAutogradSetIsRecording,
+ * MXAutogradSetIsTraining, MXAutogradMarkVariables,
+ * MXAutogradBackwardEx, MXNDArrayGetGrad). grad_req: 0=null 1=write
+ * 2=add. head_grads may be NULL (ones-like seeding). */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradSetIsTraining(int is_training, int* prev);
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* grad_reqs,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* head_grad_handles, int retain_graph,
+                       int train_mode);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out);
+
+/* ---- Profiler (reference: c_api.h MXSetProcessProfilerConfig /
+ * MXSetProcessProfilerState / MXDumpProcessProfile /
+ * MXAggregateProfileStatsPrint; src/c_api/c_api_profile.cc).
+ * state: 0=stop 1=run 2=pause. *out_str points at thread-local
+ * storage valid until the next stats print on this thread. */
+int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                               const char** vals);
+int MXSetProcessProfilerState(int state);
+int MXDumpProcessProfile(int finished);
+int MXAggregateProfileStatsPrint(const char** out_str, int reset);
+
+/* Seed the global PRNG (reference: c_api.h MXRandomSeed). */
+int MXRandomSeed(int seed);
+
 #ifdef __cplusplus
 }
 #endif

@@ -28,7 +28,12 @@ __all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_from_bytes",
            "exec_simple_bind", "exec_array",
            "exec_forward", "exec_backward", "exec_outputs",
            "kv_create", "kv_set_optimizer", "kv_init", "kv_push",
-           "kv_pull", "kv_meta"]
+           "kv_pull", "kv_meta",
+           "cached_op_create", "cached_op_invoke",
+           "autograd_set_recording", "autograd_set_training",
+           "autograd_mark_variables", "autograd_backward", "nd_get_grad",
+           "profiler_config", "profiler_set_state", "profiler_dump",
+           "profiler_stats_print", "random_seed"]
 
 
 def nd_create(shape, dtype_flag):
@@ -491,3 +496,152 @@ def io_label(it):
 
 def io_pad(it):
     return int(getattr(_io_cur(it), "pad", 0) or 0)
+
+
+# ---- CachedOp (reference: c_api_ndarray.cc MXCreateCachedOp /
+# MXInvokeCachedOp — the hybridize engine exposed over the C ABI) --------
+
+class CCachedOp:
+    """Symbol bound as a reusable callable. Inputs are positional in
+    list_arguments() + list_auxiliary_states() order. Outside autograd
+    recording, forward runs through one jit-compiled callable per input
+    signature (the 'cached' part); while recording it runs eagerly so
+    the tape sees every op."""
+
+    def __init__(self, cell):
+        # symbol handles cross the ABI as 1-element lists (see sym_var)
+        self._sym = cell[0] if isinstance(cell, list) else cell
+        self._names = list(self._sym.list_arguments()) + \
+            list(self._sym.list_auxiliary_states())
+        self._jitted = {}
+
+    def __call__(self, inputs):
+        from . import autograd
+
+        if len(inputs) != len(self._names):
+            raise MXNetError(
+                f"CachedOp expects {len(self._names)} inputs "
+                f"({self._names}), got {len(inputs)}")
+        feed = dict(zip(self._names, inputs))
+        if autograd.is_recording():
+            out = self._sym.eval_with(feed)
+        else:
+            import jax
+
+            sig = tuple((a.shape, str(a.dtype)) for a in inputs)
+            fn = self._jitted.get(sig)
+            if fn is None:
+                def run(datas):
+                    f = {n: NDArray(d) for n, d in zip(self._names, datas)}
+                    o = self._sym.eval_with(f)
+                    if isinstance(o, (list, tuple)):
+                        return [x.data for x in o]
+                    return o.data
+
+                fn = self._jitted[sig] = jax.jit(run)
+            res = fn([a.data for a in inputs])
+            out = [NDArray(r) for r in res] if isinstance(res, list) \
+                else NDArray(res)
+        return out if isinstance(out, list) else \
+            list(out) if isinstance(out, tuple) else [out]
+
+
+def cached_op_create(cell):
+    return CCachedOp(cell)
+
+
+def cached_op_invoke(cop, inputs):
+    return cop(list(inputs))
+
+
+# ---- autograd over the C ABI (reference: c_api_ndarray.cc
+# MXAutogradSetIsRecording/MXAutogradMarkVariables/MXAutogradBackwardEx,
+# src/c_api/c_api_ndarray.cc:81-143) -------------------------------------
+
+def autograd_set_recording(flag):
+    from . import autograd
+
+    prev = autograd.is_recording()
+    if flag and not prev:
+        # fresh top-level record over the ABI: drop any stale tape a
+        # backward-less forward left behind (same bounded-memory rule
+        # as autograd._scope on entering record())
+        autograd._STATE.tape = []
+    autograd.set_recording(bool(flag))
+    return int(prev)
+
+
+def autograd_set_training(flag):
+    from . import autograd
+
+    prev = autograd.is_training()
+    autograd.set_training(bool(flag))
+    return int(prev)
+
+
+_GRAD_REQ_NAMES = {0: "null", 1: "write", 2: "add"}
+
+
+def autograd_mark_variables(variables, grad_reqs, gradients):
+    from . import autograd
+
+    reqs = [_GRAD_REQ_NAMES.get(int(r), "write") for r in grad_reqs]
+    autograd.mark_variables(list(variables), list(gradients), reqs)
+    return None
+
+
+def autograd_backward(outputs, head_grads, retain_graph, train_mode):
+    from . import autograd
+
+    heads = list(outputs)
+    hg = None if head_grads is None else list(head_grads)
+    autograd.backward(heads, hg, retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+    return None
+
+
+def nd_get_grad(a):
+    if a.grad is None:
+        raise MXNetError("array has no gradient buffer "
+                         "(call MXAutogradMarkVariables first)")
+    return a.grad
+
+
+# ---- profiler over the C ABI (reference: src/c_api/c_api_profile.cc
+# MXSetProcessProfilerConfig/State, MXDumpProcessProfile,
+# MXAggregateProfileStatsPrint) ------------------------------------------
+
+def profiler_config(keys, vals):
+    from . import profiler
+
+    kwargs = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    profiler.set_config(**kwargs)
+    return None
+
+
+def profiler_set_state(state):
+    from . import profiler
+
+    profiler.set_state({0: "stop", 1: "run", 2: "pause"}.get(
+        int(state), "stop"))
+    return None
+
+
+def profiler_dump(finished):
+    from . import profiler
+
+    profiler.dump(finished=bool(finished))
+    return None
+
+
+def profiler_stats_print(reset):
+    from . import profiler
+
+    return profiler.dumps(reset=bool(reset))
+
+
+def random_seed(s):
+    from . import random as _r
+
+    _r.seed(int(s))
+    return None

@@ -14,6 +14,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import time as _time
+
+from .. import profiler as _prof
 
 from ..base import MXNetError
 
@@ -141,6 +144,22 @@ def invoke(opdef, args, kwargs):
             kw_arrays[k] = len(arr_args)
             arr_args.append(v)
             del kwargs[k]
+
+    if _prof.imperative_on():
+        t0 = _time.perf_counter()
+        try:
+            return _invoke_inner(opdef, args, kwargs, out, arr_args,
+                                 arg_template, kw_arrays)
+        finally:
+            _prof.record_op(opdef.name, t0 * 1e6,
+                            (_time.perf_counter() - t0) * 1e6)
+    return _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
+                         kw_arrays)
+
+
+def _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
+                  kw_arrays):
+    from .ndarray import NDArray
 
     amp_cast = _amp_cast_fn(opdef.name)
 

@@ -88,6 +88,21 @@ def is_running():
     return _state["running"]
 
 
+def imperative_on():
+    """Fast gate checked by the op-dispatch layer (reference: the
+    PROFILER_MESSAGE taps in src/imperative/imperative_utils.h fire when
+    profile_imperative/profile_all is set and the profiler runs)."""
+    return _state["running"] and (_config["profile_imperative"]
+                                  or _config["profile_all"])
+
+
+def record_op(name, start_us, dur_us):
+    """Per-op dispatch timing (NB: JAX dispatch is async — this measures
+    host-side dispatch+trace time, not device compute; device timing
+    lives in the XPlane trace)."""
+    _record("operator", name, start_us, dur_us, cat="imperative")
+
+
 def _record(domain, name, start_us, dur_us, cat="event", value=None):
     with _lock:
         if cat == "counter":

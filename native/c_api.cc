@@ -1013,3 +1013,198 @@ MXTPU_API int MXDataIterGetPadNum(void* it, int* out) {
   Py_DECREF(r);
   return 0;
 }
+
+// ------------------------------------------------------------------------
+// CachedOp (reference: src/c_api/c_api_ndarray.cc MXCreateCachedOp /
+// MXInvokeCachedOpEx — the hybridize engine over the C ABI)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXCreateCachedOp(void* sym, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", sym);
+  PyObject* r = bridge_call("cached_op_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // owned handle
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOp(void* handle, int num_inputs, void** inputs,
+                               int* num_outputs, void*** outputs) {
+  Gil gil;
+  PyObject* ins = handle_list(static_cast<uint32_t>(num_inputs), inputs);
+  PyObject* args = Py_BuildValue("(ON)", handle, ins);
+  PyObject* r = bridge_call("cached_op_invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_ssize_t n = PyList_Size(r);
+  clear_invoke_ret();
+  auto& ret = invoke_ret();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    ret.push_back(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = ret.data();
+  return 0;
+}
+
+MXTPU_API int MXFreeCachedOp(void* handle) {
+  if (handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// Autograd (reference: src/c_api/c_api_ndarray.cc:81-143
+// MXAutogradSetIsRecording / MXAutogradMarkVariables /
+// MXAutogradBackwardEx / MXNDArrayGetGrad)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", is_recording);
+  PyObject* r = bridge_call("autograd_set_recording", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", is_training);
+  PyObject* r = bridge_call("autograd_set_training", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradMarkVariables(uint32_t num_var, void** var_handles,
+                                      uint32_t* grad_reqs,
+                                      void** grad_handles) {
+  Gil gil;
+  PyObject* vars = handle_list(num_var, var_handles);
+  PyObject* grads = handle_list(num_var, grad_handles);
+  PyObject* reqs = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i) {
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_reqs[i]));
+  }
+  PyObject* args = Py_BuildValue("(NNN)", vars, reqs, grads);
+  PyObject* r = bridge_call("autograd_mark_variables", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradBackward(uint32_t num_output, void** output_handles,
+                                 void** head_grad_handles, int retain_graph,
+                                 int train_mode) {
+  Gil gil;
+  PyObject* outs = handle_list(num_output, output_handles);
+  PyObject* heads;
+  if (head_grad_handles == nullptr) {
+    heads = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    // reference MXAutogradBackwardEx allows per-entry NULL (ones-like
+    // seeding for that head) — map NULL to None, never INCREF(NULL)
+    heads = PyList_New(num_output);
+    for (uint32_t i = 0; i < num_output; ++i) {
+      PyObject* h = head_grad_handles[i] == nullptr
+          ? Py_None
+          : reinterpret_cast<PyObject*>(head_grad_handles[i]);
+      Py_INCREF(h);
+      PyList_SET_ITEM(heads, i, h);
+    }
+  }
+  PyObject* args = Py_BuildValue("(NNii)", outs, heads, retain_graph,
+                                 train_mode);
+  PyObject* r = bridge_call("autograd_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetGrad(void* handle, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = bridge_call("nd_get_grad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // new owned handle (caller frees with MXNDArrayFree)
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// Profiler (reference: src/c_api/c_api_profile.cc)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                                         const char** vals) {
+  Gil gil;
+  PyObject* k = PyList_New(num_params);
+  PyObject* v = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(NN)", k, v);
+  PyObject* r = bridge_call("profiler_config", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSetProcessProfilerState(int state) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* r = bridge_call("profiler_set_state", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDumpProcessProfile(int finished) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", finished);
+  PyObject* r = bridge_call("profiler_dump", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* r = bridge_call("profiler_stats_print", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  thread_local std::string buf;
+  const char* c = PyUnicode_AsUTF8(r);
+  buf = c ? c : "";
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* r = bridge_call("random_seed", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
