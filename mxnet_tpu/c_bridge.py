@@ -528,18 +528,31 @@ class CCachedOp:
         else:
             import jax
 
-            sig = tuple((a.shape, str(a.dtype)) for a in inputs)
+            from . import random as _mxrandom
+            from .ndarray import registry as _registry
+
+            # cache key mirrors gluon CachedOp (block.py): mode-dependent
+            # ops (dropout/BN) and AMP casts bake into the trace, and a
+            # PRNG key rides as an ARGUMENT so stochastic ops draw fresh
+            # randomness per call instead of replaying the traced mask
+            sig = (tuple((a.shape, str(a.dtype)) for a in inputs),
+                   autograd.is_training(), _registry.amp_version())
             fn = self._jitted.get(sig)
             if fn is None:
-                def run(datas):
-                    f = {n: NDArray(d) for n, d in zip(self._names, datas)}
-                    o = self._sym.eval_with(f)
+                train = autograd.is_training()
+
+                def run(datas, key):
+                    with _mxrandom.key_provider(key), \
+                            autograd._scope(training=train):
+                        f = {n: NDArray(d)
+                             for n, d in zip(self._names, datas)}
+                        o = self._sym.eval_with(f)
                     if isinstance(o, (list, tuple)):
                         return [x.data for x in o]
                     return o.data
 
                 fn = self._jitted[sig] = jax.jit(run)
-            res = fn([a.data for a in inputs])
+            res = fn([a.data for a in inputs], _mxrandom.next_key())
             out = [NDArray(r) for r in res] if isinstance(res, list) \
                 else NDArray(res)
         return out if isinstance(out, list) else \
@@ -586,7 +599,8 @@ def autograd_mark_variables(variables, grad_reqs, gradients):
     from . import autograd
 
     reqs = [_GRAD_REQ_NAMES.get(int(r), "write") for r in grad_reqs]
-    autograd.mark_variables(list(variables), list(gradients), reqs)
+    grads = [None if g is None else g for g in gradients]
+    autograd.mark_variables(list(variables), grads, reqs)
     return None
 
 
@@ -619,11 +633,25 @@ def profiler_config(keys, vals):
     return None
 
 
+_PROF_PAUSED = [False]
+
+
 def profiler_set_state(state):
     from . import profiler
 
-    profiler.set_state({0: "stop", 1: "run", 2: "pause"}.get(
-        int(state), "stop"))
+    state = int(state)
+    if state == 2:
+        profiler.pause()
+        _PROF_PAUSED[0] = True
+    elif state == 1:
+        if _PROF_PAUSED[0]:
+            profiler.resume()
+            _PROF_PAUSED[0] = False
+        else:
+            profiler.set_state("run")
+    else:
+        _PROF_PAUSED[0] = False
+        profiler.set_state("stop")
     return None
 
 

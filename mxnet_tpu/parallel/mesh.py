@@ -70,3 +70,44 @@ class mesh_scope:
 
 def current_mesh():
     return _CURRENT[-1] if _CURRENT else None
+
+
+def put_sharded(x, sharding):
+    """Place `x` under `sharding`, working across PROCESS boundaries.
+
+    jax.device_put handles the single-process case (and traced values,
+    where it lowers to a sharding constraint); for an eager multi-process
+    mesh the target sharding is not fully addressable and device_put
+    refuses, so the global array is assembled from this process's local
+    copy via make_array_from_callback — which requires the eager input to
+    be REPLICATED (every process holding identical data), the invariant
+    our eager collectives already assume for unsharded operands.
+    """
+    if isinstance(x, jax.core.Tracer) or \
+            getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    if getattr(x, "sharding", None) is not None and \
+            not x.is_fully_addressable:
+        # already a global array: only an identical sharding is free;
+        # anything else would need a cross-process reshard collective
+        if x.sharding == sharding:
+            return x
+        raise ValueError(
+            "cannot eagerly reshard a global (multi-process) array; "
+            "run the consuming op under jit instead")
+    host = onp.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+def put_back(out, orig_sharding, relayout):
+    """Epilogue pairing put_sharded: hand an eager collective's result
+    back in the caller's original layout when that is possible — traced
+    values and single-process arrays relayout freely; an eager
+    multi-process (non-addressable) result stays mesh-sharded."""
+    if not relayout:
+        return out
+    if isinstance(out, jax.core.Tracer) or \
+            getattr(out, "is_fully_addressable", True):
+        return jax.device_put(out, orig_sharding)
+    return out

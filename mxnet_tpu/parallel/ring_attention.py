@@ -105,11 +105,11 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", batch_axis=None,
         # one device; lay them out over the mesh, run the ring, and hand
         # the result back in the caller's layout (device_put is traceable
         # and differentiable, so this works eagerly, under vjp, and jit)
-        qx, kx, vx = (jax.device_put(x, sh) for x in (qx, kx, vx))
+        from .mesh import put_back, put_sharded
+
+        qx, kx, vx = (put_sharded(x, sh) for x in (qx, kx, vx))
         out = fn(qx, kx, vx)
-        if relayout:
-            out = jax.device_put(out, orig_sharding)
-        return out
+        return put_back(out, orig_sharding, relayout)
 
     if wrap_out:
         return _registry.apply_pure(pure, [q, k, v])

@@ -80,11 +80,11 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", batch_axis=None,
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
 
     def pure(qx, kx, vx):
-        qx, kx, vx = (jax.device_put(x, sh) for x in (qx, kx, vx))
+        from .mesh import put_back, put_sharded
+
+        qx, kx, vx = (put_sharded(x, sh) for x in (qx, kx, vx))
         out = fn(qx, kx, vx)
-        if relayout:
-            out = jax.device_put(out, orig_sharding)
-        return out
+        return put_back(out, orig_sharding, relayout)
 
     if wrap_out:
         return _registry.apply_pure(pure, [q, k, v])

@@ -1091,7 +1091,16 @@ MXTPU_API int MXAutogradMarkVariables(uint32_t num_var, void** var_handles,
                                       void** grad_handles) {
   Gil gil;
   PyObject* vars = handle_list(num_var, var_handles);
-  PyObject* grads = handle_list(num_var, grad_handles);
+  // grad_req 0 ("null") slots naturally carry NULL grad handles — map
+  // to None rather than Py_INCREF(NULL)
+  PyObject* grads = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i) {
+    PyObject* h = (grad_handles == nullptr || grad_handles[i] == nullptr)
+        ? Py_None
+        : reinterpret_cast<PyObject*>(grad_handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(grads, i, h);
+  }
   PyObject* reqs = PyList_New(num_var);
   for (uint32_t i = 0; i < num_var; ++i) {
     PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_reqs[i]));

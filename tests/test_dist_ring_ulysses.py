@@ -1,0 +1,79 @@
+"""Two-OS-process sequence parallelism: ring attention and Ulysses
+all-to-all attention across a process-spanning 2-device mesh, checked for
+exact equivalence against full (unsharded) attention on the same global
+tensors; plus MoE loss equivalence sharded-vs-local (VERDICT r4 item 6 —
+multi-process runs of the NEW parallelism with loss-equivalence asserts).
+"""
+from _dist_harness import run_launched_workers
+
+BODY = r"""
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import mxnet_tpu
+from mxnet_tpu.parallel.ring_attention import ring_attention
+from mxnet_tpu.parallel.ulysses import ulysses_attention
+from mxnet_tpu.parallel.moe import moe_ffn
+
+rank = jax.process_index()
+devs = jax.devices()
+assert len(devs) == 2, devs
+
+rng = onp.random.RandomState(0)
+B, H, S, D = 2, 4, 16, 8
+q = jnp.asarray(rng.randn(B, H, S, D).astype("f"))
+k = jnp.asarray(rng.randn(B, H, S, D).astype("f"))
+v = jnp.asarray(rng.randn(B, H, S, D).astype("f"))
+
+# reference: full attention on the replicated tensors
+sm = 1.0 / onp.sqrt(D)
+logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+ref_np = onp.asarray(ref)
+
+mesh = Mesh(onp.array(devs), ("sp",))
+ring_out = ring_attention(q, k, v, mesh=mesh, axis_name="sp")
+ring_vals = [onp.asarray(s.data) for s in ring_out.addressable_shards]
+# each process holds its S/2 sequence shard of the result
+lo = rank * (S // 2)
+ring_ok = all(
+    onp.allclose(vv, ref_np[:, :, lo:lo + S // 2, :], rtol=2e-4,
+                 atol=2e-5) for vv in ring_vals)
+
+uly_out = ulysses_attention(q, k, v, mesh=mesh, axis_name="sp")
+uly_vals = [onp.asarray(s.data) for s in uly_out.addressable_shards]
+uly_ok = all(
+    onp.allclose(vv, ref_np[:, :, lo:lo + S // 2, :], rtol=2e-4,
+                 atol=2e-5) for vv in uly_vals)
+
+# MoE loss equivalence: sharded (ep crossing the process boundary) vs
+# the single-shard fallback on the same global batch, ample capacity
+E, Dm, Hm = 4, 8, 16
+params = (jnp.asarray(rng.randn(Dm, E).astype("f") * 0.5),
+          jnp.asarray(rng.randn(E, Dm, Hm).astype("f") * 0.2),
+          jnp.zeros((E, Hm), jnp.float32),
+          jnp.asarray(rng.randn(E, Hm, Dm).astype("f") * 0.2),
+          jnp.zeros((E, Dm), jnp.float32))
+x = jnp.asarray(rng.randn(8, 4, Dm).astype("f"))
+out_sh, aux_sh = moe_ffn(x, *params, mesh=mesh, axis_name="ep",
+                         batch_axes=("ep",), capacity_factor=8.0)
+loss_sh = float(jnp.mean(out_sh ** 2) + 0.01 * aux_sh)
+out_lo, aux_lo = moe_ffn(x, *params, mesh=None, capacity_factor=8.0)
+loss_lo = float(jnp.mean(out_lo ** 2) + 0.01 * aux_lo)
+moe_ok = abs(loss_sh - loss_lo) < 5e-5 * max(1.0, abs(loss_lo))
+
+with open(os.path.join({outdir!r}, "r" + str(rank) + ".txt"), "w") as f:
+    f.write("OK" if (ring_ok and uly_ok and moe_ok) else
+            "BAD ring=%s uly=%s moe=%s (%r vs %r)" %
+            (ring_ok, uly_ok, moe_ok, loss_sh, loss_lo))
+"""
+
+
+def test_two_process_ring_ulysses_moe_equivalence(tmp_path):
+    run_launched_workers(tmp_path, BODY, n=2)
+    for rank in (0, 1):
+        p = tmp_path / f"r{rank}.txt"
+        assert p.is_file(), f"worker {rank} produced no result"
+        assert p.read_text() == "OK", p.read_text()
