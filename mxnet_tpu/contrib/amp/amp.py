@@ -27,7 +27,8 @@ def init(target_dtype="bfloat16"):
     _registry.set_amp(target_dtype,
                       target_ops=lists.TARGET_DTYPE_OPS,
                       fp32_ops=lists.FP32_OPS,
-                      widest_ops=lists.WIDEST_TYPE_CASTS)
+                      widest_ops=lists.WIDEST_TYPE_CASTS,
+                      conditional_ops=lists.CONDITIONAL_FP32_OPS)
     _state["initialized"] = True
     _state["target_dtype"] = target_dtype
 
@@ -117,8 +118,14 @@ def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
                 return base
             ins = [conv(i) for i in s._inputs]
             op, name = s._op, s._name
+            cond_f32 = any(
+                op == c_op and str(s._kwargs.get(c_attr)) in c_vals
+                for c_op, c_attr, c_vals in lists.CONDITIONAL_FP32_OPS)
             if op is not None and name not in excluded:
-                if op in tgt:
+                if cond_f32:
+                    ins = [cast_in(x, "float32", i)
+                           for i, x in enumerate(ins)]
+                elif op in tgt:
                     ins = [cast_in(x, target_dtype, i)
                            for i, x in enumerate(ins)]
                 elif op in f32:

@@ -5,6 +5,9 @@ Classification of registered ops for automatic mixed precision:
   are the MXU ops where bf16 doubles throughput)
 - FP32_OPS: numerically sensitive, always fp32
 - WIDEST_TYPE_CASTS: multi-input ops computed in the widest operand type
+- CONDITIONAL_FP32_OPS: fp32 only when a named attr takes listed values
+  (reference symbol.py:504 CONDITIONAL_FP32_FUNCS — softrelu's exp and
+  elu/selu's expm1 overflow in 16-bit)
 Everything unlisted runs in whatever dtype its inputs already have.
 """
 
@@ -30,4 +33,10 @@ WIDEST_TYPE_CASTS = [
     "broadcast_mod", "elemwise_add", "elemwise_sub", "elemwise_mul",
     "elemwise_div", "add_n", "concat", "stack", "where", "maximum",
     "minimum", "batch_take", "take_along_axis",
+]
+
+
+CONDITIONAL_FP32_OPS = [
+    ("activation", "act_type", ["softrelu"]),
+    ("leaky_relu", "act_type", ["elu", "selu"]),
 ]

@@ -42,6 +42,9 @@ class GraphBuilder:
         self.params = params  # name -> numpy
         self._initialized = set()
         self._n = 0
+        # translators bump this when they emit ops newer than the
+        # default opset; the model declares max(requested, min_opset)
+        self.min_opset = 13
 
     def uniq(self, base):
         self._n += 1
@@ -468,7 +471,288 @@ def export_model(sym, params, input_shape, input_type="float32",
 
     model = O.ModelProto(ir_version=7, producer_name="mxnet_tpu",
                          producer_version="3.0", graph=b.graph)
-    model.opset_import.add(domain="", version=opset_version)
+    model.opset_import.add(domain="",
+                           version=max(opset_version, b.min_opset))
     with open(onnx_file_path, "wb") as f:
         f.write(model.SerializeToString())
     return onnx_file_path
+
+
+# ---- round-5 breadth (reference _op_translations.py tail) ----------------
+
+for _mx, _onnx in [("sin", "Sin"), ("cos", "Cos"), ("tan", "Tan"),
+                   ("arcsin", "Asin"), ("arccos", "Acos"),
+                   ("arctan", "Atan"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+                   ("round", "Round"), ("sign", "Sign"),
+                   ("reciprocal", "Reciprocal"),
+                   ("depth_to_space", "DepthToSpace"),
+                   ("space_to_depth", "SpaceToDepth")]:
+    def _mk2(onnx_op):
+        def tr(b, name, ins, attrs):
+            kw = {}
+            if onnx_op in ("DepthToSpace", "SpaceToDepth"):
+                kw["blocksize"] = int(attrs.get("block_size", 2))
+            b.add_node(onnx_op, ins, [name], name=name, **kw)
+        return tr
+    register_translator(_mx)(_mk2(_onnx))
+
+
+@register_translator("where")
+def _where_exp(b, name, ins, attrs):
+    # mx conditions are float 0/1 masks; ONNX Where requires bool
+    cond = b.uniq(name + "_cond")
+    b.add_node("Cast", [ins[0]], [cond], to=int(O.TensorProto.BOOL))
+    b.add_node("Where", [cond] + list(ins[1:]), [name], name=name)
+
+
+@register_translator("gather_nd")
+def _gather_nd_exp(b, name, ins, attrs):
+    """mx gather_nd indices are (M, d1..dk) — index tuple on the LEADING
+    axis; ONNX GatherND wants it on the LAST. The inserted full-reverse
+    Transpose maps between them exactly for the rank-2 indices case
+    (the only layout this exporter supports; reference
+    _op_translations.py transposes the same way)."""
+    idx = b.uniq(name + "_idxT")
+    b.add_node("Transpose", [ins[1]], [idx])
+    idx64 = b.uniq(name + "_idx64")
+    b.add_node("Cast", [idx], [idx64], to=int(O.TensorProto.INT64))
+    b.add_node("GatherND", [ins[0], idx64], [name], name=name)
+    b.min_opset = max(b.min_opset, 12)
+
+
+def _cmp_export(onnx_op):
+    """mx comparisons return float 0/1 masks; ONNX returns bool — Cast
+    back to float32 to keep graph semantics identical."""
+    def tr(b, name, ins, attrs):
+        raw = b.uniq(name + "_bool")
+        b.add_node(onnx_op, ins, [raw])
+        b.add_node("Cast", [raw], [name], name=name,
+                   to=int(O.TensorProto.FLOAT))
+    return tr
+
+
+for _mx, _onnx in [("broadcast_greater", "Greater"),
+                   ("broadcast_lesser", "Less"),
+                   ("broadcast_equal", "Equal"),
+                   ("broadcast_greater_equal", "GreaterOrEqual"),
+                   ("broadcast_lesser_equal", "LessOrEqual"),
+                   ("broadcast_not_equal", "Equal")]:
+    if _mx == "broadcast_not_equal":
+        def _ne(b, name, ins, attrs):
+            eq = b.uniq(name + "_eq")
+            b.add_node("Equal", ins, [eq])
+            nb = b.uniq(name + "_not")
+            b.add_node("Not", [eq], [nb])
+            b.add_node("Cast", [nb], [name], name=name,
+                       to=int(O.TensorProto.FLOAT))
+        register_translator(_mx)(_ne)
+    else:
+        register_translator(_mx)(_cmp_export(_onnx))
+# GreaterOrEqual/LessOrEqual exist from opset 12 (covered by default 13)
+
+
+@register_translator("slice_axis")
+def _slice_axis(b, name, ins, attrs):
+    axis = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0) or 0)
+    end = attrs.get("end")
+    end = int(end) if end is not None else (2 ** 31 - 1)
+    b.add_node("Slice",
+               [ins[0], b.const(name + "_starts", onp.asarray([begin], "int64")),
+                b.const(name + "_ends", onp.asarray([end], "int64")),
+                b.const(name + "_axes", onp.asarray([axis], "int64"))],
+               [name], name=name)
+
+
+@register_translator("slice")
+def _slice(b, name, ins, attrs):
+    begin = [0 if v is None else int(v) for v in attrs.get("begin", ())]
+    end = [(2 ** 31 - 1) if v is None else int(v)
+           for v in attrs.get("end", ())]
+    axes = list(range(len(begin)))
+    extra = [b.const(name + "_starts", onp.asarray(begin, "int64")),
+             b.const(name + "_ends", onp.asarray(end, "int64")),
+             b.const(name + "_axes", onp.asarray(axes, "int64"))]
+    step = attrs.get("step")
+    if step:
+        extra.append(b.const(name + "_steps", onp.asarray(
+            [1 if v is None else int(v) for v in step], "int64")))
+    b.add_node("Slice", [ins[0]] + extra, [name], name=name)
+
+
+@register_translator("split")
+def _split(b, name, ins, attrs):
+    b.add_node("Split", ins, [name], name=name,
+               axis=int(attrs.get("axis", 1)))
+
+
+def _split_multi(b, name, ins, attrs, outs):
+    b.add_node("Split", ins, outs, name=name,
+               axis=int(attrs.get("axis", 1)))
+
+
+_split.multi = _split_multi
+
+
+@register_translator("embedding")
+def _embedding(b, name, ins, attrs):
+    idx = b.uniq(name + "_idx")
+    b.add_node("Cast", [ins[0]], [idx], to=int(O.TensorProto.INT64))
+    b.add_node("Gather", [ins[1], idx], [name], name=name, axis=0)
+
+
+@register_translator("take")
+def _take(b, name, ins, attrs):
+    idx = b.uniq(name + "_idx")
+    b.add_node("Cast", [ins[1]], [idx], to=int(O.TensorProto.INT64))
+    b.add_node("Gather", [ins[0], idx], [name], name=name,
+               axis=int(attrs.get("axis", 0)))
+
+
+@register_translator("cast")
+def _cast(b, name, ins, attrs):
+    b.add_node("Cast", ins, [name], name=name,
+               to=int(_DTYPE_TO_ONNX[str(attrs.get("dtype", "float32"))]))
+
+
+@register_translator("tile")
+def _tile(b, name, ins, attrs):
+    reps = attrs.get("reps") or attrs.get("reps_", ())
+    b.add_node("Tile",
+               [ins[0], b.const(name + "_reps",
+                                onp.asarray(list(reps), "int64"))],
+               [name], name=name)
+
+
+@register_translator("broadcast_to")
+def _broadcast_to(b, name, ins, attrs):
+    b.add_node("Expand",
+               [ins[0], b.const(name + "_shape", onp.asarray(
+                   list(attrs.get("shape", ())), "int64"))],
+               [name], name=name)
+
+
+@register_translator("shape_array")
+def _shape_array(b, name, ins, attrs):
+    b.add_node("Shape", ins, [name], name=name)
+
+
+@register_translator("one_hot")
+def _one_hot(b, name, ins, attrs):
+    depth = int(attrs["depth"])
+    on = float(attrs.get("on_value", 1.0))
+    off = float(attrs.get("off_value", 0.0))
+    idx = b.uniq(name + "_idx")
+    b.add_node("Cast", [ins[0]], [idx], to=int(O.TensorProto.INT64))
+    b.add_node("OneHot",
+               [idx, b.const(name + "_depth", onp.asarray(depth, "int64")),
+                b.const(name + "_vals", onp.asarray([off, on], "float32"))],
+               [name], name=name, axis=-1)
+
+
+@register_translator("argmax")
+def _argmax(b, name, ins, attrs):
+    raw = b.uniq(name + "_i64")
+    axis = attrs.get("axis")
+    data = ins[0]
+    if axis is None:
+        # axis=None flattens first (mx semantics: one flat index)
+        flat = b.uniq(name + "_flat")
+        b.add_node("Reshape",
+                   [data, b.const(name + "_m1",
+                                  onp.asarray([-1], "int64"))], [flat])
+        data, axis = flat, 0
+    b.add_node("ArgMax", [data], [raw], axis=int(axis),
+               keepdims=int(attrs.get("keepdims", False)))
+    b.add_node("Cast", [raw], [name], name=name,
+               to=int(O.TensorProto.FLOAT))
+
+
+@register_translator("argmin")
+def _argmin(b, name, ins, attrs):
+    raw = b.uniq(name + "_i64")
+    axis = attrs.get("axis")
+    data = ins[0]
+    if axis is None:
+        # axis=None flattens first (mx semantics: one flat index)
+        flat = b.uniq(name + "_flat")
+        b.add_node("Reshape",
+                   [data, b.const(name + "_m1",
+                                  onp.asarray([-1], "int64"))], [flat])
+        data, axis = flat, 0
+    b.add_node("ArgMin", [data], [raw], axis=int(axis),
+               keepdims=int(attrs.get("keepdims", False)))
+    b.add_node("Cast", [raw], [name], name=name,
+               to=int(O.TensorProto.FLOAT))
+
+
+@register_translator("topk")
+def _topk(b, name, ins, attrs):
+    raise MXNetError("ONNX TopK exports ret_typ='both' only")
+
+
+def _topk_multi(b, name, ins, attrs, outs):
+    if attrs.get("ret_typ", "indices") != "both":
+        raise MXNetError("ONNX TopK exports ret_typ='both' only")
+    k = int(attrs.get("k", 1))
+    axis = int(attrs.get("axis", -1))
+    idx_raw = b.uniq(name + "_idx64")
+    b.add_node("TopK",
+               [ins[0], b.const(name + "_k", onp.asarray([k], "int64"))],
+               [outs[0], idx_raw], name=name, axis=axis,
+               largest=int(not attrs.get("is_ascend", False)))
+    b.add_node("Cast", [idx_raw], [outs[1]],
+               to=int(O.TensorProto.FLOAT))
+
+
+_topk.multi = _topk_multi
+
+
+@register_translator("layer_norm")
+def _layer_norm(b, name, ins, attrs):
+    b.add_node("LayerNormalization", ins[:3], [name], name=name,
+               axis=int(attrs.get("axis", -1)),
+               epsilon=float(attrs.get("eps", 1e-5)))
+    b.min_opset = max(b.min_opset, 17)  # LayerNormalization: opset >=17
+
+
+@register_translator("instance_norm")
+def _instance_norm(b, name, ins, attrs):
+    b.add_node("InstanceNormalization", ins[:3], [name], name=name,
+               epsilon=float(attrs.get("eps", 1e-3)))
+
+
+@register_translator("norm")
+def _norm(b, name, ins, attrs):
+    ordv = int(attrs.get("ord", 2))
+    axis = attrs.get("axis")
+    kw = {"keepdims": int(attrs.get("keepdims", False))}
+    if axis is not None:
+        kw["axes"] = [axis] if isinstance(axis, int) else list(axis)
+    op = {1: "ReduceL1", 2: "ReduceL2"}.get(ordv)
+    if op is None:
+        raise MXNetError(f"ONNX export supports norm ord 1/2, got {ordv}")
+    b.add_node(op, ins, [name], name=name, **kw)
+
+
+@register_translator("upsampling")
+def _upsampling(b, name, ins, attrs):
+    scale = float(attrs.get("scale", 2))
+    b.add_node("Resize",
+               [ins[0], b.const(name + "_roi", onp.asarray([], "float32")),
+                b.const(name + "_scales",
+                        onp.asarray([1.0, 1.0, scale, scale], "float32"))],
+               [name], name=name, mode=b"nearest" and "nearest")
+
+
+@register_translator("stack")
+def _stack(b, name, ins, attrs):
+    axis = int(attrs.get("axis", 0))
+    unsq = []
+    for i, x in enumerate(ins):
+        u = b.uniq(f"{name}_u{i}")
+        b.add_node("Unsqueeze",
+                   [x, b.const(f"{name}_ax{i}",
+                               onp.asarray([axis], "int64"))], [u])
+        unsq.append(u)
+    b.add_node("Concat", unsq, [name], name=name, axis=axis)

@@ -491,6 +491,269 @@ def _pad(ctx, node, attrs):
         pad_width=tuple(width), name=node.name or node.output[0]))
 
 
+
+
+# ---- round-5 breadth (mirrors mx2onnx additions; reference
+# _op_translations.py import direction) ------------------------------------
+
+for _onnx, _mx in [("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                   ("Asin", "arcsin"), ("Acos", "arccos"),
+                   ("Atan", "arctan"), ("Sinh", "sinh"), ("Cosh", "cosh"),
+                   ("Round", "round"), ("Sign", "sign"),
+                   ("Reciprocal", "reciprocal")]:
+    def _mk_un2(mxop):
+        def tr(ctx, node, attrs):
+            _set(ctx, node, getattr(ctx.sym, mxop)(
+                ctx.inp(node.input[0]), name=node.name or node.output[0]))
+        return tr
+    if _onnx not in ONNX2MX_OPS:
+        register_import(_onnx)(_mk_un2(_mx))
+
+
+for _onnx, _mx in [("Greater", "broadcast_greater"),
+                   ("Less", "broadcast_lesser"),
+                   ("Equal", "broadcast_equal"),
+                   ("GreaterOrEqual", "broadcast_greater_equal"),
+                   ("LessOrEqual", "broadcast_lesser_equal")]:
+    def _mk_cmp(mxop):
+        def tr(ctx, node, attrs):
+            _set(ctx, node, getattr(ctx.sym, mxop)(
+                ctx.inp(node.input[0]), ctx.inp(node.input[1]),
+                name=node.name or node.output[0]))
+        return tr
+    register_import(_onnx)(_mk_cmp(_mx))
+
+
+@register_import("Not")
+def _not(ctx, node, attrs):
+    x = ctx.inp(node.input[0])
+    _set(ctx, node, ctx.sym.broadcast_equal(
+        x, ctx.sym.zeros_like(x), name=node.name or node.output[0]))
+
+
+@register_import("Where")
+def _where(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.where(
+        ctx.inp(node.input[0]), ctx.inp(node.input[1]),
+        ctx.inp(node.input[2]), name=node.name or node.output[0]))
+
+
+@register_import("Cast")
+def _cast_imp(ctx, node, attrs):
+    dt = _ONNX_TO_DTYPE.get(int(attrs.get("to", O.TensorProto.FLOAT)),
+                            "float32")
+    _set(ctx, node, ctx.sym.cast(ctx.inp(node.input[0]), dtype=dt,
+                                 name=node.name or node.output[0]))
+
+
+@register_import("Slice")
+def _slice_imp(ctx, node, attrs):
+    if "starts" in attrs:  # opset<10 attribute form
+        starts = tuple(attrs["starts"])
+        ends = tuple(attrs["ends"])
+        axes = tuple(attrs.get("axes", range(len(starts))))
+        steps = (1,) * len(starts)
+    else:
+        starts = tuple(int(x) for x in ctx.const_value(node.input[1]))
+        ends = tuple(int(x) for x in ctx.const_value(node.input[2]))
+        axes = tuple(int(x) for x in ctx.const_value(node.input[3])) \
+            if len(node.input) > 3 else tuple(range(len(starts)))
+        steps = tuple(int(x) for x in ctx.const_value(node.input[4])) \
+            if len(node.input) > 4 else (1,) * len(starts)
+    out = ctx.inp(node.input[0])
+    big = 2 ** 31 - 1
+    for ax, s, e, st in zip(axes, starts, ends, steps):
+        if st != 1:
+            raise MXNetError("Slice import supports step 1 only")
+        out = ctx.sym.slice_axis(out, axis=int(ax), begin=int(s),
+                                 end=None if e >= big else int(e))
+    out._name = node.name or node.output[0]
+    _set(ctx, node, out)
+
+
+@register_import("Split")
+def _split_imp(ctx, node, attrs):
+    n = len(node.output)
+    axis = int(attrs.get("axis", 0))
+    sizes = attrs.get("split")
+    if sizes is None and len(node.input) > 1 and node.input[1]:
+        sizes = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    if sizes is not None and len(set(sizes)) > 1:
+        # uneven split: slice_axis chain honoring the exact sizes
+        start = 0
+        for oname, sz in zip(node.output, sizes):
+            ctx.tensors[oname] = ctx.sym.slice_axis(
+                ctx.inp(node.input[0]), axis=axis, begin=start,
+                end=start + int(sz))
+            start += int(sz)
+        return
+    parts = ctx.sym.split(ctx.inp(node.input[0]), num_outputs=n,
+                          axis=axis, name=node.name or node.output[0])
+    for i, oname in enumerate(node.output):
+        ctx.tensors[oname] = parts[i] if n > 1 else parts
+
+
+@register_import("Gather")
+def _gather(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.take(
+        ctx.inp(node.input[0]), ctx.inp(node.input[1]),
+        axis=int(attrs.get("axis", 0)),
+        name=node.name or node.output[0]))
+
+
+@register_import("GatherND")
+def _gather_nd(ctx, node, attrs):
+    # ONNX puts the index tuple on the LAST indices axis, mx gather_nd
+    # on the FIRST — full-reverse transpose maps rank-2 indices exactly
+    idx = ctx.sym.transpose(ctx.inp(node.input[1]))
+    _set(ctx, node, ctx.sym.gather_nd(
+        ctx.inp(node.input[0]), idx,
+        name=node.name or node.output[0]))
+
+
+@register_import("Tile")
+def _tile_imp(ctx, node, attrs):
+    reps = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    _set(ctx, node, ctx.sym.tile(ctx.inp(node.input[0]), reps=reps,
+                                 name=node.name or node.output[0]))
+
+
+@register_import("Expand")
+def _expand(ctx, node, attrs):
+    shape = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    _set(ctx, node, ctx.sym.broadcast_to(
+        ctx.inp(node.input[0]), shape=shape,
+        name=node.name or node.output[0]))
+
+
+@register_import("Shape")
+def _shape_imp(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.shape_array(
+        ctx.inp(node.input[0]), name=node.name or node.output[0]))
+
+
+@register_import("OneHot")
+def _one_hot_imp(ctx, node, attrs):
+    depth = int(onp.asarray(ctx.const_value(node.input[1])).reshape(()))
+    vals = onp.asarray(ctx.const_value(node.input[2])).reshape(-1)
+    _set(ctx, node, ctx.sym.one_hot(
+        ctx.inp(node.input[0]), depth=depth,
+        off_value=float(vals[0]), on_value=float(vals[1]),
+        name=node.name or node.output[0]))
+
+
+@register_import("ArgMax")
+def _argmax_imp(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.argmax(
+        ctx.inp(node.input[0]), axis=int(attrs.get("axis", 0)),
+        keepdims=bool(attrs.get("keepdims", 1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("ArgMin")
+def _argmin_imp(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.argmin(
+        ctx.inp(node.input[0]), axis=int(attrs.get("axis", 0)),
+        keepdims=bool(attrs.get("keepdims", 1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("TopK")
+def _topk_imp(ctx, node, attrs):
+    k = int(onp.asarray(ctx.const_value(node.input[1])).reshape(-1)[0])
+    res = ctx.sym.topk(ctx.inp(node.input[0]), k=k,
+                       axis=int(attrs.get("axis", -1)),
+                       ret_typ="both",
+                       is_ascend=not bool(attrs.get("largest", 1)),
+                       name=node.name or node.output[0])
+    ctx.tensors[node.output[0]] = res[0]
+    if len(node.output) > 1:
+        ctx.tensors[node.output[1]] = res[1]
+
+
+@register_import("LayerNormalization")
+def _layer_norm_imp(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.layer_norm(
+        ctx.inp(node.input[0]), ctx.inp(node.input[1]),
+        ctx.inp(node.input[2]), axis=int(attrs.get("axis", -1)),
+        eps=float(attrs.get("epsilon", 1e-5)),
+        name=node.name or node.output[0]))
+
+
+@register_import("InstanceNormalization")
+def _instance_norm_imp(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.instance_norm(
+        ctx.inp(node.input[0]), ctx.inp(node.input[1]),
+        ctx.inp(node.input[2]), eps=float(attrs.get("epsilon", 1e-3)),
+        name=node.name or node.output[0]))
+
+
+@register_import("ReduceL1")
+def _reduce_l1(ctx, node, attrs):
+    axes = attrs.get("axes")
+    _set(ctx, node, ctx.sym.norm(
+        ctx.inp(node.input[0]), ord=1,
+        axis=tuple(axes) if axes else None,
+        keepdims=bool(attrs.get("keepdims", 1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("ReduceL2")
+def _reduce_l2(ctx, node, attrs):
+    axes = attrs.get("axes")
+    _set(ctx, node, ctx.sym.norm(
+        ctx.inp(node.input[0]), ord=2,
+        axis=tuple(axes) if axes else None,
+        keepdims=bool(attrs.get("keepdims", 1)),
+        name=node.name or node.output[0]))
+
+
+@register_import("DepthToSpace")
+def _d2s(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.depth_to_space(
+        ctx.inp(node.input[0]), block_size=int(attrs["blocksize"]),
+        name=node.name or node.output[0]))
+
+
+@register_import("SpaceToDepth")
+def _s2d(ctx, node, attrs):
+    _set(ctx, node, ctx.sym.space_to_depth(
+        ctx.inp(node.input[0]), block_size=int(attrs["blocksize"]),
+        name=node.name or node.output[0]))
+
+
+@register_import("Resize")
+def _resize(ctx, node, attrs):
+    mode = attrs.get("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if mode != "nearest":
+        raise MXNetError(f"Resize import supports mode='nearest' only "
+                         f"(got {mode!r})")
+    scales = None
+    if len(node.input) > 2 and node.input[2]:
+        scales = onp.asarray(ctx.const_value(node.input[2])).reshape(-1)
+    if scales is None or len(scales) != 4 or scales[2] != scales[3]:
+        raise MXNetError("Resize import supports uniform HW scales only")
+    if scales[0] != 1.0 or scales[1] != 1.0:
+        raise MXNetError("Resize import cannot scale batch/channel dims")
+    if float(scales[2]) != int(scales[2]):
+        raise MXNetError(f"Resize import needs an integer HW scale "
+                         f"(got {float(scales[2])})")
+    _set(ctx, node, ctx.sym.UpSampling(
+        ctx.inp(node.input[0]), scale=int(scales[2]),
+        sample_type="nearest", name=node.name or node.output[0]))
+
+
+@register_import("Constant")
+def _constant(ctx, node, attrs):
+    for a in node.attribute:
+        if a.name == "value":
+            ctx.params[node.output[0]] = _tensor_to_numpy(a.t)
+            return
+    raise MXNetError("Constant node without value tensor")
+
+
 def import_model(model_file):
     """ONNX file -> (sym, arg_params, aux_params).
 

@@ -24,7 +24,8 @@ _OPS = {}
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "differentiable", "doc", "namespaces")
+    __slots__ = ("name", "fn", "differentiable", "doc", "namespaces",
+                 "_sig")
 
     def __init__(self, name, fn, differentiable=True, doc=None, namespaces=("nd",)):
         self.name = name
@@ -32,6 +33,14 @@ class OpDef:
         self.differentiable = differentiable
         self.doc = doc or fn.__doc__
         self.namespaces = namespaces
+        self._sig = None
+
+    def signature(self):
+        if self._sig is None:
+            import inspect
+
+            self._sig = inspect.signature(self.fn)
+        return self._sig
 
 
 def register(name=None, differentiable=True, namespaces=("nd",)):
@@ -67,17 +76,22 @@ def _unwrap(x):
 # invoke() casts float inputs per the op lists before dispatch (the
 # reference wraps every registered op at amp.init, contrib/amp/amp.py:251)
 _AMP = {"on": False, "target": None, "target_ops": frozenset(),
-        "fp32_ops": frozenset(), "widest_ops": frozenset(), "version": 0}
+        "fp32_ops": frozenset(), "widest_ops": frozenset(),
+        "conditional_ops": {}, "version": 0}
 
 _FLOATS = ("float16", "bfloat16", "float32", "float64")
 
 
-def set_amp(target_dtype=None, target_ops=(), fp32_ops=(), widest_ops=()):
+def set_amp(target_dtype=None, target_ops=(), fp32_ops=(), widest_ops=(),
+            conditional_ops=()):
     _AMP["on"] = target_dtype is not None
     _AMP["target"] = target_dtype
     _AMP["target_ops"] = frozenset(target_ops)
     _AMP["fp32_ops"] = frozenset(fp32_ops)
     _AMP["widest_ops"] = frozenset(widest_ops)
+    # op -> (attr_name, frozenset(values)): fp32 when the attr matches
+    _AMP["conditional_ops"] = {op: (attr, frozenset(vals))
+                               for op, attr, vals in conditional_ops}
     # traced code (CachedOp) bakes the casts in; bumping the version keys
     # a fresh trace so init()/disable() take effect on hybridized blocks
     _AMP["version"] += 1
@@ -87,12 +101,34 @@ def amp_version():
     return _AMP["version"]
 
 
-def _amp_cast_fn(opname):
+def _cond_attr(opdef, args, kwargs, attr):
+    """Value of `attr` whether passed by keyword or positionally."""
+    if kwargs and attr in kwargs:
+        return kwargs[attr]
+    if args:
+        try:
+            bound = opdef.signature().bind_partial(*args, **(kwargs or {}))
+            return bound.arguments.get(attr)
+        except TypeError:
+            return None
+    return None
+
+
+def _amp_cast_fn(opdef, args=None, kwargs=None):
     """Returns f(list of arrays) -> list of arrays applying the AMP policy
     for this op, or None. Applied inside the op's pure function so the
     casts sit on the tape/jaxpr and gradients flow back through them."""
+    opname = opdef.name if isinstance(opdef, OpDef) else opdef
     if not _AMP["on"]:
         return None
+    cond = _AMP["conditional_ops"].get(opname)
+    if cond is not None and isinstance(opdef, OpDef) and \
+            str(_cond_attr(opdef, args, kwargs, cond[0])) in cond[1]:
+        def c32(xs):
+            return [x.astype("float32") if hasattr(x, "dtype")
+                    and str(x.dtype) in _FLOATS
+                    and str(x.dtype) != "float32" else x for x in xs]
+        return c32
     if opname in _AMP["target_ops"]:
         to = _AMP["target"]
     elif opname in _AMP["fp32_ops"]:
@@ -161,7 +197,7 @@ def _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
                   kw_arrays):
     from .ndarray import NDArray
 
-    amp_cast = _amp_cast_fn(opdef.name)
+    amp_cast = _amp_cast_fn(opdef, args, kwargs)
 
     def pure_fn(*xs):
         if amp_cast is not None:

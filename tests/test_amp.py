@@ -97,3 +97,40 @@ def test_convert_model_keeps_norms_fp32():
     assert any(v == "bfloat16" for k, v in dtypes.items() if "dense" in k)
     assert all(v == "float32" for k, v in dtypes.items()
                if "batchnorm" in k or "gamma" in k or "beta" in k)
+
+
+def test_amp_conditional_fp32_ops():
+    """CONDITIONAL_FP32_OPS (reference symbol.py:504): softrelu/elu/selu
+    run fp32 under AMP (their exp/expm1 overflow in 16-bit); other attr
+    values keep the target dtype."""
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import amp
+
+    amp.init("bfloat16")
+    try:
+        x = nd.array(onp.random.rand(4, 8).astype("f")).astype("bfloat16")
+        assert nd.Activation(x, act_type="softrelu").dtype == onp.float32
+        assert str(nd.Activation(x, act_type="relu").dtype) == "bfloat16"
+        assert nd.LeakyReLU(x, act_type="elu").dtype == onp.float32
+        assert nd.LeakyReLU(x, act_type="selu").dtype == onp.float32
+        assert str(nd.LeakyReLU(x, act_type="leaky").dtype) == "bfloat16"
+    finally:
+        amp.disable()
+
+
+def test_amp_convert_symbol_conditional():
+    import json
+
+    import mxnet_tpu.symbol as S
+    from mxnet_tpu.contrib import amp
+
+    a = S.Variable("data")
+    net = S.Activation(S.FullyConnected(a, name="fc", num_hidden=4),
+                       name="sr", act_type="softrelu")
+    cs = amp.convert_symbol(net, target_dtype="bfloat16")
+    nodes = json.loads(cs.tojson())["nodes"]
+    f32_casts = [n for n in nodes if n["op"] == "amp_cast"
+                 and "float32" in str(n.get("attrs", {}))]
+    assert f32_casts, "softrelu input not cast to fp32"

@@ -126,3 +126,101 @@ def test_onnx_symbol_level_ops(tmp_path):
     ex = s.bind(mx.cpu(), {"data": nd.array(A)})
     (res,) = ex.forward()
     onp.testing.assert_allclose(res.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def _rt_sym(out_sym, feed, tmp_path, fname, in_shapes, rtol=1e-5,
+            atol=1e-5, extra_feed=None):
+    """Export a hand-built symbol, re-import, compare eval outputs."""
+    ref = out_sym.eval_with(dict(feed))
+    f = onnx_mxnet.export_model(out_sym, dict(extra_feed or {}), in_shapes,
+                                onnx_file_path=str(tmp_path / fname))
+    s, args, aux = onnx_mxnet.import_model(f)
+    feed2 = dict(feed)
+    feed2.update(args)
+    feed2.update(aux)
+    got = s.eval_with(feed2)
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    gots = got if isinstance(got, (list, tuple)) else [got]
+    for r, g in zip(refs, gots):
+        onp.testing.assert_allclose(g.asnumpy(), r.asnumpy(),
+                                    rtol=rtol, atol=atol)
+
+
+def test_onnx_r5_indexing_ops(tmp_path):
+    """slice/slice_axis/split/take/tile/broadcast_to/stack round-trips."""
+    a = sym.Variable("data")
+    A = rs.rand(4, 6).astype("f")
+    _rt_sym(sym.slice(a, begin=(1, 0), end=(3, 4)), {"data": nd.array(A)},
+            tmp_path, "sl.onnx", (4, 6))
+    _rt_sym(sym.slice_axis(a, axis=1, begin=2, end=5),
+            {"data": nd.array(A)}, tmp_path, "sa.onnx", (4, 6))
+    _rt_sym(sym.tile(a, reps=(2, 1)), {"data": nd.array(A)}, tmp_path,
+            "ti.onnx", (4, 6))
+    parts = sym.split(a, num_outputs=2, axis=1)
+    _rt_sym(sym.Group([parts[0], parts[1]]), {"data": nd.array(A)},
+            tmp_path, "sp.onnx", (4, 6))
+    idx = sym.Variable("idx")
+    _rt_sym(sym.take(a, idx, axis=0),
+            {"data": nd.array(A), "idx": nd.array([0., 2., 1.])},
+            tmp_path, "tk.onnx", {"data": (4, 6), "idx": (3,)})
+    b = sym.Variable("b")
+    B = rs.rand(1, 6).astype("f")
+    _rt_sym(sym.broadcast_to(b, shape=(4, 6)), {"b": nd.array(B)},
+            tmp_path, "bt.onnx", {"b": (1, 6)})
+    _rt_sym(sym.stack(a, a * 2.0, axis=0), {"data": nd.array(A)},
+            tmp_path, "st.onnx", (4, 6))
+
+
+def test_onnx_r5_compare_where_onehot(tmp_path):
+    a = sym.Variable("data")
+    b = sym.Variable("b")
+    A = rs.rand(3, 4).astype("f")
+    B = rs.rand(3, 4).astype("f")
+    feed = {"data": nd.array(A), "b": nd.array(B)}
+    shapes = {"data": (3, 4), "b": (3, 4)}
+    _rt_sym(sym.broadcast_greater(a, b), feed, tmp_path, "gt.onnx", shapes)
+    _rt_sym(sym.broadcast_not_equal(a, b), feed, tmp_path, "ne.onnx",
+            shapes)
+    _rt_sym(sym.where(sym.broadcast_greater(a, b), a, b), feed, tmp_path,
+            "wh.onnx", shapes)
+    lbl = sym.Variable("lbl")
+    _rt_sym(sym.one_hot(lbl, depth=5),
+            {"lbl": nd.array([0., 3., 2.])}, tmp_path, "oh.onnx",
+            {"lbl": (3,)})
+
+
+def test_onnx_r5_math_norm_argmax(tmp_path):
+    a = sym.Variable("data")
+    A = (rs.rand(3, 5).astype("f") - 0.3)
+    feed = {"data": nd.array(A)}
+    for op in ("sin", "cos", "round", "sign", "reciprocal", "arctan"):
+        _rt_sym(getattr(sym, op)(a), feed, tmp_path, f"{op}.onnx", (3, 5),
+                rtol=1e-4, atol=1e-5)
+    _rt_sym(sym.norm(a, ord=2, axis=1), feed, tmp_path, "l2.onnx", (3, 5))
+    _rt_sym(sym.argmax(a, axis=1), feed, tmp_path, "am.onnx", (3, 5))
+    _rt_sym(sym.cast(a, dtype="int32"), feed, tmp_path, "ct.onnx", (3, 5))
+    vals_idx = sym.topk(a, k=2, axis=1, ret_typ="both")
+    _rt_sym(sym.Group([vals_idx[0], vals_idx[1]]), feed, tmp_path,
+            "tkk.onnx", (3, 5))
+
+
+def test_onnx_r5_norm_layers(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(12), nn.LayerNorm())
+    _roundtrip_block(net, (2, 8), tmp_path)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Conv2D(4, 3, padding=1), nn.InstanceNorm(),
+             nn.Activation("relu"))
+    _roundtrip_block(net2, (2, 3, 8, 8), tmp_path)
+
+
+def test_onnx_r5_embedding_gather(tmp_path):
+    w = sym.Variable("w")
+    idx = sym.Variable("data")
+    emb = sym.Embedding(idx, w, input_dim=10, output_dim=4)
+    W = rs.rand(10, 4).astype("f")
+    _rt_sym(emb, {"data": nd.array([1., 4., 7.]), "w": nd.array(W)},
+            tmp_path, "em.onnx", {"data": (3,)},
+            extra_feed={"w": nd.array(W)})
