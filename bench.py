@@ -238,13 +238,24 @@ def child_main(platform):
     state = os.environ.get("BENCH_STATE")
     progress = {}  # persisted across retries via the state file
 
-    def checkpoint():
+    def checkpoint(phase=None):
+        if phase:
+            progress["phase"] = phase
         if state:
             try:
                 with open(state, "w") as f:
                     f.write(json.dumps(progress))
             except OSError:
                 pass
+
+    # phase markers drive the parent's kill policy: it may only
+    # terminate a child that has not yet claimed the tunnel ("init") —
+    # killing mid-compile is what wedged the tunnel in rounds 3/4
+    checkpoint("init")
+    import jax
+
+    jax.devices()  # tunnel dial happens HERE, before any compile
+    checkpoint("devices")
 
     def measure(tag, batch, dtype):
         """OOM-halving descent; returns (imgs/s, batch) or raises
@@ -253,10 +264,11 @@ def child_main(platform):
         last_err = None
         while batch >= 16:
             progress.update({"tag": tag, "batch": batch})
-            checkpoint()
+            checkpoint("compile")  # a fresh batch size recompiles
             try:
                 imgs, _ = run(batch=batch, image_size=224, classes=1000,
                               dtype=dtype)
+                checkpoint("run")
                 return imgs, batch
             except RuntimeError as e:  # OOM → halve the batch
                 last_err = e
@@ -323,6 +335,7 @@ def child_main(platform):
     # training results are safe NOW (the parent takes the LAST metric
     # line) — a scoring hang/failure can no longer discard them
     print(json.dumps(result), flush=True)
+    checkpoint("scoring")
     # inference scoring vs the reference's V100 table (perf.md:187-215);
     # per-dtype try so an fp32 failure doesn't take bf16 down with it
     try:
@@ -583,29 +596,109 @@ def io_main():
 
 # --------------------------------------------------------------- parent ---
 
-def _attempt(platform, timeout):
-    """Run the child; return its JSON line or None."""
-    env = dict(os.environ, BENCH_CHILD=platform)
+def _parse_metric_lines(text):
+    """Last valid metric JSON line in `text`, or None."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                if "metric" in json.loads(line):
+                    return line
+            except ValueError:
+                continue
+    return None
+
+
+# per-phase stall budgets (seconds since the child last wrote a phase
+# marker). "init" = dialing the tunnel: killing there is safe (no
+# compile in flight — the same thing every health probe does). Once a
+# compile may be running the child is NEVER killed on a stall shorter
+# than the compile budget: a mid-compile SIGKILL wedged the tunnel for
+# ~9h in round 4 (BENCH_NOTES_r04.md).
+_PHASE_BUDGET = {"init": 240, "devices": 180, "compile": 900,
+                 "run": 600, "scoring": 900}
+_ATTEMPT_CAP = 1800  # absolute wall per attempt
+
+
+def _read_phase(state):
     try:
-        proc = subprocess.run(
+        with open(state) as f:
+            phase = json.loads(f.read()).get("phase", "init")
+        return phase, os.path.getmtime(state)
+    except (OSError, ValueError):
+        return None, None
+
+
+def _attempt(platform, timeout):
+    """Run the child under phase-aware supervision; return its last
+    metric JSON line (possibly from a partially-complete run) or None.
+    `timeout` only bounds the CPU-fallback child; the axon child is
+    governed by the phase budgets above."""
+    env = dict(os.environ, BENCH_CHILD=platform)
+    state = env.get("BENCH_STATE", "")
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as outf, \
+            tempfile.TemporaryFile(mode="w+") as errf:
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
-            env=env, timeout=timeout, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print(f"[bench] {platform} attempt timed out after {timeout}s",
-              file=sys.stderr)
-        return None
-    if proc.returncode == 0:
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    if "metric" in json.loads(line):
-                        return line
-                except ValueError:
+            env=env, stdout=outf, stderr=errf, text=True)
+        start = time.monotonic()
+        killed_reason = None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            now = time.monotonic()
+            if platform != "axon":
+                if now - start > timeout:
+                    killed_reason = f"cpu attempt exceeded {timeout}s"
+                elif True:
+                    time.sleep(2)
                     continue
-    tail = (proc.stderr or "")[-2000:]
-    print(f"[bench] {platform} attempt rc={proc.returncode}: {tail}",
-          file=sys.stderr)
+            else:
+                phase, mtime = _read_phase(state)
+                start_wall = time.time() - (now - start)
+                if phase is None or (mtime or 0) < start_wall:
+                    # no marker from THIS child yet (missing file, or a
+                    # stale one from the previous attempt): clock from
+                    # this child's spawn, phase init
+                    phase, mtime = "init", start_wall
+                stall = time.time() - mtime
+                budget = _PHASE_BUDGET.get(phase, 600)
+                if now - start > _ATTEMPT_CAP:
+                    killed_reason = (f"attempt cap {_ATTEMPT_CAP}s hit "
+                                     f"in phase {phase}")
+                elif stall > budget:
+                    killed_reason = (f"phase {phase} stalled "
+                                     f"{int(stall)}s (> {budget}s)")
+                else:
+                    time.sleep(5)
+                    continue
+            # graceful first: SIGTERM lets the child's runtime unwind
+            # (finally blocks, PJRT client close) before a hard kill
+            print(f"[bench] terminating {platform} child: "
+                  f"{killed_reason}", file=sys.stderr)
+            proc.terminate()
+            try:
+                proc.wait(45)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            break
+        outf.seek(0)
+        stdout = outf.read()
+        errf.seek(0)
+        stderr = errf.read()
+    line = _parse_metric_lines(stdout)
+    if line:
+        if killed_reason:
+            print(f"[bench] salvaged partial result after kill "
+                  f"({killed_reason})", file=sys.stderr)
+        return line
+    tail = (stderr or "")[-2000:]
+    print(f"[bench] {platform} attempt rc={proc.returncode} "
+          f"{killed_reason or ''}: {tail}", file=sys.stderr)
     return None
 
 
@@ -625,15 +718,17 @@ def main():
     if os.environ.get("BENCH_MODE") == "profile":
         profile_main()
         return
-    # worst-case budget 3*480 + 2*60 + 240 ≈ 28 min if every stage
-    # times out — the goal is that a hung tunnel still ends in a
-    # printed JSON line, not an rc=124 kill. A killed axon process can
-    # wedge the tunnel for minutes, so inter-attempt sleeps are long
-    # enough for it to recover.
-    t0 = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "480"))
+    # Budget shape: a WEDGED tunnel dies fast (each attempt ends at the
+    # 240s init budget -> ~3 attempts + CPU fallback ≈ 16 min), while a
+    # LIVE tunnel gets patience (compile phases are never killed before
+    # their 900s budget; partial stdout is salvaged on any kill).
     state = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_state")
     os.environ["BENCH_STATE"] = state
+    try:
+        os.remove(state)  # stale phases must not skew the kill policy
+    except OSError:
+        pass
     for i in range(3):
         if i:
             time.sleep(120)  # tunnel recovery window
@@ -644,7 +739,7 @@ def main():
                     os.environ["BENCH_RESUME"] = f.read().strip()
             except OSError:
                 pass
-        line = _attempt("axon", t0)
+        line = _attempt("axon", None)
         if line:
             print(line)
             return

@@ -1,8 +1,8 @@
 """Data iterators (reference: python/mxnet/io/io.py + src/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter)
+                 PrefetchingIter, DevicePrefetchIter, MNISTIter, CSVIter)
 from .image_record import ImageRecordIter, ImageDetRecordIter, LibSVMIter
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
-           "ImageDetRecordIter", "LibSVMIter"]
+           "PrefetchingIter", "DevicePrefetchIter", "MNISTIter", "CSVIter",
+           "ImageRecordIter", "ImageDetRecordIter", "LibSVMIter"]

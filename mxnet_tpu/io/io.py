@@ -405,3 +405,84 @@ class CSVIter(NDArrayIter):
                 (-1,) + tuple(label_shape))
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="pad" if round_batch else "discard")
+
+
+class DevicePrefetchIter(DataIter):
+    """Host→device double buffering: a background thread pulls batches
+    from the wrapped iterator and stages them onto the target device
+    with an ASYNC jax.device_put, so the transfer of batch k+1 overlaps
+    the compiled step consuming batch k (the missing half of the
+    reference's prefetch story — iter_prefetcher.h overlaps decode with
+    compute, PJRT async H2D overlaps the copy with the device step).
+
+    depth=2 keeps at most two staged batches in flight (one being
+    consumed, one in transfer) — deeper queues only add HBM pressure.
+    """
+
+    def __init__(self, base, device=None, depth=2):
+        import queue
+        import threading
+
+        super().__init__()
+        self.base = base
+        self.batch_size = getattr(base, "batch_size", None)
+        self._device = device
+        self._q = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _stage(self, arr):
+        import jax
+
+        from ..ndarray import NDArray
+
+        dev = self._device or jax.devices()[0]
+        return NDArray(jax.device_put(arr.data, dev))
+
+    def _worker(self):
+        try:
+            for batch in self.base:
+                if self._stop.is_set():
+                    return
+                staged = DataBatch(
+                    data=[self._stage(d) for d in batch.data],
+                    label=[self._stage(l) for l in batch.label],
+                    pad=getattr(batch, "pad", 0),
+                    index=getattr(batch, "index", None))
+                self._q.put(staged)
+        except Exception as e:  # surface in the consumer, not the thread
+            self._q.put(e)
+        finally:
+            self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    next = __next__
+
+    def reset(self):
+        # drain + restart the worker on the (reset) base iterator
+        import threading
+
+        self._stop.set()
+        while True:
+            try:
+                if self._q.get_nowait() is None:
+                    break
+            except Exception:
+                break
+        self._thread.join(timeout=30)
+        self.base.reset()
+        self._stop.clear()
+        self._q = type(self._q)(maxsize=self._q.maxsize)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()

@@ -34,3 +34,51 @@ def test_train_gan_toy_example_converges():
     m = re.search(r"mean radius ([0-9.]+)", out.stdout)
     assert m, out.stdout
     assert 0.8 < float(m.group(1)) < 3.5, out.stdout
+
+
+def test_device_prefetch_iter_overlap(tmp_path):
+    """DevicePrefetchIter stages batches to the device off-thread and
+    preserves order/content; reset restarts the stream."""
+    import numpy as onp
+
+    from mxnet_tpu import io as mxio, nd
+
+    X = onp.arange(8 * 4, dtype="f").reshape(8, 4)
+    Y = onp.arange(8, dtype="f")
+    base = mxio.NDArrayIter(nd.array(X), nd.array(Y), batch_size=4)
+    pf = mxio.DevicePrefetchIter(base)
+    b1 = next(pf)
+    b2 = next(pf)
+    onp.testing.assert_allclose(b1.data[0].asnumpy(), X[:4])
+    onp.testing.assert_allclose(b2.data[0].asnumpy(), X[4:])
+    try:
+        next(pf)
+        assert False, "expected StopIteration"
+    except StopIteration:
+        pass
+    pf.reset()
+    again = [b.data[0].asnumpy() for b in pf]
+    assert len(again) == 2
+    onp.testing.assert_allclose(again[0], X[:4])
+
+
+def test_train_imagenet_rec_overlap_report(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples",
+                                      "train_imagenet_rec.py"),
+         "--images", "96", "--batch", "16", "--image-size", "32",
+         "--depth", "18", "--steps", "3", "--overlap-report"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("{") and "data_fed" in l]
+    assert line, r.stdout
+    payload = json.loads(line[-1])
+    assert payload["extra"]["overlap_efficiency_pct"] > 30
