@@ -74,7 +74,6 @@ def main():
 
     import mxnet_tpu as mx
     from mxnet_tpu import io as mxio, nd, gluon, parallel
-    from mxnet_tpu import io as io_module
     from mxnet_tpu.gluon.model_zoo import vision
 
     rec = args.rec
@@ -108,7 +107,27 @@ def main():
         optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
         mesh=mesh, compute_dtype="bfloat16" if args.bf16 else None)
 
-    feed = io_module.DevicePrefetchIter(it) if args.overlap_report else it
+    feed = mxio.DevicePrefetchIter(it) if args.overlap_report else it
+
+    syn_rate = None
+    if args.overlap_report:
+        # synthetic ceiling FIRST, while the input pipeline is idle —
+        # measuring it after the fed loop would time against still-busy
+        # decode/prefetch threads and overstate overlap efficiency
+        import numpy as onp
+
+        rs = onp.random.RandomState(0)
+        xs = nd.array(rs.rand(args.batch, 3, args.image_size,
+                              args.image_size).astype("f"))
+        ys = nd.array(rs.randint(0, args.classes, args.batch).astype("f"))
+        l2 = trainer.step(xs, ys)
+        l2.wait_to_read()  # compile
+        n_syn = max(args.steps, 4)
+        t1 = time.perf_counter()
+        for _ in range(n_syn):
+            l2 = trainer.step(xs, ys)
+        l2.wait_to_read()
+        syn_rate = args.batch * n_syn / (time.perf_counter() - t1)
 
     # NCHW batches from the decode pipeline; the model runs its layout
     step = imgs = 0
@@ -141,26 +160,11 @@ def main():
     print(f"steps={step} loss={float(loss.asscalar()):.4f} "
           f"pipeline {fed_rate:.1f} img/s (decode+augment+train)")
     if args.overlap_report:
-        # synthetic ceiling: the same compiled step on a device-resident
-        # batch (no host pipeline in the loop) — the ratio fed/synthetic
-        # quantifies how completely decode+H2D hide behind the step
-        # (VERDICT r4 weak #3: 'within ~10% of synthetic' is the target)
+        # fed/synthetic ratio quantifies how completely decode+H2D hide
+        # behind the compiled step (VERDICT r4 weak #3: 'within ~10% of
+        # synthetic' is the target)
         import json as _json
 
-        import numpy as onp
-
-        rs = onp.random.RandomState(0)
-        xs = nd.array(rs.rand(args.batch, 3, args.image_size,
-                              args.image_size).astype("f"))
-        ys = nd.array(rs.randint(0, args.classes, args.batch).astype("f"))
-        l2 = trainer.step(xs, ys)
-        l2.wait_to_read()  # compile (shape already cached) + settle
-        n_syn = max(args.steps, 4)
-        t1 = time.perf_counter()
-        for _ in range(n_syn):
-            l2 = trainer.step(xs, ys)
-        l2.wait_to_read()
-        syn_rate = args.batch * n_syn / (time.perf_counter() - t1)
         print(_json.dumps({
             "metric": "data_fed_train_imgs_per_sec",
             "value": round(fed_rate, 2), "unit": "img/s",

@@ -420,16 +420,24 @@ class DevicePrefetchIter(DataIter):
     """
 
     def __init__(self, base, device=None, depth=2):
-        import queue
-        import threading
-
         super().__init__()
         self.base = base
         self.batch_size = getattr(base, "batch_size", None)
         self._device = device
-        self._q = queue.Queue(maxsize=depth)
+        self._depth = depth
+        self._start_worker()
+
+    def _start_worker(self):
+        import queue
+        import threading
+
+        # queue+event are LOCAL to each worker generation: a worker from
+        # before a reset can never deliver stale batches (or its None
+        # sentinel) into the new stream
+        self._q = queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._q, self._stop), daemon=True)
         self._thread.start()
 
     def _stage(self, arr):
@@ -440,21 +448,36 @@ class DevicePrefetchIter(DataIter):
         dev = self._device or jax.devices()[0]
         return NDArray(jax.device_put(arr.data, dev))
 
-    def _worker(self):
+    @staticmethod
+    def _put(q, stop, item):
+        """put() that a reset can always unblock; returns False if
+        stopped before the item landed."""
+        import queue as _queue
+
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self, q, stop):
         try:
             for batch in self.base:
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 staged = DataBatch(
                     data=[self._stage(d) for d in batch.data],
                     label=[self._stage(l) for l in batch.label],
                     pad=getattr(batch, "pad", 0),
                     index=getattr(batch, "index", None))
-                self._q.put(staged)
+                if not self._put(q, stop, staged):
+                    return
         except Exception as e:  # surface in the consumer, not the thread
-            self._q.put(e)
+            self._put(q, stop, e)
         finally:
-            self._q.put(None)
+            self._put(q, stop, None)
 
     def __iter__(self):
         return self
@@ -470,19 +493,17 @@ class DevicePrefetchIter(DataIter):
     next = __next__
 
     def reset(self):
-        # drain + restart the worker on the (reset) base iterator
-        import threading
+        import queue as _queue
 
         self._stop.set()
-        while True:
+        # drain until the worker actually exits — it may be blocked in
+        # put(); every get() frees a slot, and _put() rechecks the stop
+        # flag each 0.2s, so this terminates
+        while self._thread.is_alive():
             try:
-                if self._q.get_nowait() is None:
-                    break
-            except Exception:
-                break
-        self._thread.join(timeout=30)
+                self._q.get(timeout=0.1)
+            except _queue.Empty:
+                pass
+        self._thread.join()
         self.base.reset()
-        self._stop.clear()
-        self._q = type(self._q)(maxsize=self._q.maxsize)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        self._start_worker()
