@@ -66,6 +66,7 @@ class KVStore:
         self._async_q = None
         self._async_thread = None
         self._async_err = None
+        self._ps = None
         if kv_type == "dist_async":
             try:
                 import jax
@@ -73,7 +74,18 @@ class KVStore:
                 nproc = jax.process_count()
             except Exception:
                 nproc = 1
-            self._async_mode = nproc == 1
+            if nproc == 1:
+                self._async_mode = True
+            else:
+                # multi-process: a REAL parameter server over the
+                # jax.distributed coordinator KV store — pushes apply
+                # individually on rank 0's applier thread, workers never
+                # wait on each other (kvstore_ps.py; reference
+                # kvstore_dist_server.h async mode)
+                from .kvstore_ps import AsyncParamServer
+
+                self._ps = AsyncParamServer(
+                    jax.process_index(), lambda: self._updater)
 
     # -- async applier -----------------------------------------------------
     def _async_submit(self, k, agg):
@@ -237,6 +249,9 @@ class KVStore:
                 v = v[0]
             v = v.copy()
             self._store[k] = v
+            if self._ps is not None:
+                self._ps.init(k, v)
+                continue
             self._maybe_shard(k)
 
     def _maybe_shard(self, k):
@@ -322,6 +337,10 @@ class KVStore:
                     agg = self._compress(k, 0, agg)
             if k not in self._store:
                 raise MXNetError(f"key {k} was not initialized")
+            if self._ps is not None:
+                # true async: enqueue to the parameter server and return
+                self._ps.push(k, agg)
+                continue
             if self._async_mode:
                 # dist_async: push returns immediately; a single applier
                 # thread aggregates + applies off the critical path
@@ -369,6 +388,11 @@ class KVStore:
             k = str(k)
             if k not in self._store:
                 raise MXNetError(f"key {k} was not initialized")
+            if self._ps is not None:
+                # server value (read-your-writes: waits for own pushes)
+                val = nd.array(self._ps.pull(k))
+                self._store[k]._data = val.data.astype(
+                    self._store[k].data.dtype)
             src = self._store[k]
             from .ndarray import sparse as _sp
 
@@ -437,12 +461,18 @@ class KVStore:
         workers desynchronize silently)."""
         if self._async_mode:
             self._async_flush()
+        if self._ps is not None:
+            # the barrier contract includes this worker's own pending
+            # async pushes being durably applied
+            self._ps.flush()
         if self._type.startswith("dist") and self.num_workers > 1:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("kvstore_barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._ps is not None:
+            self._ps.flush()
         if self._updater is None:
             raise MXNetError("no optimizer is set")
         if self._async_mode:

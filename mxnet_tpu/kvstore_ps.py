@@ -1,0 +1,305 @@
+"""True asynchronous parameter server for multi-process ``dist_async``.
+
+Reference: src/kvstore/kvstore_dist_server.h — in async mode each
+worker's push is applied to the server copy INDIVIDUALLY the moment it
+arrives; workers never wait on each other (bounded staleness), and a
+worker's own pushes are visible to its next pull (read-your-writes via
+the engine's per-key ordering).
+
+TPU-native transport: the jax.distributed coordinator's key-value store
+(the service every multi-host JAX job already runs) replaces ps-lite's
+TCP vans. Wire protocol per key:
+
+  mxps/val/<key>/<v>    canonical value at watermark v (npy bytes) —
+                        the coordinator KV is WRITE-ONCE per key, so
+                        each publish mints a fresh versioned key and
+                        lazily deletes v-2 (readers retry the fetch)
+  mxps/seq/<key>        atomic push counter (key_value_increment)
+  mxps/push/<key>/<seq> one pending gradient, applied+deleted in order
+  mxps/applied/<key>    applied watermark, advanced by increment —
+                        pulls wait for their own seq
+
+Rank 0 runs the applier thread (the "server"); its updater/optimizer is
+the authoritative one, mirroring the reference where the optimizer is
+shipped to the server (kvstore_dist_server ApplyUpdates). Because the
+server rides on rank 0, workers must rendezvous (``kv.barrier()``)
+before process teardown — the reference's ps-lite Finalize is likewise
+a collective shutdown. Gradients ride
+the coordinator channel, which is sized for control traffic — ideal for
+the async protocol's semantics; bulk synchronous training should keep
+using ``dist_sync`` (XLA collectives over ICI).
+"""
+from __future__ import annotations
+
+import io
+import threading
+import time
+
+import numpy as onp
+
+from .base import MXNetError
+
+_PREFIX = "mxps"
+
+# Each dist_async KVStore created in a process gets its own namespace
+# generation. SPMD programs create their stores in identical order on
+# every process, so the per-process counter agrees globally — a second
+# store no longer collides with the first one's write-once keys.
+_GENERATION = [0]
+
+
+def _log():
+    import logging
+
+    return logging.getLogger(__name__)
+
+
+def _client():
+    from jax._src import distributed
+
+    c = distributed.global_state.client
+    if c is None:
+        raise MXNetError("dist_async parameter server needs "
+                         "jax.distributed to be initialized")
+    return c
+
+
+def _ser(arr):
+    buf = io.BytesIO()
+    onp.save(buf, onp.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _deser(b):
+    return onp.load(io.BytesIO(bytes(b)), allow_pickle=False)
+
+
+def _serve_loop(ps_ref, stop):
+    """Applier entry: holds only a WEAKREF to the server object, so a
+    dropped kvstore (and its parameters) can be collected — the same
+    no-pinning rule as the single-process applier in kvstore.py."""
+    while not stop.is_set():
+        ps = ps_ref()
+        if ps is None:
+            return
+        busy = ps._sweep()
+        del ps
+        if not busy:
+            time.sleep(0.005)
+
+
+class AsyncParamServer:
+    """Worker-side handle; rank 0 additionally runs the applier."""
+
+    def __init__(self, rank, get_updater):
+        import atexit
+        import weakref
+
+        _GENERATION[0] += 1
+        self._prefix = f"{_PREFIX}{_GENERATION[0]}"
+        self._c = _client()
+        self._rank = rank
+        self._get_updater = get_updater  # () -> updater|None, read at apply
+        self._last_seq = {}  # key -> my highest pushed seq
+        self._keys = set()
+        self._server_vals = {}  # rank 0 only: canonical host copies
+        self._stop = threading.Event()
+        self._next_seq = {}   # rank 0: key -> next seq to apply
+        self._gap_seen = {}   # rank 0: key -> first time the gap was seen
+        self._published = {}  # rank 0: key -> watermark last published
+        self._retire = {}     # rank 0: key -> version to delete next
+        self._thread = None
+        ref = weakref.ref(self)
+
+        def _exit_flush():
+            ps = ref()
+            if ps is None:
+                return
+            try:  # tail pushes must land before the applier dies
+                ps.flush(timeout_s=30.0)
+            except Exception as e:
+                _log().warning("dist_async exit flush failed: %s", e)
+            ps.close()
+
+        atexit.register(_exit_flush)
+        if rank == 0:
+            self._thread = threading.Thread(
+                target=_serve_loop, args=(ref, self._stop), daemon=True)
+            self._thread.start()
+
+    # ---- worker API ------------------------------------------------------
+    def init(self, key, value):
+        key = str(key)
+        self._keys.add(key)
+        if self._rank == 0:
+            val = onp.asarray(value.asnumpy(), dtype=onp.float32) \
+                if hasattr(value, "asnumpy") else onp.asarray(value)
+            self._server_vals[key] = val.copy()
+            self._c.key_value_set_bytes(f"{self._prefix}/val/{key}/0",
+                                        _ser(val))
+        else:
+            # wait for the server's initial value (blocking, like the
+            # reference worker blocking on the server's init response)
+            self._c.blocking_key_value_get_bytes(
+                f"{self._prefix}/val/{key}/0", 120_000)
+
+    def push(self, key, grad):
+        """Non-blocking: enqueue and return (async semantics)."""
+        key = str(key)
+        seq = self._c.key_value_increment(f"{self._prefix}/seq/{key}", 1)
+        self._c.key_value_set_bytes(
+            f"{self._prefix}/push/{key}/{seq:012d}",
+            _ser(grad.asnumpy() if hasattr(grad, "asnumpy") else grad))
+        self._last_seq[key] = seq
+
+    def pull(self, key, timeout_s=120.0):
+        """Read-your-writes: wait until the server has applied at least
+        this worker's own last push for the key, then fetch the value
+        published at (or after) that watermark."""
+        key = str(key)
+        want = self._last_seq.get(key, 0)
+        deadline = time.monotonic() + timeout_s
+
+        def applied_now():
+            try:
+                return int(self._c.key_value_try_get(
+                    f"{self._prefix}/applied/{key}"))
+            except Exception:
+                return 0  # counter not created yet: nothing applied
+
+        while True:
+            applied = applied_now()
+            if applied >= want:
+                # fetch the version matching the watermark we read; the
+                # server may already have published a NEWER version and
+                # deleted this one — re-read the watermark and retry
+                try:
+                    blob = self._c.key_value_try_get_bytes(
+                        f"{self._prefix}/val/{key}/{applied}")
+                    return _deser(blob)
+                except Exception:
+                    pass  # version rotated away; loop re-reads
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"dist_async pull('{key}') timed out waiting for "
+                    f"seq {want} (applied={applied}) — server down?")
+            time.sleep(0.01)
+
+    def flush(self, timeout_s=60.0):
+        """Wait until every push from THIS worker has been applied."""
+        for key in list(self._last_seq):
+            self.pull(key, timeout_s)
+
+    def close(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+
+    # ---- server (rank 0) -------------------------------------------------
+    def _apply(self, key, grad):
+        stored = self._server_vals.get(key)
+        if stored is None:
+            # rank 0's init always populates _server_vals, so a missing
+            # key means a push raced ahead of init — fetch the latest
+            # published version without blocking the applier loop
+            v = self._published.get(key, 0)
+            try:
+                stored = _deser(self._c.key_value_try_get_bytes(
+                    f"{self._prefix}/val/{key}/{v}"))
+            except Exception:
+                raise MXNetError(f"push to uninitialized key '{key}'")
+            self._server_vals[key] = stored
+        updater = self._get_updater()
+        if updater is not None:
+            from . import ndarray as nd
+            from .kvstore import _key_to_int
+
+            snd = nd.array(stored)
+            updater(_key_to_int(key), nd.array(grad), snd)
+            stored = snd.asnumpy()
+        else:
+            stored = stored + grad  # reference server default: sum
+        self._server_vals[key] = stored
+        return stored
+
+    def _sweep(self):
+        """ONE pass of the applier: apply pending pushes per key IN SEQ
+        ORDER, publish new values and the applied watermark (the
+        reference server's request-handling loop, poll-driven instead of
+        RPC-driven). Returns whether any work was done."""
+        if True:
+            busy = False
+            try:
+                entries = self._c.key_value_dir_get_bytes(
+                    f"{self._prefix}/push/")
+            except Exception:
+                entries = []
+            by_key = {}
+            for name, blob in entries:
+                # name = mxps/push/<key>/<seq>
+                parts = name.split("/")
+                if len(parts) < 4:
+                    continue
+                by_key.setdefault(parts[2], []).append((parts[3], blob))
+            for key, items in by_key.items():
+                items.sort()  # zero-padded seq: lexicographic == numeric
+                # apply STRICTLY CONSECUTIVE seqs: a pusher increments
+                # the counter before its blob lands, so a visible seq
+                # k+1 does not imply k arrived — applying k+1 first and
+                # publishing applied=k+1 would let k's pusher pull a
+                # value missing its own write (read-your-writes break)
+                nxt = self._next_seq.get(key, 1)
+                last = None
+                for seqs, blob in items:
+                    s = int(seqs)
+                    if s < nxt:  # stale duplicate (already applied)
+                        self._c.key_value_delete(
+                            f"{self._prefix}/push/{key}/{seqs}")
+                        continue
+                    if s > nxt:
+                        # gap: blob for `nxt` still in flight. Tolerate
+                        # briefly; a crashed pusher must not stall the
+                        # key forever (reference: dead-worker timeouts)
+                        first = self._gap_seen.setdefault(
+                            key, time.monotonic())
+                        if time.monotonic() - first > 30.0:
+                            self._gap_seen.pop(key, None)
+                            nxt = s  # give up on the lost seq
+                        else:
+                            break
+                    try:
+                        self._apply(key, _deser(blob))
+                    except Exception as e:
+                        # a poisoned gradient must not kill the server;
+                        # log and continue (reference does the same)
+                        _log().warning(
+                            "dist_async server dropped push seq %s for "
+                            "key '%s': %s", seqs, key, e)
+                    self._c.key_value_delete(
+                        f"{self._prefix}/push/{key}/{seqs}")
+                    self._gap_seen.pop(key, None)
+                    last = s
+                    nxt = s + 1
+                    busy = True
+                self._next_seq[key] = nxt
+                if last is not None:
+                    prev = self._published.get(key, 0)
+                    # write-once store: publish under the NEW watermark,
+                    # advance the counter by the delta, then retire the
+                    # version before last (keeping one back version
+                    # narrows the reader fetch race)
+                    self._c.key_value_set_bytes(
+                        f"{self._prefix}/val/{key}/{last}",
+                        _ser(self._server_vals[key]))
+                    self._c.key_value_increment(
+                        f"{self._prefix}/applied/{key}", last - prev)
+                    older = self._retire.pop(key, None)
+                    if older is not None:
+                        try:
+                            self._c.key_value_delete(
+                                f"{self._prefix}/val/{key}/{older}")
+                        except Exception:
+                            pass
+                    self._retire[key] = prev
+                    self._published[key] = last
+            return busy
