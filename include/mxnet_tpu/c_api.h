@@ -229,6 +229,43 @@ int MXAggregateProfileStatsPrint(const char** out_str, int reset);
 /* Seed the global PRNG (reference: c_api.h MXRandomSeed). */
 int MXRandomSeed(int seed);
 
+
+/* ---- Operator introspection (reference: c_api.h MXListAllOpNames,
+ * MXSymbolGetAtomicSymbolInfo). String arrays are thread-local like the
+ * MXSymbolList* buffers. */
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array);
+int MXSymbolGetAtomicSymbolInfo(const char* op_name, const char** name,
+                                const char** description,
+                                uint32_t* num_args,
+                                const char*** arg_names,
+                                const char*** arg_default_vals);
+
+/* ---- Shape/type inference (reference: c_api_symbolic.cc
+ * MXSymbolInferShape/MXSymbolInferType, flattened-buffer variant).
+ * Results: out_sections = [n_args, n_outs, n_aux]; out_ndims one entry
+ * per shape in that order (-1 = undetermined); out_dims concatenated.
+ * Type flags follow the NDArray dtype codes; -1 = undetermined. */
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                       const char** keys, const uint32_t* arg_ind_ptr,
+                       const int64_t* arg_shape_data, uint32_t* out_total,
+                       const int64_t** out_ndims, const int64_t** out_dims,
+                       const int64_t** out_sections);
+int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                      const char** keys, const int* arg_types,
+                      uint32_t* out_total, const int** out_types,
+                      const int64_t** out_sections);
+
+/* ---- KVStore tail + NDArray misc. */
+int MXKVStoreBarrier(KVStoreHandle kv);
+int MXKVStorePushPull(KVStoreHandle kv, uint32_t num, const int* keys,
+                      NDArrayHandle* vals, NDArrayHandle* outs,
+                      int priority);
+/* Row view (new handle, caller frees). */
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
+/* dev_type codes: 1=cpu 2=gpu/tpu 3=cpu_pinned 5=cpu_shared. */
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id);
+
 #ifdef __cplusplus
 }
 #endif

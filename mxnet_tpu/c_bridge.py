@@ -673,3 +673,97 @@ def random_seed(s):
 
     _r.seed(int(s))
     return None
+
+
+# ---- operator introspection (reference: c_api.cc MXListAllOpNames,
+# MXSymbolListAtomicSymbolCreators / MXSymbolGetAtomicSymbolInfo — the
+# surface every frontend uses to AUTOGENERATE its op bindings) -----------
+
+def list_all_op_names():
+    from .ndarray import registry as _registry
+
+    return list(_registry.list_ops())
+
+
+def op_info(op_name):
+    """(name, doc, arg_names, arg_defaults_repr) for one registered op."""
+    import inspect
+
+    from .ndarray import registry as _registry
+
+    opdef = _registry.get_op(op_name)
+    if opdef is None:
+        raise MXNetError(f"unknown operator '{op_name}'")
+    try:
+        sig = opdef.signature()
+        args, defaults = [], []
+        for p in sig.parameters.values():
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                args.append("*" + p.name if p.kind == p.VAR_POSITIONAL
+                            else "**" + p.name)
+                defaults.append("")
+            else:
+                args.append(p.name)
+                defaults.append("" if p.default is p.empty
+                                else repr(p.default))
+    except (TypeError, ValueError):
+        args, defaults = [], []
+    return (opdef.name, opdef.doc or "", args, defaults)
+
+
+def sym_infer_shape(cell, keys, shapes):
+    """MXSymbolInferShape: partial shape inference from named input
+    shapes; returns (arg_names, arg_shapes, out_shapes, aux_names,
+    aux_shapes) with None for undetermined entries."""
+    from .symbol.infer import infer_shapes
+
+    symb = _composed(cell)
+    known = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    # infer_shapes gives the full var map, so aux shapes come back too
+    # (infer_shape_partial drops them — reference MXSymbolInferShape
+    # reports aux shapes, frontends allocate moving stats from them)
+    var_shapes, out_shapes = infer_shapes(symb, known,
+                                          allow_unknown=True)
+    args = symb.list_arguments()
+    auxs = symb.list_auxiliary_states()
+    return (args, [var_shapes.get(a) for a in args], list(out_shapes),
+            auxs, [var_shapes.get(a) for a in auxs])
+
+
+def sym_infer_type(cell, keys, dtype_flags):
+    """MXSymbolInferType: dtype inference from named input type flags."""
+    symb = _composed(cell)
+    known = {k: _TYPE_FLAG_TO_DTYPE[int(f)]
+             for k, f in zip(keys, dtype_flags)}
+    arg_types, out_types, aux_types = symb.infer_type(**known)
+
+    def flags(ts):
+        return [-1 if t is None else int(_DTYPE_TO_TYPE_FLAG[str(
+            onp.dtype(t))]) for t in ts]
+
+    return (symb.list_arguments(), flags(arg_types), flags(out_types),
+            symb.list_auxiliary_states(), flags(aux_types))
+
+
+def kv_barrier(kv):
+    kv.barrier()
+    return None
+
+
+def kv_pushpull(kv, keys, vals, outs, priority):
+    kv.pushpull(list(keys), list(vals), out=list(outs),
+                priority=int(priority))
+    return None
+
+
+def nd_at(a, idx):
+    """MXNDArrayAt: view of row `idx` (reference c_api.cc MXNDArrayAt)."""
+    return a[int(idx)]
+
+
+def nd_context(a):
+    """(dev_type, dev_id) — reference dev_type codes via
+    Context.devstr2type (one source of truth, context.py)."""
+    ctx = a.context
+    return (int(getattr(ctx, "device_typeid", 1)),
+            int(getattr(ctx, "device_id", 0)))

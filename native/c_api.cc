@@ -1217,3 +1217,242 @@ MXTPU_API int MXRandomSeed(int seed) {
   Py_DECREF(r);
   return 0;
 }
+
+// ------------------------------------------------------------------------
+// Operator introspection (reference: c_api.cc MXListAllOpNames,
+// MXSymbolGetAtomicSymbolInfo — frontends autogenerate bindings from it)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXListAllOpNames(uint32_t* out_size,
+                               const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = bridge_call("list_all_op_names", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  int rc = list_to_names(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc;
+}
+
+namespace {
+// op-info buffers (separate from names_store so interleaved name-list
+// calls don't clobber an in-flight info result)
+struct OpInfoBuf {
+  std::string name, doc;
+  std::vector<std::string> arg_names, arg_defaults;
+  std::vector<const char*> arg_names_c, arg_defaults_c;
+};
+OpInfoBuf& opinfo_buf() {
+  thread_local OpInfoBuf b;
+  return b;
+}
+}  // namespace
+
+MXTPU_API int MXSymbolGetAtomicSymbolInfo(
+    const char* op_name, const char** name, const char** description,
+    uint32_t* num_args, const char*** arg_names,
+    const char*** arg_default_vals) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", op_name);
+  PyObject* r = bridge_call("op_info", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  auto& b = opinfo_buf();
+  b.arg_names.clear();
+  b.arg_defaults.clear();
+  b.arg_names_c.clear();
+  b.arg_defaults_c.clear();
+  const char* nm = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  const char* doc = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+  b.name = nm ? nm : "";
+  b.doc = doc ? doc : "";
+  PyObject* an = PyTuple_GET_ITEM(r, 2);
+  PyObject* ad = PyTuple_GET_ITEM(r, 3);
+  Py_ssize_t n = PyList_Size(an);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* a = PyUnicode_AsUTF8(PyList_GET_ITEM(an, i));
+    const char* d = PyUnicode_AsUTF8(PyList_GET_ITEM(ad, i));
+    b.arg_names.emplace_back(a ? a : "");
+    b.arg_defaults.emplace_back(d ? d : "");
+  }
+  Py_DECREF(r);
+  for (auto& s : b.arg_names) b.arg_names_c.push_back(s.c_str());
+  for (auto& s : b.arg_defaults) b.arg_defaults_c.push_back(s.c_str());
+  if (name != nullptr) *name = b.name.c_str();
+  if (description != nullptr) *description = b.doc.c_str();
+  if (num_args != nullptr) *num_args = static_cast<uint32_t>(n);
+  if (arg_names != nullptr) *arg_names = b.arg_names_c.data();
+  if (arg_default_vals != nullptr)
+    *arg_default_vals = b.arg_defaults_c.data();
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// Shape/type inference over the ABI (reference: c_api_symbolic.cc
+// MXSymbolInferShape / MXSymbolInferType). Shapes return via flattened
+// thread-local buffers: per-section [count, then per-entry ndim]
+// indexing into one int64 data array; -1 ndim = undetermined.
+// ------------------------------------------------------------------------
+
+namespace {
+struct InferBuf {
+  std::vector<int64_t> ndims;   // arg..., out..., aux... (-1 unknown)
+  std::vector<int64_t> data;    // concatenated dims
+  std::vector<int64_t> section; // [n_args, n_outs, n_aux]
+};
+InferBuf& infer_buf() {
+  thread_local InferBuf b;
+  return b;
+}
+
+int pack_shapes(PyObject* shapes, InferBuf& b) {  // list of tuple|None
+  Py_ssize_t n = PyList_Size(shapes);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GET_ITEM(shapes, i);
+    if (s == Py_None) {
+      b.ndims.push_back(-1);
+      continue;
+    }
+    Py_ssize_t nd = PySequence_Size(s);
+    b.ndims.push_back(nd);
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      PyObject* it = PySequence_GetItem(s, d);
+      b.data.push_back(it ? PyLong_AsLongLong(it) : -1);
+      Py_XDECREF(it);
+    }
+  }
+  return static_cast<int>(n);
+}
+}  // namespace
+
+MXTPU_API int MXSymbolInferShape(
+    void* sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const int64_t* arg_shape_data,
+    uint32_t* out_total, const int64_t** out_ndims,
+    const int64_t** out_dims, const int64_t** out_sections) {
+  Gil gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* pshapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t d = lo; d < hi; ++d) {
+      PyList_SET_ITEM(shp, d - lo,
+                      PyLong_FromLongLong(arg_shape_data[d]));
+    }
+    PyList_SET_ITEM(pshapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ONN)", sym, pkeys, pshapes);
+  PyObject* r = bridge_call("sym_infer_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  auto& b = infer_buf();
+  b.ndims.clear();
+  b.data.clear();
+  b.section.clear();
+  // r = (arg_names, arg_shapes, out_shapes, aux_names, aux_shapes)
+  b.section.push_back(pack_shapes(PyTuple_GET_ITEM(r, 1), b));
+  b.section.push_back(pack_shapes(PyTuple_GET_ITEM(r, 2), b));
+  b.section.push_back(pack_shapes(PyTuple_GET_ITEM(r, 4), b));
+  Py_DECREF(r);
+  if (out_total != nullptr)
+    *out_total = static_cast<uint32_t>(b.ndims.size());
+  if (out_ndims != nullptr) *out_ndims = b.ndims.data();
+  if (out_dims != nullptr) *out_dims = b.data.data();
+  if (out_sections != nullptr) *out_sections = b.section.data();
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferType(void* sym, uint32_t num_args,
+                                const char** keys, const int* arg_types,
+                                uint32_t* out_total, const int** out_types,
+                                const int64_t** out_sections) {
+  Gil gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* ptypes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(ptypes, i, PyLong_FromLong(arg_types[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONN)", sym, pkeys, ptypes);
+  PyObject* r = bridge_call("sym_infer_type", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  thread_local std::vector<int> types;
+  thread_local std::vector<int64_t> sections;
+  types.clear();
+  sections.clear();
+  for (int part : {1, 2, 4}) {
+    PyObject* lst = PyTuple_GET_ITEM(r, part);
+    Py_ssize_t n = PyList_Size(lst);
+    sections.push_back(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      types.push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+    }
+  }
+  Py_DECREF(r);
+  if (out_total != nullptr)
+    *out_total = static_cast<uint32_t>(types.size());
+  if (out_types != nullptr) *out_types = types.data();
+  if (out_sections != nullptr) *out_sections = sections.data();
+  return 0;
+}
+
+// ------------------------------------------------------------------------
+// KVStore tail + NDArray misc (reference: c_api.cc)
+// ------------------------------------------------------------------------
+
+MXTPU_API int MXKVStoreBarrier(void* kv) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", kv);
+  PyObject* r = bridge_call("kv_barrier", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePushPull(void* kv, uint32_t num, const int* keys,
+                                void** vals, void** outs, int priority) {
+  Gil gil;
+  PyObject* pk = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pk, i, PyLong_FromLong(keys[i]));
+  }
+  PyObject* pv = handle_list(num, vals);
+  PyObject* po = handle_list(num, outs);
+  PyObject* args = Py_BuildValue("(ONNNi)", kv, pk, pv, po, priority);
+  PyObject* r = bridge_call("kv_pushpull", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayAt(void* handle, uint32_t idx, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", handle, idx);
+  PyObject* r = bridge_call("nd_at", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  *out = r;  // caller frees with MXNDArrayFree
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetContext(void* handle, int* out_dev_type,
+                                  int* out_dev_id) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = bridge_call("nd_context", args);
+  Py_DECREF(args);
+  if (r == nullptr) return set_py_error();
+  if (out_dev_type != nullptr)
+    *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  if (out_dev_id != nullptr)
+    *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}

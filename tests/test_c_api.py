@@ -1039,3 +1039,143 @@ def test_cached_op_jit_cache_via_ctypes(capi):
     b = nd.array(onp.ones((5, 3), "f"))
     cop([b, pw, pb])
     assert len(cop._jitted) == 2
+
+
+def test_op_introspection_abi(capi):
+    """MXListAllOpNames + MXSymbolGetAtomicSymbolInfo — the surface a
+    frontend uses to autogenerate its op bindings (reference c_api.cc)."""
+    lib = capi
+    vp, u32, cp = ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p
+    lib.MXListAllOpNames.argtypes = [ctypes.POINTER(u32),
+                                     ctypes.POINTER(ctypes.POINTER(cp))]
+    n = u32()
+    arr = ctypes.POINTER(cp)()
+    assert lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = [arr[i].decode() for i in range(n.value)]
+    assert n.value > 300, n.value
+    assert "convolution" in names and "fully_connected" in names
+
+    lib.MXSymbolGetAtomicSymbolInfo.argtypes = [
+        cp, ctypes.POINTER(cp), ctypes.POINTER(cp), ctypes.POINTER(u32),
+        ctypes.POINTER(ctypes.POINTER(cp)),
+        ctypes.POINTER(ctypes.POINTER(cp))]
+    nm, desc = cp(), cp()
+    na = u32()
+    an = ctypes.POINTER(cp)()
+    ad = ctypes.POINTER(cp)()
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        b"convolution", ctypes.byref(nm), ctypes.byref(desc),
+        ctypes.byref(na), ctypes.byref(an), ctypes.byref(ad)) == 0
+    assert nm.value == b"convolution"
+    args = [an[i].decode() for i in range(na.value)]
+    assert "data" in args and "kernel" in args
+    defaults = [ad[i].decode() for i in range(na.value)]
+    assert defaults[args.index("num_group")] == "1"
+    # unknown op errors cleanly
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        b"no_such_op", ctypes.byref(nm), ctypes.byref(desc),
+        ctypes.byref(na), ctypes.byref(an), ctypes.byref(ad)) == -1
+
+
+def test_infer_shape_type_abi(capi):
+    """MXSymbolInferShape/InferType over a composed MLP."""
+    lib = capi
+    vp, u32, cp = ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p
+    lib.MXSymbolInferShape.argtypes = [
+        vp, u32, ctypes.POINTER(cp), ctypes.POINTER(u32),
+        ctypes.POINTER(i64), ctypes.POINTER(u32),
+        ctypes.POINTER(ctypes.POINTER(i64)),
+        ctypes.POINTER(ctypes.POINTER(i64)),
+        ctypes.POINTER(ctypes.POINTER(i64))]
+    lib.MXSymbolInferType.argtypes = [
+        vp, u32, ctypes.POINTER(cp), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(u32), ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+        ctypes.POINTER(ctypes.POINTER(i64))]
+
+    data = vp()
+    fc = vp()
+    assert capi.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    kh, v8 = ctypes.c_char_p(b"num_hidden"), ctypes.c_char_p(b"8")
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, ctypes.byref(kh), ctypes.byref(v8),
+        ctypes.byref(fc)) == 0
+    assert capi.MXSymbolCompose(fc, b"fc", 1, None, ctypes.byref(data)) == 0
+
+    keys = (cp * 1)(b"data")
+    indptr = (u32 * 2)(0, 2)
+    dims = (i64 * 2)(4, 16)
+    total = u32()
+    ndims = ctypes.POINTER(i64)()
+    ddata = ctypes.POINTER(i64)()
+    sect = ctypes.POINTER(i64)()
+    assert lib.MXSymbolInferShape(
+        fc, 1, keys, indptr, dims, ctypes.byref(total),
+        ctypes.byref(ndims), ctypes.byref(ddata),
+        ctypes.byref(sect)) == 0, _err(capi)
+    n_args, n_outs, n_aux = sect[0], sect[1], sect[2]
+    assert n_args == 3 and n_outs == 1 and n_aux == 0
+    # walk the flattened dims: data(4,16), fc_weight(8,16), fc_bias(8)
+    shapes = []
+    off = 0
+    for i in range(total.value):
+        nd_ = ndims[i]
+        if nd_ < 0:
+            shapes.append(None)
+        else:
+            shapes.append(tuple(ddata[off + d] for d in range(nd_)))
+            off += nd_
+    assert shapes[0] == (4, 16)
+    assert shapes[1] == (8, 16)
+    assert shapes[2] == (8,)
+    assert shapes[3] == (4, 8)  # output
+
+    tkeys = (cp * 1)(b"data")
+    tflags = (ctypes.c_int * 1)(0)  # 0 = float32
+    ttotal = u32()
+    ttypes = ctypes.POINTER(ctypes.c_int)()
+    tsect = ctypes.POINTER(i64)()
+    assert lib.MXSymbolInferType(
+        fc, 1, tkeys, tflags, ctypes.byref(ttotal), ctypes.byref(ttypes),
+        ctypes.byref(tsect)) == 0, _err(capi)
+    assert ttotal.value == 4
+    assert all(ttypes[i] == 0 for i in range(4))  # all float32
+
+
+def test_nd_at_and_context_abi(capi):
+    lib = capi
+    vp, u32 = ctypes.c_void_p, ctypes.c_uint32
+    lib.MXNDArrayAt.argtypes = [vp, u32, ctypes.POINTER(vp)]
+    lib.MXNDArrayGetContext.argtypes = [vp, ctypes.POINTER(ctypes.c_int),
+                                        ctypes.POINTER(ctypes.c_int)]
+    shape = (i64 * 2)(3, 4)
+    h = vp()
+    assert capi.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h)) == 0
+    buf = onp.arange(12, dtype="f")
+    assert capi.MXNDArraySyncCopyFromCPU(
+        h, buf.ctypes.data_as(vp), buf.nbytes) == 0
+    row = vp()
+    assert lib.MXNDArrayAt(h, 1, ctypes.byref(row)) == 0
+    out = onp.zeros(4, "f")
+    assert capi.MXNDArraySyncCopyToCPU(
+        row, out.ctypes.data_as(vp), out.nbytes) == 0
+    onp.testing.assert_allclose(out, buf.reshape(3, 4)[1])
+    dt, di = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXNDArrayGetContext(h, ctypes.byref(dt),
+                                   ctypes.byref(di)) == 0
+    assert dt.value in (1, 2)
+    capi.MXNDArrayFree(row)
+    capi.MXNDArrayFree(h)
+
+
+def test_infer_shape_reports_aux_shapes(capi):
+    """Aux states (BN moving stats) must come back with real shapes —
+    frontends allocate them from MXSymbolInferShape (r5 review fix)."""
+    import mxnet_tpu.c_bridge as cb
+
+    data = cb.sym_var("data")
+    bn = cb.sym_create_atomic("BatchNorm", [], [])
+    cb.sym_compose(bn, "bn", [], [data])
+    args, arg_shapes, out_shapes, auxs, aux_shapes = cb.sym_infer_shape(
+        bn, ["data"], [(2, 4)])
+    assert auxs == ["bn_moving_mean", "bn_moving_var"]
+    assert aux_shapes == [(4,), (4,)], aux_shapes
