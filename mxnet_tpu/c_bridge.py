@@ -687,8 +687,6 @@ def list_all_op_names():
 
 def op_info(op_name):
     """(name, doc, arg_names, arg_defaults_repr) for one registered op."""
-    import inspect
-
     from .ndarray import registry as _registry
 
     opdef = _registry.get_op(op_name)

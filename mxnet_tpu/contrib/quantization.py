@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import numpy as onp
 
-__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
-           "calib_entropy", "quantize_symbol", "quantize_model"]
+__all__ = ["quantize_net", "quantize_net_graph", "QuantizedDense",
+           "QuantizedConv2D", "calib_entropy", "quantize_symbol",
+           "quantize_model"]
 
 
 def calib_entropy(hist, hist_edges, num_quantized_bins=255):
@@ -610,3 +611,54 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         if wname not in still_needed:
             del qarg[wname]
     return qsym, qarg, dict(aux_params)
+
+
+def quantize_net_graph(network, calib_data=None, calib_mode="naive",
+                       quantized_dtype="int8", exclude_layers=(),
+                       exclude_operators=(), num_calib_batches=None,
+                       input_names=("data",), logger=None):
+    """Graph-mode gluon quantization (the reference architecture:
+    python/mxnet/contrib/quantization.py quantize_net traces the
+    HybridBlock to a symbol, runs the quantize_model graph pass, and
+    returns a SymbolBlock). Unlike the block-swap ``quantize_net``,
+    consecutive quantizable layers here form single int8 regions —
+    conv→bn→relu→pool chains never round-trip through fp32.
+
+    ``exclude_layers`` matches symbol node names (the traced op names,
+    e.g. 'hybridsequential0_conv0'); ``exclude_operators`` matches op
+    types ('pooling', 'batch_norm', ...).
+    """
+    from .. import symbol as S
+    from ..gluon.block import SymbolBlock
+
+    out = network(*[S.var(n) for n in input_names])
+    if isinstance(out, (list, tuple)):
+        out = S.Group(list(out))  # multi-output block: group the heads
+    aux_names = set()
+    for s in out._walk():
+        if s._op == "batch_norm" and len(s._inputs) >= 5:
+            aux_names.update(i._name for i in s._inputs[3:5]
+                             if i._op is None)
+    arg_params, aux_params = {}, {}
+    for name, p in network.collect_params().items():
+        (aux_params if name in aux_names else arg_params)[name] = p.data()
+
+    qsym, qarg, qaux = quantize_model(
+        out, arg_params, aux_params, data_names=tuple(input_names),
+        excluded_sym_names=tuple(exclude_layers),
+        excluded_op_names=tuple(exclude_operators),
+        calib_mode=calib_mode, calib_data=calib_data,
+        num_calib_batches=num_calib_batches,
+        quantized_dtype=quantized_dtype, logger=logger)
+
+    inputs = [S.var(n) for n in input_names]
+    block = SymbolBlock(qsym, inputs)
+    params = block.collect_params()
+    for name, val in {**qarg, **qaux}.items():
+        if name in params:
+            p = params[name]
+            # dtype must be set BEFORE init so the deferred-init path
+            # materializes int8 weights as int8
+            p.dtype = val.dtype
+            p._load_init_from(val)
+    return block
