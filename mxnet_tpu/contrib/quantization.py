@@ -615,8 +615,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
 def quantize_net_graph(network, calib_data=None, calib_mode="naive",
                        quantized_dtype="int8", exclude_layers=(),
-                       exclude_operators=(), num_calib_batches=None,
-                       input_names=("data",), logger=None):
+                       exclude_layers_match=(), exclude_operators=(),
+                       num_calib_batches=None, input_names=("data",),
+                       logger=None):
     """Graph-mode gluon quantization (the reference architecture:
     python/mxnet/contrib/quantization.py quantize_net traces the
     HybridBlock to a symbol, runs the quantize_model graph pass, and
@@ -631,9 +632,46 @@ def quantize_net_graph(network, calib_data=None, calib_mode="naive",
     from .. import symbol as S
     from ..gluon.block import SymbolBlock
 
+    # deferred-init params need one eager forward to learn their shapes
+    # before the symbolic trace (reference quantize_net runs the block on
+    # dummy data for the same reason)
+    from ..gluon.parameter import DeferredInitializationError
+
+    try:
+        needs_shape = any(p._ndarray is None
+                          for p in network.collect_params().values())
+    except Exception:
+        needs_shape = True
+    if needs_shape:
+        if calib_data is None:
+            raise ValueError(
+                "network has uninitialized (deferred) parameters; pass "
+                "calib_data so a shape-materializing forward can run")
+        from .. import autograd
+        from ..ndarray import NDArray
+
+        first = calib_data[0] if isinstance(calib_data, (list, tuple)) \
+            else next(iter(calib_data))
+        datas = [first] if isinstance(first, NDArray) else (
+            list(first) if isinstance(first, (list, tuple))
+            else list(first.data))
+        with autograd.pause(train_mode=False):
+            network(*datas[:len(input_names)])
+        if hasattr(calib_data, "reset"):
+            calib_data.reset()
+
     out = network(*[S.var(n) for n in input_names])
     if isinstance(out, (list, tuple)):
         out = S.Group(list(out))  # multi-output block: group the heads
+    exclude_layers = set(exclude_layers)
+    if exclude_layers_match:
+        # reference quantize_net exclude_layers_match: substring match
+        # against traced node names
+        for s in out._walk():
+            nm = s._name or ""
+            if s._op is not None and any(pat in nm
+                                         for pat in exclude_layers_match):
+                exclude_layers.add(nm)
     aux_names = set()
     for s in out._walk():
         if s._op == "batch_norm" and len(s._inputs) >= 5:

@@ -239,3 +239,22 @@ def test_quantize_net_graph_resnet18_exclusions():
                             exclude_layers=(convs[0], fcs[-1]))
     qout = qb(x).asnumpy()
     assert _rel_err(qout, fp32) < 0.15, _rel_err(qout, fp32)
+
+
+def test_quantize_net_graph_exclude_match_and_deferred_init():
+    """reference quantize_net options: exclude_layers_match substring
+    matching; deferred-init nets materialize from calib_data."""
+    from mxnet_tpu.contrib.quantization import quantize_net_graph
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.Activation("relu"),
+            nn.Flatten(), nn.Dense(5))
+    net.initialize(mx.init.Xavier())  # shapes deferred (no forward yet)
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, 8, 8).astype("f"))
+    qb = quantize_net_graph(net, calib_data=[x], calib_mode="naive",
+                            exclude_layers_match=("conv",))
+    js = qb._outputs.tojson()
+    assert "_contrib_quantized_conv" not in js
+    assert "_contrib_quantized_fully_connected" in js
+    assert qb(x).shape == (2, 5)
