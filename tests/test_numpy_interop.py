@@ -129,3 +129,63 @@ def test_mixed_operands_and_testing_helpers():
     onp.testing.assert_allclose(onp.asarray(out), [11, 22])
     # assert_allclose works directly on mx arrays via __array__
     onp.testing.assert_allclose(a, [1.0, 2.0])
+
+
+def test_numpy_interop_sweep_69_functions():
+    """Broad onp-function-over-mx.np-array sweep (reference:
+    test_numpy_interoperability.py's 175-function battery, condensed to
+    the widely-used surface). Every call must succeed via the dispatch
+    protocols (device path or host fallback)."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    a = mnp.array([[1., 2.], [3., 4.]])
+    b = mnp.array([[5., 6.], [7., 8.]])
+    v = mnp.array([1., 2., 3.])
+    cases = [
+        lambda: onp.concatenate([a, b]), lambda: onp.stack([a, b]),
+        lambda: onp.vstack([a, b]), lambda: onp.hstack([a, b]),
+        lambda: onp.mean(a), lambda: onp.sum(a), lambda: onp.std(a),
+        lambda: onp.var(a), lambda: onp.median(a), lambda: onp.ptp(a),
+        lambda: onp.argmax(a), lambda: onp.argsort(v),
+        lambda: onp.sort(v), lambda: onp.unique(v),
+        lambda: onp.clip(a, 1.5, 3.5), lambda: onp.transpose(a),
+        lambda: onp.reshape(a, (4,)), lambda: onp.ravel(a),
+        lambda: onp.squeeze(a[None]), lambda: onp.expand_dims(a, 0),
+        lambda: onp.split(v, 3), lambda: onp.where(a > 2, a, b),
+        lambda: onp.dot(a, b), lambda: onp.matmul(a, b),
+        lambda: onp.einsum("ij,jk->ik", a, b), lambda: onp.tensordot(a, b),
+        lambda: onp.inner(a, b), lambda: onp.outer(v, v),
+        lambda: onp.cross(v, v), lambda: onp.kron(a, b),
+        lambda: onp.trace(a), lambda: onp.diag(v), lambda: onp.tril(a),
+        lambda: onp.cumsum(a), lambda: onp.diff(v),
+        lambda: onp.gradient(v),
+        lambda: onp.interp(mnp.array([1.5]), v, v),
+        lambda: onp.histogram(v),
+        lambda: onp.bincount(mnp.array([0., 1., 1.]).astype("int32")),
+        lambda: onp.percentile(a, 50), lambda: onp.quantile(a, 0.5),
+        lambda: onp.average(a), lambda: onp.round(a),
+        lambda: onp.floor_divide(a, b), lambda: onp.isclose(a, a),
+        lambda: onp.allclose(a, a), lambda: onp.array_equal(a, a),
+        lambda: onp.atleast_2d(v), lambda: onp.broadcast_to(v, (2, 3)),
+        lambda: onp.tile(v, 2), lambda: onp.repeat(v, 2),
+        lambda: onp.roll(v, 1), lambda: onp.flip(v), lambda: onp.rot90(a),
+        lambda: onp.meshgrid(v, v), lambda: onp.linalg.norm(a),
+        lambda: onp.linalg.inv(a), lambda: onp.linalg.det(a),
+        lambda: onp.linalg.svd(a), lambda: onp.fft.fft(v),
+        lambda: onp.pad(v, 1),
+        lambda: onp.take(v, mnp.array([0., 2.]).astype("int32")),
+        lambda: onp.searchsorted(v, 1.5),
+        lambda: onp.apply_along_axis(lambda r: r.sum(), 1, a),
+        lambda: onp.nanmean(a), lambda: onp.corrcoef(a),
+        lambda: onp.cov(a), lambda: onp.polyfit(v, v, 1),
+        lambda: onp.digitize(v, v),
+    ]
+    failures = []
+    for i, fn in enumerate(cases):
+        try:
+            fn()
+        except Exception as e:  # pragma: no cover - failure reporting
+            failures.append((i, type(e).__name__, str(e)[:80]))
+    assert not failures, failures
