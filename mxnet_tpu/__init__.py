@@ -84,6 +84,9 @@ from . import kvstore
 from . import gluon
 from . import parallel
 from . import utils  # noqa: F401
+from . import engine  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import misc  # noqa: F401
 from . import initialize as _initialize
 
 _initialize.initialize()  # crash tracebacks + fork-safe engine (initialize.cc)

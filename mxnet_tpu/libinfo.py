@@ -1,0 +1,35 @@
+"""Library discovery + version (reference: python/mxnet/libinfo.py).
+
+The reference locates libmxnet.so for the ctypes bridge; here the native
+runtime is ``mxnet_tpu/_native/libmxnet_c.so`` (built on demand) and the
+compute backend is in-process JAX/XLA, so find_lib_path returns the flat
+C ABI library instead.
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path(prefix="libmxnet"):
+    """Paths of the native C-ABI library, building it if a toolchain is
+    available (reference libinfo.py:26 returns [libmxnet.so])."""
+    from ._native import build_c_api
+
+    so = build_c_api()
+    return [so] if so else []
+
+
+def find_include_path():
+    """Directory of the public C headers (reference libinfo.py:79)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    inc = os.path.join(here, "include")
+    return inc if os.path.isdir(inc) else ""
+
+
+def features():
+    """Runtime feature flags (see mxnet_tpu.runtime for the full API)."""
+    from . import runtime
+
+    return runtime.Features()
