@@ -344,11 +344,16 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
     """
     from .. import symbol as S
 
-    if quantized_dtype in ("auto", None):
+    auto_dtype = quantized_dtype in ("auto", None)
+    if auto_dtype:
         quantized_dtype = "int8"
     if quantized_dtype != "int8":
-        raise ValueError("TPU int8 path quantizes to int8 "
-                         f"(got {quantized_dtype})")
+        # global uint8 would zero every negative activation (the uint8
+        # lattice here is zero-point-free); only 'auto' may select it,
+        # and only for calibrated-non-negative tensors
+        raise ValueError("quantized_dtype must be 'int8' or 'auto' "
+                         f"(got {quantized_dtype}); 'auto' applies "
+                         "uint8 to provably non-negative tensors")
     calib_ranges = calib_ranges or {}
     excluded_sym_names = set(excluded_sym_names)
     excluded_op_names = set(excluded_op_names)
@@ -377,27 +382,35 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
             return f[node._output_index]
         return f
 
-    def as_q(node):
+    def as_q(node, dtype_req=None):
         r = base_rep(node)
-        # keyed per OUTPUT VIEW: different outputs of a multi-output
-        # producer quantize independently
+        # keyed per OUTPUT VIEW and requested dtype: different outputs
+        # of a multi-output producer quantize independently, and a
+        # uint8-intolerant consumer (conv/fc: XLA needs matching
+        # operand dtypes, weights are int8) can force int8
         if "qout" in r:
             return r["qout"]
         idx = node._output_index if node._num_outputs > 1 else 0
+        rng = calib_ranges.get(_out_name(node))
+        dt = dtype_req or quantized_dtype
+        if dtype_req is None and auto_dtype and rng is not None \
+                and rng[0] >= 0.0:
+            # reference 'auto': provably non-negative (post-relu)
+            # tensors take the uint8 lattice's extra resolution
+            dt = "uint8"
         qmap = r.setdefault("q", {})
-        if idx not in qmap:
+        key = (idx, dt)
+        if key not in qmap:
             f = as_fp32(node)
-            kw = {"out_type": quantized_dtype}
-            rng = calib_ranges.get(_out_name(node))
+            kw = {"out_type": dt}
             if rng is not None:
                 kw["min_calib_range"] = float(rng[0])
                 kw["max_calib_range"] = float(rng[1])
             n = S._make_node("quantize_v2", [f], kw,
-                             name=(node._name or "t") + f"_quantize{idx}"
-                             if node._num_outputs > 1 else
-                             (node._name or "t") + "_quantize")
-            qmap[idx] = (n[0], n[1], n[2])
-        return qmap[idx]
+                             name=(node._name or "t")
+                             + f"_quantize_{dt}{idx}")
+            qmap[key] = (n[0], n[1], n[2])
+        return qmap[key]
 
     def weight_vars(wnode):
         """Offline-quantized weight: three fresh variables the caller
@@ -452,7 +465,7 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
         kw = dict(node._kwargs)
         rng = calib_ranges.get(_out_name(node))
         if op in ("convolution", "fully_connected"):
-            dq, dmn, dmx = as_q(node._inputs[0])
+            dq, dmn, dmx = as_q(node._inputs[0], dtype_req="int8")
             wq, wmn, wmx = weight_vars(node._inputs[1])
             ins = [dq, wq, dmn, dmx, wmn, wmx]
             if len(node._inputs) > 2 and not kw.get("no_bias"):

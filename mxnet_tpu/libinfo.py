@@ -13,12 +13,14 @@ __version__ = "0.1.0"
 
 
 def find_lib_path(prefix="libmxnet"):
-    """Paths of the native C-ABI library, building it if a toolchain is
-    available (reference libinfo.py:26 returns [libmxnet.so])."""
+    """Paths of the native C-ABI library matching `prefix`, building it
+    if a toolchain is available (reference libinfo.py:26)."""
     from ._native import build_c_api
 
     so = build_c_api()
-    return [so] if so else []
+    if so and os.path.basename(so).startswith(prefix):
+        return [so]
+    return []
 
 
 def find_include_path():

@@ -27,14 +27,6 @@ class FactorScheduler(LearningRateScheduler):
                              "reduce")
         self.step = step
         self.factor = factor
-        self.old_lr = self.base_lr
-        self.init = False
 
     def __call__(self, iteration):
-        if not self.init:
-            self.init = True
-            self.old_lr = self.base_lr
-        lr = self.base_lr * (self.factor ** (iteration // self.step))
-        if lr != self.old_lr:
-            self.old_lr = lr
-        return lr
+        return self.base_lr * (self.factor ** (iteration // self.step))

@@ -43,13 +43,19 @@ def quantize(data, min_range, max_range, out_type="uint8"):
 def quantize_v2(data, min_calib_range=None, max_calib_range=None,
                 out_type="int8"):
     """Reference: quantization/quantize_v2.cc — computes ranges from data
-    when no calibrated range is given."""
+    when no calibrated range is given. out_type='uint8' assumes a
+    non-negative range (the pass selects it only post-relu) and uses the
+    zero-point-free [0, max] lattice with 255 steps."""
     if min_calib_range is None or max_calib_range is None:
         mn = jnp.min(data).astype(jnp.float32)
         mx_ = jnp.max(data).astype(jnp.float32)
     else:
         mn = jnp.asarray(min_calib_range, jnp.float32)
         mx_ = jnp.asarray(max_calib_range, jnp.float32)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx_, 1e-20)
+        q = jnp.clip(jnp.rint(data * scale), 0, 255).astype(jnp.uint8)
+        return q, jnp.zeros((), jnp.float32), mx_
     return _quantize_raw(data, mn, mx_, out_type)
 
 
@@ -67,7 +73,7 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     if data.dtype == jnp.int8:
         amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
         return data.astype(jnp.float32) * (amax / 127.0)
-    # uint8 affine
+    # uint8: zero-point-free [mn(=0), mx] lattice
     scale = (mx_ - mn) / 255.0
     return data.astype(jnp.float32) * scale + mn
 
@@ -106,8 +112,33 @@ def _sym_scale(mn, mx_):
     return jnp.maximum(amax, 1e-20) / 127.0
 
 
+def _in_scale(data, mn, mx_):
+    """Decode scale for a quantized input: uint8 tensors carry
+    zero-point-free [0, max] ranges (the pass only selects uint8 for
+    provably non-negative tensors — post-relu), int8 symmetric
+    otherwise. Reference: quantization uses uint8 after relu for the
+    extra bit of resolution (quantize_v2.cc auto mode)."""
+    if data.dtype == jnp.uint8:
+        return jnp.maximum(jnp.abs(_scalar(mx_)), 1e-20) / 255.0
+    return _sym_scale(_scalar(mn), _scalar(mx_))
+
+
 def _scalar(x):
     return jnp.reshape(x, ()).astype(jnp.float32)
+
+
+def _to_s8_lattice(data, min_data, max_data):
+    """Re-quantize a uint8 [0,max] tensor onto the int8 lattice (cheap
+    elementwise) so int8-only MXU ops (conv/fc) can consume it; int8
+    inputs pass through. Returns (q_s8, decode_scale)."""
+    if data.dtype == jnp.uint8:
+        mx_ = _scalar(max_data)
+        s8_scale = jnp.maximum(mx_, 1e-20) / 127.0
+        # real = u8 * mx/255; q_s8 = real / (mx/127) = u8 * 127/255
+        q = jnp.clip(jnp.rint(data.astype(jnp.float32) * (127.0 / 255.0)),
+                     0, 127).astype(jnp.int8)
+        return q, s8_scale
+    return data, _in_scale(data, min_data, max_data)
 
 
 @register(differentiable=False)
@@ -116,6 +147,8 @@ def _contrib_quantized_act(data, min_data, max_data, act_type="relu"):
     the int8 lattice (zero-point 0 for symmetric int8), range preserved."""
     if act_type != "relu":
         raise ValueError("only act_type='relu' is quantized")
+    if data.dtype == jnp.uint8:  # already non-negative
+        return data, _scalar(min_data), _scalar(max_data)
     return (jnp.maximum(data, 0).astype(data.dtype),
             _scalar(min_data), _scalar(max_data))
 
@@ -150,7 +183,8 @@ def _contrib_quantized_pooling(data, min_data, max_data, kernel=None,
                    stride=stride, pad=pad,
                    pooling_convention=pooling_convention,
                    count_include_pad=count_include_pad, layout=layout)
-        out = jnp.clip(jnp.rint(acc), -127, 127).astype(data.dtype)
+        lo, hi = (0, 255) if data.dtype == jnp.uint8 else (-127, 127)
+        out = jnp.clip(jnp.rint(acc), lo, hi).astype(data.dtype)
     return out, _scalar(min_data), _scalar(max_data)
 
 
@@ -160,8 +194,8 @@ def _contrib_quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min,
     """Reference: quantization/quantized_elemwise_add.cc — rescale both
     addends onto the output lattice; output range = |l|max + |r|max (the
     exact bound for a sum)."""
-    ls = _sym_scale(_scalar(lhs_min), _scalar(lhs_max))
-    rs = _sym_scale(_scalar(rhs_min), _scalar(rhs_max))
+    ls = _in_scale(lhs, lhs_min, lhs_max)
+    rs = _in_scale(rhs, rhs_min, rhs_max)
     omax = jnp.abs(_scalar(lhs_max)) + jnp.abs(_scalar(rhs_max))
     omax = jnp.maximum(omax,
                        jnp.abs(_scalar(lhs_min)) + jnp.abs(_scalar(rhs_min)))
@@ -184,9 +218,10 @@ def _contrib_quantized_concat(*args, dim=1):
     for a in amaxs[1:]:
         omax = jnp.maximum(omax, a)
     os_ = jnp.maximum(omax, 1e-20) / 127.0
-    parts = [jnp.clip(jnp.rint(d.astype(jnp.float32) * (a / 127.0) / os_),
+    parts = [jnp.clip(jnp.rint(d.astype(jnp.float32)
+                               * _in_scale(d, mn, mx_) / os_),
                       -127, 127).astype(jnp.int8)
-             for d, a in zip(datas, amaxs)]
+             for d, mn, mx_ in zip(datas, mins, maxs)]
     return jnp.concatenate(parts, axis=dim), -omax, omax
 
 
@@ -198,7 +233,7 @@ def _contrib_quantized_batch_norm(data, gamma, beta, moving_mean,
     """Reference: quantization/quantized_batch_norm.cc — inference BN
     folded to a per-channel affine applied on the dequantized lattice,
     requantized onto the calibrated output range."""
-    scale = _sym_scale(_scalar(min_data), _scalar(max_data))
+    scale = _in_scale(data, min_data, max_data)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = g / jnp.sqrt(moving_var + eps)
     shp = (1, -1) + (1,) * (data.ndim - 2)
@@ -235,6 +270,10 @@ def _contrib_quantized_conv(data, weight, min_data=None, max_data=None,
     stride_ = _tup(stride or 1, nd)
     dilate_ = _tup(dilate or 1, nd)
     pad_ = _tup(pad or 0, nd)
+    # uint8 inputs (auto mode, via pool/act chains) hop onto the int8
+    # lattice BEFORE the conv: XLA convs need matching operand dtypes
+    data, ds = _to_s8_lattice(data, min_data, max_data)
+    ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
     dn = _lax.conv_dimension_numbers(data.shape, weight.shape,
                                      _conv_dims(nd, layout))
     acc = _lax.conv_general_dilated(
@@ -242,8 +281,6 @@ def _contrib_quantized_conv(data, weight, min_data=None, max_data=None,
         padding=[(p, p) for p in pad_], rhs_dilation=dilate_,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=jnp.int32)
-    ds = _sym_scale(_scalar(min_data), _scalar(max_data))
-    ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
     if bias is not None and not no_bias:
         from .ops_nn import _CHANNEL_LAST
 
@@ -271,9 +308,9 @@ def _contrib_quantized_fully_connected(data, weight, min_data=None,
 
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    acc = _lax.dot(data, weight.T, preferred_element_type=jnp.int32)
-    ds = _sym_scale(_scalar(min_data), _scalar(max_data))
+    data, ds = _to_s8_lattice(data, min_data, max_data)
     ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
+    acc = _lax.dot(data, weight.T, preferred_element_type=jnp.int32)
     if bias is not None and not no_bias:
         bq = jnp.rint(bias.astype(jnp.float32) / (ds * ws)).astype(jnp.int32)
         acc = acc + bq

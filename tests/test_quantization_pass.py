@@ -258,3 +258,44 @@ def test_quantize_net_graph_exclude_match_and_deferred_init():
     assert "_contrib_quantized_conv" not in js
     assert "_contrib_quantized_fully_connected" in js
     assert qb(x).shape == (2, 5)
+
+
+def test_quantized_dtype_auto_uint8():
+    """quantized_dtype='auto' (reference quantize_v2.cc auto mode):
+    provably non-negative region boundaries (post-relu) take the uint8
+    lattice; conv/fc consumers force int8 at their own boundary (XLA
+    convs need matching operand dtypes) or hop uint8 chains onto the
+    int8 lattice in-op."""
+    import json as J
+
+    def build(with_pool):
+        data = S.var("data")
+        c1 = S.Convolution(data, name="conv1", kernel=(3, 3),
+                           num_filter=6, pad=(1, 1))
+        r1 = S.Activation(c1, name="relu1", act_type="relu")
+        mid = S.Pooling(r1, name="pool1", kernel=(2, 2), stride=(2, 2),
+                        pool_type="max") if with_pool else r1
+        c2 = S.Convolution(mid, name="conv2", kernel=(3, 3),
+                           num_filter=6, pad=(1, 1))
+        return S.FullyConnected(S.Flatten(c2, name="fl"), name="fc1",
+                                num_hidden=4)
+
+    onp.random.seed(0)
+    for with_pool, expect_u8 in ((False, 0), (True, 1)):
+        fc = build(with_pool)
+        args = fc.list_arguments()
+        shp, _, _ = fc.infer_shape(data=(2, 3, 12, 12))
+        params = {n: nd.array(onp.random.randn(*s).astype("f") * 0.2)
+                  for n, s in zip(args, shp) if n != "data"}
+        x = nd.array(onp.random.randn(2, 3, 12, 12).astype("f"))
+        fp32 = fc.eval_with({**params, "data": x}).asnumpy()
+        calib = [x, nd.array(onp.random.randn(2, 3, 12, 12).astype("f"))]
+        qsym, qarg, _ = quantize_model(
+            fc, params, {}, calib_mode="naive", calib_data=calib,
+            quantized_dtype="auto", excluded_sym_names=("conv1", "relu1"))
+        nodes = J.loads(qsym.tojson())["nodes"]
+        u8 = [n for n in nodes if n["op"] == "quantize_v2"
+              and n.get("attrs", {}).get("out_type") == "uint8"]
+        assert len(u8) == expect_u8, (with_pool, u8)
+        out = qsym.eval_with({**qarg, "data": x}).asnumpy()
+        assert _rel_err(out, fp32) < 0.1

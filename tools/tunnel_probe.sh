@@ -5,7 +5,7 @@
 LOG=/tmp/tunnel_probe.log
 while true; do
   ts=$(date -u +%FT%TZ)
-  raw=$(timeout 150 python -c "import jax; print(jax.devices())" 2>&1)
+  raw=$(timeout -k 10 150 python -c "import jax; print(jax.devices())" 2>&1)
   rc=$?
   out=$(printf '%s\n' "$raw" | tail -1)
   if [ $rc -eq 0 ] && echo "$out" | grep -q "TpuDevice\|axon"; then
