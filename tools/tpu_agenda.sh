@@ -1,0 +1,60 @@
+#!/bin/bash
+# The round-5 on-chip measurement agenda, run back-to-back in one healthy
+# tunnel window (BENCH_NOTES_r05.md "ready-to-run" list). Writes artifacts
+# into the repo root and logs to /tmp/tpu_agenda.log. Idempotent: skips
+# steps whose artifact already exists; a lock prevents concurrent runs.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG=/tmp/tpu_agenda.log
+LOCK=/tmp/tpu_agenda.lock
+cd "$REPO"
+
+exec 9>"$LOCK"
+if ! flock -n 9; then
+  echo "$(date -u +%FT%TZ) agenda already running" >> "$LOG"
+  exit 0
+fi
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+run_step() {  # name, artifact, timeout_s, cmd...
+  local name="$1" artifact="$2" tmo="$3"; shift 3
+  if [ -s "$artifact" ] && ! grep -q "cpu_fallback\|unavailable" "$artifact"; then
+    log "$name: artifact exists, skipping"
+    return 0
+  fi
+  log "$name: starting ($*)"
+  local out
+  out=$(timeout -k 30 "$tmo" "$@" 2>>"$LOG")
+  local rc=$?
+  # keep the LAST json line as the artifact
+  local line
+  line=$(printf '%s\n' "$out" | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    printf '%s\n' "$line" > "$artifact"
+    log "$name: OK -> $artifact"
+  else
+    log "$name: rc=$rc, no json line"
+  fi
+  return $rc
+}
+
+log "=== agenda start ==="
+
+# 1. the headline bench (phase-aware supervisor handles retries itself)
+run_step bench BENCH_LOCAL_r05.json 3600 python bench.py
+
+# 2. no-framework ceiling for the same model
+run_step rawjax RAWJAX_r05.json 2400 env BENCH_CHILD= BENCH_MODE=rawjax \
+  python bench.py
+
+# 3. XPlane profile of the bf16 b512 step + inline top-self-time table
+run_step profile PROFILE_r05.json 2400 env BENCH_MODE=profile \
+  BENCH_BATCH=512 BENCH_PROFILE_DIR=bench_profile_r05 python bench.py
+
+# 4. data-FED training rate vs synthetic ceiling (decode+H2D overlap)
+run_step overlap OVERLAP_r05.json 2400 python \
+  examples/train_imagenet_rec.py --bf16 --depth 50 --image-size 224 \
+  --batch 256 --images 2048 --steps 8 --overlap-report
+
+log "=== agenda end ==="

@@ -10,6 +10,9 @@ while true; do
   out=$(printf '%s\n' "$raw" | tail -1)
   if [ $rc -eq 0 ] && echo "$out" | grep -q "TpuDevice\|axon"; then
     echo "$ts HEALTHY $out" >> "$LOG"
+    # pounce: run the round's on-chip agenda while the window is open
+    # (idempotent + locked; see tools/tpu_agenda.sh)
+    /root/repo/tools/tpu_agenda.sh
   else
     echo "$ts down rc=$rc $out" >> "$LOG"
   fi
