@@ -624,3 +624,27 @@ def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
     for li, ri in zip(la, ra):
         target[li] = rhs.shape[ri]
     return jnp.broadcast_to(lhs, tuple(target))
+
+
+@register()
+def rnn_param_concat(*args, dim=0):
+    """Reference: src/operator/nn/concat.cc _rnn_param_concat — plain
+    concatenation specialized for RNN parameter packing. Mixed-rank
+    inputs (weights + biases) flatten first; differentiable so packed
+    RNN parameters receive gradients (the reference reuses concat's
+    split backward)."""
+    if any(a.ndim != args[0].ndim for a in args):
+        return jnp.concatenate([jnp.ravel(a) for a in args])
+    return jnp.concatenate(list(args), axis=dim)
+
+
+@register()
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Reference: src/operator/regression_output.cc
+    IdentityAttachKLSparseReg — identity forward; the KL sparsity
+    penalty acts through the backward pass in the reference (training
+    autoencoders). Forward-identical AND differentiable here (gradients
+    pass through); the penalty is documented as a loss-side concern on
+    TPU (add it to the loss explicitly)."""
+    return data

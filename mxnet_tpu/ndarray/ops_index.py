@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import get_op, register
 
 
 @register()
@@ -175,3 +175,59 @@ def _static_slice(data, key=None):
 @register(name="_slice_take")
 def _slice_take(data, key=None):
     return data[key]
+
+
+@register(differentiable=False)
+def unravel_index(data, shape=None):
+    """Alias of `unravel` under the reference's public name
+    (src/operator/tensor/ravel.cc _unravel_index)."""
+    return get_op("unravel").fn(data, shape=shape)
+
+
+@register()
+def slice_assign(lhs, rhs, begin=None, end=None, step=None):
+    """Functional slice write: lhs with lhs[begin:end:step] = rhs
+    (reference: src/operator/tensor/matrix_op.cc _slice_assign — the op
+    form of sliced __setitem__; XLA lowers to dynamic_update_slice)."""
+    idx = tuple(slice(b if b is not None else None,
+                      e if e is not None else None,
+                      s if s not in (None, 0) else None)
+                for b, e, s in zip(begin or (), end or (),
+                                   step or (None,) * len(begin or ())))
+    return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+
+@register()
+def slice_assign_scalar(data, begin=None, end=None, step=None,
+                        scalar=0.0):
+    """Reference: _slice_assign_scalar."""
+    idx = tuple(slice(b if b is not None else None,
+                      e if e is not None else None,
+                      s if s not in (None, 0) else None)
+                for b, e, s in zip(begin or (), end or (),
+                                   step or (None,) * len(begin or ())))
+    return data.at[idx].set(jnp.asarray(scalar, data.dtype))
+
+
+@register()
+def scatter_set_nd(lhs, rhs, indices, shape=None):
+    """Reference: src/operator/tensor/indexing_op.cc _scatter_set_nd —
+    lhs with lhs[indices] = rhs (gather_nd's inverse on an existing
+    tensor; indices (M, N) index the first M axes)."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in
+                range(indices.shape[0]))
+    return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+
+@register(differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference: src/operator/tensor/init_op.cc _contrib_arange_like —
+    arange shaped like `data` (or its `axis` length)."""
+    def seq(n):
+        base = start + step * jnp.arange(
+            -(-n // repeat) if repeat != 1 else n, dtype=jnp.float32)
+        return jnp.repeat(base, repeat)[:n] if repeat != 1 else base
+
+    if axis is None:
+        return seq(data.size).reshape(data.shape)
+    return seq(data.shape[axis])

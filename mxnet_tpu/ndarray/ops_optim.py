@@ -333,3 +333,151 @@ def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
     gnorm = jnp.sqrt(grads_sum_sq) * rescale_grad
     ratio = eta * wnorm / (gnorm + wds * wnorm + eps)
     return lrs * jnp.where(wnorm > 0, jnp.where(gnorm > 0, ratio, 1.0), 1.0)
+
+
+# ---- round-5 multi-precision / multi-tensor tail (reference:
+# src/operator/optimizer_op.cc mp_* variants, contrib/adamw.cc multi_*,
+# all_finite.cc MultiAllFinite). mp_* keep an fp32 MASTER copy of a
+# low-precision weight: the update computes in fp32 and writes both the
+# cast weight and the master (TPU: exactly the bf16-params + fp32-master
+# recipe SPMDTrainer uses internally).
+
+@register(differentiable=False)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register(differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register(differentiable=False)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad,
+                   clip_gradient) + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register(differentiable=False)
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
+                    eta=1.0, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    wd=0.0, clip_gradient=-1.0):
+    """Reference: contrib/adamw.cc MPUpdate — NB rescale_grad is a
+    TENSOR input here (the loss-scale), not a scalar attr."""
+    scale = jnp.reshape(rescale_grad, ()).astype(jnp.float32)
+    g = _prep_grad(grad.astype(jnp.float32), scale, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                            + wd * weight32)
+    return w32.astype(weight.dtype), mean_new, var_new, w32
+
+
+@register(differentiable=False)
+def multi_adamw_update(*args, lrs=None, wds=None, etas=None, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, num_weights=0,
+                       clip_gradient=-1.0):
+    """Inputs [w,g,mean,var]*n + [rescale_grad tensor]; returns
+    (w'..., mean'..., var'...)."""
+    n = _multi_n(num_weights, len(args) - 1, 4)
+    scale = jnp.reshape(args[-1], ()).astype(jnp.float32)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    etas = _scalar_list(etas, n, "etas")
+    ws, means, vars_ = [], [], []
+    for i in range(n):
+        w, g, m, v = args[4 * i:4 * i + 4]
+        g = _prep_grad(g.astype(jnp.float32), scale, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+        w2 = w - etas[i] * (lrs[i] * m2 / (jnp.sqrt(v2) + epsilon)
+                            + wds[i] * w)
+        ws.append(w2.astype(w.dtype))
+        means.append(m2)
+        vars_.append(v2)
+    return tuple(ws) + tuple(means) + tuple(vars_)
+
+
+@register(differentiable=False)
+def multi_mp_adamw_update(*args, lrs=None, wds=None, etas=None, beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, num_weights=0,
+                          clip_gradient=-1.0):
+    """Inputs [w,g,mean,var,w32]*n + [rescale_grad]; returns
+    (w'..., mean'..., var'..., w32'...)."""
+    n = _multi_n(num_weights, len(args) - 1, 5)
+    scale = jnp.reshape(args[-1], ()).astype(jnp.float32)
+    lrs = _scalar_list(lrs, n, "lrs")
+    wds = _scalar_list(wds, n, "wds")
+    etas = _scalar_list(etas, n, "etas")
+    ws, means, vars_, w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = args[5 * i:5 * i + 5]
+        g = _prep_grad(g.astype(jnp.float32), scale, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+        nw32 = w32 - etas[i] * (lrs[i] * m2 / (jnp.sqrt(v2) + epsilon)
+                                + wds[i] * w32)
+        ws.append(nw32.astype(w.dtype))
+        means.append(m2)
+        vars_.append(v2)
+        w32s.append(nw32)
+    return tuple(ws) + tuple(means) + tuple(vars_) + tuple(w32s)
+
+
+@register(differentiable=False)
+def preloaded_multi_mp_sgd_update(*args, num_weights=0, rescale_grad=1.0,
+                                  clip_gradient=-1.0):
+    """Inputs [w,g,w32]*n + [lrs tensor, wds tensor] (reference
+    preloaded_multi_* — hyperparams ride as tensors so one compiled op
+    serves every step)."""
+    n = _multi_n(num_weights, len(args) - 2, 3)
+    lrs, wds = args[-2], args[-1]
+    ws, w32s = [], []
+    for i in range(n):
+        w, g, w32 = args[3 * i:3 * i + 3]
+        g = _prep_grad(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        nw32 = w32 - lrs[i] * (g + wds[i] * w32)
+        ws.append(nw32.astype(w.dtype))
+        w32s.append(nw32)
+    return tuple(ws) + tuple(w32s)
+
+
+@register(differentiable=False)
+def preloaded_multi_mp_sgd_mom_update(*args, momentum=0.0, num_weights=0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    """Inputs [w,g,m,w32]*n + [lrs, wds]."""
+    n = _multi_n(num_weights, len(args) - 2, 4)
+    lrs, wds = args[-2], args[-1]
+    ws, ms, w32s = [], [], []
+    for i in range(n):
+        w, g, m, w32 = args[4 * i:4 * i + 4]
+        g = _prep_grad(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        m2 = momentum * m - lrs[i] * (g + wds[i] * w32)
+        nw32 = w32 + m2
+        ws.append(nw32.astype(w.dtype))
+        ms.append(m2)
+        w32s.append(nw32)
+    return tuple(ws) + tuple(ms) + tuple(w32s)
+
+
+@register(differentiable=False)
+def multi_all_finite(*arrays, num_arrays=0, init_output=True):
+    """Reference: src/operator/all_finite.cc MultiAllFinite — one flag
+    over every input tensor."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.astype(jnp.float32).reshape(1)

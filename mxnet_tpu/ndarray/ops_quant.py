@@ -316,3 +316,18 @@ def _contrib_quantized_fully_connected(data, weight, min_data=None,
         acc = acc + bq
     omax = 127.0 * 127.0 * ds * ws
     return acc, -omax, omax
+
+
+@register(differentiable=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """Reference: quantization/calibrate.cc _contrib_calibrate_entropy —
+    op form of the KL-threshold search. Host-side (data-dependent loop),
+    returns (min, max) of the optimal calibrated range."""
+    import numpy as _onp
+
+    from ..contrib.quantization import calib_entropy as _ce
+
+    t = _ce(_onp.asarray(hist), _onp.asarray(hist_edges),
+            int(num_quantized_bins))
+    return (jnp.asarray(-t, jnp.float32).reshape(()),
+            jnp.asarray(t, jnp.float32).reshape(()))

@@ -242,3 +242,110 @@ def test_group_adagrad_sparse_rows_only():
         onp.testing.assert_allclose(st[r, 0], h, rtol=1e-6)
         onp.testing.assert_allclose(
             got[r], w0[r] - 0.5 * v / onp.sqrt(h + 1e-5), rtol=1e-5)
+
+
+def test_mp_update_ops_master_copy_semantics():
+    """r5 op tail: mp_* optimizer ops keep an fp32 master alongside a
+    low-precision weight (reference optimizer_op.cc MP_SGD etc.)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    w32 = nd.array(onp.ones(4, "f"))
+    w = w32.astype("float16")
+    g = nd.array(onp.full(4, 0.5, "f")).astype("float16")
+    nw, nw32 = nd.mp_sgd_update(w, g, w32, lr=0.1)
+    onp.testing.assert_allclose(nw32.asnumpy(), 0.95 * onp.ones(4),
+                                rtol=1e-6)
+    assert str(nw.dtype) == "float16"
+    mom = nd.zeros(4)
+    nw, nmom, nw32 = nd.mp_sgd_mom_update(w, g, mom, w32, lr=0.1,
+                                          momentum=0.9)
+    assert str(nw.dtype) == "float16" and nw32.dtype == onp.float32
+
+    # mp_adamw: rescale_grad is a TENSOR (loss-scale)
+    mean, var = nd.zeros(4), nd.zeros(4)
+    scale = nd.array([1.0])
+    ws, nmean, nvar, nw32 = nd.mp_adamw_update(
+        w, g, mean, var, w32, scale, lr=0.01)
+    assert str(ws.dtype) == "float16"
+    assert float(nvar.asnumpy()[0]) > 0
+
+    # multi_all_finite over mixed tensors
+    good = nd.array(onp.ones(3, "f"))
+    bad = nd.array(onp.array([1.0, onp.inf, 0.0], "f"))
+    assert float(nd.multi_all_finite(good, good).asnumpy()[0]) == 1.0
+    assert float(nd.multi_all_finite(good, bad).asnumpy()[0]) == 0.0
+
+
+def test_multi_adamw_and_preloaded_mp_sgd():
+    import numpy as onp
+
+    from mxnet_tpu import nd
+
+    w1, w2 = nd.array(onp.ones(3, "f")), nd.array(onp.ones(2, "f") * 2)
+    g1, g2 = nd.array(onp.full(3, 0.1, "f")), nd.array(onp.full(2, 0.2, "f"))
+    m1, m2 = nd.zeros(3), nd.zeros(2)
+    v1, v2 = nd.zeros(3), nd.zeros(2)
+    scale = nd.array([1.0])
+    outs = nd.multi_adamw_update(w1, g1, m1, v1, w2, g2, m2, v2, scale,
+                                 lrs=(0.01, 0.01), wds=(0.0, 0.0),
+                                 etas=(1.0, 1.0), num_weights=2)
+    assert len(outs) == 6
+    assert outs[0].shape == (3,) and outs[1].shape == (2,)
+    assert float(outs[0].asnumpy()[0]) < 1.0  # moved toward smaller
+
+    # preloaded: lrs/wds ride as tensors
+    w32a, w32b = nd.array(onp.ones(3, "f")), nd.array(onp.ones(2, "f"))
+    wa, wb = w32a.astype("float16"), w32b.astype("float16")
+    ga, gb = nd.array(onp.full(3, 0.5, "f")), nd.array(onp.full(2, 0.5, "f"))
+    lrs, wds = nd.array([0.1, 0.2]), nd.array([0.0, 0.0])
+    outs = nd.preloaded_multi_mp_sgd_update(
+        wa, ga, w32a, wb, gb, w32b, lrs, wds, num_weights=2)
+    assert len(outs) == 4
+    onp.testing.assert_allclose(outs[2].asnumpy(), 0.95 * onp.ones(3),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(outs[3].asnumpy(), 0.9 * onp.ones(2),
+                                rtol=1e-6)
+
+
+def test_r5_utility_ops():
+    import numpy as onp
+
+    from mxnet_tpu import nd
+
+    # slice_assign / scalar
+    a = nd.array(onp.zeros((3, 4), "f"))
+    r = nd.slice_assign(a, nd.array(onp.ones((2, 2), "f")),
+                        begin=(0, 1), end=(2, 3))
+    assert r.asnumpy()[0, 1] == 1.0 and r.asnumpy()[2, 3] == 0.0
+    r2 = nd.slice_assign_scalar(a, begin=(1,), end=(2,), scalar=7.0)
+    assert r2.asnumpy()[1, 0] == 7.0
+    # scatter_set_nd
+    base = nd.array(onp.zeros((3, 3), "f"))
+    idx = nd.array(onp.array([[0, 2], [1, 0]], "f"))
+    vals = nd.array(onp.array([5.0, 6.0], "f"))
+    out = nd.scatter_set_nd(base, vals, idx)
+    assert out.asnumpy()[0, 1] == 5.0 and out.asnumpy()[2, 0] == 6.0
+    # arange_like
+    x = nd.array(onp.zeros((2, 3), "f"))
+    al = nd.arange_like(x)
+    assert al.shape == (2, 3) and float(al.asnumpy()[1, 2]) == 5.0
+    assert nd.arange_like(x, axis=1).shape == (3,)
+    # unravel_index alias
+    flat = nd.array(onp.array([5.0]))
+    ur = nd.unravel_index(flat, shape=(2, 3))
+    assert ur.asnumpy().ravel().tolist() == [1.0, 2.0]
+    # cast_storage exported on nd
+    dense = nd.array(onp.array([[1.0, 0.0], [0.0, 2.0]], "f"))
+    csr = nd.cast_storage(dense, "csr")
+    assert csr.stype == "csr"
+    back = nd.cast_storage(csr, "default")
+    onp.testing.assert_allclose(back.asnumpy(), dense.asnumpy())
+    # calibrate_entropy op form
+    h = onp.histogram(onp.abs(onp.random.RandomState(0).randn(4000)),
+                      bins=512)
+    mn, mx_ = nd.calibrate_entropy(nd.array(h[0].astype("f")),
+                                   nd.array(h[1].astype("f")))
+    assert float(mx_.asnumpy()) > 0 and float(mn.asnumpy()) < 0
