@@ -224,3 +224,44 @@ def test_bn_use_global_stats_never_updates_aux():
         exe.forward(is_train=True)
     onp.testing.assert_array_equal(
         exe.aux_dict["bn0_moving_mean"].asnumpy(), before)
+
+
+def test_legacy_opname_json_interop():
+    """Reference-era JSON graphs carrying legacy / underscore-prefixed
+    nnvm op names (BatchNorm_v1, _slice_assign_scalar, ...) load and
+    evaluate (r5 alias table; SURVEY §7 checkpoint-interop)."""
+    import json
+
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    import mxnet_tpu.symbol as S
+
+    js = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "bn_gamma", "inputs": []},
+            {"op": "null", "name": "bn_beta", "inputs": []},
+            {"op": "null", "name": "bn_moving_mean", "inputs": []},
+            {"op": "null", "name": "bn_moving_var", "inputs": []},
+            {"op": "BatchNorm_v1", "name": "bn",
+             "attrs": {"fix_gamma": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0], [3, 0, 1],
+                        [4, 0, 1]]},
+            {"op": "_slice_assign_scalar", "name": "sa",
+             "attrs": {"begin": "(0,)", "end": "(1,)", "scalar": "9.0"},
+             "inputs": [[5, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 3, 4],
+        "node_row_ptr": list(range(8)),
+        "heads": [[6, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10500]},
+    })
+    symb = S.load_json(js)
+    feed = {"data": nd.array(onp.ones((2, 3), "f")),
+            "bn_gamma": nd.array(onp.ones(3, "f")),
+            "bn_beta": nd.array(onp.zeros(3, "f")),
+            "bn_moving_mean": nd.array(onp.zeros(3, "f")),
+            "bn_moving_var": nd.array(onp.ones(3, "f"))}
+    out = symb.eval_with(feed)
+    assert out.asnumpy()[0, 0] == 9.0
