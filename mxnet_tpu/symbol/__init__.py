@@ -288,9 +288,14 @@ class Symbol:
         from ..ndarray import _CAMEL_ALIASES
 
         # SoftmaxActivation is a LOSSY alias (different op/params in the
-        # reference) — never reverse-map onto it
-        rev = {v: k for k, v in _CAMEL_ALIASES.items()
-               if k != "SoftmaxActivation"}
+        # reference) — never reverse-map onto it. Later table entries are
+        # LEGACY-ONLY aliases (BatchNorm_v1, _contrib_quantize_v2, ...):
+        # they must load but never win the reverse mapping, so the FIRST
+        # alias per target (the canonical CamelCase name) is kept.
+        rev = {}
+        for k, v in _CAMEL_ALIASES.items():
+            if k != "SoftmaxActivation":
+                rev.setdefault(v, k)
         # canonicalize: output-view Symbols (same node, different
         # output_index) must collapse to ONE emitted node, keyed by name
         order, idx = [], {}

@@ -115,8 +115,11 @@ def test_quantized_graph_structure(cnn):
     import json as J
 
     nodes = J.loads(json)["nodes"]
-    n_quant = sum(1 for n in nodes if n["op"] == "quantize_v2")
-    n_deq = sum(1 for n in nodes if n["op"] == "dequantize")
+    # tojson emits the REFERENCE names (_contrib_quantize_v2 et al.)
+    n_quant = sum(1 for n in nodes
+                  if n["op"] in ("quantize_v2", "_contrib_quantize_v2"))
+    n_deq = sum(1 for n in nodes
+                if n["op"] in ("dequantize", "_contrib_dequantize"))
     assert n_quant == 1, n_quant
     assert n_deq == 1, n_deq
 
@@ -210,8 +213,10 @@ def test_quantize_net_graph_mode():
                "_contrib_quantized_fully_connected"):
         assert op in ops, op
     # one quantize at the data boundary, one dequantize at the output
-    assert ops.count("quantize_v2") == 1
-    assert ops.count("dequantize") == 1
+    assert sum(ops.count(o) for o in
+               ("quantize_v2", "_contrib_quantize_v2")) == 1
+    assert sum(ops.count(o) for o in
+               ("dequantize", "_contrib_dequantize")) == 1
     # int8 weights made it into the block's parameters
     wq = [p for name, p in qb.collect_params().items()
           if name.endswith("_quantized")]
@@ -294,7 +299,8 @@ def test_quantized_dtype_auto_uint8():
             fc, params, {}, calib_mode="naive", calib_data=calib,
             quantized_dtype="auto", excluded_sym_names=("conv1", "relu1"))
         nodes = J.loads(qsym.tojson())["nodes"]
-        u8 = [n for n in nodes if n["op"] == "quantize_v2"
+        u8 = [n for n in nodes
+              if n["op"] in ("quantize_v2", "_contrib_quantize_v2")
               and n.get("attrs", {}).get("out_type") == "uint8"]
         assert len(u8) == expect_u8, (with_pool, u8)
         out = qsym.eval_with({**qarg, "data": x}).asnumpy()
