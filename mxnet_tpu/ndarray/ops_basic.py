@@ -508,12 +508,16 @@ def _ones_like_op(data):
 
 @register()
 def shape_array(data):
-    return jnp.asarray(data.shape, dtype=jnp.int64)
+    # int64 per the reference contract when x64 is on; int32 otherwise
+    # (shapes fit, and requesting int64 would just warn-and-truncate)
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(data.shape, dtype=dt)
 
 
 @register()
 def size_array(data):
-    return jnp.asarray([data.size], dtype=jnp.int64)
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray([data.size], dtype=dt)
 
 
 @register()
