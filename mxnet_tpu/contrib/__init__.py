@@ -1,5 +1,9 @@
 """mx.contrib (reference: python/mxnet/contrib/__init__.py)."""
 from . import amp  # noqa: F401
+from . import autograd  # noqa: F401  (legacy experimental API)
+from . import io  # noqa: F401
+from . import ndarray  # noqa: F401  (namespace shim)
+from . import symbol  # noqa: F401  (namespace shim)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import svrg_optimization  # noqa: F401

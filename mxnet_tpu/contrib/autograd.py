@@ -1,0 +1,101 @@
+"""Legacy experimental autograd API (reference:
+python/mxnet/contrib/autograd.py — the pre-mx.autograd surface old
+scripts import). Thin adapters over mxnet_tpu.autograd."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Reference contrib/autograd.py:32 — returns the previous state."""
+    prev = _ag.is_recording()
+    _ag.set_recording(bool(is_train))
+    _ag.set_training(bool(is_train))
+    return prev
+
+
+class TrainingStateScope:
+    """Reference contrib/autograd.py:54."""
+
+    def __init__(self, enter_state):
+        self._enter_state = bool(enter_state)
+        self._prev_r = None
+        self._prev_t = None
+
+    def __enter__(self):
+        self._prev_r = _ag.set_recording(self._enter_state)
+        self._prev_t = _ag.set_training(self._enter_state)
+
+    def __exit__(self, *exc):
+        _ag.set_recording(self._prev_r)
+        _ag.set_training(self._prev_t)
+
+
+def train_section():
+    """``with autograd.train_section():`` legacy recording scope."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Reference contrib/autograd.py:158 — backward with ones heads."""
+    _ag.backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator: f(*args) -> (grads, outputs) (reference :163)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        from .. import ndarray as nd
+
+        variables = list(args)
+        if argnum is not None:
+            nums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in nums]
+        for v in variables:
+            if not isinstance(v, NDArray):
+                raise TypeError("arguments must be NDArrays")
+        # fresh zero buffers EVERY call (reference does the same):
+        # reused buffers would leak grad_req='add' accumulation or
+        # stale values for variables unused by func
+        _ag.mark_variables(
+            variables,
+            [nd.zeros(v.shape, dtype=str(v.dtype)) for v in variables])
+        with TrainingStateScope(True):
+            outputs = func(*args)
+        _ag.backward([outputs] if isinstance(outputs, NDArray)
+                     else list(outputs))
+        grads = [v.grad for v in variables]
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorator returning only the gradients (reference :195)."""
+    g_and_l = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return g_and_l(*args)[0]
+
+    return wrapped

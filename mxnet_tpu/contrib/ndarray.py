@@ -1,0 +1,4 @@
+"""Namespace shim (reference: python/mxnet/contrib/ndarray.py is an
+autogen re-export of the contrib op surface). ``mx.contrib.ndarray.*``
+== ``mx.nd.contrib.*``."""
+from ..ndarray.contrib import *  # noqa: F401,F403

@@ -1,0 +1,3 @@
+"""Namespace shim (reference: python/mxnet/contrib/symbol.py).
+``mx.contrib.symbol.*`` == ``mx.sym.contrib.*``."""
+from ..symbol.contrib import *  # noqa: F401,F403

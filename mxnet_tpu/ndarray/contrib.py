@@ -196,6 +196,13 @@ def cond(pred, then_func, else_func):
 # contrib-namespaced registered ops (reference: mx.nd.contrib.*). Every
 # name listed here must resolve — _install raises on a missing op so the
 # advertised API surface can't silently rot.
+# public surface for `from ... import *` (mx.contrib.ndarray shim):
+# the op names installed by _install() plus the control-flow helpers
+def _public_names():
+    return (["foreach", "while_loop", "cond", "reset_arrays", "getnnz"]
+            + _CONTRIB_OPS + list(_CONTRIB_ALIASES))
+
+
 _CONTRIB_OPS = [
     "boolean_mask", "index_copy", "index_array", "adaptive_avg_pooling2d",
     "bilinear_resize2d", "all_finite", "multi_sum_sq",
@@ -289,3 +296,6 @@ def getnnz(data, axis=None):
     if axis is None:
         return NDArray(jnp.sum(x != 0).reshape(1).astype(jnp.int32))
     return NDArray(jnp.sum(x != 0, axis=axis).astype(jnp.int32))
+
+
+__all__ = _public_names()

@@ -306,3 +306,60 @@ def test_lstmp_cell_projection_shapes():
     out, (r, c) = cell(x, states)
     assert out.shape == (5, 3)
     assert r.shape == (5, 3) and c.shape == (5, 8)
+
+
+def test_legacy_contrib_autograd_api():
+    """reference contrib/autograd.py: the pre-mx.autograd experimental
+    surface (train_section, grad_and_loss, compute_gradient...)."""
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def f(a, b):
+        return a * b
+
+    a = nd.array(onp.array([2.0], "f"))
+    b = nd.array(onp.array([3.0], "f"))
+    grads, out = f(a, b)
+    assert float(out.asnumpy()[0]) == 6.0
+    assert [float(g.asnumpy()[0]) for g in grads] == [3.0, 2.0]
+
+    @cag.grad
+    def g(a):
+        return a * a
+
+    (ga,) = g(nd.array(onp.array([4.0], "f")))
+    assert float(ga.asnumpy()[0]) == 8.0
+
+    x = nd.array(onp.ones(3, "f"))
+    x.attach_grad()
+    with cag.train_section():
+        y = (x * x).sum()
+    cag.compute_gradient([y])
+    assert x.grad.asnumpy().tolist() == [2.0] * 3
+    # test_section suspends recording
+    with cag.train_section():
+        with cag.test_section():
+            from mxnet_tpu import autograd as ag
+
+            assert not ag.is_recording()
+        assert True
+
+
+def test_legacy_contrib_dataloader_iter():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(nd.array(onp.arange(8, dtype="f").reshape(4, 2)),
+                      nd.array(onp.arange(4, dtype="f")))
+    it = mx.contrib.io.DataLoaderIter(DataLoader(ds, batch_size=2))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 2)
+    it.reset()
+    assert len(list(it)) == 2
