@@ -82,3 +82,21 @@ def test_train_imagenet_rec_overlap_report(tmp_path):
     assert line, r.stdout
     payload = json.loads(line[-1])
     assert payload["extra"]["overlap_efficiency_pct"] > 30
+
+
+def test_recommender_mf_example_converges():
+    """examples/train_recommender_mf.py: two-Embedding dot-product MF
+    (reference example/recommenders) converges on synthetic ratings."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "train_recommender_mf.py"),
+         "--epochs", "10", "--ratings", "2000"],
+        env=env, capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-500:])
+    assert "->" in r.stdout
