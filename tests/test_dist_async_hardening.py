@@ -157,3 +157,46 @@ def test_two_process_async_is_actually_async(tmp_path):
         p = tmp_path / f"r{rank}.txt"
         assert p.is_file(), f"worker {rank} produced no result"
         assert p.read_text() == "OK", p.read_text()
+
+
+THREE_PROC_BODY = r"""
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_async")
+rank, size = kv.rank, kv.num_workers
+assert size == 3
+kv.init("w", nd.zeros((2,)))
+# staggered pushes from three workers stress the consecutive-seq
+# applier (seq gaps appear whenever increments interleave with blob
+# writes); every push must land exactly once
+import time as _t
+for r in range(1, 5):
+    kv.push("w", nd.ones((2,)) * (r * (10 ** rank)))
+    _t.sleep(0.01 * rank)
+out = nd.zeros((2,))
+expect = sum(range(1, 5)) * (1 + 10 + 100)  # 10*111 = 1110
+deadline = _t.monotonic() + 60
+final = None
+while _t.monotonic() < deadline:
+    kv.pull("w", out=out)
+    final = float(out.asnumpy()[0])
+    if abs(final - expect) < 1e-3:
+        break
+    _t.sleep(0.05)
+with open(os.path.join({outdir!r}, "r" + str(rank) + ".txt"), "w") as f:
+    f.write("OK" if abs(final - expect) < 1e-3 else
+            "BAD final=%r expect=%r" % (final, expect))
+kv.barrier()
+"""
+
+
+def test_three_process_async_interleave(tmp_path):
+    """Three workers' interleaved pushes all land exactly once through
+    the consecutive-seq applier (gap tolerance exercised)."""
+    run_launched_workers(tmp_path, THREE_PROC_BODY, n=3)
+    for rank in (0, 1, 2):
+        p = tmp_path / f"r{rank}.txt"
+        assert p.is_file(), f"worker {rank} produced no result"
+        assert p.read_text() == "OK", p.read_text()
