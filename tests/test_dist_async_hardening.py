@@ -160,7 +160,6 @@ def test_two_process_async_is_actually_async(tmp_path):
 
 
 THREE_PROC_BODY = r"""
-import numpy as onp
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 
