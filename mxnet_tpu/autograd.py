@@ -132,7 +132,15 @@ def predict_mode():
 
 
 def _record_op(vjp_fn, array_inputs, outputs, fun=None, keys=None):
-    """Append a tape node (called by the op-dispatch layer)."""
+    """Append a tape node (called by the op-dispatch layer).
+
+    Both dispatch paths land here with the same contract: the uncached
+    path passes the eager ``jax.vjp`` closure, the compiled-dispatch
+    cache (ndarray/registry.py) passes the ``jax.tree_util.Partial``
+    pullback returned from its jitted executable. Either way ``fun`` is
+    the un-jitted primal and ``keys`` the PRNG keys the forward drew, so
+    ``create_graph`` replay (_backward_recorded) re-derives the vjp
+    byte-identically regardless of which path recorded the node."""
     _STATE.tape.append(
         _TapeNode(vjp_fn, list(array_inputs), list(outputs), fun, keys))
 

@@ -1,0 +1,126 @@
+"""Eager-dispatch microbenchmark: compiled cache vs uncached op-by-op.
+
+Measures per-call host dispatch latency of a repeated fixed-shape eager op
+chain (the imperative hot path: registry.invoke → compiled cache | apply_pure)
+in two modes per chain:
+
+- ``uncached``: MXNET_EAGER_JIT=0 — today's op-by-op path (fresh jax.vjp
+  trace per call when recording);
+- ``cached``:   MXNET_EAGER_JIT=1 — the compiled-dispatch cache
+  (registry.py), warmed so calls are hits.
+
+Two chains are timed: ``nograd`` (plain eager math) and ``recorded`` (the
+same chain under autograd.record(), where the uncached path pays a full
+vjp retrace per op per call).
+
+Emits one JSON document (default ``BENCH_DISPATCH_r06.json``) with per-mode
+latency, speedups, and the cache hit/miss counters; also prints it.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.dispatch_bench [--smoke] [--iters N]
+        [--out FILE]
+
+``--smoke`` shrinks shapes/iterations for a CPU tier-1 time budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _chain_ops(nd, x, w, b):
+    h = nd.dot(x, w)
+    h = nd.broadcast_add(h, b)
+    h = nd.softmax(h)
+    h = nd.tanh(h)
+    return nd.sum(h)
+
+
+_OPS_PER_CALL = 5  # dot, broadcast_add, softmax, tanh, sum
+
+
+def _time_chain(nd, autograd, x, w, b, iters, warmup, record):
+    def run_once():
+        if record:
+            with autograd.record():
+                y = _chain_ops(nd, x, w, b)
+        else:
+            y = _chain_ops(nd, x, w, b)
+        return y
+
+    for _ in range(warmup):
+        run_once().wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = run_once()
+    y.wait_to_read()
+    total = time.perf_counter() - t0
+    return total / (iters * _OPS_PER_CALL) * 1e6  # us per op dispatch
+
+
+def run(smoke=False, iters=None, shape=None, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import registry
+
+    nd = mx.nd
+    n, k = shape or ((16, 32) if smoke else (64, 256))
+    iters = iters or (80 if smoke else 400)
+    warmup = max(10, iters // 10)
+
+    x = nd.ones((n, k))
+    w = nd.ones((k, k))
+    b = nd.ones((k,))
+
+    prev = os.environ.get("MXNET_EAGER_JIT")
+    results = {}
+    try:
+        for label, record in (("nograd", False), ("recorded", True)):
+            os.environ["MXNET_EAGER_JIT"] = "0"
+            un = _time_chain(nd, autograd, x, w, b, iters, warmup, record)
+            registry.reset_dispatch_cache()
+            os.environ["MXNET_EAGER_JIT"] = "1"
+            ca = _time_chain(nd, autograd, x, w, b, iters, warmup, record)
+            results[label] = {"uncached_us_per_op": round(un, 2),
+                              "cached_us_per_op": round(ca, 2),
+                              "speedup": round(un / ca, 2)}
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_EAGER_JIT", None)
+        else:
+            os.environ["MXNET_EAGER_JIT"] = prev
+
+    doc = {
+        "benchmark": "eager_dispatch_cache",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "shape": [n, k],
+        "iters": iters,
+        "ops_per_call": _OPS_PER_CALL,
+        "results": results,
+        "counters": registry.dispatch_cache_stats(),
+    }
+    out_path = out_path or "BENCH_DISPATCH_r06.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small shapes/iters; CPU tier-1 time budget")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, iters=a.iters, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

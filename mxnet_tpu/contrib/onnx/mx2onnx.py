@@ -742,7 +742,7 @@ def _upsampling(b, name, ins, attrs):
                [ins[0], b.const(name + "_roi", onp.asarray([], "float32")),
                 b.const(name + "_scales",
                         onp.asarray([1.0, 1.0, scale, scale], "float32"))],
-               [name], name=name, mode=b"nearest" and "nearest")
+               [name], name=name, mode="nearest")
 
 
 @register_translator("stack")

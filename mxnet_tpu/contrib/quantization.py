@@ -389,6 +389,12 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
         # uint8-intolerant consumer (conv/fc: XLA needs matching
         # operand dtypes, weights are int8) can force int8
         if "qout" in r:
+            # early return IGNORES dtype_req: a quantized producer's qout
+            # may be uint8 (auto mode pool/act chains) while the consumer
+            # asked for int8 (conv/fc). That mismatch is resolved IN-OP:
+            # the quantized conv/fc bodies hop uint8 inputs onto the int8
+            # lattice via _to_s8_lattice (ndarray/ops_quant.py) before the
+            # MXU matmul, so no extra graph-level requantize is needed
             return r["qout"]
         idx = node._output_index if node._num_outputs > 1 else 0
         rng = calib_ranges.get(_out_name(node))

@@ -57,6 +57,22 @@ KNOBS = {
         "wired", "engine.Engine", "worker-pool lanes (compute/IO split)"),
     "MXNET_USE_SIGNAL_HANDLER": (
         "wired", "initialize", "crash tracebacks via faulthandler"),
+    "MXNET_EAGER_JIT": (
+        "wired", "ndarray.registry",
+        "compiled eager-dispatch cache; 0 = uncached op-by-op dispatch"),
+    "MXNET_EAGER_JIT_CACHE_SIZE": (
+        "wired", "ndarray.registry",
+        "LRU bound on cached eager-dispatch executables (default 512)"),
+    "MXNET_EAGER_JIT_DONATE": (
+        "wired", "ndarray.registry",
+        "OPT-IN (default 0): donate the out= buffer to the cached "
+        "executable when out aliases an input (in-place update "
+        "pattern). Donation deletes the old buffer on TPU — only "
+        "enable when no detach()/copyto snapshot still references it"),
+    "MXNET_KVSTORE_GAP_TOLERANCE": (
+        "wired", "kvstore_ps",
+        "dist_async: seconds rank 0 waits on a missing gradient seq "
+        "before abandoning it (default 30)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

@@ -106,6 +106,13 @@ class AsyncParamServer:
         self._stop = threading.Event()
         self._next_seq = {}   # rank 0: key -> next seq to apply
         self._gap_seen = {}   # rank 0: key -> first time the gap was seen
+        from . import env as _env
+
+        # how long rank 0 tolerates a missing gradient seq before
+        # abandoning it (a crashed pusher must not stall the key forever;
+        # a slow-but-alive worker needs the window to be tunable)
+        self._gap_tolerance = _env.get_float(
+            "MXNET_KVSTORE_GAP_TOLERANCE", 30.0)
         self._published = {}  # rank 0: key -> watermark last published
         self._retire = {}     # rank 0: key -> version to delete next
         self._thread = None
@@ -258,11 +265,19 @@ class AsyncParamServer:
                         continue
                     if s > nxt:
                         # gap: blob for `nxt` still in flight. Tolerate
-                        # briefly; a crashed pusher must not stall the
-                        # key forever (reference: dead-worker timeouts)
+                        # briefly (MXNET_KVSTORE_GAP_TOLERANCE seconds);
+                        # a crashed pusher must not stall the key forever
+                        # (reference: dead-worker timeouts)
                         first = self._gap_seen.setdefault(
                             key, time.monotonic())
-                        if time.monotonic() - first > 30.0:
+                        if time.monotonic() - first > self._gap_tolerance:
+                            _log().warning(
+                                "dist_async server abandoning gradient "
+                                "seq(s) %d..%d for key '%s' after %.0fs "
+                                "gap tolerance; a slow worker's push is "
+                                "lost (raise MXNET_KVSTORE_GAP_TOLERANCE "
+                                "if workers stall transiently)",
+                                nxt, s - 1, key, self._gap_tolerance)
                             self._gap_seen.pop(key, None)
                             nxt = s  # give up on the lost seq
                         else:

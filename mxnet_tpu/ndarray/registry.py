@@ -11,6 +11,10 @@ eager NDArray ops, hybridized (jit) CachedOp replay, and symbolic tracing.
 from __future__ import annotations
 
 import functools
+import os
+import threading
+import warnings
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -153,6 +157,364 @@ def _amp_cast_fn(opdef, args=None, kwargs=None):
     return cast
 
 
+# ---------------------------------------------------------------------------
+# Compiled eager-dispatch cache.
+#
+# Every eager op used to execute its pure-JAX body un-jitted, op-by-op, and —
+# when autograd was recording — pay a full ``jax.vjp`` retrace per call. The
+# reference framework's imperative dispatch is a thin cached fast path
+# (Imperative::Invoke over a cached FCompute lookup, src/imperative/
+# imperative.cc:89; CachedOp replay for whole subgraphs), and JAX's
+# trace-once/replay-many split makes the same shape cheap here: a bounded
+# LRU maps (op, arg template, config kwargs, input avals, AMP version,
+# recording/training mode) → a ``jax.jit``-compiled executable. When
+# recording, the executable returns the ``jax.vjp`` pair — the pullback is
+# a ``jax.tree_util.Partial`` pytree, so it crosses the jit boundary with
+# its residuals as compiled outputs and the per-call cost drops from full
+# retrace to cache lookup + compiled dispatch.
+#
+# PRNG discipline: stochastic op bodies draw keys from the ambient provider
+# (mxnet_tpu.random). A jitted body must not split the global key at trace
+# time (the key would be baked in as a constant), so the first call per key
+# runs today's uncached path under a key_logger to COUNT draws; cached calls
+# pre-split exactly that many keys eagerly (advancing the global stream
+# exactly as the uncached path would) and pass them as executable arguments
+# replayed strictly in order. The tape stores the same keys, so
+# ``create_graph`` replay is byte-identical to the uncached path.
+
+_UNJITTABLE = set()  # op names whose bodies failed to trace under jit
+
+
+class _Uncacheable(Exception):
+    """A config literal cannot be frozen into a cache key."""
+
+
+def _freeze(v):
+    """Hashable, type-tagged form of a config literal for the cache key.
+
+    Type-tagged so ``True``/``1``/``1.0`` (equal, same hash) key distinct
+    executables. Raises _Uncacheable for values with no cheap stable hash
+    (numpy arrays etc.) — those dispatches bypass the cache."""
+    if v is None or isinstance(v, (str, bytes)):
+        return v
+    if isinstance(v, (bool, int, float, complex)):
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return ("dict",) + tuple(
+            sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, slice):
+        return ("slice", _freeze(v.start), _freeze(v.stop), _freeze(v.step))
+    try:
+        hash(v)
+    except TypeError:
+        raise _Uncacheable(type(v).__name__) from None
+    return v
+
+
+class _CacheEntry:
+    __slots__ = ("jfn", "normalized", "n_keys", "recording", "donate")
+
+    def __init__(self, jfn, normalized, n_keys, recording, donate):
+        self.jfn = jfn
+        self.normalized = normalized
+        self.n_keys = n_keys
+        self.recording = recording
+        self.donate = donate  # input slot whose buffer is donated, or None
+
+
+class _DispatchCache:
+    """Bounded LRU of jit-compiled eager-op executables + counters."""
+
+    def __init__(self, maxsize=None):
+        from .. import env as _env
+
+        self.maxsize = maxsize if maxsize is not None else \
+            _env.get_int("MXNET_EAGER_JIT_CACHE_SIZE", 512)
+        self._d = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.bypasses = 0   # uncacheable dispatches (tracers, providers...)
+        self.fallbacks = 0  # cached executable failed; op blacklisted
+
+    def lookup(self, key):
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is None:
+                self.misses += 1
+            else:
+                self._d.move_to_end(key)
+                self.hits += 1
+            return entry
+
+    def note_bypass(self):
+        with self._lock:
+            self.bypasses += 1
+
+    def note_fallback(self):
+        with self._lock:
+            self.fallbacks += 1
+
+    def insert(self, key, entry):
+        with self._lock:
+            self._d[key] = entry
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def remove(self, key):
+        with self._lock:
+            self._d.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+            self.hits = self.misses = self.evictions = 0
+            self.bypasses = self.fallbacks = 0
+
+    def stats(self):
+        with self._lock:
+            return {"size": len(self._d), "maxsize": self.maxsize,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "bypasses": self.bypasses,
+                    "fallbacks": self.fallbacks}
+
+
+_CACHE = _DispatchCache()
+
+
+class _DispatchFlag(threading.local):
+    cached = False  # did the last dispatch run from the compiled cache?
+
+
+_DISPATCH_FLAG = _DispatchFlag()
+
+
+def eager_jit_enabled():
+    """MXNET_EAGER_JIT knob (default on); 0 falls back to uncached
+    op-by-op dispatch. Read per-dispatch so tests/benchmarks can toggle
+    without reimport (one dict lookup against ~50us of dispatch work)."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_EAGER_JIT", True)
+
+
+def _donate_enabled():
+    # OPT-IN: donation deletes the out= buffer on backends that honor it
+    # (TPU), which breaks any other NDArray still aliasing that jax.Array
+    # (detach() snapshots, same-dtype copyto, tape node.primals). Only
+    # enable for in-place loops known to hold no such aliases.
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_EAGER_JIT_DONATE", False)
+
+
+def dispatch_cache_stats():
+    """Hit/miss/evict/bypass/fallback counters + current size."""
+    return _CACHE.stats()
+
+
+def reset_dispatch_cache(maxsize=None):
+    """Drop all cached executables and counters (tests, benchmarks).
+    ``maxsize`` optionally rebinds the LRU bound."""
+    _CACHE.clear()
+    if maxsize is not None:
+        _CACHE.maxsize = int(maxsize)
+    _UNJITTABLE.clear()
+
+
+def _normalize_output(pure_fn):
+    def normalized(*xs):
+        # jnp routines return result NAMEDTUPLES (QRResult, SVDResult,
+        # SlogdetResult...); backward rebuilds cotangents as plain
+        # tuples, and jax.vjp rejects the pytree-type mismatch — flatten
+        # the type here once for every op
+        r = pure_fn(*xs)
+        if isinstance(r, tuple) and type(r) is not tuple:
+            return tuple(r)
+        return r
+
+    return normalized
+
+
+def _build_jfn(normalized, recording, donate_slot):
+    from .. import random as _mxrandom
+
+    if recording:
+        def traced(_keys, *xs):
+            with _mxrandom.key_replayer(_keys, strict=True):
+                return jax.vjp(normalized, *xs)
+    else:
+        def traced(_keys, *xs):
+            with _mxrandom.key_replayer(_keys, strict=True):
+                return normalized(*xs)
+    donate = (1 + donate_slot,) if donate_slot is not None else ()
+    return jax.jit(traced, donate_argnums=donate)
+
+
+def _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas, wrap_cls,
+                  recording, donate_slot):
+    from .. import autograd
+
+    tmpl = tuple(("a", t[1]) if t[0] == "arr" else ("l", _freeze(t[1]))
+                 for t in arg_template)
+    kws = tuple(sorted((k, _freeze(v)) for k, v in kwargs.items()))
+    kwa = tuple(sorted(kw_arrays.items()))
+    # weak_type matters: a python-scalar-promoted operand traces to a
+    # different jaxpr than a committed-dtype one
+    avals = tuple((d.shape, d.dtype, bool(getattr(d.aval, "weak_type",
+                                                  False)))
+                  for d in datas)
+    # is_training()/is_recording() are read INSIDE some op bodies
+    # (dropout, batchnorm, rnn) — part of the traced behavior
+    return (opdef.name, tmpl, kws, kwa, avals, _AMP["version"], recording,
+            autograd.is_training(), autograd.is_recording(), wrap_cls,
+            donate_slot)
+
+
+def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
+                     kwargs, arg_template, kw_arrays):
+    """Serve this dispatch from the compiled cache. Returns (handled,
+    result); (False, None) means the caller should run the uncached path."""
+    from .. import autograd
+    from .. import random as _mxrandom
+    from .ndarray import NDArray, _wrap as _default_wrap
+
+    if opdef.name in _UNJITTABLE:
+        _CACHE.note_bypass()
+        return False, None
+    if _OPS.get(opdef.name) is not opdef:
+        # ad-hoc OpDef (numpy frontend _call wraps a fresh closure per
+        # dispatch): the op name does not identify the computation, so a
+        # cache key built from it would collide across distinct bodies
+        _CACHE.note_bypass()
+        return False, None
+    if _mxrandom._STATE.providers:
+        # an ambient key provider (CachedOp trace) owns key derivation;
+        # cached executables manage their own keys — stay out of the way
+        _CACHE.note_bypass()
+        return False, None
+    datas = []
+    for a in arr_args:
+        d = a._data
+        if isinstance(d, jax.core.Tracer) or not isinstance(d, jax.Array):
+            # symbolic tracing (hybridize) reuses this dispatch path with
+            # tracer payloads; nesting jit adds nothing but cache churn
+            _CACHE.note_bypass()
+            return False, None
+        datas.append(d)
+
+    recording = (autograd.is_recording() and opdef.differentiable
+                 and bool(arr_args))
+    donate_slot = None
+    if out is not None and not recording and _donate_enabled():
+        for i, a in enumerate(arr_args):
+            if a is out:
+                donate_slot = i
+                break
+    try:
+        key = _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas,
+                            wrap_cls, recording, donate_slot)
+        hash(key)
+    except (_Uncacheable, TypeError):
+        _CACHE.note_bypass()
+        return False, None
+
+    entry = _CACHE.lookup(key)
+    if entry is None:
+        # MISS: run today's uncached path once — byte-identical semantics,
+        # and it tells us how many PRNG keys the body draws — then install
+        # the executable (compiled lazily, on the first hit).
+        if recording:
+            result = apply_pure(pure_fn, arr_args, differentiable=True,
+                                out=out, wrap=wrap)
+            node = autograd._STATE.tape[-1] if autograd._STATE.tape else None
+            n_keys = len(node.keys) if node is not None and node.keys else 0
+        else:
+            with _mxrandom.key_logger() as klog:
+                result = apply_pure(pure_fn, arr_args,
+                                    differentiable=opdef.differentiable,
+                                    out=out, wrap=wrap)
+            n_keys = len(klog.keys)
+        donate = None
+        if donate_slot is not None and out is not None:
+            # donate only when XLA can actually alias: the result landed in
+            # `out` with the same shape/dtype the donated operand had
+            src = datas[donate_slot]
+            if out._data.shape == src.shape and out._data.dtype == src.dtype:
+                donate = donate_slot
+        normalized = _normalize_output(pure_fn)
+        _CACHE.insert(key, _CacheEntry(
+            _build_jfn(normalized, recording, donate), normalized, n_keys,
+            recording, donate))
+        return True, result
+
+    # HIT: pre-split the op's keys eagerly (same global-stream evolution
+    # as the uncached path) and run the compiled executable.
+    keys = [_mxrandom.next_key() for _ in range(entry.n_keys)]
+    try:
+        if entry.donate is not None:
+            with warnings.catch_warnings():
+                # XLA backends without donation support (CPU) warn at
+                # lowering time; the hint is best-effort by design
+                warnings.simplefilter("ignore")
+                raw = entry.jfn(tuple(keys), *datas)
+        else:
+            raw = entry.jfn(tuple(keys), *datas)
+    except Exception:
+        # jit-incompatible body (value-dependent control flow, host
+        # callback). Replay the already-drawn keys through the uncached
+        # path so the PRNG stream stays consistent; if THAT also fails
+        # the error is the op's, and it propagates as it always did.
+        _CACHE.remove(key)
+        rep = _mxrandom.key_replayer(keys)
+        with rep:
+            result = apply_pure(pure_fn, arr_args,
+                                differentiable=opdef.differentiable,
+                                out=out, wrap=wrap)
+        if recording and keys and autograd._STATE.tape:
+            # apply_pure's key_logger stood down behind our replayer;
+            # pin the consumed keys on the node for create_graph replay
+            autograd._STATE.tape[-1].keys = keys[:rep._i] or None
+        _UNJITTABLE.add(opdef.name)
+        _CACHE.note_fallback()
+        return True, result
+
+    _DISPATCH_FLAG.cached = True
+    _w = wrap or _default_wrap
+    tape_keys = keys or None
+    if entry.recording:
+        result, vjp_partial = raw
+        multi = isinstance(result, tuple)
+        if out is not None:
+            if multi:
+                raise MXNetError("out= not supported for multi-output ops")
+            out._data = jnp.asarray(result, out._data.dtype)
+            autograd._record_op(vjp_partial, list(arr_args), [out],
+                                fun=entry.normalized, keys=tape_keys)
+            return True, out
+        outs = [_w(r) for r in (result if multi else (result,))]
+        autograd._record_op(vjp_partial, list(arr_args), outs,
+                            fun=entry.normalized, keys=tape_keys)
+        return True, outs if multi else outs[0]
+
+    result = raw
+    if isinstance(result, tuple):
+        result = [_w(r) for r in result]
+    else:
+        result = _w(result)
+    if out is not None:
+        if isinstance(result, list):
+            raise MXNetError("out= not supported for multi-output ops")
+        out._data = jnp.asarray(result.data, out._data.dtype)
+        return True, out
+    return True, result
+
+
 def invoke(opdef, args, kwargs):
     """Dispatch an op: unwrap NDArrays, run (recording a vjp if needed), wrap.
 
@@ -160,7 +522,8 @@ def invoke(opdef, args, kwargs):
     (reference: src/imperative/imperative.cc:89,
     src/imperative/imperative_utils.h:395): JAX's async dispatch plays the
     role of the dependency engine — results are futures, sync happens at
-    `wait_to_read`/`asnumpy`.
+    `wait_to_read`/`asnumpy`. Dispatch runs through the compiled-executable
+    cache above unless MXNET_EAGER_JIT=0.
     """
     from .ndarray import NDArray
 
@@ -183,12 +546,14 @@ def invoke(opdef, args, kwargs):
 
     if _prof.imperative_on():
         t0 = _time.perf_counter()
+        _DISPATCH_FLAG.cached = False
         try:
             return _invoke_inner(opdef, args, kwargs, out, arr_args,
                                  arg_template, kw_arrays)
         finally:
             _prof.record_op(opdef.name, t0 * 1e6,
-                            (_time.perf_counter() - t0) * 1e6)
+                            (_time.perf_counter() - t0) * 1e6,
+                            cached=_DISPATCH_FLAG.cached)
     return _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
                          kw_arrays)
 
@@ -224,6 +589,12 @@ def _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
                 break
     wrap = (lambda r: wrap_cls(r)) if wrap_cls is not NDArray else None
 
+    if eager_jit_enabled():
+        handled, result = _dispatch_cached(opdef, pure_fn, arr_args, out,
+                                           wrap, wrap_cls, kwargs,
+                                           arg_template, kw_arrays)
+        if handled:
+            return result
     return apply_pure(pure_fn, arr_args,
                       differentiable=opdef.differentiable, out=out, wrap=wrap)
 
@@ -244,16 +615,7 @@ def apply_pure(pure_fn, arr_args, differentiable=True, out=None, wrap=None):
 
     _wrap = wrap or _default_wrap
     datas = [a.data if isinstance(a, NDArray) else a for a in arr_args]
-
-    def normalized(*xs):
-        # jnp routines return result NAMEDTUPLES (QRResult, SVDResult,
-        # SlogdetResult...); backward rebuilds cotangents as plain
-        # tuples, and jax.vjp rejects the pytree-type mismatch — flatten
-        # the type here once for every op
-        r = pure_fn(*xs)
-        if isinstance(r, tuple) and type(r) is not tuple:
-            return tuple(r)
-        return r
+    normalized = _normalize_output(pure_fn)
 
     if autograd.is_recording() and differentiable and arr_args:
         from .. import random as _mxrandom

@@ -96,14 +96,29 @@ def imperative_on():
                                   or _config["profile_all"])
 
 
-def record_op(name, start_us, dur_us):
+def record_op(name, start_us, dur_us, cached=None):
     """Per-op dispatch timing (NB: JAX dispatch is async — this measures
     host-side dispatch+trace time, not device compute; device timing
-    lives in the XPlane trace)."""
-    _record("operator", name, start_us, dur_us, cat="imperative")
+    lives in the XPlane trace). ``cached`` marks dispatches served from
+    the compiled eager-dispatch cache (registry.py) so a trace shows
+    which ops ran as cached executables vs op-by-op."""
+    _record("operator", name, start_us, dur_us, cat="imperative",
+            cached=cached)
 
 
-def _record(domain, name, start_us, dur_us, cat="event", value=None):
+def dispatch_cache_counters():
+    """Eager-dispatch executable-cache counters (hit/miss/evict/bypass/
+    fallback + size), live from the registry. Zeros before first use."""
+    try:
+        from .ndarray.registry import dispatch_cache_stats
+
+        return dispatch_cache_stats()
+    except Exception:
+        return {}
+
+
+def _record(domain, name, start_us, dur_us, cat="event", value=None,
+            cached=None):
     with _lock:
         if cat == "counter":
             # chrome-trace counter sample: ph 'C' with the value payload
@@ -111,10 +126,13 @@ def _record(domain, name, start_us, dur_us, cat="event", value=None):
                             "ts": start_us, "pid": 0,
                             "args": {name: value}})
         else:
+            args = {"domain": domain}
+            if cached is not None:
+                args["cached"] = bool(cached)
             _events.append({"name": name, "cat": cat, "ph": "X",
                             "ts": start_us, "dur": dur_us, "pid": 0,
                             "tid": threading.get_ident() % 100000,
-                            "args": {"domain": domain}})
+                            "args": args})
         a = _agg[(domain, name)]
         a["count"] += 1
         if cat == "counter":
@@ -128,17 +146,27 @@ def _record(domain, name, start_us, dur_us, cat="event", value=None):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write accumulated host events as chrome://tracing JSON."""
+    """Write accumulated host events as chrome://tracing JSON. The
+    eager-dispatch cache counters ride along as chrome counter samples
+    ('eager_jit_cache/<name>') stamped at dump time."""
     fname = _config.get("filename") or "profile.json"
     with _lock:
         payload = {"traceEvents": list(_events)}
+    ts = time.perf_counter() * 1e6
+    for cname, cval in sorted(dispatch_cache_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"eager_jit_cache/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
     with open(fname, "w") as f:
         json.dump(payload, f)
     return fname
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate stats table (reference: profiler.py:151 dumps)."""
+    """Aggregate stats table (reference: profiler.py:151 dumps). The
+    eager-dispatch cache counters are NOT aggregate rows (they would
+    survive `reset` and break the empty-table contract) — read them via
+    ``dispatch_cache_counters()`` or the counter samples in ``dump()``."""
     with _lock:
         rows = [(d, n, v["count"], v["total"], v["min"], v["max"],
                  v["total"] / max(v["count"], 1))

@@ -93,17 +93,27 @@ class key_logger:
 class key_replayer:
     """Feed back keys captured by a key_logger, in order. Extra draws
     beyond the log fall through to the global stream (defensive — a
-    primal fn draws a fixed number of keys per trace)."""
+    primal fn draws a fixed number of keys per trace). With
+    ``strict=True`` an extra draw raises instead: the compiled-dispatch
+    cache pre-splits exactly the counted number of keys and passes them
+    as executable arguments, so a fall-through split under jit would
+    bake a concrete key into the compiled executable as a constant —
+    silently reusing one mask forever. Raising turns that into a trace
+    failure the dispatch layer catches and falls back from."""
 
-    def __init__(self, keys):
+    def __init__(self, keys, strict=False):
         self._keys = list(keys)
         self._i = 0
+        self._strict = strict
 
     def _next(self):
         if self._i < len(self._keys):
             k = self._keys[self._i]
             self._i += 1
             return k
+        if self._strict:
+            raise RuntimeError(
+                "op drew more PRNG keys than were pre-split for replay")
         _STATE.key, sub = jax.random.split(_STATE.key)
         return sub
 

@@ -27,6 +27,13 @@ def _detect():
     feats["BLAS_OPEN"] = True
     feats["XLA"] = True
     feats["PALLAS"] = True
+    try:
+        from .ndarray.registry import eager_jit_enabled
+
+        # compiled eager-dispatch cache (MXNET_EAGER_JIT, registry.py)
+        feats["EAGER_JIT"] = eager_jit_enabled()
+    except Exception:
+        feats["EAGER_JIT"] = False
     feats["DIST_KVSTORE"] = True  # jax.distributed collectives
     feats["INT64_TENSOR_SIZE"] = True
     feats["SIGNAL_HANDLER"] = True

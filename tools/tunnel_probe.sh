@@ -12,7 +12,7 @@ while true; do
     echo "$ts HEALTHY $out" >> "$LOG"
     # pounce: run the round's on-chip agenda while the window is open
     # (idempotent + locked; see tools/tpu_agenda.sh)
-    /root/repo/tools/tpu_agenda.sh
+    "$(dirname "$0")/tpu_agenda.sh"
   else
     echo "$ts down rc=$rc $out" >> "$LOG"
   fi
