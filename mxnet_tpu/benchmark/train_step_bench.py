@@ -1,0 +1,163 @@
+"""Fused train-step benchmark: one compiled executable vs the eager loop.
+
+Measures per-step latency of ``Trainer.step`` over a >=50-parameter model
+in two modes:
+
+- ``eager``: MXNET_FUSED_STEP=0 — the host-driven per-param loop (one
+  optimizer-op dispatch per parameter);
+- ``fused``: MXNET_FUSED_STEP=1 — the compiled fused train-step
+  (gluon/fused_step.py), warmed so steps are cache hits.
+
+Also verifies the acceptance contract: after N steps driven by an
+identical seeded gradient sequence — including an AMP skip-step episode
+(one step of all-inf gradients under a LossScaler) — the parameters are
+BITWISE equal under both paths and the loss scales match.
+
+Emits one JSON document (default ``BENCH_STEP_r07.json``) with per-mode
+latency, speedup, equality results and the fused-step cache counters;
+also prints it.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.train_step_bench [--smoke] [--steps N]
+        [--out FILE]
+
+``--smoke`` shrinks the model/iterations for a CPU tier-1 time budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as onp
+
+
+def _make_params(n_params, dim, seed=0):
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    rs = onp.random.RandomState(seed)
+    params = []
+    for i in range(n_params):
+        shape = (dim, dim) if i % 2 == 0 else (dim,)
+        p = Parameter(f"p{i}", shape=shape)
+        p.initialize()
+        p.set_data(nd.array(rs.randn(*shape).astype("f")))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, step, seed=1000, poison=False):
+    from mxnet_tpu import nd
+
+    rs = onp.random.RandomState(seed + step)
+    for p in params:
+        g = rs.randn(*p.shape).astype("f") * 0.1
+        if poison:
+            g = onp.full(p.shape, onp.inf, "f")
+        p.grad()._data = nd.array(g).data
+
+
+def _time_steps(fused, n_params, dim, steps, warmup):
+    from mxnet_tpu import gluon
+
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    params = _make_params(n_params, dim)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    _set_grads(params, 0)
+    for _ in range(warmup):
+        trainer.step(1)
+    params[0].data().wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.step(1)
+    params[0].data().wait_to_read()
+    return (time.perf_counter() - t0) / steps * 1e3  # ms per step
+
+
+def _equality_run(fused, n_params, dim, steps, inf_at):
+    """N seeded steps with an AMP skip-step episode at ``inf_at``;
+    returns (param bytes, final loss scale, skip detected)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    params = _make_params(n_params, dim)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    trainer._amp_loss_scaler = LossScaler(init_scale=2.0 ** 10,
+                                          scale_window=max(2, steps // 2))
+    for s in range(steps):
+        _set_grads(params, s, poison=(s == inf_at))
+        trainer.step(1)
+    return ([p.data().asnumpy().tobytes() for p in params],
+            trainer._amp_loss_scaler.loss_scale)
+
+
+def run(smoke=False, steps=None, n_params=None, dim=None, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    from mxnet_tpu.gluon import fused_step
+
+    n_params = n_params or (12 if smoke else 60)
+    dim = dim or (8 if smoke else 64)
+    steps = steps or (10 if smoke else 50)
+    warmup = max(3, steps // 10)
+
+    prev = os.environ.get("MXNET_FUSED_STEP")
+    try:
+        eager_ms = _time_steps(False, n_params, dim, steps, warmup)
+        fused_step.reset_fused_step_cache()
+        fused_ms = _time_steps(True, n_params, dim, steps, warmup)
+        eq_steps = max(6, steps // 4)
+        wb_e, ls_e = _equality_run(False, n_params, dim, eq_steps,
+                                   inf_at=eq_steps // 2)
+        wb_f, ls_f = _equality_run(True, n_params, dim, eq_steps,
+                                   inf_at=eq_steps // 2)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prev
+
+    counters = fused_step.fused_step_stats()
+    doc = {
+        "benchmark": "fused_train_step",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "n_params": n_params,
+        "dim": dim,
+        "steps": steps,
+        "results": {"eager_ms_per_step": round(eager_ms, 3),
+                    "fused_ms_per_step": round(fused_ms, 3),
+                    "speedup": round(eager_ms / fused_ms, 2)},
+        "bitwise_equal": wb_e == wb_f,
+        "skip_step_exercised": counters.get("skipped_steps", 0) > 0,
+        "loss_scale_equal": ls_e == ls_f,
+        "counters": counters,
+    }
+    out_path = out_path or "BENCH_STEP_r07.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small model/iters; CPU tier-1 time budget")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--n-params", type=int, default=None)
+    p.add_argument("--dim", type=int, default=None)
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, steps=a.steps, n_params=a.n_params,
+              dim=a.dim, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

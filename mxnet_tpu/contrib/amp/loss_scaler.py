@@ -2,17 +2,38 @@
 
 Needed for fp16 parity; bf16 on TPU has fp32's exponent range so the
 default bf16 policy trains without scaling (the scaler still works if
-enabled)."""
+enabled).
+
+Under the Trainer's compiled fused step (gluon/fused_step.py) the scale,
+grow-window counter and skip count live ON DEVICE inside the donated
+step executable — the overflow check and grow/backoff never round-trip
+to the host. The host fields here then lag the device; reading
+``loss_scale`` syncs them back (one scalar device read), so
+``amp.scale_loss`` always multiplies by the same scale the executable
+will divide by."""
 from __future__ import annotations
 
 
 class LossScaler:
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
                  scale_window=2000):
-        self.loss_scale = float(init_scale)
+        self._loss_scale = float(init_scale)
         self._scale_factor = float(scale_factor)
         self._scale_window = int(scale_window)
         self._unskipped = 0
+        self._device_sync = None  # set by Trainer when state moves on device
+
+    @property
+    def loss_scale(self):
+        if self._device_sync is not None:
+            self._device_sync()
+        return self._loss_scale
+
+    @loss_scale.setter
+    def loss_scale(self, value):
+        # an external write re-seeds the device state on the next fused
+        # step (the Trainer compares against its seed-time mirror)
+        self._loss_scale = float(value)
 
     def has_overflow(self, params):
         """True if any gradient is non-finite (reference: multi_all_finite
@@ -28,10 +49,11 @@ class LossScaler:
     def update_scale(self, overflow):
         """Halve on overflow; double every scale_window clean steps."""
         if overflow:
-            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._loss_scale = max(1.0,
+                                   self._loss_scale / self._scale_factor)
             self._unskipped = 0
         else:
             self._unskipped += 1
             if self._unskipped >= self._scale_window:
-                self.loss_scale *= self._scale_factor
+                self._loss_scale *= self._scale_factor
                 self._unskipped = 0

@@ -14,7 +14,7 @@ import logging
 import os
 
 __all__ = ["KNOBS", "describe", "check", "get_int", "get_float",
-           "get_bool"]
+           "get_bool", "markdown_table"]
 
 # name -> (status, consumer, description)
 KNOBS = {
@@ -73,6 +73,20 @@ KNOBS = {
         "wired", "kvstore_ps",
         "dist_async: seconds rank 0 waits on a missing gradient seq "
         "before abandoning it (default 30)"),
+    "MXNET_FUSED_STEP": (
+        "wired", "gluon.Trainer",
+        "compiled fused train-step: allreduce + AMP overflow check + "
+        "optimizer update as one donated XLA executable; 0 = eager "
+        "per-param fallback"),
+    "MXNET_FUSED_STEP_CACHE_SIZE": (
+        "wired", "gluon.fused_step",
+        "LRU bound on cached fused train-step executables (default 16)"),
+    "MXNET_FUSED_STEP_DONATE": (
+        "wired", "gluon.fused_step",
+        "OPT-IN (default 0): donate PARAMETER buffers to the fused step "
+        "executable. Donation deletes the old buffer — only enable when "
+        "no tape node / detach() snapshot still references it. "
+        "Optimizer state and loss-scale state are always donated"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),
@@ -187,3 +201,35 @@ def check():
         logging.warning("environment variable %s is not recognized by "
                         "mxnet_tpu (see mxnet_tpu.env.describe())", k)
     return unknown
+
+
+def markdown_table():
+    """docs/ENV_VARS.md content, generated from the KNOBS registry so
+    the doc can never drift from the code (a tier-1 test asserts the
+    committed file matches). Regenerate with::
+
+        python -m mxnet_tpu.env > docs/ENV_VARS.md
+    """
+    lines = [
+        "# `MXNET_*` environment variables",
+        "",
+        "Generated from the knob registry in `mxnet_tpu/env.py` — do "
+        "not edit by hand; regenerate with "
+        "`python -m mxnet_tpu.env > docs/ENV_VARS.md`.",
+        "",
+        "Status **wired** = changes behavior here; **accepted** = read "
+        "and validated but intentionally a no-op because XLA/PJRT owns "
+        "that concern on TPU (see the module docstring).",
+        "",
+        "| Variable | Status | Consumer | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for name, (status, consumer, desc) in sorted(KNOBS.items()):
+        lines.append(f"| `{name}` | {status} | {consumer} | {desc} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.stdout.write(markdown_table())

@@ -4,11 +4,17 @@ TPU-native equivalent of python/mxnet/gluon/trainer.py (reference:
 Trainer:27, kvstore wiring :169-217, step/allreduce_grads/update). The
 reference pushes grads through kvstore (CPU/GPU reduce or ps-lite); here
 single-host aggregation is implicit (one logical grad per param) and
-multi-host runs ride `mxnet_tpu.parallel` collectives. The actual update
-is executed as ONE fused jitted function over all parameters per optimizer
-step — the analog of the reference's multi-tensor fused update ops
-(src/operator/contrib/preloaded_multi_sgd.cc) — falling back to per-param
-eager updates for optimizers without a fused path.
+multi-host runs ride `mxnet_tpu.parallel` collectives.
+
+``step`` runs through the compiled fused train-step by default
+(gluon/fused_step.py): ONE jit-compiled, buffer-donated XLA executable
+per parameter-group signature covering the bucketed gradient allreduce,
+the device-side AMP overflow check with ``lax.cond`` skip-step
+semantics, rescale, and the multi-tensor optimizer update — the analog
+of the reference's multi-tensor fused update ops
+(src/operator/contrib/preloaded_multi_sgd.cc) extended to the whole
+weight-update phase. ``MXNET_FUSED_STEP=0``, optimizers without a fused
+kernel, and sparse gradients fall back to the eager per-param loop.
 """
 from __future__ import annotations
 
@@ -18,6 +24,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from . import fused_step as _fs
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -53,7 +60,10 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._distributed = self._kvstore_type.startswith("dist")
         self._states_created = False
-        self._fused = None
+        self._fused = None           # cached (key, executable) for this trainer
+        self._fused_state = None     # device-resident (t[, scale, unsk, skips])
+        self._fused_broken = False   # compiled step raised once; stay eager
+        self._fused_skips_host = 0   # skip total carried across re-seeds
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -84,32 +94,264 @@ class Trainer:
         return self._optimizer
 
     def set_learning_rate(self, lr):
+        """Takes effect on the very next step: the fused executable reads
+        lr as a dynamic scalar argument, so no recompilation happens."""
         self._optimizer.set_learning_rate(lr)
 
     def allreduce_grads(self):
         """Cross-worker gradient all-reduce (reference: trainer.py
         _allreduce_grads via kvstore push/pull). Single host: no-op (one
-        logical grad); dist: ICI/DCN psum via parallel.all_reduce."""
-        if self._distributed:
-            from .. import parallel
+        logical grad); dist: dense gradients are coalesced into one
+        dtype-bucketed flattened collective per dtype
+        (parallel.all_reduce_coalesced) instead of one psum per
+        parameter; sparse gradients keep the per-tensor path."""
+        if not self._distributed:
+            return
+        from .. import parallel
+        from ..ndarray import sparse as _sp
 
-            for p in self._params:
-                if p.grad_req != "null":
-                    g = p.grad()
-                    g._data = parallel.all_reduce(g).data
+        grads = [p.grad() for p in self._params if p.grad_req != "null"]
+        dense = [g for g in grads
+                 if not isinstance(g, _sp.BaseSparseNDArray)]
+        if dense:
+            for g, r in zip(dense, parallel.all_reduce_coalesced(dense)):
+                g._data = r.data
+        for g in grads:
+            if isinstance(g, _sp.BaseSparseNDArray):
+                g._data = parallel.all_reduce(g).data
+
+    # -- fused compiled step ------------------------------------------------
+
+    def _fused_skipped_steps(self):
+        """AMP skip-step total (host carry + live device counter)."""
+        st = self._fused_state
+        if st is not None and len(st["vals"]) == 4:
+            return int(st["vals"][3])
+        return self._fused_skips_host
+
+    def _invalidate_fused_state(self):
+        st = self._fused_state
+        if st is not None and len(st["vals"]) == 4:
+            try:
+                self._fused_skips_host = int(st["vals"][3])
+            except Exception:
+                # the state tuple was donated to an executable that then
+                # failed at execution — the buffers are gone; keep the
+                # last host carry rather than crash the eager fallback
+                pass
+        self._fused_state = None
+
+    def _sync_fused_state(self):
+        """Pull the device-resident step state back into the host
+        mirrors: optimizer.num_update (authoritative update count — the
+        host mirror drifts by the number of AMP-skipped steps) and the
+        loss scaler's scale/window counter. Called by save_states and by
+        ``LossScaler.loss_scale`` property reads; a no-op unless a fused
+        step ran since the last sync, so repeated reads (one
+        ``amp.scale_loss`` per iteration) cost at most one scalar
+        device read per step."""
+        st = self._fused_state
+        if st is None or not st.get("dirty", True):
+            return
+        vals = st["vals"]
+        t = int(vals[0])
+        self._optimizer.num_update = t
+        for k in self._optimizer._index_update_count:
+            self._optimizer._index_update_count[k] = t
+        st["expected_num_update"] = t
+        if len(vals) == 4:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                scaler._loss_scale = float(vals[1])
+                scaler._unskipped = int(vals[2])
+                st["scaler_mirror"] = (scaler._loss_scale,
+                                       scaler._unskipped)
+            self._fused_skips_host = int(vals[3])
+        st["dirty"] = False
+
+    def _ensure_fused_state(self, scaler):
+        """(Re)seed the donated device step-state when absent or when the
+        host-side sources changed externally (load_states, a user write
+        to scaler.loss_scale / optimizer.num_update)."""
+        optim = self._optimizer
+        st = self._fused_state
+        mode = 4 if scaler is not None else 1
+        if st is not None and len(st["vals"]) == mode:
+            if st["expected_num_update"] == optim.num_update and (
+                    scaler is None or st["scaler_mirror"] ==
+                    (scaler._loss_scale, scaler._unskipped)):
+                return st
+        self._invalidate_fused_state()
+        vals = (jnp.int32(optim.num_update),)
+        mirror = None
+        if scaler is not None:
+            vals = vals + (jnp.float32(scaler._loss_scale),
+                           jnp.int32(scaler._unskipped),
+                           jnp.int32(self._fused_skips_host))
+            mirror = (scaler._loss_scale, scaler._unskipped)
+            scaler._device_sync = self._sync_fused_state
+        st = {"vals": vals, "expected_num_update": optim.num_update,
+              "scaler_mirror": mirror, "dirty": True}
+        self._fused_state = st
+        _fs.register_trainer(self)
+        return st
+
+    def _fused_step(self, batch_size, scaler):
+        """One compiled-executable step; False = bypass to the eager
+        path (unsupported optimizer, sparse grads, tracers). The full
+        aval signature / LRU key is only rebuilt when cheap identity
+        tokens change (param buffers rebound by cast(), states replaced
+        by load_states, grad_req edits, hyperparameter statics) — the
+        steady-state per-step host work is gathering buffers and the
+        dynamic lr/wd/rescale scalars. A stale token is a perf miss, not
+        a correctness hazard: the inner jax.jit re-specializes on avals
+        anyway."""
+        from ..ndarray import sparse as _sp
+
+        optim = self._optimizer
+        kern = optim._fused_kernel()
+        if kern is None:
+            _fs._CACHE.note_bypass()
+            return False
+        if not self._states_created:
+            self._create_states()
+        kernel_key, kernel = kern
+        scaler_cfg = None if scaler is None else \
+            (float(scaler._scale_factor), int(scaler._scale_window))
+        donate_params = _fs.donate_params_enabled()
+        from ..ndarray import registry as _registry
+
+        token = (kernel_key, scaler_cfg, donate_params,
+                 _registry.amp_version(),
+                 tuple(p._grad_req for p in self._params))
+        cache = self._fused
+        if cache is not None and cache["token"] == token and \
+                cache["states"] is self._states and \
+                cache["nd_ids"] == tuple(
+                    (id(p._ndarray), id(p._ndarray._grad))
+                    for p in cache["params"]):
+            params, grads = cache["params"], cache["grads"]
+            states, entry = cache["work_states"], cache["entry"]
+            if any(isinstance(g, _sp.BaseSparseNDArray) for g in grads) \
+                    or _fs.has_tracer([g.data for g in grads]):
+                _fs._CACHE.note_bypass()
+                return False
+            _fs._CACHE.note_hit()
+        else:
+            work = [i for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if not work:
+                return True  # nothing to update; eager loop no-ops too
+            params = [self._params[i] for i in work]
+            grads = [p.grad() for p in params]
+            if any(isinstance(g, _sp.BaseSparseNDArray) for g in grads) \
+                    or _fs.has_tracer([g.data for g in grads]):
+                _fs._CACHE.note_bypass()
+                return False
+            mp_flags = tuple(
+                bool(optim.multi_precision and optim._is_half(p.data()))
+                for p in params)
+            states = [self._states[i] for i in work]
+            sig = tuple(
+                (tuple(p.shape), str(p.data().data.dtype),
+                 str(g.data.dtype), _fs.state_sig(s))
+                for p, g, s in zip(params, grads, states))
+            key = (type(optim).__name__, kernel_key, mp_flags, sig,
+                   scaler_cfg, self._distributed, donate_params,
+                   _registry.amp_version())
+            entry = _fs._CACHE.lookup(key)
+            if entry is None:
+                entry = _fs.build_executable(kernel, mp_flags,
+                                             scaler_cfg, donate_params)
+                _fs._CACHE.insert(key, entry)
+            self._fused = cache = {
+                "token": token, "states": self._states,
+                "nd_ids": tuple((id(p._ndarray), id(p._ndarray._grad))
+                                for p in params),
+                "params": params, "grads": grads, "work_states": states,
+                "work": work, "entry": entry,
+                "lr_host": None, "lr_dev": None,
+                "wd_host": None, "wd_dev": None,
+                "rescale_host": None, "rescale_dev": None}
+        work = cache["work"]
+        st = self._ensure_fused_state(scaler)
+
+        # host update-count mirror advances like the eager path (on AMP
+        # overflow the device t stays put and the mirror drifts until
+        # _sync_fused_state); lr/wd computed AFTER the bump so an
+        # attached lr_scheduler sees the same num_update as eager
+        snap = (optim.num_update, dict(optim._index_update_count))
+        for i in work:
+            optim._update_count(i)
+        lr_host = [optim._get_lr(i) for i in work]
+        if lr_host != cache["lr_host"]:
+            cache["lr_host"] = lr_host
+            cache["lr_dev"] = jnp.asarray(lr_host, jnp.float32)
+        lrs = cache["lr_dev"]
+        wd_host = [optim._get_wd(i) for i in work]
+        if wd_host != cache["wd_host"]:
+            cache["wd_host"] = wd_host
+            cache["wd_dev"] = jnp.asarray(wd_host, jnp.float32)
+        wds = cache["wd_dev"]
+        rescale_host = self._scale / batch_size
+        if rescale_host != cache["rescale_host"]:
+            cache["rescale_host"] = rescale_host
+            cache["rescale_dev"] = jnp.float32(rescale_host)
+        rescale = cache["rescale_dev"]
+        pv = tuple(p._ndarray._data for p in params)
+        gv = tuple(g._data for g in grads)
+        sv = tuple(_fs.state_data(s) for s in states)
+        try:
+            new_p, new_s, vals2 = entry(pv, gv, sv, st["vals"], lrs, wds,
+                                        rescale)
+        except Exception:
+            # roll the count mirror back; the eager path re-counts
+            optim.num_update, optim._index_update_count = snap[0], snap[1]
+            _fs._CACHE.note_fallback()
+            self._fused_broken = True
+            self._fused = None
+            self._invalidate_fused_state()
+            return False
+        st["vals"] = vals2
+        st["expected_num_update"] = optim.num_update
+        st["dirty"] = True
+        for p, w2 in zip(params, new_p):
+            p.data()._data = w2
+        for s, s2 in zip(states, new_s):
+            _fs.rebind_state(s, s2)
+        return True
+
+    # -- stepping -----------------------------------------------------------
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Rescale by 1/batch_size, allreduce, update
-        (reference: trainer.py step). With an AMP loss scaler attached
-        (amp.init_trainer), gradients are additionally divided by the loss
-        scale and the whole step is skipped on overflow (reference:
-        amp/loss_scaler.py skip-step via multi_all_finite)."""
-        rescale = self._scale / batch_size
+        """Rescale by 1/batch_size, allreduce, overflow-check, update —
+        as ONE compiled donated executable on the fused path (reference:
+        trainer.py step + amp/loss_scaler.py skip-step via
+        multi_all_finite). With an AMP loss scaler attached
+        (amp.init_trainer), gradients are additionally divided by the
+        loss scale and the whole step is skipped on overflow; the
+        scale's grow/backoff state lives on device (no host round-trip)
+        and is synced back on ``scaler.loss_scale`` reads/save_states."""
         scaler = getattr(self, "_amp_loss_scaler", None)
-        # allreduce BEFORE the overflow check: every worker then sees the
-        # same reduced gradients and takes the same skip/apply branch (a
-        # local check would desync workers and hang the next collective)
+        # allreduce BEFORE the overflow check — for the eager AND fused
+        # paths alike: every worker then sees the same reduced gradients
+        # and takes the same skip/apply branch (a local check would
+        # desync workers and hang the next collective). It runs HERE,
+        # once, so a fused executable that fails mid-flight cannot lead
+        # to a second reduction on the eager fallback. Multi-process
+        # host_local<->global array conversion can't live inside jit, so
+        # the collective runs as its own compiled program between
+        # backward and the fused update; single process it is a no-op.
         self.allreduce_grads()
+        if _fs.fused_step_enabled() and not self._fused_broken and \
+                self._fused_step(batch_size, scaler):
+            return
+        if self._fused_state is not None:
+            # fused was active earlier (env toggle / bypass): device
+            # state is authoritative — pull it back before eager math
+            self._sync_fused_state()
+            self._invalidate_fused_state()
+        rescale = self._scale / batch_size
         if scaler is not None:
             if scaler.has_overflow(self._params):
                 scaler.update_scale(True)
@@ -142,10 +384,13 @@ class Trainer:
 
     def save_states(self, fname):
         """Reference: trainer.py save_states (optimizer state incl. kvstore
-        resident state)."""
+        resident state). The AMP loss-scaler state rides along, and any
+        device-resident fused-step state is synced into the host mirrors
+        first."""
         assert self._optimizer is not None
         if not self._states_created:
             self._create_states()
+        self._sync_fused_state()
         import pickle
 
         from .. import ndarray as nd
@@ -159,6 +404,10 @@ class Trainer:
 
         payload = {"num_update": self._optimizer.num_update,
                    "states": [dump(s) for s in self._states]}
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            payload["loss_scaler"] = {"loss_scale": scaler._loss_scale,
+                                      "unskipped": scaler._unskipped}
         with open(fname, "wb") as f:
             pickle.dump(payload, f)
 
@@ -182,3 +431,11 @@ class Trainer:
         self._states_created = True
         self._optimizer.num_update = payload["num_update"]
         self._optimizer.begin_num_update = payload["num_update"]
+        scaler_state = payload.get("loss_scaler")
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler_state is not None and scaler is not None:
+            scaler._loss_scale = float(scaler_state["loss_scale"])
+            scaler._unskipped = int(scaler_state["unskipped"])
+        # device step-state is stale now; re-seed from the restored host
+        # values on the next fused step
+        self._invalidate_fused_state()

@@ -14,7 +14,6 @@ import functools
 import os
 import threading
 import warnings
-from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +22,7 @@ import time as _time
 from .. import profiler as _prof
 
 from ..base import MXNetError
+from ..utils.lru import CountedLRUCache
 
 _OPS = {}
 
@@ -224,64 +224,16 @@ class _CacheEntry:
         self.donate = donate  # input slot whose buffer is donated, or None
 
 
-class _DispatchCache:
-    """Bounded LRU of jit-compiled eager-op executables + counters."""
+class _DispatchCache(CountedLRUCache):
+    """Bounded LRU of jit-compiled eager-op executables + counters
+    (bypasses = uncacheable dispatches — tracers, providers...;
+    fallbacks = cached executable failed; op blacklisted)."""
 
     def __init__(self, maxsize=None):
         from .. import env as _env
 
-        self.maxsize = maxsize if maxsize is not None else \
-            _env.get_int("MXNET_EAGER_JIT_CACHE_SIZE", 512)
-        self._d = OrderedDict()
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.bypasses = 0   # uncacheable dispatches (tracers, providers...)
-        self.fallbacks = 0  # cached executable failed; op blacklisted
-
-    def lookup(self, key):
-        with self._lock:
-            entry = self._d.get(key)
-            if entry is None:
-                self.misses += 1
-            else:
-                self._d.move_to_end(key)
-                self.hits += 1
-            return entry
-
-    def note_bypass(self):
-        with self._lock:
-            self.bypasses += 1
-
-    def note_fallback(self):
-        with self._lock:
-            self.fallbacks += 1
-
-    def insert(self, key, entry):
-        with self._lock:
-            self._d[key] = entry
-            self._d.move_to_end(key)
-            while len(self._d) > self.maxsize:
-                self._d.popitem(last=False)
-                self.evictions += 1
-
-    def remove(self, key):
-        with self._lock:
-            self._d.pop(key, None)
-
-    def clear(self):
-        with self._lock:
-            self._d.clear()
-            self.hits = self.misses = self.evictions = 0
-            self.bypasses = self.fallbacks = 0
-
-    def stats(self):
-        with self._lock:
-            return {"size": len(self._d), "maxsize": self.maxsize,
-                    "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions, "bypasses": self.bypasses,
-                    "fallbacks": self.fallbacks}
+        super().__init__(maxsize if maxsize is not None else
+                         _env.get_int("MXNET_EAGER_JIT_CACHE_SIZE", 512))
 
 
 _CACHE = _DispatchCache()

@@ -141,6 +141,25 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    def _fused_clip(self):
+        """clip_gradient as the static -1.0-disables float the pure ops
+        (ndarray/ops_optim.py _prep_grad) understand."""
+        return -1.0 if self.clip_gradient is None else \
+            float(self.clip_gradient)
+
+    def _fused_kernel(self):
+        """Per-parameter update kernel for the Trainer's compiled fused
+        train step (gluon/fused_step.py): ``(static_key, fn)`` with
+        ``fn(w, g, s, lr, wd, rescale, t) -> (w2, s2)`` over raw jax
+        arrays. The closure captures STATIC hyperparameters only
+        (momentum, betas, clip...) — lr/wd/rescale/t arrive as traced
+        scalars so ``set_learning_rate`` / loss-scale changes never
+        retrace; ``static_key`` keys the executable cache. ``t`` is the
+        1-based update count (device-resident for AMP skip-step parity).
+        None (the default) means no fused path and the Trainer falls
+        back to the eager per-param loop."""
+        return None
+
     def __repr__(self):
         return f"{type(self).__name__}(lr={self.lr})"
 
@@ -258,6 +277,25 @@ class SGD(Optimizer):
                 for j in range(n):
                     _swap(ws[j], out[j])
 
+    def _fused_kernel(self):
+        if type(self).update is not SGD.update:
+            return None  # subclass with custom math: eager path
+        from ..ndarray import ops_optim as _oo
+
+        clip = self._fused_clip()
+        mom = float(self.momentum)
+        if mom:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.sgd_mom_update(w, g, s, lr, momentum=mom,
+                                          wd=wd, rescale_grad=rescale,
+                                          clip_gradient=clip)
+        else:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.sgd_update(w, g, lr, wd=wd,
+                                      rescale_grad=rescale,
+                                      clip_gradient=clip), None
+        return ("sgd", mom, clip), fn
+
 
 @register
 class NAG(Optimizer):
@@ -281,6 +319,25 @@ class NAG(Optimizer):
                                      momentum=self.momentum, wd=wd, **kw)
             _swap(weight, w)
             _swap(state, m)
+
+    def _fused_kernel(self):
+        if type(self).update is not NAG.update:
+            return None
+        from ..ndarray import ops_optim as _oo
+
+        clip = self._fused_clip()
+        mom = float(self.momentum)
+        if mom:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.nag_mom_update(w, g, s, lr, momentum=mom,
+                                          wd=wd, rescale_grad=rescale,
+                                          clip_gradient=clip)
+        else:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.sgd_update(w, g, lr, wd=wd,
+                                      rescale_grad=rescale,
+                                      clip_gradient=clip), None
+        return ("nag", mom, clip), fn
 
 
 @register
@@ -319,6 +376,29 @@ class Adam(Optimizer):
         _swap(mean, m)
         _swap(var, v)
 
+    def _fused_kernel(self):
+        if type(self).update is not Adam.update:
+            return None
+        import jax.numpy as jnp
+
+        from ..ndarray import ops_optim as _oo
+
+        b1, b2 = float(self.beta1), float(self.beta2)
+        eps, clip = float(self.epsilon), self._fused_clip()
+
+        def fn(w, g, s, lr, wd, rescale, t):
+            m, v = s
+            # NB: the eager path computes this bias-correction
+            # coefficient on host in float64; here t is device-resident
+            # (skip-step parity) so it is float32 — ulp-level deviation
+            tf = t.astype(jnp.float32)
+            coef = (1.0 - b2 ** tf) ** 0.5 / (1.0 - b1 ** tf)
+            w2, m2, v2 = _oo.adam_update(
+                w, g, m, v, lr * coef, beta1=b1, beta2=b2, epsilon=eps,
+                wd=wd, rescale_grad=rescale, clip_gradient=clip)
+            return w2, (m2, v2)
+        return ("adam", b1, b2, eps, clip), fn
+
 
 @register
 class AdaGrad(Optimizer):
@@ -340,6 +420,24 @@ class AdaGrad(Optimizer):
         # eps inside the sqrt, matching the reference (optimizer.py:1559)
         div = grad / ((history + self.float_stable_eps) ** 0.5)
         weight._data = (weight - lr * (div + wd * weight)).data
+
+    def _fused_kernel(self):
+        if type(self).update is not AdaGrad.update:
+            return None
+        import jax.numpy as jnp
+
+        eps = float(self.float_stable_eps)
+        clip = None if self.clip_gradient is None else \
+            float(self.clip_gradient)
+
+        def fn(w, g, s, lr, wd, rescale, t):
+            g = g * rescale
+            if clip is not None:  # eager clips whenever set, even <= 0
+                g = jnp.clip(g, -clip, clip)
+            h2 = s + g * g
+            div = g / ((h2 + eps) ** 0.5)
+            return w - lr * (div + wd * w), h2
+        return ("adagrad", eps, clip), fn
 
 
 @register
@@ -381,6 +479,32 @@ class RMSProp(Optimizer):
             _swap(g, g2)
             _swap(delta, d2)
 
+    def _fused_kernel(self):
+        if type(self).update is not RMSProp.update:
+            return None
+        from ..ndarray import ops_optim as _oo
+
+        g1, g2 = float(self.gamma1), float(self.gamma2)
+        eps, clip = float(self.epsilon), self._fused_clip()
+        clipw = -1.0 if not self.clip_weights else float(self.clip_weights)
+        if self.centered:
+            def fn(w, g, s, lr, wd, rescale, t):
+                n, mg, delta = s
+                w2, n2, mg2, d2 = _oo.rmspropalex_update(
+                    w, g, n, mg, delta, lr, gamma1=g1, gamma2=g2,
+                    epsilon=eps, wd=wd, rescale_grad=rescale,
+                    clip_gradient=clip, clip_weights=clipw)
+                return w2, (n2, mg2, d2)
+        else:
+            def fn(w, g, s, lr, wd, rescale, t):
+                w2, n2 = _oo.rmsprop_update(
+                    w, g, s, lr, gamma1=g1, epsilon=eps, wd=wd,
+                    rescale_grad=rescale, clip_gradient=clip,
+                    clip_weights=clipw)
+                return w2, n2
+        return ("rmsprop", g1, g2, eps, clip, clipw,
+                bool(self.centered)), fn
+
 
 @register
 class AdaDelta(Optimizer):
@@ -406,6 +530,26 @@ class AdaDelta(Optimizer):
                            + (1 - self.rho) * delta * delta).data
         weight._data = (weight - delta - wd * weight).data
 
+    def _fused_kernel(self):
+        if type(self).update is not AdaDelta.update:
+            return None
+        import jax.numpy as jnp
+
+        rho, eps = float(self.rho), float(self.epsilon)
+        clip = None if self.clip_gradient is None else \
+            float(self.clip_gradient)
+
+        def fn(w, g, s, lr, wd, rescale, t):  # lr unused, like eager
+            g = g * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            acc_g, acc_d = s
+            acc_g2 = rho * acc_g + (1 - rho) * g * g
+            delta = ((acc_d + eps) ** 0.5) / ((acc_g2 + eps) ** 0.5) * g
+            acc_d2 = rho * acc_d + (1 - rho) * delta * delta
+            return w - delta - wd * w, (acc_g2, acc_d2)
+        return ("adadelta", rho, eps, clip), fn
+
 
 @register
 class Ftrl(Optimizer):
@@ -427,6 +571,22 @@ class Ftrl(Optimizer):
         _swap(weight, w)
         _swap(z, z2)
         _swap(n, n2)
+
+    def _fused_kernel(self):
+        if type(self).update is not Ftrl.update:
+            return None
+        from ..ndarray import ops_optim as _oo
+
+        lamda1, beta = float(self.lamda1), float(self.beta)
+        clip = self._fused_clip()
+
+        def fn(w, g, s, lr, wd, rescale, t):
+            z, n = s
+            w2, z2, n2 = _oo.ftrl_update(
+                w, g, z, n, lr, lamda1=lamda1, beta=beta, wd=wd,
+                rescale_grad=rescale, clip_gradient=clip)
+            return w2, (z2, n2)
+        return ("ftrl", lamda1, beta, clip), fn
 
 
 @register
@@ -496,6 +656,19 @@ class SignSGD(Optimizer):
             weight, grad, lr=self._get_lr(index), wd=self._get_wd(index),
             **self._common_kwargs()))
 
+    def _fused_kernel(self):
+        if type(self).update is not SignSGD.update:
+            return None
+        from ..ndarray import ops_optim as _oo
+
+        clip = self._fused_clip()
+
+        def fn(w, g, s, lr, wd, rescale, t):
+            return _oo.signsgd_update(w, g, lr, wd=wd,
+                                      rescale_grad=rescale,
+                                      clip_gradient=clip), None
+        return ("signsgd", clip), fn
+
 
 @register
 class Signum(Optimizer):
@@ -521,6 +694,25 @@ class Signum(Optimizer):
                                     wd_lh=self.wd_lh, **self._common_kwargs())
             _swap(weight, w)
             _swap(state, m)
+
+    def _fused_kernel(self):
+        if type(self).update is not Signum.update:
+            return None
+        from ..ndarray import ops_optim as _oo
+
+        mom, wd_lh = float(self.momentum), float(self.wd_lh)
+        clip = self._fused_clip()
+        if mom:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.signum_update(w, g, s, lr, momentum=mom,
+                                         wd=wd, rescale_grad=rescale,
+                                         clip_gradient=clip, wd_lh=wd_lh)
+        else:
+            def fn(w, g, s, lr, wd, rescale, t):
+                return _oo.signsgd_update(w, g, lr, wd=wd,
+                                          rescale_grad=rescale,
+                                          clip_gradient=clip), None
+        return ("signum", mom, wd_lh, clip), fn
 
 
 @register

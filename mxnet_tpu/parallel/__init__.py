@@ -16,8 +16,8 @@ as jax.sharding over a Mesh and XLA inserts the ICI/DCN collectives:
 from __future__ import annotations
 
 from .mesh import make_mesh, current_mesh, mesh_scope, device_count
-from .spmd import (all_reduce, group_all_reduce, SPMDTrainer, shard_batch,
-                   replicate, shard_params)
+from .spmd import (all_reduce, all_reduce_coalesced, group_all_reduce,
+                   SPMDTrainer, shard_batch, replicate, shard_params)
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .moe import moe_ffn, switch_router
@@ -28,6 +28,7 @@ from .checkpoint import (save_sharded, load_sharded, save_trainer,
 __all__ = ["moe_ffn", "switch_router", "pipeline_apply",
            "save_sharded", "load_sharded", "save_trainer", "load_trainer",
            "make_mesh", "current_mesh", "mesh_scope", "device_count",
-           "all_reduce", "group_all_reduce", "SPMDTrainer", "shard_batch",
+           "all_reduce", "all_reduce_coalesced", "group_all_reduce",
+           "SPMDTrainer", "shard_batch",
            "replicate", "shard_params", "ring_attention",
            "ulysses_attention"]

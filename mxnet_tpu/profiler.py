@@ -117,6 +117,20 @@ def dispatch_cache_counters():
         return {}
 
 
+def fused_step_counters():
+    """Fused train-step executable-cache counters (hit/miss/evict/
+    bypass/fallback + size) plus the AMP skip-step total, live from
+    gluon.fused_step. Zeros before first use. NB: ``skipped_steps``
+    reads a device-resident scalar per live trainer, which blocks on
+    any in-flight step."""
+    try:
+        from .gluon.fused_step import fused_step_stats
+
+        return fused_step_stats()
+    except Exception:
+        return {}
+
+
 def _record(domain, name, start_us, dur_us, cat="event", value=None,
             cached=None):
     with _lock:
@@ -147,8 +161,9 @@ def _record(domain, name, start_us, dur_us, cat="event", value=None,
 
 def dump(finished=True, profile_process="worker"):
     """Write accumulated host events as chrome://tracing JSON. The
-    eager-dispatch cache counters ride along as chrome counter samples
-    ('eager_jit_cache/<name>') stamped at dump time."""
+    eager-dispatch and fused-step cache counters ride along as chrome
+    counter samples ('eager_jit_cache/<name>', 'fused_step/<name>')
+    stamped at dump time."""
     fname = _config.get("filename") or "profile.json"
     with _lock:
         payload = {"traceEvents": list(_events)}
@@ -156,6 +171,10 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(dispatch_cache_counters().items()):
         payload["traceEvents"].append(
             {"name": f"eager_jit_cache/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
+    for cname, cval in sorted(fused_step_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"fused_step/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
     with open(fname, "w") as f:
         json.dump(payload, f)

@@ -34,6 +34,13 @@ def _detect():
         feats["EAGER_JIT"] = eager_jit_enabled()
     except Exception:
         feats["EAGER_JIT"] = False
+    try:
+        from .gluon.fused_step import fused_step_enabled
+
+        # compiled fused train-step (MXNET_FUSED_STEP, gluon/fused_step.py)
+        feats["FUSED_STEP"] = fused_step_enabled()
+    except Exception:
+        feats["FUSED_STEP"] = False
     feats["DIST_KVSTORE"] = True  # jax.distributed collectives
     feats["INT64_TENSOR_SIZE"] = True
     feats["SIGNAL_HANDLER"] = True

@@ -1,0 +1,74 @@
+"""Bounded LRU of compiled executables, with dispatch counters.
+
+One implementation shared by the two executable caches on the hot
+paths: the compiled eager-dispatch cache (ndarray/registry.py, PR 1)
+and the fused train-step cache (gluon/fused_step.py, PR 2). Thread-safe;
+`stats()` is the counter surface profiler.*_counters() exposes.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+__all__ = ["CountedLRUCache"]
+
+
+class CountedLRUCache:
+    def __init__(self, maxsize):
+        self.maxsize = maxsize
+        self._d = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.bypasses = 0   # dispatches that could not use the cache
+        self.fallbacks = 0  # cached executable failed; caller went eager
+
+    def lookup(self, key):
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is None:
+                self.misses += 1
+            else:
+                self._d.move_to_end(key)
+                self.hits += 1
+            return entry
+
+    def note_hit(self):
+        """Hit served from a caller-side fast path (the full key was
+        neither rebuilt nor hashed)."""
+        with self._lock:
+            self.hits += 1
+
+    def note_bypass(self):
+        with self._lock:
+            self.bypasses += 1
+
+    def note_fallback(self):
+        with self._lock:
+            self.fallbacks += 1
+
+    def insert(self, key, entry):
+        with self._lock:
+            self._d[key] = entry
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def remove(self, key):
+        with self._lock:
+            self._d.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+            self.hits = self.misses = self.evictions = 0
+            self.bypasses = self.fallbacks = 0
+
+    def stats(self):
+        with self._lock:
+            return {"size": len(self._d), "maxsize": self.maxsize,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "bypasses": self.bypasses,
+                    "fallbacks": self.fallbacks}

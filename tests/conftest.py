@@ -19,6 +19,13 @@ import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmark/smoke runs (tier-1 excludes them "
+        "via -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import mxnet_tpu as mx

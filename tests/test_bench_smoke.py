@@ -1,0 +1,84 @@
+"""Benchmark harness smoke tests + tools/bench_compare.py.
+
+The ``slow``-marked tests run dispatch_bench and train_step_bench in
+``--smoke`` mode so the benchmark entry points can't rot (excluded from
+tier-1 via ``-m 'not slow'``); the bench_compare tests are fast unit
+tests over synthetic documents."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import bench_compare  # noqa: E402
+
+
+@pytest.mark.slow
+def test_dispatch_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import dispatch_bench
+
+    out = str(tmp_path / "dispatch.json")
+    doc = dispatch_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert set(doc["results"]) == {"nograd", "recorded"}
+    assert os.path.exists(out)
+
+
+@pytest.mark.slow
+def test_train_step_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import train_step_bench
+
+    out = str(tmp_path / "step.json")
+    doc = train_step_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["bitwise_equal"]
+    assert doc["loss_scale_equal"]
+    assert doc["skip_step_exercised"]
+    assert doc["results"]["fused_ms_per_step"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "fused_train_step"
+
+
+def _doc(ms, speedup):
+    return {"results": {"fused_ms_per_step": ms, "speedup": speedup},
+            "steps": 50, "counters": {"hits": 1}}
+
+
+def test_bench_compare_directions():
+    rows = bench_compare.compare(_doc(1.0, 4.0), _doc(1.1, 3.9))
+    by_path = {r[0]: r for r in rows}
+    # 10% slower latency / 2.5% lower speedup: both worse, neither > 20%
+    assert by_path["results.fused_ms_per_step"][3] == pytest.approx(0.1)
+    assert not any(r[4] for r in rows)
+    # counters/steps are not perf metrics
+    assert "steps" not in by_path and "counters.hits" not in by_path
+
+
+def test_bench_compare_flags_regression():
+    rows = bench_compare.compare(_doc(1.0, 4.0), _doc(1.5, 4.0))
+    assert any(r[4] for r in rows)  # 50% latency regression
+    rows = bench_compare.compare(_doc(1.0, 4.0), _doc(1.0, 2.0))
+    assert any(r[4] for r in rows)  # speedup halved
+
+
+def test_bench_compare_cli_exit_codes(tmp_path):
+    base, new_ok, new_bad = (str(tmp_path / n) for n in
+                             ("base.json", "ok.json", "bad.json"))
+    with open(base, "w") as f:
+        json.dump(_doc(1.0, 4.0), f)
+    with open(new_ok, "w") as f:
+        json.dump(_doc(1.05, 4.1), f)
+    with open(new_bad, "w") as f:
+        json.dump(_doc(2.0, 1.5), f)
+    script = os.path.join(_REPO, "tools", "bench_compare.py")
+    ok = subprocess.run([sys.executable, script, base, new_ok],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run([sys.executable, script, base, new_bad],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "REGRESSED" in bad.stdout

@@ -1,0 +1,35 @@
+"""docs/ENV_VARS.md is generated from the env.py knob registry; keep
+the committed file in lockstep with the code (regenerate with
+``python -m mxnet_tpu.env > docs/ENV_VARS.md``)."""
+import os
+
+from mxnet_tpu import env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_env_vars_md_matches_registry():
+    path = os.path.join(_REPO, "docs", "ENV_VARS.md")
+    with open(path) as f:
+        committed = f.read()
+    assert committed == env.markdown_table(), (
+        "docs/ENV_VARS.md is stale — regenerate with "
+        "`python -m mxnet_tpu.env > docs/ENV_VARS.md`")
+
+
+def test_fused_step_knobs_registered():
+    for name in ("MXNET_FUSED_STEP", "MXNET_FUSED_STEP_CACHE_SIZE",
+                 "MXNET_FUSED_STEP_DONATE"):
+        assert name in env.KNOBS
+        assert env.KNOBS[name][0] == "wired"
+
+
+def test_markdown_table_covers_all_knobs():
+    table = env.markdown_table()
+    for name in env.KNOBS:
+        assert f"`{name}`" in table
+
+
+def test_readme_links_env_vars():
+    with open(os.path.join(_REPO, "README.md")) as f:
+        assert "docs/ENV_VARS.md" in f.read()

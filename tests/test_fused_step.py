@@ -1,0 +1,392 @@
+"""Fused compiled train-step (gluon/fused_step.py + Trainer wiring).
+
+Covers the compiled-executable step against the eager per-param loop:
+bitwise parity (incl. an AMP skip-step episode), dynamic-scalar
+hyperparameters (no retrace on set_learning_rate / loss-scale motion),
+save/load round-trips before and after compilation, the coalesced
+fallback allreduce, and the counter/feature surfaces."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, profiler, runtime
+from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+from mxnet_tpu.gluon import fused_step
+from mxnet_tpu.gluon.parameter import Parameter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    saved = {k: os.environ.pop(k, None)
+             for k in ("MXNET_FUSED_STEP", "MXNET_FUSED_STEP_DONATE")}
+    fused_step.reset_fused_step_cache()
+    yield
+    for k, v in saved.items():
+        os.environ.pop(k, None)
+        if v is not None:
+            os.environ[k] = v
+    fused_step.reset_fused_step_cache()
+
+
+def _make_params(n=6, dim=4, seed=0, dtype="float32"):
+    rs = onp.random.RandomState(seed)
+    params = []
+    for i in range(n):
+        shape = (dim, dim) if i % 2 == 0 else (dim,)
+        p = Parameter(f"p{i}", shape=shape, dtype=dtype)
+        p.initialize()
+        p.set_data(nd.array(rs.randn(*shape).astype("f")))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, step, seed=100, poison=False):
+    rs = onp.random.RandomState(seed + step)
+    for p in params:
+        g = rs.randn(*p.shape).astype("f") * 0.1
+        if poison:
+            g = onp.full(p.shape, onp.inf, "f")
+        p.grad()._data = nd.array(g).astype(
+            str(p.data().data.dtype)).data
+
+
+def _run(optimizer, opt_args, fused, steps=6, scaler=None, inf_at=None,
+         lr_at=None, multi_precision=False, dtype="float32"):
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    params = _make_params(dtype=dtype)
+    args = dict(opt_args)
+    if multi_precision:
+        args["multi_precision"] = True
+    tr = gluon.Trainer(params, optimizer, args)
+    if scaler is not None:
+        tr._amp_loss_scaler = scaler
+    for s in range(steps):
+        if lr_at is not None and s == lr_at:
+            tr.set_learning_rate(0.01)
+        _set_grads(params, s, poison=(inf_at is not None and s == inf_at))
+        tr.step(2)
+    return [p.data().asnumpy() for p in params], tr
+
+
+def _bitwise(ws1, ws2):
+    return all(a.tobytes() == b.tobytes() for a, b in zip(ws1, ws2))
+
+
+@pytest.mark.parametrize("opt,args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.05, "clip_gradient": 0.02}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9}),
+])
+def test_fused_matches_eager_bitwise(opt, args):
+    we, _ = _run(opt, args, fused=False)
+    wf, _ = _run(opt, args, fused=True)
+    assert _bitwise(we, wf)
+
+
+@pytest.mark.parametrize("opt,args", [
+    ("adagrad", {"learning_rate": 0.05, "wd": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {"learning_rate": 0.1}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_matches_eager_ulp(opt, args):
+    """Optimizers whose update contains a division by sqrt match to a
+    few ulps but not bitwise: XLA's algebraic simplifier rewrites
+    a/sqrt(b) into a*rsqrt(b) (or not) depending on the fusion context,
+    which differs between one whole-step executable and the eager
+    per-op executables. Adam additionally computes its bias-correction
+    coefficient in device float32 (t is device-resident for skip-step
+    parity) vs host float64 on the eager path."""
+    we, _ = _run(opt, args, fused=False)
+    wf, _ = _run(opt, args, fused=True)
+    assert all(onp.allclose(a, b, rtol=1e-4, atol=1e-6)
+               for a, b in zip(we, wf))
+
+
+def test_fused_amp_skip_episode_bitwise():
+    """An all-inf gradient step must be skipped on device (lax.cond),
+    halve the scale, and leave the trajectory bitwise equal to eager."""
+    we, tre = _run("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                   fused=False, inf_at=2,
+                   scaler=LossScaler(init_scale=2.0 ** 8, scale_window=3))
+    wf, trf = _run("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                   fused=True, inf_at=2,
+                   scaler=LossScaler(init_scale=2.0 ** 8, scale_window=3))
+    assert _bitwise(we, wf)
+    # grow (window=3) and backoff both happened; property read syncs the
+    # device-resident state back to the host
+    assert trf._amp_loss_scaler.loss_scale == \
+        tre._amp_loss_scaler.loss_scale
+    assert fused_step.fused_step_stats()["skipped_steps"] == 1
+    # skipped step did not advance the update count
+    trf._sync_fused_state()
+    assert trf._optimizer.num_update == tre._optimizer.num_update
+
+
+def test_set_learning_rate_no_retrace():
+    """lr enters the executable as a dynamic scalar: changing it
+    mid-training takes effect on the very next step with the miss
+    counter flat (regression test for the tentpole contract)."""
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    _set_grads(params, 0)
+    tr.step(1)
+    misses = fused_step.fused_step_stats()["misses"]
+    w_before = params[0].data().asnumpy().copy()
+    tr.set_learning_rate(0.0)  # next step must be a no-op update
+    _set_grads(params, 1)
+    tr.step(1)
+    st = fused_step.fused_step_stats()
+    assert st["misses"] == misses  # no recompilation
+    assert st["hits"] >= 1
+    assert onp.array_equal(params[0].data().asnumpy(), w_before)
+    tr.set_learning_rate(0.5)  # and takes effect immediately again
+    _set_grads(params, 2)
+    tr.step(1)
+    assert fused_step.fused_step_stats()["misses"] == misses
+    assert not onp.array_equal(params[0].data().asnumpy(), w_before)
+
+
+def test_loss_scale_growth_no_retrace():
+    """Scale grow/backoff moves entirely on device; no recompilation."""
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    tr._amp_loss_scaler = LossScaler(init_scale=4.0, scale_window=2)
+    _set_grads(params, 0)
+    tr.step(1)
+    misses = fused_step.fused_step_stats()["misses"]
+    for s in range(1, 4):
+        _set_grads(params, s)
+        tr.step(1)
+    assert tr._amp_loss_scaler.loss_scale == 16.0  # grew twice (window 2)
+    assert fused_step.fused_step_stats()["misses"] == misses
+
+
+def test_external_loss_scale_write_reseeds():
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    tr._amp_loss_scaler = LossScaler(init_scale=2.0 ** 8)
+    _set_grads(params, 0)
+    tr.step(1)
+    tr._amp_loss_scaler.loss_scale = 2.0  # external write
+    _set_grads(params, 1)
+    tr.step(1)
+    assert tr._amp_loss_scaler.loss_scale == 2.0  # device re-seeded
+
+
+def test_fused_cache_shared_across_trainers():
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr1 = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    _set_grads(params, 0)
+    tr1.step(1)
+    misses = fused_step.fused_step_stats()["misses"]
+    tr2 = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    tr2.step(1)  # same signature -> same executable, no new compile
+    st = fused_step.fused_step_stats()
+    assert st["misses"] == misses
+    assert st["size"] == 1
+
+
+def test_env_fallback_matches_and_bypasses_cache():
+    os.environ["MXNET_FUSED_STEP"] = "0"
+    we, _ = _run("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                 fused=False)
+    st = fused_step.fused_step_stats()
+    assert st["size"] == 0 and st["misses"] == 0
+
+
+def test_unsupported_optimizer_bypasses_to_eager():
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "adamax", {"learning_rate": 0.01})
+    w0 = params[0].data().asnumpy().copy()
+    _set_grads(params, 0)
+    tr.step(1)
+    st = fused_step.fused_step_stats()
+    assert st["bypasses"] >= 1 and st["size"] == 0
+    assert not onp.array_equal(params[0].data().asnumpy(), w0)
+
+
+def test_multi_precision_fused_matches_eager():
+    """bf16 params with fp32 masters: fused mp update == eager mp."""
+    we, _ = _run("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                 fused=False, multi_precision=True, dtype="bfloat16")
+    wf, _ = _run("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                 fused=True, multi_precision=True, dtype="bfloat16")
+    assert _bitwise(we, wf)
+
+
+def test_param_donation_opt_in():
+    os.environ["MXNET_FUSED_STEP_DONATE"] = "1"
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05,
+                                       "momentum": 0.9})
+    for s in range(3):
+        _set_grads(params, s)
+        tr.step(1)
+    # params stay readable through the rebinding despite donation
+    assert onp.isfinite(params[0].data().asnumpy()).all()
+
+
+def test_save_load_states_roundtrip_before_compile(tmp_path):
+    """save/load before the fused step ever compiled (fresh trainer)."""
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05,
+                                       "momentum": 0.9})
+    tr._amp_loss_scaler = LossScaler(init_scale=2.0 ** 6)
+    fname = str(tmp_path / "pre.states")
+    tr.save_states(fname)
+    tr2 = gluon.Trainer(params, "sgd", {"learning_rate": 0.05,
+                                        "momentum": 0.9})
+    tr2._amp_loss_scaler = LossScaler()
+    tr2.load_states(fname)
+    assert tr2._amp_loss_scaler.loss_scale == 2.0 ** 6
+    _set_grads(params, 0)
+    tr2.step(1)  # compiles cleanly from restored state
+    assert onp.isfinite(params[0].data().asnumpy()).all()
+
+
+def test_save_load_states_roundtrip_after_compile(tmp_path):
+    """After fused steps (incl. a skip), the device-resident update
+    count and scaler state are synced into the checkpoint; a fresh
+    trainer continues bitwise-identically with the eager path."""
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05,
+                                       "momentum": 0.9})
+    tr._amp_loss_scaler = LossScaler(init_scale=2.0 ** 8, scale_window=3)
+    for s in range(4):
+        _set_grads(params, s, poison=(s == 1))
+        tr.step(1)
+    fname = str(tmp_path / "post.states")
+    tr.save_states(fname)
+    assert tr._optimizer.num_update == 3  # skipped step not counted
+    tr2 = gluon.Trainer(params, "sgd", {"learning_rate": 0.05,
+                                        "momentum": 0.9})
+    tr2._amp_loss_scaler = LossScaler()
+    tr2.load_states(fname)
+    assert tr2._optimizer.num_update == 3
+    assert tr2._amp_loss_scaler.loss_scale == 2.0 ** 7  # halved once
+    # momentum buffers restored: one more identical step from tr / tr2
+    # must produce identical weights
+    s1 = {k: (v[0].asnumpy() if isinstance(v, tuple) else v.asnumpy())
+          for k, v in enumerate(tr._states) if v is not None}
+    s2 = {k: (v[0].asnumpy() if isinstance(v, tuple) else v.asnumpy())
+          for k, v in enumerate(tr2._states) if v is not None}
+    for k in s1:
+        assert onp.array_equal(s1[k], s2[k])
+
+
+def test_eager_toggle_mid_training_syncs_state():
+    """Flipping MXNET_FUSED_STEP off mid-run pulls the device state back
+    so the eager path continues from the right scale/count."""
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05})
+    tr._amp_loss_scaler = LossScaler(init_scale=8.0, scale_window=2)
+    for s in range(2):
+        _set_grads(params, s)
+        tr.step(1)
+    os.environ["MXNET_FUSED_STEP"] = "0"
+    _set_grads(params, 2)
+    tr.step(1)
+    # grew once on device (window 2), then one clean eager step
+    assert tr._amp_loss_scaler._unskipped == 1
+    assert tr._amp_loss_scaler._loss_scale == 16.0
+
+
+def test_runtime_feature_and_profiler_counters():
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    feats = runtime.Features()
+    assert feats.is_enabled("FUSED_STEP")
+    os.environ["MXNET_FUSED_STEP"] = "0"
+    assert not runtime.Features().is_enabled("FUSED_STEP")
+    ctr = profiler.fused_step_counters()
+    for k in ("hits", "misses", "evictions", "bypasses", "fallbacks",
+              "size", "maxsize", "skipped_steps"):
+        assert k in ctr
+
+
+def test_coalesced_allreduce_one_collective_per_dtype():
+    from mxnet_tpu import parallel
+
+    rs = onp.random.RandomState(0)
+    values = [nd.array(rs.randn(3, 4).astype("f")),
+              nd.array(rs.randn(5).astype("f")),
+              nd.array((rs.rand(6) * 10).astype("int32")),
+              nd.array(rs.randn(7).astype("f"))]
+    calls = []
+
+    def counting_reduce(flat):
+        calls.append(flat.shape)
+        return flat * 2
+
+    out = parallel.all_reduce_coalesced(values, reduce_fn=counting_reduce)
+    assert len(calls) == 2  # one float32 bucket + one int32 bucket
+    for v, o in zip(values, out):
+        assert o.shape == v.shape
+        assert onp.array_equal(o.asnumpy(), v.asnumpy() * 2)
+
+
+def test_coalesced_allreduce_single_process_identity():
+    vals = [nd.ones((2, 2)), nd.ones((3,))]
+    out = __import__("mxnet_tpu").parallel.all_reduce_coalesced(vals)
+    assert out[0] is vals[0] and out[1] is vals[1]
+
+
+def test_distributed_trainer_allreduce_noop_single_process():
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05},
+                       kvstore="dist_sync")
+    assert tr._distributed
+    _set_grads(params, 0)
+    g0 = params[0].grad().asnumpy().copy()
+    tr.allreduce_grads()
+    assert onp.array_equal(params[0].grad().asnumpy(), g0)
+    tr.step(1)  # fused path with the distributed flag in the cache key
+    assert onp.isfinite(params[0].data().asnumpy()).all()
+
+
+def test_fused_in_training_loop_end_to_end():
+    """Whole net forward/backward/step loop converges under the fused
+    path and matches the eager loop bitwise."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    def train(fused):
+        os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        lf = gluon.loss.SoftmaxCrossEntropyLoss()
+        rs = onp.random.RandomState(0)
+        X = rs.randn(32, 8).astype("f")
+        y = (X.sum(1) > 0).astype("f")
+        for _ in range(10):
+            with autograd.record():
+                loss = lf(net(nd.array(X)), nd.array(y)).mean()
+            loss.backward()
+            tr.step(1)
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()], float(
+                    loss.asscalar())
+
+    we, le = train(False)
+    wf, lw = train(True)
+    assert _bitwise(we, wf)
+    assert le == lw

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Diff two BENCH_*.json documents and fail on performance regressions.
+
+Numeric leaves are matched by dotted path; the comparison direction is
+inferred from the leaf name:
+
+- lower is better:  ``*_us*``, ``*_ms*``, ``*latency*``, ``*_sec``
+- higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
+  ``*items_per*``
+
+Other numeric leaves (shapes, iteration counts, counters) are ignored.
+Exits nonzero when any tracked metric regresses by more than the
+threshold (default 20%), so CI can pin benchmark results against a
+committed baseline::
+
+    python tools/bench_compare.py BENCH_STEP_r07.json new.json
+    python tools/bench_compare.py base.json new.json --threshold 0.1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec")
+HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec", "items_per")
+
+
+def _direction(path):
+    leaf = path.rsplit(".", 1)[-1].lower()
+    # higher-is-better first: 'items_per_sec' also matches '_sec'
+    if any(tag in leaf for tag in HIGHER_IS_BETTER):
+        return "higher"
+    if any(tag in leaf for tag in LOWER_IS_BETTER):
+        return "lower"
+    return None
+
+
+def numeric_leaves(doc, prefix=""):
+    """{dotted path: value} over all int/float (non-bool) leaves."""
+    out = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(numeric_leaves(v, f"{prefix}.{k}" if prefix else k))
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            out.update(numeric_leaves(v, f"{prefix}[{i}]"))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = float(doc)
+    return out
+
+
+def compare(base_doc, new_doc, threshold=0.2):
+    """Rows of (path, base, new, relative_change, regressed) for every
+    tracked metric present in both documents. relative_change > 0 always
+    means 'worse' regardless of direction."""
+    base = numeric_leaves(base_doc)
+    new = numeric_leaves(new_doc)
+    rows = []
+    for path in sorted(set(base) & set(new)):
+        direction = _direction(path)
+        if direction is None or base[path] == 0:
+            continue
+        rel = (new[path] - base[path]) / abs(base[path])
+        if direction == "higher":
+            rel = -rel
+        rows.append((path, base[path], new[path], rel, rel > threshold))
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("base", help="baseline BENCH_*.json")
+    p.add_argument("new", help="candidate BENCH_*.json")
+    p.add_argument("--threshold", type=float, default=0.2,
+                   help="max tolerated relative regression (default 0.2)")
+    a = p.parse_args(argv)
+    with open(a.base) as f:
+        base_doc = json.load(f)
+    with open(a.new) as f:
+        new_doc = json.load(f)
+    rows = compare(base_doc, new_doc, a.threshold)
+    if not rows:
+        print("bench_compare: no comparable metrics found")
+        return 0
+    width = max(len(r[0]) for r in rows)
+    regressed = False
+    for path, b, n, rel, bad in rows:
+        flag = "REGRESSED" if bad else "ok"
+        print(f"{path:<{width}}  base={b:<12g} new={n:<12g} "
+              f"change={rel * 100:+7.1f}%  {flag}")
+        regressed = regressed or bad
+    if regressed:
+        print(f"bench_compare: regression beyond "
+              f"{a.threshold * 100:.0f}% threshold")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
