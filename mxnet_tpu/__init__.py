@@ -18,6 +18,17 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 
+def _distributed_is_initialized(jax):
+    """``jax.distributed.is_initialized`` arrived after 0.4.x; there the
+    tell is the private rendezvous client (initialized iff it exists)."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return fn()
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "client", None) is not None
+
+
 def _maybe_init_distributed():
     """jax.distributed.initialize must run BEFORE anything touches the
     XLA backend, and importing this package touches it (PRNG state) —
@@ -26,9 +37,11 @@ def _maybe_init_distributed():
     of the reference's implicit ps-lite bootstrap inside ``import
     mxnet`` when DMLC_PS_ROOT_URI is set."""
     import multiprocessing
-    import os
 
-    if not os.environ.get("MXNET_COORDINATOR"):
+    from . import env as _env
+
+    coord = _env.get_str("MXNET_COORDINATOR")
+    if not coord:
         return
     if multiprocessing.parent_process() is not None:
         # forkserver/spawn children (DataLoader workers, ...) inherit
@@ -37,14 +50,23 @@ def _maybe_init_distributed():
         return
     import jax
 
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized(jax):
         return  # an explicit launch.init() beat us
     # rendezvous failures propagate: a silently un-joined worker would
-    # leave its peers hanging at their first collective
+    # leave its peers hanging at their first collective — and a launch
+    # env with the coordinator but not the rank vars is itself such a
+    # failure (defaulting to rank 0 of 1 would fork the cluster)
+    nproc = _env.get_str("MXNET_NUM_PROCESSES")
+    pid = _env.get_str("MXNET_PROCESS_ID")
+    if nproc is None or pid is None:
+        raise RuntimeError(
+            "MXNET_COORDINATOR is set but MXNET_NUM_PROCESSES/"
+            "MXNET_PROCESS_ID are not — refusing to join the cluster "
+            "with guessed rank (every worker would claim rank 0)")
     jax.distributed.initialize(
-        coordinator_address=os.environ["MXNET_COORDINATOR"],
-        num_processes=int(os.environ["MXNET_NUM_PROCESSES"]),
-        process_id=int(os.environ["MXNET_PROCESS_ID"]))
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(pid))
 
 
 def _maybe_enable_int64():
@@ -52,9 +74,9 @@ def _maybe_enable_int64():
     indexing and int64 arithmetic (reference: include/mxnet/libinfo.h:126,
     flag INT64_TENSOR_SIZE; nightly test_large_array.py). The TPU analog
     is JAX's x64 mode — it must be set before the first jax use."""
-    import os
+    from . import env as _env
 
-    if os.environ.get("MXNET_INT64_TENSOR_SIZE", "0").lower() in (
+    if (_env.get_str("MXNET_INT64_TENSOR_SIZE", "0") or "0").lower() in (
             "1", "true", "on"):
         import jax
 
@@ -120,6 +142,7 @@ from .monitor import Monitor  # noqa: F401
 from . import visualization  # noqa: F401
 from .visualization import print_summary  # noqa: F401
 from . import runtime  # noqa: F401
+from . import analysis  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import operator  # noqa: F401
 from . import rtc  # noqa: F401
@@ -134,9 +157,7 @@ Context = Context
 
 # env-knob wiring (mxnet_tpu.env KNOBS table): global seed + profiler
 # autostart, applied once at import like the reference's engine init
-import os as _os  # noqa: E402
-
-if _os.environ.get("MXNET_SEED"):
+if env.get_str("MXNET_SEED"):
     random.seed(env.get_int("MXNET_SEED", 0))
 if env.get_bool("MXNET_PROFILER_AUTOSTART"):
     profiler.set_config(aggregate_stats=True)

@@ -1,0 +1,86 @@
+"""mxnet_tpu.analysis — pass-based static analysis for graphs and traces.
+
+A verification layer the reference ran as C++ graph passes at bind time
+(reference: src/executor/infer_graph_attr_pass.cc shape/type inference,
+src/nnvm/plan_memory.cc in-place/aliasing planning) and Relay-style
+typed-IR systems run as whole-program analysis: prove a graph safe
+*before* XLA compiles it, with structured diagnostics instead of runtime
+trace errors.
+
+Two front ends share one diagnostic catalogue (diagnostics.CODES):
+
+- **Symbol graphs** (``verify_symbol``): shape/dtype inference
+  cross-checks, declared-vs-derived parameter shapes, dead outputs,
+  duplicate node names. Gated onto ``Executor`` bind by
+  ``MXNET_GRAPH_VERIFY={0,warn,error}``.
+- **Execution traces** (``record_trace`` + ``verify_trace``): PRNG key
+  reuse, use-after-donate, double donation, dead values over one
+  recorded eager forward. Gated onto ``HybridBlock.hybridize`` by the
+  same knob; the donation checks also run inline in the compiled
+  dispatch cache and the fused train-step.
+
+Plus ``verify_shardings`` for the SPMD layer and the runtime donation
+guards in ``donation``. See docs/ANALYSIS.md for the full catalogue.
+"""
+from __future__ import annotations
+
+from .diagnostics import (CODES, Diagnostic, DiagnosticReport,
+                          GraphVerifyError, SEV_ERROR, SEV_WARNING,
+                          counters, reset_counters, verify_mode)
+from .donation import check_dispatch_donation, check_param_donation
+from .events import (GraphTrace, OpEvent, TRACE_PASSES, record_trace,
+                     verify_trace)
+from .passes import PASSES, PassContext, run_passes, verify_symbol
+from .sharding import verify_shardings
+
+__all__ = [
+    "CODES", "Diagnostic", "DiagnosticReport", "GraphVerifyError",
+    "SEV_ERROR", "SEV_WARNING", "counters", "reset_counters",
+    "verify_mode", "check_dispatch_donation", "check_param_donation",
+    "GraphTrace", "OpEvent", "TRACE_PASSES", "record_trace",
+    "verify_trace", "PASSES", "PassContext", "run_passes",
+    "verify_symbol", "verify_shardings", "verify_block_call",
+]
+
+
+def verify_block_call(block, args, subject=None):
+    """Verify a (to-be-hybridized) block by recording one paused eager
+    forward and running the trace passes. Returns the undispositioned
+    report; the hybridize hook dispositions it per MXNET_GRAPH_VERIFY."""
+    from .. import autograd
+    from .. import random as _mxrandom
+
+    # Finish deferred parameter init FIRST, on the normal stream: the
+    # init draws would happen anyway (CachedOp's own throwaway pass runs
+    # under the same condition), so their key consumption must persist.
+    params = getattr(block, "collect_params", None)
+    if params is not None and any(p._ndarray is None
+                                  for _, p in params().items()):
+        with autograd.pause(train_mode=autograd.is_training()):
+            block.forward(*args)
+    # The verification forward itself is THROWAWAY: restore the global
+    # PRNG stream (arming MXNET_GRAPH_VERIFY must never shift the keys
+    # the real run draws) AND every parameter buffer (a training-mode
+    # forward folds fresh batch stats into BatchNorm running mean/var —
+    # without the restore the first real step would apply that EMA
+    # twice). Seeded runs stay byte-identical with verification on/off.
+    saved_key = _mxrandom._STATE.key
+    saved_params = []
+    if params is not None:
+        saved_params = [(p._ndarray, p._ndarray._data)
+                        for _, p in params().items()
+                        if p._ndarray is not None]
+    try:
+        with record_trace(subject=subject or type(block).__name__) as trace:
+            with autograd.pause(train_mode=autograd.is_training()):
+                out = block.forward(*args)
+    finally:
+        _mxrandom._STATE.key = saved_key
+        for nd_obj, data in saved_params:
+            nd_obj._data = data
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    flat = []
+    for o in outs:
+        flat.extend(o if isinstance(o, (list, tuple)) else [o])
+    trace.mark_outputs(flat)
+    return verify_trace(trace)

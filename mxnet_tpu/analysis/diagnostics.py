@@ -1,0 +1,177 @@
+"""Structured diagnostics for the static-analysis subsystem.
+
+The analog of the reference's graph-pass error surfaces (reference:
+src/executor/infer_graph_attr_pass.cc CHECK failures, src/nnvm/
+plan_memory.cc inplace-option vetoes) — but instead of aborting inside a
+C++ pass with a stringly CHECK message, every verifier pass emits
+``Diagnostic`` records (code, severity, node path, message, fix hint)
+into a ``DiagnosticReport``. The report is then *dispositioned* once,
+according to ``MXNET_GRAPH_VERIFY``:
+
+- ``0`` (default): verification is off — passes never run;
+- ``warn``: diagnostics are logged and counted (profiler counters);
+- ``error``: any diagnostic raises ``GraphVerifyError`` carrying the
+  full report, so the failure names every problem at once instead of
+  dying on the first.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..base import MXNetError
+
+__all__ = ["Diagnostic", "DiagnosticReport", "GraphVerifyError", "CODES",
+           "SEV_ERROR", "SEV_WARNING", "verify_mode", "counters",
+           "reset_counters"]
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+# diagnostic catalogue: code -> (default severity, title)
+# GV1xx shape/dtype inference, GV2xx donation/aliasing, GV3xx PRNG,
+# GV4xx graph structure, GV5xx sharding.
+CODES = {
+    "GV101": (SEV_ERROR, "shape mismatch"),
+    "GV102": (SEV_ERROR, "dtype mismatch"),
+    "GV103": (SEV_ERROR, "shape-inference desync (infer vs eval_shape)"),
+    "GV201": (SEV_ERROR, "use-after-donate"),
+    "GV202": (SEV_ERROR, "double donation"),
+    "GV301": (SEV_ERROR, "PRNG key reuse"),
+    "GV401": (SEV_WARNING, "dead node / unused output"),
+    "GV402": (SEV_WARNING, "unused input"),
+    "GV403": (SEV_ERROR, "duplicate node name"),
+    "GV501": (SEV_ERROR, "sharding mismatch"),
+    "GV502": (SEV_ERROR, "mesh mismatch"),
+}
+
+
+class Diagnostic:
+    """One finding: code + severity + where + what + how to fix."""
+
+    __slots__ = ("code", "severity", "node", "message", "hint")
+
+    def __init__(self, code, message, node=None, hint=None, severity=None):
+        if code not in CODES:
+            raise ValueError(f"unknown diagnostic code {code!r}")
+        self.code = code
+        self.severity = severity or CODES[code][0]
+        self.node = node  # node path ("fc1/weight"), buffer label, ...
+        self.message = message
+        self.hint = hint
+
+    def __repr__(self):
+        loc = f" at {self.node}" if self.node else ""
+        hint = f" (hint: {self.hint})" if self.hint else ""
+        return (f"[{self.code} {self.severity}] "
+                f"{CODES[self.code][1]}{loc}: {self.message}{hint}")
+
+
+class GraphVerifyError(MXNetError):
+    """Raised in ``MXNET_GRAPH_VERIFY=error`` mode; carries the report."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__("graph verification failed:\n" +
+                         "\n".join(f"  {d!r}" for d in report))
+
+
+class DiagnosticReport:
+    """Ordered collection of diagnostics from one verification run."""
+
+    def __init__(self, subject=None):
+        self.subject = subject  # what was verified (symbol name, block)
+        self._diags = []
+
+    def emit(self, code, message, node=None, hint=None, severity=None):
+        self._diags.append(
+            Diagnostic(code, message, node=node, hint=hint,
+                       severity=severity))
+        return self._diags[-1]
+
+    def extend(self, other):
+        self._diags.extend(other._diags)
+
+    def __iter__(self):
+        return iter(self._diags)
+
+    def __len__(self):
+        return len(self._diags)
+
+    def __bool__(self):
+        return bool(self._diags)
+
+    def codes(self):
+        return [d.code for d in self._diags]
+
+    def by_code(self, code):
+        return [d for d in self._diags if d.code == code]
+
+    @property
+    def errors(self):
+        return [d for d in self._diags if d.severity == SEV_ERROR]
+
+    @property
+    def warnings(self):
+        return [d for d in self._diags if d.severity == SEV_WARNING]
+
+    def disposition(self, mode=None):
+        """Count, then log (warn mode) or raise (error mode). Returns
+        self so call sites can chain: ``report = verify(...).disposition()``.
+        """
+        mode = mode or verify_mode()
+        _count(self)
+        if mode == "off" or not self._diags:
+            return self
+        if mode == "error":
+            raise GraphVerifyError(self)
+        for d in self._diags:
+            logging.warning("graph-verify %r", d)
+        return self
+
+
+def verify_mode():
+    """MXNET_GRAPH_VERIFY: '0'/off (default) | warn | error. Read per
+    verification point so tests can toggle without reimport."""
+    from .. import env as _env
+
+    raw = _env.get_str("MXNET_GRAPH_VERIFY", "0").strip().lower()
+    if raw in ("", "0", "off", "false", "none"):
+        return "off"
+    if raw in ("error", "raise", "2"):
+        return "error"
+    return "warn"  # "warn", "1", anything else conservative-lenient
+
+
+# ---------------------------------------------------------------------------
+# counters (surfaced through profiler.graph_verify_counters)
+
+_LOCK = threading.Lock()
+_COUNTERS = {"graphs_checked": 0, "diagnostics": 0, "errors": 0,
+             "warnings": 0}
+_BY_CODE = {}
+
+
+def _count(report):
+    with _LOCK:
+        _COUNTERS["graphs_checked"] += 1
+        _COUNTERS["diagnostics"] += len(report)
+        _COUNTERS["errors"] += len(report.errors)
+        _COUNTERS["warnings"] += len(report.warnings)
+        for d in report:
+            _BY_CODE[d.code] = _BY_CODE.get(d.code, 0) + 1
+
+
+def counters():
+    """Live verifier counters: totals + per-diagnostic-code tallies."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+        out.update({f"code_{c}": n for c, n in sorted(_BY_CODE.items())})
+        return out
+
+
+def reset_counters():
+    with _LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+        _BY_CODE.clear()

@@ -1,0 +1,103 @@
+"""Runtime donation/aliasing safety checks.
+
+Buffer donation (``donate_argnums``) *deletes* the donated jax.Array on
+backends that honor it — any other holder of that buffer (an autograd
+tape node's saved primals, a ``detach()`` snapshot, a user copy) is left
+pointing at freed device memory. XLA only reports this lazily, as an
+opaque "buffer has been deleted" error at the *next* use; these checks
+prove the hazard at donation time and name the holder.
+
+Called from the compiled-dispatch cache (ndarray/registry.py, ``out=``
+donation under ``MXNET_EAGER_JIT_DONATE``) and the fused train-step
+(gluon/trainer.py, parameter donation under ``MXNET_FUSED_STEP_DONATE``)
+when ``MXNET_GRAPH_VERIFY`` is active.
+"""
+from __future__ import annotations
+
+from .diagnostics import DiagnosticReport, verify_mode
+
+__all__ = ["check_dispatch_donation", "check_param_donation"]
+
+
+def _tape_aliases(buffers):
+    """Map buffer id -> describing string for tape-held aliases."""
+    from .. import autograd
+
+    held = {}
+    for pos, node in enumerate(getattr(autograd._STATE, "tape", ()) or ()):
+        for pr in node.primals:
+            held.setdefault(id(pr), f"tape node {pos} "
+                                    f"({getattr(node, 'fun', None) and getattr(node.fun, '__name__', 'op') or 'op'})")
+    return {b: held[b] for b in buffers if b in held}
+
+
+def check_dispatch_donation(opname, arr_args, donate_slot, out):
+    """Verify an ``out=``-aliasing dispatch may donate its input slot.
+
+    GV202: the to-be-donated buffer also feeds another argument slot of
+    the same dispatch (XLA would alias one buffer into two parameters).
+    GV201: an autograd tape node still holds the buffer as a saved
+    primal — backward would read deleted memory.
+
+    Returns the dispositioned report (raises under =error).
+    """
+    mode = verify_mode()
+    if mode == "off" or donate_slot is None:
+        return None
+    report = DiagnosticReport(subject=opname)
+    donated = arr_args[donate_slot]._data
+    for i, a in enumerate(arr_args):
+        if i != donate_slot and a._data is donated:
+            report.emit(
+                "GV202",
+                f"op '{opname}': the out= buffer is also argument slot "
+                f"{i} — donating would invalidate a live input",
+                node=opname,
+                hint="pass a distinct array for out=")
+    alias = _tape_aliases([id(donated)])
+    if alias:
+        report.emit(
+            "GV201",
+            f"op '{opname}': the out= buffer to be donated is still "
+            f"held by {alias[id(donated)]} — backward would read "
+            "deleted memory",
+            node=opname,
+            hint="run the in-place update outside autograd.record, or "
+                 "disable MXNET_EAGER_JIT_DONATE")
+    return report.disposition(mode)
+
+
+def check_param_donation(param_arrays, subject="fused_step"):
+    """Verify fused-step parameter donation: no donated parameter buffer
+    may still be referenced by a live tape node (GV201) and no two
+    parameters may share one buffer (GV202)."""
+    mode = verify_mode()
+    if mode == "off":
+        return None
+    report = DiagnosticReport(subject=subject)
+    seen = {}
+    bufs = []
+    for name, data in param_arrays:
+        bufs.append(id(data))
+        prev = seen.get(id(data))
+        if prev is not None:
+            report.emit(
+                "GV202",
+                f"parameters '{prev}' and '{name}' share one buffer — "
+                "donation would free it twice",
+                node=name,
+                hint="give each parameter its own storage")
+        else:
+            seen[id(data)] = name
+    aliases = _tape_aliases(bufs)
+    for name, data in param_arrays:
+        holder = aliases.get(id(data))
+        if holder is not None:
+            report.emit(
+                "GV201",
+                f"parameter '{name}' is donated to the fused step but "
+                f"still held by {holder}",
+                node=name,
+                hint="call backward() before step(), or keep "
+                     "MXNET_FUSED_STEP_DONATE=0")
+    return report.disposition(mode)

@@ -1,0 +1,253 @@
+"""Dynamic-trace front end: record one eager forward as an event list.
+
+The Symbol passes (passes.py) see the declared graph; hybridized blocks
+and raw imperative code have no Symbol to walk. This front end records
+ONE paused eager execution — every op dispatch with its input/output
+buffer identities, PRNG keys drawn, and ``out=`` donation aliasing —
+into a ``GraphTrace``, then runs dataflow passes over the events:
+
+- ``key_reuse``: the same PRNG key consumed by two stochastic dispatches
+  (GV301) — the classic jit-unsafety where a key baked into a replayed
+  region silently reuses one mask forever;
+- ``donation``: an input buffer read after an ``out=``-aliasing dispatch
+  rebound it (use-after-donate, GV201: under ``MXNET_EAGER_JIT_DONATE``
+  /TPU the old buffer is *deleted*, so that read would fault or return
+  garbage), and one buffer appearing in two donated slots of a single
+  dispatch (double donation, GV202);
+- ``dead_values``: op results that nothing ever consumed and that are
+  not among the traced call's outputs (GV401).
+
+Recording works by wrapping ``ndarray.registry.invoke`` (every eager op,
+hybridized replay, and symbolic evaluation funnels through it) and
+``mxnet_tpu.random.next_key`` (every key draw — through the global
+stream, a provider, or a replayer — resolves the module attribute at
+call time). Both hooks are removed on exit; the compiled-dispatch cache
+keeps working underneath, and since its hit path pre-splits keys through
+``next_key`` too, the observed keys are exactly the keys execution uses.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as onp
+
+import jax
+
+from .diagnostics import DiagnosticReport
+
+__all__ = ["OpEvent", "GraphTrace", "record_trace", "verify_trace"]
+
+
+class OpEvent:
+    """One dispatched op: names + buffer identities + keys + donation."""
+
+    __slots__ = ("index", "op", "inputs", "outputs", "keys", "donated",
+                 "stochastic")
+
+    def __init__(self, index, op, inputs=(), outputs=(), keys=(),
+                 donated=(), stochastic=False):
+        self.index = index
+        self.op = op
+        self.inputs = tuple(inputs)    # buffer ids read
+        self.outputs = tuple(outputs)  # buffer ids produced
+        self.keys = tuple(keys)        # hashable key fingerprints
+        self.donated = tuple(donated)  # buffer ids donated/invalidated
+        self.stochastic = stochastic or bool(keys)
+
+    def __repr__(self):
+        extra = ""
+        if self.keys:
+            extra += f" keys={len(self.keys)}"
+        if self.donated:
+            extra += f" donated={len(self.donated)}"
+        return f"<OpEvent {self.index}:{self.op}{extra}>"
+
+
+class GraphTrace:
+    def __init__(self, subject=None):
+        self.subject = subject
+        self.events = []
+        self.live_out = set()  # buffer ids returned from the traced call
+        # events identify buffers by id(); keep every recorded array
+        # alive for the trace's lifetime so a freed buffer's heap
+        # address cannot be recycled into a later array and alias two
+        # distinct buffers in the dataflow passes
+        self._keepalive = []
+
+    def add(self, op, inputs=(), outputs=(), keys=(), donated=(),
+            stochastic=False):
+        ev = OpEvent(len(self.events), op, inputs, outputs, keys, donated,
+                     stochastic)
+        self.events.append(ev)
+        return ev
+
+    def mark_outputs(self, arrays):
+        """Declare the traced call's results (their buffers are live)."""
+        for a in arrays:
+            d = getattr(a, "_data", a)
+            self._keepalive.append(d)
+            self.live_out.add(id(d))
+
+    def __len__(self):
+        return len(self.events)
+
+
+def _key_fingerprint(key):
+    """Content identity of a PRNG key: two splits never collide, so equal
+    content == the same key reused. Tracer keys (inside an enclosing jit
+    trace) have no content — fall back to object identity, which still
+    catches literal reuse of one tracer."""
+    try:
+        return tuple(onp.asarray(key).ravel().tolist())
+    except Exception:
+        return ("tracer", id(key))
+
+
+@contextlib.contextmanager
+def record_trace(subject=None):
+    """Record every op dispatch + PRNG key draw into a GraphTrace."""
+    from .. import random as _mxrandom
+    from ..ndarray import NDArray
+    from ..ndarray import registry as _registry
+
+    trace = GraphTrace(subject=subject)
+    drawn = []  # keys drawn since the current dispatch began
+
+    # -- key observer: wrap random.next_key itself, so draws through ANY
+    # source are seen — the global eager stream, and providers/replayers
+    # installed before OR inside the recorded region ---------------------
+    orig_next_key = _mxrandom.next_key
+
+    def observed_next_key():
+        k = orig_next_key()
+        drawn.append(_key_fingerprint(k))
+        return k
+
+    # -- invoke wrapper -------------------------------------------------
+    orig_invoke = _registry.invoke
+    depth = [0]
+
+    def recording_invoke(opdef, args, kwargs):
+        if depth[0]:  # nested dispatch (op body calling ops): outer owns
+            return orig_invoke(opdef, args, kwargs)
+        depth[0] += 1
+        start = len(drawn)
+        in_datas = [a._data for a in args if isinstance(a, NDArray)]
+        # NB: `out` is a destination, not an input — including it here
+        # would make every out= dispatch look self-aliasing (donated)
+        in_datas += [v._data for k, v in kwargs.items()
+                     if k != "out" and isinstance(v, NDArray)]
+        trace._keepalive.extend(in_datas)
+        in_ids = [id(d) for d in in_datas]
+        out_arr = kwargs.get("out")
+        donated = []
+        if isinstance(out_arr, NDArray):
+            out_buf = id(out_arr._data)
+            trace._keepalive.append(out_arr._data)
+            if out_buf in in_ids:
+                # out= aliases a REAL input: under buffer donation the
+                # old payload is invalidated by this dispatch
+                donated = [out_buf]
+        try:
+            result = orig_invoke(opdef, args, kwargs)
+        finally:
+            depth[0] -= 1
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        out_datas = [o._data for o in outs if isinstance(o, NDArray)]
+        trace._keepalive.extend(out_datas)
+        out_ids = [id(d) for d in out_datas]
+        trace.add(opdef.name, in_ids, out_ids, drawn[start:], donated,
+                  stochastic=not opdef.differentiable and
+                  len(drawn) > start)
+        return result
+
+    _mxrandom.next_key = observed_next_key
+    _registry.invoke = recording_invoke
+    try:
+        yield trace
+    finally:
+        _registry.invoke = orig_invoke
+        _mxrandom.next_key = orig_next_key
+
+
+# ---------------------------------------------------------------------------
+# trace passes
+
+def key_reuse_pass(trace, report):
+    seen = {}  # fingerprint -> first event
+    for ev in trace.events:
+        for fp in ev.keys:
+            first = seen.get(fp)
+            if first is not None:
+                report.emit(
+                    "GV301",
+                    f"PRNG key consumed by op '{first.op}' (event "
+                    f"{first.index}) is consumed again by op '{ev.op}' "
+                    f"(event {ev.index}) — both draw the same random "
+                    "stream",
+                    node=ev.op,
+                    hint="split the key (mx.random.next_key / "
+                         "key_provider) instead of reusing it")
+            else:
+                seen[fp] = ev
+
+
+def donation_pass(trace, report):
+    dead = {}  # buffer id -> event that donated it
+    for ev in trace.events:
+        for buf in ev.inputs:
+            donor = dead.get(buf)
+            if donor is not None:
+                report.emit(
+                    "GV201",
+                    f"op '{ev.op}' (event {ev.index}) reads a buffer "
+                    f"donated by op '{donor.op}' (event {donor.index}) "
+                    "— with buffer donation enabled that payload is "
+                    "deleted",
+                    node=ev.op,
+                    hint="copy() the array before the in-place op, or "
+                         "keep MXNET_EAGER_JIT_DONATE=0 while aliases "
+                         "are live")
+        if len(ev.donated) != len(set(ev.donated)):
+            report.emit(
+                "GV202",
+                f"op '{ev.op}' (event {ev.index}) donates the same "
+                "buffer through two argument slots",
+                node=ev.op,
+                hint="pass distinct arrays for out= and the aliased "
+                     "operand")
+        for buf in ev.donated:
+            dead[buf] = ev
+
+
+def dead_value_pass(trace, report):
+    consumed = set()
+    for ev in trace.events:
+        consumed.update(ev.inputs)
+    for ev in trace.events:
+        unused = [b for b in ev.outputs
+                  if b not in consumed and b not in trace.live_out]
+        if unused and len(unused) == len(ev.outputs):
+            report.emit(
+                "GV401",
+                f"op '{ev.op}' (event {ev.index}) produces "
+                f"{len(ev.outputs)} result(s) that nothing consumes",
+                node=ev.op,
+                hint="remove the dead computation")
+
+
+TRACE_PASSES = {
+    "key_reuse": key_reuse_pass,
+    "donation": donation_pass,
+    "dead_values": dead_value_pass,
+}
+
+DEFAULT_TRACE_PIPELINE = ("key_reuse", "donation", "dead_values")
+
+
+def verify_trace(trace, passes=None, subject=None):
+    """Run the trace passes; returns the (undispositioned) report."""
+    report = DiagnosticReport(subject=subject or trace.subject)
+    for name in (passes or DEFAULT_TRACE_PIPELINE):
+        TRACE_PASSES[name](trace, report)
+    return report

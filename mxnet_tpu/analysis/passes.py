@@ -1,0 +1,342 @@
+"""Composable verifier passes over a Symbol DAG.
+
+The TPU-native analog of the reference's bound-graph static passes
+(reference: src/executor/infer_graph_attr_pass.cc forward/backward
+attribute inference with partial info; nnvm pass registry). Each pass is
+``pass_fn(ctx)`` over a shared ``PassContext`` (symbol + known
+shapes/dtypes + memoized inference results), emitting structured
+diagnostics instead of CHECK-aborting:
+
+- ``shape``: partial shape inference (symbol/infer.py) seeded from
+  declared ``__shape__`` attrs + caller-known shapes, cross-checked
+  against the layer rules (a declared parameter shape that contradicts
+  what the consuming layer requires is a GV101 *here*, not an opaque XLA
+  error at first forward) and against a whole-graph ``jax.eval_shape``
+  of the actual op bodies (GV103 catches the two inference paths
+  disagreeing — a bug in the framework itself).
+- ``dtype``: forward dtype propagation cross-checked against declared
+  ``__dtype__`` attrs (GV102).
+- ``structure``: duplicate node names (GV403 — ``tojson`` keys nodes by
+  name, so duplicates silently merge on save/load) and dead outputs of
+  multi-output nodes (GV401 — computed, never consumed, not a head).
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as onp
+
+from ..base import MXNetError
+from .diagnostics import DiagnosticReport
+
+__all__ = ["PassContext", "PASSES", "run_passes", "verify_symbol"]
+
+
+class PassContext:
+    def __init__(self, symbol, shapes=None, dtypes=None, subject=None):
+        self.symbol = symbol
+        self.known_shapes = {k: tuple(v) for k, v in (shapes or {}).items()}
+        self.known_dtypes = {k: onp.dtype(v)
+                             for k, v in (dtypes or {}).items()}
+        self.report = DiagnosticReport(subject=subject)
+        self.var_shapes = None  # filled by the shape pass
+        self.out_shapes = None
+
+    # -- graph helpers ------------------------------------------------------
+    def nodes(self):
+        """Walked nodes, de-duplicated: output views made by __getitem__
+        share the base node's _inputs/_kwargs identities — collapse them
+        to one representative so per-node passes fire once per real op."""
+        seen, out = set(), []
+        for s in self.symbol._walk():
+            if s._group is not None:
+                continue
+            key = self.node_key(s)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(s)
+        return out
+
+    @staticmethod
+    def node_key(s):
+        if s._op is None:
+            return ("var", s._name)
+        return (s._op, id(s._inputs), id(s._kwargs))
+
+    def heads(self):
+        return (self.symbol._group if self.symbol._group
+                else [self.symbol])
+
+    def declared_shapes(self):
+        """Variable shapes declared via ``__shape__`` attrs."""
+        out = {}
+        for s in self.nodes():
+            if s._op is None and "__shape__" in s._attrs:
+                try:
+                    out[s._name] = tuple(
+                        ast.literal_eval(s._attrs["__shape__"]))
+                except (ValueError, SyntaxError):
+                    pass
+        return out
+
+    def declared_dtypes(self):
+        out = {}
+        for s in self.nodes():
+            if s._op is None and "__dtype__" in s._attrs:
+                try:
+                    out[s._name] = onp.dtype(s._attrs["__dtype__"])
+                except TypeError:
+                    pass
+        return out
+
+
+# ---------------------------------------------------------------------------
+# shape pass
+
+def _merge_known(ctx):
+    """Caller-known shapes win over declared attrs; a conflict between
+    the two is itself a GV101."""
+    declared = ctx.declared_shapes()
+    merged = dict(declared)
+    for name, shp in ctx.known_shapes.items():
+        if name in declared and tuple(declared[name]) != tuple(shp):
+            ctx.report.emit(
+                "GV101",
+                f"variable '{name}' is declared with shape "
+                f"{declared[name]} but bound with shape {tuple(shp)}",
+                node=name,
+                hint="fix the Variable(shape=...) declaration or the "
+                     "bound array")
+        merged[name] = tuple(shp)
+    return merged
+
+
+def shape_pass(ctx):
+    from ..symbol.infer import (_array_arg_names, _param_shape_rules,
+                                infer_shapes)
+    from ..ndarray import registry as _registry
+
+    known = _merge_known(ctx)
+    try:
+        var_shapes, out_shapes = infer_shapes(ctx.symbol, known,
+                                              allow_unknown=True)
+    except MXNetError as e:
+        ctx.report.emit(
+            "GV101", str(e),
+            hint="check the input shapes fed to this graph")
+        return
+    ctx.var_shapes, ctx.out_shapes = var_shapes, out_shapes
+
+    # cross-check KNOWN parameter shapes against the layer rules the
+    # partial-inference pass would use to derive them: the reference's
+    # bidirectional FInferShape consistency, forward half
+    for node in ctx.nodes():
+        if node._op is None:
+            continue
+        opdef = _registry.get_op(node._op)
+        if opdef is None:
+            ctx.report.emit(
+                "GV101", f"op '{node._op}' is not registered",
+                node=node._name)
+            continue
+        arg_names = _array_arg_names(opdef)
+        in_shapes = {}
+        for i, inp in enumerate(node._inputs):
+            s = var_shapes.get(inp._name) if inp._op is None else None
+            if s is not None:
+                in_shapes[i] = tuple(s)
+        if 0 not in in_shapes:
+            # data shape unknown at this node under partial info — the
+            # rules need it; nothing to cross-check
+            continue
+        try:
+            rules = _param_shape_rules(node._op, node._kwargs, in_shapes,
+                                       arg_names)
+        except Exception:
+            continue  # a rule that cannot run is not a user error
+        for i, want in rules.items():
+            if i >= len(node._inputs):
+                continue
+            inp = node._inputs[i]
+            if inp._op is not None:
+                continue
+            have = var_shapes.get(inp._name)
+            if have is not None and tuple(have) != tuple(want):
+                ctx.report.emit(
+                    "GV101",
+                    f"parameter '{inp._name}' has shape {tuple(have)} "
+                    f"but op '{node._op}' ({node._name}) requires "
+                    f"{tuple(want)} given data shape {in_shapes[0]}",
+                    node=f"{node._name}/{inp._name}",
+                    hint=f"declare '{inp._name}' with shape "
+                         f"{tuple(want)} or fix the layer config")
+
+
+def eval_shape_cross_check(ctx):
+    """Whole-graph ``jax.eval_shape`` over the real op bodies vs the
+    inference pass — a desync means symbol/infer.py and the executable
+    semantics have drifted (GV103). Runs only when every argument shape
+    resolved (full information)."""
+    import jax
+
+    from ..ndarray import NDArray
+
+    if ctx.var_shapes is None or ctx.out_shapes is None:
+        return
+    if any(s is None for s in ctx.out_shapes):
+        return
+    symbol = ctx.symbol
+    names = symbol.list_arguments() + symbol.list_auxiliary_states()
+    shapes = [ctx.var_shapes.get(n) for n in names]
+    if any(s is None for s in shapes):
+        return  # partial info: nothing sound to compare
+    specs = [jax.ShapeDtypeStruct(tuple(s), onp.float32) for s in shapes]
+
+    def g(*vals):
+        from .. import autograd
+
+        with autograd.pause():
+            feed = {n: NDArray(v) for n, v in zip(names, vals)}
+            out = symbol._eval_nodes(feed, {})
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o.data for o in outs)
+
+    try:
+        observed = jax.eval_shape(g, *specs)
+    except Exception:
+        return  # bodies needing non-float inputs etc.: not comparable
+    inferred = [tuple(s) for s in ctx.out_shapes]
+    if len(observed) != len(inferred):
+        return  # head-view flattening differs; pairwise compare unsound
+    for i, (obs, inf) in enumerate(zip(observed, inferred)):
+        if tuple(obs.shape) != inf:
+            ctx.report.emit(
+                "GV103",
+                f"output {i}: inference pass says {inf} but the op "
+                f"bodies produce {tuple(obs.shape)}",
+                node=ctx.heads()[min(i, len(ctx.heads()) - 1)]._name,
+                hint="symbol/infer.py has drifted from the op "
+                     "registry — file a framework bug")
+
+
+# ---------------------------------------------------------------------------
+# dtype pass
+
+def dtype_pass(ctx):
+    from ..symbol.infer import infer_types
+
+    declared = ctx.declared_dtypes()
+    known = dict(declared)
+    for name, dt in ctx.known_dtypes.items():
+        if name in declared and declared[name] != onp.dtype(dt):
+            ctx.report.emit(
+                "GV102",
+                f"variable '{name}' is declared {declared[name]} but "
+                f"bound as {onp.dtype(dt)}",
+                node=name,
+                hint="fix the Variable(dtype=...) declaration or cast "
+                     "the bound array")
+        known[name] = onp.dtype(dt)
+    try:
+        var_types, _ = infer_types(ctx.symbol, known)
+    except Exception as e:
+        ctx.report.emit("GV102", f"dtype inference failed: {e}")
+        return
+    for name, want in declared.items():
+        have = var_types.get(name)
+        if have is not None and onp.dtype(have) != onp.dtype(want):
+            ctx.report.emit(
+                "GV102",
+                f"variable '{name}' is declared {want} but inference "
+                f"assigns {have}",
+                node=name,
+                hint="insert an explicit cast or fix the declaration")
+
+
+# ---------------------------------------------------------------------------
+# structure pass: duplicate names + dead outputs
+
+def structure_pass(ctx):
+    # duplicate names: tojson() keys nodes by name — two distinct nodes
+    # sharing one silently collapse on save/load round-trip
+    by_name = {}
+    for node in ctx.nodes():
+        if node._name is None:
+            continue
+        prev = by_name.get(node._name)
+        if prev is not None and ctx.node_key(prev) != ctx.node_key(node):
+            ctx.report.emit(
+                "GV403",
+                f"two distinct nodes share the name '{node._name}' "
+                f"(ops: {prev._op or 'variable'} and "
+                f"{node._op or 'variable'})",
+                node=node._name,
+                hint="name symbols uniquely; serialization merges "
+                     "same-named nodes")
+        else:
+            by_name[node._name] = node
+
+    # dead outputs: output k of a multi-output node that no consumer
+    # reads and that is not exposed as a head
+    consumed = {}  # node_key -> set(output indices read)
+    for s in ctx.symbol._walk():
+        if s._group is not None:
+            continue
+        for inp in s._inputs:
+            consumed.setdefault(ctx.node_key(inp), set()).add(
+                inp._output_index)
+    live_heads = {}
+    for h in ctx.heads():
+        key = ctx.node_key(h)
+        n_out = getattr(h, "_num_outputs", 1) or 1
+        if n_out > 1 and h._output_index == 0 and h._op is not None:
+            # a bare multi-output head exposes ALL its outputs
+            # (list_outputs); a view head exposes only its index
+            live_heads.setdefault(key, set()).update(range(n_out))
+        else:
+            live_heads.setdefault(key, set()).add(h._output_index)
+    for node in ctx.nodes():
+        if node._op is None:
+            continue
+        n_out = getattr(node, "_num_outputs", 1) or 1
+        if n_out <= 1:
+            continue
+        key = ctx.node_key(node)
+        live = consumed.get(key, set()) | live_heads.get(key, set())
+        dead = sorted(set(range(n_out)) - live)
+        if dead:
+            ctx.report.emit(
+                "GV401",
+                f"op '{node._op}' ({node._name}) computes {n_out} "
+                f"outputs but outputs {dead} are never consumed",
+                node=node._name,
+                hint="drop the unused outputs (e.g. fewer split "
+                     "sections) or consume them")
+
+
+PASSES = {
+    "shape": shape_pass,
+    "eval_shape": eval_shape_cross_check,
+    "dtype": dtype_pass,
+    "structure": structure_pass,
+}
+
+#: default pipeline order — shape first (eval_shape consumes its result)
+DEFAULT_PIPELINE = ("shape", "eval_shape", "dtype", "structure")
+
+
+def run_passes(ctx, passes=None):
+    for name in (passes or DEFAULT_PIPELINE):
+        PASSES[name](ctx)
+    return ctx.report
+
+
+def verify_symbol(symbol, shapes=None, dtypes=None, passes=None,
+                  subject=None):
+    """Run the verifier pipeline over a Symbol DAG; returns the
+    ``DiagnosticReport`` (not yet dispositioned — call ``.disposition()``
+    to apply the MXNET_GRAPH_VERIFY mode)."""
+    ctx = PassContext(symbol, shapes=shapes, dtypes=dtypes,
+                      subject=subject or getattr(symbol, "_name", None))
+    return run_passes(ctx, passes)

@@ -1,0 +1,106 @@
+"""Sharding-consistency checks for the SPMD layer.
+
+GSPMD accepts almost any sharding and silently falls back to
+replication-with-reshards when a spec doesn't divide a dimension — the
+program still runs, just slower, and the asymmetry is invisible until a
+profile. These checks make the contract explicit at bind time
+(reference analog: the reference validated device placement eagerly in
+``DataParallelExecutorGroup`` — batch size divisible by the ctx list,
+executor_group.py:282):
+
+- GV502: shardings built against different Mesh objects mixed in one
+  program (collectives would disagree on the axis universe);
+- GV501: a PartitionSpec naming an axis the mesh doesn't have, a dim
+  index out of range for the array's rank, or a sharded dimension not
+  divisible by the product of its mesh axis sizes.
+"""
+from __future__ import annotations
+
+from .diagnostics import DiagnosticReport
+
+__all__ = ["verify_shardings"]
+
+
+def _spec_entries(spec):
+    """PartitionSpec -> list of (dim, (axis names...)) for sharded dims."""
+    out = []
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        out.append((dim, tuple(axes)))
+    return out
+
+
+def verify_shardings(shapes, shardings, mesh=None, subject=None):
+    """Check {name: shape} against {name: NamedSharding | PartitionSpec}.
+
+    Raw ``PartitionSpec`` values are checked against ``mesh`` (required
+    for them) — this is what lets ``shard_params`` validate user rules
+    *before* ``NamedSharding`` construction turns a bad axis name into a
+    bare ValueError. ``mesh`` otherwise pins the expected mesh; with
+    NamedShardings and no ``mesh``, the first sharding's mesh is the
+    reference. Returns the (undispositioned) DiagnosticReport.
+    """
+    report = DiagnosticReport(subject=subject or "shardings")
+    ref_mesh = mesh
+    for name in shardings:
+        sh = shardings[name]
+        this_mesh = getattr(sh, "mesh", None)
+        spec = getattr(sh, "spec", sh)  # NamedSharding or raw spec
+        if this_mesh is None:
+            this_mesh = ref_mesh
+            if this_mesh is None:
+                continue  # raw spec without a mesh: nothing to check
+        if ref_mesh is None:
+            ref_mesh = this_mesh
+        elif this_mesh is not ref_mesh and \
+                dict(getattr(this_mesh, "shape", {})) != \
+                dict(getattr(ref_mesh, "shape", {})):
+            report.emit(
+                "GV502",
+                f"'{name}' is sharded over mesh "
+                f"{dict(this_mesh.shape)} but the program's mesh is "
+                f"{dict(ref_mesh.shape)}",
+                node=name,
+                hint="build every sharding from the same make_mesh() "
+                     "result")
+            continue
+        shape = shapes.get(name)
+        if shape is None:
+            continue
+        shape = tuple(shape)
+        axis_sizes = dict(this_mesh.shape)
+        for dim, axes in _spec_entries(spec):
+            unknown = [a for a in axes if a not in axis_sizes]
+            if unknown:
+                report.emit(
+                    "GV501",
+                    f"'{name}' dim {dim} is sharded over axis "
+                    f"{unknown[0]!r} but the mesh axes are "
+                    f"{sorted(axis_sizes)}",
+                    node=name,
+                    hint="fix the PartitionSpec axis name")
+                continue
+            if dim >= len(shape):
+                report.emit(
+                    "GV501",
+                    f"'{name}' has rank {len(shape)} but its "
+                    f"PartitionSpec shards dim {dim}",
+                    node=name,
+                    hint="the spec has more entries than the array has "
+                         "dimensions")
+                continue
+            total = 1
+            for a in axes:
+                total *= axis_sizes[a]
+            if total and shape[dim] % total != 0:
+                report.emit(
+                    "GV501",
+                    f"'{name}' dim {dim} has size {shape[dim]}, not "
+                    f"divisible by the {'x'.join(axes)} mesh extent "
+                    f"{total}",
+                    node=name,
+                    hint=f"pad dim {dim} to a multiple of {total} or "
+                         "reshape the mesh")
+    return report

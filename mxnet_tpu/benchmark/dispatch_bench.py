@@ -76,7 +76,8 @@ def run(smoke=False, iters=None, shape=None, out_path=None):
     w = nd.ones((k, k))
     b = nd.ones((k,))
 
-    prev = os.environ.get("MXNET_EAGER_JIT")
+    # raw save/restore of the user's setting (not a knob READ):
+    prev = os.environ.get("MXNET_EAGER_JIT")  # graft-lint: allow(L101)
     results = {}
     try:
         for label, record in (("nograd", False), ("recorded", True)):

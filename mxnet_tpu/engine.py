@@ -51,9 +51,11 @@ class Engine:
         if _native.englib is None:
             raise RuntimeError("native engine library unavailable")
         self._lib = _native.englib
-        nthreads = nthreads or int(os.environ.get(
-            "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4))
-        nlanes = nlanes or int(os.environ.get("MXNET_ENGINE_NUM_LANES", 2))
+        from . import env as _env
+
+        nthreads = nthreads or _env.get_int(
+            "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4)
+        nlanes = nlanes or _env.get_int("MXNET_ENGINE_NUM_LANES", 2)
         self._h = self._lib.eng_create_lanes(int(nthreads), int(nlanes))
         self._nlanes = int(nlanes)
         self._lock = threading.Lock()
@@ -61,13 +63,20 @@ class Engine:
         self._live_cbs = {}  # op_id -> (callback, ctx) keepalive
 
     def new_variable(self):
-        return _Var(self._lib.eng_new_var(self._h))
+        h = self._h  # snapshot: close() may null the attr concurrently
+        if h is None:  # closed: inline mode needs no real deps
+            return _Var(-1)
+        return _Var(self._lib.eng_new_var(h))
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              lane=LANE_COMPUTE):
         """Schedule fn() after its deps; returns the op id. An exception
         in fn poisons `mutable_vars` and surfaces at wait_for_var."""
+        if self._h is None:  # closed (atexit shutdown): run inline
+            fn()
+            return -1
         holder = {}
+        inline = False
 
         def run(_ctx):
             try:
@@ -90,29 +99,42 @@ class Engine:
         # free a trampoline the worker may still call
         writer_ids = frozenset(v.id for v in mutable_vars)
         with self._lock:
-            op_id = self._lib.eng_push_lane(
-                self._h, ctypes.cast(cb, ctypes.c_void_p), None, cv,
-                len(const_vars), mv, len(mutable_vars), int(priority),
-                int(lane))
-            holder["op_id"] = op_id
-            # keepalive carries the op's WRITER var set so wait_for_var
-            # can GC it: after the wait returns, every writer of that var
-            # has completed AND its trampoline frame has returned (the
-            # native engine marks completion after the callback returns),
-            # so steady-state pipelines (IO iterators, nd.save) don't
-            # grow _live_cbs unboundedly between wait_all barriers
-            self._live_cbs[op_id] = (cb, writer_ids)
+            if self._h is None:
+                # close() swapped the handle between the unlocked check
+                # above and here — fall through to inline execution
+                # rather than hand NULL to the native library
+                inline = True
+            else:
+                op_id = self._lib.eng_push_lane(
+                    self._h, ctypes.cast(cb, ctypes.c_void_p), None, cv,
+                    len(const_vars), mv, len(mutable_vars),
+                    int(priority), int(lane))
+                holder["op_id"] = op_id
+                # keepalive carries the op's WRITER var set so
+                # wait_for_var can GC it: after the wait returns, every
+                # writer of that var has completed AND its trampoline
+                # frame has returned (the native engine marks completion
+                # after the callback returns), so steady-state pipelines
+                # (IO iterators, nd.save) don't grow _live_cbs
+                # unboundedly between wait_all barriers
+                self._live_cbs[op_id] = (cb, writer_ids)
+        if inline:
+            fn()
+            return -1
         return op_id
 
     def wait_for_var(self, v):
         """Block until all ops touching v finish; re-raise its poison."""
+        h = self._h  # snapshot: close() may null the attr concurrently
+        if h is None:
+            return
         # snapshot BEFORE the barrier: an op pushed concurrently with the
         # wait may still be running when it returns — only ops registered
         # before the wait are provably done (same rule as wait_all)
         with self._lock:
             dead = [oid for oid, (_, var_ids) in self._live_cbs.items()
                     if v.id in var_ids]
-        err_op = self._lib.eng_wait_for_var(self._h, v.id)
+        err_op = self._lib.eng_wait_for_var(h, v.id)
         # those ops have completed and their trampolines returned
         # (Complete runs after op->fn) — drop the keepalives
         with self._lock:
@@ -126,16 +148,22 @@ class Engine:
             raise RuntimeError(f"engine op {err_op} failed")
 
     def wait_all(self):
+        h = self._h  # snapshot: close() may null the attr concurrently
+        if h is None:
+            return
         # snapshot BEFORE the barrier: a concurrent push() racing with the
         # barrier's return may register a new callback whose op is still
         # in flight — only ops pushed before the barrier are provably done
         with self._lock:
             done_ids = list(self._live_cbs)
-        self._lib.eng_wait_all(self._h)
+        self._lib.eng_wait_all(h)
         self._gc_callbacks(done_ids)
 
     def var_version(self, v):
-        return int(self._lib.eng_var_version(self._h, v.id))
+        h = self._h  # snapshot: close() may null the attr concurrently
+        if h is None:
+            return 0
+        return int(self._lib.eng_var_version(h, v.id))
 
     def num_live_callbacks(self):
         with self._lock:
@@ -151,9 +179,36 @@ class Engine:
             for op_id in done_ids:
                 self._live_cbs.pop(op_id, None)
 
+    def close(self):
+        """Drain in-flight ops and join the native worker pool.
+        Idempotent; after close() pushes run inline (NaiveEngine-style)
+        so late callers (atexit hooks, iterator teardown) stay correct.
+
+        The handle swap happens under the push lock (a racing push
+        re-checks and goes inline), but the drain runs OUTSIDE it —
+        in-flight callbacks take the same lock to record exceptions, so
+        holding it through eng_wait_all would deadlock. getattr guards:
+        __del__ may see a half-constructed instance whose __init__
+        raised before _h/_lock were assigned."""
+        lock = getattr(self, "_lock", None)
+        if lock is None:
+            return
+        with lock:
+            h = getattr(self, "_h", None)
+            self._h = None
+        if h is None:
+            return
+        try:
+            self._lib.eng_wait_all(h)
+            self._lib.eng_destroy(h)
+        except Exception:
+            pass
+        with lock:
+            self._live_cbs.clear()
+
     def __del__(self):
         try:
-            self._lib.eng_destroy(self._h)
+            self.close()
         except Exception:
             pass
 
@@ -221,7 +276,9 @@ def get():
     global _engine
     with _engine_lock:
         if _engine is None:
-            etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            from . import env as _env
+
+            etype = _env.get_str("MXNET_ENGINE_TYPE", "ThreadedEngine")
             if etype == "NaiveEngine":
                 _engine = NaiveEngine()
             else:
@@ -246,3 +303,25 @@ def wait_for_var(v):
 
 def wait_all():
     get().wait_all()
+
+
+def _shutdown_at_exit():
+    """Join the native worker pool BEFORE interpreter teardown.
+
+    Without this, a process exiting with decode/IO ops still in flight
+    tears down the Python runtime while a native worker is inside (or
+    about to enter) a ctypes callback trampoline — an intermittent
+    teardown segfault first seen in the train_imagenet_rec example
+    subprocess (tests/test_examples_rec.py). atexit runs while Python is
+    fully alive: drain every op, join the threads, and flip the engine
+    to inline mode so any later atexit hook that pushes still runs."""
+    global _engine
+    with _engine_lock:
+        eng = _engine
+    if eng is not None and isinstance(eng, Engine):
+        eng.close()
+
+
+import atexit  # noqa: E402
+
+atexit.register(_shutdown_at_exit)

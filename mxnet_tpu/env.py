@@ -14,7 +14,7 @@ import logging
 import os
 
 __all__ = ["KNOBS", "describe", "check", "get_int", "get_float",
-           "get_bool", "markdown_table"]
+           "get_bool", "get_str", "markdown_table"]
 
 # name -> (status, consumer, description)
 KNOBS = {
@@ -87,6 +87,17 @@ KNOBS = {
         "executable. Donation deletes the old buffer — only enable when "
         "no tape node / detach() snapshot still references it. "
         "Optimizer state and loss-scale state are always donated"),
+    "MXNET_GRAPH_VERIFY": (
+        "wired", "analysis",
+        "static graph verifier: 0 (default, off) | warn (log "
+        "diagnostics) | error (raise GraphVerifyError). Gates "
+        "verify-on-bind (executor), verify-on-hybridize (gluon), "
+        "donation/aliasing guards (dispatch + fused-step caches) and "
+        "SPMD sharding checks; see docs/ANALYSIS.md"),
+    "MXNET_TEST_SEED": (
+        "wired", "test_utils",
+        "fixed seed for test_utils.set_default_context/seeded test "
+        "reruns (tools/flakiness_checker.py sets it per trial)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),
@@ -185,6 +196,12 @@ def get_bool(name, default=False):
     if v is None:
         return default
     return v not in ("0", "false", "False", "")
+
+
+def get_str(name, default=None):
+    """String knob read (the one blessed raw-env accessor: graft_lint
+    flags direct os.environ reads of MXNET_* names outside this module)."""
+    return os.environ.get(name, default)
 
 
 def describe():

@@ -46,6 +46,29 @@ class Executor:
         self._fwd_jit = None
         self._label_names = [n for n in self.arg_names
                              if n.endswith("label")]
+        self._verify_on_bind()
+
+    def _verify_on_bind(self):
+        """MXNET_GRAPH_VERIFY-gated static verification of the bound
+        graph (the analog of the reference's bind-time attribute passes,
+        infer_graph_attr_pass.cc, run as diagnostics instead of
+        CHECKs): bound arg/aux shapes+dtypes are the known set, and the
+        full pipeline (shape cross-check, eval_shape desync, dtype,
+        structure) dispositions per the mode."""
+        from . import analysis
+
+        if analysis.verify_mode() == "off":
+            return
+        shapes, dtypes = {}, {}
+        for n, a in zip(self.arg_names + self.aux_names,
+                        self.arg_arrays + self.aux_arrays):
+            if a is not None:
+                shapes[n] = tuple(a.shape)
+                dtypes[n] = a.dtype
+        analysis.verify_symbol(
+            self._symbol, shapes=shapes, dtypes=dtypes,
+            subject=f"bind:{self._symbol._name or 'symbol'}"
+        ).disposition()
 
     @property
     def arg_dict(self):

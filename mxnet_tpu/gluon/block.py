@@ -530,6 +530,23 @@ class HybridBlock(Block):
     def _build_cache(self):
         self._cached_op = CachedOp(self, **self._cached_op_args)
 
+    def _verify_on_hybridize(self, args):
+        """MXNET_GRAPH_VERIFY-gated trace verification before the first
+        CachedOp build: one paused eager forward is recorded
+        (analysis.record_trace) and the dataflow passes — PRNG key
+        reuse, use-after-donate, dead values — disposition per the mode.
+        Runs once per cache build, never on the hot path."""
+        from .. import analysis
+
+        if analysis.verify_mode() == "off":
+            return
+        try:
+            report = analysis.verify_block_call(
+                self, args, subject=f"hybridize:{self.name}")
+        except DeferredInitializationError:
+            return  # params not yet shaped; CachedOp's own pass inits
+        report.disposition()
+
     def infer_shape(self, *args):
         """Finish deferred param init from example inputs."""
         with autograd.pause():
@@ -546,6 +563,7 @@ class HybridBlock(Block):
                 and not getattr(self, "_op_hooks_active", 0):
             if all(isinstance(a, NDArray) for a in args):
                 if self._cached_op is None:
+                    self._verify_on_hybridize(args)
                     self._build_cache()
                 for hook in list(self._forward_pre_hooks):
                     hook(self, args)

@@ -60,7 +60,9 @@ _url_format = "{repo_url}gluon/models/{file_name}.zip"
 
 
 def data_dir():
-    return os.environ.get(
+    from ... import env as _env
+
+    return _env.get_str(
         "MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"))
 
 
@@ -99,7 +101,9 @@ def get_model_file(name, root=None):
               "detected. Downloading again.")
     os.makedirs(root, exist_ok=True)
     zip_path = os.path.join(root, file_name + ".zip")
-    url = _url_format.format(repo_url=os.environ.get(
+    from ... import env as _env
+
+    url = _url_format.format(repo_url=_env.get_str(
         "MXNET_GLUON_REPO", apache_repo_url), file_name=file_name)
     try:
         import urllib.request

@@ -274,6 +274,16 @@ class Trainer:
                 "wd_host": None, "wd_dev": None,
                 "rescale_host": None, "rescale_dev": None}
         work = cache["work"]
+        if donate_params:
+            # MXNET_GRAPH_VERIFY-gated: donating parameter buffers while
+            # a tape node still holds them as saved primals means the
+            # next backward reads deleted memory (analysis/donation.py).
+            # Checked before the host count mirror advances so an
+            # =error raise leaves the optimizer state untouched.
+            from ..analysis import check_param_donation
+
+            check_param_donation(
+                [(p.name, p._ndarray._data) for p in params])
         st = self._ensure_fused_state(scaler)
 
         # host update-count mirror advances like the eager path (on AMP

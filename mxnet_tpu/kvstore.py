@@ -55,8 +55,10 @@ class KVStore:
         # device group (the analog of splitting big arrays across
         # ps-lite servers, reference kvstore_dist.h
         # MXNET_KVSTORE_BIGARRAY_BOUND)
-        self._bigarray_bound = int(
-            os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+        from . import env as _env
+
+        self._bigarray_bound = _env.get_int(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", 1000000)
         # dist_async: pushes apply on a background thread (non-blocking
         # push, eventual consistency — the property async mode exists
         # for). Cross-process collectives can't be safely reordered onto
@@ -505,10 +507,10 @@ def create(name="local"):
     if name not in _VALID:
         raise MXNetError(f"unknown kvstore type {name}")
     kv = KVStore(name)
-    gc_type = os.environ.get("MXNET_KVSTORE_GC_TYPE")
-    if gc_type:
-        from . import env as _env
+    from . import env as _env
 
+    gc_type = _env.get_str("MXNET_KVSTORE_GC_TYPE")
+    if gc_type:
         kv.set_gradient_compression({
             "type": gc_type,
             "threshold": _env.get_float("MXNET_KVSTORE_GC_THRESHOLD",

@@ -57,41 +57,51 @@ for _n, _f in _UNARY.items():
 
 @register()
 def rsqrt(data):
+    """Elementwise 1/sqrt(x) (reference: elemwise_unary_op_basic.cc rsqrt)."""
     return lax.rsqrt(data)
 
 
 @register()
 def rcbrt(data):
+    """Elementwise 1/cbrt(x) (reference: elemwise_unary_op_basic.cc rcbrt)."""
     return 1.0 / jnp.cbrt(data)
 
 
 @register(name="gamma")
 def _gamma_fn(data):
+    """Elementwise gamma function Γ(x) (reference: special_functions-inl.h)."""
     return jnp.exp(jax.scipy.special.gammaln(data))
 
 
 @register()
 def relu(data):
+    """Rectified linear unit max(x, 0) (reference: activation-inl.h kReLU)."""
     return jnp.maximum(data, 0)
 
 
 @register()
 def sigmoid(data):
+    """Logistic sigmoid 1/(1+exp(-x)) (reference: activation-inl.h
+    kSigmoid)."""
     return jax.nn.sigmoid(data)
 
 
 @register()
 def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid clip(alpha*x + beta, 0, 1) (reference:
+    elemwise_unary_op_basic.cc hard_sigmoid)."""
     return jnp.clip(alpha * data + beta, 0.0, 1.0)
 
 
 @register()
 def softsign(data):
+    """Elementwise x/(1+|x|) (reference: activation-inl.h kSoftSign)."""
     return data / (1 + jnp.abs(data))
 
 
 @register()
 def cast(data, dtype):
+    """Cast to ``dtype`` (reference: elemwise_unary_op_basic.cc Cast)."""
     from .ndarray import _canon_dtype
 
     return data.astype(_canon_dtype(dtype))
@@ -126,6 +136,7 @@ def amp_multicast(*data, num_outputs=0):
 
 @register()
 def clip(data, a_min=None, a_max=None):
+    """Clamp values into [a_min, a_max] (reference: matrix_op.cc clip)."""
     return jnp.clip(data, a_min, a_max)
 
 
@@ -196,6 +207,9 @@ def _scalar_pair(name, fn, cast_bool=True):
         return r
 
     op.__name__ = name
+    op.__doc__ = (f"Scalar form of {name.replace('_scalar', '')} "
+                  "(reference: elemwise_binary_scalar_op*.cc; `reverse` "
+                  "swaps the operand order for r-ops).")
     register(name)(op)
 
 
@@ -242,12 +256,20 @@ for _n, _f in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
                "max": jnp.max, "min": jnp.min}.items():
     _make_reduce(_n, _f)
 
-register("sum_axis")(lambda data, axis=None, keepdims=False:
-                     jnp.sum(data, axis=_norm_axis(axis, data.ndim), keepdims=keepdims))
+def _sum_axis(data, axis=None, keepdims=False):
+    """Legacy alias of sum over ``axis`` (reference: broadcast_reduce_op
+    sum_axis)."""
+    return jnp.sum(data, axis=_norm_axis(axis, data.ndim),
+                   keepdims=keepdims)
+
+
+register("sum_axis")(_sum_axis)
 
 
 @register()
 def norm(data, ord=2, axis=None, keepdims=False):
+    """Matrix/vector norm over ``axis`` with MXNet ord semantics
+    (reference: broadcast_reduce_norm_value.cc)."""
     ax = _norm_axis(axis, data.ndim)
     if ord == 1:
         return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
@@ -256,17 +278,23 @@ def norm(data, ord=2, axis=None, keepdims=False):
 
 @register()
 def argmax(data, axis=None, keepdims=False):
+    """Index of the maximum along ``axis``, returned as float32 like the
+    reference (reference: broadcast_reduce_op_index.cc)."""
     r = jnp.argmax(data, axis=axis, keepdims=keepdims)
     return r.astype(jnp.float32)
 
 
 @register()
 def argmin(data, axis=None, keepdims=False):
+    """Index of the minimum along ``axis``, returned as float32 like the
+    reference (reference: broadcast_reduce_op_index.cc)."""
     return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
 
 
 @register()
 def mean_all(data):
+    """Scalar mean over all elements (reference: mean_all in
+    broadcast_reduce_op)."""
     return jnp.mean(data)
 
 
@@ -340,11 +368,15 @@ def reshape(data, shape=None, reverse=False):
 
 @register()
 def flatten(data):
+    """Collapse all axes after the first into one (reference: matrix_op.cc
+    Flatten)."""
     return jnp.reshape(data, (data.shape[0], -1))
 
 
 @register()
 def transpose(data, axes=None):
+    """Permute axes (default: full reversal) (reference: matrix_op.cc
+    transpose)."""
     if axes is not None and len(axes) == 0:
         axes = None
     return jnp.transpose(data, axes)
@@ -352,28 +384,37 @@ def transpose(data, axes=None):
 
 @register()
 def swapaxes(data, dim1=0, dim2=1):
+    """Exchange two axes (reference: swapaxis.cc SwapAxis)."""
     return jnp.swapaxes(data, dim1, dim2)
 
 
 @register()
 def expand_dims(data, axis):
+    """Insert a size-1 axis at ``axis`` (reference: matrix_op.cc
+    expand_dims)."""
     return jnp.expand_dims(data, axis)
 
 
 @register()
 def squeeze(data, axis=None):
+    """Drop size-1 axes (all, or just ``axis``) (reference: matrix_op.cc
+    squeeze)."""
     return jnp.squeeze(data, axis)
 
 
 @register()
 def broadcast_to(data, shape):
     # mxnet allows 0 meaning "keep this dim"
+    """Broadcast to ``shape``; 0 keeps the input extent (reference:
+    broadcast_reduce_op_value.cc broadcast_to)."""
     shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
     return jnp.broadcast_to(data, shape)
 
 
 @register()
 def broadcast_axis(data, axis=(), size=()):
+    """Broadcast size-1 ``axis`` to ``size`` (reference:
+    broadcast_reduce_op_value.cc broadcast_axis)."""
     if isinstance(axis, int):
         axis, size = (axis,), (size,)
     tgt = list(data.shape)
@@ -398,6 +439,8 @@ def argmax_channel(data):
 
 @register(name="slice")
 def _slice(data, begin, end, step=None):
+    """Region slice with begin/end/step per axis, None = full extent
+    (reference: matrix_op-inl.h Slice)."""
     idx = []
     for i in range(len(begin)):
         st = None if step is None else step[i]
@@ -411,6 +454,8 @@ def builtins_slice(b, e, s):
 
 @register()
 def slice_axis(data, axis, begin, end):
+    """Slice [begin, end) along one axis; None end = to the end (reference:
+    matrix_op.cc slice_axis)."""
     idx = [slice(None)] * data.ndim
     if end is None:
         end = data.shape[axis]
@@ -420,6 +465,8 @@ def slice_axis(data, axis, begin, end):
 
 @register()
 def slice_like(data, shape_like, axes=()):
+    """Slice to shape_like's extents along ``axes`` (reference:
+    matrix_op.cc slice_like)."""
     axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
     idx = [slice(None)] * data.ndim
     for a in axes:
@@ -429,16 +476,20 @@ def slice_like(data, shape_like, axes=()):
 
 @register()
 def concat(*args, dim=1):
+    """Join arrays along ``dim`` (reference: concat.cc Concat)."""
     return jnp.concatenate(args, axis=dim)
 
 
 @register()
 def stack(*args, axis=0):
+    """Stack arrays along a NEW ``axis`` (reference: matrix_op.cc stack)."""
     return jnp.stack(args, axis=axis)
 
 
 @register()
 def split(data, num_outputs, axis=1, squeeze_axis=False):
+    """Split into ``num_outputs`` equal parts along ``axis``; squeeze_axis
+    drops the split axis (reference: slice_channel.cc)."""
     parts = jnp.split(data, num_outputs, axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
@@ -447,6 +498,8 @@ def split(data, num_outputs, axis=1, squeeze_axis=False):
 
 @register()
 def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """Split at sections or explicit indices (reference: matrix_op.cc
+    split_v2)."""
     parts = jnp.split(data, indices_or_sections, axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
@@ -455,23 +508,35 @@ def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
 
 @register()
 def tile(data, reps):
+    """Repeat the whole array ``reps`` times per axis (reference:
+    matrix_op.cc tile)."""
     return jnp.tile(data, reps)
 
 
 @register()
 def repeat(data, repeats, axis=None):
+    """Repeat each element ``repeats`` times along ``axis`` (reference:
+    matrix_op.cc repeat)."""
     return jnp.repeat(data, repeats, axis=axis)
 
 
 @register()
 def reverse(data, axis=0):
+    """Reverse element order along ``axis`` (reference: matrix_op.cc
+    reverse)."""
     if isinstance(axis, int):
         axis = (axis,)
     return jnp.flip(data, axis=axis)
 
 
-register("flip")(lambda data, axis=0: jnp.flip(
-    data, axis=(axis,) if isinstance(axis, int) else tuple(axis)))
+def _flip(data, axis=0):
+    """Reverse along ``axis`` (reference: matrix_op.cc reverse alias
+    flip)."""
+    return jnp.flip(data,
+                    axis=(axis,) if isinstance(axis, int) else tuple(axis))
+
+
+register("flip")(_flip)
 
 
 @register()
@@ -486,11 +551,15 @@ def pad(data, mode="constant", pad_width=(), constant_value=0.0):
 
 @register(name="where")
 def _where(condition, x, y):
+    """Select x where condition is nonzero else y; 1-D condition selects
+    batch rows (reference: control_flow_op.cc where)."""
     return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
 
 
 @register()
 def diag(data, k=0):
+    """Extract the k-th diagonal / build a diagonal matrix (reference:
+    diag_op.cc)."""
     if data.ndim == 1:
         return jnp.diag(data, k)
     return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
@@ -498,11 +567,15 @@ def diag(data, k=0):
 
 @register(name="zeros_like")
 def _zeros_like_op(data):
+    """Zeros with the input's shape and dtype (reference:
+    elemwise_unary_op_basic.cc zeros_like)."""
     return jnp.zeros_like(data)
 
 
 @register(name="ones_like")
 def _ones_like_op(data):
+    """Ones with the input's shape and dtype (reference:
+    elemwise_unary_op_basic.cc ones_like)."""
     return jnp.ones_like(data)
 
 
@@ -510,33 +583,56 @@ def _ones_like_op(data):
 def shape_array(data):
     # int64 per the reference contract when x64 is on; int32 otherwise
     # (shapes fit, and requesting int64 would just warn-and-truncate)
+    """The input's shape as a 1-D int64 array (reference: matrix_op.cc
+    shape_array)."""
     dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     return jnp.asarray(data.shape, dtype=dt)
 
 
 @register()
 def size_array(data):
+    """The input's element count as a 1-element int64 array (reference:
+    matrix_op.cc size_array)."""
     dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     return jnp.asarray([data.size], dtype=dt)
 
 
 @register()
 def identity(data):
+    """Pass the input through unchanged (reference:
+    elemwise_unary_op_basic.cc _copy)."""
     return data
 
 
-register("stop_gradient")(lambda data: lax.stop_gradient(data))
-register("BlockGrad", namespaces=("nd",))(lambda data: lax.stop_gradient(data))
+def _stop_gradient(data):
+    """Identity forward, zero gradient (reference: elemwise_unary_op
+    BlockGrad)."""
+    return lax.stop_gradient(data)
+
+
+register("stop_gradient")(_stop_gradient)
+register("BlockGrad", namespaces=("nd",))(_stop_gradient)
+
 
 # literal-shaped constants backing sym.zeros / sym.ones graph nodes
-register("_sym_zeros", differentiable=False, namespaces=())(
-    lambda shape=None, dtype="float32": jnp.zeros(tuple(shape), dtype))
-register("_sym_ones", differentiable=False, namespaces=())(
-    lambda shape=None, dtype="float32": jnp.ones(tuple(shape), dtype))
+def _sym_zeros_body(shape=None, dtype="float32"):
+    """Literal-shaped zeros constant node (sym.zeros)."""
+    return jnp.zeros(tuple(shape), dtype)
+
+
+def _sym_ones_body(shape=None, dtype="float32"):
+    """Literal-shaped ones constant node (sym.ones)."""
+    return jnp.ones(tuple(shape), dtype)
+
+
+register("_sym_zeros", differentiable=False, namespaces=())(_sym_zeros_body)
+register("_sym_ones", differentiable=False, namespaces=())(_sym_ones_body)
 
 
 @register()
 def depth_to_space(data, block_size):
+    """Rearrange channel blocks into spatial blocks, NCHW (reference:
+    depth_to_space op in matrix_op.cc)."""
     n, c, h, w = data.shape
     b = block_size
     x = data.reshape(n, b, b, c // (b * b), h, w)
@@ -546,6 +642,8 @@ def depth_to_space(data, block_size):
 
 @register()
 def space_to_depth(data, block_size):
+    """Rearrange spatial blocks into channels, NCHW (reference:
+    space_to_depth op in matrix_op.cc)."""
     n, c, h, w = data.shape
     b = block_size
     x = data.reshape(n, c, h // b, b, w // b, b)
@@ -568,6 +666,8 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
 @register()
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matrix product over leading batch dims with optional
+    transposes (reference: dot.cc batch_dot)."""
     if transpose_a:
         lhs = jnp.swapaxes(lhs, -1, -2)
     if transpose_b:
@@ -577,11 +677,15 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
 @register(name="_matmul")
 def _matmul(lhs, rhs):
+    """numpy-semantics matmul with full broadcasting (reference:
+    np_matmul_op.cc)."""
     return jnp.matmul(lhs, rhs)
 
 
 @register()
 def khatri_rao(*args):
+    """Column-wise Khatri-Rao (Kronecker) product (reference: contrib
+    krprod.cc)."""
     out = args[0]
     for m in args[1:]:
         out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])

@@ -63,22 +63,28 @@ def normalize(data, mean=0.0, std=1.0):
 
 @register(name="image_flip_left_right")
 def flip_left_right(data):
+    """Flip the width axis of (..., H, W, C) images (reference:
+    image/image_random.cc)."""
     return jnp.flip(data, axis=-2)
 
 
 @register(name="image_flip_top_bottom")
 def flip_top_bottom(data):
+    """Flip the height axis of (..., H, W, C) images (reference:
+    image/image_random.cc)."""
     return jnp.flip(data, axis=-3)
 
 
 @register(name="image_random_flip_left_right", differentiable=False)
 def random_flip_left_right(data):
+    """Flip width with probability 1/2 (reference: image/image_random.cc)."""
     coin = jax.random.bernoulli(_key())
     return jnp.where(coin, jnp.flip(data, axis=-2), data)
 
 
 @register(name="image_random_flip_top_bottom", differentiable=False)
 def random_flip_top_bottom(data):
+    """Flip height with probability 1/2 (reference: image/image_random.cc)."""
     coin = jax.random.bernoulli(_key())
     return jnp.where(coin, jnp.flip(data, axis=-3), data)
 
@@ -118,21 +124,29 @@ def _unif(lo, hi):
 
 @register(name="image_random_brightness", differentiable=False)
 def random_brightness(data, min_factor=0.0, max_factor=0.0):
+    """Scale intensity by U(min_factor, max_factor) (reference:
+    image/image_random.cc)."""
     return _brightness(data, _unif(min_factor, max_factor))
 
 
 @register(name="image_random_contrast", differentiable=False)
 def random_contrast(data, min_factor=0.0, max_factor=0.0):
+    """Blend with the mean intensity by a U(min, max) factor (reference:
+    image/image_random.cc)."""
     return _contrast(data, _unif(min_factor, max_factor))
 
 
 @register(name="image_random_saturation", differentiable=False)
 def random_saturation(data, min_factor=0.0, max_factor=0.0):
+    """Blend with the per-pixel gray value by a U(min, max) factor
+    (reference: image/image_random.cc)."""
     return _saturation(data, _unif(min_factor, max_factor))
 
 
 @register(name="image_random_hue", differentiable=False)
 def random_hue(data, min_factor=0.0, max_factor=0.0):
+    """Rotate hue via the YIQ transform by a U(min, max) factor (reference:
+    image/image_random.cc)."""
     return _hue(data, _unif(min_factor, max_factor))
 
 
@@ -162,11 +176,15 @@ def _adjust(data, a):
 
 @register(name="image_adjust_lighting")
 def adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """Add PCA-lighting noise with fixed ``alpha`` weights (reference:
+    image/image_random.cc AdjustLighting)."""
     return _adjust(data, alpha)
 
 
 @register(name="image_random_lighting", differentiable=False)
 def random_lighting(data, alpha_std=0.05):
+    """Add AlexNet-style PCA lighting noise, alpha ~ N(0, alpha_std)
+    (reference: image/image_random.cc RandomLighting)."""
     return _adjust(data, jax.random.normal(_key(), (3,)) * alpha_std)
 
 

@@ -26,6 +26,8 @@ def take(data, indices, axis=0, mode="clip"):
 
 @register()
 def take_along_axis(data, indices, axis=0):
+    """Gather values along ``axis`` at per-position ``indices`` (reference:
+    np_take_along_axis)."""
     return jnp.take_along_axis(data, indices.astype(jnp.int32), axis=axis)
 
 
@@ -122,12 +124,16 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
 
 @register()
 def sort(data, axis=-1, is_ascend=True):
+    """Sort values along ``axis``; is_ascend=False reverses (reference:
+    ordering_op.cc sort)."""
     out = jnp.sort(data, axis=axis)
     return out if is_ascend else jnp.flip(out, axis=axis)
 
 
 @register()
 def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    """Sorting indices along ``axis`` in the requested dtype (reference:
+    ordering_op.cc argsort)."""
     from .ndarray import _canon_dtype
 
     idx = jnp.argsort(data, axis=axis, stable=True)
@@ -138,6 +144,7 @@ def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
 
 @register()
 def shuffle(data):
+    """Random permutation of the first axis (reference: shuffle_op.cc)."""
     from .. import random as mxrandom
 
     key = mxrandom.next_key()
@@ -155,12 +162,16 @@ def histogram(data, bins=10, range=None, bin_cnt=None):
 
 @register()
 def unravel(data, shape=None):
+    """Flat indices -> coordinate rows for ``shape`` (reference: ravel.cc
+    unravel_index)."""
     idx = jnp.unravel_index(data.astype(jnp.int32), shape)
     return jnp.stack(idx).astype(data.dtype)
 
 
 @register()
 def ravel_multi_index(data, shape=None):
+    """Coordinate rows -> flat indices for ``shape`` (reference: ravel.cc
+    ravel_multi_index)."""
     idx = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
     return jnp.ravel_multi_index(idx, shape, mode="clip").astype(data.dtype)
 
@@ -169,11 +180,15 @@ def ravel_multi_index(data, shape=None):
 
 @register(name="_static_slice")
 def _static_slice(data, key=None):
+    """Basic-indexing kernel behind NDArray.__getitem__ for static keys
+    (reference: ndarray.py _get_nd_basic_indexing)."""
     return data[key]
 
 
 @register(name="_slice_take")
 def _slice_take(data, key=None):
+    """Advanced-indexing kernel: take rows by index array after a static
+    prefix (reference: ndarray.py advanced indexing)."""
     return data[key]
 
 

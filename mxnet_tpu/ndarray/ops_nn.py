@@ -274,6 +274,8 @@ def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
 
 @register()
 def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    """log(softmax(x)) along ``axis`` with optional temperature, computed
+    stably (reference: softmax.cc log_softmax)."""
     if dtype is not None:
         data = data.astype(jnp.dtype(dtype))
     if temperature is not None and temperature != 1.0:
@@ -283,6 +285,7 @@ def log_softmax(data, axis=-1, temperature=None, dtype=None):
 
 @register()
 def softmin(data, axis=-1):
+    """softmax of -x along ``axis`` (reference: softmax.cc softmin)."""
     return jax.nn.softmax(-data, axis=axis)
 
 
@@ -435,6 +438,8 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 @register()
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Mark a symbol as a loss head: forward is identity, backward seeds
+    gradient grad_scale (reference: make_loss.cc)."""
     return data
 
 
@@ -493,6 +498,8 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
 
 @register()
 def slice_channel(data, num_outputs, axis=1, squeeze_axis=False):
+    """Alias of split: partition ``axis`` into num_outputs parts
+    (reference: slice_channel.cc SliceChannel)."""
     parts = jnp.split(data, num_outputs, axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]

@@ -27,6 +27,8 @@ def _prep_grad(grad, rescale_grad, clip_gradient):
 @register(differentiable=False)
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                lazy_update=True):
+    """SGD step w -= lr * (rescale*clip(g) + wd*w) (reference:
+    optimizer_op.cc sgd_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     return weight - lr * (grad + wd * weight)
 
@@ -34,6 +36,8 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
 @register(differentiable=False)
 def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """SGD-with-momentum step; returns (w', mom') (reference:
+    optimizer_op.cc sgd_mom_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     mom_new = momentum * mom - lr * (grad + wd * weight)
     return weight + mom_new, mom_new
@@ -42,6 +46,8 @@ def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 @register(differentiable=False)
 def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov accelerated SGD step; returns (w', mom') (reference:
+    optimizer_op.cc nag_mom_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
     mom_new = momentum * mom + grad
     return weight - lr * (grad + momentum * mom_new), mom_new
@@ -51,6 +57,8 @@ def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
+    """Adam step over (mean, var) moments; returns (w', m', v') (reference:
+    optimizer_op.cc adam_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
     mean_new = beta1 * mean + (1 - beta1) * grad
     var_new = beta2 * var + (1 - beta2) * jnp.square(grad)
@@ -73,6 +81,8 @@ def adamw_update(weight, grad, mean, var, lr, eta=1.0, beta1=0.9, beta2=0.999,
 @register(differentiable=False)
 def rmsprop_update(weight, grad, n, lr, gamma1=0.9, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp step over the squared-grad accumulator n (reference:
+    optimizer_op.cc rmsprop_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
     n_new = (1 - gamma1) * jnp.square(grad) + gamma1 * n
     w_new = weight - lr * grad / jnp.sqrt(n_new + epsilon)
@@ -85,6 +95,8 @@ def rmsprop_update(weight, grad, n, lr, gamma1=0.9, epsilon=1e-8, wd=0.0,
 def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95, gamma2=0.9,
                        epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp (Graves/Alex) step with first-moment g and delta momentum
+    (reference: optimizer_op.cc rmspropalex_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
     n_new = (1 - gamma1) * jnp.square(grad) + gamma1 * n
     g_new = (1 - gamma1) * grad + gamma1 * g
@@ -99,6 +111,8 @@ def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95, gamma2=0.9,
 @register(differentiable=False)
 def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal step over (z, n) accumulators (reference:
+    optimizer_op.cc ftrl_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     n_new = n + jnp.square(grad)
     sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
@@ -113,6 +127,8 @@ def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
 @register(differentiable=False)
 def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0):
+    """signSGD step w -= lr * sign(g) (reference: optimizer_op.cc
+    signsgd_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     return weight - lr * (jnp.sign(grad) + wd * weight)
 
@@ -120,6 +136,8 @@ def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 @register(differentiable=False)
 def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum step: momentum then sign (reference: optimizer_op.cc
+    signum_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     mom_new = momentum * mom - (1 - momentum) * (grad + wd * weight)
     w_new = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
@@ -129,6 +147,8 @@ def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 @register(differentiable=False)
 def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    """FTML step over (d, v, z) state at step t (reference: optimizer_op.cc
+    ftml_update)."""
     grad = _prep_grad(grad, rescale_grad, clip_grad) + wd * weight
     v_new = beta2 * v + (1 - beta2) * jnp.square(grad)
     d_new = (1 - beta1 ** t) / lr * (
@@ -142,6 +162,8 @@ def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
 def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0):
+    """LAMB phase 1: bias-corrected Adam direction (no lr) (reference:
+    optimizer_op.cc lamb_update_phase1)."""
     grad = _prep_grad(grad, rescale_grad, clip_gradient)
     mean_new = beta1 * mean + (1 - beta1) * grad
     var_new = beta2 * var + (1 - beta2) * jnp.square(grad)
@@ -156,6 +178,8 @@ def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
 @register(differentiable=False)
 def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
                        upper_bound=-1.0):
+    """LAMB phase 2: trust-ratio (r1/r2) scaled weight update (reference:
+    optimizer_op.cc lamb_update_phase2)."""
     ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
     if lower_bound > 0:
         ratio = jnp.maximum(ratio, lower_bound)
@@ -345,6 +369,8 @@ def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
 @register(differentiable=False)
 def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: fp32 master update, half-precision weight
+    written back (reference: optimizer_op.cc mp_sgd_update)."""
     g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
     w32 = weight32 - lr * (g + wd * weight32)
     return w32.astype(weight.dtype), w32
@@ -354,6 +380,8 @@ def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True):
+    """Multi-precision SGD-momentum over the fp32 master copy (reference:
+    optimizer_op.cc mp_sgd_mom_update)."""
     g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
     mom_new = momentum * mom - lr * (g + wd * weight32)
     w32 = weight32 + mom_new
@@ -363,6 +391,8 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
 @register(differentiable=False)
 def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Multi-precision NAG over the fp32 master copy (reference:
+    optimizer_op.cc mp_nag_mom_update)."""
     g = _prep_grad(grad.astype(jnp.float32), rescale_grad,
                    clip_gradient) + wd * weight32
     mom_new = momentum * mom + g

@@ -28,36 +28,50 @@ def _dt(dtype):
 
 @register(differentiable=False)
 def random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw U(low, high) samples of ``shape`` (reference: sample_op.cc
+    uniform)."""
     return jax.random.uniform(_key(), shape, _dt(dtype), low, high)
 
 
 @register(differentiable=False)
 def random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw N(loc, scale^2) samples of ``shape`` (reference: sample_op.cc
+    normal)."""
     return jax.random.normal(_key(), shape, _dt(dtype)) * scale + loc
 
 
 @register(differentiable=False)
 def random_randint(low=0, high=1, shape=(1,), dtype="int32", ctx=None):
+    """Draw integers in [low, high) of ``shape`` (reference: sample_op.cc
+    randint)."""
     return jax.random.randint(_key(), shape, low, high, _dt(dtype))
 
 
 @register(differentiable=False)
 def random_exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw Exp(lam) samples of ``shape`` (reference: sample_op.cc
+    exponential)."""
     return jax.random.exponential(_key(), shape, _dt(dtype)) / lam
 
 
 @register(differentiable=False)
 def random_poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw Poisson(lam) samples of ``shape`` (reference: sample_op.cc
+    poisson)."""
     return jax.random.poisson(_key(), lam, shape).astype(_dt(dtype))
 
 
 @register(differentiable=False)
 def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw Gamma(alpha, beta) samples of ``shape`` (reference:
+    sample_op.cc gamma)."""
     return jax.random.gamma(_key(), alpha, shape, _dt(dtype)) * beta
 
 
 @register(differentiable=False)
 def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw NB(k, p) samples of ``shape`` (reference: sample_op.cc
+    negative_binomial)."""
     lam = jax.random.gamma(_key(), k, shape) * (1.0 - p) / p
     return jax.random.poisson(_key(), lam, shape).astype(_dt(dtype))
 
@@ -65,6 +79,8 @@ def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None):
 @register(differentiable=False)
 def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
                                          dtype="float32", ctx=None):
+    """Draw generalized NB(mu, alpha) samples via gamma-Poisson mixture
+    (reference: sample_op.cc)."""
     k = 1.0 / alpha
     p = k / (k + mu)
     lam = jax.random.gamma(_key(), k, shape) * (1.0 - p) / p
@@ -73,6 +89,8 @@ def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
 
 @register(differentiable=False)
 def random_gumbel(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Draw Gumbel(loc, scale) samples of ``shape`` (reference:
+    sample_op.cc gumbel)."""
     return jax.random.gumbel(_key(), shape, _dt(dtype)) * scale + loc
 
 
@@ -80,6 +98,8 @@ def random_gumbel(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
 
 @register(differentiable=False)
 def sample_uniform(low, high, shape=(), dtype="float32"):
+    """Per-row U(low_i, high_i) draws: one batch of samples per parameter
+    row (reference: multisample_op.cc)."""
     s = tuple(low.shape) + (tuple(shape) if shape else ())
     u = jax.random.uniform(_key(), s, _dt(dtype))
     ex = low.reshape(low.shape + (1,) * (len(s) - low.ndim))
@@ -89,6 +109,8 @@ def sample_uniform(low, high, shape=(), dtype="float32"):
 
 @register(differentiable=False)
 def sample_normal(mu, sigma, shape=(), dtype="float32"):
+    """Per-row N(mu_i, sigma_i^2) draws: one batch of samples per parameter
+    row (reference: multisample_op.cc)."""
     s = tuple(mu.shape) + (tuple(shape) if shape else ())
     z = jax.random.normal(_key(), s, _dt(dtype))
     ex = mu.reshape(mu.shape + (1,) * (len(s) - mu.ndim))

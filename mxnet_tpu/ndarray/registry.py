@@ -368,6 +368,14 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
             if a is out:
                 donate_slot = i
                 break
+        if donate_slot is not None:
+            # MXNET_GRAPH_VERIFY-gated donation safety: prove no tape
+            # node / second argument slot still aliases the buffer this
+            # dispatch would let XLA delete (analysis/donation.py)
+            from ..analysis import check_dispatch_donation
+
+            check_dispatch_donation(opdef.name, arr_args, donate_slot,
+                                    out)
     try:
         key = _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas,
                             wrap_cls, recording, donate_slot)

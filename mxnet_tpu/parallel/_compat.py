@@ -1,0 +1,37 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` around jax 0.5; this container pins jax
+0.4.37 where only the experimental path exists. The call signature
+(f, mesh=..., in_specs=..., out_specs=...) is identical across both
+homes, so one import-time fallback keeps every ``parallel/`` module —
+and the shard_map-dependent test files — running on either version.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map", "axis_size", "pvary"]
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (jax >= 0.5); the 0.4.x idiom is the constant-
+    folded ``psum(1, axis)``."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def pvary(x, axis_names):
+    """``lax.pvary`` marks a value device-varying for the newer
+    replication type system; 0.4.x has no such distinction — identity."""
+    try:
+        return jax.lax.pvary(x, axis_names)
+    except AttributeError:
+        return x

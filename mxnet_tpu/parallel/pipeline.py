@@ -18,6 +18,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from . import _compat
+from ._compat import shard_map as _shard_map
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -29,7 +32,7 @@ def _pp_local(stage_params, x, fn, n_micro, axis_name):
     stage dim of size 1 squeezed by the caller's spec); x: the full
     (replicated) batch (B, ...). Returns the pipelined output (B, ...).
     """
-    p = lax.axis_size(axis_name)
+    p = _compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B = x.shape[0]
     assert B % n_micro == 0, "batch must divide microbatches"
@@ -40,7 +43,7 @@ def _pp_local(stage_params, x, fn, n_micro, axis_name):
         try:
             return lax.pcast(v, (axis_name,), to="varying")
         except (AttributeError, TypeError):
-            return lax.pvary(v, (axis_name,))
+            return _compat.pvary(v, (axis_name,))
 
     state0 = _vary(jnp.zeros_like(mbs[0]))
     out0 = _vary(jnp.zeros_like(mbs))
@@ -111,6 +114,6 @@ def pipeline_apply(stage_fn, stage_params, x, mesh=None, axis_name="pp",
                          axis_name)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = _shard_map(local, mesh=mesh,
                        in_specs=(pspec, P()), out_specs=P())
     return fn(stage_params, x)

@@ -16,6 +16,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from ._compat import shard_map as _shard_map
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -95,7 +97,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", batch_axis=None,
     orig_sharding = getattr(qd, "sharding", None)
     relayout = orig_sharding is not None and \
         getattr(orig_sharding, "device_set", None) != sh.device_set
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_ring_attn_local, axis_name=axis_name,
                 sm_scale=float(sm_scale), causal=bool(causal)),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -68,7 +68,7 @@ def all_reduce(x, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _psum_over_workers(mesh):
-    from jax import shard_map
+    from ._compat import shard_map
 
     def reduce(g):
         return jax.lax.psum(g, "worker")
@@ -154,7 +154,7 @@ def group_all_reduce(values):
 
 @functools.lru_cache(maxsize=None)
 def _group_reduce_fn(mesh):
-    from jax import shard_map
+    from ._compat import shard_map
 
     def reduce(g):  # g: (1, ...) local shard
         return jax.lax.psum(g, "kvg")
@@ -179,17 +179,32 @@ def replicate(x, mesh):
 
 def shard_params(named_params, mesh, rules=None):
     """Compute a NamedSharding per parameter from {regex: PartitionSpec}
-    rules; unmatched params are replicated. Returns {name: sharding}."""
+    rules; unmatched params are replicated. Returns {name: sharding}.
+
+    Under ``MXNET_GRAPH_VERIFY`` the resolved specs are validated
+    against the mesh and the parameter shapes FIRST
+    (analysis.verify_shardings): a bad axis name or a non-dividing
+    sharded dim becomes a GV501 diagnostic naming the parameter, rather
+    than a bare NamedSharding ValueError or a silent GSPMD reshard."""
     rules = [(re.compile(k), v) for k, v in (rules or {}).items()]
-    out = {}
+    specs = {}
     for name, p in named_params.items():
         spec = P()
         for pat, s in rules:
             if pat.search(name):
                 spec = s if isinstance(s, P) else P(*s)
                 break
-        out[name] = NamedSharding(mesh, spec)
-    return out
+        specs[name] = spec
+    from ..analysis import verify_mode, verify_shardings
+
+    if verify_mode() != "off":
+        shapes = {name: tuple(p.shape)
+                  for name, p in named_params.items()
+                  if getattr(p, "shape", None) is not None}
+        verify_shardings(shapes, specs, mesh=mesh,
+                         subject="shard_params").disposition()
+    return {name: NamedSharding(mesh, spec)
+            for name, spec in specs.items()}
 
 
 def _make_optimizer(name, op):

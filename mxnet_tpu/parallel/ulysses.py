@@ -22,6 +22,8 @@ import jax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map as _shard_map
+
 __all__ = ["ulysses_attention"]
 
 def _ulysses_local(q, k, v, axis_name, sm_scale, causal):
@@ -74,7 +76,7 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", batch_axis=None,
     orig_sharding = getattr(qd, "sharding", None)
     relayout = orig_sharding is not None and \
         getattr(orig_sharding, "device_set", None) != sh.device_set
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_ulysses_local, axis_name=axis_name,
                 sm_scale=float(sm_scale), causal=bool(causal)),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -131,6 +131,18 @@ def fused_step_counters():
         return {}
 
 
+def graph_verify_counters():
+    """Static graph-verifier counters (graphs checked, diagnostics by
+    severity and code), live from mxnet_tpu.analysis. Zeros before the
+    first verification (MXNET_GRAPH_VERIFY gated)."""
+    try:
+        from .analysis import counters
+
+        return counters()
+    except Exception:
+        return {}
+
+
 def _record(domain, name, start_us, dur_us, cat="event", value=None,
             cached=None):
     with _lock:
@@ -175,6 +187,10 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(fused_step_counters().items()):
         payload["traceEvents"].append(
             {"name": f"fused_step/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
+    for cname, cval in sorted(graph_verify_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"graph_verify/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
     with open(fname, "w") as f:
         json.dump(payload, f)

@@ -41,6 +41,13 @@ def _detect():
         feats["FUSED_STEP"] = fused_step_enabled()
     except Exception:
         feats["FUSED_STEP"] = False
+    try:
+        from .analysis import verify_mode
+
+        # static graph verifier armed (MXNET_GRAPH_VERIFY, analysis/)
+        feats["GRAPH_VERIFY"] = verify_mode() != "off"
+    except Exception:
+        feats["GRAPH_VERIFY"] = False
     feats["DIST_KVSTORE"] = True  # jax.distributed collectives
     feats["INT64_TENSOR_SIZE"] = True
     feats["SIGNAL_HANDLER"] = True

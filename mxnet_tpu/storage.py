@@ -51,9 +51,11 @@ class Storage:
             # / _RESERVE steer the GPU pool; on TPU HBM belongs to PJRT,
             # so they steer this host pool — Round = pow2 buckets,
             # Naive = exact-size, Unpooled = plain malloc/free)
+            from . import env as _env
+
             strategy = {"Naive": 0, "Round": 1, "Unpooled": 2}.get(
-                os.environ.get("MXNET_GPU_MEM_POOL_TYPE", "Naive"), 0)
-            reserve = int(os.environ.get("MXNET_GPU_MEM_POOL_RESERVE", "0"))
+                _env.get_str("MXNET_GPU_MEM_POOL_TYPE", "Naive"), 0)
+            reserve = _env.get_int("MXNET_GPU_MEM_POOL_RESERVE", 0)
             cap = -1
             if reserve > 0:
                 try:  # keep at most (100-reserve)% of phys mem pooled
@@ -72,7 +74,9 @@ class Storage:
 
     def alloc(self, size):
         """→ handle with .ptr/.size (reference: Storage::Alloc)."""
-        if self._lib is not None and not os.environ.get(
+        from . import env as _env
+
+        if self._lib is not None and not _env.get_bool(
                 "MXNET_CPU_MEM_POOL_DISABLE"):
             ptr = self._lib.pool_alloc(self._h, int(size))
             if ptr:

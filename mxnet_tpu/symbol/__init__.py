@@ -391,6 +391,12 @@ def Variable(name=None, shape=None, dtype=None, init=None, **kwargs):
         s._attrs.update({k: str(v) for k, v in scope_attrs.items()})
     if shape is not None:
         s._attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        # declared dtype rides as an attr like __shape__ (reference JSON
+        # stores __dtype__ as a type index; a name is clearer and our
+        # loader keeps unknown dunder attrs verbatim) — the analysis
+        # layer cross-checks it against inferred/bound dtypes (GV102)
+        s._attrs["__dtype__"] = str(onp.dtype(dtype))
     return s
 
 

@@ -180,9 +180,11 @@ def with_seed(seed=None):
         def wrapper(*args, **kwargs):
             from . import random as mx_random
 
+            from . import env as _env
+
             this = seed if seed is not None else \
-                int(os.environ.get("MXNET_TEST_SEED",
-                                   onp.random.randint(0, 2**31)))
+                _env.get_int("MXNET_TEST_SEED",
+                             onp.random.randint(0, 2**31))
             onp.random.seed(this)
             mx_random.seed(this)
             try:

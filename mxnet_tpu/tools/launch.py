@@ -36,17 +36,28 @@ def init():
     the rendezvous must happen before/with it). Calling this explicitly
     is supported for scripts that import bare jax first. Returns True
     when the launcher env was present."""
-    coord = os.environ.get("MXNET_COORDINATOR")
+    from .. import env as _env
+
+    coord = _env.get_str("MXNET_COORDINATOR")
     if not coord:
         return False
     import jax
 
-    if not jax.distributed.is_initialized():
-        # rendezvous failures propagate — never run un-joined
+    from .. import _distributed_is_initialized
+
+    if not _distributed_is_initialized(jax):
+        # rendezvous failures propagate — never run un-joined, and never
+        # guess the rank (see mxnet_tpu.__init__._maybe_init_distributed)
+        nproc = _env.get_str("MXNET_NUM_PROCESSES")
+        pid = _env.get_str("MXNET_PROCESS_ID")
+        if nproc is None or pid is None:
+            raise RuntimeError(
+                "MXNET_COORDINATOR is set but MXNET_NUM_PROCESSES/"
+                "MXNET_PROCESS_ID are not — launch env is incomplete")
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ["MXNET_NUM_PROCESSES"]),
-            process_id=int(os.environ["MXNET_PROCESS_ID"]))
+            num_processes=int(nproc),
+            process_id=int(pid))
     return True
 
 

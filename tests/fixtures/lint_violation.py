@@ -1,0 +1,40 @@
+"""Seeded graft_lint violation fixture (NOT imported by the package).
+
+Each block below violates one lint invariant on purpose; the tier-1
+lint test asserts graft_lint flags every one of them. Keep this file
+OUTSIDE mxnet_tpu/ so ``python -m tools.graft_lint mxnet_tpu`` stays
+clean on the shipped tree.
+"""
+import os
+import time
+
+import jax
+import numpy as onp
+
+from mxnet_tpu.ndarray.registry import register
+
+
+def bad_env_reads():
+    # L101: direct environment read of an MXNET_* knob
+    a = os.environ.get("MXNET_EAGER_JIT", "1")
+    # L101 + L102: direct read of a knob that is not even registered
+    b = os.environ["MXNET_TOTALLY_BOGUS_KNOB"]
+    # L101 via os.getenv
+    c = os.getenv("MXNET_FUSED_STEP")
+    return a, b, c
+
+
+def registered_knob_check():
+    from mxnet_tpu import env
+
+    # L102: blessed helper, but the knob is not in KNOBS
+    return env.get_int("MXNET_NOT_A_REAL_KNOB", 3)
+
+
+@register("lint_fixture_bad_op")
+def lint_fixture_bad_op(data):  # L301: no docstring
+    t = time.perf_counter()           # L201: host clock in a jit body
+    seed = onp.random.randint(0, 7)   # L201: host numpy RNG
+    key = jax.random.PRNGKey(seed)    # L202: constant key baked in
+    print("tracing", t)               # L201: print in a jit body
+    return data + jax.random.uniform(key, data.shape)

@@ -1,0 +1,395 @@
+"""Golden tests for the static-analysis subsystem (mxnet_tpu.analysis).
+
+One seeded defect per diagnostic code, each caught under
+MXNET_GRAPH_VERIFY=error through the real integration point where
+possible (bind, dispatch cache, shard_params) — the acceptance contract
+of the analysis ISSUE: shape mismatch (GV101), dtype mismatch (GV102),
+use-after-donate (GV201), double donation (GV202), PRNG key reuse
+(GV301), dead node (GV401), duplicate name (GV403), sharding mismatch
+(GV501) / mesh mismatch (GV502)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, autograd, gluon, nd, sym
+from mxnet_tpu import random as mxrandom
+from mxnet_tpu.analysis import GraphVerifyError
+
+
+@pytest.fixture
+def verify_error(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "error")
+
+
+@pytest.fixture
+def verify_warn(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "warn")
+
+
+# ------------------------------------------------------------- GV101 ------
+
+def test_shape_mismatch_caught_on_bind(verify_error):
+    """Declared parameter shape contradicting the consuming layer's
+    requirement fails AT BIND with a diagnostic naming the parameter."""
+    data = sym.var("data")
+    w = sym.var("w_bad", shape=(10, 5))  # fc wants (8, 5)
+    net = sym.fully_connected(data, weight=w, num_hidden=8, name="fc")
+    with pytest.raises(GraphVerifyError) as ei:
+        net.simple_bind(data=(4, 5))
+    assert "GV101" in ei.value.report.codes()
+    assert any("w_bad" in (d.node or "") for d in ei.value.report)
+
+
+def test_shape_mismatch_bound_vs_declared(verify_error):
+    """A bound array disagreeing with the Variable(shape=...) declaration
+    is caught before any compilation."""
+    data = sym.var("data", shape=(2, 3))
+    net = sym.relu(data, name="r")
+    rep = analysis.verify_symbol(net, shapes={"data": (4, 3)})
+    assert "GV101" in rep.codes()
+    with pytest.raises(GraphVerifyError):
+        rep.disposition()
+
+
+def test_clean_graph_has_no_diagnostics(verify_error):
+    data = sym.var("data")
+    net = sym.fully_connected(data, num_hidden=8, name="fc_ok")
+    ex = net.simple_bind(data=(4, 5))  # must NOT raise
+    assert ex.forward()[0].shape == (4, 8)
+
+
+def test_shape_inference_failure_is_gv101(verify_error):
+    data = sym.var("data")
+    net = sym.split(data, num_outputs=3, name="sp3")  # axis 1 size 4: 4 % 3 != 0
+    rep = analysis.verify_symbol(net[0], shapes={"data": (6, 4)})
+    assert "GV101" in rep.codes()
+
+
+# ------------------------------------------------------------- GV102 ------
+
+def test_dtype_mismatch_declared_vs_bound(verify_error):
+    data = sym.var("data", dtype="int32")
+    net = sym.relu(data, name="r2")
+    rep = analysis.verify_symbol(net, shapes={"data": (2, 2)},
+                                 dtypes={"data": onp.float32})
+    assert "GV102" in rep.codes()
+    with pytest.raises(GraphVerifyError):
+        rep.disposition()
+
+
+# ------------------------------------------------------------- GV201 ------
+
+def test_use_after_donate_dispatch_guard(verify_error, monkeypatch):
+    """MXNET_EAGER_JIT_DONATE + a tape node still holding the out=
+    buffer: the dispatch cache's donation guard raises instead of
+    letting XLA delete a buffer backward will read."""
+    monkeypatch.setenv("MXNET_EAGER_JIT_DONATE", "1")
+    a = nd.ones((4,))
+    a.attach_grad()
+    with autograd.record():
+        b = a * a  # tape node holds a's buffer as a saved primal
+    with pytest.raises(GraphVerifyError) as ei:
+        nd.broadcast_add_scalar(a, scalar=1.0, out=a)
+    assert "GV201" in ei.value.report.codes()
+    # the tape is intact: backward still works
+    b.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * onp.ones(4))
+
+
+def test_use_after_donate_in_trace(verify_error):
+    """Trace front end: a snapshot alias read after an in-place op
+    rebound/donated the buffer."""
+    with analysis.record_trace("uad") as tr:
+        a = nd.ones((4,))
+        snap = nd.NDArray(a.data)  # aliases a's buffer
+        nd.broadcast_add_scalar(a, scalar=1.0, out=a)
+        z = snap + 1  # reads the donated buffer
+        tr.mark_outputs([z])
+    rep = analysis.verify_trace(tr, passes=("donation",))
+    assert "GV201" in rep.codes()
+    with pytest.raises(GraphVerifyError):
+        rep.disposition()
+
+
+def test_donation_guard_allows_clean_inplace(verify_error, monkeypatch):
+    monkeypatch.setenv("MXNET_EAGER_JIT_DONATE", "1")
+    a = nd.ones((4,))
+    for _ in range(3):  # no live aliases: donation is safe, no raise
+        nd.broadcast_add_scalar(a, scalar=1.0, out=a)
+    onp.testing.assert_allclose(a.asnumpy(), 4 * onp.ones(4))
+
+
+# ------------------------------------------------------------- GV202 ------
+
+def test_double_donation_synthetic_trace(verify_error):
+    tr = analysis.GraphTrace("dd")
+    tr.add("fused_axpy", inputs=(1, 2), outputs=(3,), donated=(1, 1))
+    rep = analysis.verify_trace(tr, passes=("donation",))
+    assert "GV202" in rep.codes()
+
+
+def test_fused_step_param_donation_guard(verify_error, monkeypatch):
+    """MXNET_FUSED_STEP_DONATE + a live tape referencing the parameters:
+    the fused step refuses to donate them out from under backward."""
+    monkeypatch.setenv("MXNET_FUSED_STEP_DONATE", "1")
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.ones((2, 4))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)  # tape cleared by backward: fine
+    with autograd.record():
+        loss2 = net(x).sum()
+    # backward NOT called: tape still holds the parameter buffers
+    with pytest.raises(GraphVerifyError) as ei:
+        trainer.step(2)
+    assert "GV201" in ei.value.report.codes()
+
+
+# ------------------------------------------------------------- GV301 ------
+
+def test_prng_key_reuse_detected(verify_error):
+    k = jax.random.PRNGKey(7)
+    with analysis.record_trace("keys") as tr:
+        with mxrandom.key_replayer([k, k]):
+            x1 = nd.random_uniform(shape=(3,))
+            x2 = nd.random_normal(shape=(3,))
+        tr.mark_outputs([x1, x2])
+    rep = analysis.verify_trace(tr, passes=("key_reuse",))
+    assert "GV301" in rep.codes()
+    with pytest.raises(GraphVerifyError):
+        rep.disposition()
+
+
+def test_distinct_keys_are_clean(verify_error):
+    with analysis.record_trace("keys2") as tr:
+        x1 = nd.random_uniform(shape=(3,))
+        x2 = nd.random_uniform(shape=(3,))
+        tr.mark_outputs([x1, x2])
+    assert analysis.verify_trace(tr, passes=("key_reuse",)).codes() == []
+
+
+def test_verify_does_not_shift_prng_stream(monkeypatch):
+    """Arming MXNET_GRAPH_VERIFY must not change the keys a seeded run
+    draws: the hybridize verification forward is throwaway, so the
+    global stream is restored after it."""
+    def seeded_draws(mode):
+        monkeypatch.setenv("MXNET_GRAPH_VERIFY", mode)
+        mx.random.seed(1234)
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(4), gluon.nn.Dropout(0.5))
+        net.initialize()
+        net.hybridize()
+        net(nd.ones((2, 3)))  # triggers (possibly verified) cache build
+        return nd.random_uniform(shape=(5,)).asnumpy()
+
+    off = seeded_draws("0")
+    on = seeded_draws("warn")
+    onp.testing.assert_array_equal(off, on)
+
+
+def test_verify_does_not_double_update_batchnorm_stats(monkeypatch):
+    """The throwaway verification forward must not mutate model state:
+    BatchNorm running stats after the first training step are identical
+    with verification on and off."""
+    def first_step_stats(mode):
+        monkeypatch.setenv("MXNET_GRAPH_VERIFY", mode)
+        mx.random.seed(5)
+        # explicit (identical) prefixes: the auto-name counters advance
+        # per process, so the two runs would otherwise disagree on
+        # parameter names
+        net = gluon.nn.Sequential(prefix="bnv_")
+        net.add(gluon.nn.Dense(4, prefix="bnv_d_"),
+                gluon.nn.BatchNorm(prefix="bnv_b_"))
+        net.initialize()
+        net.hybridize()
+        x = nd.array(onp.random.RandomState(0).randn(8, 3).astype("f"))
+        with autograd.record():
+            net(x).sum().backward()
+        stats = {name: p.data().asnumpy()
+                 for name, p in net.collect_params().items()
+                 if "running" in name or "moving" in name}
+        assert stats, "no BN stats found"
+        return stats
+
+    off = first_step_stats("0")
+    on = first_step_stats("warn")
+    for name in off:
+        onp.testing.assert_array_equal(off[name], on[name])
+
+
+def test_out_without_input_alias_is_not_donation(verify_error):
+    """out= to a fresh destination is a write, not a donation: a live
+    alias of the destination's OLD buffer must not trip GV201."""
+    with analysis.record_trace("w") as tr:
+        a, b = nd.ones((4,)), nd.ones((4,))
+        c = nd.zeros((4,))
+        view = nd.NDArray(c.data)  # alias of c's pre-write buffer
+        nd.broadcast_add(a, b, out=c)  # c is NOT an input: no donation
+        z = view + 1
+        tr.mark_outputs([z, c])
+    assert analysis.verify_trace(tr, passes=("donation",)).codes() == []
+
+
+def test_hybridize_verify_runs_clean_with_dropout(verify_error):
+    """verify-on-hybridize records a forward through a stochastic block;
+    a correctly key-split dropout emits nothing and behavior is intact."""
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    y = net(nd.ones((2, 3)))
+    assert y.shape == (2, 4)
+
+
+# ------------------------------------------------------------- GV401 ------
+
+def test_dead_outputs_detected(verify_error):
+    data = sym.var("data")
+    parts = sym.split(data, num_outputs=3, name="sp")
+    net = sym.relu(parts[0], name="keep")
+    rep = analysis.verify_symbol(net, shapes={"data": (6, 6)})
+    assert "GV401" in rep.codes()
+    (diag,) = rep.by_code("GV401")
+    assert "[1, 2]" in diag.message
+    with pytest.raises(GraphVerifyError):
+        rep.disposition()  # error mode raises on warnings too
+
+
+def test_consumed_outputs_are_live(verify_error):
+    data = sym.var("data")
+    parts = sym.split(data, num_outputs=2, name="sp2")
+    net = parts[0] + parts[1]
+    rep = analysis.verify_symbol(net, shapes={"data": (6, 6)})
+    assert rep.by_code("GV401") == []
+
+
+# ------------------------------------------------------------- GV403 ------
+
+def test_duplicate_node_names(verify_error):
+    a = sym.var("x")
+    n1 = sym.relu(a, name="same")
+    n2 = sym.sigmoid(n1, name="same")
+    rep = analysis.verify_symbol(n2, shapes={"x": (2, 2)})
+    assert "GV403" in rep.codes()
+
+
+# ------------------------------------------------------- GV501 / GV502 ----
+
+def test_sharding_mismatch_through_shard_params(verify_error):
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"dp": jax.device_count()})
+    params = {"w": nd.ones((5, 4))}  # 5 % 8 != 0
+    with pytest.raises(GraphVerifyError) as ei:
+        parallel.shard_params(params, mesh, rules={"w": ("dp", None)})
+    assert "GV501" in ei.value.report.codes()
+
+
+def test_sharding_unknown_axis(verify_error):
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"dp": jax.device_count()})
+    rep = analysis.verify_shardings({"w": (16, 4)}, {"w": P("tp")},
+                                    mesh=mesh)
+    assert "GV501" in rep.codes()
+
+
+def test_mesh_mismatch(verify_error):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    devs = jax.devices()
+    m1 = parallel.make_mesh({"dp": len(devs)})
+    m2 = parallel.make_mesh({"mp": 2}, devices=devs[:2])
+    rep = analysis.verify_shardings(
+        {"a": (16, 4), "b": (16, 4)},
+        {"a": NamedSharding(m1, P("dp")), "b": NamedSharding(m2, P("mp"))})
+    assert "GV502" in rep.codes()
+
+
+def test_valid_shardings_clean(verify_error):
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"dp": jax.device_count()})
+    params = {"w": nd.ones((16, 4)), "b": nd.ones((4,))}
+    sh = parallel.shard_params(params, mesh, rules={"^w$": ("dp", None)})
+    assert set(sh) == {"w", "b"}
+
+
+# --------------------------------------------------------- modes/surface --
+
+def test_warn_mode_logs_instead_of_raising(verify_warn, caplog):
+    data = sym.var("data", shape=(2, 3))
+    net = sym.relu(data, name="rw")
+    with caplog.at_level(logging.WARNING):
+        rep = analysis.verify_symbol(net, shapes={"data": (4, 3)})
+        rep.disposition()  # must NOT raise
+    assert any("GV101" in r.message for r in caplog.records)
+
+
+def test_off_mode_skips_verification(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "0")
+    data = sym.var("data")
+    w = sym.var("w_off", shape=(10, 5))
+    net = sym.fully_connected(data, weight=w, num_hidden=8, name="fc_off")
+    # bind must not verify (shape conflict would raise under =error)...
+    # but the conflicting declared shape DOES break real compilation, so
+    # only assert the verifier stayed out of the way at bind time
+    before = analysis.counters()["graphs_checked"]
+    try:
+        net.simple_bind(data=(4, 5))
+    except Exception:
+        pass
+    assert analysis.counters()["graphs_checked"] == before
+
+
+def test_counters_and_profiler_surface(verify_error):
+    from mxnet_tpu import profiler
+
+    before = analysis.counters()["graphs_checked"]
+    data = sym.var("data")
+    net = sym.relu(data, name="cnt")
+    analysis.verify_symbol(net, shapes={"data": (2, 2)}).disposition()
+    after = profiler.graph_verify_counters()
+    assert after["graphs_checked"] == before + 1
+
+
+def test_runtime_feature_flag(monkeypatch):
+    from mxnet_tpu import runtime
+
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "warn")
+    assert runtime.Features().is_enabled("GRAPH_VERIFY")
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "0")
+    assert not runtime.Features().is_enabled("GRAPH_VERIFY")
+
+
+def test_eval_shape_cross_check_runs_clean(verify_error):
+    """Full-information graphs run the eval_shape desync pass; on a
+    healthy registry it must agree with symbol/infer.py everywhere."""
+    data = sym.var("data")
+    h = sym.fully_connected(data, num_hidden=8, name="l1")
+    h = sym.Activation(h, act_type="relu", name="a1")
+    out = sym.fully_connected(h, num_hidden=3, name="l2")
+    rep = analysis.verify_symbol(out, shapes={"data": (4, 6)})
+    assert rep.by_code("GV103") == []
+
+
+def test_report_structure():
+    rep = analysis.DiagnosticReport("s")
+    d = rep.emit("GV101", "msg", node="n", hint="h")
+    assert d.severity == analysis.SEV_ERROR
+    assert rep.errors and not rep.warnings
+    assert "GV101" in repr(d) and "hint" in repr(d)
+    with pytest.raises(ValueError):
+        rep.emit("GV999", "nope")

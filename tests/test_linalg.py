@@ -70,8 +70,14 @@ def test_potrf_and_potri():
 
 
 def test_trmm():
-    A = onp.tril(rs.randn(4, 4)).astype("f") + 4 * onp.eye(4, dtype="f")
-    B = rs.randn(4, 5).astype("f")
+    # own RandomState: the module-level `rs` makes these operands depend
+    # on how many draws earlier tests consumed, and one such ordering
+    # lands on a marginal finite-difference comparison (rel err 1.2e-2
+    # vs rtol 1e-2). Local seeding keeps the operands identical no
+    # matter which subset of the file runs.
+    rs_local = onp.random.RandomState(7)
+    A = onp.tril(rs_local.randn(4, 4)).astype("f") + 4 * onp.eye(4, dtype="f")
+    B = rs_local.randn(4, 5).astype("f")
     out = nd.linalg.trmm(nd.array(A), nd.array(B), alpha=2.0)
     assert_almost_equal(out.asnumpy(), 2.0 * onp.tril(A) @ B, rtol=1e-4)
     out = nd.linalg.trmm(nd.array(A), nd.array(B.T), rightside=True)

@@ -1,0 +1,412 @@
+"""graft_lint — the framework's self-lint: AST-enforced invariants.
+
+The second front end of the static-analysis subsystem (the graph
+verifier in ``mxnet_tpu/analysis/`` proves USER graphs safe; this tool
+proves the FRAMEWORK itself keeps the invariants those proofs rest on).
+Stdlib-only AST checks, plus optional registry checks that import the
+package:
+
+``L101 env-read``      a literal ``MXNET_*`` environment variable read
+                       outside ``mxnet_tpu/env.py`` — every knob read
+                       must go through the env registry helpers
+                       (``env.get_int/float/bool/str``) so ``check()``
+                       and docs/ENV_VARS.md stay truthful.
+``L102 unknown-knob``  a literal ``MXNET_*`` name used anywhere that is
+                       not registered in ``env.KNOBS`` — an unregistered
+                       knob is invisible to the typo guard and the docs.
+``L201 jit-host-sync`` host-side effects inside a jit-compiled body
+                       (registered op bodies, ``fused_step`` executable
+                       builders, optimizer ``_fused_kernel`` closures):
+                       ``time.*``, ``os.environ``, numpy-RNG draws,
+                       ``.asnumpy()/.asscalar()/.wait_to_read()``,
+                       ``print``. Any of these either breaks tracing or
+                       bakes a host value into the executable.
+``L202 jit-prng``      ``jax.random.PRNGKey(...)`` inside a jit body —
+                       a constant seed baked into the trace replays ONE
+                       stream forever; keys must arrive pre-split from
+                       the ambient provider (``mxnet_tpu.random``).
+``L301 op-docstring``  a ``@register``-decorated op body without a
+                       docstring (AST form of the registry R301 check).
+``R301/R302/R303``     registry checks (``--registry``): every
+                       registered op carries a docstring; every op named
+                       in the dtype-rule tables of ``symbol/infer.py``
+                       and the structural tables of ``symbol/__init__``
+                       is actually registered; every registered op's
+                       output dtype is resolvable by ``_node_out_dtype``.
+
+Suppress a finding with a same-line pragma: ``# graft-lint: allow(L101)``.
+
+Usage::
+
+    python -m tools.graft_lint [paths...]     # default: mxnet_tpu
+    python -m tools.graft_lint --no-registry mxnet_tpu
+
+Exit status 0 iff no findings. Runs inside tier-1 via
+tests/test_graft_lint.py.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+ENV_MODULE = os.path.join("mxnet_tpu", "env.py")
+ENV_HELPERS = {"get_int", "get_float", "get_bool", "get_str"}
+
+
+class Finding:
+    def __init__(self, code, path, line, message):
+        self.code = code
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _literal_env_name(node):
+    """The literal MXNET_* string of an env access, if any."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("MXNET_"):
+        return node.value
+    return None
+
+
+def _is_os_environ(node):
+    """node is an ``environ`` expression — ``os.environ``, an aliased
+    ``_os.environ``, or a bare imported ``environ``."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" \
+            and isinstance(node.value, ast.Name):
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _dotted(node):
+    """'a.b.c' for an attribute chain over Names, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def load_registered_knobs(repo_root):
+    """KNOBS keys parsed out of mxnet_tpu/env.py without importing it."""
+    path = os.path.join(repo_root, ENV_MODULE)
+    try:
+        tree = ast.parse(open(path).read(), path)
+    except OSError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOBS" \
+                        and isinstance(node.value, ast.Dict):
+                    return {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)}
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-file checks
+
+class _Pragmas:
+    def __init__(self, source):
+        self._allow = {}
+        for i, line in enumerate(source.splitlines(), 1):
+            if "graft-lint:" in line:
+                frag = line.split("graft-lint:", 1)[1]
+                if "allow(" in frag:
+                    codes = frag.split("allow(", 1)[1].split(")")[0]
+                    self._allow[i] = {c.strip() for c in codes.split(",")}
+
+    def allows(self, line, code):
+        return code in self._allow.get(line, ())
+
+
+def check_env_discipline(path, tree, source, knobs, findings):
+    """L101 + L102 over one parsed file."""
+    is_env_module = path.replace(os.sep, "/").endswith("mxnet_tpu/env.py")
+    pragmas = _Pragmas(source)
+
+    def emit(code, node, msg):
+        if not pragmas.allows(node.lineno, code):
+            findings.append(Finding(code, path, node.lineno, msg))
+
+    for node in ast.walk(tree):
+        name = None
+        is_read = False
+        if isinstance(node, ast.Subscript) and _is_os_environ(node.value):
+            name = _literal_env_name(node.slice)
+            is_read = not isinstance(getattr(node, "ctx", None),
+                                     (ast.Store, ast.Del))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            dn = _dotted(fn)
+            if dn and (dn.endswith(".environ.get") or dn in
+                       ("environ.get", "os.getenv", "getenv")):
+                name = _literal_env_name(node.args[0]) if node.args \
+                    else None
+                is_read = True
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr in ENV_HELPERS and node.args:
+                # env.get_int("MXNET_X", ...) — blessed read; still
+                # requires the knob to be registered (L102)
+                name = _literal_env_name(node.args[0])
+                if name and knobs is not None and name not in knobs:
+                    emit("L102", node,
+                         f"env knob {name!r} is not registered in "
+                         "mxnet_tpu/env.py KNOBS")
+                continue
+            elif dn and (dn.endswith(".environ.pop") or
+                         dn.endswith(".environ.setdefault") or
+                         dn in ("environ.pop", "environ.setdefault")):
+                continue  # writes/clears are not knob reads
+        if name and is_read and not is_env_module:
+            emit("L101", node,
+                 f"direct environment read of {name!r}; use "
+                 "mxnet_tpu.env.get_int/get_float/get_bool/get_str")
+        if name and knobs is not None and name not in knobs:
+            emit("L102", node,
+                 f"env knob {name!r} is not registered in "
+                 "mxnet_tpu/env.py KNOBS")
+
+
+# -- jit-body scopes --------------------------------------------------------
+
+def _op_registry_names(tree):
+    """Local names that ``register`` from an op-registry module is bound
+    to in this file (``from .registry import register`` / ``from
+    mxnet_tpu.ndarray.registry import register``). Keeps the jit-scope
+    detection semantic — other ``register`` decorators (optimizer
+    classes, metric classes, embeddings) are not op bodies."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[-1] == "registry":
+            for a in node.names:
+                if a.name == "register":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _has_register_decorator(fn, reg_names=("register",)):
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = _dotted(target)
+        if dn and dn.split(".")[-1] in reg_names:
+            return True
+    return False
+
+
+def collect_jit_scopes(path, tree):
+    """[(FunctionDef, label)] whose bodies execute under jax.jit."""
+    norm = path.replace(os.sep, "/")
+    scopes = []
+    base = os.path.basename(norm)
+    in_ops_file = "/ndarray/" in norm and base.startswith("ops_")
+    reg_names = _op_registry_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if reg_names and _has_register_decorator(node, reg_names):
+            scopes.append((node, f"op '{node.name}'"))
+        elif in_ops_file and node.name == "op":
+            # factory-produced op bodies (_make_unary/_scalar_pair/...)
+            scopes.append((node, "factory op body"))
+        elif norm.endswith("gluon/fused_step.py"):
+            if node.name == "build_executable":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.FunctionDef) \
+                            and sub is not node:
+                        scopes.append(
+                            (sub, f"fused-step body '{sub.name}'"))
+        elif norm.endswith("optimizer/optimizer.py") \
+                and node.name == "_fused_kernel":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.FunctionDef) and sub is not node:
+                    scopes.append(
+                        (sub, f"fused kernel '{sub.name}'"))
+    # de-dup (nested walk may visit twice)
+    seen, out = set(), []
+    for fn, label in scopes:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, label))
+    return out
+
+
+_HOST_SYNC_CALLS = {"asnumpy", "asscalar", "wait_to_read",
+                    "block_until_ready", "item"}
+_TIME_MODULES = {"time", "_time"}
+_NP_MODULES = {"np", "onp", "numpy"}
+
+
+def check_jit_safety(path, tree, source, findings):
+    pragmas = _Pragmas(source)
+    seen = set()  # (code, line): nested Attribute walks hit chains twice
+
+    def emit(code, node, label, msg):
+        if pragmas.allows(node.lineno, code) or \
+            (code, node.lineno) in seen:
+            return
+        seen.add((code, node.lineno))
+        findings.append(
+            Finding(code, path, node.lineno, f"{msg} inside "
+                    f"jit-compiled {label}"))
+
+    for fn, label in collect_jit_scopes(path, tree):
+        for node in ast.walk(fn):
+            dn = _dotted(node) if isinstance(node, ast.Attribute) else None
+            if dn:
+                root, *rest = dn.split(".")
+                if root in _TIME_MODULES and rest:
+                    emit("L201", node, label,
+                         f"host clock access '{dn}'")
+                elif root in _NP_MODULES and rest \
+                        and rest[0] == "random":
+                    emit("L201", node, label,
+                         f"host numpy RNG '{dn}' (draws once at trace "
+                         "time)")
+                elif dn.startswith("os.environ"):
+                    emit("L201", node, label, "os.environ read")
+                elif dn == "jax.random.PRNGKey":
+                    emit("L202", node, label,
+                         "constant PRNGKey (un-split key baked into "
+                         "the trace); draw from the ambient provider")
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _HOST_SYNC_CALLS:
+                    emit("L201", node, label,
+                         f"host sync '.{f.attr}()'")
+                elif isinstance(f, ast.Name) and f.id == "print":
+                    emit("L201", node, label, "print()")
+
+
+def check_op_docstrings(path, tree, source, findings):
+    reg_names = _op_registry_names(tree)
+    if not reg_names:
+        return
+    pragmas = _Pragmas(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and _has_register_decorator(node, reg_names) \
+                and ast.get_docstring(node) is None \
+                and not pragmas.allows(node.lineno, "L301"):
+            findings.append(Finding(
+                "L301", path, node.lineno,
+                f"registered op '{node.name}' has no docstring"))
+
+
+# ---------------------------------------------------------------------------
+# registry checks (import the package)
+
+def registry_checks(findings):
+    """R301 doc coverage, R302 table consistency, R303 dtype-rule
+    resolvability — over the LIVE registry, so factory-generated ops
+    (whose docstrings the AST cannot see) are covered too."""
+    from mxnet_tpu.ndarray import registry as _registry
+    from mxnet_tpu.symbol import _AUTO_PARAMS, _AUX_INPUT_SLOTS
+    from mxnet_tpu.symbol.infer import (_FIXED_OUT_DTYPE,
+                                        _PARAM_DTYPE_DEFAULTS,
+                                        _node_out_dtype)
+
+    loc = "mxnet_tpu/ndarray/registry.py"
+    for name in _registry.list_ops():
+        opdef = _registry.get_op(name)
+        if not (opdef.doc or "").strip():
+            findings.append(Finding(
+                "R301", loc, 0,
+                f"registered op '{name}' has no docstring"))
+        try:
+            _node_out_dtype(name, {}, {})
+        except Exception as e:
+            findings.append(Finding(
+                "R303", "mxnet_tpu/symbol/infer.py", 0,
+                f"output dtype of op '{name}' is not resolvable: {e}"))
+    for table, where in ((_FIXED_OUT_DTYPE, "symbol/infer.py "
+                          "_FIXED_OUT_DTYPE"),
+                         (_PARAM_DTYPE_DEFAULTS, "symbol/infer.py "
+                          "_PARAM_DTYPE_DEFAULTS"),
+                         (_AUTO_PARAMS, "symbol/__init__ _AUTO_PARAMS"),
+                         (_AUX_INPUT_SLOTS, "symbol/__init__ "
+                          "_AUX_INPUT_SLOTS")):
+        for opname in table:
+            if _registry.get_op(opname) is None:
+                findings.append(Finding(
+                    "R302", "mxnet_tpu/symbol/infer.py", 0,
+                    f"{where} names unregistered op '{opname}'"))
+
+
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths, repo_root=None, registry=True):
+    repo_root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    knobs = load_registered_knobs(repo_root)
+    findings = []
+    want_registry = False
+    for path in iter_py_files(paths):
+        try:
+            source = open(path).read()
+            tree = ast.parse(source, path)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("L000", path, 0, f"unparseable: {e}"))
+            continue
+        check_env_discipline(path, tree, source, knobs, findings)
+        check_jit_safety(path, tree, source, findings)
+        check_op_docstrings(path, tree, source, findings)
+        if os.path.basename(path) == "registry.py":
+            want_registry = True
+    if registry and want_registry:
+        try:
+            registry_checks(findings)
+        except Exception as e:  # package not importable here: AST-only
+            findings.append(Finding(
+                "R000", "mxnet_tpu", 0,
+                f"registry checks skipped (import failed: {e})"))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="graft_lint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: mxnet_tpu)")
+    ap.add_argument("--no-registry", action="store_true",
+                    help="skip the import-based registry checks")
+    args = ap.parse_args(argv)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(repo_root, "mxnet_tpu")]
+    findings = lint_paths(paths, repo_root=repo_root,
+                          registry=not args.no_registry)
+    for f in findings:
+        print(f)
+    print(f"graft_lint: {len(findings)} finding(s) in "
+          f"{len(list(iter_py_files(paths)))} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
