@@ -71,7 +71,7 @@ def run_performance_test(op_fn, inputs, run_backward=True, dtype="float32",
         outs = out if isinstance(out, (list, tuple)) else [out]
         return sum(jnp.sum(o.data.astype(jnp.float32)) for o in outs)
 
-    fwd_jit = jax.jit(fwd)
+    fwd_jit = jax.jit(fwd)  # graft-lint: allow(jit-nocache)
     fwd_s = _time_fn(fwd_jit, datas, warmup, runs)
     result = {"op": name or getattr(op_fn, "__name__", "op"),
               "dtype": str(dtype),
@@ -98,7 +98,8 @@ def run_performance_test(op_fn, inputs, run_backward=True, dtype="float32",
                                 ds[i].astype(jnp.float32))
                        for g, i in zip(gs, argnums))
 
-        bwd_s = _time_fn(jax.jit(bwd_scalar), datas, warmup, runs)
+        bwd_s = _time_fn(jax.jit(bwd_scalar),  # graft-lint: allow(jit-nocache)
+                         datas, warmup, runs)
         result["fwd_bwd_ms"] = round(bwd_s * 1e3, 4)
     return result
 

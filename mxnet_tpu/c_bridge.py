@@ -17,6 +17,7 @@ import numpy as onp
 
 from . import ndarray as nd
 from .base import MXNetError
+from .utils import compile_cache as _cc
 from .ndarray import NDArray
 from .ndarray.ndarray import _TYPE_FLAG_TO_DTYPE, _DTYPE_TO_TYPE_FLAG
 
@@ -551,7 +552,7 @@ class CCachedOp:
                         return [x.data for x in o]
                     return o.data
 
-                fn = self._jitted[sig] = jax.jit(run)
+                fn = self._jitted[sig] = _cc.counting_jit(run, label="cached_op")
             res = fn([a.data for a in inputs], _mxrandom.next_key())
             out = [NDArray(r) for r in res] if isinstance(res, list) \
                 else NDArray(res)

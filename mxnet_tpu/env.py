@@ -98,6 +98,32 @@ KNOBS = {
         "wired", "test_utils",
         "fixed seed for test_utils.set_default_context/seeded test "
         "reruns (tools/flakiness_checker.py sets it per trial)"),
+    "MXNET_COMPILE_CACHE": (
+        "wired", "utils.compile_cache",
+        "persistent compile-artifact cache: on-disk second tier behind "
+        "the eager-dispatch and fused-step executable LRUs (serialized "
+        "AOT executables + jax persistent-cache fallback), so a warm "
+        "process start skips trace+XLA-compile; 0 disables (default 1)"),
+    "MXNET_COMPILE_CACHE_DIR": (
+        "wired", "utils.compile_cache",
+        "directory for the persistent compile cache (default "
+        "$MXNET_HOME/compile_cache); entries are keyed by op/graph "
+        "fingerprint + avals + donation + AMP version + "
+        "jax/jaxlib/backend/framework versions, corrupt or mismatched "
+        "entries are treated as misses and removed"),
+    "MXNET_COMPILE_CACHE_MAX_MB": (
+        "wired", "utils.compile_cache",
+        "size cap on the on-disk compile cache (default 1024); every "
+        "32nd write prunes oldest-used .mxc entries down to 80% of the "
+        "cap (load refreshes mtime). 0 = unbounded"),
+    "MXNET_SHAPE_BUCKETS": (
+        "wired", "ndarray.registry",
+        "automatic batch-axis shape bucketing for eager dispatch: "
+        "0 (default, off) | pow2 | mult:N. Whitelisted row-independent "
+        "ops are padded up to the bucket boundary before cache lookup "
+        "and outputs sliced back, so variable-length streams reuse a "
+        "few bucket executables instead of retracing per batch size "
+        "(see docs/COMPILE_CACHE.md)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
+from .utils import compile_cache as _cc
 
 __all__ = ["Executor"]
 
@@ -163,12 +164,14 @@ class Executor:
             outs = out if isinstance(out, (list, tuple)) else [out]
             return tuple(o.data for o in outs), aux_new
 
-        self._fwd_full_jit = jax.jit(fwd, static_argnums=(1,))
+        self._fwd_full_jit = _cc.counting_jit(fwd, label="executor_fwd_full",
+                                              static_argnums=(1,))
 
         def fwd_only(vals, train):
             return fwd(vals, train)[0]
 
-        self._fwd_jit = jax.jit(fwd_only, static_argnums=(1,))
+        self._fwd_jit = _cc.counting_jit(fwd_only, label="executor_fwd",
+                                         static_argnums=(1,))
 
         # loss-aware scalar function for backward
         def loss_fn(vals):
@@ -228,13 +231,15 @@ class Executor:
         # here ONE computation with batch inputs sharded over 'dp';
         # GSPMD inserts the gradient all-reduces the reference ran
         # through kvstore device comm)
-        self._grad_jit = jax.jit(jax.grad(loss_fn))
+        self._grad_jit = _cc.counting_jit(jax.grad(loss_fn),
+                                          label="executor_grad")
 
         def head_vjp(vals, cots):
             _, vjp_fn = jax.vjp(fwd_for_vjp, vals)
             return vjp_fn(cots)[0]
 
-        self._head_vjp_jit = jax.jit(head_vjp)
+        self._head_vjp_jit = _cc.counting_jit(head_vjp,
+                                              label="executor_head_vjp")
 
     # ---- data parallelism over a mesh -----------------------------------
     def _mesh(self):
@@ -350,7 +355,8 @@ class Executor:
                     outs.extend(o.data for o in seq)
             return tuple(outs)
 
-        self._mon_jit = jax.jit(mon_fwd, static_argnums=(1,))
+        self._mon_jit = _cc.counting_jit(mon_fwd, label="executor_monitor",
+                                         static_argnums=(1,))
 
     def _run_monitor(self, vals, is_train):
         cb = getattr(self, "_mon_cb", None)
@@ -427,6 +433,31 @@ class Executor:
                 garr._data = garr.data + g
             else:
                 garr._data = g
+
+    def warmup(self, is_train=None):
+        """Compile the forward (and, when bound for training, backward)
+        executables for the CURRENT buffer shapes without touching any
+        executor state: outputs are discarded, aux running stats and
+        gradient buffers are not written. One device execution on the
+        bound buffers is paid per executable — the price of warming
+        jit's real call cache (AOT ``lower().compile()`` would compile a
+        *separate* executable the later traced calls could not reuse).
+        ``BucketingModule.warmup_buckets`` drives this per bucket so all
+        buckets compile up front instead of mid-epoch."""
+        self._ensure_fwd()
+        if is_train is None:
+            is_train = self.grad_req != "null" and \
+                self.grad_arrays is not None
+        vals = self._place_vals(
+            [a.data for a in self.arg_arrays + self.aux_arrays],
+            self._val_shardings())
+        if is_train and self.aux_arrays:
+            self._fwd_full_jit(vals, True)
+        else:
+            self._fwd_jit(vals, bool(is_train))
+        if is_train and self.grad_arrays is not None and \
+                self.grad_req != "null":
+            self._grad_jit(vals)
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new shapes (reference: graph_executor.cc:876).

@@ -394,7 +394,10 @@ class CachedOp:
         # recomputes them during backward
         if env.get_bool("MXNET_BACKWARD_DO_MIRROR"):
             fn = jax.checkpoint(fn, static_argnums=(0, 1))
-        self._jitted = jax.jit(fn, static_argnums=(0, 1))
+        from ..utils import compile_cache as _cc
+
+        self._jitted = _cc.counting_jit(fn, label="cached_op",
+                                        static_argnums=(0, 1))
 
     def _ensure_params(self):
         if self._param_list is None:

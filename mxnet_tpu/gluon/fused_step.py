@@ -40,6 +40,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from ..utils import compile_cache as _cc
 from ..utils.lru import CountedLRUCache
 
 __all__ = ["fused_step_enabled", "fused_step_stats",
@@ -130,6 +131,18 @@ def state_data(s):
     return s.data
 
 
+def state_copy(s):
+    """Device COPIES of a state tree's buffers (shape of
+    ``state_data``). Snapshots that must survive a fused step need
+    copies, not refs: the step donates state buffers to XLA, which
+    deletes the originals."""
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(state_copy(x) for x in s)
+    return jnp.array(s.data, copy=True)
+
+
 def rebind_state(old, new):
     """Write the executable's output arrays back into the existing
     NDArray state objects (identity of ``trainer._states`` entries is
@@ -150,7 +163,54 @@ def has_tracer(arrays):
 # ---------------------------------------------------------------------------
 # executable builder
 
-def build_executable(kernel, mp_flags, scaler_cfg, donate_params):
+class _FusedEntry:
+    """LRU entry wrapping the fused-step executable with lazy disk-tier
+    resolution. The first call (or an explicit ``prepare()``) resolves
+    it: a serialized executable from a previous process is deserialized
+    (no trace, no XLA compile — the warm-start win), else the jitted
+    step is AOT-compiled once and written back for future processes.
+    Resolution failures degrade to the plain jit path — a corrupt or
+    stale cache entry must never break (or permanently eagerize) the
+    trainer's step loop."""
+
+    __slots__ = ("_jfn", "_call", "_fp")
+
+    def __init__(self, jfn, fp=None):
+        self._jfn = jfn
+        self._call = None
+        self._fp = fp
+
+    def prepare(self, args):
+        """Resolve without executing (``lower``/``compile`` only) —
+        ``Trainer.warmup`` precompiles through this, so warmup has no
+        side effects on parameters or optimizer state."""
+        if self._call is None:
+            self._resolve(args)
+
+    def _resolve(self, args):
+        if self._fp is not None:
+            loaded = _cc.disk_load(self._fp)
+            if loaded is not None:
+                self._call = _cc.GuardedCompiled(loaded[0], self._jfn)
+                return self._call
+            try:
+                compiled = _cc.aot_compile(self._jfn, *args)
+            except Exception:
+                self._call = self._jfn
+                return self._call
+            _cc.disk_store(self._fp, compiled)
+            self._call = _cc.GuardedCompiled(compiled, self._jfn)
+            return self._call
+        self._call = self._jfn
+        return self._call
+
+    def __call__(self, *args):
+        call = self._call or self._resolve(args)
+        return call(*args)
+
+
+def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
+                     cache_key=None):
     """One donated XLA executable for the whole weight-update phase.
 
     kernel(w, g, s, lr, wd, rescale, t) -> (w2, s2) is the optimizer's
@@ -237,4 +297,14 @@ def build_executable(kernel, mp_flags, scaler_cfg, donate_params):
             return jax.lax.cond(finite, do_apply, do_skip, None)
 
     donate = (0, 2, 3) if donate_params else (2, 3)
-    return jax.jit(step, donate_argnums=donate)
+    # fingerprint only when the disk tier is armed (MXNET_COMPILE_CACHE=0
+    # must mean the plain jit path, not a no-op GuardedCompiled layer),
+    # salted with the bytecode of the optimizer kernel AND this builder
+    # so editing either invalidates disk entries instead of serving the
+    # old update math
+    fp = _cc.fingerprint("fused_step", cache_key,
+                         code_of=(kernel, build_executable)) \
+        if cache_key is not None and _cc.cache_enabled() else None
+    return _FusedEntry(
+        _cc.counting_jit(step, label="fused_step", donate_argnums=donate),
+        fp)

@@ -238,32 +238,17 @@ class Trainer:
                 return False
             _fs._CACHE.note_hit()
         else:
-            work = [i for i, p in enumerate(self._params)
-                    if p.grad_req != "null"]
-            if not work:
+            group = self._fused_group(kernel_key, scaler_cfg,
+                                      donate_params)
+            if group == "empty":
                 return True  # nothing to update; eager loop no-ops too
-            params = [self._params[i] for i in work]
-            grads = [p.grad() for p in params]
-            if any(isinstance(g, _sp.BaseSparseNDArray) for g in grads) \
-                    or _fs.has_tracer([g.data for g in grads]):
+            if group is None:
                 _fs._CACHE.note_bypass()
                 return False
-            mp_flags = tuple(
-                bool(optim.multi_precision and optim._is_half(p.data()))
-                for p in params)
-            states = [self._states[i] for i in work]
-            sig = tuple(
-                (tuple(p.shape), str(p.data().data.dtype),
-                 str(g.data.dtype), _fs.state_sig(s))
-                for p, g, s in zip(params, grads, states))
-            key = (type(optim).__name__, kernel_key, mp_flags, sig,
-                   scaler_cfg, self._distributed, donate_params,
-                   _registry.amp_version())
-            entry = _fs._CACHE.lookup(key)
-            if entry is None:
-                entry = _fs.build_executable(kernel, mp_flags,
-                                             scaler_cfg, donate_params)
-                _fs._CACHE.insert(key, entry)
+            work, params = group["work"], group["params"]
+            grads, states = group["grads"], group["states"]
+            entry = self._fused_entry(group, kernel, scaler_cfg,
+                                      donate_params)
             self._fused = cache = {
                 "token": token, "states": self._states,
                 "nd_ids": tuple((id(p._ndarray), id(p._ndarray._grad))
@@ -329,6 +314,206 @@ class Trainer:
             p.data()._data = w2
         for s, s2 in zip(states, new_s):
             _fs.rebind_state(s, s2)
+        return True
+
+    def _fused_group(self, kernel_key, scaler_cfg, donate_params):
+        """Work set + LRU cache key for a fused step over the current
+        parameter group: a dict, the sentinel ``"empty"`` (nothing has
+        grad_req != null — the step is a no-op), or None (sparse or
+        tracer gradients force the eager path)."""
+        from ..ndarray import sparse as _sp
+        from ..ndarray import registry as _registry
+
+        optim = self._optimizer
+        work = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not work:
+            return "empty"
+        params = [self._params[i] for i in work]
+        grads = [p.grad() for p in params]
+        if any(isinstance(g, _sp.BaseSparseNDArray) for g in grads) \
+                or _fs.has_tracer([g.data for g in grads]):
+            return None
+        mp_flags = tuple(
+            bool(optim.multi_precision and optim._is_half(p.data()))
+            for p in params)
+        states = [self._states[i] for i in work]
+        sig = tuple(
+            (tuple(p.shape), str(p.data().data.dtype),
+             str(g.data.dtype), _fs.state_sig(s))
+            for p, g, s in zip(params, grads, states))
+        key = (type(optim).__name__, kernel_key, mp_flags, sig,
+               scaler_cfg, self._distributed, donate_params,
+               _registry.amp_version())
+        return {"work": work, "params": params, "grads": grads,
+                "states": states, "mp_flags": mp_flags, "key": key}
+
+    def _fused_entry(self, group, kernel, scaler_cfg, donate_params):
+        """The cached fused-step executable for a ``_fused_group`` —
+        ONE construction site shared by the step loop and warmup, so
+        both always build identical entries for a key."""
+        key = group["key"]
+        entry = _fs._CACHE.lookup(key)
+        if entry is None:
+            entry = _fs.build_executable(kernel, group["mp_flags"],
+                                         scaler_cfg, donate_params,
+                                         cache_key=key)
+            _fs._CACHE.insert(key, entry)
+        return entry
+
+    # -- AOT warmup ---------------------------------------------------------
+
+    def warmup(self, shapes=None, block=None):
+        """Precompile the training-path executables up front, so no
+        compile stall (or retrace storm) lands mid-epoch — with the
+        persistent compile cache armed (``MXNET_COMPILE_CACHE``), warm
+        processes pull the executables straight off disk instead of
+        compiling at all.
+
+        Without arguments: resolves the fused train-step executable for
+        the current parameter group via ``lower()``/``compile()`` only —
+        nothing executes, no state changes.
+
+        With ``block`` and ``shapes`` (an iterable of input shapes, one
+        per expected batch signature/bucket): additionally runs one full
+        forward/backward/``step`` per shape on zero inputs to warm every
+        executable on the training path (eager-dispatch entries,
+        hybridized CachedOp traces, the fused step), then restores
+        parameters, gradients, optimizer state, AMP loss-scale state and
+        the PRNG stream bit-for-bit, so training after ``warmup`` is
+        byte-identical to training without it. Two caveats: (1) when
+        deferred-init params materialize during warmup AND the forward
+        draws stochastic keys (dropout), the cold run would interleave
+        init and mask draws in one stream — that interleave cannot be
+        reproduced ahead of time, so initialize shapes (or run one
+        inference forward) first for strict parity; (2) warming shifts
+        which step is the first *compiled* execution of each recording
+        entry, which on fusion-sensitive graphs can differ from the
+        uncached first run by an ulp (same class of caveat as
+        BENCH_NOTES_r07). Best effort by design: executables keyed off
+        the real loss head still compile on first use. Returns the
+        number of shapes warmed."""
+        if (block is None) != (shapes is None):
+            # a half-specified call would silently warm NOTHING the
+            # caller asked for — the mid-epoch stall this API exists to
+            # prevent would land anyway
+            raise ValueError(
+                "Trainer.warmup needs BOTH shapes and block for the "
+                "full forward/backward/step warmup (got only "
+                f"{'shapes' if shapes is not None else 'block'}); call "
+                "warmup() with neither to AOT-resolve just the fused "
+                "step")
+        if block is None:
+            from .parameter import DeferredInitializationError
+
+            try:
+                self._warmup_fused()
+            except DeferredInitializationError:
+                pass  # shapes unknown until first forward: nothing to AOT
+            return 0
+        from .. import autograd, ndarray as nd, random as _mxrandom
+
+        shapes = [tuple(s) for s in shapes]
+        params = list(block.collect_params().values())
+        if shapes and any(p._ndarray is None for p in params):
+            # deferred-init params materialize on the first forward,
+            # drawing initializer keys from the global stream — run that
+            # forward NOW (grad/train modes off: no dropout draws, no BN
+            # stat updates) so the snapshot below lands post-init, the
+            # same stream position the first real forward would leave
+            with autograd.pause(train_mode=False):
+                block(nd.zeros(shapes[0]))
+            params = list(block.collect_params().values())
+        for p in self._params:
+            if p not in params:
+                params.append(p)
+        # device step-state is authoritative while fused stepping (loss
+        # scale, skip-drifted update count): pull it into the host
+        # mirrors FIRST, or the snapshots below would capture — and the
+        # restore would resurrect — stale pre-sync values
+        self._sync_fused_state()
+        self._invalidate_fused_state()
+        # param buffers are donated only under MXNET_FUSED_STEP_DONATE —
+        # copy then; refs suffice otherwise (jax arrays are immutable).
+        # Optimizer-state buffers are ALWAYS donated by the fused step,
+        # so their snapshot must be device copies (state_copy).
+        copy_params = _fs.donate_params_enabled()
+        snap_params = [(p,
+                        jnp.array(p._ndarray._data, copy=True)
+                        if copy_params else p._ndarray._data,
+                        None if p._ndarray._grad is None
+                        else p._ndarray._grad._data) for p in params
+                       if getattr(p, "_ndarray", None) is not None]
+        optim = self._optimizer
+        snap_optim = (optim.num_update, optim.begin_num_update,
+                      dict(optim._index_update_count))
+        if not self._states_created:
+            self._create_states()
+        snap_states = [_fs.state_copy(s) for s in self._states]
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        snap_scaler = None if scaler is None else \
+            (scaler._loss_scale, scaler._unskipped)
+        snap_skips = self._fused_skips_host
+        snap_key = _mxrandom._STATE.key
+        count = 0
+        try:
+            for shape in shapes:
+                x = nd.zeros(tuple(shape))
+                with autograd.record():
+                    y = block(x)
+                    outs = y if isinstance(y, (list, tuple)) else [y]
+                    loss = outs[0].sum()
+                    for o in outs[1:]:
+                        loss = loss + o.sum()
+                loss.backward()
+                self.step(batch_size=max(int(shape[0]), 1)
+                          if shape else 1)
+                count += 1
+        finally:
+            for p, data, grad in snap_params:
+                p._ndarray._data = data
+                if grad is not None and p._ndarray._grad is not None:
+                    p._ndarray._grad._data = grad
+            (optim.num_update, optim.begin_num_update, counts) = snap_optim
+            optim._index_update_count = counts
+            for s, data in zip(self._states, snap_states):
+                _fs.rebind_state(s, data)
+            if scaler is not None:
+                scaler._loss_scale, scaler._unskipped = snap_scaler
+            self._invalidate_fused_state()
+            self._fused_skips_host = snap_skips
+            _mxrandom._STATE.key = snap_key
+        return count
+
+    def _warmup_fused(self):
+        """Resolve (disk-load or AOT-compile) the fused-step executable
+        without executing it. No-op when the fused path cannot serve the
+        current parameter group."""
+        if not _fs.fused_step_enabled() or self._fused_broken:
+            return False
+        kern = self._optimizer._fused_kernel()
+        if kern is None:
+            return False
+        if not self._states_created:
+            self._create_states()
+        kernel_key, kernel = kern
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        scaler_cfg = None if scaler is None else \
+            (float(scaler._scale_factor), int(scaler._scale_window))
+        donate_params = _fs.donate_params_enabled()
+        group = self._fused_group(kernel_key, scaler_cfg, donate_params)
+        if group == "empty" or group is None:
+            return False
+        entry = self._fused_entry(group, kernel, scaler_cfg,
+                                  donate_params)
+        st = self._ensure_fused_state(scaler)
+        pv = tuple(p._ndarray._data for p in group["params"])
+        gv = tuple(g._data for g in group["grads"])
+        sv = tuple(_fs.state_data(s) for s in group["states"])
+        n = len(group["work"])
+        entry.prepare((pv, gv, sv, st["vals"],
+                       jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),
+                       jnp.float32(1.0)))
         return True
 
     # -- stepping -----------------------------------------------------------

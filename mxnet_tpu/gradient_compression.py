@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import MXNetError
+from .utils import compile_cache as _cc
 
 __all__ = ["GradientCompression"]
 
@@ -64,8 +65,10 @@ class GradientCompression:
             raise MXNetError("threshold must be positive")
         self.type = type
         self.threshold = float(threshold)
-        self._q = jax.jit(_quantize_2bit, static_argnames=())
-        self._dq = jax.jit(_dequantize_2bit, static_argnames=("n",))
+        self._q = _cc.counting_jit(_quantize_2bit, label="gc_quantize",
+                                   static_argnames=())
+        self._dq = _cc.counting_jit(_dequantize_2bit, label="gc_dequantize",
+                                    static_argnames=("n",))
 
     def get_compression_factor(self):
         return 16  # float32 -> 2 bits

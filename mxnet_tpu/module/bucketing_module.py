@@ -94,6 +94,33 @@ class BucketingModule(BaseModule):
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
 
+    def warmup_buckets(self, buckets, is_train=None):
+        """AOT-precompile every bucket up front instead of mid-epoch.
+
+        ``buckets`` is an iterable of ``(bucket_key, data_shapes)`` or
+        ``(bucket_key, data_shapes, label_shapes)``. Each bucket is
+        bound (sharing parameters with the default bucket, exactly like
+        ``switch_bucket``) and its executor compiled for the bucket's
+        shapes via ``Module.warmup`` — parameters, aux states and
+        gradients are untouched, and the module is switched back to the
+        bucket that was current on entry. With the persistent compile
+        cache armed, later processes pull these executables from jax's
+        on-disk cache instead of recompiling. Returns the number of
+        buckets warmed."""
+        assert self.binded and self.params_initialized
+        prev_key = self._curr_bucket_key
+        count = 0
+        for bucket in buckets:
+            key, data_shapes = bucket[0], bucket[1]
+            label_shapes = bucket[2] if len(bucket) > 2 else None
+            self.switch_bucket(key, data_shapes, label_shapes)
+            self._curr_module.warmup(is_train=is_train)
+            count += 1
+        if prev_key is not None and prev_key in self._buckets:
+            self._curr_module = self._buckets[prev_key]
+            self._curr_bucket_key = prev_key
+        return count
+
     def init_params(self, *args, **kwargs):
         self._curr_module.init_params(*args, **kwargs)
         self.params_initialized = True

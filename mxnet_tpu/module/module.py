@@ -250,6 +250,14 @@ class Module(BaseModule):
             self._exec.set_monitor_callback(mon)
 
     # ---- step ------------------------------------------------------------
+    def warmup(self, is_train=None):
+        """Precompile this module's executor for its bound shapes (see
+        ``Executor.warmup``) — no outputs, grads or aux states change."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._exec.warmup(is_train=is_train)
+
     def forward(self, data_batch, is_train=None):
         """Reference: module.py forward."""
         assert self.binded and self.params_initialized

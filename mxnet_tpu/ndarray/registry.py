@@ -22,6 +22,7 @@ import time as _time
 from .. import profiler as _prof
 
 from ..base import MXNetError
+from ..utils import compile_cache as _cc
 from ..utils.lru import CountedLRUCache
 
 _OPS = {}
@@ -214,14 +215,18 @@ def _freeze(v):
 
 
 class _CacheEntry:
-    __slots__ = ("jfn", "normalized", "n_keys", "recording", "donate")
+    __slots__ = ("jfn", "call", "normalized", "n_keys", "recording",
+                 "donate", "fp")
 
-    def __init__(self, jfn, normalized, n_keys, recording, donate):
+    def __init__(self, jfn, normalized, n_keys, recording, donate,
+                 fp=None):
         self.jfn = jfn
+        self.call = None  # resolved at first hit: disk load | AOT | jfn
         self.normalized = normalized
         self.n_keys = n_keys
         self.recording = recording
         self.donate = donate  # input slot whose buffer is donated, or None
+        self.fp = fp  # disk-tier fingerprint (None: memory-only entry)
 
 
 class _DispatchCache(CountedLRUCache):
@@ -293,7 +298,7 @@ def _normalize_output(pure_fn):
     return normalized
 
 
-def _build_jfn(normalized, recording, donate_slot):
+def _build_jfn(normalized, recording, donate_slot, label=None):
     from .. import random as _mxrandom
 
     if recording:
@@ -305,7 +310,7 @@ def _build_jfn(normalized, recording, donate_slot):
             with _mxrandom.key_replayer(_keys, strict=True):
                 return normalized(*xs)
     donate = (1 + donate_slot,) if donate_slot is not None else ()
-    return jax.jit(traced, donate_argnums=donate)
+    return _cc.counting_jit(traced, label=label, donate_argnums=donate)
 
 
 def _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas, wrap_cls,
@@ -326,6 +331,42 @@ def _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas, wrap_cls,
     return (opdef.name, tmpl, kws, kwa, avals, _AMP["version"], recording,
             autograd.is_training(), autograd.is_recording(), wrap_cls,
             donate_slot)
+
+
+def _resolve_entry_call(entry, keys, datas):
+    """First hit: make the entry's executable concrete. With the disk
+    tier armed (``entry.fp``), AOT-compile — ``lower().compile()``, ONE
+    trace counted by counting_jit — so the ``Compiled`` handle can be
+    serialized for future processes; without it, the plain jit path
+    (the C++ dispatch fastpath) compiles on this call as before."""
+    if entry.fp is not None:
+        try:
+            compiled = _cc.aot_compile(entry.jfn, tuple(keys), *datas)
+        except Exception:
+            # lowering rejected the body (e.g. value-dependent control
+            # flow surfaces differently under AOT) — the jit call below
+            # either works or takes the uncached-fallback path
+            entry.call = entry.jfn
+            return entry.call
+        _cc.disk_store(entry.fp, compiled,
+                       meta={"n_keys": entry.n_keys,
+                             "donate": entry.donate})
+        entry.call = _cc.GuardedCompiled(compiled, entry.jfn)
+    else:
+        entry.call = entry.jfn
+    return entry.call
+
+
+def _unbucket_result(result, plan, wrap):
+    """Slice bucket-padded outputs back to the true batch (axis 0)."""
+    from .ndarray import _wrap as _default_wrap
+
+    padded_b, true_b, _ = plan
+    w = wrap or _default_wrap
+    if isinstance(result, list):
+        return [w(_cc.slice_batch(r.data, padded_b, true_b))
+                for r in result]
+    return w(_cc.slice_batch(result.data, padded_b, true_b))
 
 
 def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
@@ -362,6 +403,26 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
 
     recording = (autograd.is_recording() and opdef.differentiable
                  and bool(arr_args))
+    # -- shape bucketing (MXNET_SHAPE_BUCKETS): round the batch axis of
+    # whitelisted row-independent ops up to a bucket boundary so a
+    # variable-length stream reuses a few bucket executables instead of
+    # retracing per batch size. Inputs are padded BEFORE the key is
+    # built (the cache sees bucket avals only); outputs are sliced back
+    # below — padded rows never escape, so results stay row-bitwise
+    # identical to the unbucketed path.
+    plan = None
+    if out is None and not recording and not kw_arrays:
+        plan = _cc.plan_bucketing(opdef.name, datas, arg_template, kwargs)
+    if plan is not None:
+        padded_b, true_b, pad_slots = plan
+        datas = list(datas)
+        arr_args = list(arr_args)
+        for i in pad_slots:
+            datas[i] = _cc.pad_batch(datas[i], padded_b)
+            # stand-ins keep the uncached/fallback path (apply_pure
+            # reads .data only; recording is off) on the padded shapes
+            arr_args[i] = _default_wrap(datas[i])
+        _cc.note_bucketed(padded_b, true_b)
     donate_slot = None
     if out is not None and not recording and _donate_enabled():
         for i, a in enumerate(arr_args):
@@ -386,9 +447,33 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
 
     entry = _CACHE.lookup(key)
     if entry is None:
-        # MISS: run today's uncached path once — byte-identical semantics,
-        # and it tells us how many PRNG keys the body draws — then install
-        # the executable (compiled lazily, on the first hit).
+        # MISS: consult the disk tier first — a warm-start process finds
+        # the executable a previous run compiled and serves even this
+        # first dispatch from it (no trace, no XLA compile; recording
+        # entries never persist — their vjp pullback can't serialize).
+        # the op NAME in the key does not pin the op BODY — the
+        # fingerprint folds in the body's bytecode digest so an edited
+        # implementation invalidates its disk entries
+        fp = _cc.fingerprint("dispatch", key, code_of=(opdef.fn,)) \
+            if not recording and _cc.cache_enabled() else None
+        if fp is not None:
+            loaded = _cc.disk_load(fp)
+            if loaded is not None:
+                compiled, meta = loaded
+                donate = meta.get("donate")
+                normalized = _normalize_output(pure_fn)
+                entry = _CacheEntry(
+                    _build_jfn(normalized, False, donate,
+                               label=opdef.name),
+                    normalized, int(meta.get("n_keys", 0)), False, donate,
+                    fp)
+                entry.call = _cc.GuardedCompiled(compiled, entry.jfn)
+                _CACHE.insert(key, entry)
+                # fall through to the hit-serving path below
+    if entry is None:
+        # true MISS: run today's uncached path once — byte-identical
+        # semantics, and it tells us how many PRNG keys the body draws —
+        # then install the executable (compiled lazily, on the first hit).
         if recording:
             result = apply_pure(pure_fn, arr_args, differentiable=True,
                                 out=out, wrap=wrap)
@@ -409,22 +494,25 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
                 donate = donate_slot
         normalized = _normalize_output(pure_fn)
         _CACHE.insert(key, _CacheEntry(
-            _build_jfn(normalized, recording, donate), normalized, n_keys,
-            recording, donate))
+            _build_jfn(normalized, recording, donate, label=opdef.name),
+            normalized, n_keys, recording, donate, fp))
+        if plan is not None:
+            result = _unbucket_result(result, plan, wrap)
         return True, result
 
     # HIT: pre-split the op's keys eagerly (same global-stream evolution
     # as the uncached path) and run the compiled executable.
     keys = [_mxrandom.next_key() for _ in range(entry.n_keys)]
+    call = entry.call or _resolve_entry_call(entry, keys, datas)
     try:
         if entry.donate is not None:
             with warnings.catch_warnings():
                 # XLA backends without donation support (CPU) warn at
                 # lowering time; the hint is best-effort by design
                 warnings.simplefilter("ignore")
-                raw = entry.jfn(tuple(keys), *datas)
+                raw = call(tuple(keys), *datas)
         else:
-            raw = entry.jfn(tuple(keys), *datas)
+            raw = call(tuple(keys), *datas)
     except Exception:
         # jit-incompatible body (value-dependent control flow, host
         # callback). Replay the already-drawn keys through the uncached
@@ -442,6 +530,8 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
             autograd._STATE.tape[-1].keys = keys[:rep._i] or None
         _UNJITTABLE.add(opdef.name)
         _CACHE.note_fallback()
+        if plan is not None:
+            result = _unbucket_result(result, plan, wrap)
         return True, result
 
     _DISPATCH_FLAG.cached = True
@@ -472,6 +562,8 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
             raise MXNetError("out= not supported for multi-output ops")
         out._data = jnp.asarray(result.data, out._data.dtype)
         return True, out
+    if plan is not None:
+        result = _unbucket_result(result, plan, wrap)
     return True, result
 
 

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from .. import ndarray as nd
+from ..utils import compile_cache as _cc
 from ..ndarray import NDArray
 from .. import autograd
 from .. import random as mxrandom
@@ -73,9 +74,9 @@ def _psum_over_workers(mesh):
     def reduce(g):
         return jax.lax.psum(g, "worker")
 
-    return jax.jit(shard_map(
+    return _cc.counting_jit(shard_map(
         reduce, mesh=mesh, in_specs=P("worker"),
-        out_specs=P()))
+        out_specs=P()), label="psum_workers")
 
 
 def all_reduce_coalesced(values, reduce_fn=None):
@@ -159,8 +160,9 @@ def _group_reduce_fn(mesh):
     def reduce(g):  # g: (1, ...) local shard
         return jax.lax.psum(g, "kvg")
 
-    return jax.jit(shard_map(
-        reduce, mesh=mesh, in_specs=P("kvg"), out_specs=P("kvg")))
+    return _cc.counting_jit(shard_map(
+        reduce, mesh=mesh, in_specs=P("kvg"), out_specs=P("kvg")),
+        label="group_reduce")
 
 
 def shard_batch(x, mesh, axis_name="dp"):
@@ -428,8 +430,8 @@ class SPMDTrainer:
         key0 = key0.data if isinstance(key0, NDArray) else jnp.asarray(key0)
         self._aux = (replicate(key0, mesh), replicate(jnp.int32(0), mesh))
         aux_shard = (rep, rep)
-        self._compiled = jax.jit(
-            step,
+        self._compiled = _cc.counting_jit(
+            step, label="spmd_step",
             in_shardings=(self._pshard, state_shards, aux_shard,
                           batch_shard, batch_shard),
             out_shardings=(rep, self._pshard, state_shards, aux_shard),

@@ -131,6 +131,18 @@ def fused_step_counters():
         return {}
 
 
+def compile_cache_counters():
+    """Persistent compile-cache counters (disk hit/miss/write/corrupt,
+    serialize skips, retrace count, bucket pad-ratio), live from
+    utils.compile_cache. Zeros before first use."""
+    try:
+        from .utils.compile_cache import compile_cache_stats
+
+        return compile_cache_stats()
+    except Exception:
+        return {}
+
+
 def graph_verify_counters():
     """Static graph-verifier counters (graphs checked, diagnostics by
     severity and code), live from mxnet_tpu.analysis. Zeros before the
@@ -192,6 +204,12 @@ def dump(finished=True, profile_process="worker"):
         payload["traceEvents"].append(
             {"name": f"graph_verify/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
+    for cname, cval in sorted(compile_cache_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"compile_cache/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
     with open(fname, "w") as f:
         json.dump(payload, f)
     return fname

@@ -42,6 +42,14 @@ def _detect():
     except Exception:
         feats["FUSED_STEP"] = False
     try:
+        from .utils.compile_cache import cache_enabled
+
+        # persistent compile-artifact cache (MXNET_COMPILE_CACHE,
+        # utils/compile_cache.py)
+        feats["COMPILE_CACHE"] = cache_enabled()
+    except Exception:
+        feats["COMPILE_CACHE"] = False
+    try:
         from .analysis import verify_mode
 
         # static graph verifier armed (MXNET_GRAPH_VERIFY, analysis/)

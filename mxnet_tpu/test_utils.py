@@ -234,7 +234,7 @@ def check_consistency(fn, inputs, rtol=None, atol=None, dtype="float32",
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
         return tuple(o.data for o in outs)
 
-    jitted = jax.jit(pure)(*[a.data for a in nds])
+    jitted = jax.jit(pure)(*[a.data for a in nds])  # graft-lint: allow(jit-nocache)
     for e, j in zip(eager_list, jitted):
         assert_almost_equal(e, onp.asarray(j.astype(jax.numpy.float32)),
                             rtol=rtol, atol=atol, names=("eager", "jit"))

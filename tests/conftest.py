@@ -83,3 +83,20 @@ def _seed():
     mx.random.seed(0)
     onp.random.seed(0)
     yield
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hermetic_compile_cache(tmp_path_factory):
+    """Point the persistent compile cache at a per-session tmpdir so
+    tier-1 runs are hermetic: no executables leak in from (or out to)
+    $MXNET_HOME/compile_cache across runs, and the suite never depends
+    on what a previous run happened to compile. Tests that need their
+    own isolation monkeypatch MXNET_COMPILE_CACHE_DIR on top."""
+    d = tmp_path_factory.mktemp("compile_cache")
+    prev = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = str(d)
+    yield str(d)
+    if prev is None:
+        os.environ.pop("MXNET_COMPILE_CACHE_DIR", None)
+    else:
+        os.environ["MXNET_COMPILE_CACHE_DIR"] = prev

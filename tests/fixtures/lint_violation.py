@@ -31,6 +31,12 @@ def registered_knob_check():
     return env.get_int("MXNET_NOT_A_REAL_KNOB", 3)
 
 
+def bad_raw_jit():
+    # jit-nocache: raw jax.jit bypasses the compile-cache helpers
+    # (counting_jit retrace accounting + persistent disk tier)
+    return jax.jit(lambda x: x + 1)
+
+
 @register("lint_fixture_bad_op")
 def lint_fixture_bad_op(data):  # L301: no docstring
     t = time.perf_counter()           # L201: host clock in a jit body

@@ -43,6 +43,41 @@ def test_train_step_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "fused_train_step"
 
 
+@pytest.mark.slow
+def test_compile_cache_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import compile_cache_bench
+
+    out = str(tmp_path / "compile.json")
+    doc = compile_cache_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["warm_start_bitwise_equal"]
+    assert doc["bucketing_bitwise_equal"]
+    assert doc["results"]["warm_speedup"] > 1.0
+    assert doc["results"]["retraces_bucketed"] < \
+        doc["results"]["retraces_unbucketed"]
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "compile_cache"
+
+
+def test_bench_compare_retrace_metrics_gated():
+    """The regression gate understands the BENCH_COMPILE_r09.json
+    metric names: retrace counts are lower-is-better, the speedups
+    higher-is-better, pad_ratio untracked."""
+    base = {"results": {"retraces_bucketed": 20, "warm_speedup": 16.0,
+                        "bucketing_speedup": 6.4, "pad_ratio": 0.43,
+                        "cold_first_step_ms": 1500.0}}
+    worse = {"results": {"retraces_bucketed": 30, "warm_speedup": 10.0,
+                         "bucketing_speedup": 6.4, "pad_ratio": 0.9,
+                         "cold_first_step_ms": 1500.0}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["results.retraces_bucketed"][4]  # +50% retraces: REGRESSED
+    assert rows["results.warm_speedup"][4]       # speedup drop: REGRESSED
+    assert not rows["results.bucketing_speedup"][4]
+    assert "results.pad_ratio" not in rows       # not a perf direction
+    same = {r[0]: r for r in bench_compare.compare(base, base)}
+    assert not any(r[4] for r in same.values())
+
+
 def _doc(ms, speedup):
     return {"results": {"fused_ms_per_step": ms, "speedup": speedup},
             "steps": 50, "counters": {"hits": 1}}

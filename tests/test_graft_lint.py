@@ -27,7 +27,8 @@ def test_fixture_triggers_every_check():
     findings = graft_lint.lint_paths([FIXTURE], repo_root=REPO,
                                      registry=False)
     codes = {f.code for f in findings}
-    assert {"L101", "L102", "L201", "L202", "L301"} <= codes, codes
+    assert {"L101", "L102", "L201", "L202", "L301",
+            "jit-nocache"} <= codes, codes
     # the three distinct host-sync species are each caught
     msgs = "\n".join(f.message for f in findings)
     assert "host clock" in msgs

@@ -4,7 +4,9 @@
 Numeric leaves are matched by dotted path; the comparison direction is
 inferred from the leaf name:
 
-- lower is better:  ``*_us*``, ``*_ms*``, ``*latency*``, ``*_sec``
+- lower is better:  ``*_us*``, ``*_ms*``, ``*latency*``, ``*_sec``,
+  ``*retrace*`` (compile-count metrics from BENCH_COMPILE_r09.json —
+  more retraces in a like-for-like stream is a cache regression)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
   ``*items_per*``
 
@@ -22,7 +24,7 @@ import argparse
 import json
 import sys
 
-LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec")
+LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec", "items_per")
 
 

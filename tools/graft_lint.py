@@ -27,6 +27,14 @@ package:
                        the ambient provider (``mxnet_tpu.random``).
 ``L301 op-docstring``  a ``@register``-decorated op body without a
                        docstring (AST form of the registry R301 check).
+``jit-nocache``        a raw ``jax.jit`` call site inside ``mxnet_tpu/``
+                       that bypasses the compile-cache helpers
+                       (``utils.compile_cache.counting_jit`` or the AOT
+                       serialize path): raw sites are invisible to the
+                       retrace counter and the persistent compile
+                       cache. Deliberate bypasses (one-shot equivalence
+                       checks, raw-jit benchmarks) carry
+                       ``# graft-lint: allow(jit-nocache)``.
 ``R301/R302/R303``     registry checks (``--registry``): every
                        registered op carries a docstring; every op named
                        in the dtype-rule tables of ``symbol/infer.py``
@@ -290,6 +298,23 @@ def check_jit_safety(path, tree, source, findings):
                     emit("L201", node, label, "print()")
 
 
+def check_jit_nocache(path, tree, source, findings):
+    """jit-nocache: raw ``jax.jit(...)`` call sites must route through
+    the compile-cache helpers or carry an allow pragma."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith("mxnet_tpu/utils/compile_cache.py"):
+        return  # the helpers themselves own the one legitimate raw site
+    pragmas = _Pragmas(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "jax.jit" \
+                and not pragmas.allows(node.lineno, "jit-nocache"):
+            findings.append(Finding(
+                "jit-nocache", path, node.lineno,
+                "raw jax.jit call site bypasses the compile-cache "
+                "helpers (use utils.compile_cache.counting_jit, or "
+                "annotate a deliberate bypass)"))
+
+
 def check_op_docstrings(path, tree, source, findings):
     reg_names = _op_registry_names(tree)
     if not reg_names:
@@ -374,6 +399,7 @@ def lint_paths(paths, repo_root=None, registry=True):
             continue
         check_env_discipline(path, tree, source, knobs, findings)
         check_jit_safety(path, tree, source, findings)
+        check_jit_nocache(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
             want_registry = True
