@@ -134,6 +134,7 @@ from . import contrib  # noqa: F401
 from . import util  # noqa: F401
 from . import log  # noqa: F401
 from . import registry  # noqa: F401
+from . import serving  # noqa: F401
 from . import kvstore_server  # noqa: F401  (exits server-role processes)
 from . import monitor as mon  # noqa: F401
 from . import profiler  # noqa: F401

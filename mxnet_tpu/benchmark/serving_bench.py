@@ -1,0 +1,264 @@
+"""Serving benchmark: dynamic batching vs a sequential loop + warm start.
+
+Two measurements, matching the round-10 acceptance criteria:
+
+**Dynamic-batching throughput.** The same N single-row requests are
+served two ways over one warmed ``InferenceSession``: (a) a sequential
+batch=1 loop — ``session.predict`` per request, the hand-written
+inference loop this subsystem replaces — and (b) concurrent clients
+submitting through a ``DynamicBatcher`` (blocking submits: backpressure,
+no rejects), which coalesces them into bucket-sized executions.
+Criterion: dynamic sustains >= 3x the sequential requests/sec at a
+bounded p99 (reported from the serving latency histogram; the natural
+bound is ``max_latency_ms`` + one batched execution), with per-request
+outputs bitwise equal to the sequential loop's.
+
+**Warm start.** A child process (fresh interpreter, fresh in-memory
+caches) builds the model, constructs a session (AOT-warming every
+bucket through the persistent compile cache) and serves one request,
+timing model-ready -> first response. The parent runs the child twice
+against one ``MXNET_COMPILE_CACHE_DIR``: cold populates the disk tier,
+warm deserializes it. Criterion: the warm process reaches its first
+response with ZERO traces and zero XLA compiles
+(``compile_cache_stats()['retraces'] == 0``, one disk hit per bucket)
+and a bitwise-identical response.
+
+Emits one JSON document (default ``BENCH_SERVE_r10.json``); also prints
+it.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.serving_bench [--smoke]
+        [--requests N] [--out FILE]
+
+``--smoke`` shrinks the model/request count for a CPU tier-1 budget.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as onp
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_net(hidden, layers):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    for i in range(layers):
+        # distinct widths: distinct executables per layer, like a real
+        # model (see compile_cache_bench)
+        net.add(nn.Dense(hidden - 8 * i, activation="relu"))
+    net.add(nn.Dense(8))
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(mx.nd.zeros((1, hidden)))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# dynamic batching vs sequential loop (in-process)
+
+def _throughput(smoke, n_requests):
+    from mxnet_tpu import serving
+
+    hidden = 64 if smoke else 256
+    layers = 2 if smoke else 4
+    max_batch = 16 if smoke else 32
+    net = _build_net(hidden, layers)
+    sess = serving.InferenceSession(
+        net, input_shapes=[(1, hidden)],
+        buckets=serving.parse_buckets("pow2", max_batch))
+    xs = [onp.random.RandomState(i).rand(1, hidden).astype("float32")
+          for i in range(n_requests)]
+
+    # sequential batch=1 loop (the replaced hand-written path)
+    seq_outs = []
+    t0 = time.perf_counter()
+    for x in xs:
+        seq_outs.append(sess.predict(x))
+    for o in seq_outs:
+        o.wait_to_read()
+    seq_s = time.perf_counter() - t0
+
+    # dynamic batching: concurrent clients, blocking submits
+    batcher = serving.DynamicBatcher(sess, max_batch_size=max_batch,
+                                     max_latency_ms=2.0,
+                                     timeout_ms=60_000)
+    # untimed warmup burst: first-touch costs off the measurement
+    # (sustained throughput is the claim, not first-batch latency)
+    for f in [batcher.submit(x, block=True) for x in xs[:max_batch]]:
+        f.result(timeout=120)
+    serving.reset_serving_counters()
+    n_clients = 8
+    futs = [None] * n_requests
+
+    def client(cid):
+        for i in range(cid, n_requests, n_clients):
+            futs[i] = batcher.submit(xs[i], block=True)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dyn_outs = [f.result(timeout=120) for f in futs]
+    dyn_s = time.perf_counter() - t0
+    stats = serving.serving_stats()
+    batcher.close()
+
+    bitwise = all(
+        onp.array_equal(a.asnumpy(), b)  # dyn results are host arrays
+        for a, b in zip(seq_outs, dyn_outs))
+    return {
+        "n_requests": n_requests,
+        "model": {"hidden": hidden, "layers": layers,
+                  "max_batch": max_batch},
+        "sequential_rps": round(n_requests / seq_s, 1),
+        "dynamic_rps": round(n_requests / dyn_s, 1),
+        "batching_speedup": round(seq_s / dyn_s, 2),
+        "latency_p50_ms": stats["latency_p50_ms"],
+        "latency_p99_ms": stats["latency_p99_ms"],
+        "exec_p50_ms": stats["exec_p50_ms"],
+        "batches": stats["batches"],
+        "batch_rows_mean": stats["batch_rows_mean"],
+        "pad_ratio": stats["pad_ratio"],
+        "bitwise_equal": bitwise,
+    }
+
+
+# ---------------------------------------------------------------------------
+# warm start (child process per data point)
+
+def _warm_child_main(hidden, layers, max_batch):
+    """One process lifetime: model-ready -> session warmup -> first
+    response, timed; prints retrace/disk counters + a response
+    checksum."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.utils import compile_cache as cc
+
+    net = _build_net(hidden, layers)
+    x = onp.random.RandomState(99).rand(3, hidden).astype("float32")
+    cc.reset_compile_cache_counters()
+    t0 = time.perf_counter()
+    sess = serving.InferenceSession(
+        net, input_shapes=[(1, hidden)],
+        buckets=serving.parse_buckets("pow2", max_batch))
+    out = sess.predict(x)
+    first_s = time.perf_counter() - t0
+    st = cc.compile_cache_stats()
+    print(json.dumps({
+        "first_response_s": first_s,
+        "retraces": st["retraces"], "disk_hits": st["disk_hits"],
+        "n_buckets": len(sess.buckets),
+        "response_sha256": hashlib.sha256(
+            onp.ascontiguousarray(out.asnumpy()).tobytes()).hexdigest()}))
+
+
+def _run_child(cache_dir, hidden, layers, max_batch):
+    env = dict(os.environ, MXNET_COMPILE_CACHE_DIR=cache_dir,
+               MXNET_COMPILE_CACHE="1", JAX_PLATFORMS="cpu",
+               MXNET_SEED="5")
+    code = ("import sys; sys.path.insert(0, {root!r});\n"
+            "from _cpu_platform import force_cpu_platform;\n"
+            "force_cpu_platform();\n"
+            "from mxnet_tpu.benchmark.serving_bench import "
+            "_warm_child_main;\n"
+            "_warm_child_main({hidden}, {layers}, {max_batch})").format(
+                root=_REPO, hidden=hidden, layers=layers,
+                max_batch=max_batch)
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=_REPO,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench child failed:\n{out.stderr[-4000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _warm_start(smoke):
+    hidden = 64 if smoke else 128
+    layers = 2 if smoke else 4
+    max_batch = 4 if smoke else 8
+    with tempfile.TemporaryDirectory(prefix="mxserve_bench_") as d:
+        cold = _run_child(d, hidden, layers, max_batch)
+        warm = _run_child(d, hidden, layers, max_batch)
+    return {
+        "model": {"hidden": hidden, "layers": layers,
+                  "max_batch": max_batch},
+        "cold_first_response_ms": round(
+            cold["first_response_s"] * 1e3, 1),
+        "warm_first_response_ms": round(
+            warm["first_response_s"] * 1e3, 1),
+        "warm_speedup": round(cold["first_response_s"] /
+                              warm["first_response_s"], 2),
+        "cold_retraces": cold["retraces"],
+        "warm_retraces": warm["retraces"],
+        "warm_disk_hits": warm["disk_hits"],
+        "n_buckets": warm["n_buckets"],
+        "bitwise_equal":
+            cold["response_sha256"] == warm["response_sha256"],
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def run(smoke=False, requests=None, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    n_requests = requests or (64 if smoke else 512)
+    tp = _throughput(smoke, n_requests)
+    ws = _warm_start(smoke)
+    doc = {
+        "benchmark": "serving",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "throughput": tp,
+        "warm_start": ws,
+        "results": {
+            "sequential_rps": tp["sequential_rps"],
+            "dynamic_rps": tp["dynamic_rps"],
+            "batching_speedup": tp["batching_speedup"],
+            "latency_p50_ms": tp["latency_p50_ms"],
+            "latency_p99_ms": tp["latency_p99_ms"],
+            "warm_first_response_ms": ws["warm_first_response_ms"],
+            "warm_speedup": ws["warm_speedup"],
+            "warm_retraces": ws["warm_retraces"],
+        },
+        "dynamic_bitwise_equal": tp["bitwise_equal"],
+        "warm_start_bitwise_equal": ws["bitwise_equal"],
+        "warm_start_zero_compiles": ws["warm_retraces"] == 0 and
+            ws["warm_disk_hits"] >= ws["n_buckets"],
+    }
+    out_path = out_path or "BENCH_SERVE_r10.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small model/request count; CPU tier-1 budget")
+    p.add_argument("--requests", type=int, default=None)
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, requests=a.requests, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

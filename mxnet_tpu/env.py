@@ -124,6 +124,48 @@ KNOBS = {
         "and outputs sliced back, so variable-length streams reuse a "
         "few bucket executables instead of retracing per batch size "
         "(see docs/COMPILE_CACHE.md)"),
+    "MXNET_SERVING": (
+        "wired", "serving",
+        "serving subsystem master switch (default 1): 0 degrades "
+        "DynamicBatcher to inline pass-through execution (no queue, no "
+        "coalescing) and reports the SERVING runtime feature as off"),
+    "MXNET_SERVING_MAX_BATCH": (
+        "wired", "serving",
+        "largest coalesced batch / largest compiled bucket (default "
+        "32); larger direct InferenceSession.predict calls are chunked"),
+    "MXNET_SERVING_MAX_LATENCY_MS": (
+        "wired", "serving.batcher",
+        "micro-batch flush deadline in ms measured from the OLDEST "
+        "queued request (default 5): a batch executes when full or "
+        "when its first request has waited this long"),
+    "MXNET_SERVING_QUEUE_DEPTH": (
+        "wired", "serving.batcher",
+        "bound on queued requests (default 256); a full queue rejects "
+        "submits with ServerBusy (HTTP 503) — backpressure, not "
+        "unbounded buffering"),
+    "MXNET_SERVING_TIMEOUT_MS": (
+        "wired", "serving.batcher",
+        "default per-request deadline in ms (default 2000): a request "
+        "still queued past it fails alone with RequestTimeout (HTTP "
+        "504) without executing; <= 0 disables"),
+    "MXNET_SERVING_WORKERS": (
+        "wired", "serving.batcher",
+        "batch-formation worker threads (default 1 — right for one "
+        "accelerator; more only helps when executions overlap)"),
+    "MXNET_SERVING_BUCKETS": (
+        "wired", "serving.session",
+        "batch-size buckets compiled per model: pow2 (default — powers "
+        "of two up to MAX_BATCH) | mult:N | explicit comma list "
+        "('1,4,16,32'); MAX_BATCH itself is always included, and an "
+        "explicit entry above it is an error (never silently dropped)"),
+    "MXNET_SERVING_HOST": (
+        "wired", "serving.server",
+        "ModelServer bind address (default 127.0.0.1; set 0.0.0.0 to "
+        "accept external traffic)"),
+    "MXNET_SERVING_PORT": (
+        "wired", "serving.server",
+        "ModelServer port (default 8080; 0 binds an ephemeral port, "
+        "read back via server.port)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

@@ -314,7 +314,14 @@ class Block:
         files (save_parameters) and arg:/aux:-prefixed export/Module
         checkpoints, matching the latter by full parameter name as the
         reference does."""
-        loaded = nd.load(filename)
+        self._load_loaded_parameters(nd.load(filename), filename,
+                                     allow_missing, ignore_extra)
+
+    def _load_loaded_parameters(self, loaded, filename,
+                                allow_missing=False, ignore_extra=False):
+        """Apply an already-deserialized ``nd.load`` dict (callers that
+        inspected the file — SymbolBlock.imports — pass it through so
+        big param files parse and device-upload once, not twice)."""
         if loaded and all(k.startswith(("arg:", "aux:")) for k in loaded):
             loaded = {k.split(":", 1)[1]: v for k, v in loaded.items()}
             params = dict(self.collect_params().items())
@@ -665,16 +672,38 @@ class SymbolBlock(HybridBlock):
                     s._name, allow_deferred_init=True)
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None):
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        """Reference: gluon/block.py SymbolBlock.imports. Serving loader
+        glue: ``input_names=None`` infers the data inputs as the graph's
+        free variables NOT present in ``param_file`` — the exported
+        (symbol, params) pair fully determines which variables are fed
+        per request, so a model server can load any export without
+        out-of-band input metadata."""
         from .. import symbol as sym
+        from .. import ndarray as _nd
 
         outputs = sym.load(symbol_file)
+        loaded = _nd.load(param_file) if param_file is not None else None
+        if input_names is None:
+            if loaded is None:
+                raise MXNetError(
+                    "SymbolBlock.imports(input_names=None) needs "
+                    "param_file to tell data inputs from parameters")
+            saved = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                     else k for k in loaded}
+            input_names = [n for n in outputs.list_arguments()
+                           if n not in saved]
+            if not input_names:
+                raise MXNetError(
+                    f"no free variables of {symbol_file!r} remain after "
+                    f"binding {param_file!r}; pass input_names "
+                    "explicitly")
         if isinstance(input_names, str):
             input_names = [input_names]
         inputs = [sym.var(n) for n in input_names]
         ret = SymbolBlock(outputs, inputs)
-        if param_file is not None:
-            ret.load_parameters(param_file)
+        if loaded is not None:
+            ret._load_loaded_parameters(loaded, param_file)
         return ret
 
     def forward(self, *args):

@@ -143,6 +143,19 @@ def compile_cache_counters():
         return {}
 
 
+def serving_counters():
+    """Serving-subsystem counters (requests/responses/failures/
+    timeouts/rejected, p50/p95/p99 latency, queue depth, batch-size
+    stats, QPS, warm-start disk hits vs compiles), live from
+    mxnet_tpu.serving.metrics. Zeros before the first request."""
+    try:
+        from .serving.metrics import serving_stats
+
+        return serving_stats()
+    except Exception:
+        return {}
+
+
 def graph_verify_counters():
     """Static graph-verifier counters (graphs checked, diagnostics by
     severity and code), live from mxnet_tpu.analysis. Zeros before the
@@ -207,6 +220,12 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(compile_cache_counters().items()):
         payload["traceEvents"].append(
             {"name": f"compile_cache/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
+    for cname, cval in sorted(serving_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"serving/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0,
              "args": {cname: float(cval) if isinstance(cval, float)
                       else cval}})

@@ -50,6 +50,13 @@ def _detect():
     except Exception:
         feats["COMPILE_CACHE"] = False
     try:
+        from .serving import serving_enabled
+
+        # dynamic-batching inference serving (MXNET_SERVING, serving/)
+        feats["SERVING"] = serving_enabled()
+    except Exception:
+        feats["SERVING"] = False
+    try:
         from .analysis import verify_mode
 
         # static graph verifier armed (MXNET_GRAPH_VERIFY, analysis/)

@@ -1,0 +1,61 @@
+"""mxnet_tpu.serving — dynamic-batching inference serving.
+
+The production inference path of the framework (ROADMAP north star:
+"serves heavy traffic from millions of users"; reference analog: the
+MXNet model-server ecosystem over ``SymbolBlock.imports`` artifacts),
+built directly on the round-9 compile-cache primitives:
+
+- :class:`~mxnet_tpu.serving.session.InferenceSession` — eval-mode,
+  no-tape forward compiled ONCE per batch-size bucket (AOT through
+  ``utils/compile_cache.py``); a warm process deserializes every bucket
+  and serves its first request with zero traces and zero XLA compiles.
+- :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` — bounded request
+  queue with backpressure, micro-batch coalescing under a
+  ``max_latency_ms`` flush deadline, per-request validation/timeout
+  isolation, engine.close()-style graceful drain.
+- :class:`~mxnet_tpu.serving.server.ModelServer` — stdlib
+  ``ThreadingHTTPServer`` JSON/npy endpoint with ``/healthz`` and
+  Prometheus ``/metrics``.
+- :mod:`~mxnet_tpu.serving.metrics` — p50/p95/p99 latency histograms,
+  queue depth, batch-size histogram, QPS, warm-start counters; surfaced
+  via ``profiler.serving_counters()`` and the ``SERVING`` runtime
+  feature.
+
+Quick start::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    sess = serving.InferenceSession.load("export/mymodel",
+                                         input_shapes=[(1, 784)])
+    with serving.ModelServer(sess, port=8080) as srv:
+        ...  # POST /predict, GET /healthz, GET /metrics
+
+Knobs: ``MXNET_SERVING`` (0 degrades the batcher to inline
+pass-through), ``MXNET_SERVING_MAX_BATCH`` / ``_MAX_LATENCY_MS`` /
+``_QUEUE_DEPTH`` / ``_TIMEOUT_MS`` / ``_WORKERS`` / ``_BUCKETS`` /
+``_HOST`` / ``_PORT`` — see docs/SERVING.md and docs/ENV_VARS.md.
+"""
+from __future__ import annotations
+
+__all__ = ["InferenceSession", "DynamicBatcher", "ModelServer",
+           "ServerBusy", "RequestTimeout", "parse_buckets",
+           "serving_enabled", "serving_stats", "reset_serving_counters",
+           "prometheus_text", "METRICS"]
+
+
+def serving_enabled():
+    """MXNET_SERVING knob (default on): 0 disables dynamic batching —
+    batchers execute requests inline, pass-through — and reports the
+    ``SERVING`` runtime feature as off. Read per use so tests can
+    toggle without reimport."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_SERVING", True)
+
+
+from .metrics import (METRICS, prometheus_text,  # noqa: E402
+                      reset_serving_counters, serving_stats)
+from .session import InferenceSession, parse_buckets  # noqa: E402
+from .batcher import DynamicBatcher, RequestTimeout, ServerBusy  # noqa: E402
+from .server import ModelServer  # noqa: E402

@@ -1,0 +1,388 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into bucket-sized
+model executions.
+
+The throughput lever of the serving subsystem (reference analog: the
+MXNet model-server's dynamic batching; same shape as every production
+inference queue): requests land on a bounded queue (backpressure —
+``submit`` raises :class:`ServerBusy` when full), a worker coalesces
+them until ``max_batch_size`` rows are gathered OR the oldest request
+has waited ``max_latency_ms``, runs ONE
+:class:`~mxnet_tpu.serving.session.InferenceSession` execution over the
+concatenated rows (which pads to the session's shape bucket), then
+slices per-request outputs back and resolves each request's future.
+
+Failure isolation: every request is validated at ``submit`` time
+against the session's input specs, so one malformed input fails alone —
+it never reaches a batch, never poisons its neighbors. A request that
+outlives its deadline (``timeout_ms``) is failed with
+:class:`RequestTimeout` at batch-formation time without executing.
+
+Graceful shutdown mirrors ``engine.close()``: ``close()`` stops
+accepting queued work, drains everything already accepted, joins the
+workers, and is idempotent; after close (or with ``MXNET_SERVING=0``)
+``submit`` degrades to inline single-request execution so late callers
+stay correct — exactly the engine's post-close inline semantics.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .metrics import METRICS
+
+__all__ = ["DynamicBatcher", "ServerBusy", "RequestTimeout"]
+
+
+class ServerBusy(MXNetError):
+    """The request queue is full (backpressure); retry later (HTTP 503)."""
+
+
+class RequestTimeout(MXNetError):
+    """The request outlived its deadline before execution (HTTP 504)."""
+
+
+_STOP = object()  # queue sentinel, one per worker at close()
+
+
+class _Request:
+    __slots__ = ("arrs", "rows", "future", "t_submit", "deadline")
+
+    def __init__(self, arrs, rows, deadline):
+        self.arrs = arrs  # list[NDArray], one per session input
+        self.rows = rows
+        self.future = Future()
+        self.t_submit = time.monotonic()
+        self.deadline = deadline
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+
+class DynamicBatcher:
+    """Bounded-queue dynamic micro-batcher over an InferenceSession.
+
+    Parameters (all defaulting to their ``MXNET_SERVING_*`` knobs)
+    ----------
+    session : InferenceSession (or any object with ``validate`` /
+        ``predict`` and a ``max_batch`` property)
+    max_batch_size : int — coalescing row bound (capped at the
+        session's ``max_batch`` so a batch never chunks)
+    max_latency_ms : float — flush deadline measured from the OLDEST
+        request in the forming batch
+    max_queue : int — bound on queued requests (backpressure)
+    timeout_ms : float — default per-request deadline; <= 0 disables
+    num_workers : int — batch-formation threads (one is right for one
+        accelerator; more only helps when execution itself overlaps)
+    """
+
+    def __init__(self, session, max_batch_size=None, max_latency_ms=None,
+                 max_queue=None, timeout_ms=None, num_workers=None):
+        from .. import env as _env
+        from . import serving_enabled
+
+        self.session = session
+        self._max_batch = int(max_batch_size or _env.get_int(
+            "MXNET_SERVING_MAX_BATCH", 32))
+        sess_max = getattr(session, "max_batch", None)
+        if sess_max:
+            self._max_batch = min(self._max_batch, int(sess_max))
+        self._max_latency_s = float(
+            max_latency_ms if max_latency_ms is not None else
+            _env.get_float("MXNET_SERVING_MAX_LATENCY_MS", 5.0)) / 1e3
+        self._timeout_s = float(
+            timeout_ms if timeout_ms is not None else
+            _env.get_float("MXNET_SERVING_TIMEOUT_MS", 2000.0)) / 1e3
+        nworkers = int(num_workers or _env.get_int(
+            "MXNET_SERVING_WORKERS", 1))
+        depth = int(max_queue or _env.get_int(
+            "MXNET_SERVING_QUEUE_DEPTH", 256))
+        self._queue = queue.Queue(maxsize=depth)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._pass_through = not serving_enabled()
+        self._workers = []
+        if not self._pass_through:
+            ready = []
+            for i in range(max(nworkers, 1)):
+                ev = threading.Event()
+                ready.append(ev)
+                t = threading.Thread(target=self._worker_loop,
+                                     args=(ev,),
+                                     name=f"mxnet-serving-batcher-{i}",
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
+            # a constructed batcher is READY: wait out the workers'
+            # one-time thread-PRNG priming so the first request never
+            # pays it (bounded — a wedged prime must not hang startup)
+            for ev in ready:
+                ev.wait(timeout=30)
+        self._depth_token = METRICS.register_depth_probe(
+            self._queue.qsize)
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, *inputs, timeout_ms=None, block=False):
+        """Validate and enqueue one request; returns a
+        ``concurrent.futures.Future`` resolving to the request's output
+        rows as HOST numpy arrays (one array, or a tuple for
+        multi-output models). The batcher is a host-boundary component
+        — requests arrive from the network and responses leave to it —
+        so coalescing, padding and per-request slicing all run in
+        numpy, and each executed batch pays exactly one device upload
+        and one download per output. Validation failures raise
+        ``ValueError`` immediately — per-request, never
+        batch-poisoning. A full queue raises :class:`ServerBusy` (or
+        blocks when ``block=True``). After ``close()`` / under
+        ``MXNET_SERVING=0`` the request runs inline."""
+        import numpy as onp
+
+        METRICS.bump("requests")
+        try:
+            arrs, rows = self.session.validate(*inputs)
+            arrs = [a.asnumpy() if isinstance(a, NDArray)
+                    else onp.asarray(a) for a in arrs]
+        except ValueError:
+            METRICS.bump("invalid")
+            raise
+        if rows > self._max_batch:
+            METRICS.bump("invalid")
+            raise ValueError(
+                f"request batch {rows} exceeds max_batch_size "
+                f"{self._max_batch}; split the request")
+        t = self._timeout_s if timeout_ms is None else \
+            float(timeout_ms) / 1e3
+        deadline = time.monotonic() + t if t > 0 else None
+        req = _Request(arrs, rows, deadline)
+        with self._lock:
+            inline = self._closed or self._pass_through
+        if inline:
+            METRICS.bump("inline")
+            self._execute([req])
+            return req.future
+        if block:
+            # bounded waits that re-check _closed: a blocking put on a
+            # full queue whose consumers close() just joined would
+            # otherwise wait forever
+            while True:
+                try:
+                    self._queue.put(req, timeout=0.05)
+                    break
+                except queue.Full:
+                    with self._lock:
+                        closed = self._closed
+                    if closed:
+                        METRICS.bump("inline")
+                        self._execute([req])
+                        return req.future
+        else:
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                METRICS.bump("rejected")
+                raise ServerBusy(
+                    f"serving queue full ({self._queue.maxsize} "
+                    "requests); backpressure — retry later") from None
+        # close() may have finished (workers joined, queue drained)
+        # between the _closed check above and our put landing — nobody
+        # would ever consume this request. Drain it ourselves;
+        # get_nowait is atomic, so racing drains never double-execute.
+        with self._lock:
+            orphaned = self._closed
+        if orphaned:
+            self._drain_queue()
+        return req.future
+
+    def predict(self, *inputs, timeout_ms=None):
+        """Blocking convenience: ``submit(...).result()`` with a result
+        wait bounded by the request deadline (plus execution slack)."""
+        fut = self.submit(*inputs, timeout_ms=timeout_ms)
+        t = self._timeout_s if timeout_ms is None else \
+            float(timeout_ms) / 1e3
+        return fut.result(timeout=(t + 60.0) if t > 0 else None)
+
+    def qsize(self):
+        return self._queue.qsize()
+
+    # -- worker side ---------------------------------------------------
+
+    def _worker_loop(self, ready=None):
+        # prime this thread's PRNG stream NOW: the first next_key() in
+        # a fresh thread constructs the thread-local base key (eager
+        # PRNGKey + fold_in, ~100ms of one-time XLA compile on CPU) —
+        # pay it at worker start, never under the first request
+        try:
+            from .. import random as mxrandom
+
+            mxrandom.next_key()
+        except Exception:
+            pass
+        finally:
+            if ready is not None:
+                ready.set()
+        holdover = None
+        while True:
+            req = holdover if holdover is not None else self._queue.get()
+            holdover = None
+            if req is _STOP:
+                break
+            now = time.monotonic()
+            if req.expired(now):
+                self._fail_timeout(req)
+                continue
+            batch = [req]
+            rows = req.rows
+            # deadline runs from the oldest request's SUBMIT time (the
+            # documented bound): time already spent queued behind a
+            # busy worker counts against the coalescing window. Past
+            # it, the worker stops WAITING for companions but still
+            # drains whatever is already queued (get_nowait) — a
+            # backed-up queue coalesces full batches instead of
+            # degrading to batch=1
+            flush_at = req.t_submit + self._max_latency_s
+            while rows < self._max_batch:
+                remaining = flush_at - time.monotonic()
+                try:
+                    nxt = self._queue.get_nowait() if remaining <= 0 \
+                        else self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    # sentinels are anonymous (close() posts one per
+                    # worker), so keep this one for our own top-of-loop
+                    # exit — finish the formed batch first. A blocking
+                    # repost could deadlock against a full queue.
+                    holdover = nxt
+                    break
+                if nxt.expired():
+                    self._fail_timeout(nxt)
+                    continue
+                if rows + nxt.rows > self._max_batch:
+                    holdover = nxt  # opens the next batch
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            METRICS.observe_flush(time.monotonic() - batch[0].t_submit)
+            self._execute(batch)
+
+    def _execute(self, batch):
+        """One session execution over the batch's concatenated rows;
+        fetch outputs to host once, slice numpy views back per request
+        and resolve futures. A session failure here is systemic (inputs
+        were validated at submit), so it fails the whole batch."""
+        import numpy as onp
+
+        try:
+            if len(batch) == 1:
+                arrs = batch[0].arrs
+            else:
+                arrs = [onp.concatenate([r.arrs[i] for r in batch],
+                                        axis=0)
+                        for i in range(len(batch[0].arrs))]
+            outs = self.session.predict(*arrs)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            # ONE device->host transfer per output; per-request slices
+            # are free numpy views
+            host = [o.asnumpy() if isinstance(o, NDArray)
+                    else onp.asarray(o) for o in outs]
+            if len(batch) > 1:
+                # every output must be batch-major over exactly the
+                # coalesced rows, or per-request slicing is impossible
+                # — handing anyone the full array would leak other
+                # requests' data, so the batch fails loudly instead
+                total = sum(r.rows for r in batch)
+                bad = [i for i, h in enumerate(host)
+                       if not (h.ndim and h.shape[0] == total)]
+                if bad:
+                    raise MXNetError(
+                        f"output(s) {bad} are not batch-major over "
+                        f"{total} coalesced rows (shapes "
+                        f"{[host[i].shape for i in bad]}); batched "
+                        "serving needs row-independent outputs — use "
+                        "max_batch_size=1 or a direct "
+                        "InferenceSession for this model")
+        except Exception as e:  # noqa: BLE001 — delivered per-future
+            for r in batch:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(e)
+                METRICS.observe_request(
+                    time.monotonic() - r.t_submit, failed=True)
+            return
+        offset = 0
+        now = time.monotonic()
+        for r in batch:
+            if len(batch) == 1:
+                sliced = tuple(host)
+            else:
+                sliced = tuple(h[offset:offset + r.rows] for h in host)
+            offset += r.rows
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(
+                    sliced[0] if len(sliced) == 1 else sliced)
+            METRICS.observe_request(now - r.t_submit)
+
+    def _fail_timeout(self, req):
+        if req.future.set_running_or_notify_cancel():
+            # the REQUEST's own deadline (submit may have overridden
+            # the batcher default)
+            budget_ms = (req.deadline - req.t_submit) * 1e3
+            req.future.set_exception(RequestTimeout(
+                f"request expired after {budget_ms:.0f} ms in queue"))
+        METRICS.observe_request(time.monotonic() - req.t_submit,
+                                failed=True, timed_out=True)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self):
+        """Graceful shutdown: stop accepting queued work, drain every
+        accepted request, join the workers. Idempotent; post-close
+        submits run inline (the ``engine.close()`` contract)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for _ in self._workers:
+            self._queue.put(_STOP)
+        for t in self._workers:
+            t.join()
+        self._workers = []
+        # anything a racing submit slipped in behind the sentinels
+        self._drain_queue()
+        METRICS.unregister_depth_probe(self._depth_token)
+
+    def _drain_queue(self):
+        """Pop and execute everything queued (skipping stray
+        sentinels). Called by close() after joining workers, and by a
+        submit that discovers its freshly-enqueued request landed in a
+        closed (consumer-less) queue. Expired requests fail with
+        RequestTimeout here too — the deadline contract ('fails alone,
+        without executing') holds on every path a request can leave
+        the queue by."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                continue
+            if item.expired():
+                self._fail_timeout(item)
+            else:
+                self._execute([item])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

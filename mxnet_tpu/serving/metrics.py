@@ -1,0 +1,281 @@
+"""Serving metrics: latency histograms, queue depth, batch shape, QPS.
+
+The observability spine of the serving subsystem (reference analog: the
+MXNet model-server's `/metrics` endpoint and per-request logs). One
+process-wide :class:`ServingMetrics` registry backs every
+``InferenceSession`` / ``DynamicBatcher`` / ``ModelServer`` instance, so
+``profiler.serving_counters()`` (and the ``serving/*`` counter samples in
+``profiler.dump()``) always reflect the whole process — the same pattern
+as the dispatch-cache and fused-step counters.
+
+Three measurement families:
+
+- **Latency histograms** (log-spaced, fixed bounds): end-to-end request
+  latency (submit -> result), model execution latency (one coalesced
+  batch through the session), and time-to-flush (how long the batcher
+  held the first request of a batch). Quantiles (p50/p95/p99) are read
+  by linear interpolation inside the owning bucket — cheap enough to
+  compute per scrape, never on the request path.
+- **Counters**: requests/responses/failures/invalid/timeouts/rejected
+  (backpressure), batches, inline executions (pass-through or
+  post-close), warm-start disk hits vs fresh compiles, padded vs true
+  rows (bucket padding overhead).
+- **Gauges**: live queue depth (probed from the owning batcher at read
+  time, never sampled on the hot path) and a 60-second completion
+  window for QPS.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+
+__all__ = ["LatencyHistogram", "ServingMetrics", "METRICS",
+           "serving_stats", "reset_serving_counters", "prometheus_text"]
+
+#: log-spaced latency bucket upper bounds, seconds (last bucket +inf)
+LATENCY_BOUNDS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: batch-size bucket upper bounds, rows (last bucket +inf)
+BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+_QPS_WINDOW_S = 60.0
+
+
+class LatencyHistogram:
+    """Fixed-bound histogram with interpolated quantiles.
+
+    Bounds are upper edges; one overflow bucket catches everything past
+    the last bound. ``observe`` is O(log buckets) (bisect) under the
+    shared registry lock — the caller holds it."""
+
+    __slots__ = ("bounds", "counts", "total", "sum")
+
+    def __init__(self, bounds=LATENCY_BOUNDS_S):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value):
+        self.counts[bisect.bisect_left(self.bounds, float(value))] += 1
+        self.total += 1
+        self.sum += float(value)
+
+    def quantile(self, q):
+        """Value at quantile ``q`` (0..1), linearly interpolated inside
+        the owning bucket; 0.0 when empty. The overflow bucket reports
+        its lower edge (there is no upper edge to interpolate toward)."""
+        if self.total == 0:
+            return 0.0
+        rank = q * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                hi = self.bounds[i]
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.bounds[-1]
+
+    def snapshot(self):
+        return {"total": self.total, "sum": self.sum,
+                "counts": list(self.counts)}
+
+
+_COUNTER_NAMES = (
+    "requests", "responses", "failures", "invalid", "timeouts",
+    "rejected", "batches", "inline", "warm_disk_hits", "warm_compiles",
+    "bucket_execs", "padded_rows", "true_rows",
+)
+
+
+class ServingMetrics:
+    """Process-wide serving metric registry (single lock; every
+    mutation is a couple of integer bumps, cheap enough for the request
+    path)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset_locked()
+        self._depth_probes = {}  # token -> callable() -> int
+
+    def _reset_locked(self):
+        self.counters = dict.fromkeys(_COUNTER_NAMES, 0)
+        self.request_latency = LatencyHistogram()
+        self.exec_latency = LatencyHistogram()
+        self.flush_wait = LatencyHistogram()
+        self.batch_rows = LatencyHistogram(BATCH_BOUNDS)
+        self._completions = deque()  # monotonic stamps, QPS window
+        self._started = time.monotonic()
+
+    # -- mutation (request path) -------------------------------------
+
+    def bump(self, name, n=1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe_request(self, latency_s, failed=False, timed_out=False):
+        now = time.monotonic()
+        with self._lock:
+            self.counters["responses"] += 1
+            if failed:
+                self.counters["failures"] += 1
+            if timed_out:
+                self.counters["timeouts"] += 1
+            self.request_latency.observe(latency_s)
+            self._completions.append(now)
+            self._trim_window_locked(now)
+
+    def observe_batch(self, rows, exec_s):
+        """One session.predict execution (bucket_execs counts the
+        underlying bucket-executable invocations separately — a
+        chunked oversized predict runs several per batch)."""
+        with self._lock:
+            self.counters["batches"] += 1
+            self.batch_rows.observe(rows)
+            self.exec_latency.observe(exec_s)
+
+    def observe_flush(self, wait_s):
+        """Time the batcher held a batch's FIRST request before
+        executing (the latency cost of coalescing)."""
+        with self._lock:
+            self.flush_wait.observe(wait_s)
+
+    def _trim_window_locked(self, now):
+        cutoff = now - _QPS_WINDOW_S
+        while self._completions and self._completions[0] < cutoff:
+            self._completions.popleft()
+
+    # -- gauges -------------------------------------------------------
+
+    def register_depth_probe(self, probe):
+        """Register a live queue-depth callable (a batcher's
+        ``qsize``); returns a token for :meth:`unregister_depth_probe`.
+        Probed at read time only — depth is never sampled on the
+        request path."""
+        token = object()
+        with self._lock:
+            self._depth_probes[token] = probe
+        return token
+
+    def unregister_depth_probe(self, token):
+        with self._lock:
+            self._depth_probes.pop(token, None)
+
+    def queue_depth(self):
+        with self._lock:
+            probes = list(self._depth_probes.values())
+        depth = 0
+        for p in probes:
+            try:
+                depth += int(p())
+            except Exception:
+                pass
+        return depth
+
+    # -- reading ------------------------------------------------------
+
+    def snapshot(self):
+        """Flat numeric dict — the ``profiler.serving_counters()``
+        surface. Latencies are reported in milliseconds (matching the
+        ``*_ms`` lower-is-better convention of bench_compare)."""
+        now = time.monotonic()
+        with self._lock:
+            st = dict(self.counters)
+            self._trim_window_locked(now)
+            window = min(_QPS_WINDOW_S, max(now - self._started, 1e-9))
+            st["qps_60s"] = round(len(self._completions) / window, 3)
+            for prefix, hist in (("latency", self.request_latency),
+                                 ("exec", self.exec_latency)):
+                st[f"{prefix}_p50_ms"] = round(
+                    hist.quantile(0.50) * 1e3, 3)
+                st[f"{prefix}_p95_ms"] = round(
+                    hist.quantile(0.95) * 1e3, 3)
+                st[f"{prefix}_p99_ms"] = round(
+                    hist.quantile(0.99) * 1e3, 3)
+            st["batch_rows_mean"] = round(
+                self.batch_rows.sum / self.batch_rows.total, 3) \
+                if self.batch_rows.total else 0.0
+            st["pad_ratio"] = round(
+                st["padded_rows"] / st["true_rows"], 4) \
+                if st["true_rows"] else 0.0
+        st["queue_depth"] = self.queue_depth()
+        return st
+
+    def reset(self):
+        """Zero counters and histograms (tests, benchmarks). Depth
+        probes survive — they belong to live batchers, not to the
+        sample window."""
+        with self._lock:
+            self._reset_locked()
+
+    def prometheus_text(self):
+        """Prometheus text exposition of the registry — the
+        ``/metrics`` endpoint body."""
+        lines = []
+
+        def emit(name, value, help_=None, typ="counter", labels=""):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {typ}")
+            lines.append(f"{name}{labels} {value}")
+
+        with self._lock:
+            counters = dict(self.counters)
+            hists = [("mxnet_serving_request_latency_seconds",
+                      self.request_latency.snapshot(),
+                      self.request_latency.bounds,
+                      "end-to-end request latency"),
+                     ("mxnet_serving_exec_latency_seconds",
+                      self.exec_latency.snapshot(),
+                      self.exec_latency.bounds,
+                      "model execution latency per coalesced batch"),
+                     ("mxnet_serving_batch_rows",
+                      self.batch_rows.snapshot(),
+                      self.batch_rows.bounds,
+                      "rows per executed batch")]
+        for name, value in sorted(counters.items()):
+            emit(f"mxnet_serving_{name}_total", value,
+                 help_=f"serving counter {name}")
+        emit("mxnet_serving_queue_depth", self.queue_depth(),
+             help_="live batcher queue depth", typ="gauge")
+        for name, snap, bounds, help_ in hists:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for b, c in zip(bounds, snap["counts"]):
+                cum += c
+                lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+            lines.append(
+                f'{name}_bucket{{le="+Inf"}} {snap["total"]}')
+            lines.append(f"{name}_sum {snap['sum']}")
+            lines.append(f"{name}_count {snap['total']}")
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide registry every serving component reports into
+METRICS = ServingMetrics()
+
+
+def serving_stats():
+    """Flat numeric serving counters (the profiler surface)."""
+    return METRICS.snapshot()
+
+
+def reset_serving_counters():
+    """Zero the process-wide serving counters (tests, benchmarks)."""
+    METRICS.reset()
+
+
+def prometheus_text():
+    """Prometheus text rendering of the process-wide registry."""
+    return METRICS.prometheus_text()

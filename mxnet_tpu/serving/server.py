@@ -1,0 +1,222 @@
+"""Stdlib HTTP front end: JSON / npy inference over ThreadingHTTPServer.
+
+The network face of the serving subsystem (reference analog: the MXNet
+model-server REST surface). Deliberately stdlib-only — no framework
+dependency beyond numpy, which the package already requires — so a
+serving container needs nothing the training image doesn't have.
+
+Endpoints:
+
+- ``POST /predict`` — ``application/json`` body ``{"data": <nested
+  list>}`` (or ``{"inputs": [<list>, ...]}`` for multi-input models)
+  returns ``{"outputs": [...], "shapes": [...]}``; raw
+  ``application/x-npy`` body returns the first output as npy bytes.
+- ``GET /healthz`` — liveness + warm state (``200`` once every bucket
+  executable is resolved; load balancers gate on this so a cold
+  replica never takes traffic).
+- ``GET /metrics`` — Prometheus text exposition of the process-wide
+  serving registry.
+
+Error mapping: validation ``ValueError`` -> 400, queue backpressure
+(:class:`~mxnet_tpu.serving.batcher.ServerBusy`) -> 503, deadline
+(:class:`~mxnet_tpu.serving.batcher.RequestTimeout` or a result-wait
+timeout) -> 504, anything else -> 500. ``stop()`` is graceful: the
+listener closes first, then the batcher drains (engine.close() order —
+no accepted request is dropped).
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as onp
+
+from .batcher import DynamicBatcher, RequestTimeout, ServerBusy
+from .metrics import prometheus_text
+
+__all__ = ["ModelServer"]
+
+_MAX_BODY = 64 * 1024 * 1024  # 64 MiB request-body bound
+
+
+class ModelServer:
+    """HTTP serving endpoint over an InferenceSession / DynamicBatcher.
+
+    ``ModelServer(session)`` owns a batcher built from the
+    ``MXNET_SERVING_*`` knobs; pass ``batcher=`` to share an existing
+    one (it will NOT be closed on ``stop()``). ``port=0`` binds an
+    ephemeral port (tests); read it back via ``server.port`` after
+    ``start()``."""
+
+    def __init__(self, session=None, batcher=None, host=None, port=None):
+        from .. import env as _env
+
+        if (session is None) == (batcher is None):
+            raise ValueError("exactly one of session= / batcher= is "
+                             "required")
+        self._own_batcher = batcher is None
+        self.batcher = batcher or DynamicBatcher(session)
+        self.session = session or self.batcher.session
+        self._host = host if host is not None else _env.get_str(
+            "MXNET_SERVING_HOST", "127.0.0.1")
+        self._port = int(port if port is not None else _env.get_int(
+            "MXNET_SERVING_PORT", 8080))
+        self._httpd = None
+        self._thread = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        """Bind and serve in a daemon thread; returns self."""
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class _Handler(_ServingHandler):
+            model_server = server
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mxnet-serving-http", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def port(self):
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._port
+
+    @property
+    def address(self):
+        return f"http://{self._host}:{self.port}"
+
+    def stop(self):
+        """Graceful shutdown: close the listener (stop accepting),
+        then drain the batcher (owned batchers only). Idempotent."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._own_batcher:
+            self.batcher.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    model_server = None  # bound per-server by ModelServer.start
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # default: stderr spam
+        logging.debug("serving http: " + fmt, *args)
+
+    def _reply(self, code, body, content_type="application/json"):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, message):
+        self._reply(code, {"error": message})
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self):
+        srv = self.model_server
+        if self.path == "/healthz":
+            session = srv.session
+            warm = bool(getattr(session, "warm", True))
+            # 503 until warm so a status-code health check (the
+            # standard LB kind) keeps traffic off a cold replica
+            self._reply(200 if warm else 503, {
+                "status": "ok" if warm else "warming",
+                "warm": warm,
+                "buckets": list(getattr(session, "buckets", [])),
+                "queue_depth": srv.batcher.qsize()})
+        elif self.path == "/metrics":
+            self._reply(200, prometheus_text().encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._error(404, f"no route {self.path!r}")
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self):
+        if self.path not in ("/predict", "/invocations"):
+            self._error(404, f"no route {self.path!r}")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._error(400, "bad Content-Length")
+            return
+        if length <= 0 or length > _MAX_BODY:
+            self._error(400, f"body length {length} out of bounds "
+                             f"(max {_MAX_BODY})")
+            return
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or
+                 "application/json").split(";")[0].strip().lower()
+        try:
+            if ctype == "application/x-npy":
+                inputs = [onp.load(io.BytesIO(body), allow_pickle=False)]
+                as_npy = True
+            else:
+                doc = json.loads(body)
+                if isinstance(doc, dict) and "inputs" in doc:
+                    inputs = [onp.asarray(x) for x in doc["inputs"]]
+                elif isinstance(doc, dict) and "data" in doc:
+                    inputs = [onp.asarray(doc["data"])]
+                else:
+                    raise ValueError(
+                        'JSON body must carry "data" or "inputs"')
+                as_npy = False
+        except ValueError as e:
+            self._error(400, f"unparseable request body: {e}")
+            return
+        try:
+            outs = self.model_server.batcher.predict(*inputs)
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        except ServerBusy as e:
+            self._error(503, str(e))
+            return
+        except (RequestTimeout, _FutureTimeout) as e:
+            self._error(504, str(e) or "request timed out")
+            return
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            logging.exception("serving: predict failed")
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        outs = [onp.asarray(o) for o in outs]  # batcher yields host arrays
+        if as_npy:
+            buf = io.BytesIO()
+            onp.save(buf, outs[0])
+            self._reply(200, buf.getvalue(),
+                        content_type="application/x-npy")
+        else:
+            self._reply(200, {
+                "outputs": [o.tolist() for o in outs],
+                "shapes": [list(o.shape) for o in outs]})

@@ -1,0 +1,577 @@
+"""InferenceSession: an exported/hybridizable Block as a serving engine.
+
+Turns a model — a hybridizable ``gluon.Block``, or an exported
+``*-symbol.json`` + ``*.params`` pair via :meth:`InferenceSession.load`
+(reference analog: the MXNet model-server loading ``SymbolBlock.imports``
+artifacts) — into a fixed set of **bucket executables**: one AOT-compiled
+XLA program per configured batch size. Requests of any batch size are
+padded up to the smallest covering bucket and outputs sliced back, the
+``MXNET_SHAPE_BUCKETS`` discipline (round 9) applied to whole-model
+inference, so a variable request stream never retraces.
+
+Eval-mode contract: forward runs under ``autograd.pause
+(train_mode=False)`` — no tape, no BatchNorm stat updates, dropout off —
+and parameter mutation during the trace is dropped with a one-time
+warning (a serving forward must be side-effect free). Outputs must be
+batch-major and row-independent (output row i depends on input row i
+only), which every standard inference head satisfies; padding is
+zero-fill and padded rows are sliced off before anyone reads them.
+
+Warm start: each bucket executable is resolved through the persistent
+compile cache (``utils/compile_cache.py``) under a fingerprint of the
+model's symbol-graph JSON + parameter/input avals + AMP version. A warm
+process deserializes every bucket at :meth:`warmup` — **zero traces,
+zero XLA compiles** before the first request, verifiable via
+``profiler.compile_cache_counters()['retraces']``. Models that cannot
+symbol-trace fall back to memory-only executables (first process pays
+the compile; correctness unchanged).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+
+import numpy as onp
+
+from .. import autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import random as mxrandom
+from ..utils import compile_cache as cc
+from .metrics import METRICS
+
+__all__ = ["InferenceSession", "parse_buckets"]
+
+
+def parse_buckets(raw, max_batch):
+    """Batch-size buckets from an ``MXNET_SERVING_BUCKETS``-style spec:
+    ``pow2`` (default) — powers of two up to ``max_batch``; ``mult:N`` —
+    multiples of N up to ``max_batch``; or an explicit comma list
+    ("1,4,16,64"). Always includes ``max_batch`` itself and is returned
+    sorted ascending."""
+    raw = (raw or "pow2").strip()
+    buckets = set()
+    if raw == "pow2":
+        b = 1
+        while b < max_batch:
+            buckets.add(b)
+            b <<= 1
+    elif raw.startswith("mult:"):
+        try:
+            n = int(raw.split(":", 1)[1])
+        except ValueError:
+            n = 0
+        if n < 1:
+            raise MXNetError(
+                f"invalid bucket spec {raw!r} (expected mult:N, N >= 1)")
+        buckets.update(range(n, max_batch, n))
+    else:
+        try:
+            buckets.update(int(tok) for tok in raw.split(",") if tok.strip())
+        except ValueError:
+            raise MXNetError(
+                f"invalid bucket spec {raw!r} (expected pow2 | mult:N | "
+                "comma list)") from None
+        if any(b < 1 for b in buckets):
+            raise MXNetError(f"bucket sizes must be >= 1 (got {raw!r})")
+        # explicit lists fail fast instead of silently dropping
+        # entries the operator configured (generated specs cap quietly)
+        too_big = sorted(b for b in buckets if b > max_batch)
+        if too_big:
+            raise MXNetError(
+                f"explicit bucket(s) {too_big} exceed max_batch "
+                f"{max_batch}; raise MXNET_SERVING_MAX_BATCH or drop "
+                "them")
+    buckets.add(int(max_batch))
+    return sorted(b for b in buckets if b <= max_batch)
+
+
+class _InputSpec:
+    """One data input: name + per-row (batch-less) shape + dtype."""
+
+    __slots__ = ("name", "row_shape", "dtype")
+
+    def __init__(self, name, row_shape, dtype):
+        self.name = name
+        self.row_shape = tuple(int(d) for d in row_shape)
+        self.dtype = onp.dtype(dtype)
+
+    def __repr__(self):
+        return (f"_InputSpec({self.name!r}, (N, "
+                f"{', '.join(map(str, self.row_shape))}), {self.dtype})")
+
+
+class _BucketEntry:
+    """One resolved bucket: the executable + its provenance."""
+
+    __slots__ = ("bucket", "amp_ver", "fn", "num_outputs", "from_disk")
+
+    def __init__(self, bucket, amp_ver, fn, num_outputs, from_disk):
+        self.bucket = bucket
+        self.amp_ver = amp_ver
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.from_disk = from_disk
+
+
+class InferenceSession:
+    """Eval-mode, no-tape, bucket-compiled forward over a Block.
+
+    Parameters
+    ----------
+    block : gluon.Block
+        The model. Parameters must be initialized, or initializable
+        from one eager forward over a zeros example.
+    example : NDArray / numpy array / tuple of them, optional
+        Example input(s) — batch axis first — from which per-input row
+        shapes and dtypes are taken. Exactly one of ``example`` /
+        ``input_shapes`` is required.
+    input_shapes : sequence of shape tuples, optional
+        Full input shapes INCLUDING a (placeholder) batch axis, e.g.
+        ``[(1, 784)]``; dtype float32 unless ``input_dtypes`` is given.
+    input_dtypes : sequence of dtypes, optional
+    buckets : sequence of int, optional
+        Batch-size buckets to compile. Default: the
+        ``MXNET_SERVING_BUCKETS`` policy over ``MXNET_SERVING_MAX_BATCH``.
+    max_batch : int, optional
+        Upper bucket bound (default ``MXNET_SERVING_MAX_BATCH``).
+        Larger requests are chunked.
+    warm : bool
+        Resolve every bucket executable in the constructor (AOT compile
+        or disk deserialize). ``warm=False`` defers each bucket to its
+        first request.
+    """
+
+    def __init__(self, block, example=None, input_shapes=None,
+                 input_dtypes=None, buckets=None, max_batch=None,
+                 warm=True):
+        from .. import env as _env
+
+        self._block = block
+        self._lock = threading.Lock()
+        self._entries = {}  # (bucket, amp_ver) -> _BucketEntry
+        self._num_outputs = None
+        self._mutation_warned = False
+        max_batch = int(max_batch or _env.get_int(
+            "MXNET_SERVING_MAX_BATCH", 32))
+        if buckets is None:
+            buckets = parse_buckets(
+                _env.get_str("MXNET_SERVING_BUCKETS"), max_batch)
+        self.buckets = sorted(int(b) for b in set(buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise MXNetError("buckets must be a non-empty set of "
+                             f"positive batch sizes (got {buckets})")
+        self._input_specs = self._resolve_input_specs(
+            example, input_shapes, input_dtypes)
+        self._ensure_initialized()
+        self._param_list = [p for _, p in
+                            sorted(block.collect_params().items())]
+        self._param_names = [name for name, _ in
+                             sorted(block.collect_params().items())]
+        self._param_vals = [p._ndarray._data for p in self._param_list]
+        self._graph_sig = self._graph_signature()
+        self._jitted_by_ver = {}
+        if warm:
+            self.warmup()
+
+    # -- construction helpers -----------------------------------------
+
+    @classmethod
+    def load(cls, prefix, input_names=None, epoch=0, input_shapes=None,
+             **kwargs):
+        """Build a session from an exported model: ``{prefix}-symbol.json``
+        + ``{prefix}-{epoch:04d}.params`` (the ``Block.export`` layout).
+        ``input_names=None`` infers the data inputs as the graph
+        variables not present in the params file (SymbolBlock.imports
+        loader glue)."""
+        import os
+
+        from ..gluon.block import SymbolBlock
+
+        symbol_file = f"{prefix}-symbol.json"
+        param_file = f"{prefix}-{epoch:04d}.params"
+        if not os.path.exists(param_file):
+            # a session over uninitialized params can only serve
+            # garbage or die later with a cryptic deferred-init error —
+            # name the operator's actual mistake (prefix/epoch) here
+            raise MXNetError(
+                f"params file {param_file!r} not found (Block.export "
+                "writes {prefix}-{epoch:04d}.params; check prefix and "
+                "epoch)")
+        block = SymbolBlock.imports(symbol_file, input_names, param_file)
+        return cls(block, input_shapes=input_shapes, **kwargs)
+
+    def _resolve_input_specs(self, example, input_shapes, input_dtypes):
+        if (example is None) == (input_shapes is None):
+            raise MXNetError("exactly one of example= / input_shapes= "
+                             "is required")
+        names = [getattr(i, "name", f"data{k}") for k, i in
+                 enumerate(getattr(self._block, "_inputs", []))] or None
+        specs = []
+        if example is not None:
+            if not isinstance(example, (list, tuple)):
+                example = [example]
+            for k, ex in enumerate(example):
+                arr = ex.asnumpy() if isinstance(ex, NDArray) else \
+                    onp.asarray(ex)
+                if arr.ndim < 1:
+                    raise MXNetError("example inputs must carry a batch "
+                                     "axis")
+                name = names[k] if names and k < len(names) else f"data{k}"
+                specs.append(_InputSpec(name, arr.shape[1:], arr.dtype))
+        else:
+            input_dtypes = input_dtypes or ["float32"] * len(input_shapes)
+            for k, (shape, dt) in enumerate(zip(input_shapes,
+                                                input_dtypes)):
+                if len(shape) < 1:
+                    raise MXNetError("input_shapes entries must include "
+                                     "the batch axis")
+                name = names[k] if names and k < len(names) else f"data{k}"
+                specs.append(_InputSpec(name, tuple(shape)[1:], dt))
+        return specs
+
+    def _ensure_initialized(self):
+        params = self._block.collect_params()
+        if all(p._ndarray is not None for p in params.values()):
+            return
+        # one throwaway eager forward over zeros finishes deferred init
+        zeros = [nd.zeros((1,) + s.row_shape, dtype=str(s.dtype))
+                 for s in self._input_specs]
+        with autograd.pause(train_mode=False):
+            self._block.forward(*zeros)
+
+    def _graph_signature(self):
+        """Process-stable model identity for the disk fingerprint: the
+        nnvm JSON of the model's symbol graph (SymbolBlock carries it;
+        other blocks are traced through the F=sym namespace, the
+        ``export`` path). None when the block cannot symbol-trace —
+        those sessions compile per process (memory-only executables)."""
+        from .. import name as _name_mod
+        from .. import symbol as sym
+        from ..gluon.block import SymbolBlock
+
+        try:
+            if isinstance(self._block, SymbolBlock):
+                return self._block._outputs.tojson()
+            # a FRESH NameManager makes op-node names deterministic
+            # (counter starts at zero per trace): the same model yields
+            # the same JSON in every process — and on every re-trace —
+            # so warm starts actually hit. Explicit names (param/input
+            # variables) pass through untouched.
+            with _name_mod.NameManager():
+                out = self._block(*[sym.var(s.name)
+                                    for s in self._input_specs])
+            if isinstance(out, (list, tuple)):
+                out = sym.Group(list(out))
+            return out.tojson()
+        except Exception:
+            return None
+
+    # -- the pure function every bucket compiles ----------------------
+
+    def _pure(self, param_vals, key, input_datas):
+        """(param values, PRNG key, input arrays) -> tuple of output
+        arrays; eval mode, no tape. The CachedOp._pure pattern without
+        the mutation return path: serving forwards must be side-effect
+        free, so trace-time parameter mutation is dropped (warned
+        once)."""
+        pnds = [p._ndarray for p in self._param_list]
+        saved = [p._data for p in pnds]
+        try:
+            for p, v in zip(pnds, param_vals):
+                p._data = v
+            with autograd.pause(train_mode=False), \
+                    mxrandom.key_provider(key):
+                args = [NDArray(d) for d in input_datas]
+                outs = self._block.forward(*args)
+            if isinstance(outs, NDArray):
+                flat = [outs]
+            else:
+                flat = [o for o in outs]
+            self._num_outputs = len(flat)
+            if not self._mutation_warned and any(
+                    p._data is not v
+                    for p, v in zip(pnds, param_vals)):
+                self._mutation_warned = True
+                logging.warning(
+                    "InferenceSession: forward mutated parameters "
+                    "during the eval-mode trace; serving drops the "
+                    "mutation (side-effect-free contract)")
+            return tuple(o.data for o in flat)
+        finally:
+            for p, v in zip(pnds, saved):
+                p._data = v
+
+    # -- bucket resolution --------------------------------------------
+
+    def _amp_version(self):
+        from ..ndarray import registry as _op_registry
+
+        return _op_registry.amp_version()
+
+    def _jitted_for(self, amp_ver):
+        """One jitted object PER AMP VERSION: ``jit(...).lower`` caches
+        traces by aval, so re-lowering one shared jitted function after
+        an ``amp.init()``/``disable()`` flip would replay the stale
+        jaxpr — old casts baked in. A fresh function object per version
+        gets a fresh trace cache (the CachedOp static-amp_ver pattern,
+        without changing the executable's call signature)."""
+        jf = self._jitted_by_ver.get(amp_ver)
+        if jf is None:
+            def pure(param_vals, key, input_datas):
+                """Serving forward (AMP policy version %d)."""
+                return self._pure(param_vals, key, input_datas)
+
+            pure.__doc__ = pure.__doc__ % amp_ver
+            jf = cc.counting_jit(pure, label="serving")
+            self._jitted_by_ver[amp_ver] = jf
+        return jf
+
+    def _graph_op_bodies(self):
+        """The registered op functions the graph's nodes dispatch to —
+        their bytecode digests salt the fingerprint (the round-9 rule:
+        editing an op implementation must invalidate disk entries, not
+        silently serve the old math)."""
+        import json as _json
+
+        from ..ndarray import _CAMEL_ALIASES
+        from ..ndarray.registry import get_op
+
+        bodies = []
+        try:
+            nodes = _json.loads(self._graph_sig)["nodes"]
+        except Exception:
+            return bodies
+        for opname in sorted({n.get("op") or "null" for n in nodes}):
+            if opname == "null":
+                continue
+            opdef = get_op(_CAMEL_ALIASES.get(opname, opname))
+            if opdef is not None:
+                bodies.append(opdef.fn)
+        return bodies
+
+    def _fingerprint(self, bucket, amp_ver):
+        if self._graph_sig is None:
+            return None
+        key = ("serving", hashlib.sha256(
+            self._graph_sig.encode()).hexdigest(),
+            tuple(self._param_names),
+            tuple((tuple(v.shape), str(v.dtype))
+                  for v in self._param_vals),
+            tuple((s.name, (bucket,) + s.row_shape, str(s.dtype))
+                  for s in self._input_specs),
+            amp_ver, bucket)
+        code_of = [type(self)._pure, type(self._block).forward]
+        code_of.extend(self._graph_op_bodies())
+        return cc.fingerprint("serving", key, code_of=tuple(code_of))
+
+    def _avals(self, bucket):
+        import jax
+
+        sds = jax.ShapeDtypeStruct
+        # shape/dtype of a PRNG key WITHOUT drawing one: warmup must not
+        # advance the ambient eager stream (PRNG neutrality, cf. the
+        # round-9 Trainer.warmup contract)
+        key = jax.random.PRNGKey(0)
+        param_avals = [sds(v.shape, v.dtype) for v in self._param_vals]
+        key_aval = sds(key.shape, key.dtype)
+        input_avals = [sds((bucket,) + s.row_shape, s.dtype)
+                       for s in self._input_specs]
+        return param_avals, key_aval, input_avals
+
+    def _entry(self, bucket):
+        """The resolved executable for ``bucket`` under the CURRENT AMP
+        policy (an ``amp.init()``/``disable()`` between calls re-resolves
+        — AMP casts are baked into the trace, like CachedOp)."""
+        amp_ver = self._amp_version()
+        ent = self._entries.get((bucket, amp_ver))
+        if ent is not None:
+            return ent
+        with self._lock:
+            ent = self._entries.get((bucket, amp_ver))
+            if ent is not None:
+                return ent
+            fp = self._fingerprint(bucket, amp_ver)
+            # meta is a callable: num_outputs is only known after the
+            # trace runs (a warm process reads it from the envelope of
+            # an executable it never traced)
+            fn, meta, from_disk = cc.load_or_compile(
+                fp, self._jitted_for(amp_ver), self._avals(bucket),
+                meta=lambda: {"num_outputs": self._num_outputs})
+            if from_disk:
+                METRICS.bump("warm_disk_hits")
+                if self._num_outputs is None:
+                    self._num_outputs = meta.get("num_outputs")
+            else:
+                METRICS.bump("warm_compiles")
+            ent = _BucketEntry(bucket, amp_ver, fn,
+                               self._num_outputs, from_disk)
+            self._entries[(bucket, amp_ver)] = ent
+            return ent
+
+    def warmup(self, buckets=None):
+        """Resolve every bucket executable now (AOT compile, or disk
+        deserialize on a warm start). Returns ``{"disk_hits": n,
+        "compiles": m}`` for this call."""
+        hits = compiles = 0
+        for b in (buckets or self.buckets):
+            ent = self._entry(int(b))
+            if ent.from_disk:
+                hits += 1
+            else:
+                compiles += 1
+        return {"disk_hits": hits, "compiles": compiles}
+
+    @property
+    def warm(self):
+        """True when every configured bucket is resolved under the
+        current AMP policy."""
+        amp_ver = self._amp_version()
+        return all((b, amp_ver) in self._entries for b in self.buckets)
+
+    # -- the request path ---------------------------------------------
+
+    @property
+    def input_specs(self):
+        return list(self._input_specs)
+
+    @property
+    def num_outputs(self):
+        return self._num_outputs
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def refresh_params(self):
+        """Re-snapshot parameter values from the block (after a live
+        weight update). Executables are shape-keyed, so no recompile."""
+        with self._lock:
+            self._param_vals = [p._ndarray._data
+                                for p in self._param_list]
+
+    def validate(self, *inputs):
+        """Check request inputs against the session's input specs;
+        returns (arrays, batch). NDArrays pass through untouched (the
+        device-native path); everything else is coerced to a HOST numpy
+        array of the spec dtype — deliberately not uploaded here, so
+        batchers can coalesce and pad in pure numpy (no per-pattern XLA
+        prim compiles) and pay exactly one device transfer per executed
+        batch. Raises ``ValueError`` — the per-request failure a
+        batcher reports on one future without poisoning its batch."""
+        if len(inputs) != len(self._input_specs):
+            raise ValueError(
+                f"expected {len(self._input_specs)} input(s), got "
+                f"{len(inputs)}")
+        arrs, batch = [], None
+        for x, spec in zip(inputs, self._input_specs):
+            if isinstance(x, NDArray):
+                # the bucket executables are traced at the spec dtype;
+                # a mismatched device array would raise inside the AOT
+                # Compiled and permanently degrade that bucket to the
+                # jit path — reject it here, per-request
+                if onp.dtype(x.dtype) != spec.dtype:
+                    raise ValueError(
+                        f"input {spec.name!r} dtype {x.dtype} != "
+                        f"expected {spec.dtype}")
+                arr = x
+            else:
+                try:
+                    arr = onp.asarray(x, dtype=spec.dtype)
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"input {spec.name!r} is not convertible to "
+                        f"dtype {spec.dtype}: {e}") from None
+            if tuple(arr.shape[1:]) != spec.row_shape:
+                raise ValueError(
+                    f"input {spec.name!r} row shape "
+                    f"{tuple(arr.shape[1:])} != expected "
+                    f"{spec.row_shape}")
+            if batch is None:
+                batch = arr.shape[0]
+            elif arr.shape[0] != batch:
+                raise ValueError("inputs disagree on batch size "
+                                 f"({batch} vs {arr.shape[0]})")
+            if batch == 0:
+                raise ValueError("empty batch")
+            arrs.append(arr)
+        return arrs, batch
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _run_bucket(self, arrs, n):
+        """Execute one <=max_batch slice through its bucket executable;
+        returns the list of output jax arrays sliced back to ``n``
+        rows. Host (numpy) inputs are padded in numpy and uploaded
+        ONCE — no shape-dependent eager prims on the request path;
+        device (NDArray) inputs pad on device."""
+        bucket = self._bucket_for(n)
+        ent = self._entry(bucket)
+        datas = []
+        for a in arrs:
+            if isinstance(a, NDArray):
+                datas.append(cc.pad_batch(a.data, bucket))
+            else:
+                if a.shape[0] != bucket:
+                    padded = onp.zeros((bucket,) + a.shape[1:], a.dtype)
+                    padded[:a.shape[0]] = a
+                    a = padded
+                datas.append(nd.array(a).data)
+        key = mxrandom.next_key()
+        out = ent.fn(self._param_vals, key, datas)
+        METRICS.bump("bucket_execs")
+        METRICS.bump("padded_rows", bucket - n)
+        METRICS.bump("true_rows", n)
+        if bucket == n:
+            return list(out)  # nothing padded: no slice op to pay
+        return [cc.slice_batch(o, bucket, n) for o in out]
+
+    def predict(self, *inputs):
+        """Run eval-mode inference. Inputs may be NDArrays or anything
+        ``numpy.asarray`` accepts (batch axis first). Batches larger
+        than ``max_batch`` are chunked. Returns an NDArray (single
+        output) or tuple of NDArrays."""
+        arrs, batch = self.validate(*inputs)
+        t0 = time.perf_counter()
+        chunks = []
+        start = 0
+        while start < batch:
+            n = min(self.max_batch, batch - start)
+            if start == 0 and n == batch:
+                chunk = arrs  # whole request fits one bucket: no slice
+            else:
+                chunk = [NDArray(a.data[start:start + n])
+                         if isinstance(a, NDArray) else
+                         a[start:start + n] for a in arrs]
+            chunks.append(self._run_bucket(chunk, n))
+            start += n
+        if len(chunks) == 1:
+            outs = chunks[0]
+        else:
+            import jax.numpy as jnp
+
+            outs = [jnp.concatenate([c[i] for c in chunks], axis=0)
+                    for i in range(len(chunks[0]))]
+        # sync before stamping: jax dispatch is asynchronous, and an
+        # unsynced stamp would report enqueue time as exec latency
+        import jax
+
+        jax.block_until_ready(outs)
+        METRICS.observe_batch(batch, time.perf_counter() - t0)
+        result = tuple(NDArray(o) for o in outs)
+        return result[0] if len(result) == 1 else result
+
+    def __call__(self, *inputs):
+        return self.predict(*inputs)
+
+    def __repr__(self):
+        return (f"InferenceSession({type(self._block).__name__}, "
+                f"inputs={self._input_specs}, buckets={self.buckets}, "
+                f"warm={self.warm})")

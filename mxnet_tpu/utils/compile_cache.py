@@ -60,8 +60,8 @@ import numpy as onp
 
 __all__ = ["cache_enabled", "cache_dir", "fingerprint", "disk_load",
            "disk_store", "counting_jit", "note_retrace", "aot_compile",
-           "GuardedCompiled", "bucket_spec", "bucket_size",
-           "plan_bucketing", "pad_batch", "slice_batch",
+           "load_or_compile", "GuardedCompiled", "bucket_spec",
+           "bucket_size", "plan_bucketing", "pad_batch", "slice_batch",
            "compile_cache_stats", "reset_compile_cache_counters"]
 
 FORMAT_VERSION = 1
@@ -428,6 +428,27 @@ def aot_compile(jitted, *args, **kwargs):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         return jitted.lower(*args, **kwargs).compile()
+
+
+def load_or_compile(fp, jitted, args, meta=None):
+    """The whole warm-start story as one call: deserialize the disk
+    entry under ``fp`` if present, else AOT-compile ``jitted`` over
+    ``args`` (avals or concrete arrays) and persist it. Returns
+    ``(fn, meta, from_disk)`` where ``fn`` is a :class:`GuardedCompiled`
+    (any aval mismatch or stale artifact degrades to the jit path
+    rather than erroring the caller). ``meta`` may be a dict or a
+    zero-arg callable evaluated AFTER the fresh compile — so metadata
+    derived at trace time (output arity, tree structure) can ride the
+    envelope for warm processes that never trace. ``fp=None`` (an
+    unstable key) compiles memory-only."""
+    loaded = disk_load(fp)
+    if loaded is not None:
+        compiled, m = loaded
+        return GuardedCompiled(compiled, jitted), m, True
+    compiled = aot_compile(jitted, *args)
+    m = dict(meta() if callable(meta) else (meta or {}))
+    disk_store(fp, compiled, meta=m)
+    return GuardedCompiled(compiled, jitted), m, False
 
 
 class GuardedCompiled:

@@ -59,6 +59,40 @@ def test_compile_cache_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "compile_cache"
 
 
+@pytest.mark.slow
+def test_serving_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import serving_bench
+
+    out = str(tmp_path / "serve.json")
+    doc = serving_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["dynamic_bitwise_equal"]
+    assert doc["warm_start_bitwise_equal"]
+    assert doc["warm_start_zero_compiles"], \
+        "warm restart must serve its first request with zero compiles"
+    assert doc["results"]["warm_retraces"] == 0
+    assert doc["results"]["batching_speedup"] > 1.0
+    assert doc["results"]["latency_p99_ms"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "serving"
+
+
+def test_bench_compare_serving_latency_metrics():
+    """p50/p99 quantiles are lower-is-better whatever suffix they
+    carry; *_rps counts as throughput (BENCH_SERVE_r10.json names)."""
+    base = {"results": {"latency_p50_ms": 10.0, "latency_p99_ms": 25.0,
+                        "dynamic_rps": 18000.0, "batches": 32}}
+    worse = {"results": {"latency_p50_ms": 10.0, "latency_p99_ms": 40.0,
+                         "dynamic_rps": 9000.0, "batches": 32}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["results.latency_p99_ms"][4]  # +60% p99: REGRESSED
+    assert not rows["results.latency_p50_ms"][4]
+    assert rows["results.dynamic_rps"][4]     # rps halved: REGRESSED
+    assert "results.batches" not in rows      # not a perf direction
+    same = bench_compare.compare(base, base)
+    assert not any(r[4] for r in same)
+
+
 def test_bench_compare_retrace_metrics_gated():
     """The regression gate understands the BENCH_COMPILE_r09.json
     metric names: retrace counts are lower-is-better, the speedups

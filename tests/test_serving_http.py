@@ -1,0 +1,131 @@
+"""ModelServer HTTP end-to-end (slow: real sockets, excluded from
+tier-1 via ``-m 'not slow'``; the socketless batcher+session smoke
+coverage lives in test_serving.py)."""
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, serving
+from mxnet_tpu.gluon import nn
+
+nd = mx.nd
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def served():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(nd.zeros((1, 8)))
+    sess = serving.InferenceSession(net, input_shapes=[(1, 8)],
+                                    buckets=[1, 4, 8])
+    server = serving.ModelServer(sess, port=0).start()
+    serving.reset_serving_counters()
+    yield net, server, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def _post(url, body, ctype="application/json"):
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": ctype})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_http_predict_json(served):
+    net, _, url = served
+    x = onp.random.RandomState(1).rand(3, 8).astype("float32")
+    resp = json.load(_post(url + "/predict",
+                           json.dumps({"data": x.tolist()}).encode()))
+    with autograd.pause(train_mode=False):
+        ref = net(nd.array(x)).asnumpy()
+    assert resp["shapes"] == [[3, 4]]
+    assert onp.array_equal(
+        onp.array(resp["outputs"][0], dtype="float32"), ref)
+
+
+def test_http_predict_npy_roundtrip(served):
+    net, _, url = served
+    x = onp.random.RandomState(2).rand(2, 8).astype("float32")
+    buf = io.BytesIO()
+    onp.save(buf, x)
+    resp = _post(url + "/predict", buf.getvalue(),
+                 ctype="application/x-npy")
+    assert resp.headers["Content-Type"] == "application/x-npy"
+    out = onp.load(io.BytesIO(resp.read()))
+    with autograd.pause(train_mode=False):
+        ref = net(nd.array(x)).asnumpy()
+    assert onp.array_equal(out, ref)
+
+
+def test_http_concurrent_clients_each_get_their_rows(served):
+    net, _, url = served
+    results = {}
+
+    def client(i):
+        x = onp.random.RandomState(10 + i).rand(1, 8).astype("float32")
+        resp = json.load(_post(
+            url + "/predict", json.dumps({"data": x.tolist()}).encode()))
+        results[i] = (x, onp.array(resp["outputs"][0], dtype="float32"))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 8
+    for x, out in results.values():
+        with autograd.pause(train_mode=False):
+            assert onp.array_equal(out, net(nd.array(x)).asnumpy())
+
+
+def test_http_healthz_and_metrics(served):
+    _, server, url = served
+    h = json.load(urllib.request.urlopen(url + "/healthz", timeout=30))
+    assert h["status"] == "ok"
+    assert h["warm"] is True
+    assert h["buckets"] == [1, 4, 8]
+    x = onp.ones((1, 8), dtype="float32")
+    _post(url + "/predict", json.dumps({"data": x.tolist()}).encode())
+    text = urllib.request.urlopen(url + "/metrics",
+                                  timeout=30).read().decode()
+    assert "mxnet_serving_responses_total 1" in text
+    assert "mxnet_serving_request_latency_seconds_bucket" in text
+
+
+def test_http_error_mapping(served):
+    _, _, url = served
+    # malformed JSON -> 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url + "/predict", b"not json")
+    assert e.value.code == 400
+    # wrong row shape -> 400, with the validation message
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url + "/predict",
+              json.dumps({"data": [[1.0, 2.0]]}).encode())
+    assert e.value.code == 400
+    assert "row shape" in json.load(e.value)["error"]
+    # unknown route -> 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url + "/nope", timeout=30)
+    assert e.value.code == 404
+
+
+def test_http_graceful_stop_is_idempotent(served):
+    _, server, url = served
+    x = onp.ones((2, 8), dtype="float32")
+    _post(url + "/predict", json.dumps({"data": x.tolist()}).encode())
+    server.stop()
+    server.stop()  # idempotent
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(url + "/healthz", timeout=3)

@@ -6,9 +6,12 @@ inferred from the leaf name:
 
 - lower is better:  ``*_us*``, ``*_ms*``, ``*latency*``, ``*_sec``,
   ``*retrace*`` (compile-count metrics from BENCH_COMPILE_r09.json —
-  more retraces in a like-for-like stream is a cache regression)
+  more retraces in a like-for-like stream is a cache regression),
+  ``*p50*``/``*p95*``/``*p99*`` (serving latency quantiles from
+  BENCH_SERVE_r10.json — tagged explicitly so a quantile leaf is
+  lower-is-better whatever unit suffix it carries)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
-  ``*items_per*``
+  ``*items_per*``, ``*_rps*`` (serving requests/sec)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -24,8 +27,10 @@ import argparse
 import json
 import sys
 
-LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace")
-HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec", "items_per")
+LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
+                   "p50", "p95", "p99")
+HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec", "items_per",
+                    "_rps")
 
 
 def _direction(path):
