@@ -105,6 +105,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import gluon
 from . import parallel
+from . import pipeline  # noqa: F401
 from . import utils  # noqa: F401
 from . import engine  # noqa: F401
 from . import libinfo  # noqa: F401

@@ -163,6 +163,35 @@ def _zeros_like_data(data):
     return jnp.zeros(data.shape, data.dtype)
 
 
+# grad-ready hooks: called with each marked variable the moment
+# ``backward`` writes its gradient, in deterministic program order —
+# the dispatch-as-ready seam the async gradient all-reduce
+# (pipeline/grad_sync.py) buckets on. Plain ``backward`` only: the
+# recorded/higher-order path yields tracer grads a collective must not
+# touch mid-trace.
+_GRAD_READY_HOOKS = []
+
+
+def register_grad_ready_hook(hook):
+    """Register ``hook(marked_ndarray)`` to fire right after each
+    marked variable's gradient is written by ``backward``. Returns a
+    zero-argument callable that unregisters it (idempotent)."""
+    _GRAD_READY_HOOKS.append(hook)
+
+    def remove():
+        try:
+            _GRAD_READY_HOOKS.remove(hook)
+        except ValueError:
+            pass
+
+    return remove
+
+
+def _signal_grad_ready(arr):
+    for hook in tuple(_GRAD_READY_HOOKS):
+        hook(arr)
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Compute gradients of heads w.r.t. all marked variables on the tape.
 
@@ -223,10 +252,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                     arr._grad._data = arr._grad._data + grads[id(arr)]
                 else:
                     arr._grad._data = jnp.asarray(grads[id(arr)], arr._grad.dtype)
+                if _GRAD_READY_HOOKS:
+                    _signal_grad_ready(arr)
     # heads may themselves be marked leaves that never appear on the tape
     for h in heads:
         if getattr(h, "_ag_marked", False) and id(h) not in seen and h._grad is not None:
             h._grad._data = jnp.asarray(grads[id(h)], h._grad.dtype)
+            if _GRAD_READY_HOOKS:
+                _signal_grad_ready(h)
 
     if not retain_graph:
         _STATE.tape = []

@@ -1,0 +1,292 @@
+"""End-to-end epoch benchmark: synchronous loop vs the async pipeline.
+
+The per-step executables are already fast (rounds 6-9); what this bench
+measures is the EPOCH — how much of the host-side data path the async
+pipeline (``mxnet_tpu.pipeline``) hides behind the compiled step.
+
+Two modes over an identical seeded batch stream from an IO-bound
+source (per-batch latency models storage/decode wait — it sleeps, i.e.
+releases the GIL exactly like blocking reads and C decode loops do —
+followed by real numpy normalization prep):
+
+- ``sync``: the classic loop — pull + prep the batch on the step
+  thread, ``nd.array`` H2D, forward/backward/``step``, then the
+  per-step metric readback every real training loop does
+  (``Module.fit`` updates its eval metric per batch). Every stage
+  serializes: epoch ≈ sum(io + prep + step + sync).
+- ``pipelined``: the same math through ``DeviceFeed`` — source pull +
+  prep + H2D run in the feed's worker thread ``MXNET_DEVICE_PREFETCH``
+  batches ahead — with the per-step metric kept ON DEVICE and read once
+  at epoch end (the async-metric idiom, docs/PIPELINE.md). Epoch ≈
+  max(io + prep, step).
+
+The source's IO latency is calibrated to the measured step time (the
+regime where a synchronous loop loses the most and a prefetcher must
+prove itself; ``--io-ms`` overrides). Parity is checked the hard way,
+in separate untimed runs: final parameters BITWISE equal across sync /
+pipelined / depth-0 fallback, identical per-step loss traces, and an
+identical AMP loss-scale episode trace through a poisoned (all-inf)
+batch that forces a fused skip-step. Profiler counters prove the
+overlap rather than asserting it: prefetch hits > 0 and the pipelined
+loop's stall ("engine idle") seconds collapse versus the synchronous
+loop's measured data wait.
+
+Emits one JSON document (default ``BENCH_PIPELINE_r11.json``)::
+
+    python -m mxnet_tpu.benchmark.pipeline_bench [--smoke] [--steps N]
+        [--io-ms MS] [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as onp
+
+
+def _make_net(dim, hidden, seed):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"))
+    net.add(nn.Dense(hidden, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize()
+    # materialize deferred-init params now so both modes draw identical
+    # initializer keys regardless of loop structure
+    from mxnet_tpu import nd
+
+    net(nd.zeros((1, dim)))
+    return net
+
+
+def _raw_batches(n_steps, batch, dim, seed, poison_at=None):
+    """Deterministic raw epoch data; ``poison_at`` makes one batch
+    all-inf (an AMP overflow episode both loops must skip identically)."""
+    rs = onp.random.RandomState(seed)
+    out = []
+    for s in range(n_steps):
+        x = rs.rand(batch, dim).astype("f")
+        y = rs.rand(batch, 10).astype("f")
+        if s == poison_at:
+            x = onp.full_like(x, onp.inf)
+        out.append((x, y))
+    return out
+
+
+def _prep(x):
+    """The host decode/augment stand-in: per-feature normalization.
+    (errstate: the poisoned all-inf AMP batch normalizes to NaN — by
+    design, both loops must skip it identically.)"""
+    with onp.errstate(invalid="ignore"):
+        return (x - x.mean(0)) / (x.std(0) + 1e-6)
+
+
+def _source(raw, io_s):
+    """IO-bound producer: blocking-wait latency + numpy prep per batch."""
+    for x, y in raw:
+        if io_s > 0:
+            time.sleep(io_s)
+        yield _prep(x), y
+
+
+def _train_setup(dim, hidden, seed, amp):
+    from mxnet_tpu import gluon
+
+    net = _make_net(dim, hidden, seed)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    if amp:
+        from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+        trainer._amp_loss_scaler = LossScaler(init_scale=2.0 ** 10,
+                                              scale_window=64)
+    return net, trainer
+
+
+def _step(net, trainer, xb, yb, batch):
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        out = net(xb)
+        loss = ((out - yb) ** 2).mean()
+    loss.backward()
+    trainer.step(batch)
+    return loss
+
+
+def _run_sync(raw, io_s, dim, hidden, batch, seed, amp=False,
+              scale_trace=None):
+    """The synchronous loop; returns (elapsed_s, loss floats, params)."""
+    from mxnet_tpu import nd
+
+    net, trainer = _train_setup(dim, hidden, seed, amp)
+    losses = []
+    t0 = time.perf_counter()
+    for x, y in _source(raw, io_s):
+        xb, yb = nd.array(x), nd.array(y)
+        loss = _step(net, trainer, xb, yb, batch)
+        # the per-step metric sync of a classic training loop
+        losses.append(float(loss.asnumpy()))
+        if scale_trace is not None:
+            scale_trace.append(trainer._amp_loss_scaler.loss_scale)
+    elapsed = time.perf_counter() - t0
+    return elapsed, losses, _param_bytes(net)
+
+
+def _run_pipelined(raw, io_s, dim, hidden, batch, seed, depth, amp=False,
+                   scale_trace=None):
+    """The async pipeline: DeviceFeed prefetch + deferred metric."""
+    from mxnet_tpu.pipeline import DeviceFeed
+
+    net, trainer = _train_setup(dim, hidden, seed, amp)
+    feed = DeviceFeed(_source(raw, io_s), depth=depth)
+    device_losses = []
+    t0 = time.perf_counter()
+    try:
+        for xb, yb in feed:
+            loss = _step(net, trainer, xb, yb, batch)
+            device_losses.append(loss)  # stays on device until epoch end
+            if scale_trace is not None:
+                scale_trace.append(trainer._amp_loss_scaler.loss_scale)
+        losses = [float(l.asnumpy()) for l in device_losses]
+    finally:
+        feed.close()
+    elapsed = time.perf_counter() - t0
+    return elapsed, losses, _param_bytes(net)
+
+
+def _param_bytes(net):
+    # creation order, NOT name order: auto-names carry a process-global
+    # counter (dense0, dense1, ...), and lexicographic order flips when
+    # a net spans a digit boundary (dense10_weight < dense9_bias) — two
+    # runs would then zip DIFFERENT layers against each other and
+    # report a phantom parity failure
+    return [p.data().asnumpy().tobytes()
+            for p in net.collect_params().values()]
+
+
+def _calibrate_io_ms(dim, hidden, batch, seed):
+    """Per-batch source latency matched to the measured step time (the
+    balanced regime: a synchronous loop pays io + step, the pipeline
+    pays max of them)."""
+    from mxnet_tpu import nd
+
+    net, trainer = _train_setup(dim, hidden, seed, amp=False)
+    rs = onp.random.RandomState(99)
+    xb = nd.array(rs.rand(batch, dim).astype("f"))
+    yb = nd.array(rs.rand(batch, 10).astype("f"))
+    for _ in range(3):  # compile + warm
+        _step(net, trainer, xb, yb, batch)
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        loss = _step(net, trainer, xb, yb, batch)
+    float(loss.asnumpy())
+    step_ms = (time.perf_counter() - t0) / n * 1e3
+    return min(20.0, max(1.0, step_ms)), step_ms
+
+
+def run(smoke=False, steps=None, io_ms=None, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    import mxnet_tpu  # noqa: F401 — backend up before timing
+    from mxnet_tpu.pipeline import (pipeline_counters,
+                                    reset_pipeline_counters)
+
+    dim, hidden = (128, 64) if smoke else (512, 256)
+    batch = 32 if smoke else 64
+    steps = steps or (10 if smoke else 60)
+    depth = 2
+    seed = 7
+
+    calibrated_ms, step_ms = _calibrate_io_ms(dim, hidden, batch, seed)
+    io_s = (io_ms if io_ms is not None else calibrated_ms) / 1e3
+    raw = _raw_batches(steps, batch, dim, seed=123)
+
+    # -- timed epochs (one warm epoch each so compiles are off-path) ----
+    _run_sync(raw[:2], io_s, dim, hidden, batch, seed)
+    sync_s, sync_losses, sync_params = _run_sync(
+        raw, io_s, dim, hidden, batch, seed)
+    sync_data_s = steps * io_s  # lower bound: the loop's blocking waits
+
+    _run_pipelined(raw[:2], io_s, dim, hidden, batch, seed, depth)
+    reset_pipeline_counters()
+    pipe_s, pipe_losses, pipe_params = _run_pipelined(
+        raw, io_s, dim, hidden, batch, seed, depth)
+    counters = pipeline_counters()
+
+    # -- fallback: depth 0 must be today's synchronous behavior --------
+    _, fb_losses, fb_params = _run_pipelined(
+        raw, io_s, dim, hidden, batch, seed, depth=0)
+
+    # -- AMP loss-scale episode parity (untimed) -----------------------
+    amp_steps = max(6, steps // 4)
+    amp_raw = _raw_batches(amp_steps, batch, dim, seed=321,
+                           poison_at=amp_steps // 2)
+    strace_sync, strace_pipe = [], []
+    _, amp_sync_losses, amp_sync_params = _run_sync(
+        amp_raw, 0.0, dim, hidden, batch, seed, amp=True,
+        scale_trace=strace_sync)
+    _, amp_pipe_losses, amp_pipe_params = _run_pipelined(
+        amp_raw, 0.0, dim, hidden, batch, seed, depth, amp=True,
+        scale_trace=strace_pipe)
+
+    doc = {
+        "benchmark": "pipeline_epoch",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        # config constants stay untagged for tools/bench_compare.py (a
+        # recalibrated source latency is not a perf regression)
+        "config": {"dim": dim, "hidden": hidden, "batch": batch,
+                   "steps": steps, "prefetch_depth": depth,
+                   "io_batch_wait": round(io_s * 1e3, 3),
+                   "io_calibrated_to_step": round(step_ms, 3)},
+        "results": {
+            "sync_epoch_s": round(sync_s, 4),
+            "pipelined_epoch_s": round(pipe_s, 4),
+            "epoch_speedup": round(sync_s / pipe_s, 3),
+            "sync_steps_per_s": round(steps / sync_s, 2),
+            "pipelined_steps_per_s": round(steps / pipe_s, 2),
+            "sync_engine_idle_s": round(sync_data_s, 4),
+            "pipelined_engine_idle_s": round(
+                counters["engine_idle_s"], 4),
+            "overlap_ratio": round(counters["overlap_ratio"], 4),
+        },
+        "bitwise_equal": sync_params == pipe_params,
+        "fallback_bitwise_equal": sync_params == fb_params,
+        "loss_trace_equal": sync_losses == pipe_losses and
+        sync_losses == fb_losses,
+        "amp_bitwise_equal": amp_sync_params == amp_pipe_params,
+        "loss_scale_trace_equal": strace_sync == strace_pipe,
+        "loss_scale_skip_exercised": any(
+            b < a for a, b in zip(strace_sync, strace_sync[1:])),
+        "counters": {k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in counters.items()},
+    }
+    out_path = out_path or "BENCH_PIPELINE_r11.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small model/iters; CPU tier-1 time budget")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--io-ms", type=float, default=None,
+                   help="per-batch source latency (default: calibrated "
+                        "to the measured step time)")
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, steps=a.steps, io_ms=a.io_ms,
+              out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -56,23 +56,55 @@ class Engine:
         nthreads = nthreads or _env.get_int(
             "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4)
         nlanes = nlanes or _env.get_int("MXNET_ENGINE_NUM_LANES", 2)
-        self._h = self._lib.eng_create_lanes(int(nthreads), int(nlanes))
-        self._nlanes = int(nlanes)
         self._lock = threading.Lock()
+        # close() coordination: _active counts threads inside a native
+        # call on the handle (close must not destroy it under them);
+        # _drained flips once close() has fully drained + destroyed, so
+        # post-close callers can order themselves after every pre-close
+        # op (a wait_for_var racing close() must NOT return before the
+        # op writing its slot ran — that silently loses the write)
+        self._cond = threading.Condition(self._lock)
+        self._active = 0
+        self._drained = threading.Event()
+        self._var_poison = {}  # var id -> exception, frozen at close()
         self._exceptions = {}  # op_id -> exception
         self._live_cbs = {}  # op_id -> (callback, ctx) keepalive
+        self._h = self._lib.eng_create_lanes(int(nthreads), int(nlanes))
+        self._nlanes = int(nlanes)
+
+    def _reserve(self):
+        """Pin the native handle for one call; None when closed. Every
+        _reserve() pairs with _release() — close() destroys the handle
+        only once no thread holds a reservation."""
+        with self._lock:
+            if self._h is None:
+                return None
+            self._active += 1
+            return self._h
+
+    def _release(self):
+        with self._cond:
+            self._active -= 1
+            if self._active == 0:
+                self._cond.notify_all()
 
     def new_variable(self):
-        h = self._h  # snapshot: close() may null the attr concurrently
+        h = self._reserve()
         if h is None:  # closed: inline mode needs no real deps
             return _Var(-1)
-        return _Var(self._lib.eng_new_var(h))
+        try:
+            return _Var(self._lib.eng_new_var(h))
+        finally:
+            self._release()
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              lane=LANE_COMPUTE):
         """Schedule fn() after its deps; returns the op id. An exception
         in fn poisons `mutable_vars` and surfaces at wait_for_var."""
-        if self._h is None:  # closed (atexit shutdown): run inline
+        if self._h is None:  # closed (atexit shutdown): run inline,
+            # but only after the drain — an in-flight pre-close op may
+            # write the same vars this fn depends on
+            self._drained.wait()
             fn()
             return -1
         holder = {}
@@ -119,51 +151,74 @@ class Engine:
                 # unboundedly between wait_all barriers
                 self._live_cbs[op_id] = (cb, writer_ids)
         if inline:
+            self._drained.wait()
             fn()
             return -1
         return op_id
 
     def wait_for_var(self, v):
-        """Block until all ops touching v finish; re-raise its poison."""
-        h = self._h  # snapshot: close() may null the attr concurrently
+        """Block until all ops touching v finish; re-raise its poison.
+        Racing close() is safe on both sides: a wait already inside the
+        native call pins the handle (close drains first and the live
+        pool completes the awaited op), and a wait arriving after the
+        close blocks on the drain — so when it returns, every pre-close
+        op touching v has truly run — then re-raises frozen poison."""
+        h = self._reserve()
         if h is None:
-            return
-        # snapshot BEFORE the barrier: an op pushed concurrently with the
-        # wait may still be running when it returns — only ops registered
-        # before the wait are provably done (same rule as wait_all)
-        with self._lock:
-            dead = [oid for oid, (_, var_ids) in self._live_cbs.items()
-                    if v.id in var_ids]
-        err_op = self._lib.eng_wait_for_var(h, v.id)
-        # those ops have completed and their trampolines returned
-        # (Complete runs after op->fn) — drop the keepalives
-        with self._lock:
-            for oid in dead:
-                self._live_cbs.pop(oid, None)
-        if err_op >= 0:
-            with self._lock:
-                exc = self._exceptions.get(err_op)
+            self._drained.wait()
+            exc = self._var_poison.get(v.id)
             if exc is not None:
                 raise exc
-            raise RuntimeError(f"engine op {err_op} failed")
+            return
+        try:
+            # snapshot BEFORE the barrier: an op pushed concurrently
+            # with the wait may still be running when it returns — only
+            # ops registered before the wait are provably done (same
+            # rule as wait_all)
+            with self._lock:
+                dead = [oid for oid, (_, var_ids) in self._live_cbs.items()
+                        if v.id in var_ids]
+            err_op = self._lib.eng_wait_for_var(h, v.id)
+            # those ops have completed and their trampolines returned
+            # (Complete runs after op->fn) — drop the keepalives
+            with self._lock:
+                for oid in dead:
+                    self._live_cbs.pop(oid, None)
+            if err_op >= 0:
+                with self._lock:
+                    exc = self._exceptions.get(err_op)
+                if exc is not None:
+                    raise exc
+                raise RuntimeError(f"engine op {err_op} failed")
+        finally:
+            self._release()
 
     def wait_all(self):
-        h = self._h  # snapshot: close() may null the attr concurrently
+        h = self._reserve()
         if h is None:
+            self._drained.wait()
             return
-        # snapshot BEFORE the barrier: a concurrent push() racing with the
-        # barrier's return may register a new callback whose op is still
-        # in flight — only ops pushed before the barrier are provably done
-        with self._lock:
-            done_ids = list(self._live_cbs)
-        self._lib.eng_wait_all(h)
-        self._gc_callbacks(done_ids)
+        try:
+            # snapshot BEFORE the barrier: a concurrent push() racing
+            # with the barrier's return may register a new callback
+            # whose op is still in flight — only ops pushed before the
+            # barrier are provably done
+            with self._lock:
+                done_ids = list(self._live_cbs)
+            self._lib.eng_wait_all(h)
+            self._gc_callbacks(done_ids)
+        finally:
+            self._release()
 
     def var_version(self, v):
-        h = self._h  # snapshot: close() may null the attr concurrently
+        h = self._reserve()
         if h is None:
+            self._drained.wait()
             return 0
-        return int(self._lib.eng_var_version(h, v.id))
+        try:
+            return int(self._lib.eng_var_version(h, v.id))
+        finally:
+            self._release()
 
     def num_live_callbacks(self):
         with self._lock:
@@ -184,27 +239,56 @@ class Engine:
         Idempotent; after close() pushes run inline (NaiveEngine-style)
         so late callers (atexit hooks, iterator teardown) stay correct.
 
-        The handle swap happens under the push lock (a racing push
-        re-checks and goes inline), but the drain runs OUTSIDE it —
-        in-flight callbacks take the same lock to record exceptions, so
-        holding it through eng_wait_all would deadlock. getattr guards:
-        __del__ may see a half-constructed instance whose __init__
-        raised before _h/_lock were assigned."""
+        Ordering vs concurrent waiters (the DeviceFeed/DataLoader
+        pipeline closes the engine mid-epoch in tests): (1) swap the
+        handle out under the push lock (a racing push re-checks and
+        goes inline); (2) wait for threads already inside a native call
+        to return — their awaited ops complete on the still-live pool;
+        (3) drain every pending op, freeze per-var poison for
+        post-close wait_for_var, destroy, and only then flip _drained —
+        the gate every post-close path (inline push, closed-path waits)
+        blocks on, so no pre-close slot write can be skipped over. The
+        drain runs OUTSIDE the lock — in-flight callbacks take the same
+        lock to record exceptions, so holding it through eng_wait_all
+        would deadlock. getattr guards: __del__ may see a
+        half-constructed instance whose __init__ raised before _h/_lock
+        were assigned."""
         lock = getattr(self, "_lock", None)
         if lock is None:
             return
+        missing = object()
         with lock:
-            h = getattr(self, "_h", None)
+            h = getattr(self, "_h", missing)
+            if h is missing:  # __init__ raised before the handle existed
+                return
             self._h = None
         if h is None:
+            # another close() owns (or finished) the drain — order after
+            self._drained.wait()
             return
         try:
-            self._lib.eng_wait_all(h)
-            self._lib.eng_destroy(h)
-        except Exception:
-            pass
-        with lock:
-            self._live_cbs.clear()
+            with self._cond:
+                while self._active > 0:
+                    self._cond.wait()
+            try:
+                self._lib.eng_wait_all(h)
+            except Exception:
+                pass
+            with lock:
+                poison = {}
+                for oid, (_, var_ids) in self._live_cbs.items():
+                    exc = self._exceptions.get(oid)
+                    if exc is not None:
+                        for vid in var_ids:
+                            poison[vid] = exc
+                self._var_poison = poison
+                self._live_cbs.clear()
+            try:
+                self._lib.eng_destroy(h)
+            except Exception:
+                pass
+        finally:
+            self._drained.set()
 
     def __del__(self):
         try:

@@ -166,6 +166,40 @@ KNOBS = {
         "wired", "serving.server",
         "ModelServer port (default 8080; 0 binds an ephemeral port, "
         "read back via server.port)"),
+    "MXNET_DEVICE_PREFETCH": (
+        "wired", "pipeline.DeviceFeed",
+        "device-feed prefetch depth (default 2): batches staged onto "
+        "the device AHEAD of the consuming step by a background "
+        "thread, so host batch prep + async H2D overlap the compiled "
+        "step. 0 = synchronous inline staging — bit-for-bit the "
+        "unpipelined loop (see docs/PIPELINE.md)"),
+    "MXNET_ASYNC_GRAD_SYNC": (
+        "wired", "pipeline.grad_sync / gluon.Trainer",
+        "dispatch-as-ready bucketed gradient all-reduce (default 1): "
+        "distributed dense grads are bucketed by dtype/size and each "
+        "bucket's collective dispatches as soon as backward writes "
+        "its grads, overlapping comm with the remaining backward; "
+        "0 = one coalesced collective at step() time (the previous "
+        "barrier behavior — values are bit-identical either way)"),
+    "MXNET_GRAD_BUCKET_KB": (
+        "wired", "pipeline.grad_sync",
+        "async grad-sync bucket size in KiB (default 512): a dtype "
+        "bucket dispatches its all-reduce once pending grads reach "
+        "this many bytes; partial buckets flush at step() time"),
+    "MXNET_KVSTORE_ASYNC": (
+        "wired", "kvstore",
+        "OPT-IN (default 0): apply local/single-process kvstore "
+        "pushes on the background applier thread so push() returns "
+        "immediately and the server-side updater overlaps the next "
+        "forward; pull/barrier flush pending updates "
+        "(read-your-writes). Multi-process dist types stay "
+        "synchronous (collective ordering must match across workers)"),
+    "MXNET_DATALOADER_PREFETCH": (
+        "wired", "gluon DataLoader",
+        "default worker-pool prefetch depth (in-flight batches ahead "
+        "of the consumer) for gluon DataLoader when the constructor's "
+        "prefetch=None (default 2*num_workers); an explicit "
+        "constructor value always wins"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

@@ -10,6 +10,7 @@ workers + CPUSharedStorageManager without cross-process NDArray plumbing.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from concurrent.futures.process import BrokenProcessPool
 
 import numpy as onp
@@ -34,7 +35,21 @@ def default_batchify_fn(data):
 
 
 class DataLoader:
-    """Reference: dataloader.py DataLoader."""
+    """Reference: dataloader.py DataLoader.
+
+    ``prefetch`` is the worker-pool pipeline depth: how many batches may
+    be in flight (submitted to workers, not yet consumed) ahead of the
+    consumer. ``None`` (default) reads ``MXNET_DATALOADER_PREFETCH``,
+    falling back to ``2 * num_workers``; an explicit value always wins,
+    and is clamped to >= 1 whenever workers are on (depth 0 would
+    deadlock the pipelined iterator). Only meaningful with
+    ``num_workers > 0`` — the synchronous loader has no pipeline.
+
+    ``timeout`` (seconds, reference dataloader.py default 120) bounds
+    the wait on any single worker batch: a worker stuck longer (hung
+    decode, dead process) raises RuntimeError in the consumer instead
+    of hanging the epoch; ``<= 0`` or ``None`` waits forever.
+    """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
@@ -44,6 +59,8 @@ class DataLoader:
         # an EXPLICIT 0 stays synchronous regardless of the env var
         self._dataset = dataset
         self._pin_memory = pin_memory
+        self._timeout = None if timeout is None or timeout <= 0 \
+            else float(timeout)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "
@@ -67,8 +84,12 @@ class DataLoader:
 
             num_workers = _env.get_int("MXNET_MP_WORKER_NTHREADS", 0)
         self._num_workers = num_workers
-        self._prefetch = max(0, int(prefetch) if prefetch is not None
-                             else 2 * max(num_workers, 1))
+        if prefetch is None:
+            from ... import env as _env
+
+            prefetch = _env.get_int("MXNET_DATALOADER_PREFETCH",
+                                    2 * max(num_workers, 1))
+        self._prefetch = max(0, int(prefetch))
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._decode = None
         if num_workers > 0 and not thread_pool:
@@ -129,7 +150,15 @@ class DataLoader:
 
         def push_collect(fut, slot):
             def collect(fut=fut, slot=slot):
-                b = fut.result()
+                try:
+                    b = fut.result(timeout=self._timeout)
+                except FuturesTimeoutError:
+                    fut.cancel()
+                    raise RuntimeError(
+                        f"DataLoader worker batch took longer than "
+                        f"timeout={self._timeout}s (hung decode or dead "
+                        "worker); raise the timeout= constructor "
+                        "argument for slow datasets") from None
                 if self._decode is not None:
                     b = self._decode(b)
                 slots[slot] = b

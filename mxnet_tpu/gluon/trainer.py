@@ -64,6 +64,7 @@ class Trainer:
         self._fused_state = None     # device-resident (t[, scale, unsk, skips])
         self._fused_broken = False   # compiled step raised once; stay eager
         self._fused_skips_host = 0   # skip total carried across re-seeds
+        self._grad_reducer = None    # dispatch-as-ready bucketed allreduce
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -101,10 +102,17 @@ class Trainer:
     def allreduce_grads(self):
         """Cross-worker gradient all-reduce (reference: trainer.py
         _allreduce_grads via kvstore push/pull). Single host: no-op (one
-        logical grad); dist: dense gradients are coalesced into one
-        dtype-bucketed flattened collective per dtype
+        logical grad); dist: dense gradients are coalesced into
+        dtype-bucketed flattened collectives
         (parallel.all_reduce_coalesced) instead of one psum per
-        parameter; sparse gradients keep the per-tensor path."""
+        parameter; sparse gradients keep the per-tensor path.
+
+        With ``MXNET_ASYNC_GRAD_SYNC`` (default on) the dense buckets
+        are dispatched AS BACKWARD PRODUCES THEM via the grad-ready
+        hook (pipeline/grad_sync.py) — this call then only flushes the
+        partial buckets and binds the already-reduced results, so the
+        collectives overlap the backward instead of serializing after
+        it. Values are bit-identical on both paths."""
         if not self._distributed:
             return
         from .. import parallel
@@ -113,12 +121,34 @@ class Trainer:
         grads = [p.grad() for p in self._params if p.grad_req != "null"]
         dense = [g for g in grads
                  if not isinstance(g, _sp.BaseSparseNDArray)]
-        if dense:
+        reducer = self._async_reducer()
+        if dense and reducer is not None:
+            reducer.flush(dense)
+        elif dense:
             for g, r in zip(dense, parallel.all_reduce_coalesced(dense)):
                 g._data = r.data
         for g in grads:
             if isinstance(g, _sp.BaseSparseNDArray):
                 g._data = parallel.all_reduce(g).data
+
+    def _async_reducer(self):
+        """The dispatch-as-ready bucketed reducer, created and hooked
+        into autograd once per trainer while MXNET_ASYNC_GRAD_SYNC is
+        on (the hook itself no-ops per round when toggled off, so the
+        knob stays a pure fallback switch)."""
+        from .. import pipeline as _pl
+
+        if not _pl.async_grad_sync_enabled():
+            if self._grad_reducer is not None:
+                # knob flipped off between backward and step: discard
+                # this round's speculation and re-arm the hook's
+                # per-round knob read, else it keeps dispatching
+                self._grad_reducer.abandon()
+            return None
+        if self._grad_reducer is None:
+            self._grad_reducer = _pl.AsyncGradReducer(
+                self._params).attach()
+        return self._grad_reducer
 
     # -- fused compiled step ------------------------------------------------
 
@@ -592,7 +622,9 @@ class Trainer:
 
         def dump(v):
             if isinstance(v, nd.NDArray):
-                return ("nd", v.asnumpy())
+                # checkpointing is an intentional full sync, off the
+                # step loop's hot path
+                return ("nd", v.asnumpy())  # graft-lint: allow(L401)
             if isinstance(v, tuple):
                 return ("tuple", tuple(dump(s) for s in v))
             return ("raw", v)

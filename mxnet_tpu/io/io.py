@@ -17,6 +17,7 @@ import numpy as onp
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..pipeline.device_feed import DeviceFeed as _DeviceFeedBase
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter"]
@@ -407,7 +408,7 @@ class CSVIter(NDArrayIter):
                          last_batch_handle="pad" if round_batch else "discard")
 
 
-class DevicePrefetchIter(DataIter):
+class DevicePrefetchIter(_DeviceFeedBase):
     """Host→device double buffering: a background thread pulls batches
     from the wrapped iterator and stages them onto the target device
     with an ASYNC jax.device_put, so the transfer of batch k+1 overlaps
@@ -415,95 +416,13 @@ class DevicePrefetchIter(DataIter):
     reference's prefetch story — iter_prefetcher.h overlaps decode with
     compute, PJRT async H2D overlaps the copy with the device step).
 
-    depth=2 keeps at most two staged batches in flight (one being
-    consumed, one in transfer) — deeper queues only add HBM pressure.
-    """
+    Since round 11 this is a thin wrapper over the general
+    ``mxnet_tpu.pipeline.DeviceFeed`` (one prefetch implementation, one
+    set of counters); kept for the original (base, device, depth)
+    signature. depth=2 keeps at most two staged batches in flight (one
+    being consumed, one in transfer) — deeper queues only add HBM
+    pressure."""
 
     def __init__(self, base, device=None, depth=2):
-        super().__init__()
+        super().__init__(base, depth=depth, device=device)
         self.base = base
-        self.batch_size = getattr(base, "batch_size", None)
-        self._device = device
-        self._depth = depth
-        self._start_worker()
-
-    def _start_worker(self):
-        import queue
-        import threading
-
-        # queue+event are LOCAL to each worker generation: a worker from
-        # before a reset can never deliver stale batches (or its None
-        # sentinel) into the new stream
-        self._q = queue.Queue(maxsize=self._depth)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._worker, args=(self._q, self._stop), daemon=True)
-        self._thread.start()
-
-    def _stage(self, arr):
-        import jax
-
-        from ..ndarray import NDArray
-
-        dev = self._device or jax.devices()[0]
-        return NDArray(jax.device_put(arr.data, dev))
-
-    @staticmethod
-    def _put(q, stop, item):
-        """put() that a reset can always unblock; returns False if
-        stopped before the item landed."""
-        import queue as _queue
-
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except _queue.Full:
-                continue
-        return False
-
-    def _worker(self, q, stop):
-        try:
-            for batch in self.base:
-                if stop.is_set():
-                    return
-                staged = DataBatch(
-                    data=[self._stage(d) for d in batch.data],
-                    label=[self._stage(l) for l in batch.label],
-                    pad=getattr(batch, "pad", 0),
-                    index=getattr(batch, "index", None))
-                if not self._put(q, stop, staged):
-                    return
-        except Exception as e:  # surface in the consumer, not the thread
-            self._put(q, stop, e)
-        finally:
-            self._put(q, stop, None)
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        item = self._q.get()
-        if item is None:
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
-
-    next = __next__
-
-    def reset(self):
-        import queue as _queue
-
-        self._stop.set()
-        # drain until the worker actually exits — it may be blocked in
-        # put(); every get() frees a slot, and _put() rechecks the stop
-        # flag each 0.2s, so this terminates
-        while self._thread.is_alive():
-            try:
-                self._q.get(timeout=0.1)
-            except _queue.Empty:
-                pass
-        self._thread.join()
-        self.base.reset()
-        self._start_worker()

@@ -69,13 +69,20 @@ class KVStore:
         self._async_thread = None
         self._async_err = None
         self._ps = None
-        if kv_type == "dist_async":
+        self._pipeline_async = False  # opt-in MXNET_KVSTORE_ASYNC mode
+
+        def _nproc():
+            # lazy: touching jax.process_count() initializes the jax
+            # backend, which a plain local store must not force
             try:
                 import jax
 
-                nproc = jax.process_count()
+                return jax.process_count()
             except Exception:
-                nproc = 1
+                return 1
+
+        if kv_type == "dist_async":
+            nproc = _nproc()
             if nproc == 1:
                 self._async_mode = True
             else:
@@ -88,6 +95,19 @@ class KVStore:
 
                 self._ps = AsyncParamServer(
                     jax.process_index(), lambda: self._updater)
+        elif _env.get_bool("MXNET_KVSTORE_ASYNC", False) and (
+                not kv_type.startswith("dist") or _nproc() == 1):
+            # pipeline opt-in (docs/PIPELINE.md): apply LOCAL pushes on
+            # the applier thread so push() returns immediately and the
+            # updater overlaps the next forward. pull()/barrier() flush
+            # (read-your-writes), so update_on_kvstore training loops
+            # see exactly the synchronous values one step later at the
+            # pull they already do. Multi-process dist types stay
+            # synchronous: per-key collectives reordered onto a free
+            # thread would deadlock (ordering must match across
+            # workers).
+            self._async_mode = True
+            self._pipeline_async = True
 
     # -- async applier -----------------------------------------------------
     def _async_submit(self, k, agg):
@@ -145,6 +165,13 @@ class KVStore:
             self._async_thread.start()
             weakref.finalize(self, q.put, None)
         self._async_q.put((k, agg))
+        if self._pipeline_async:
+            # count only the MXNET_KVSTORE_ASYNC opt-in — the legacy
+            # dist_async mode also routes through here, and its pushes
+            # must not show up as pipeline activity in the counters
+            from . import pipeline as _pl
+
+            _pl._count("kvstore_async_pushes")
 
     def _async_flush(self):
         """Wait for in-flight async updates; re-raise their first error

@@ -1,0 +1,146 @@
+"""Async end-to-end training pipeline.
+
+Rounds 6-9 made per-step device compute cheap (eager jit cache, one
+donated XLA executable per optimizer step, persistent compile cache), so
+the epoch loop is host-bound: each step serializes host batch prep →
+``device_put`` → dispatch → gradient all-reduce → optimizer update. This
+package overlaps those stages — the step-loop analog of TVM's
+latency-hiding-by-scheduling, and of the reference's PrefetcherIter +
+kvstore-async machinery (src/io/iter_prefetcher.h,
+kvstore_dist_server.h):
+
+- ``DeviceFeed`` (device_feed.py): a prefetching device-feed iterator
+  wrapping any DataIter / DataLoader / iterable. A background thread
+  pulls batches from the source and stages them onto the device with
+  async ``jax.device_put``, keeping ``MXNET_DEVICE_PREFETCH`` batches
+  double-buffered ahead of the consuming step. Staged buffers are
+  freshly allocated and uniquely referenced (donation-friendly).
+- ``AsyncGradReducer`` (grad_sync.py): bucketed dispatch-as-ready
+  gradient all-reduce. Grads are bucketed by dtype/size and each
+  bucket's collective is dispatched the moment backward writes its
+  grads (via the autograd grad-ready hook), overlapping communication
+  with the remaining backward instead of one barrier at ``step()``.
+  ``MXNET_ASYNC_GRAD_SYNC`` gates it; values are bit-identical to the
+  coalesced-at-step path (elementwise sums commute with bucketing).
+- async kvstore pushes (``MXNET_KVSTORE_ASYNC``, kvstore.py): local
+  pushes apply on the background applier thread so the server-side
+  updater overlaps the next forward.
+
+``pipeline_counters()`` surfaces prefetch depth/hits/stalls, the
+accumulated stall ("engine idle") time, the measured overlap ratio, and
+the grad-sync/kvstore dispatch counts; the counters ride
+``profiler.dump()`` and the ``PIPELINE`` runtime feature mirrors the
+master knob. See docs/PIPELINE.md.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["DeviceFeed", "AsyncGradReducer", "pipeline_enabled",
+           "prefetch_depth", "async_grad_sync_enabled",
+           "kvstore_async_enabled", "grad_bucket_bytes",
+           "pipeline_counters", "reset_pipeline_counters"]
+
+
+def prefetch_depth():
+    """MXNET_DEVICE_PREFETCH (default 2); 0 = synchronous passthrough.
+    Read at feed construction so tests/benchmarks toggle per instance."""
+    from .. import env as _env
+
+    return max(0, _env.get_int("MXNET_DEVICE_PREFETCH", 2))
+
+
+def pipeline_enabled():
+    """The PIPELINE runtime feature: prefetch armed (depth > 0)."""
+    return prefetch_depth() > 0
+
+
+def async_grad_sync_enabled():
+    """MXNET_ASYNC_GRAD_SYNC (default on): dispatch-as-ready bucketed
+    gradient all-reduce; 0 = one coalesced collective at step() time."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_ASYNC_GRAD_SYNC", True)
+
+
+def grad_bucket_bytes():
+    """MXNET_GRAD_BUCKET_KB (default 512 KiB) in bytes."""
+    from .. import env as _env
+
+    return max(1, _env.get_int("MXNET_GRAD_BUCKET_KB", 512)) * 1024
+
+
+def kvstore_async_enabled():
+    """MXNET_KVSTORE_ASYNC — OPT-IN (default 0) background-thread
+    application of local kvstore pushes."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_KVSTORE_ASYNC", False)
+
+
+# ---------------------------------------------------------------------------
+# counters (thread-safe: feed workers, the consumer, and kvstore's
+# applier thread all tick them)
+
+_LOCK = threading.Lock()
+
+
+def _zero_counters():
+    return {
+        # device feed
+        "prefetch_depth": 0,       # last configured depth
+        "prefetch_batches": 0,     # batches staged onto device
+        "prefetch_hits": 0,        # batch already staged when asked for
+        "prefetch_stalls": 0,      # consumer had to wait on the worker
+        "prefetch_stall_s": 0.0,   # total consumer wait = device idle gap
+        "feed_active_s": 0.0,      # wall time feeds spent being consumed
+        "feed_errors": 0,          # source exceptions propagated
+        # async grad sync
+        "grad_buckets": 0,         # collectives dispatched mid-backward
+        "grad_bucket_bytes": 0,    # bytes those collectives covered
+        "grad_async_grads": 0,     # grads reduced ahead of step()
+        "grad_flush_grads": 0,     # grads reduced at the step() flush
+        "grad_stale_discards": 0,  # speculative reductions re-done
+        # async kvstore
+        "kvstore_async_pushes": 0,
+    }
+
+
+_COUNTERS = _zero_counters()
+
+
+def _count(name, delta=1):
+    with _LOCK:
+        _COUNTERS[name] += delta
+
+
+def _count_set(name, value):
+    with _LOCK:
+        _COUNTERS[name] = value
+
+
+def pipeline_counters():
+    """Live pipeline counters plus two derived metrics: ``engine_idle_s``
+    (total time the consuming step loop sat waiting on data — the gap
+    the prefetcher exists to close) and ``overlap_ratio`` (fraction of
+    the feed's consumption window NOT spent stalled; 1.0 = the source
+    was always ahead of the step)."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+    out["engine_idle_s"] = out["prefetch_stall_s"]
+    active = out["feed_active_s"]
+    out["overlap_ratio"] = (
+        max(0.0, 1.0 - out["prefetch_stall_s"] / active) if active > 0
+        else 0.0)
+    return out
+
+
+def reset_pipeline_counters():
+    """Zero every counter (tests, benchmarks)."""
+    global _COUNTERS
+    with _LOCK:
+        _COUNTERS = _zero_counters()
+
+
+from .device_feed import DeviceFeed  # noqa: E402
+from .grad_sync import AsyncGradReducer  # noqa: E402

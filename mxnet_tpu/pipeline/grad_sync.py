@@ -1,0 +1,205 @@
+"""Bucketed dispatch-as-ready gradient all-reduce.
+
+The round-7 distributed path coalesces all dense grads into ONE
+collective per dtype — but that collective only dispatches inside
+``Trainer.step``, AFTER the whole backward finished: communication and
+backward compute fully serialize. This module overlaps them (horovod /
+DDP-style gradient bucketing; the schedulable-weight-update framing of
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training"):
+
+- ``AsyncGradReducer.attach()`` registers an autograd **grad-ready
+  hook**: ``autograd.backward`` signals each marked variable the moment
+  its gradient is written;
+- ready grads accumulate into per-dtype buckets; when a bucket reaches
+  ``MXNET_GRAD_BUCKET_KB`` bytes its all-reduce dispatches IMMEDIATELY
+  (XLA dispatch is async — the collective rides the device while the
+  host continues the remaining backward);
+- dispatched reductions are **speculative**: the reducer records the
+  exact input buffer it reduced, and ``flush()`` (called from
+  ``Trainer.allreduce_grads`` at step time) binds a speculative result
+  only if the grad buffer is still the one it reduced. A grad
+  overwritten or accumulated into after dispatch (double backward,
+  ``grad_req='add'`` accumulation) discards the stale speculation and
+  re-reduces the current value — correctness never depends on "one
+  backward per step".
+
+Bucketing is bitwise-neutral: the reduction is elementwise, so
+``concat(psum) == psum(concat)`` whatever the bucket boundaries
+(``parallel.all_reduce_coalesced``'s contract). Every worker runs the
+same program in the same order, so bucket fill — and therefore the
+collective sequence — is identical across workers.
+
+Single process, ``all_reduce`` is the identity and the reducer is pure
+bookkeeping; pass ``reduce_fn`` to observe/override the per-bucket
+collective (tests, custom comm backends, gradient compression via
+``GradientCompression`` wire formats).
+"""
+from __future__ import annotations
+
+from . import (_count, async_grad_sync_enabled, grad_bucket_bytes)
+
+__all__ = ["AsyncGradReducer"]
+
+
+class AsyncGradReducer:
+    """Dispatch-as-ready bucketed all-reduce over a parameter group.
+
+    Single-threaded by design: the autograd hook fires on the thread
+    running ``backward`` and ``flush()`` on the thread running
+    ``step()`` — the training loop's thread in both cases.
+    """
+
+    def __init__(self, params, bucket_bytes=None, reduce_fn=None):
+        self._params = list(params)
+        self._bucket_bytes = bucket_bytes
+        self._reduce_fn = reduce_fn
+        self._by_id = {}        # id(param._ndarray) -> Parameter
+        self._unhook = None
+        self._pending = {}      # dtype str -> [(grad NDArray, captured jnp)]
+        self._pending_bytes = {}
+        self._spec = {}         # id(grad NDArray) -> (captured, reduced)
+        self._round_enabled = None  # knob, read once per round
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self):
+        """Register the grad-ready hook (idempotent). The hook stays
+        registered across steps; ``MXNET_ASYNC_GRAD_SYNC=0`` turns each
+        round into a no-op so toggling needs no re-wiring. The global
+        hook holds this reducer (and its parameter group) only weakly —
+        a dropped trainer unregisters itself on the next backward."""
+        if self._unhook is None:
+            import weakref
+
+            from .. import autograd
+
+            self._refresh_index()
+            ref = weakref.ref(self)
+            handle = []
+
+            def hook(arr):
+                r = ref()
+                if r is None:
+                    handle[0]()
+                else:
+                    r._on_grad_ready(arr)
+
+            handle.append(autograd.register_grad_ready_hook(hook))
+            self._unhook = handle[0]
+        return self
+
+    def detach(self):
+        if self._unhook is not None:
+            self._unhook()
+            self._unhook = None
+
+    def _refresh_index(self):
+        self._by_id = {
+            id(p._ndarray): p for p in self._params
+            if getattr(p, "_ndarray", None) is not None
+            and p.grad_req != "null"}
+
+    # -- dispatch-as-ready --------------------------------------------------
+
+    def _on_grad_ready(self, arr):
+        """Called by ``autograd.backward`` right after ``arr._grad`` is
+        written. Cheap rejects first — the hook runs once per marked
+        variable per backward."""
+        if self._round_enabled is None:
+            self._round_enabled = async_grad_sync_enabled()
+            if self._round_enabled:
+                self._refresh_index()  # params may have (re)materialized
+        if not self._round_enabled:
+            return
+        p = self._by_id.get(id(arr))
+        if p is None:
+            return
+        g = arr._grad
+        if g is None or not self._reducible(g):
+            return
+        data = g._data
+        key = str(data.dtype)
+        self._pending.setdefault(key, []).append((g, data))
+        size = self._pending_bytes.get(key, 0) + data.size * data.dtype.itemsize
+        self._pending_bytes[key] = size
+        cap = self._bucket_bytes if self._bucket_bytes is not None \
+            else grad_bucket_bytes()
+        if size >= cap:
+            self._dispatch(key)
+
+    @staticmethod
+    def _reducible(g):
+        from ..gluon import fused_step as _fs
+        from ..ndarray import sparse as _sp
+
+        return not isinstance(g, _sp.BaseSparseNDArray) and \
+            not _fs.has_tracer([g._data])
+
+    def _dispatch(self, key):
+        from .. import parallel
+
+        bucket = self._pending.pop(key, [])
+        self._pending_bytes.pop(key, None)
+        if not bucket:
+            return
+        datas = [d for _, d in bucket]
+        reduced = parallel.all_reduce_coalesced(
+            datas, reduce_fn=self._reduce_fn)
+        nbytes = sum(d.size * d.dtype.itemsize for d in datas)
+        for (g, captured), r in zip(bucket, reduced):
+            self._spec[id(g)] = (captured, _raw(r))
+        _count("grad_buckets")
+        _count("grad_bucket_bytes", nbytes)
+        _count("grad_async_grads", len(bucket))
+
+    def abandon(self):
+        """Drop all per-round state without dispatching or binding —
+        the step-time path declined async sync this round (the knob
+        flipped off between backward and step()). Speculative results
+        are discarded; the grads themselves were never modified, so the
+        coalesced-at-step path reduces the true values. Also re-arms
+        the per-round knob read, so later backwards stop dispatching."""
+        self._pending.clear()
+        self._pending_bytes.clear()
+        self._spec.clear()
+        self._round_enabled = None
+
+    # -- step-time flush ----------------------------------------------------
+
+    def flush(self, grads):
+        """Finish the round: dispatch partial buckets, then bind every
+        grad in ``grads`` to its reduced value — the speculative result
+        when the buffer is untouched since dispatch, a fresh reduction
+        otherwise (late accumulation / overwrite / a param backward
+        never reached this round). Exactly-once per round per grad."""
+        from .. import parallel
+
+        for key in list(self._pending):
+            self._dispatch(key)
+        spec, self._spec = self._spec, {}
+        self._round_enabled = None
+        todo = []
+        for g in grads:
+            ent = spec.get(id(g))
+            if ent is not None and g._data is ent[0]:
+                g._data = ent[1]
+            else:
+                if ent is not None:
+                    _count("grad_stale_discards")
+                todo.append(g)
+        if todo:
+            reduced = parallel.all_reduce_coalesced(
+                [g._data for g in todo], reduce_fn=self._reduce_fn)
+            for g, r in zip(todo, reduced):
+                g._data = _raw(r)
+            _count("grad_flush_grads", len(todo))
+        return len(todo)
+
+
+def _raw(x):
+    """The jnp array behind an all_reduce_coalesced result (NDArray when
+    the inputs were NDArrays, raw otherwise)."""
+    from ..ndarray import NDArray
+
+    return x.data if isinstance(x, NDArray) else x

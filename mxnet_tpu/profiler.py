@@ -156,6 +156,19 @@ def serving_counters():
         return {}
 
 
+def pipeline_counters():
+    """Async-training-pipeline counters (prefetch depth/hits/stalls,
+    stall = engine idle seconds, overlap ratio, dispatch-as-ready grad
+    buckets, async kvstore pushes), live from mxnet_tpu.pipeline.
+    Zeros before the first DeviceFeed/AsyncGradReducer use."""
+    try:
+        from .pipeline import pipeline_counters as _pc
+
+        return _pc()
+    except Exception:
+        return {}
+
+
 def graph_verify_counters():
     """Static graph-verifier counters (graphs checked, diagnostics by
     severity and code), live from mxnet_tpu.analysis. Zeros before the
@@ -226,6 +239,12 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(serving_counters().items()):
         payload["traceEvents"].append(
             {"name": f"serving/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
+    for cname, cval in sorted(pipeline_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"pipeline/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0,
              "args": {cname: float(cval) if isinstance(cval, float)
                       else cval}})

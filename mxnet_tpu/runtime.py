@@ -57,6 +57,14 @@ def _detect():
     except Exception:
         feats["SERVING"] = False
     try:
+        from .pipeline import pipeline_enabled
+
+        # async training pipeline: device prefetch armed
+        # (MXNET_DEVICE_PREFETCH, pipeline/)
+        feats["PIPELINE"] = pipeline_enabled()
+    except Exception:
+        feats["PIPELINE"] = False
+    try:
         from .analysis import verify_mode
 
         # static graph verifier armed (MXNET_GRAPH_VERIFY, analysis/)

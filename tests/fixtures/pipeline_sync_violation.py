@@ -1,0 +1,34 @@
+"""Seeded graft_lint L401 violation fixture (NOT imported by the
+package). graft-lint: scope(step-loop)
+
+The marker comment above opts this file into the step-loop host-sync
+discipline that ``mxnet_tpu/pipeline/`` and ``gluon/trainer.py`` get
+automatically; the tier-1 lint test asserts every violation species
+below is flagged. Keep this file OUTSIDE mxnet_tpu/ so
+``python -m tools.graft_lint mxnet_tpu`` stays clean on the shipped
+tree.
+"""
+import numpy as onp
+
+
+def bad_step_loop(feed, net, trainer):
+    for xb, yb in feed:
+        loss = ((net(xb) - yb) ** 2).mean()
+        loss.backward()
+        trainer.step(xb.shape[0])
+        # L401: per-step metric readback — serializes the pipeline
+        total = float(loss.asnumpy())
+        # L401: device→host transfer mid-loop
+        host = onp.asarray(loss)
+        # L401: explicit device barrier in the hot path
+        loss.data.block_until_ready()
+        # L401: scalar sync
+        s = loss.item()
+        # L401: reference-style wait
+        loss.wait_to_read()
+    return total, host, s
+
+
+def whitelisted_epoch_end(losses):
+    # epoch-end readback is the blessed pattern: one sync per epoch
+    return [float(l.asnumpy()) for l in losses]  # graft-lint: allow(L401)

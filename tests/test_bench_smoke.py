@@ -77,6 +77,43 @@ def test_serving_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "serving"
 
 
+@pytest.mark.slow
+def test_pipeline_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import pipeline_bench
+
+    out = str(tmp_path / "pipeline.json")
+    doc = pipeline_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["bitwise_equal"]
+    assert doc["fallback_bitwise_equal"]
+    assert doc["loss_trace_equal"]
+    assert doc["loss_scale_trace_equal"]
+    assert doc["results"]["pipelined_epoch_s"] > 0
+    assert doc["counters"]["prefetch_hits"] > 0, \
+        "the prefetcher never got ahead of the step loop"
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "pipeline_epoch"
+
+
+def test_bench_compare_pipeline_epoch_metrics():
+    """BENCH_PIPELINE_r11.json names: epoch/idle seconds are
+    lower-is-better, steps_per_s and overlap_ratio higher-is-better,
+    the depth knob untracked."""
+    base = {"results": {"pipelined_epoch_s": 0.43, "sync_engine_idle_s":
+                        0.36, "pipelined_steps_per_s": 140.0,
+                        "overlap_ratio": 0.86, "prefetch_depth": 2}}
+    worse = {"results": {"pipelined_epoch_s": 0.65, "sync_engine_idle_s":
+                         0.36, "pipelined_steps_per_s": 90.0,
+                         "overlap_ratio": 0.4, "prefetch_depth": 2}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["results.pipelined_epoch_s"][4]      # +51%: REGRESSED
+    assert rows["results.pipelined_steps_per_s"][4]  # throughput drop
+    assert rows["results.overlap_ratio"][4]          # overlap collapsed
+    assert not rows["results.sync_engine_idle_s"][4]
+    assert "results.prefetch_depth" not in rows      # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_serving_latency_metrics():
     """p50/p99 quantiles are lower-is-better whatever suffix they
     carry; *_rps counts as throughput (BENCH_SERVE_r10.json names)."""

@@ -12,6 +12,8 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 import graft_lint  # noqa: E402
 
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "lint_violation.py")
+PIPE_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                            "pipeline_sync_violation.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -34,6 +36,37 @@ def test_fixture_triggers_every_check():
     assert "host clock" in msgs
     assert "numpy RNG" in msgs
     assert "print()" in msgs
+
+
+def test_step_sync_fixture_triggers_each_species():
+    """L401: every blocking-host-sync species in the seeded step-loop
+    fixture is flagged, and the allow(L401) epoch-end site is not."""
+    findings = graft_lint.lint_paths([PIPE_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l401 = [f for f in findings if f.code == "L401"]
+    msgs = "\n".join(f.message for f in l401)
+    for species in (".asnumpy()", ".item()", ".wait_to_read()",
+                    ".block_until_ready()", "onp.asarray"):
+        assert species in msgs, msgs
+    assert len(l401) == 5, l401
+    # the pragma'd whitelisted_epoch_end sync is suppressed
+    assert all(f.line < 32 for f in l401), l401
+
+
+def test_step_sync_scope_is_opt_in_outside_pipeline(tmp_path):
+    """The L401 discipline binds pipeline/trainer modules automatically
+    and other files only via the scope(step-loop) marker — a metric
+    helper elsewhere may sync freely."""
+    src = "def poll(x):\n    return x.asnumpy()\n"
+    free = tmp_path / "metrics_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    scoped = tmp_path / "loop_frag.py"
+    scoped.write_text("# graft-lint: scope(step-loop)\n" + src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(scoped)], repo_root=REPO, registry=False)]
+    assert codes == ["L401"]
 
 
 def test_cli_exit_codes():

@@ -9,9 +9,16 @@ inferred from the leaf name:
   more retraces in a like-for-like stream is a cache regression),
   ``*p50*``/``*p95*``/``*p99*`` (serving latency quantiles from
   BENCH_SERVE_r10.json — tagged explicitly so a quantile leaf is
-  lower-is-better whatever unit suffix it carries)
+  lower-is-better whatever unit suffix it carries), ``*epoch_s*`` /
+  ``*idle*`` / ``*stall*`` (epoch-bench wall/idle seconds from
+  BENCH_PIPELINE_r11.json — the async pipeline exists to shrink them)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
-  ``*items_per*``, ``*_rps*`` (serving requests/sec)
+  ``*per_s`` (end-anchored: ``steps_per_s`` is throughput but
+  ``fused_ms_per_step`` stays latency), ``*items_per*``, ``*_rps*``
+  (serving requests/sec), ``*overlap*`` (BENCH_PIPELINE_r11.json
+  overlap_ratio
+  — the fraction of the feed window not spent stalled; a drop means
+  the pipeline stopped hiding the host path)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -28,15 +35,19 @@ import json
 import sys
 
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
-                   "p50", "p95", "p99")
-HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec", "items_per",
-                    "_rps")
+                   "p50", "p95", "p99", "epoch_s", "idle", "stall")
+HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
+                    "items_per", "_rps", "overlap")
+# end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
+# must stay latency — a bare 'per_s' substring would match both
+HIGHER_SUFFIXES = ("per_s",)
 
 
 def _direction(path):
     leaf = path.rsplit(".", 1)[-1].lower()
     # higher-is-better first: 'items_per_sec' also matches '_sec'
-    if any(tag in leaf for tag in HIGHER_IS_BETTER):
+    if any(tag in leaf for tag in HIGHER_IS_BETTER) \
+            or leaf.endswith(HIGHER_SUFFIXES):
         return "higher"
     if any(tag in leaf for tag in LOWER_IS_BETTER):
         return "lower"

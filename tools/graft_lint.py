@@ -27,6 +27,18 @@ package:
                        the ambient provider (``mxnet_tpu.random``).
 ``L301 op-docstring``  a ``@register``-decorated op body without a
                        docstring (AST form of the registry R301 check).
+``L401 step-sync``     a blocking host sync (``.asnumpy()``,
+                       ``.asscalar()``, ``.item()``, ``.wait_to_read()``,
+                       ``.block_until_ready()``, ``np.asarray(...)``)
+                       inside a step-loop/pipeline module —
+                       ``mxnet_tpu/pipeline/``, ``gluon/trainer.py``,
+                       or any file carrying the
+                       ``# graft-lint: scope(step-loop)`` marker. One
+                       stray sync serializes the whole async pipeline
+                       (the round-11 overlap win), so the hot path must
+                       stay sync-free; deliberate sites (checkpointing,
+                       epoch-end metric reads) carry
+                       ``# graft-lint: allow(L401)``.
 ``jit-nocache``        a raw ``jax.jit`` call site inside ``mxnet_tpu/``
                        that bypasses the compile-cache helpers
                        (``utils.compile_cache.counting_jit`` or the AOT
@@ -298,6 +310,56 @@ def check_jit_safety(path, tree, source, findings):
                     emit("L201", node, label, "print()")
 
 
+_STEP_SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read",
+                    "block_until_ready"}
+
+
+def _step_loop_scoped(path, source):
+    """Files the L401 step-sync discipline applies to: the pipeline
+    package and the Trainer step loop are scoped automatically (a new
+    pipeline module can't silently opt out); other step-loop code opts
+    in with a ``# graft-lint: scope(step-loop)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/pipeline/" in norm or norm.endswith("gluon/trainer.py"):
+        return True
+    return "graft-lint: scope(step-loop)" in source
+
+
+def check_step_host_sync(path, tree, source, findings):
+    """L401: blocking host syncs inside step-loop/pipeline modules.
+    Each one stalls the consuming thread until the device (or a worker)
+    catches up — exactly the serialization the async pipeline exists to
+    remove — so the hot path must route them off-path (device-resident
+    metrics, epoch-end reads) or whitelist them explicitly."""
+    if not _step_loop_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+    seen = set()
+
+    def emit(node, msg):
+        if pragmas.allows(node.lineno, "L401") or node.lineno in seen:
+            return
+        seen.add(node.lineno)
+        findings.append(Finding(
+            "L401", path, node.lineno,
+            f"{msg} in a step-loop/pipeline module serializes the "
+            "async pipeline; defer it off the hot path or annotate a "
+            "deliberate site with allow(L401)"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _STEP_SYNC_ATTRS:
+            emit(node, f"blocking host sync '.{f.attr}()'")
+            continue
+        dn = _dotted(f)
+        if dn:
+            root, *rest = dn.split(".")
+            if root in _NP_MODULES and rest in (["asarray"], ["array"]):
+                emit(node, f"blocking device→host transfer '{dn}(...)'")
+
+
 def check_jit_nocache(path, tree, source, findings):
     """jit-nocache: raw ``jax.jit(...)`` call sites must route through
     the compile-cache helpers or carry an allow pragma."""
@@ -400,6 +462,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_env_discipline(path, tree, source, knobs, findings)
         check_jit_safety(path, tree, source, findings)
         check_jit_nocache(path, tree, source, findings)
+        check_step_host_sync(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
             want_registry = True
