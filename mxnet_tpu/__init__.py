@@ -106,6 +106,7 @@ from . import kvstore
 from . import gluon
 from . import parallel
 from . import pipeline  # noqa: F401
+from . import resilience  # noqa: F401
 from . import utils  # noqa: F401
 from . import engine  # noqa: F401
 from . import libinfo  # noqa: F401

@@ -19,6 +19,11 @@ import ctypes
 import os
 import threading
 
+# module-level on purpose: push() is the framework's hottest host path,
+# and the disarmed fault seam must cost one global read, not an import
+# lookup per call (resilience.faults has no imports back into engine)
+from .resilience import faults as _faults
+
 __all__ = ["Engine", "NaiveEngine", "get", "var", "push", "wait_for_var",
            "wait_all", "LANE_COMPUTE", "LANE_IO"]
 
@@ -101,6 +106,9 @@ class Engine:
              lane=LANE_COMPUTE):
         """Schedule fn() after its deps; returns the op id. An exception
         in fn poisons `mutable_vars` and surfaces at wait_for_var."""
+        # registered fault point: a failed host-task schedule (raises
+        # synchronously in the pusher, like a dead worker pool)
+        _faults.maybe_fail("engine_push")
         if self._h is None:  # closed (atexit shutdown): run inline,
             # but only after the drain — an in-flight pre-close op may
             # write the same vars this fn depends on
@@ -272,7 +280,7 @@ class Engine:
                     self._cond.wait()
             try:
                 self._lib.eng_wait_all(h)
-            except Exception:
+            except Exception:  # graft-lint: allow(L501)
                 pass
             with lock:
                 poison = {}
@@ -285,7 +293,7 @@ class Engine:
                 self._live_cbs.clear()
             try:
                 self._lib.eng_destroy(h)
-            except Exception:
+            except Exception:  # graft-lint: allow(L501)
                 pass
         finally:
             self._drained.set()
@@ -293,7 +301,7 @@ class Engine:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graft-lint: allow(L501)
             pass
 
 
@@ -315,6 +323,7 @@ class NaiveEngine:
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              lane=0):
+        _faults.maybe_fail("engine_push")
         op_id = self._next
         self._next += 1
         poisoned = [v for v in list(const_vars) + list(mutable_vars)

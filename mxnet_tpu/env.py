@@ -200,6 +200,67 @@ KNOBS = {
         "of the consumer) for gluon DataLoader when the constructor's "
         "prefetch=None (default 2*num_workers); an explicit "
         "constructor value always wins"),
+    "MXNET_RESILIENCE": (
+        "wired", "resilience",
+        "resilience master switch (default 1): 0 degrades to "
+        "fail-fast — retry policies make a single attempt, circuit "
+        "breakers never trip, AutoResume propagates the first fault. "
+        "Checkpoint writes and the fault-injection harness stay "
+        "available either way (see docs/RESILIENCE.md)"),
+    "MXNET_CKPT_DIR": (
+        "wired", "resilience.checkpoint",
+        "default CheckpointManager directory when none is passed "
+        "(default $MXNET_HOME/checkpoints)"),
+    "MXNET_CKPT_KEEP": (
+        "wired", "resilience.checkpoint",
+        "keep-last-N checkpoint retention (default 3); older "
+        "checkpoints are pruned after each successful write; <= 0 "
+        "keeps everything"),
+    "MXNET_CKPT_ASYNC": (
+        "wired", "resilience.checkpoint",
+        "async checkpoint serialization (default 1): snapshots are "
+        "captured as immutable device references (+ device copies of "
+        "donated buffers) and the D2H transfer + pickle + atomic "
+        "write run on a background writer thread off the step loop; "
+        "0 writes inline"),
+    "MXNET_RESUME_MAX_RESTARTS": (
+        "wired", "resilience.AutoResume",
+        "restore-and-continue budget per AutoResume.run (default 3); "
+        "a fault past the budget raises ResumeExhausted chaining the "
+        "last error"),
+    "MXNET_RETRY_MAX_ATTEMPTS": (
+        "wired", "resilience.RetryPolicy",
+        "total attempts (including the first) of the shared "
+        "retry/backoff policy (default 4); kvstore_ps sends route "
+        "through it"),
+    "MXNET_RETRY_BACKOFF_MS": (
+        "wired", "resilience.RetryPolicy",
+        "base backoff in ms (default 50); doubles per retry with "
+        "decorrelated jitter"),
+    "MXNET_RETRY_BACKOFF_MAX_MS": (
+        "wired", "resilience.RetryPolicy",
+        "backoff cap in ms (default 2000)"),
+    "MXNET_BREAKER_THRESHOLD": (
+        "wired", "resilience.CircuitBreaker",
+        "consecutive failures that trip a circuit breaker open "
+        "(default 5); serving keeps one breaker per bucket executable"),
+    "MXNET_BREAKER_COOLDOWN_MS": (
+        "wired", "resilience.CircuitBreaker",
+        "open-circuit cooldown in ms before a half-open probe is "
+        "admitted (default 30000)"),
+    "MXNET_FAULT_PLAN": (
+        "wired", "resilience.faults",
+        "deterministic fault-injection plan, e.g. "
+        "'device_put:at=3;kvstore_push:every=5:times=2' — clauses "
+        "fire an exception at registered fault points by call "
+        "index/period/seeded probability (docs/RESILIENCE.md lists "
+        "the point catalogue and grammar); unset = disarmed "
+        "(zero-cost seams)"),
+    "MXNET_FAULT_SEED": (
+        "wired", "resilience.faults",
+        "seed for probabilistic fault clauses (default 0); each "
+        "point folds its name in, so streams are deterministic per "
+        "(seed, point)"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

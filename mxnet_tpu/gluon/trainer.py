@@ -150,6 +150,17 @@ class Trainer:
                 self._params).attach()
         return self._grad_reducer
 
+    def _abandon_speculation(self):
+        """Discard any in-flight MXNET_ASYNC_GRAD_SYNC speculation
+        (pending buckets + speculative reductions) without binding it.
+        State capture/restore boundaries — ``save_states``,
+        ``load_states``, CheckpointManager snapshots — must call this:
+        a speculative reduction captured before the boundary would
+        otherwise be bound into the first step AFTER it, mixing
+        pre-restore gradient values into post-restore math."""
+        if self._grad_reducer is not None:
+            self._grad_reducer.abandon()
+
     # -- fused compiled step ------------------------------------------------
 
     def _fused_skipped_steps(self):
@@ -164,7 +175,7 @@ class Trainer:
         if st is not None and len(st["vals"]) == 4:
             try:
                 self._fused_skips_host = int(st["vals"][3])
-            except Exception:
+            except Exception:  # graft-lint: allow(L501)
                 # the state tuple was donated to an executable that then
                 # failed at execution — the buffers are gone; keep the
                 # last host carry rather than crash the eager fallback
@@ -615,6 +626,9 @@ class Trainer:
         assert self._optimizer is not None
         if not self._states_created:
             self._create_states()
+        # speculation from a backward that already ran must not
+        # straddle the capture boundary (see _abandon_speculation)
+        self._abandon_speculation()
         self._sync_fused_state()
         import pickle
 
@@ -641,20 +655,20 @@ class Trainer:
     def load_states(self, fname):
         import pickle
 
-        from .. import ndarray as nd
-
+        # restoring over a round whose backward already dispatched
+        # speculative reductions: drop them, or the next step() flush
+        # would bind pre-restore gradient math into the restored state
+        self._abandon_speculation()
         with open(fname, "rb") as f:
             payload = pickle.load(f)
 
-        def restore(v):
-            tag, val = v
-            if tag == "nd":
-                return nd.array(val)
-            if tag == "tuple":
-                return tuple(restore(s) for s in val)
-            return val
-
-        self._states = [restore(s) for s in payload["states"]]
+        # shared walk (fused_step.state_tree_restore): rebuilds the
+        # tagged tree AND launders every buffer through state_adopt —
+        # the fused step donates state buffers, and donating raw
+        # device_put uploads corrupts memory on the jaxlib-0.4.37 CPU
+        # client
+        self._states = [_fs.state_tree_restore(s)
+                        for s in payload["states"]]
         self._states_created = True
         self._optimizer.num_update = payload["num_update"]
         self._optimizer.begin_num_update = payload["num_update"]

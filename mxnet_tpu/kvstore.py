@@ -252,7 +252,7 @@ class KVStore:
             client.key_value_set(key, "1")
             try:
                 client.key_value_delete(key)
-            except Exception:
+            except Exception:  # graft-lint: allow(L501)
                 pass  # old jax without delete: keys leak only per-probe
             return 0
         except Exception:
@@ -330,7 +330,11 @@ class KVStore:
         devices — the CommDevice/NCCL path — with serial adds as the
         same-device fallback."""
         from .ndarray import sparse as _sp
+        from .resilience import faults as _faults
 
+        # registered fault point: a lost/failed gradient send (the
+        # kvstore analog of a dropped ps-lite van message)
+        _faults.maybe_fail("kvstore_push")
         keys, values, _ = self._normalize(key, value)
         for k, v in zip(keys, values):
             k = str(k)
@@ -410,6 +414,10 @@ class KVStore:
         """Read current values. In dist_async, this worker's own pending
         pushes are flushed first (read-your-writes; the reference engine
         orders same-key push→pull through variable dependencies)."""
+        from .resilience import faults as _faults
+
+        # registered fault point: a failed parameter fetch
+        _faults.maybe_fail("kvstore_pull")
         if self._async_mode:
             self._async_flush()
         keys, outs, _ = self._normalize(key, out)

@@ -113,6 +113,14 @@ class AsyncParamServer:
         # a slow-but-alive worker needs the window to be tunable)
         self._gap_tolerance = _env.get_float(
             "MXNET_KVSTORE_GAP_TOLERANCE", 30.0)
+        # transient coordinator-KV send failures retry with jittered
+        # exponential backoff instead of failing the training step on
+        # the first hiccup (reference: ps-lite van resend/timeouts);
+        # the shared policy gives a clear terminal error after the
+        # bounded attempts (docs/RESILIENCE.md)
+        from .resilience import RetryPolicy
+
+        self._retry = RetryPolicy(name="kvstore_ps send")
         self._published = {}  # rank 0: key -> watermark last published
         self._retire = {}     # rank 0: key -> version to delete next
         self._thread = None
@@ -151,12 +159,23 @@ class AsyncParamServer:
                 f"{self._prefix}/val/{key}/0", 120_000)
 
     def push(self, key, grad):
-        """Non-blocking: enqueue and return (async semantics)."""
+        """Non-blocking: enqueue and return (async semantics). Both
+        coordinator-KV RPCs retry through the shared backoff policy —
+        a transient rendezvous hiccup must not kill the step. NB the
+        seq increment is claimed BEFORE the blob send; if every blob
+        attempt fails, the claimed seq stays empty and the server's
+        gap tolerance (MXNET_KVSTORE_GAP_TOLERANCE) reclaims it — the
+        terminal RetryExhausted reaches the caller either way."""
+        from .resilience import faults as _faults
+
         key = str(key)
-        seq = self._c.key_value_increment(f"{self._prefix}/seq/{key}", 1)
-        self._c.key_value_set_bytes(
-            f"{self._prefix}/push/{key}/{seq:012d}",
-            _ser(grad.asnumpy() if hasattr(grad, "asnumpy") else grad))
+        _faults.maybe_fail("kvstore_push")
+        seq = self._retry.run(
+            self._c.key_value_increment, f"{self._prefix}/seq/{key}", 1)
+        blob = _ser(grad.asnumpy() if hasattr(grad, "asnumpy") else grad)
+        self._retry.run(
+            self._c.key_value_set_bytes,
+            f"{self._prefix}/push/{key}/{seq:012d}", blob)
         self._last_seq[key] = seq
 
     def pull(self, key, timeout_s=120.0):
@@ -184,7 +203,7 @@ class AsyncParamServer:
                     blob = self._c.key_value_try_get_bytes(
                         f"{self._prefix}/val/{key}/{applied}")
                     return _deser(blob)
-                except Exception:
+                except Exception:  # graft-lint: allow(L501)
                     pass  # version rotated away; loop re-reads
             if time.monotonic() > deadline:
                 raise MXNetError(
@@ -313,7 +332,7 @@ class AsyncParamServer:
                         try:
                             self._c.key_value_delete(
                                 f"{self._prefix}/val/{key}/{older}")
-                        except Exception:
+                        except Exception:  # graft-lint: allow(L501)
                             pass
                     self._retire[key] = prev
                     self._published[key] = last

@@ -36,6 +36,7 @@ import time
 
 import numpy as onp
 
+from ..resilience import faults as _faults
 from . import (_count, _count_set, prefetch_depth)
 
 __all__ = ["DeviceFeed"]
@@ -90,6 +91,8 @@ class DeviceFeed:
         self._sync_it = None     # active source iterator (passthrough)
         self._finished = False
         self._t_first = None     # first-next timestamp of this pass
+        self._served = 0         # batches delivered this pass (cursor)
+        self._skip_base = 0      # batches skip()'d before this pass
         _count_set("prefetch_depth", self._depth)
 
     # -- staging ------------------------------------------------------------
@@ -99,6 +102,10 @@ class DeviceFeed:
 
         from ..ndarray import NDArray
 
+        # registered fault point: a failed H2D transfer / staging OOM.
+        # Fires in the worker thread; the exception propagates to the
+        # consumer's next() exactly like a real device_put failure.
+        _faults.maybe_fail("device_put")
         if isinstance(x, NDArray):
             return NDArray(jax.device_put(x.data, self._device))
         if isinstance(x, (onp.ndarray, jax.Array)):
@@ -150,6 +157,39 @@ class DeviceFeed:
         finally:
             self._put(ep, _END)
 
+    @property
+    def position(self):
+        """The epoch offset of the NEXT batch — ``skip()``'d prefix
+        plus batches delivered this pass. This is the step cursor a
+        CheckpointManager snapshot records (``cursor={"step": ...}``),
+        so it must stay absolute across a skip-based resume: a second
+        crash in the same epoch then resumes at the true offset
+        instead of replaying the prefix twice."""
+        return self._skip_base + self._served
+
+    def skip(self, n):
+        """Advance the SOURCE past ``n`` batches without staging them
+        (resume repositioning before iteration starts); ``position``
+        counts them. Only valid on a one-shot source (generator /
+        fresh iterator): a re-iterable source would rewind when the
+        worker later calls ``iter`` on it, silently undoing the skip —
+        that raises instead."""
+        if n <= 0:
+            return self
+        if self._epoch is not None or self._sync_it is not None:
+            raise RuntimeError("DeviceFeed.skip() must run before "
+                               "iteration starts")
+        it = iter(self.source)
+        if it is not iter(self.source):
+            raise RuntimeError(
+                "DeviceFeed.skip() needs a one-shot source (iter(src) "
+                "is src); re-iterable sources would rewind when the "
+                "feed starts — slice the source instead")
+        for _ in range(n):
+            next(it)
+        self._skip_base += n
+        return self
+
     def _start(self):
         ep = _Epoch(self._depth)
         ep.thread = threading.Thread(
@@ -158,6 +198,7 @@ class DeviceFeed:
         self._epoch = ep
         self._finished = False
         self._t_first = None
+        self._served = 0
         ep.thread.start()
 
     # -- iteration ----------------------------------------------------------
@@ -196,6 +237,7 @@ class DeviceFeed:
         else:
             _count("prefetch_hits")
         _count("prefetch_batches")
+        self._served += 1
         return item
 
     next = __next__
@@ -205,11 +247,14 @@ class DeviceFeed:
         if self._sync_it is None:
             self._sync_it = iter(self.source)
             self._t_first = time.perf_counter()
+            self._served = 0
         try:
-            return self._stage(next(self._sync_it))
+            item = self._stage(next(self._sync_it))
         except StopIteration:
             self._end_pass()
             raise
+        self._served += 1
+        return item
 
     def _end_pass(self):
         if self._t_first is not None:
@@ -228,6 +273,7 @@ class DeviceFeed:
         ep = self._epoch
         self._epoch = None
         self._sync_it = None
+        self._skip_base = 0
         if self._t_first is not None:
             _count("feed_active_s", time.perf_counter() - self._t_first)
             self._t_first = None
@@ -260,7 +306,7 @@ class DeviceFeed:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graft-lint: allow(L501)
             pass
 
     def __len__(self):

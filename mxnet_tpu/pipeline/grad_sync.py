@@ -138,11 +138,18 @@ class AsyncGradReducer:
 
     def _dispatch(self, key):
         from .. import parallel
+        from ..resilience import faults as _faults
 
         bucket = self._pending.pop(key, [])
         self._pending_bytes.pop(key, None)
         if not bucket:
             return
+        # registered fault point: a failed mid-backward collective.
+        # Raises into backward (or the step-time flush) with the
+        # bucket already popped — exactly the partial-round state a
+        # real collective failure leaves; recovery goes through
+        # abandon() (AutoResume restore / the load_states boundary).
+        _faults.maybe_fail("grad_bucket_dispatch")
         datas = [d for _, d in bucket]
         reduced = parallel.all_reduce_coalesced(
             datas, reduce_fn=self._reduce_fn)

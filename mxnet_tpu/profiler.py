@@ -69,7 +69,7 @@ def stop(profile_process="worker"):
             import jax
 
             jax.profiler.stop_trace()
-        except Exception:
+        except Exception:  # graft-lint: allow(L501)
             pass
         _state["jax_trace"] = False
     if _config.get("continuous_dump"):
@@ -169,6 +169,19 @@ def pipeline_counters():
         return {}
 
 
+def resilience_counters():
+    """Fault-tolerance counters (checkpoint saves/restores/corrupt
+    skips, AutoResume restarts, retry attempts/giveups, circuit-breaker
+    trips/demotions, injected-fault fires per point), live from
+    mxnet_tpu.resilience. Zeros before first use."""
+    try:
+        from .resilience import resilience_counters as _rc
+
+        return _rc()
+    except Exception:
+        return {}
+
+
 def graph_verify_counters():
     """Static graph-verifier counters (graphs checked, diagnostics by
     severity and code), live from mxnet_tpu.analysis. Zeros before the
@@ -245,6 +258,12 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(pipeline_counters().items()):
         payload["traceEvents"].append(
             {"name": f"pipeline/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
+    for cname, cval in sorted(resilience_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"resilience/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0,
              "args": {cname: float(cval) if isinstance(cval, float)
                       else cval}})

@@ -1,0 +1,144 @@
+"""mxnet_tpu.resilience — fault-tolerant training and serving.
+
+The availability layer of the framework (ROADMAP north star: "serves
+heavy traffic from millions of users" — which means surviving the
+failures a million-user fleet sees hourly). The reference inherits
+ps-lite's core promise — long training jobs survive worker failure and
+restart from server-held state (ps-lite Customer/Postoffice recovery,
+kvstore_dist_server.h) — and this package rebuilds that promise
+TPU-native, on top of the round-7/9/11 compiled training spine:
+
+- :class:`~mxnet_tpu.resilience.checkpoint.CheckpointManager` —
+  crash-consistent snapshots of COMPLETE training state (parameters,
+  optimizer state, AMP loss-scaler, PRNG stream position, fused-step
+  skip counters, kvstore contents, data cursor), written atomically
+  (tmp dir + rename) under a manifest with version-salted content
+  hashes, keep-last-N retention, and corrupt/partial detection that
+  falls back to the last good checkpoint. An async mode serializes on
+  a background writer thread off the step loop (jax arrays are
+  immutable, so capturing device references IS a consistent snapshot —
+  the host transfer and file IO then overlap the next steps).
+- :class:`~mxnet_tpu.resilience.supervisor.AutoResume` — a training
+  loop supervisor that catches faults, restores the last good
+  checkpoint, and resumes at the exact step with bitwise parameter
+  parity (identical loss traces vs an uninterrupted run, including
+  through an AMP skip-step episode).
+- :mod:`~mxnet_tpu.resilience.faults` — a deterministic
+  fault-injection harness (``MXNET_FAULT_PLAN`` + programmatic API)
+  with registered fault points at the real seams — ``device_put``
+  staging, grad-bucket collective dispatch, kvstore push/pull, serving
+  batch execution, compile-cache disk IO, engine push — firing by
+  seeded step/count so every recovery path is exercisable in tier-1.
+- :class:`~mxnet_tpu.resilience.retry.RetryPolicy` — the shared
+  bounded-attempts, jittered-exponential-backoff policy (kvstore_ps
+  transient sends route through it; terminal failures raise a clear
+  :class:`~mxnet_tpu.resilience.retry.RetryExhausted`).
+- :class:`~mxnet_tpu.resilience.breaker.CircuitBreaker` — serving-side
+  degradation: a repeatedly-failing bucket executable trips back to
+  the jit path (and ultimately open / fail-fast with cooldown), with
+  the degraded state reflected in ``/healthz``.
+
+``resilience_counters()`` surfaces checkpoint/restore/retry/breaker/
+fault-fire counts; they ride ``profiler.dump()`` and the
+``RESILIENCE`` runtime feature mirrors the master knob. See
+docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["CheckpointManager", "AutoResume", "ResumeExhausted",
+           "RetryPolicy", "RetryExhausted", "CircuitBreaker",
+           "CircuitOpen", "InjectedFault", "faults",
+           "resilience_enabled", "resilience_counters",
+           "reset_resilience_counters"]
+
+
+def resilience_enabled():
+    """MXNET_RESILIENCE master switch (default on). 0 degrades the
+    subsystem to fail-fast semantics: retry policies make a single
+    attempt, circuit breakers never trip, and AutoResume propagates
+    the first fault instead of restoring. Checkpoint writes and the
+    fault-injection harness are NOT gated (a disabled safety net must
+    still let you take snapshots and run chaos drills). Read per use
+    so tests can toggle without reimport."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_RESILIENCE", True)
+
+
+# ---------------------------------------------------------------------------
+# counters (thread-safe: the checkpoint writer thread, serving workers,
+# and the training thread all tick them)
+
+_LOCK = threading.Lock()
+
+
+def _zero_counters():
+    return {
+        # checkpointing
+        "ckpt_saves": 0,           # completed checkpoint writes
+        "ckpt_async_saves": 0,     # of which rode the writer thread
+        "ckpt_async_waits": 0,     # step loop blocked on a prior write
+        "ckpt_write_s": 0.0,       # serialize+write wall time (writer)
+        "ckpt_bytes": 0,           # payload bytes written
+        "ckpt_restores": 0,        # successful restores
+        "ckpt_corrupt_skipped": 0,  # invalid checkpoints skipped on load
+        "ckpt_pruned": 0,          # retention-evicted checkpoints
+        # auto-resume
+        "resume_faults_caught": 0,  # step-loop faults the supervisor ate
+        "resume_restarts": 0,       # restore-and-continue cycles
+        # retry/backoff
+        "retry_attempts": 0,       # EXTRA attempts beyond the first
+        "retry_giveups": 0,        # policies that exhausted attempts
+        "retry_sleep_s": 0.0,      # total backoff wall time
+        # circuit breaker
+        "breaker_trips": 0,        # closed -> open transitions
+        "breaker_fast_fails": 0,   # calls rejected while open
+        "breaker_resets": 0,       # half-open probe succeeded
+        "breaker_demotions": 0,    # serving buckets demoted to jit path
+        # fault injection
+        "fault_fires": 0,          # injected faults raised (all points)
+    }
+
+
+_COUNTERS = _zero_counters()
+
+
+def _count(name, delta=1):
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+
+
+def resilience_counters():
+    """Live resilience counters, plus one ``fault_fires:<point>`` entry
+    per fault point that fired and ``enabled`` mirroring the master
+    knob (the profiler surface; see the module docstring)."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+    from . import faults as _faults
+
+    for point, n in _faults.fire_counts().items():
+        out[f"fault_fires:{point}"] = n
+    out["fault_armed"] = 1 if _faults.armed() else 0
+    out["enabled"] = resilience_enabled()
+    return out
+
+
+def reset_resilience_counters():
+    """Zero every counter (tests, benchmarks). Does not disarm an
+    active fault plan — ``faults.disarm()`` owns that."""
+    global _COUNTERS
+    with _LOCK:
+        _COUNTERS = _zero_counters()
+    from . import faults as _faults
+
+    _faults.reset_fire_counts()
+
+
+from . import faults  # noqa: E402
+from .faults import InjectedFault  # noqa: E402
+from .retry import RetryPolicy, RetryExhausted  # noqa: E402
+from .breaker import CircuitBreaker, CircuitOpen  # noqa: E402
+from .checkpoint import CheckpointManager  # noqa: E402
+from .supervisor import AutoResume, ResumeExhausted  # noqa: E402

@@ -1,0 +1,130 @@
+"""Circuit breaker: stop hammering a failing dependency.
+
+The serving-side degradation primitive (the classic three-state
+breaker every production fleet front-end carries): CLOSED passes calls
+through and counts consecutive failures; ``threshold`` consecutive
+failures TRIP it OPEN — calls fail fast with :class:`CircuitOpen`
+(mapped to HTTP 503 by the model server) instead of queueing behind a
+dependency that cannot serve them; after ``cooldown_ms`` the breaker
+goes HALF-OPEN and admits one probe — a success closes it again, a
+failure re-opens (and restarts the cooldown).
+
+``mxnet_tpu/serving/session.py`` keeps one breaker per bucket
+executable: a bucket that fails repeatedly is first DEMOTED from its
+AOT/deserialized executable back to the plain jit path (a corrupt or
+stale artifact must not poison the bucket forever), and only if the
+jit path keeps failing does the breaker open. ``/healthz`` reflects
+both states so a load balancer / operator sees the degradation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["CircuitBreaker", "CircuitOpen"]
+
+
+class CircuitOpen(MXNetError):
+    """Fail-fast rejection: the breaker is open (HTTP 503 semantics —
+    retry after the cooldown)."""
+
+
+class CircuitBreaker:
+    """Three-state (closed / open / half-open) breaker.
+
+    Thread-safe: serving workers record outcomes concurrently. With
+    the ``MXNET_RESILIENCE`` master switch off the breaker never
+    trips (``allow`` is always True) — fail-fast policy belongs to
+    the resilience layer, and disabling it must restore the previous
+    always-try behavior.
+    """
+
+    def __init__(self, threshold=None, cooldown_ms=None, name="",
+                 clock=None):
+        from .. import env as _env
+
+        self.threshold = int(
+            threshold if threshold is not None else
+            _env.get_int("MXNET_BREAKER_THRESHOLD", 5))
+        self.cooldown_s = float(
+            cooldown_ms if cooldown_ms is not None else
+            _env.get_float("MXNET_BREAKER_COOLDOWN_MS", 30000.0)) / 1e3
+        self.name = name
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._failures = 0      # consecutive, while closed/half-open
+        self._opened_at = None  # monotonic stamp, while open
+        self._probing = False   # one half-open probe in flight
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self):
+        if self._opened_at is None:
+            return "closed"
+        if self._clock() - self._opened_at >= self.cooldown_s:
+            return "half-open"
+        return "open"
+
+    @property
+    def failures(self):
+        with self._lock:
+            return self._failures
+
+    def allow(self):
+        """True when a call may proceed (closed, or the single
+        half-open probe). False = the caller must fail fast; the
+        convenience :meth:`check` raises :class:`CircuitOpen` for it."""
+        from . import _count, resilience_enabled
+
+        if not resilience_enabled():
+            return True
+        with self._lock:
+            st = self._state_locked()
+            if st == "closed":
+                return True
+            if st == "half-open" and not self._probing:
+                self._probing = True
+                return True
+        _count("breaker_fast_fails")
+        return False
+
+    def check(self):
+        """``allow`` or raise :class:`CircuitOpen`."""
+        if not self.allow():
+            raise CircuitOpen(
+                f"circuit {self.name or 'breaker'} is open after "
+                f"{self.threshold} consecutive failure(s); retry after "
+                f"the {self.cooldown_s * 1e3:.0f}ms cooldown")
+
+    def record_success(self):
+        from . import _count
+
+        with self._lock:
+            was_open = self._opened_at is not None
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+        if was_open:
+            _count("breaker_resets")
+
+    def record_failure(self):
+        from . import _count, resilience_enabled
+
+        if not resilience_enabled():
+            return
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            tripped = self._opened_at is None and \
+                self._failures >= self.threshold
+            if tripped or self._opened_at is not None:
+                # trip, or re-open after a failed half-open probe:
+                # either way the cooldown restarts now
+                self._opened_at = self._clock()
+        if tripped:
+            _count("breaker_trips")

@@ -1,0 +1,318 @@
+"""Deterministic fault-injection harness.
+
+Every recovery path in this package is only trustworthy if it can be
+EXERCISED — on demand, deterministically, in tier-1 — so the framework
+registers **fault points** at its real failure seams and this module
+decides, per call, whether to raise there. The reference ecosystem
+tests ps-lite recovery by killing real processes; that stays the
+gold-standard test (tests/test_resilience.py does it with SIGKILL), but
+a seeded in-process harness makes EVERY seam reachable cheaply.
+
+Registered fault points (the catalogue; ``FAULT_POINTS``):
+
+========================  ==================================================
+``device_put``            DeviceFeed staging of a batch leaf onto the
+                          device (pipeline/device_feed.py) — models a
+                          failed H2D transfer / OOM during prefetch
+``grad_bucket_dispatch``  an AsyncGradReducer bucket collective dispatch
+                          mid-backward (pipeline/grad_sync.py)
+``kvstore_push``          KVStore.push / AsyncParamServer.push — a lost
+                          or failed gradient send
+``kvstore_pull``          KVStore.pull — a failed parameter fetch
+``serving_execute``       one InferenceSession bucket execution on the
+                          serving request path (serving/session.py)
+``compile_cache_io``      persistent compile-cache disk IO
+                          (utils/compile_cache.py load/store)
+``engine_push``           scheduling a host task on the dependency
+                          engine (engine.py)
+``checkpoint_write``      serializing/writing a checkpoint payload
+                          (resilience/checkpoint.py)
+========================  ==================================================
+
+A **plan** maps fault points to firing clauses. From the environment::
+
+    MXNET_FAULT_PLAN="device_put:at=3;kvstore_push:every=5:times=2"
+
+or programmatically::
+
+    from mxnet_tpu.resilience import faults
+    faults.arm("device_put:at=3")            # or a {point: spec} dict
+    ...
+    faults.disarm()
+
+    with faults.inject("kvstore_push", every=2, times=3, exc=OSError):
+        ...
+
+Clause keys (all integers unless noted): ``at=N`` fire on the Nth call
+to the point (1-based, once); ``every=N`` fire on every Nth call;
+``prob=P`` (float) fire with probability P from a ``random.Random``
+seeded by ``seed`` (default ``MXNET_FAULT_SEED``) folded with the point
+name — deterministic per (seed, point, call sequence); ``after=N``
+ignore the first N calls; ``times=K`` cap total fires (default 1 for
+``at``, unlimited otherwise); ``exc=Name`` the exception type to raise
+(``InjectedFault`` by default; OSError/IOError/RuntimeError/ValueError/
+ConnectionError/TimeoutError/MXNetError by name).
+
+The disarmed fast path is one module-global ``is None`` check, so the
+seams cost nothing in production. Arming an unknown point raises (a
+typo'd plan that silently never fires is worse than no plan).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import threading
+import zlib
+
+from ..base import MXNetError
+
+__all__ = ["InjectedFault", "FAULT_POINTS", "register_fault_point",
+           "maybe_fail", "arm", "disarm", "inject", "armed",
+           "fire_counts", "reset_fire_counts", "parse_plan"]
+
+
+class InjectedFault(MXNetError, OSError):
+    """The default injected exception. Subclasses both MXNetError (so
+    framework-error handlers see it) and OSError (so IO-seam handlers
+    that narrowly catch OSError exercise their real recovery path)."""
+
+
+#: name -> one-line description; the catalogue docs/RESILIENCE.md
+#: renders and ``arm`` validates against.
+FAULT_POINTS = {
+    "device_put": "DeviceFeed H2D staging of a batch leaf",
+    "grad_bucket_dispatch": "async grad-sync bucket collective dispatch",
+    "kvstore_push": "kvstore gradient push (local + param-server send)",
+    "kvstore_pull": "kvstore parameter pull",
+    "serving_execute": "InferenceSession bucket execution",
+    "compile_cache_io": "persistent compile-cache disk load/store",
+    "engine_push": "dependency-engine host-task push",
+    "checkpoint_write": "checkpoint payload serialize/write",
+}
+
+_EXC_BY_NAME = {
+    "InjectedFault": InjectedFault, "MXNetError": MXNetError,
+    "OSError": OSError, "IOError": OSError, "RuntimeError": RuntimeError,
+    "ValueError": ValueError, "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+}
+
+
+def register_fault_point(name, description):
+    """Extension point: declare a new fault point (custom subsystems,
+    tests). Idempotent for an identical description."""
+    FAULT_POINTS[str(name)] = str(description)
+
+
+class _Clause:
+    """One point's firing rule + its mutable call/fire counters.
+    Ticked under the module lock — fault points sit on multi-threaded
+    seams (feed workers, serving workers, the writer thread)."""
+
+    __slots__ = ("point", "at", "every", "prob", "after", "times",
+                 "exc", "calls", "fires", "_rng")
+
+    def __init__(self, point, at=None, every=None, prob=None, after=0,
+                 times=None, exc=InjectedFault, seed=None):
+        if at is None and every is None and prob is None:
+            raise MXNetError(
+                f"fault clause for {point!r} needs a trigger "
+                "(at=N | every=N | prob=P)")
+        self.point = point
+        self.at = None if at is None else int(at)
+        self.every = None if every is None else max(1, int(every))
+        self.prob = None if prob is None else float(prob)
+        self.after = int(after or 0)
+        if times is None:
+            times = 1 if self.at is not None else None
+        self.times = None if times is None else int(times)
+        self.exc = exc
+        self.calls = 0
+        self.fires = 0
+        if self.prob is not None:
+            if seed is None:
+                from .. import env as _env
+
+                seed = _env.get_int("MXNET_FAULT_SEED", 0)
+            # fold the point name in so two probabilistic clauses under
+            # one seed draw DIFFERENT (but each deterministic) streams;
+            # crc32, not hash(): PYTHONHASHSEED randomizes str hashes
+            # per process, and the firing sequence must be reproducible
+            # across runs (the whole point of a SEEDED plan)
+            self._rng = _pyrandom.Random(
+                (int(seed) << 32) ^ zlib.crc32(point.encode()))
+        else:
+            self._rng = None
+
+    def should_fire(self):
+        """Advance the call counter; True when this call must raise."""
+        self.calls += 1
+        n = self.calls
+        if n <= self.after:
+            return False
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.at is not None:
+            hit = n == self.at
+        elif self.every is not None:
+            hit = (n - self.after) % self.every == 0
+        else:
+            hit = self._rng.random() < self.prob
+        if hit:
+            self.fires += 1
+        return hit
+
+
+_LOCK = threading.Lock()
+_PLAN = None          # dict point -> _Clause, or None (disarmed)
+_FIRES = {}           # point -> total fires across plans (counters)
+
+
+def parse_plan(spec, seed=None):
+    """``MXNET_FAULT_PLAN`` grammar -> {point: _Clause}. ``spec`` may
+    also be a dict of {point: clause-kwargs-dict | clause-string}."""
+    clauses = {}
+    if isinstance(spec, dict):
+        items = spec.items()
+    else:
+        items = []
+        for frag in str(spec).split(";"):
+            frag = frag.strip()
+            if not frag:
+                continue
+            point, _, rest = frag.partition(":")
+            items.append((point.strip(), rest))
+    for point, rest in items:
+        if point not in FAULT_POINTS:
+            raise MXNetError(
+                f"unknown fault point {point!r} (known: "
+                f"{', '.join(sorted(FAULT_POINTS))}; register custom "
+                "points via register_fault_point)")
+        if isinstance(rest, dict):
+            kw = dict(rest)
+        else:
+            kw = {}
+            for tok in str(rest).split(":"):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                k, _, v = tok.partition("=")
+                kw[k.strip()] = v.strip()
+        exc = kw.pop("exc", InjectedFault)
+        if isinstance(exc, str):
+            if exc not in _EXC_BY_NAME:
+                raise MXNetError(
+                    f"unknown fault exception {exc!r} (known: "
+                    f"{', '.join(sorted(_EXC_BY_NAME))})")
+            exc = _EXC_BY_NAME[exc]
+        clean = {}
+        for k in ("at", "every", "after", "times"):
+            if k in kw:
+                clean[k] = int(kw.pop(k))
+        if "prob" in kw:
+            clean["prob"] = float(kw.pop("prob"))
+        # per-CLAUSE seed: a clause-level seed= must not leak into the
+        # clauses after it (order-dependent chaos plans are undebuggable)
+        clause_seed = int(kw.pop("seed")) if "seed" in kw else seed
+        if kw:
+            raise MXNetError(
+                f"unknown fault clause key(s) {sorted(kw)} for "
+                f"{point!r} (known: at/every/prob/after/times/seed/exc)")
+        clauses[point] = _Clause(point, exc=exc, seed=clause_seed,
+                                 **clean)
+    return clauses
+
+
+def arm(spec, seed=None):
+    """Arm a fault plan (replacing any active one). ``spec`` is the
+    ``MXNET_FAULT_PLAN`` string or a {point: kwargs} dict."""
+    global _PLAN
+    plan = parse_plan(spec, seed=seed)
+    with _LOCK:
+        _PLAN = plan or None
+    return plan
+
+
+def disarm():
+    """Drop the active plan (fault points go back to zero-cost)."""
+    global _PLAN
+    with _LOCK:
+        _PLAN = None
+
+
+def armed():
+    return _PLAN is not None
+
+
+class inject:
+    """Context manager arming ONE point for the block::
+
+        with faults.inject("kvstore_push", every=2, times=3):
+            ...
+
+    Restores the previously-armed plan (if any) on exit, so tests can
+    nest scoped injections without trampling each other."""
+
+    def __init__(self, point, **clause):
+        self._spec = {point: clause}
+        self._prev = None
+
+    def __enter__(self):
+        global _PLAN
+        plan = parse_plan(self._spec)
+        with _LOCK:
+            self._prev = _PLAN
+            _PLAN = plan
+        return self
+
+    def __exit__(self, *exc):
+        global _PLAN
+        with _LOCK:
+            _PLAN = self._prev
+
+
+def maybe_fail(point):
+    """The seam hook: raise the armed exception when ``point``'s clause
+    says this call fires, else return instantly. The disarmed cost is
+    one global read — call it freely on hot paths."""
+    plan = _PLAN
+    if plan is None:
+        return
+    clause = plan.get(point)
+    if clause is None:
+        return
+    with _LOCK:
+        fire = clause.should_fire()
+        if fire:
+            _FIRES[point] = _FIRES.get(point, 0) + 1
+    if fire:
+        from . import _count
+
+        _count("fault_fires")
+        raise clause.exc(
+            f"injected fault at point {point!r} "
+            f"(call {clause.calls}, fire {clause.fires})")
+
+
+def fire_counts():
+    """{point: total injected fires} since the last reset."""
+    with _LOCK:
+        return dict(_FIRES)
+
+
+def reset_fire_counts():
+    with _LOCK:
+        _FIRES.clear()
+
+
+def _init_from_env():
+    """Arm the env-declared plan at first import (subprocess chaos
+    drills set MXNET_FAULT_PLAN before launch; an empty/missing var is
+    a no-op)."""
+    from .. import env as _env
+
+    spec = _env.get_str("MXNET_FAULT_PLAN")
+    if spec:
+        arm(spec)
+
+
+_init_from_env()

@@ -1,0 +1,127 @@
+"""Shared retry/backoff policy.
+
+Transient-failure handling was previously ad hoc (kvstore_ps failed a
+push on the first send error; pull loops hand-rolled their own sleeps).
+This module is the ONE policy every retrying seam routes through —
+bounded attempts, jittered exponential backoff, a clear terminal error
+— so behavior and counters are uniform (reference analog: ps-lite's
+van resend/timeout machinery, configured once, used by every
+connection).
+
+Defaults come from the knob registry (``MXNET_RETRY_MAX_ATTEMPTS``,
+``MXNET_RETRY_BACKOFF_MS``, ``MXNET_RETRY_BACKOFF_MAX_MS``); with the
+``MXNET_RESILIENCE`` master switch off a policy makes exactly one
+attempt (fail-fast semantics). Jitter is decorrelated-uniform: delay =
+``base * 2**(attempt-1)`` scaled by a uniform draw in ``[1-jitter, 1]``
+— seeded policies draw deterministically (tests assert exact backoff
+sequences)."""
+from __future__ import annotations
+
+import logging
+import random as _pyrandom
+import time
+
+from ..base import MXNetError
+
+__all__ = ["RetryPolicy", "RetryExhausted"]
+
+
+class RetryExhausted(MXNetError):
+    """Terminal retry failure: all attempts failed. Chains the last
+    underlying exception (``raise ... from last``) and carries
+    ``attempts`` so callers/operators see exactly what was tried."""
+
+    def __init__(self, message, attempts=0):
+        super().__init__(message)
+        self.attempts = attempts
+
+
+class RetryPolicy:
+    """Bounded-attempt, jittered-exponential-backoff retry runner.
+
+    Parameters (None = the env-knob default)
+    ----------
+    max_attempts : int — TOTAL attempts including the first (so 1 =
+        no retries); forced to 1 when ``MXNET_RESILIENCE=0``
+    base_ms / max_ms : float — backoff starts at ``base_ms`` and
+        doubles per retry, capped at ``max_ms``
+    jitter : float in [0, 1] — each delay is scaled by a uniform draw
+        in ``[1 - jitter, 1]`` (0 = deterministic full backoff)
+    retry_on : exception type(s) considered transient; anything else
+        propagates immediately
+    seed : int — deterministic jitter stream (tests); default draws
+        from the process RNG
+    name : str — labels log lines and terminal errors
+    sleep : callable — injectable clock (tests); default ``time.sleep``
+    """
+
+    def __init__(self, max_attempts=None, base_ms=None, max_ms=None,
+                 jitter=0.5, retry_on=(Exception,), seed=None,
+                 name="retry", sleep=None):
+        from .. import env as _env
+
+        self.max_attempts = int(
+            max_attempts if max_attempts is not None else
+            _env.get_int("MXNET_RETRY_MAX_ATTEMPTS", 4))
+        self.base_ms = float(
+            base_ms if base_ms is not None else
+            _env.get_float("MXNET_RETRY_BACKOFF_MS", 50.0))
+        self.max_ms = float(
+            max_ms if max_ms is not None else
+            _env.get_float("MXNET_RETRY_BACKOFF_MAX_MS", 2000.0))
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self.retry_on = retry_on if isinstance(retry_on, tuple) \
+            else (retry_on,)
+        self.name = name
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = _pyrandom.Random(seed) if seed is not None \
+            else _pyrandom
+
+    def delay_ms(self, attempt):
+        """Backoff before retry number ``attempt`` (1-based)."""
+        raw = min(self.max_ms, self.base_ms * (2.0 ** (attempt - 1)))
+        if self.jitter:
+            raw *= 1.0 - self.jitter * self._rng.random()
+        return raw
+
+    def run(self, fn, *args, **kwargs):
+        """Call ``fn(*args, **kwargs)``, retrying transient failures.
+        Returns the first successful result; raises
+        :class:`RetryExhausted` (chaining the last failure) when every
+        attempt failed, or the original exception immediately when it
+        is not in ``retry_on``."""
+        from . import _count, resilience_enabled
+
+        attempts = self.max_attempts if resilience_enabled() else 1
+        attempts = max(1, attempts)
+        last = None
+        for attempt in range(1, attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:  # transient: back off and retry
+                last = e
+                if attempt >= attempts:
+                    break
+                delay = self.delay_ms(attempt) / 1e3
+                _count("retry_attempts")
+                _count("retry_sleep_s", delay)
+                logging.getLogger(__name__).debug(
+                    "%s: attempt %d/%d failed (%s); retrying in %.0fms",
+                    self.name, attempt, attempts, e, delay * 1e3)
+                if delay > 0:
+                    self._sleep(delay)
+        _count("retry_giveups")
+        raise RetryExhausted(
+            f"{self.name}: all {attempts} attempt(s) failed "
+            f"(last error: {type(last).__name__}: {last})",
+            attempts=attempts) from last
+
+    def wrap(self, fn):
+        """Decorator form of :meth:`run`."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.run(fn, *args, **kwargs)
+
+        return wrapped

@@ -65,6 +65,13 @@ def _detect():
     except Exception:
         feats["PIPELINE"] = False
     try:
+        from .resilience import resilience_enabled
+
+        # fault-tolerance layer armed (MXNET_RESILIENCE, resilience/)
+        feats["RESILIENCE"] = resilience_enabled()
+    except Exception:
+        feats["RESILIENCE"] = False
+    try:
         from .analysis import verify_mode
 
         # static graph verifier armed (MXNET_GRAPH_VERIFY, analysis/)

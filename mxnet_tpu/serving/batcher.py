@@ -220,7 +220,7 @@ class DynamicBatcher:
             from .. import random as mxrandom
 
             mxrandom.next_key()
-        except Exception:
+        except Exception:  # graft-lint: allow(L501)
             pass
         finally:
             if ready is not None:
@@ -384,5 +384,5 @@ class DynamicBatcher:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graft-lint: allow(L501)
             pass

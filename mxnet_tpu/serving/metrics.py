@@ -178,7 +178,7 @@ class ServingMetrics:
         for p in probes:
             try:
                 depth += int(p())
-            except Exception:
+            except Exception:  # graft-lint: allow(L501)
                 pass
         return depth
 

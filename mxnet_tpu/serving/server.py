@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as onp
 
+from ..resilience.breaker import CircuitOpen
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy
 from .metrics import prometheus_text
 
@@ -146,12 +147,26 @@ class _ServingHandler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             session = srv.session
             warm = bool(getattr(session, "warm", True))
+            # resilience state rides along: buckets demoted to the jit
+            # path and open circuit breakers (serving/session.py). A
+            # degraded-but-warm replica still answers 200 — it serves,
+            # just slower — so the LB keeps it while operators see the
+            # "degraded" status and act on it
+            degraded = list(getattr(session, "degraded", []))
+            states = getattr(session, "breaker_states", dict)()
+            open_buckets = sorted(b for b, s in states.items()
+                                  if s != "closed")
+            status = "ok" if warm else "warming"
+            if warm and (degraded or open_buckets):
+                status = "degraded"
             # 503 until warm so a status-code health check (the
             # standard LB kind) keeps traffic off a cold replica
             self._reply(200 if warm else 503, {
-                "status": "ok" if warm else "warming",
+                "status": status,
                 "warm": warm,
                 "buckets": list(getattr(session, "buckets", [])),
+                "degraded_buckets": degraded,
+                "open_buckets": open_buckets,
                 "queue_depth": srv.batcher.qsize()})
         elif self.path == "/metrics":
             self._reply(200, prometheus_text().encode(),
@@ -199,7 +214,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._error(400, str(e))
             return
-        except ServerBusy as e:
+        except (ServerBusy, CircuitOpen) as e:
+            # both are "back off and retry later": queue backpressure,
+            # or this bucket's circuit is open during its cooldown
             self._error(503, str(e))
             return
         except (RequestTimeout, _FutureTimeout) as e:

@@ -153,6 +153,8 @@ class InferenceSession:
         self._block = block
         self._lock = threading.Lock()
         self._entries = {}  # (bucket, amp_ver) -> _BucketEntry
+        self._breakers = {}  # (bucket, amp_ver) -> CircuitBreaker
+        self._demoted = set()  # (bucket, amp_ver) forced to the jit path
         self._num_outputs = None
         self._mutation_warned = False
         max_batch = int(max_batch or _env.get_int(
@@ -506,26 +508,110 @@ class InferenceSession:
                 return b
         return self.buckets[-1]
 
+    def _breaker(self, bucket, amp_ver):
+        """The per-bucket circuit breaker (created on first use). One
+        breaker per (bucket, AMP version) — an AMP flip re-resolves
+        the executable, so its failure history starts clean too."""
+        from ..resilience.breaker import CircuitBreaker
+
+        br = self._breakers.get((bucket, amp_ver))
+        if br is None:
+            with self._lock:
+                br = self._breakers.setdefault(
+                    (bucket, amp_ver),
+                    CircuitBreaker(name=f"serving bucket {bucket}"))
+        return br
+
+    def _record_bucket_failure(self, bucket, amp_ver, err):
+        """Serving-side degradation policy: the FIRST failures demote
+        the bucket from its AOT/deserialized executable back to the
+        plain jit path (a corrupt or stale disk artifact must not
+        poison the bucket forever — the jit path retraces fresh);
+        failures past the breaker threshold open the circuit and the
+        bucket fails fast (CircuitOpen -> HTTP 503) until the cooldown
+        admits a probe. ``/healthz`` reflects both states."""
+        from ..resilience import _count
+
+        br = self._breaker(bucket, amp_ver)
+        br.record_failure()
+        key = (bucket, amp_ver)
+        if key not in self._demoted and br.failures >= 2:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None and key not in self._demoted:
+                    self._demoted.add(key)
+                    ent.fn = self._jitted_for(amp_ver)
+                    ent.from_disk = False
+                    _count("breaker_demotions")
+                    logging.warning(
+                        "serving: bucket %d (amp v%d) failed "
+                        "repeatedly (%s: %s); demoted its executable "
+                        "to the jit path", bucket, amp_ver,
+                        type(err).__name__, err)
+
+    @property
+    def degraded(self):
+        """Buckets no longer running their AOT executable under the
+        CURRENT AMP policy (demoted to the jit path), sorted.
+        Snapshot under the lock: /healthz handler threads iterate
+        while serving workers insert."""
+        amp_ver = self._amp_version()
+        with self._lock:
+            demoted = set(self._demoted)
+        return sorted(b for b, v in demoted if v == amp_ver)
+
+    def breaker_states(self):
+        """{bucket: breaker state} under the current AMP policy, for
+        buckets that recorded at least one outcome. Snapshot under the
+        lock (see ``degraded``)."""
+        amp_ver = self._amp_version()
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {b: br.state for (b, v), br in breakers.items()
+                if v == amp_ver}
+
     def _run_bucket(self, arrs, n):
         """Execute one <=max_batch slice through its bucket executable;
         returns the list of output jax arrays sliced back to ``n``
         rows. Host (numpy) inputs are padded in numpy and uploaded
         ONCE — no shape-dependent eager prims on the request path;
-        device (NDArray) inputs pad on device."""
+        device (NDArray) inputs pad on device. Failures feed the
+        bucket's circuit breaker (see ``_record_bucket_failure``); an
+        open breaker fails the request fast with CircuitOpen."""
+        from ..resilience import faults as _faults
+
         bucket = self._bucket_for(n)
-        ent = self._entry(bucket)
-        datas = []
-        for a in arrs:
-            if isinstance(a, NDArray):
-                datas.append(cc.pad_batch(a.data, bucket))
-            else:
-                if a.shape[0] != bucket:
-                    padded = onp.zeros((bucket,) + a.shape[1:], a.dtype)
-                    padded[:a.shape[0]] = a
-                    a = padded
-                datas.append(nd.array(a).data)
-        key = mxrandom.next_key()
-        out = ent.fn(self._param_vals, key, datas)
+        amp_ver = self._amp_version()
+        br = self._breakers.get((bucket, amp_ver))
+        if br is not None:
+            br.check()  # open circuit: fail fast (HTTP 503)
+        # EVERY failure past the check must reach the breaker — entry
+        # resolution, padding/upload, key draw and execution alike. A
+        # half-open probe admitted by check() that died without a
+        # recorded outcome would leak the probe slot and wedge the
+        # bucket in fail-fast forever.
+        try:
+            ent = self._entry(bucket)
+            datas = []
+            for a in arrs:
+                if isinstance(a, NDArray):
+                    datas.append(cc.pad_batch(a.data, bucket))
+                else:
+                    if a.shape[0] != bucket:
+                        padded = onp.zeros((bucket,) + a.shape[1:],
+                                           a.dtype)
+                        padded[:a.shape[0]] = a
+                        a = padded
+                    datas.append(nd.array(a).data)
+            key = mxrandom.next_key()
+            # registered fault point: one bucket execution on the
+            # serving request path
+            _faults.maybe_fail("serving_execute")
+            out = ent.fn(self._param_vals, key, datas)
+        except Exception as e:
+            self._record_bucket_failure(bucket, amp_ver, e)
+            raise
+        self._breaker(bucket, amp_ver).record_success()
         METRICS.bump("bucket_execs")
         METRICS.bump("padded_rows", bucket - n)
         METRICS.bump("true_rows", n)

@@ -286,6 +286,18 @@ def disk_load(fp):
     if not os.path.exists(path):
         _bump("disk_misses")
         return None
+    # registered fault point (resilience/faults.py): a transient IO
+    # failure degrades to a MISS — outside the corruption handler
+    # below, which deletes the file: an injected transient must not
+    # destroy a valid cache entry (chaos drills would erode the warm
+    # start they are testing)
+    from ..resilience import faults as _faults
+
+    try:
+        _faults.maybe_fail("compile_cache_io")
+    except Exception:
+        _bump("disk_misses")
+        return None
     try:
         with open(path, "rb") as f:
             env = pickle.load(f)
@@ -331,6 +343,9 @@ def disk_store(fp, compiled, meta=None, key_repr=None):
         _bump("serialize_skips")
         return False
     try:
+        from ..resilience import faults as _faults
+
+        _faults.maybe_fail("compile_cache_io")
         directory = cache_dir()
         os.makedirs(directory, exist_ok=True)
         path = _entry_path(fp)
@@ -338,7 +353,9 @@ def disk_store(fp, compiled, meta=None, key_repr=None):
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)  # atomic: concurrent writers race safely
-    except OSError:
+    except Exception:
+        # broad on purpose: ANY cache-write failure (disk full, perm,
+        # an injected fault) is a skipped write, never a broken step
         return False
     _bump("disk_writes")
     _maybe_prune(directory)

@@ -1,0 +1,92 @@
+"""Child process of tests/test_resilience.py's kill-and-resume test.
+
+Runs a small deterministic training job (dropout exercises the global
+PRNG stream; momentum SGD exercises optimizer state) under AutoResume
+with async checkpoints. Driven by env vars:
+
+  RESIL_CKPT_DIR   checkpoint directory (required)
+  RESIL_OUT        .npz written on COMPLETION: final params + loss trace
+  RESIL_KILL_AT    SIGKILL self when the next global step == this
+                   (simulating a hard mid-epoch crash: no atexit, no
+                   flush, whatever the writer was doing is torn)
+
+A killed run writes nothing; re-running the same command restores the
+newest valid checkpoint and finishes. The parent compares the resumed
+run's output bitwise against an uninterrupted run.
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from _cpu_platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(num_devices=1)
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.resilience import AutoResume, CheckpointManager  # noqa: E402
+
+EPOCHS = 2
+STEPS_PER_EPOCH = 6
+BATCH, DIM, OUT = 4, 8, 4
+
+
+def build():
+    mx.random.seed(42)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dropout(0.5))  # draws from the global stream per step
+    net.add(nn.Dense(OUT))
+    net.initialize()
+    net(nd.zeros((1, DIM)))
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05, "momentum": 0.9})
+    return net, trainer
+
+
+def data_factory(epoch):
+    rs = onp.random.RandomState(1000 + epoch)
+    for _ in range(STEPS_PER_EPOCH):
+        yield (rs.rand(BATCH, DIM).astype("f"),
+               rs.rand(BATCH, OUT).astype("f"))
+
+
+def main():
+    ckpt_dir = os.environ["RESIL_CKPT_DIR"]
+    out = os.environ.get("RESIL_OUT")
+    kill_at = int(os.environ.get("RESIL_KILL_AT", "0"))
+    net, trainer = build()
+    counter = {"g": 0}
+
+    def step_fn(batch):
+        if kill_at and counter["g"] + 1 == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)  # a REAL hard crash
+        counter["g"] += 1
+        x, y = nd.array(batch[0]), nd.array(batch[1])
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(BATCH)
+        return float(loss.asnumpy())
+
+    manager = CheckpointManager(ckpt_dir, trainer=trainer,
+                                async_mode=True, keep=3)
+    sup = AutoResume(manager, data_factory, step_fn, epochs=EPOCHS,
+                     ckpt_every=3)
+    trace = sup.run()
+    if out:
+        params = {name: p.data().asnumpy()
+                  for name, p in net.collect_params().items()}
+        onp.savez(out, trace=onp.asarray(trace, dtype="float64"),
+                  **params)
+    print(f"done steps={counter['g']} trace_len={len(trace)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Seeded graft_lint L501 fixture: bare/silently-swallowed excepts.
+
+NOT part of the framework — tests/test_graft_lint.py lints this file
+and asserts the rule catches every violation (and honors the pragma'd
+site). Keep the violation inventory in sync with the test.
+"""
+
+
+def bare_clause():
+    """Violation: a bare except eats SystemExit/KeyboardInterrupt."""
+    try:
+        return 1 / 0
+    except:  # noqa: E722 — the violation under test
+        return None
+
+
+def swallowed_broad():
+    """Violation: broad handler whose body is only pass."""
+    try:
+        return open("/nonexistent")
+    except Exception:
+        pass
+
+
+def swallowed_base_tuple():
+    """Violation: BaseException inside a tuple, still swallowed."""
+    try:
+        return open("/nonexistent")
+    except (ValueError, BaseException):
+        ...
+
+
+def narrow_swallow_ok():
+    """NOT a violation: a narrow type may be deliberately ignored."""
+    try:
+        return open("/nonexistent")
+    except FileNotFoundError:
+        pass
+
+
+def broad_but_handled_ok():
+    """NOT a violation: the broad handler does something."""
+    try:
+        return open("/nonexistent")
+    except Exception as e:
+        return repr(e)
+
+
+def pragma_ok():
+    """NOT a finding: the deliberate site carries the pragma."""
+    try:
+        return open("/nonexistent")
+    except Exception:  # graft-lint: allow(L501)
+        pass

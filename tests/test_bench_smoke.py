@@ -95,6 +95,41 @@ def test_pipeline_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "pipeline_epoch"
 
 
+@pytest.mark.slow
+def test_resilience_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import resilience_bench
+
+    out = str(tmp_path / "resil.json")
+    doc = resilience_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["recovery"]["bitwise_equal"]
+    assert doc["recovery"]["loss_trace_equal"]
+    assert doc["recovery"]["amp_bitwise_equal"]
+    assert doc["recovery"]["amp_scale_trace_equal"]
+    assert doc["recovery"]["amp_skip_exercised"]
+    assert doc["recovery"]["restarts"] == 1
+    assert doc["recovery"]["fault_fires"].get("bench_step") == 2
+    assert doc["overhead"]["nockpt_epoch_s"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "resilience"
+
+
+def test_bench_compare_resilience_overhead_metrics():
+    """BENCH_RESIL_r12.json names: checkpoint overhead percentages and
+    epoch seconds are lower-is-better; counters untracked."""
+    base = {"overhead": {"async_overhead_pct": 2.0,
+                         "async_ckpt_epoch_s": 0.51,
+                         "saves_per_epoch": 8}}
+    worse = {"overhead": {"async_overhead_pct": 9.0,
+                          "async_ckpt_epoch_s": 0.80,
+                          "saves_per_epoch": 8}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["overhead.async_overhead_pct"][4]   # 2% -> 9%: REGRESSED
+    assert rows["overhead.async_ckpt_epoch_s"][4]
+    assert "overhead.saves_per_epoch" not in rows   # not a direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_pipeline_epoch_metrics():
     """BENCH_PIPELINE_r11.json names: epoch/idle seconds are
     lower-is-better, steps_per_s and overlap_ratio higher-is-better,

@@ -14,6 +14,8 @@ import graft_lint  # noqa: E402
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "lint_violation.py")
 PIPE_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                             "pipeline_sync_violation.py")
+EXC_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                           "lint_bare_except.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -51,6 +53,51 @@ def test_step_sync_fixture_triggers_each_species():
     assert len(l401) == 5, l401
     # the pragma'd whitelisted_epoch_end sync is suppressed
     assert all(f.line < 32 for f in l401), l401
+
+
+def test_bare_except_fixture_triggers_l501():
+    """L501: the seeded fixture's bare except and pass-only broad
+    handlers are flagged; the narrow/handled/pragma'd sites are not."""
+    findings = graft_lint.lint_paths([EXC_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l501 = [f for f in findings if f.code == "L501"]
+    assert len(l501) == 3, l501  # bare + Exception-pass + tuple-Base
+    msgs = "\n".join(f.message for f in l501)
+    assert "bare 'except:'" in msgs
+    assert "silently swallows" in msgs
+    # each finding anchors to an actual except line of the fixture
+    src = open(EXC_FIXTURE).read().splitlines()
+    for f in l501:
+        assert src[f.line - 1].lstrip().startswith("except"), \
+            (f.line, src[f.line - 1])
+    assert {f.code for f in findings} == {"L501"}, findings
+
+
+def test_l501_swallowed_variants(tmp_path):
+    """Edge shapes: ellipsis-only body is swallowed; a logging body is
+    not; bare except is flagged even with a real body."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import logging\n"
+        "def a():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException:\n"
+        "        ...\n"
+        "def b():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        logging.warning('seen')\n"
+        "def c(xs):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        xs.append(1)\n")
+    findings = graft_lint.lint_paths([str(p)], repo_root=REPO,
+                                     registry=False)
+    lines = sorted(f.line for f in findings if f.code == "L501")
+    assert lines == [5, 15], findings  # a() ellipsis + c() bare
 
 
 def test_step_sync_scope_is_opt_in_outside_pipeline(tmp_path):

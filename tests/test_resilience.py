@@ -1,0 +1,962 @@
+"""Fault-tolerant training & serving (mxnet_tpu/resilience/): the
+crash-consistent CheckpointManager, AutoResume supervisor, deterministic
+fault-injection harness, shared retry/backoff policy, and the serving
+circuit breaker — plus their wiring into trainer / pipeline / kvstore /
+serving / compile-cache / engine seams.
+
+The headline guarantees get the hard tests: a subprocess SIGKILLed
+mid-epoch restarts through AutoResume to BITWISE-identical final
+parameters and loss trace vs an uninterrupted run, and a corrupt or
+truncated checkpoint is skipped with a warning while the previous good
+one loads.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.parameter import Parameter
+from mxnet_tpu.resilience import (AutoResume, CheckpointManager,
+                                  CircuitBreaker, InjectedFault,
+                                  ResumeExhausted, RetryExhausted,
+                                  RetryPolicy, faults)
+from mxnet_tpu.resilience.breaker import CircuitOpen
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed with fresh counters — an
+    armed plan leaking across tests would fire in unrelated seams."""
+    faults.disarm()
+    resilience.reset_resilience_counters()
+    yield
+    faults.disarm()
+    resilience.reset_resilience_counters()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _collect_cycles():
+    """One gc pass after the module: break trainer<->manager<->
+    supervisor reference cycles so this file's AMP trainers leave the
+    fused-step registry (its weak set backs the process-wide
+    ``skipped_steps`` profiler counter) before later test FILES read
+    it. Module-scoped on purpose — a full collect per test costs
+    seconds across the file for no extra isolation."""
+    import gc
+
+    yield
+    gc.collect()
+
+
+def _make_params(n, shape=(4, 4)):
+    params = []
+    for i in range(n):
+        p = Parameter(f"res_p{i}", shape=shape, dtype="float32")
+        p.initialize()
+        p.set_data(nd.array(onp.full(shape, float(i + 1), "f")))
+        params.append(p)
+    return params
+
+
+def _backward_over(params, scale=2.0):
+    with autograd.record():
+        loss = sum(((p.data() * scale).sum() for p in params),
+                   nd.array(0.0))
+    loss.backward()
+
+
+def _dropout_net(seed=3, dim=8, out=4):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dropout(0.5))
+    net.add(nn.Dense(out))
+    net.initialize()
+    net(nd.zeros((1, dim)))
+    return net
+
+
+def _param_bytes(net):
+    return [p.data().asnumpy().tobytes()
+            for p in net.collect_params().values()]
+
+
+def _traces_equal(a, b):
+    """Elementwise-identical loss traces; NaN == NaN (the poisoned AMP
+    batch produces a NaN loss on BOTH sides by design)."""
+    return len(a) == len(b) and onp.array_equal(
+        onp.asarray(a, "float64"), onp.asarray(b, "float64"),
+        equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness
+
+
+def test_fault_plan_parse_and_at_trigger():
+    faults.arm("engine_push:at=2")
+    mx.engine.push(lambda: None)  # call 1: no fire
+    with pytest.raises(InjectedFault):
+        mx.engine.push(lambda: None)  # call 2: fires once
+    mx.engine.push(lambda: None)  # at= fires exactly once
+    counts = faults.fire_counts()
+    assert counts == {"engine_push": 1}
+    assert resilience.resilience_counters()["fault_fires"] == 1
+
+
+def test_fault_every_and_times():
+    faults.arm({"engine_push": dict(every=2, times=2)})
+    fired = 0
+    for _ in range(8):
+        try:
+            mx.engine.push(lambda: None)
+        except InjectedFault:
+            fired += 1
+    assert fired == 2  # every 2nd call, capped at times=2
+
+
+def test_fault_prob_is_seeded_deterministic():
+    def fires(seed):
+        faults.arm({"engine_push": dict(prob=0.5, times=100)}, seed=seed)
+        out = []
+        for i in range(20):
+            try:
+                mx.engine.push(lambda: None)
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        faults.disarm()
+        return out
+
+    a, b, c = fires(7), fires(7), fires(8)
+    assert a == b          # same seed: identical firing sequence
+    assert a != c          # different seed: different sequence
+    assert 0 < sum(a) < 20
+
+
+def test_fault_unknown_point_and_bad_clause_raise():
+    with pytest.raises(MXNetError):
+        faults.arm("not_a_point:at=1")
+    with pytest.raises(MXNetError):
+        faults.arm("engine_push:bogus=1")
+    with pytest.raises(MXNetError):
+        faults.arm({"engine_push": {}})  # no trigger
+
+
+def test_fault_exc_mapping_and_inject_scoping():
+    faults.arm("engine_push:at=1")  # outer plan
+    with faults.inject("engine_push", at=1, exc=OSError):
+        with pytest.raises(OSError):
+            mx.engine.push(lambda: None)
+    # the context restored the OUTER plan (call count untouched)
+    with pytest.raises(InjectedFault):
+        mx.engine.push(lambda: None)
+
+
+def test_fault_clause_seed_does_not_leak_across_clauses():
+    """A clause-level seed= binds to ITS clause only — the clauses
+    after it keep the plan-level default (order-independent plans)."""
+    p1 = faults.parse_plan(
+        "engine_push:prob=0.5:seed=7;kvstore_push:prob=0.5", seed=0)
+    p2 = faults.parse_plan("kvstore_push:prob=0.5", seed=0)
+    a = [p1["kvstore_push"]._rng.random() for _ in range(5)]
+    b = [p2["kvstore_push"]._rng.random() for _ in range(5)]
+    assert a == b
+
+
+def test_injected_fault_is_oserror_and_mxneterror():
+    assert issubclass(InjectedFault, OSError)
+    assert issubclass(InjectedFault, MXNetError)
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=5, base_ms=0.01, jitter=0.0,
+                      name="test")
+    assert pol.run(flaky) == "ok"
+    assert len(calls) == 3
+    c = resilience.resilience_counters()
+    assert c["retry_attempts"] == 2
+    assert c["retry_giveups"] == 0
+
+
+def test_retry_exhausted_is_terminal_and_chains():
+    def dead():
+        raise ConnectionError("down")
+
+    pol = RetryPolicy(max_attempts=3, base_ms=0.01, jitter=0.0)
+    with pytest.raises(RetryExhausted) as ei:
+        pol.run(dead)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert isinstance(ei.value, MXNetError)  # clear terminal error
+    assert resilience.resilience_counters()["retry_giveups"] == 1
+
+
+def test_retry_non_transient_propagates_immediately():
+    calls = []
+
+    def typo():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    pol = RetryPolicy(max_attempts=5, base_ms=0.01,
+                      retry_on=(ConnectionError,))
+    with pytest.raises(ValueError):
+        pol.run(typo)
+    assert len(calls) == 1
+
+
+def test_retry_backoff_deterministic_with_seed():
+    p1 = RetryPolicy(base_ms=100, max_ms=1000, jitter=0.5, seed=1)
+    p2 = RetryPolicy(base_ms=100, max_ms=1000, jitter=0.5, seed=1)
+    d1 = [p1.delay_ms(a) for a in range(1, 5)]
+    d2 = [p2.delay_ms(a) for a in range(1, 5)]
+    assert d1 == d2
+    assert all(50 <= d1[0] <= 100 for _ in [0])  # jitter in [0.5, 1]x
+    # exponential growth under the cap
+    nojit = RetryPolicy(base_ms=100, max_ms=1000, jitter=0.0)
+    assert [nojit.delay_ms(a) for a in range(1, 6)] == \
+        [100, 200, 400, 800, 1000]
+
+
+def test_retry_single_attempt_when_resilience_off(monkeypatch):
+    monkeypatch.setenv("MXNET_RESILIENCE", "0")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("x")
+
+    pol = RetryPolicy(max_attempts=5, base_ms=0.01)
+    with pytest.raises(RetryExhausted):
+        pol.run(flaky)
+    assert len(calls) == 1  # fail-fast: no retries
+
+
+def test_kvstore_ps_push_retries_transient_sends(monkeypatch):
+    """Satellite: AsyncParamServer.push routes its coordinator-KV
+    sends through the shared policy — bounded attempts, then a clear
+    terminal error — instead of failing the first push."""
+    from mxnet_tpu import kvstore_ps
+
+    class FakeClient:
+        def __init__(self, fail_first):
+            self.fail = fail_first
+            self.seqs = {}
+            self.blobs = {}
+            self.set_calls = 0
+
+        def key_value_increment(self, key, n):
+            self.seqs[key] = self.seqs.get(key, 0) + n
+            return self.seqs[key]
+
+        def key_value_set_bytes(self, key, blob):
+            self.set_calls += 1
+            if self.fail > 0:
+                self.fail -= 1
+                raise ConnectionError("van dropped the message")
+            self.blobs[key] = blob
+
+    fake = FakeClient(fail_first=2)
+    monkeypatch.setattr(kvstore_ps, "_client", lambda: fake)
+    ps = kvstore_ps.AsyncParamServer(rank=1, get_updater=lambda: None)
+    ps._retry = RetryPolicy(max_attempts=4, base_ms=0.01, jitter=0.0,
+                            name="test kvstore_ps")
+    try:
+        ps.push("w", onp.ones(3, "f"))
+        assert fake.set_calls == 3  # two transient failures retried
+        assert len(fake.blobs) == 1
+        # permanent failure: bounded attempts then RetryExhausted
+        fake.fail = 10 ** 9
+        with pytest.raises(RetryExhausted):
+            ps.push("w", onp.ones(3, "f"))
+    finally:
+        ps._last_seq.clear()  # keep the atexit flush a no-op
+        ps.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+
+def test_breaker_trips_opens_and_half_open_recovers():
+    clk = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown_ms=1000, name="t",
+                        clock=lambda: clk[0])
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed" and br.allow()
+    br.record_failure()  # 3rd consecutive: trip
+    assert br.state == "open"
+    assert not br.allow()
+    with pytest.raises(CircuitOpen):
+        br.check()
+    clk[0] = 1.5  # past the cooldown: one half-open probe
+    assert br.state == "half-open"
+    assert br.allow()       # the probe
+    assert not br.allow()   # only ONE probe
+    br.record_success()     # probe succeeded: closed again
+    assert br.state == "closed" and br.allow()
+    c = resilience.resilience_counters()
+    assert c["breaker_trips"] == 1
+    assert c["breaker_resets"] == 1
+    assert c["breaker_fast_fails"] >= 1
+
+
+def test_breaker_failed_probe_reopens():
+    clk = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_ms=1000,
+                        clock=lambda: clk[0])
+    br.record_failure()
+    assert br.state == "open"
+    clk[0] = 1.1
+    assert br.allow()
+    br.record_failure()  # probe failed: cooldown restarts
+    assert br.state == "open"
+    assert not br.allow()
+
+
+def test_breaker_never_trips_when_resilience_off(monkeypatch):
+    monkeypatch.setenv("MXNET_RESILIENCE", "0")
+    br = CircuitBreaker(threshold=1, cooldown_ms=60000)
+    for _ in range(5):
+        br.record_failure()
+    assert br.allow()
+
+
+# ---------------------------------------------------------------------------
+# seams
+
+
+def test_device_put_fault_propagates_from_feed_worker():
+    def gen():
+        for i in range(5):
+            yield onp.full((2, 2), float(i), "f")
+
+    from mxnet_tpu.pipeline import DeviceFeed
+
+    feed = DeviceFeed(gen(), depth=2)
+    faults.arm("device_put:at=3")
+    got, err = [], None
+    try:
+        for b in feed:
+            got.append(b)
+    except InjectedFault as e:
+        err = e
+    assert err is not None  # worker fault reached the consumer
+    assert len(got) <= 3
+    assert faults.fire_counts()["device_put"] == 1
+    feed.close()
+
+
+def test_grad_bucket_dispatch_fault_fires_mid_backward():
+    from mxnet_tpu.pipeline import AsyncGradReducer
+
+    params = _make_params(3)
+    red = AsyncGradReducer(params, bucket_bytes=1,
+                           reduce_fn=lambda f: f).attach()
+    try:
+        faults.arm("grad_bucket_dispatch:at=1")
+        with pytest.raises(InjectedFault):
+            _backward_over(params)
+        faults.disarm()
+        red.abandon()  # the recovery path: drop the partial round
+        _backward_over(params)  # clean round still works
+        red.flush([p.grad() for p in params])
+    finally:
+        red.detach()
+
+
+def test_kvstore_push_pull_fault_points():
+    kv = mx.kvstore.create("local")
+    kv.init("w", nd.zeros((4,)))
+    with faults.inject("kvstore_push", at=1):
+        with pytest.raises(InjectedFault):
+            kv.push("w", nd.ones((4,)))
+    kv.push("w", nd.ones((4,)))  # disarmed: works
+    out = nd.zeros((4,))
+    with faults.inject("kvstore_pull", at=1):
+        with pytest.raises(InjectedFault):
+            kv.pull("w", out=out)
+    kv.pull("w", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones(4, "f"))
+
+
+def test_compile_cache_io_fault_degrades_to_miss():
+    from mxnet_tpu.utils import compile_cache as cc
+
+    import jax.numpy as jnp
+
+    jf = cc.counting_jit(lambda x: x * 2.0, label="resil_test")
+    fp = cc.fingerprint("resil_test", ("k", 1))
+    compiled = cc.aot_compile(jf, jnp.zeros((2,)))
+    assert cc.disk_store(fp, compiled)
+    before = cc.compile_cache_stats()
+    with faults.inject("compile_cache_io", every=1):
+        assert cc.disk_load(fp) is None       # load fault -> a miss
+        assert not cc.disk_store(fp, compiled)  # store fault -> skipped
+    after = cc.compile_cache_stats()
+    assert after["disk_misses"] >= before["disk_misses"] + 1
+    # a transient injected failure must NOT destroy the valid entry:
+    # once the drill ends, the warm start it was testing still works
+    assert cc.disk_load(fp) is not None
+    # the step path stays alive: a fresh load_or_compile still serves
+    with faults.inject("compile_cache_io", every=1):
+        fn, _, from_disk = cc.load_or_compile(fp, jf, (jnp.zeros((2,)),))
+        assert not from_disk
+        onp.testing.assert_array_equal(
+            onp.asarray(fn(jnp.ones(2))), onp.full(2, 2.0, "f"))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+
+
+def _trainer_setup(scaler=False, seed=5):
+    net = _dropout_net(seed=seed)
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05, "momentum": 0.9})
+    if scaler:
+        from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+        tr._amp_loss_scaler = LossScaler(init_scale=2.0 ** 8,
+                                         scale_window=3)
+    return net, tr
+
+
+def _train_steps(net, tr, n, seed=0, batch=4, poison_at=None):
+    rs = onp.random.RandomState(seed)
+    for s in range(n):
+        x = rs.rand(batch, 8).astype("f")
+        y = rs.rand(batch, 4).astype("f")
+        if s == poison_at:
+            x = onp.full_like(x, onp.inf)
+        with autograd.record():
+            loss = ((net(nd.array(x)) - nd.array(y)) ** 2).mean()
+        loss.backward()
+        tr.step(batch)
+
+
+@pytest.mark.parametrize("async_mode", [False, True])
+def test_checkpoint_roundtrip_bitwise(tmp_path, async_mode):
+    """Params, optimizer state, update counters, PRNG position and a
+    subsequent training trajectory all restore bitwise."""
+    net, tr = _trainer_setup()
+    _train_steps(net, tr, 3, seed=1)
+    mgr = CheckpointManager(str(tmp_path), trainer=tr,
+                            async_mode=async_mode)
+    mgr.save(3, cursor={"epoch": 0, "step_in_epoch": 3})
+    mgr.wait()
+    snap_params = _param_bytes(net)
+    # continue training from the snapshot twice; both continuations
+    # must be identical (momentum + dropout masks + counters restored)
+    _train_steps(net, tr, 3, seed=2)
+    after_a = _param_bytes(net)
+    meta = mgr.restore()
+    assert meta["step"] == 3
+    assert meta["cursor"]["step_in_epoch"] == 3
+    assert _param_bytes(net) == snap_params
+    assert tr._optimizer.num_update == 3
+    _train_steps(net, tr, 3, seed=2)
+    assert _param_bytes(net) == after_a
+    assert resilience.resilience_counters()["ckpt_restores"] == 1
+
+
+def test_checkpoint_amp_scaler_roundtrip(tmp_path):
+    """The AMP loss scale + grow-window position + skip counters
+    survive the round trip, through a real overflow episode."""
+    net, tr = _trainer_setup(scaler=True)
+    _train_steps(net, tr, 4, seed=3, poison_at=1)  # one skipped step
+    scale_before = tr._amp_loss_scaler.loss_scale
+    num_update = tr._optimizer.num_update
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, async_mode=False)
+    mgr.save(4)
+    _train_steps(net, tr, 2, seed=4)
+    mgr.restore()
+    assert tr._amp_loss_scaler.loss_scale == scale_before
+    assert tr._optimizer.num_update == num_update
+    assert scale_before == 2.0 ** 7  # the episode really halved it
+
+
+def test_checkpoint_prng_stream_roundtrip(tmp_path):
+    mx.random.seed(9)
+    mx.nd.random_uniform(shape=(2,))  # advance the stream
+    mgr = CheckpointManager(str(tmp_path), async_mode=False)
+    mgr.save(1)
+    expect = mx.nd.random_uniform(shape=(4,)).asnumpy()
+    mx.nd.random_uniform(shape=(4,))  # drift further
+    mgr.restore()
+    onp.testing.assert_array_equal(
+        mx.nd.random_uniform(shape=(4,)).asnumpy(), expect)
+
+
+def test_checkpoint_kvstore_roundtrip(tmp_path):
+    kv = mx.kvstore.create("local")
+    kv.init("w", nd.array(onp.arange(4, dtype="f")))
+    mgr = CheckpointManager(str(tmp_path), kvstore=kv, async_mode=False)
+    mgr.save(1)
+    kv.push("w", nd.ones((4,)))
+    mgr.restore()
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   onp.arange(4, dtype="f"))
+
+
+def test_checkpoint_atomic_no_tmp_left_and_manifest_hashes(tmp_path):
+    net, tr = _trainer_setup()
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, async_mode=True)
+    mgr.save(1)
+    mgr.wait()
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["ckpt-000000000001"]  # no .tmp- residue
+    with open(tmp_path / "ckpt-000000000001" / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["files"]["state.pkl"]["sha256"]
+    assert mgr.validate(1)
+
+
+def test_corrupt_checkpoint_skipped_with_warning(tmp_path, caplog):
+    """Satellite: a truncated/corrupted checkpoint is skipped with a
+    warning and the previous good one loads."""
+    net, tr = _trainer_setup()
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, async_mode=False)
+    _train_steps(net, tr, 1, seed=1)
+    mgr.save(1)
+    good = _param_bytes(net)
+    _train_steps(net, tr, 1, seed=2)
+    mgr.save(2)
+    # truncate the newest payload (a torn write that somehow renamed,
+    # or bit rot): hash validation must reject it
+    payload = tmp_path / "ckpt-000000000002" / "state.pkl"
+    payload.write_bytes(payload.read_bytes()[:32])
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.resilience.checkpoint"):
+        assert mgr.latest_valid() == 1
+        meta = mgr.restore()
+    assert meta["step"] == 1
+    assert _param_bytes(net) == good
+    assert any("corrupt" in r.message for r in caplog.records)
+    assert resilience.resilience_counters()["ckpt_corrupt_skipped"] >= 1
+
+
+def test_checkpoint_version_salt_invalidates(tmp_path, monkeypatch):
+    mgr = CheckpointManager(str(tmp_path), async_mode=False)
+    mgr.save(1)
+    assert mgr.validate(1)
+    from mxnet_tpu.resilience import checkpoint as ckpt_mod
+
+    monkeypatch.setattr(ckpt_mod, "_salt",
+                        lambda: ["other-version"])
+    assert not mgr.validate(1)  # a different build must not load it
+
+
+def test_checkpoint_retention_keeps_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2, async_mode=False)
+    for s in range(1, 5):
+        mgr.save(s)
+    assert mgr.list_steps() == [3, 4]
+    assert resilience.resilience_counters()["ckpt_pruned"] == 2
+
+
+def test_checkpoint_write_fault_surfaces_async(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_mode=True)
+    faults.arm("checkpoint_write:at=1")
+    mgr.save(1)  # writer thread hits the fault
+    with pytest.raises(MXNetError):
+        mgr.wait()
+    faults.disarm()
+    mgr.save(2)
+    mgr.wait()
+    assert mgr.latest_valid() == 2
+
+
+def test_checkpoint_async_overlaps_slow_write(tmp_path, monkeypatch):
+    """The async writer really runs off-thread: a save returns while
+    the (artificially slowed) write is still in flight."""
+    from mxnet_tpu.resilience import checkpoint as ckpt_mod
+
+    real_write = CheckpointManager._write
+    gate = threading.Event()
+
+    def slow_write(self, snap):
+        gate.wait(5)
+        real_write(self, snap)
+
+    monkeypatch.setattr(CheckpointManager, "_write", slow_write)
+    mgr = CheckpointManager(str(tmp_path), async_mode=True)
+    t0 = time.perf_counter()
+    mgr.save(1)
+    assert time.perf_counter() - t0 < 1.0  # did not wait for the write
+    assert mgr.latest_valid() is None      # still in flight
+    gate.set()
+    mgr.wait()
+    assert mgr.latest_valid() == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer <-> async-grad-sync speculation (satellite)
+
+
+def test_save_load_states_abandon_inflight_speculation(tmp_path,
+                                                       monkeypatch):
+    """Satellite: a save/load_states round trip with speculative
+    grad reductions in flight must abandon them — and the next step
+    must still produce the exact no-round-trip values."""
+    monkeypatch.setenv("MXNET_ASYNC_GRAD_SYNC", "1")
+
+    def run(round_trip):
+        mx.random.seed(21)
+        params = _make_params(3)
+        tr = mx.gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                              kvstore="dist_sync")
+        _backward_over(params)
+        tr.step(1)  # wires the reducer + its grad-ready hook
+        _backward_over(params, scale=3.0)  # speculation in flight
+        if round_trip:
+            red = tr._grad_reducer
+            assert red is not None
+            fname = str(tmp_path / "rt.states")
+            tr.save_states(fname)
+            # capture boundary: nothing speculative may survive it
+            assert red._pending == {} and red._spec == {}
+            tr.load_states(fname)
+        tr.step(1)
+        return [p.data().asnumpy().tobytes() for p in params]
+
+    assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# AutoResume
+
+
+def _resume_job(tmp_path, fault_at=None, epochs=2, steps=5,
+                max_restarts=3, scaler=False, poison_at=None):
+    net, tr = _trainer_setup(scaler=scaler, seed=11)
+    faults.register_fault_point("test_step_fault",
+                                "test-injected step failure")
+
+    def data_factory(epoch):
+        rs = onp.random.RandomState(500 + epoch)
+        for s in range(steps):
+            x = rs.rand(4, 8).astype("f")
+            y = rs.rand(4, 4).astype("f")
+            if (epoch, s) == poison_at:
+                x = onp.full_like(x, onp.inf)
+            yield x, y
+
+    def step_fn(batch):
+        faults.maybe_fail("test_step_fault")
+        x, y = nd.array(batch[0]), nd.array(batch[1])
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+        return float(loss.asnumpy())
+
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, async_mode=True)
+    sup = AutoResume(mgr, data_factory, step_fn, epochs=epochs,
+                     ckpt_every=3, max_restarts=max_restarts)
+    if fault_at is not None:
+        faults.arm({"test_step_fault": fault_at})
+    try:
+        trace = sup.run()
+    finally:
+        faults.disarm()
+    return trace, _param_bytes(net), sup
+
+
+def test_autoresume_bitwise_parity_after_fault(tmp_path):
+    t_clean, p_clean, _ = _resume_job(tmp_path / "clean")
+    t_fault, p_fault, sup = _resume_job(tmp_path / "fault",
+                                        fault_at=dict(at=7))
+    assert sup.restarts == 1
+    assert p_fault == p_clean          # bitwise params
+    assert t_fault == t_clean          # identical loss trace
+    c = resilience.resilience_counters()
+    assert c["resume_faults_caught"] == 1
+    assert c["resume_restarts"] == 1
+
+
+def test_autoresume_through_amp_skip_episode(tmp_path):
+    """Crash AFTER an AMP overflow-skip: the restored scale/skip
+    state reproduces the uninterrupted trajectory exactly."""
+    kw = dict(scaler=True, poison_at=(0, 2))
+    t_clean, p_clean, sup0 = _resume_job(tmp_path / "clean", **kw)
+    t_fault, p_fault, sup = _resume_job(tmp_path / "fault",
+                                        fault_at=dict(at=6), **kw)
+    assert sup.restarts == 1
+    assert p_fault == p_clean
+    assert _traces_equal(t_fault, t_clean)
+
+
+def test_autoresume_exhausts_restart_budget(tmp_path):
+    with pytest.raises(ResumeExhausted) as ei:
+        _resume_job(tmp_path, fault_at=dict(every=1, times=1000),
+                    max_restarts=2)
+    assert ei.value.restarts == 3
+    assert isinstance(ei.value.__cause__, InjectedFault)
+
+
+def test_autoresume_propagates_when_resilience_off(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("MXNET_RESILIENCE", "0")
+    with pytest.raises(InjectedFault):
+        _resume_job(tmp_path, fault_at=dict(at=2))
+
+
+def test_autoresume_survives_device_put_fault_in_feed(tmp_path):
+    """End-to-end over a real seam: the fault fires inside DeviceFeed's
+    worker (H2D staging), propagates to the loop, and AutoResume
+    restores + resumes to the clean-run result."""
+    from mxnet_tpu.pipeline import DeviceFeed
+
+    def job(ckpt_dir, plan):
+        net, tr = _trainer_setup(seed=13)
+
+        def data_factory(epoch):
+            rs = onp.random.RandomState(900 + epoch)
+            src = ((rs.rand(4, 8).astype("f"),
+                    rs.rand(4, 4).astype("f")) for _ in range(4))
+            return DeviceFeed(src, depth=2)
+
+        def step_fn(batch):
+            x, y = batch
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            tr.step(4)
+            return float(loss.asnumpy())
+
+        mgr = CheckpointManager(str(ckpt_dir), trainer=tr,
+                                async_mode=True)
+        sup = AutoResume(mgr, data_factory, step_fn, epochs=1,
+                         ckpt_every=2)
+        if plan:
+            faults.arm(plan)
+        try:
+            trace = sup.run()
+        finally:
+            faults.disarm()
+        return trace, _param_bytes(net), sup.restarts
+
+    t_clean, p_clean, _ = job(tmp_path / "clean", None)
+    t_fault, p_fault, restarts = job(tmp_path / "fault",
+                                     "device_put:at=6")
+    assert restarts == 1
+    assert p_fault == p_clean and t_fault == t_clean
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeed cursor
+
+
+def test_device_feed_position_and_skip():
+    from mxnet_tpu.pipeline import DeviceFeed
+
+    feed = DeviceFeed((onp.full((2,), float(i), "f") for i in range(6)),
+                      depth=2)
+    assert feed.position == 0
+    it = iter(feed)
+    next(it), next(it)
+    assert feed.position == 2
+    feed.close()
+    # skip repositions a fresh one-shot source before iteration
+    feed2 = DeviceFeed((onp.full((2,), float(i), "f")
+                        for i in range(6)), depth=0)
+    feed2.skip(4)
+    assert feed2.position == 4  # the cursor stays ABSOLUTE in the epoch
+    vals = [float(b.asnumpy()[0]) for b in feed2]
+    assert vals == [4.0, 5.0]
+    assert feed2.position == 6  # skip base + delivered
+    # a re-iterable source would silently rewind: refuse it
+    with pytest.raises(RuntimeError):
+        DeviceFeed([onp.zeros(2, "f")] * 3, depth=0).skip(1)
+
+
+# ---------------------------------------------------------------------------
+# serving degradation
+
+
+def _mlp(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(nd.zeros((1, 8)))
+    return net
+
+
+def test_serving_bucket_demotes_to_jit_path_and_recovers():
+    from mxnet_tpu import serving
+
+    net = _mlp()
+    sess = serving.InferenceSession(net, input_shapes=[(1, 8)],
+                                    buckets=[4])
+    x = onp.random.RandomState(0).rand(4, 8).astype("f")
+    with autograd.pause(train_mode=False):
+        ref = net(nd.array(x)).asnumpy()
+    faults.arm({"serving_execute": dict(every=1, times=2)})
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            sess.predict(x)
+    faults.disarm()
+    assert sess.degraded == [4]  # demoted off the AOT executable
+    out = sess.predict(x).asnumpy()  # jit path serves, bitwise-equal
+    onp.testing.assert_array_equal(out, ref)
+    assert sess.breaker_states()[4] == "closed"  # success reset it
+    assert resilience.resilience_counters()["breaker_demotions"] == 1
+
+
+def test_serving_breaker_opens_and_fails_fast(monkeypatch):
+    from mxnet_tpu import serving
+
+    monkeypatch.setenv("MXNET_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("MXNET_BREAKER_COOLDOWN_MS", "60000")
+    sess = serving.InferenceSession(_mlp(), input_shapes=[(1, 8)],
+                                    buckets=[4])
+    x = onp.zeros((4, 8), "f")
+    faults.arm({"serving_execute": dict(every=1, times=3)})
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            sess.predict(x)
+    faults.disarm()
+    with pytest.raises(CircuitOpen):
+        sess.predict(x)  # open circuit: fail fast, no execution
+    assert sess.breaker_states()[4] == "open"
+    c = resilience.resilience_counters()
+    assert c["breaker_trips"] == 1
+    assert c["breaker_fast_fails"] >= 1
+
+
+def test_serving_batcher_isolates_injected_batch_failure():
+    """An injected execution fault fails that batch's requests and
+    later requests succeed — the batcher/worker survives."""
+    from mxnet_tpu import serving
+
+    net = _mlp()
+    sess = serving.InferenceSession(net, input_shapes=[(1, 8)],
+                                    buckets=[4])
+    batcher = serving.DynamicBatcher(sess, max_batch_size=4,
+                                     max_latency_ms=1.0)
+    try:
+        x = onp.random.RandomState(1).rand(2, 8).astype("f")
+        with faults.inject("serving_execute", at=1):
+            with pytest.raises(InjectedFault):
+                batcher.predict(x)
+        out = batcher.predict(x)
+        with autograd.pause(train_mode=False):
+            ref = net(nd.array(x)).asnumpy()
+        onp.testing.assert_array_equal(onp.asarray(out), ref)
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+
+
+def test_profiler_runtime_and_dump_surfaces(tmp_path):
+    from mxnet_tpu import profiler, runtime
+
+    c = profiler.resilience_counters()
+    assert "ckpt_saves" in c and "retry_attempts" in c \
+        and "breaker_trips" in c and "fault_fires" in c
+    feats = runtime.Features()
+    assert "RESILIENCE" in feats
+    assert feats.is_enabled("RESILIENCE")
+    fname = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fname)
+    try:
+        out = profiler.dump()
+        with open(out) as f:
+            events = json.load(f)["traceEvents"]
+        assert any(e["name"].startswith("resilience/") for e in events)
+    finally:
+        profiler.set_config(filename="profile.json")
+
+
+def test_resilience_feature_off(monkeypatch):
+    monkeypatch.setenv("MXNET_RESILIENCE", "0")
+    from mxnet_tpu import runtime
+
+    assert not runtime.Features().is_enabled("RESILIENCE")
+    assert resilience.resilience_counters()["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# the hard one: SIGKILL mid-epoch, restart, bitwise parity
+
+
+def _run_child(env_extra, check=True):
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_PLAN", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "_resilience_child.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"child failed rc={proc.returncode}\nstdout:{proc.stdout}"
+            f"\nstderr:{proc.stderr}")
+    return proc
+
+
+def test_sigkill_mid_epoch_resumes_bitwise(tmp_path):
+    """Satellite: SIGKILL a training subprocess mid-epoch (a hard
+    crash — no atexit, the async checkpoint writer dies wherever it
+    was), restart the same command, and AutoResume reaches final
+    params and a loss trace BITWISE-identical to a never-killed run."""
+    cache = str(tmp_path / "compile_cache")
+    base = {"MXNET_COMPILE_CACHE_DIR": cache}
+    # uninterrupted reference
+    ref_out = str(tmp_path / "ref.npz")
+    _run_child({**base, "RESIL_CKPT_DIR": str(tmp_path / "ck_ref"),
+                "RESIL_OUT": ref_out})
+    # killed mid-epoch-2 (global step 8 of 12; last checkpoint at 6)
+    kill_dir = str(tmp_path / "ck_kill")
+    proc = _run_child({**base, "RESIL_CKPT_DIR": kill_dir,
+                       "RESIL_KILL_AT": "8"}, check=False)
+    assert proc.returncode == -9, proc.stderr  # really SIGKILLed
+    assert os.listdir(kill_dir)  # checkpoints survived the crash
+    # restart: restores the newest valid checkpoint and finishes
+    res_out = str(tmp_path / "resumed.npz")
+    proc = _run_child({**base, "RESIL_CKPT_DIR": kill_dir,
+                       "RESIL_OUT": res_out})
+    assert "done" in proc.stdout
+    ref = onp.load(ref_out)
+    res = onp.load(res_out)
+    assert sorted(ref.files) == sorted(res.files)
+    for k in ref.files:
+        assert ref[k].tobytes() == res[k].tobytes(), \
+            f"{k} diverged after kill+resume"

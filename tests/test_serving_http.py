@@ -103,6 +103,35 @@ def test_http_healthz_and_metrics(served):
     assert "mxnet_serving_request_latency_seconds_bucket" in text
 
 
+def test_http_healthz_reflects_degraded_bucket(served):
+    """A bucket demoted to the jit path by repeated failures shows up
+    in /healthz as status "degraded" (still 200 — it serves, slower),
+    and an open circuit maps predict to 503."""
+    from mxnet_tpu.resilience import faults
+
+    net, server, url = served
+    x = onp.ones((4, 8), dtype="float32")
+    faults.arm({"serving_execute": dict(every=1, times=2)})
+    try:
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url + "/predict",
+                      json.dumps({"data": x.tolist()}).encode())
+            assert ei.value.code == 500  # injected execution failure
+    finally:
+        faults.disarm()
+    h = json.load(urllib.request.urlopen(url + "/healthz", timeout=30))
+    assert h["status"] == "degraded"
+    assert h["degraded_buckets"] == [4]
+    # the demoted bucket still serves (jit path), bitwise-correct
+    resp = json.load(_post(url + "/predict",
+                           json.dumps({"data": x.tolist()}).encode()))
+    with autograd.pause(train_mode=False):
+        ref = net(nd.array(x)).asnumpy()
+    assert onp.array_equal(
+        onp.array(resp["outputs"][0], dtype="float32"), ref)
+
+
 def test_http_error_mapping(served):
     _, _, url = served
     # malformed JSON -> 400

@@ -11,7 +11,10 @@ inferred from the leaf name:
   BENCH_SERVE_r10.json — tagged explicitly so a quantile leaf is
   lower-is-better whatever unit suffix it carries), ``*epoch_s*`` /
   ``*idle*`` / ``*stall*`` (epoch-bench wall/idle seconds from
-  BENCH_PIPELINE_r11.json — the async pipeline exists to shrink them)
+  BENCH_PIPELINE_r11.json — the async pipeline exists to shrink them),
+  ``*overhead*`` (checkpoint-overhead metrics from BENCH_RESIL_r12.json
+  — async checkpointing is gated at <5% epoch overhead, so growth
+  there is a resilience-cost regression)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
   ``*per_s`` (end-anchored: ``steps_per_s`` is throughput but
   ``fused_ms_per_step`` stays latency), ``*items_per*``, ``*_rps*``
@@ -35,7 +38,8 @@ import json
 import sys
 
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
-                   "p50", "p95", "p99", "epoch_s", "idle", "stall")
+                   "p50", "p95", "p99", "epoch_s", "idle", "stall",
+                   "overhead")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'

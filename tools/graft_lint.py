@@ -39,6 +39,17 @@ package:
                        stay sync-free; deliberate sites (checkpointing,
                        epoch-end metric reads) carry
                        ``# graft-lint: allow(L401)``.
+``L501 bare-except``   a bare ``except:`` clause, or a broad handler
+                       (``except Exception``/``BaseException``, alone
+                       or in a tuple) whose body is ONLY ``pass``/
+                       ``...`` — a silently-swallowed exception. Every
+                       fault the resilience layer (round 12) is built
+                       to surface can be eaten by one of these; a
+                       deliberate best-effort site (``__del__``
+                       teardown, optional-dependency probes) carries
+                       ``# graft-lint: allow(L501)`` on the except
+                       line so the suppression is explicit and
+                       reviewable.
 ``jit-nocache``        a raw ``jax.jit`` call site inside ``mxnet_tpu/``
                        that bypasses the compile-cache helpers
                        (``utils.compile_cache.counting_jit`` or the AOT
@@ -360,6 +371,60 @@ def check_step_host_sync(path, tree, source, findings):
                 emit(node, f"blocking device→host transfer '{dn}(...)'")
 
 
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def check_swallowed_exceptions(path, tree, source, findings):
+    """L501: bare ``except:`` and silently-swallowed broad handlers.
+    A bare clause is flagged regardless of body (it also eats
+    SystemExit/KeyboardInterrupt); a typed Exception/BaseException
+    handler is flagged only when its body is nothing but ``pass``/
+    ``...`` — no log line, no counter, no re-raise, no fallback value
+    — because that is the shape that turns a real fault into silence."""
+    pragmas = _Pragmas(source)
+
+    def exc_names(t):
+        if t is None:
+            return [None]
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        out = []
+        for e in elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                out.append(e.attr)
+            else:
+                out.append(None)
+        return out
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if pragmas.allows(node.lineno, "L501"):
+            continue
+        bare = node.type is None
+        broad = bare or any(n in _BROAD_EXC
+                            for n in exc_names(node.type))
+        swallowed = all(
+            isinstance(s, ast.Pass) or
+            (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant)
+             and s.value.value is Ellipsis)
+            for s in node.body)
+        if bare:
+            findings.append(Finding(
+                "L501", path, node.lineno,
+                "bare 'except:' swallows SystemExit/KeyboardInterrupt "
+                "too; catch a concrete type (or annotate a deliberate "
+                "site with allow(L501))"))
+        elif broad and swallowed:
+            findings.append(Finding(
+                "L501", path, node.lineno,
+                "broad exception handler silently swallows the error "
+                "(body is only pass); log/count/re-raise it, or "
+                "annotate a deliberate best-effort site with "
+                "allow(L501)"))
+
+
 def check_jit_nocache(path, tree, source, findings):
     """jit-nocache: raw ``jax.jit(...)`` call sites must route through
     the compile-cache helpers or carry an allow pragma."""
@@ -463,6 +528,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_jit_safety(path, tree, source, findings)
         check_jit_nocache(path, tree, source, findings)
         check_step_host_sync(path, tree, source, findings)
+        check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
             want_registry = True
