@@ -140,9 +140,10 @@ KNOBS = {
         "when its first request has waited this long"),
     "MXNET_SERVING_QUEUE_DEPTH": (
         "wired", "serving.batcher",
-        "bound on queued requests (default 256); a full queue rejects "
-        "submits with ServerBusy (HTTP 503) — backpressure, not "
-        "unbounded buffering"),
+        "bound on queued requests PER SLO CLASS (default 256); a full "
+        "class lane rejects submits with ServerBusy (HTTP 503) — "
+        "backpressure, not unbounded buffering, and a best-effort "
+        "flood can't evict critical slots"),
     "MXNET_SERVING_TIMEOUT_MS": (
         "wired", "serving.batcher",
         "default per-request deadline in ms (default 2000): a request "
@@ -166,6 +167,45 @@ KNOBS = {
         "wired", "serving.server",
         "ModelServer port (default 8080; 0 binds an ephemeral port, "
         "read back via server.port)"),
+    "MXNET_SERVING_ADMISSION": (
+        "wired", "serving.admission",
+        "SLO-aware admission control (default 1): sheds sheddable-"
+        "class requests with a fast 503 + Retry-After (ShedLoad) at "
+        "submit() when SLO headroom runs out; 0 restores pure "
+        "FIFO-with-backpressure semantics"),
+    "MXNET_SERVING_SLO_MS": (
+        "wired", "serving.admission",
+        "latency SLO target in ms for the protected (highest-priority "
+        "with traffic) class (default 100): rolling-window p99 against "
+        "it forms the latency-headroom signal"),
+    "MXNET_SERVING_SHED_HEADROOM": (
+        "wired", "serving.admission",
+        "headroom floor (default 0.15): best_effort sheds below it, "
+        "standard below half of it, critical never (backpressure "
+        "only); headroom = min(1 - depth/capacity, 1 - p99/SLO)"),
+    "MXNET_SERVING_RETRY_AFTER_MS": (
+        "wired", "serving.admission",
+        "backoff hint in ms carried by ShedLoad and the HTTP "
+        "Retry-After header on admission-shed 503s (default 250)"),
+    "MXNET_SERVING_CANARY_FRACTION": (
+        "wired", "serving.repository",
+        "slice of non-critical traffic routed to a canary version "
+        "(default 0.1), deterministic counter-based routing; "
+        "critical-class requests never ride a canary"),
+    "MXNET_SERVING_CANARY_MIN_REQUESTS": (
+        "wired", "serving.repository",
+        "clean canary completions required before auto-promote "
+        "(default 50)"),
+    "MXNET_SERVING_CANARY_THRESHOLD": (
+        "wired", "serving.repository",
+        "canary breaker failure budget (default 3): this many canary "
+        "failures — executions or sustained latency regressions — "
+        "trip the breaker, which IS the auto-rollback trigger"),
+    "MXNET_SERVING_CANARY_LATENCY_X": (
+        "wired", "serving.repository",
+        "latency-regression multiplier (default 3.0): a canary whose "
+        "smoothed latency exceeds this multiple of the incumbent's "
+        "counts failures against its breaker"),
     "MXNET_DEVICE_PREFETCH": (
         "wired", "pipeline.DeviceFeed",
         "device-feed prefetch depth (default 2): batches staged onto "

@@ -145,9 +145,12 @@ def compile_cache_counters():
 
 def serving_counters():
     """Serving-subsystem counters (requests/responses/failures/
-    timeouts/rejected, p50/p95/p99 latency, queue depth, batch-size
-    stats, QPS, warm-start disk hits vs compiles), live from
-    mxnet_tpu.serving.metrics. Zeros before the first request."""
+    timeouts/rejected, p50/p95/p99 latency — global and per SLO class
+    (``latency_p99_ms:critical`` etc.), queue depth, SLO headroom,
+    shed/goodput (``shed_rate``, ``goodput_rps``), canary/model-swap
+    transitions, batch-size stats, QPS, warm-start disk hits vs
+    compiles), live from mxnet_tpu.serving.metrics. Zeros before the
+    first request."""
     try:
         from .serving.metrics import serving_stats
 

@@ -27,6 +27,15 @@ Registered fault points (the catalogue; ``FAULT_POINTS``):
                           engine (engine.py)
 ``checkpoint_write``      serializing/writing a checkpoint payload
                           (resilience/checkpoint.py)
+``serving_admission``     the admission-control decision at submit()
+                          (serving/admission.py) — a fire forces the
+                          shed path for sheddable SLO classes
+``model_swap``            ModelRepository's atomic version activation
+                          (serving/repository.py first deploy /
+                          promote) — a fire aborts the swap, leaving
+                          the incumbent active; rollback is
+                          deliberately seam-free (it must always
+                          succeed)
 ========================  ==================================================
 
 A **plan** maps fault points to firing clauses. From the environment::
@@ -87,6 +96,10 @@ FAULT_POINTS = {
     "compile_cache_io": "persistent compile-cache disk load/store",
     "engine_push": "dependency-engine host-task push",
     "checkpoint_write": "checkpoint payload serialize/write",
+    "serving_admission": "admission-control decision (forces the shed "
+                         "path for sheddable classes)",
+    "model_swap": "ModelRepository atomic version activation "
+                  "(first deploy / promote; rollback is seam-free)",
 }
 
 _EXC_BY_NAME = {

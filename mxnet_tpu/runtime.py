@@ -57,6 +57,15 @@ def _detect():
     except Exception:
         feats["SERVING"] = False
     try:
+        from .serving.admission import admission_enabled
+
+        # SLO-aware admission control / load shedding
+        # (MXNET_SERVING_ADMISSION, serving/admission.py)
+        feats["SERVING_ADMISSION"] = feats["SERVING"] and \
+            admission_enabled()
+    except Exception:
+        feats["SERVING_ADMISSION"] = False
+    try:
         from .pipeline import pipeline_enabled
 
         # async training pipeline: device prefetch armed

@@ -9,17 +9,26 @@ built directly on the round-9 compile-cache primitives:
   no-tape forward compiled ONCE per batch-size bucket (AOT through
   ``utils/compile_cache.py``); a warm process deserializes every bucket
   and serves its first request with zero traces and zero XLA compiles.
-- :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` — bounded request
-  queue with backpressure, micro-batch coalescing under a
-  ``max_latency_ms`` flush deadline, per-request validation/timeout
-  isolation, engine.close()-style graceful drain.
+- :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` — per-SLO-class
+  priority lanes with backpressure, deadline-aware micro-batch
+  coalescing under a ``max_latency_ms`` flush deadline, per-request
+  validation/timeout isolation, engine.close()-style graceful drain.
+- :class:`~mxnet_tpu.serving.admission.AdmissionController` —
+  SLO-aware admission control: sheds best-effort load with a fast 503
+  + ``Retry-After`` (:class:`~mxnet_tpu.serving.admission.ShedLoad`)
+  when queue-depth / rolling-p99 headroom says the high-priority SLO
+  is at risk.
+- :class:`~mxnet_tpu.serving.repository.ModelRepository` — N models x
+  versions behind per-model batchers, atomic hot-swap, canary rollout
+  with breaker-driven auto-rollback.
 - :class:`~mxnet_tpu.serving.server.ModelServer` — stdlib
-  ``ThreadingHTTPServer`` JSON/npy endpoint with ``/healthz`` and
+  ``ThreadingHTTPServer`` JSON/npy endpoint with ``/healthz``
+  (queue depths + SLO headroom + canary states), ``/models`` and
   Prometheus ``/metrics``.
-- :mod:`~mxnet_tpu.serving.metrics` — p50/p95/p99 latency histograms,
-  queue depth, batch-size histogram, QPS, warm-start counters; surfaced
-  via ``profiler.serving_counters()`` and the ``SERVING`` runtime
-  feature.
+- :mod:`~mxnet_tpu.serving.metrics` — p50/p95/p99 latency histograms
+  (global + rolling per-SLO-class), queue depth, batch-size histogram,
+  QPS, goodput, shed/canary counters; surfaced via
+  ``profiler.serving_counters()`` and the ``SERVING`` runtime feature.
 
 Quick start::
 
@@ -34,14 +43,19 @@ Quick start::
 Knobs: ``MXNET_SERVING`` (0 degrades the batcher to inline
 pass-through), ``MXNET_SERVING_MAX_BATCH`` / ``_MAX_LATENCY_MS`` /
 ``_QUEUE_DEPTH`` / ``_TIMEOUT_MS`` / ``_WORKERS`` / ``_BUCKETS`` /
-``_HOST`` / ``_PORT`` — see docs/SERVING.md and docs/ENV_VARS.md.
+``_HOST`` / ``_PORT``, plus the round-13 SLO/canary family
+(``_ADMISSION`` / ``_SLO_MS`` / ``_SHED_HEADROOM`` /
+``_RETRY_AFTER_MS`` / ``_CANARY_FRACTION`` / ``_CANARY_MIN_REQUESTS``
+/ ``_CANARY_THRESHOLD`` / ``_CANARY_LATENCY_X``) — see docs/SERVING.md
+and docs/ENV_VARS.md.
 """
 from __future__ import annotations
 
 __all__ = ["InferenceSession", "DynamicBatcher", "ModelServer",
-           "ServerBusy", "RequestTimeout", "parse_buckets",
-           "serving_enabled", "serving_stats", "reset_serving_counters",
-           "prometheus_text", "METRICS"]
+           "ModelRepository", "AdmissionController", "ShedLoad",
+           "ServerBusy", "RequestTimeout", "SLO_CLASSES",
+           "parse_buckets", "serving_enabled", "serving_stats",
+           "reset_serving_counters", "prometheus_text", "METRICS"]
 
 
 def serving_enabled():
@@ -54,8 +68,10 @@ def serving_enabled():
     return _env.get_bool("MXNET_SERVING", True)
 
 
-from .metrics import (METRICS, prometheus_text,  # noqa: E402
+from .metrics import (METRICS, SLO_CLASSES, prometheus_text,  # noqa: E402
                       reset_serving_counters, serving_stats)
 from .session import InferenceSession, parse_buckets  # noqa: E402
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy  # noqa: E402
+from .admission import AdmissionController, ShedLoad  # noqa: E402
+from .repository import ModelRepository  # noqa: E402
 from .server import ModelServer  # noqa: E402

@@ -17,6 +17,15 @@ it never reaches a batch, never poisons its neighbors. A request that
 outlives its deadline (``timeout_ms``) is failed with
 :class:`RequestTimeout` at batch-formation time without executing.
 
+Round 13 replaces the single FIFO with per-SLO-class priority lanes
+(:class:`_ClassQueues`): requests carry a class from
+:data:`~mxnet_tpu.serving.metrics.SLO_CLASSES` and a deadline, workers
+always pop the highest-priority lane first, coalescing is
+deadline-aware (the flush timer never waits past the earliest member
+deadline minus the rolling exec-latency estimate), and an
+:class:`~mxnet_tpu.serving.admission.AdmissionController` sheds
+low-priority load at ``submit()`` when SLO headroom runs out.
+
 Graceful shutdown mirrors ``engine.close()``: ``close()`` stops
 accepting queued work, drains everything already accepted, joins the
 workers, and is idempotent; after close (or with ``MXNET_SERVING=0``)
@@ -28,11 +37,12 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 
 from ..base import MXNetError
 from ..ndarray import NDArray
-from .metrics import METRICS
+from .metrics import METRICS, SLO_CLASSES
 
 __all__ = ["DynamicBatcher", "ServerBusy", "RequestTimeout"]
 
@@ -49,18 +59,110 @@ _STOP = object()  # queue sentinel, one per worker at close()
 
 
 class _Request:
-    __slots__ = ("arrs", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("arrs", "rows", "future", "t_submit", "deadline",
+                 "slo_class")
 
-    def __init__(self, arrs, rows, deadline):
+    def __init__(self, arrs, rows, deadline, slo_class="standard"):
         self.arrs = arrs  # list[NDArray], one per session input
         self.rows = rows
         self.future = Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline
+        self.slo_class = slo_class
 
     def expired(self, now=None):
         return self.deadline is not None and \
             (now if now is not None else time.monotonic()) > self.deadline
+
+
+class _ClassQueues:
+    """Per-SLO-class priority lanes behind one condition variable.
+
+    Presents the slice of the ``queue.Queue`` API the batcher (and its
+    tests) use — ``put(timeout=)`` / ``put_nowait`` / ``get(timeout=)``
+    / ``get_nowait`` / ``qsize`` / ``maxsize``, raising ``queue.Full``
+    / ``queue.Empty`` — but ``get`` pops the highest-priority non-empty
+    lane first, each lane is bounded independently (``maxsize`` is
+    per class, so a best-effort flood can never crowd critical
+    requests out of the queue), and ``_STOP`` sentinels ride an
+    unbounded control lane delivered only once every data lane is
+    empty — ``close()`` therefore drains all accepted work before the
+    workers exit, regardless of class."""
+
+    __slots__ = ("maxsize", "_order", "_lanes", "_ctrl", "_cond")
+
+    def __init__(self, maxsize, classes=SLO_CLASSES):
+        self.maxsize = int(maxsize)
+        self._order = {c: i for i, c in enumerate(classes)}
+        self._lanes = [deque() for _ in classes]
+        self._ctrl = deque()
+        self._cond = threading.Condition()
+
+    def _lane(self, item):
+        cls = getattr(item, "slo_class", "standard")
+        return self._lanes[self._order.get(cls, 1)]
+
+    def put(self, item, timeout=None):
+        """Append to the item's class lane; ``timeout=None`` blocks,
+        ``timeout=0`` is the non-blocking put."""
+        with self._cond:
+            if item is _STOP:
+                self._ctrl.append(item)
+                self._cond.notify_all()
+                return
+            lane = self._lane(item)
+            deadline = None if timeout is None else \
+                time.monotonic() + timeout
+            while len(lane) >= self.maxsize:
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Full
+                    self._cond.wait(remaining)
+            lane.append(item)
+            self._cond.notify_all()
+
+    def put_nowait(self, item):
+        self.put(item, timeout=0)
+
+    def get(self, timeout=None):
+        """Pop the highest-priority non-empty lane; sentinels only
+        when every data lane is empty."""
+        with self._cond:
+            deadline = None if timeout is None else \
+                time.monotonic() + timeout
+            while True:
+                for lane in self._lanes:
+                    if lane:
+                        item = lane.popleft()
+                        self._cond.notify_all()
+                        return item
+                if self._ctrl:
+                    return self._ctrl.popleft()
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Empty
+                    self._cond.wait(remaining)
+
+    def get_nowait(self):
+        return self.get(timeout=0)
+
+    def qsize(self):
+        with self._cond:
+            return sum(len(lane) for lane in self._lanes)
+
+    def qsize_by_class(self):
+        with self._cond:
+            return {c: len(self._lanes[i])
+                    for c, i in self._order.items()}
+
+    def capacity(self):
+        return self.maxsize * len(self._lanes)
 
 
 class DynamicBatcher:
@@ -74,14 +176,19 @@ class DynamicBatcher:
         session's ``max_batch`` so a batch never chunks)
     max_latency_ms : float — flush deadline measured from the OLDEST
         request in the forming batch
-    max_queue : int — bound on queued requests (backpressure)
+    max_queue : int — per-SLO-class bound on queued requests
+        (backpressure; a best-effort flood can't evict critical slots)
     timeout_ms : float — default per-request deadline; <= 0 disables
     num_workers : int — batch-formation threads (one is right for one
         accelerator; more only helps when execution itself overlaps)
+    admission : bool | None — SLO-aware admission control (None reads
+        MXNET_SERVING_ADMISSION; False gives round-10 pure-FIFO
+        backpressure semantics)
     """
 
     def __init__(self, session, max_batch_size=None, max_latency_ms=None,
-                 max_queue=None, timeout_ms=None, num_workers=None):
+                 max_queue=None, timeout_ms=None, num_workers=None,
+                 admission=None):
         from .. import env as _env
         from . import serving_enabled
 
@@ -101,10 +208,16 @@ class DynamicBatcher:
             "MXNET_SERVING_WORKERS", 1))
         depth = int(max_queue or _env.get_int(
             "MXNET_SERVING_QUEUE_DEPTH", 256))
-        self._queue = queue.Queue(maxsize=depth)
+        self._queue = _ClassQueues(depth)
         self._lock = threading.Lock()
         self._closed = False
         self._pass_through = not serving_enabled()
+        self._admission = None
+        if not self._pass_through:
+            from .admission import AdmissionController
+
+            self._admission = AdmissionController(
+                self, enabled=admission)
         self._workers = []
         if not self._pass_through:
             ready = []
@@ -127,7 +240,8 @@ class DynamicBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, *inputs, timeout_ms=None, block=False):
+    def submit(self, *inputs, timeout_ms=None, block=False,
+               slo_class=None):
         """Validate and enqueue one request; returns a
         ``concurrent.futures.Future`` resolving to the request's output
         rows as HOST numpy arrays (one array, or a tuple for
@@ -137,12 +251,22 @@ class DynamicBatcher:
         numpy, and each executed batch pays exactly one device upload
         and one download per output. Validation failures raise
         ``ValueError`` immediately — per-request, never
-        batch-poisoning. A full queue raises :class:`ServerBusy` (or
-        blocks when ``block=True``). After ``close()`` / under
-        ``MXNET_SERVING=0`` the request runs inline."""
+        batch-poisoning. ``slo_class`` is one of
+        :data:`~mxnet_tpu.serving.metrics.SLO_CLASSES` (default
+        "standard"); when SLO headroom says the high-priority SLO is
+        at risk, sheddable classes raise
+        :class:`~mxnet_tpu.serving.admission.ShedLoad` here — before
+        occupying a queue slot. A full class lane raises
+        :class:`ServerBusy` (or blocks when ``block=True``). After
+        ``close()`` / under ``MXNET_SERVING=0`` the request runs
+        inline."""
         import numpy as onp
 
+        from .admission import normalize_class
+
+        cls = normalize_class(slo_class)
         METRICS.bump("requests")
+        METRICS.bump_class("requests", cls)
         try:
             arrs, rows = self.session.validate(*inputs)
             arrs = [a.asnumpy() if isinstance(a, NDArray)
@@ -158,13 +282,15 @@ class DynamicBatcher:
         t = self._timeout_s if timeout_ms is None else \
             float(timeout_ms) / 1e3
         deadline = time.monotonic() + t if t > 0 else None
-        req = _Request(arrs, rows, deadline)
+        req = _Request(arrs, rows, deadline, cls)
         with self._lock:
             inline = self._closed or self._pass_through
         if inline:
             METRICS.bump("inline")
             self._execute([req])
             return req.future
+        if self._admission is not None:
+            self._admission.check(cls)  # may raise ShedLoad (503)
         if block:
             # bounded waits that re-check _closed: a blocking put on a
             # full queue whose consumers close() just joined would
@@ -187,7 +313,8 @@ class DynamicBatcher:
                 METRICS.bump("rejected")
                 raise ServerBusy(
                     f"serving queue full ({self._queue.maxsize} "
-                    "requests); backpressure — retry later") from None
+                    f"{cls} requests); backpressure — retry later"
+                ) from None
         # close() may have finished (workers joined, queue drained)
         # between the _closed check above and our put landing — nobody
         # would ever consume this request. Drain it ourselves;
@@ -198,16 +325,32 @@ class DynamicBatcher:
             self._drain_queue()
         return req.future
 
-    def predict(self, *inputs, timeout_ms=None):
+    def predict(self, *inputs, timeout_ms=None, slo_class=None):
         """Blocking convenience: ``submit(...).result()`` with a result
         wait bounded by the request deadline (plus execution slack)."""
-        fut = self.submit(*inputs, timeout_ms=timeout_ms)
+        fut = self.submit(*inputs, timeout_ms=timeout_ms,
+                          slo_class=slo_class)
         t = self._timeout_s if timeout_ms is None else \
             float(timeout_ms) / 1e3
         return fut.result(timeout=(t + 60.0) if t > 0 else None)
 
     def qsize(self):
         return self._queue.qsize()
+
+    def qsize_by_class(self):
+        """Live queue depth per SLO class (the /healthz
+        ``queue_depths`` block)."""
+        return self._queue.qsize_by_class()
+
+    def queue_capacity(self):
+        """Total queued-request capacity across class lanes (the
+        admission controller's queue-headroom denominator)."""
+        return self._queue.capacity()
+
+    @property
+    def admission(self):
+        """The batcher's AdmissionController (None when pass-through)."""
+        return self._admission
 
     # -- worker side ---------------------------------------------------
 
@@ -243,8 +386,15 @@ class DynamicBatcher:
             # it, the worker stops WAITING for companions but still
             # drains whatever is already queued (get_nowait) — a
             # backed-up queue coalesces full batches instead of
-            # degrading to batch=1
+            # degrading to batch=1.
+            # Deadline-aware coalescing: never hold a batch past the
+            # earliest member's deadline minus the rolling exec-time
+            # estimate — waiting longer converts that member into a
+            # guaranteed RequestTimeout for the sake of batch size
+            margin = METRICS.exec_estimate_s()
             flush_at = req.t_submit + self._max_latency_s
+            if req.deadline is not None:
+                flush_at = min(flush_at, req.deadline - margin)
             while rows < self._max_batch:
                 remaining = flush_at - time.monotonic()
                 try:
@@ -267,6 +417,8 @@ class DynamicBatcher:
                     break
                 batch.append(nxt)
                 rows += nxt.rows
+                if nxt.deadline is not None:
+                    flush_at = min(flush_at, nxt.deadline - margin)
             METRICS.observe_flush(time.monotonic() - batch[0].t_submit)
             self._execute(batch)
 
@@ -311,7 +463,8 @@ class DynamicBatcher:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(e)
                 METRICS.observe_request(
-                    time.monotonic() - r.t_submit, failed=True)
+                    time.monotonic() - r.t_submit, failed=True,
+                    slo_class=r.slo_class, met_deadline=False)
             return
         offset = 0
         now = time.monotonic()
@@ -324,7 +477,9 @@ class DynamicBatcher:
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(
                     sliced[0] if len(sliced) == 1 else sliced)
-            METRICS.observe_request(now - r.t_submit)
+            METRICS.observe_request(
+                now - r.t_submit, slo_class=r.slo_class,
+                met_deadline=r.deadline is None or now <= r.deadline)
 
     def _fail_timeout(self, req):
         if req.future.set_running_or_notify_cancel():
@@ -334,7 +489,9 @@ class DynamicBatcher:
             req.future.set_exception(RequestTimeout(
                 f"request expired after {budget_ms:.0f} ms in queue"))
         METRICS.observe_request(time.monotonic() - req.t_submit,
-                                failed=True, timed_out=True)
+                                failed=True, timed_out=True,
+                                slo_class=req.slo_class,
+                                met_deadline=False)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -354,6 +511,8 @@ class DynamicBatcher:
         # anything a racing submit slipped in behind the sentinels
         self._drain_queue()
         METRICS.unregister_depth_probe(self._depth_token)
+        if self._admission is not None:
+            self._admission.close()
 
     def _drain_queue(self):
         """Pop and execute everything queued (skipping stray

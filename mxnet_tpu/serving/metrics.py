@@ -21,8 +21,16 @@ Three measurement families:
   post-close), warm-start disk hits vs fresh compiles, padded vs true
   rows (bucket padding overhead).
 - **Gauges**: live queue depth (probed from the owning batcher at read
-  time, never sampled on the hot path) and a 60-second completion
-  window for QPS.
+  time, never sampled on the hot path), SLO headroom (probed from the
+  owning admission controller), and 60-second completion windows for
+  QPS and goodput (completions that met their deadline).
+
+Round 13 adds the SLO dimension: every request carries a priority
+class (:data:`SLO_CLASSES`), and the registry keeps per-class counters
+plus per-class ROLLING latency histograms (:class:`RollingHistogram`) —
+cumulative histograms never forget an overload spike, but admission
+control needs a p99 that recovers once the spike passes, so headroom
+is computed over a sliding window instead.
 """
 from __future__ import annotations
 
@@ -31,8 +39,15 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["LatencyHistogram", "ServingMetrics", "METRICS",
-           "serving_stats", "reset_serving_counters", "prometheus_text"]
+__all__ = ["LatencyHistogram", "RollingHistogram", "ServingMetrics",
+           "METRICS", "SLO_CLASSES", "serving_stats",
+           "reset_serving_counters", "prometheus_text"]
+
+#: request priority classes, highest priority first. "critical" is the
+#: protected class (admission control never sheds it); "best_effort"
+#: sheds first when headroom runs out. Defined here (the lowest layer
+#: of serving/) so batcher, admission and repository all agree.
+SLO_CLASSES = ("critical", "standard", "best_effort")
 
 #: log-spaced latency bucket upper bounds, seconds (last bucket +inf)
 LATENCY_BOUNDS_S = (
@@ -91,11 +106,67 @@ class LatencyHistogram:
                 "counts": list(self.counts)}
 
 
+class RollingHistogram:
+    """Sliding-window histogram: two :class:`LatencyHistogram` frames
+    rotated every ``window_s / 2``; reads merge both frames, so a
+    quantile covers the last ``window_s/2 .. window_s`` seconds of
+    observations and recovers once a spike ages out. The caller (the
+    registry) holds the lock and passes ``now``."""
+
+    __slots__ = ("bounds", "_half", "_cur", "_prev", "_flip_at")
+
+    def __init__(self, bounds=LATENCY_BOUNDS_S, window_s=20.0):
+        self.bounds = tuple(float(b) for b in bounds)
+        self._half = float(window_s) / 2.0
+        self._cur = LatencyHistogram(self.bounds)
+        self._prev = LatencyHistogram(self.bounds)
+        self._flip_at = None  # armed on first observe
+
+    def _rotate(self, now):
+        if self._flip_at is None:
+            self._flip_at = now + self._half
+            return
+        if now < self._flip_at:
+            return
+        # one flip when we're late by less than a frame; both frames
+        # are stale past that, so start clean instead of promoting
+        self._prev = self._cur if now - self._flip_at < self._half \
+            else LatencyHistogram(self.bounds)
+        self._cur = LatencyHistogram(self.bounds)
+        self._flip_at = now + self._half
+
+    def observe(self, value, now):
+        self._rotate(now)
+        self._cur.observe(value)
+
+    @property
+    def total(self):
+        return self._cur.total + self._prev.total
+
+    def quantile(self, q, now):
+        self._rotate(now)
+        if self._prev.total == 0:
+            return self._cur.quantile(q)
+        merged = LatencyHistogram(self.bounds)
+        merged.counts = [a + b for a, b in zip(self._cur.counts,
+                                               self._prev.counts)]
+        merged.total = self._cur.total + self._prev.total
+        return merged.quantile(q)
+
+
 _COUNTER_NAMES = (
     "requests", "responses", "failures", "invalid", "timeouts",
     "rejected", "batches", "inline", "warm_disk_hits", "warm_compiles",
     "bucket_execs", "padded_rows", "true_rows",
+    # round 13: SLO-aware admission + model repository
+    "shed", "deadline_met", "canary_requests", "canary_failures",
+    "canary_fallbacks", "canary_deploys", "canary_promotions",
+    "canary_rollbacks", "model_swaps",
 )
+
+#: the per-SLO-class slice of the counters (suffixed ``:<class>``)
+_CLASS_COUNTER_NAMES = ("requests", "responses", "failures",
+                        "timeouts", "shed")
 
 
 class ServingMetrics:
@@ -107,14 +178,20 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._reset_locked()
         self._depth_probes = {}  # token -> callable() -> int
+        self._headroom_probes = {}  # token -> callable() -> float
 
     def _reset_locked(self):
         self.counters = dict.fromkeys(_COUNTER_NAMES, 0)
+        self.class_counters = {
+            c: dict.fromkeys(_CLASS_COUNTER_NAMES, 0)
+            for c in SLO_CLASSES}
         self.request_latency = LatencyHistogram()
         self.exec_latency = LatencyHistogram()
         self.flush_wait = LatencyHistogram()
         self.batch_rows = LatencyHistogram(BATCH_BOUNDS)
+        self.class_latency = {c: RollingHistogram() for c in SLO_CLASSES}
         self._completions = deque()  # monotonic stamps, QPS window
+        self._goodput = deque()  # stamps of deadline-met completions
         self._started = time.monotonic()
 
     # -- mutation (request path) -------------------------------------
@@ -123,17 +200,55 @@ class ServingMetrics:
         with self._lock:
             self.counters[name] += n
 
-    def observe_request(self, latency_s, failed=False, timed_out=False):
+    def bump_class(self, name, slo_class, n=1):
+        """Bump the per-class slice of counter ``name`` (unknown
+        classes fold into "standard" rather than KeyError — the
+        request path must never crash on a label)."""
+        with self._lock:
+            per = self.class_counters.get(slo_class) or \
+                self.class_counters["standard"]
+            per[name] += n
+
+    def observe_request(self, latency_s, failed=False, timed_out=False,
+                        slo_class=None, met_deadline=None):
+        """One completed (or failed) request. ``slo_class`` routes the
+        observation into the per-class counters and rolling histogram;
+        ``met_deadline`` feeds goodput (None means "met iff it didn't
+        fail" — callers without a deadline notion stay correct)."""
         now = time.monotonic()
+        met = (not failed) if met_deadline is None else bool(met_deadline)
         with self._lock:
             self.counters["responses"] += 1
             if failed:
                 self.counters["failures"] += 1
             if timed_out:
                 self.counters["timeouts"] += 1
+            if met:
+                self.counters["deadline_met"] += 1
+                self._goodput.append(now)
             self.request_latency.observe(latency_s)
+            if slo_class is not None:
+                per = self.class_counters.get(slo_class) or \
+                    self.class_counters["standard"]
+                per["responses"] += 1
+                if failed:
+                    per["failures"] += 1
+                if timed_out:
+                    per["timeouts"] += 1
+                hist = self.class_latency.get(slo_class) or \
+                    self.class_latency["standard"]
+                hist.observe(latency_s, now)
             self._completions.append(now)
             self._trim_window_locked(now)
+
+    def observe_shed(self, slo_class):
+        """One request shed by admission control (fast 503 at submit —
+        it never entered the queue)."""
+        with self._lock:
+            self.counters["shed"] += 1
+            per = self.class_counters.get(slo_class) or \
+                self.class_counters["standard"]
+            per["shed"] += 1
 
     def observe_batch(self, rows, exec_s):
         """One session.predict execution (bucket_execs counts the
@@ -154,6 +269,25 @@ class ServingMetrics:
         cutoff = now - _QPS_WINDOW_S
         while self._completions and self._completions[0] < cutoff:
             self._completions.popleft()
+        while self._goodput and self._goodput[0] < cutoff:
+            self._goodput.popleft()
+
+    # -- admission-control reads (request path, cheap) ----------------
+
+    def exec_estimate_s(self):
+        """p50 model-execution latency in seconds — the batcher's
+        flush margin for deadline-aware coalescing. 0.0 before any
+        execution (no margin is the right cold-start answer)."""
+        with self._lock:
+            return self.exec_latency.quantile(0.50)
+
+    def class_latency_s(self, slo_class, q=0.99):
+        """Rolling-window latency quantile for one SLO class, seconds
+        (0.0 with no recent traffic)."""
+        now = time.monotonic()
+        with self._lock:
+            hist = self.class_latency.get(slo_class)
+            return hist.quantile(q, now) if hist is not None else 0.0
 
     # -- gauges -------------------------------------------------------
 
@@ -182,6 +316,33 @@ class ServingMetrics:
                 pass
         return depth
 
+    def register_headroom_probe(self, probe):
+        """Register a live SLO-headroom callable (an
+        AdmissionController's ``headroom``); returns a token for
+        :meth:`unregister_headroom_probe`."""
+        token = object()
+        with self._lock:
+            self._headroom_probes[token] = probe
+        return token
+
+    def unregister_headroom_probe(self, token):
+        with self._lock:
+            self._headroom_probes.pop(token, None)
+
+    def slo_headroom(self):
+        """Minimum live headroom across registered admission
+        controllers, 0..1 (1.0 with none registered — no controller
+        means nothing is at risk that we can see)."""
+        with self._lock:
+            probes = list(self._headroom_probes.values())
+        head = 1.0
+        for p in probes:
+            try:
+                head = min(head, float(p()))
+            except Exception:  # graft-lint: allow(L501)
+                pass
+        return max(head, 0.0)
+
     # -- reading ------------------------------------------------------
 
     def snapshot(self):
@@ -194,6 +355,9 @@ class ServingMetrics:
             self._trim_window_locked(now)
             window = min(_QPS_WINDOW_S, max(now - self._started, 1e-9))
             st["qps_60s"] = round(len(self._completions) / window, 3)
+            st["goodput_rps"] = round(len(self._goodput) / window, 3)
+            st["shed_rate"] = round(
+                st["shed"] / st["requests"], 4) if st["requests"] else 0.0
             for prefix, hist in (("latency", self.request_latency),
                                  ("exec", self.exec_latency)):
                 st[f"{prefix}_p50_ms"] = round(
@@ -202,6 +366,14 @@ class ServingMetrics:
                     hist.quantile(0.95) * 1e3, 3)
                 st[f"{prefix}_p99_ms"] = round(
                     hist.quantile(0.99) * 1e3, 3)
+            for cls in SLO_CLASSES:
+                for name, v in self.class_counters[cls].items():
+                    st[f"{name}:{cls}"] = v
+                hist = self.class_latency[cls]
+                st[f"latency_p50_ms:{cls}"] = round(
+                    hist.quantile(0.50, now) * 1e3, 3)
+                st[f"latency_p99_ms:{cls}"] = round(
+                    hist.quantile(0.99, now) * 1e3, 3)
             st["batch_rows_mean"] = round(
                 self.batch_rows.sum / self.batch_rows.total, 3) \
                 if self.batch_rows.total else 0.0
@@ -209,6 +381,7 @@ class ServingMetrics:
                 st["padded_rows"] / st["true_rows"], 4) \
                 if st["true_rows"] else 0.0
         st["queue_depth"] = self.queue_depth()
+        st["slo_headroom"] = round(self.slo_headroom(), 4)
         return st
 
     def reset(self):
@@ -229,8 +402,13 @@ class ServingMetrics:
                 lines.append(f"# TYPE {name} {typ}")
             lines.append(f"{name}{labels} {value}")
 
+        now = time.monotonic()
         with self._lock:
             counters = dict(self.counters)
+            class_counters = {c: dict(v)
+                              for c, v in self.class_counters.items()}
+            class_p99 = {c: self.class_latency[c].quantile(0.99, now)
+                         for c in SLO_CLASSES}
             hists = [("mxnet_serving_request_latency_seconds",
                       self.request_latency.snapshot(),
                       self.request_latency.bounds,
@@ -246,8 +424,23 @@ class ServingMetrics:
         for name, value in sorted(counters.items()):
             emit(f"mxnet_serving_{name}_total", value,
                  help_=f"serving counter {name}")
+        for name in _CLASS_COUNTER_NAMES:
+            fam = f"mxnet_serving_class_{name}_total"
+            lines.append(f"# HELP {fam} per-SLO-class counter {name}")
+            lines.append(f"# TYPE {fam} counter")
+            for cls in SLO_CLASSES:
+                lines.append(f'{fam}{{slo_class="{cls}"}} '
+                             f'{class_counters[cls][name]}')
+        fam = "mxnet_serving_class_latency_p99_seconds"
+        lines.append(f"# HELP {fam} rolling-window p99 request latency")
+        lines.append(f"# TYPE {fam} gauge")
+        for cls in SLO_CLASSES:
+            lines.append(f'{fam}{{slo_class="{cls}"}} {class_p99[cls]}')
         emit("mxnet_serving_queue_depth", self.queue_depth(),
              help_="live batcher queue depth", typ="gauge")
+        emit("mxnet_serving_slo_headroom", self.slo_headroom(),
+             help_="min live SLO headroom across admission controllers "
+                   "(0..1)", typ="gauge")
         for name, snap, bounds, help_ in hists:
             lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} histogram")

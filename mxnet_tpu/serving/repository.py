@@ -1,0 +1,520 @@
+"""Multi-model repository: N models x versions, canary rollout,
+auto-rollback.
+
+The model-management layer of the serving subsystem (reference analog:
+MXNet Model Server's model store — register/serve N models, roll
+versions without dropping traffic). Each (model, version) owns its own
+:class:`~mxnet_tpu.serving.batcher.DynamicBatcher`, so tenants never
+share a coalescing queue and one model's overload can't starve
+another's batches; the process-wide admission/metrics layer still sees
+the union.
+
+Version lifecycle
+-----------------
+``deploy(name, session)`` registers a version. The FIRST version of a
+model activates immediately; later versions start as a **canary**: a
+configurable slice of non-critical traffic (deterministic counter
+routing — exactly ``fraction`` of eligible requests, no RNG flakes)
+runs on the new version while the incumbent keeps the rest.
+``critical``-class requests never ride a canary.
+
+The rollback decision is wired through
+:class:`~mxnet_tpu.resilience.breaker.CircuitBreaker` rather than a
+parallel mechanism: every canary execution failure — and every
+sustained latency regression vs the incumbent
+(``MXNET_SERVING_CANARY_LATENCY_X``) — is ``record_failure()`` on the
+canary's breaker; the breaker leaving "closed" IS the auto-rollback
+trigger. A canary failure is transparent to the client: the request is
+re-run on the incumbent (``canary_fallbacks``), so a bad rollout shows
+up in metrics, not in user-facing errors. After
+``MXNET_SERVING_CANARY_MIN_REQUESTS`` clean canary completions the
+version auto-promotes via an atomic hot-swap (the ``model_swap``
+fault seam; an injected fire aborts the swap and the incumbent stays
+active — rollback itself is deliberately seam-free).
+
+Every transition (deploy/promote/rollback/swap) bumps a process
+counter surfaced through ``profiler.serving_counters()``, Prometheus
+``/metrics`` and the repository's ``healthz()`` block.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from ..resilience.breaker import CircuitBreaker
+from .batcher import DynamicBatcher
+from .metrics import METRICS, SLO_CLASSES
+
+__all__ = ["ModelRepository"]
+
+#: EMA smoothing for the incumbent/canary latency comparison
+_LAT_ALPHA = 0.2
+#: canary latency samples required before the regression check fires
+_MIN_LAT_SAMPLES = 8
+
+
+class _Version:
+    __slots__ = ("version", "session", "batcher")
+
+    def __init__(self, version, session, batcher):
+        self.version = version
+        self.session = session
+        self.batcher = batcher
+
+
+class _Model:
+    """One named model: its versions, the active pointer, and live
+    canary state. ``lock`` is an RLock — promotion runs from a worker
+    callback that already holds it."""
+
+    def __init__(self, name):
+        self.name = name
+        self.lock = threading.RLock()
+        self.versions = {}  # version -> _Version
+        self.active = None
+        self.canary = None
+        self.canary_fraction = 0.0
+        self.canary_breaker = None
+        self.canary_successes = 0
+        self.canary_failures = 0
+        self.canary_lat_ema = None
+        self.incumbent_lat_ema = None
+        self._tick = 0  # deterministic canary routing counter
+        self.state = "empty"
+        self.last_transition = "created"
+
+
+class ModelRepository:
+    """Host N models x versions behind per-model dynamic batchers.
+
+    ``batcher_kwargs`` (max_batch_size, max_latency_ms, ...) apply to
+    every batcher the repository builds. The first model deployed
+    becomes the default (the bare ``/predict`` route)."""
+
+    def __init__(self, canary_fraction=None, canary_min_requests=None,
+                 canary_threshold=None, canary_latency_x=None,
+                 **batcher_kwargs):
+        from .. import env as _env
+
+        self._lock = threading.Lock()
+        self._models = {}
+        self._default = None
+        self._closed = False
+        self._batcher_kwargs = dict(batcher_kwargs)
+        self._canary_fraction = float(
+            canary_fraction if canary_fraction is not None else
+            _env.get_float("MXNET_SERVING_CANARY_FRACTION", 0.1))
+        self._canary_min_requests = int(
+            canary_min_requests if canary_min_requests is not None else
+            _env.get_int("MXNET_SERVING_CANARY_MIN_REQUESTS", 50))
+        self._canary_threshold = int(
+            canary_threshold if canary_threshold is not None else
+            _env.get_int("MXNET_SERVING_CANARY_THRESHOLD", 3))
+        self._canary_latency_x = float(
+            canary_latency_x if canary_latency_x is not None else
+            _env.get_float("MXNET_SERVING_CANARY_LATENCY_X", 3.0))
+
+    # -- registration / lifecycle --------------------------------------
+
+    @property
+    def default_model(self):
+        return self._default
+
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def _model(self, name):
+        with self._lock:
+            m = self._models.get(name)
+        if m is None:
+            raise MXNetError(
+                f"unknown model {name!r} (deployed: "
+                f"{', '.join(sorted(self._models)) or 'none'})")
+        return m
+
+    def deploy(self, name, session, version=None, canary_fraction=None):
+        """Register a model version; returns the version number. The
+        first version of ``name`` activates immediately (atomic, via
+        the ``model_swap`` seam); later versions start as a canary
+        taking ``canary_fraction`` of non-critical traffic."""
+        if self._closed:
+            raise MXNetError("repository is closed")
+        with self._lock:
+            m = self._models.setdefault(name, _Model(name))
+            if self._default is None:
+                self._default = name
+        with m.lock:
+            ver = int(version) if version is not None else \
+                (max(m.versions) + 1 if m.versions else 1)
+            if ver in m.versions:
+                raise MXNetError(
+                    f"model {name!r} version {ver} already deployed")
+            if m.canary is not None:
+                raise MXNetError(
+                    f"model {name!r} already has canary v{m.canary} in "
+                    "flight; promote or roll it back first")
+            if getattr(session, "label", None) is None and \
+                    hasattr(session, "label"):
+                session.label = f"{name}@v{ver}"
+            vh = _Version(ver, session,
+                          DynamicBatcher(session, **self._batcher_kwargs))
+            if m.active is None:
+                # first version: activate or die — a failed swap here
+                # (model_swap fault) must not leave a half-registered
+                # model behind
+                try:
+                    self._activate_locked(m, ver, {ver: vh})
+                except Exception:
+                    vh.batcher.close()
+                    with self._lock:
+                        if not m.versions:
+                            self._models.pop(name, None)
+                            if self._default == name:
+                                self._default = next(
+                                    iter(sorted(self._models)), None)
+                    raise
+                m.versions[ver] = vh
+                m.state = "serving"
+                return ver
+            m.versions[ver] = vh
+            m.canary = ver
+            m.canary_fraction = float(
+                canary_fraction if canary_fraction is not None
+                else self._canary_fraction)
+            m.canary_breaker = CircuitBreaker(
+                threshold=self._canary_threshold,
+                name=f"canary {name}@v{ver}")
+            m.canary_successes = 0
+            m.canary_failures = 0
+            m.canary_lat_ema = None
+            m.incumbent_lat_ema = None
+            m._tick = 0
+            m.state = "canary"
+            m.last_transition = f"canary v{ver} deployed"
+            METRICS.bump("canary_deploys")
+            return ver
+
+    # kept as an alias: "add a model" reads better at call sites that
+    # never roll versions
+    add = deploy
+
+    def _activate_locked(self, m, version, versions=None):
+        """Atomic active-pointer swap, the ``model_swap`` fault seam.
+        An injected fire aborts BEFORE the pointer moves — the
+        incumbent stays active and in-flight requests are untouched."""
+        _faults.maybe_fail("model_swap")
+        m.active = version
+        m.last_transition = f"v{version} activated"
+        METRICS.bump("model_swaps")
+
+    def promote(self, name):
+        """Promote the canary to active (atomic hot-swap). The old
+        version's batcher stays alive — rollback after promote is
+        instant re-activation, no recompile."""
+        m = self._model(name)
+        with m.lock:
+            if m.canary is None:
+                raise MXNetError(f"model {name!r} has no canary to "
+                                 "promote")
+            self._activate_locked(m, m.canary)
+            m.canary = None
+            m.canary_breaker = None
+            m.state = "serving"
+            m.last_transition = f"canary v{m.active} promoted"
+            METRICS.bump("canary_promotions")
+            logging.info("serving: model %s canary v%d promoted",
+                         name, m.active)
+
+    def rollback(self, name, reason="operator request"):
+        """Cancel the canary; all traffic returns to the incumbent.
+        Deliberately seam-free and unconditional — the escape hatch
+        must always work."""
+        m = self._model(name)
+        with m.lock:
+            if m.canary is None:
+                return
+            ver, m.canary = m.canary, None
+            m.canary_breaker = None
+            m.state = "rolled_back"
+            m.last_transition = f"canary v{ver} rolled back: {reason}"
+            METRICS.bump("canary_rollbacks")
+            logging.warning("serving: model %s canary v%d rolled back "
+                            "(%s)", name, ver, reason)
+
+    def refresh(self, name):
+        """Live weight refresh of the ACTIVE version (the
+        ``refresh_params`` hot path — same executables, new values)."""
+        m = self._model(name)
+        with m.lock:
+            vh = m.versions[m.active]
+        vh.session.refresh_params()
+
+    def close(self):
+        """Drain every batcher of every version (engine.close()
+        order). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            models = list(self._models.values())
+        for m in models:
+            with m.lock:
+                versions = list(m.versions.values())
+            for vh in versions:
+                vh.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- the request path ----------------------------------------------
+
+    def submit(self, name, *inputs, timeout_ms=None, slo_class=None,
+               block=False):
+        """Route one request: canary slice (deterministic, non-critical
+        only) or incumbent. Returns a Future; canary execution
+        failures fall back to the incumbent transparently."""
+        from .admission import normalize_class
+
+        m = self._model(name)
+        cls = normalize_class(slo_class)
+        with m.lock:
+            if m.active is None:
+                raise MXNetError(f"model {name!r} has no active version")
+            incumbent = m.versions[m.active]
+            canary = m.versions.get(m.canary) \
+                if m.canary is not None else None
+            use_canary = False
+            if canary is not None and cls != SLO_CLASSES[0]:
+                # counter routing: request k rides the canary iff the
+                # integer part of k*fraction advanced — exactly
+                # fraction of eligible traffic, deterministically
+                m._tick += 1
+                f = m.canary_fraction
+                use_canary = int(m._tick * f) != int((m._tick - 1) * f)
+        if not use_canary:
+            t0 = time.monotonic()
+            fut = incumbent.batcher.submit(
+                *inputs, timeout_ms=timeout_ms, slo_class=cls,
+                block=block)
+            if canary is not None:
+                # sample incumbent latency while a canary is under
+                # evaluation — the baseline for the regression check
+                fut.add_done_callback(
+                    lambda f: self._note_incumbent(m, f, t0))
+            return fut
+        return self._submit_canary(m, canary, incumbent, inputs,
+                                   timeout_ms, cls, block)
+
+    def predict(self, name, *inputs, timeout_ms=None, slo_class=None):
+        """Blocking convenience over :meth:`submit`."""
+        fut = self.submit(name, *inputs, timeout_ms=timeout_ms,
+                          slo_class=slo_class)
+        return fut.result(timeout=60.0)
+
+    def _submit_canary(self, m, canary, incumbent, inputs, timeout_ms,
+                       cls, block):
+        from concurrent.futures import Future
+
+        METRICS.bump("canary_requests")
+        outer = Future()
+        t0 = time.monotonic()
+        try:
+            inner = canary.batcher.submit(
+                *inputs, timeout_ms=timeout_ms, slo_class=cls,
+                block=block)
+        except ValueError:
+            raise  # invalid input — the model didn't fail
+        except Exception:  # noqa: BLE001 — backpressure/shed on the
+            # canary lane must not surface to the client; the
+            # incumbent takes the request (no health accounting — a
+            # full queue is load, not model badness)
+            return incumbent.batcher.submit(
+                *inputs, timeout_ms=timeout_ms, slo_class=cls,
+                block=block)
+
+        def _done(f):
+            err = f.exception()
+            if err is None:
+                self._canary_success(m, canary.version,
+                                     time.monotonic() - t0)
+                if outer.set_running_or_notify_cancel():
+                    outer.set_result(f.result())
+                return
+            self._canary_failure(m, canary.version, err)
+            # transparent fallback: the client sees the incumbent's
+            # answer, the canary's failure lives only in metrics
+            METRICS.bump("canary_fallbacks")
+            try:
+                fb = incumbent.batcher.submit(
+                    *inputs, timeout_ms=timeout_ms, slo_class=cls)
+            except Exception as e2:  # noqa: BLE001 — delivered on future
+                if outer.set_running_or_notify_cancel():
+                    outer.set_exception(e2)
+                return
+            fb.add_done_callback(lambda g: self._chain(g, outer))
+
+        inner.add_done_callback(_done)
+        return outer
+
+    @staticmethod
+    def _chain(src, dst):
+        if not dst.set_running_or_notify_cancel():
+            return
+        err = src.exception()
+        if err is None:
+            dst.set_result(src.result())
+        else:
+            dst.set_exception(err)
+
+    # -- canary health accounting --------------------------------------
+
+    def _note_incumbent(self, m, fut, t0):
+        if fut.exception() is not None:
+            return
+        dt = time.monotonic() - t0
+        with m.lock:
+            prev = m.incumbent_lat_ema
+            m.incumbent_lat_ema = dt if prev is None else \
+                (1 - _LAT_ALPHA) * prev + _LAT_ALPHA * dt
+
+    def _canary_success(self, m, version, dt):
+        promote = False
+        with m.lock:
+            if m.canary != version:
+                return  # already promoted/rolled back
+            m.canary_successes += 1
+            prev = m.canary_lat_ema
+            m.canary_lat_ema = dt if prev is None else \
+                (1 - _LAT_ALPHA) * prev + _LAT_ALPHA * dt
+            # sustained latency regression counts against the breaker
+            # too — a canary that "works" at 10x latency is a failed
+            # rollout, and routing the verdict through the breaker
+            # keeps ONE rollback mechanism
+            if (m.canary_successes >= _MIN_LAT_SAMPLES and
+                    m.incumbent_lat_ema is not None and
+                    m.canary_lat_ema >
+                    self._canary_latency_x * m.incumbent_lat_ema):
+                m.canary_breaker.record_failure()
+                if m.canary_breaker.state != "closed":
+                    self._rollback_locked(
+                        m, f"latency regression ({m.canary_lat_ema * 1e3:.1f}"
+                           f" ms vs incumbent "
+                           f"{m.incumbent_lat_ema * 1e3:.1f} ms)")
+                    return
+            if (m.canary_successes >= self._canary_min_requests and
+                    m.canary_breaker.state == "closed"):
+                promote = True
+        if promote:
+            try:
+                self.promote(m.name)
+            except Exception as e:  # noqa: BLE001 — keep serving on the
+                # incumbent; an aborted swap (model_swap fault) leaves
+                # the canary under evaluation and the next clean
+                # completion retries the promotion
+                logging.warning("serving: model %s auto-promote failed "
+                                "(%s: %s); canary stays under "
+                                "evaluation", m.name,
+                                type(e).__name__, e)
+
+    def _canary_failure(self, m, version, err):
+        with m.lock:
+            if m.canary != version:
+                return
+            m.canary_failures += 1
+            METRICS.bump("canary_failures")
+            m.canary_breaker.record_failure()
+            # the breaker leaving "closed" IS the rollback trigger —
+            # with MXNET_RESILIENCE=0 breakers never trip and canaries
+            # only roll back by operator hand, documented behavior
+            if m.canary_breaker.state != "closed":
+                self._rollback_locked(
+                    m, f"breaker tripped after {m.canary_failures} "
+                       f"failure(s) ({type(err).__name__}: {err})")
+
+    def _rollback_locked(self, m, reason):
+        ver, m.canary = m.canary, None
+        m.canary_breaker = None
+        m.state = "rolled_back"
+        m.last_transition = f"canary v{ver} rolled back: {reason}"
+        METRICS.bump("canary_rollbacks")
+        logging.warning("serving: model %s canary v%d auto-rollback "
+                        "(%s)", m.name, ver, reason)
+
+    # -- observability -------------------------------------------------
+
+    def model_states(self):
+        """{name: lifecycle snapshot} — the /healthz ``models`` block."""
+        with self._lock:
+            models = dict(self._models)
+        out = {}
+        for name, m in sorted(models.items()):
+            with m.lock:
+                info = {
+                    "state": m.state,
+                    "active_version": m.active,
+                    "versions": sorted(m.versions),
+                    "last_transition": m.last_transition,
+                }
+                if m.canary is not None:
+                    info["canary"] = {
+                        "version": m.canary,
+                        "fraction": m.canary_fraction,
+                        "successes": m.canary_successes,
+                        "failures": m.canary_failures,
+                        "breaker": m.canary_breaker.state,
+                    }
+                vh = m.versions.get(m.active)
+            if vh is not None:
+                sess = vh.session
+                info["warm"] = bool(getattr(sess, "warm", True))
+                info["degraded_buckets"] = list(
+                    getattr(sess, "degraded", []))
+                info["open_buckets"] = sorted(
+                    b for b, s in getattr(sess, "breaker_states",
+                                          dict)().items()
+                    if s != "closed")
+            out[name] = info
+        return out
+
+    def healthz(self):
+        """Aggregate health: per-model lifecycle + queue depths per
+        SLO class + the live SLO headroom block (minimum across every
+        version batcher's admission controller)."""
+        models = self.model_states()
+        warm = all(i.get("warm", True) for i in models.values())
+        degraded = any(i.get("degraded_buckets") or i.get("open_buckets")
+                       or i["state"] == "rolled_back"
+                       for i in models.values())
+        depths = dict.fromkeys(SLO_CLASSES, 0)
+        slo = None
+        with self._lock:
+            all_models = list(self._models.values())
+        for m in all_models:
+            with m.lock:
+                versions = list(m.versions.values())
+            for vh in versions:
+                for cls, n in vh.batcher.qsize_by_class().items():
+                    depths[cls] = depths.get(cls, 0) + n
+                adm = getattr(vh.batcher, "admission", None)
+                if adm is not None:
+                    snap = adm.snapshot()
+                    if slo is None or snap["headroom"] < slo["headroom"]:
+                        slo = snap
+        status = "ok" if warm else "warming"
+        if warm and degraded:
+            status = "degraded"
+        return {
+            "status": status,
+            "warm": warm,
+            "models": models,
+            "queue_depth": sum(depths.values()),
+            "queue_depths": depths,
+            "slo": slo,
+        }

@@ -11,14 +11,24 @@ Endpoints:
   list>}`` (or ``{"inputs": [<list>, ...]}`` for multi-input models)
   returns ``{"outputs": [...], "shapes": [...]}``; raw
   ``application/x-npy`` body returns the first output as npy bytes.
+  ``POST /models/<name>/predict`` targets one model of a
+  :class:`~mxnet_tpu.serving.repository.ModelRepository`. Requests
+  carry their SLO class and deadline via the ``X-SLO-Class`` /
+  ``X-Timeout-Ms`` headers or the JSON fields ``slo_class`` /
+  ``timeout_ms`` (body wins).
 - ``GET /healthz`` — liveness + warm state (``200`` once every bucket
   executable is resolved; load balancers gate on this so a cold
-  replica never takes traffic).
+  replica never takes traffic) plus the degradation ladder: per-class
+  queue depths, the live SLO-headroom block, per-bucket circuit
+  state, and — in repository mode — per-model canary status.
+- ``GET /models`` — repository mode: the model/version/canary listing.
 - ``GET /metrics`` — Prometheus text exposition of the process-wide
   serving registry.
 
 Error mapping: validation ``ValueError`` -> 400, queue backpressure
-(:class:`~mxnet_tpu.serving.batcher.ServerBusy`) -> 503, deadline
+(:class:`~mxnet_tpu.serving.batcher.ServerBusy`) -> 503, admission
+shed (:class:`~mxnet_tpu.serving.admission.ShedLoad`) -> fast 503
+with a ``Retry-After`` header, deadline
 (:class:`~mxnet_tpu.serving.batcher.RequestTimeout` or a result-wait
 timeout) -> 504, anything else -> 500. ``stop()`` is graceful: the
 listener closes first, then the batcher drains (engine.close() order —
@@ -36,8 +46,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as onp
 
 from ..resilience.breaker import CircuitOpen
+from .admission import ShedLoad, normalize_class
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy
-from .metrics import prometheus_text
+from .metrics import METRICS, prometheus_text
 
 __all__ = ["ModelServer"]
 
@@ -45,23 +56,33 @@ _MAX_BODY = 64 * 1024 * 1024  # 64 MiB request-body bound
 
 
 class ModelServer:
-    """HTTP serving endpoint over an InferenceSession / DynamicBatcher.
+    """HTTP serving endpoint over an InferenceSession / DynamicBatcher
+    / ModelRepository.
 
     ``ModelServer(session)`` owns a batcher built from the
     ``MXNET_SERVING_*`` knobs; pass ``batcher=`` to share an existing
-    one (it will NOT be closed on ``stop()``). ``port=0`` binds an
-    ephemeral port (tests); read it back via ``server.port`` after
-    ``start()``."""
+    one (it will NOT be closed on ``stop()``); pass ``repository=`` to
+    front a multi-model :class:`ModelRepository` (closed on ``stop()``
+    — the server is its lifecycle owner, engine.close() order).
+    ``port=0`` binds an ephemeral port (tests); read it back via
+    ``server.port`` after ``start()``."""
 
-    def __init__(self, session=None, batcher=None, host=None, port=None):
+    def __init__(self, session=None, batcher=None, repository=None,
+                 host=None, port=None):
         from .. import env as _env
 
-        if (session is None) == (batcher is None):
-            raise ValueError("exactly one of session= / batcher= is "
-                             "required")
-        self._own_batcher = batcher is None
-        self.batcher = batcher or DynamicBatcher(session)
-        self.session = session or self.batcher.session
+        if sum(x is not None for x in (session, batcher,
+                                       repository)) != 1:
+            raise ValueError("exactly one of session= / batcher= / "
+                             "repository= is required")
+        self.repository = repository
+        self._own_batcher = batcher is None and repository is None
+        if repository is not None:
+            self.batcher = None
+            self.session = None
+        else:
+            self.batcher = batcher or DynamicBatcher(session)
+            self.session = session or self.batcher.session
         self._host = host if host is not None else _env.get_str(
             "MXNET_SERVING_HOST", "127.0.0.1")
         self._port = int(port if port is not None else _env.get_int(
@@ -111,6 +132,8 @@ class ModelServer:
             self._thread = None
         if self._own_batcher:
             self.batcher.close()
+        if self.repository is not None:
+            self.repository.close()
 
     def __enter__(self):
         return self.start()
@@ -128,23 +151,30 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # default: stderr spam
         logging.debug("serving http: " + fmt, *args)
 
-    def _reply(self, code, body, content_type="application/json"):
+    def _reply(self, code, body, content_type="application/json",
+               headers=None):
         if isinstance(body, (dict, list)):
             body = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code, message):
-        self._reply(code, {"error": message})
+    def _error(self, code, message, headers=None):
+        self._reply(code, {"error": message}, headers=headers)
 
     # -- GET -----------------------------------------------------------
 
     def do_GET(self):
         srv = self.model_server
         if self.path == "/healthz":
+            if srv.repository is not None:
+                doc = srv.repository.healthz()
+                self._reply(200 if doc["warm"] else 503, doc)
+                return
             session = srv.session
             warm = bool(getattr(session, "warm", True))
             # resilience state rides along: buckets demoted to the jit
@@ -159,6 +189,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             status = "ok" if warm else "warming"
             if warm and (degraded or open_buckets):
                 status = "degraded"
+            adm = getattr(srv.batcher, "admission", None)
             # 503 until warm so a status-code health check (the
             # standard LB kind) keeps traffic off a cold replica
             self._reply(200 if warm else 503, {
@@ -167,7 +198,19 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 "buckets": list(getattr(session, "buckets", [])),
                 "degraded_buckets": degraded,
                 "open_buckets": open_buckets,
-                "queue_depth": srv.batcher.qsize()})
+                "queue_depth": srv.batcher.qsize(),
+                # the ROADMAP "budget signal": how much SLO headroom is
+                # left (1.0 idle .. 0.0 blown) and who is shedding
+                "queue_depths": srv.batcher.qsize_by_class(),
+                "slo": adm.snapshot() if adm is not None else None})
+        elif self.path == "/models":
+            if srv.repository is None:
+                self._error(404, "no model repository behind this "
+                                 "server")
+                return
+            self._reply(200, {
+                "default": srv.repository.default_model,
+                "models": srv.repository.model_states()})
         elif self.path == "/metrics":
             self._reply(200, prometheus_text().encode(),
                         content_type="text/plain; version=0.0.4")
@@ -176,9 +219,32 @@ class _ServingHandler(BaseHTTPRequestHandler):
 
     # -- POST ----------------------------------------------------------
 
+    def _route_model(self):
+        """Resolve the POST path to a model name (repository mode) or
+        None (single-session mode). Raises LookupError for unroutable
+        paths."""
+        srv = self.model_server
+        if self.path in ("/predict", "/invocations"):
+            if srv.repository is not None:
+                name = srv.repository.default_model
+                if name is None:
+                    raise LookupError("repository has no models")
+                return name
+            return None
+        parts = self.path.strip("/").split("/")
+        if (len(parts) == 3 and parts[0] == "models" and
+                parts[2] in ("predict", "invocations") and
+                srv.repository is not None):
+            if parts[1] not in srv.repository.models():
+                raise LookupError(f"unknown model {parts[1]!r}")
+            return parts[1]
+        raise LookupError(f"no route {self.path!r}")
+
     def do_POST(self):
-        if self.path not in ("/predict", "/invocations"):
-            self._error(404, f"no route {self.path!r}")
+        try:
+            model = self._route_model()
+        except LookupError as e:
+            self._error(404, str(e))
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -192,12 +258,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length)
         ctype = (self.headers.get("Content-Type") or
                  "application/json").split(";")[0].strip().lower()
+        # SLO class + deadline ride headers for every content type;
+        # JSON bodies may override (body wins — it travels with the
+        # payload through proxies that strip custom headers)
+        slo_class = self.headers.get("X-SLO-Class")
+        timeout_ms = self.headers.get("X-Timeout-Ms")
         try:
             if ctype == "application/x-npy":
                 inputs = [onp.load(io.BytesIO(body), allow_pickle=False)]
                 as_npy = True
             else:
                 doc = json.loads(body)
+                if isinstance(doc, dict):
+                    slo_class = doc.get("slo_class", slo_class)
+                    timeout_ms = doc.get("timeout_ms", timeout_ms)
                 if isinstance(doc, dict) and "inputs" in doc:
                     inputs = [onp.asarray(x) for x in doc["inputs"]]
                 elif isinstance(doc, dict) and "data" in doc:
@@ -206,13 +280,30 @@ class _ServingHandler(BaseHTTPRequestHandler):
                     raise ValueError(
                         'JSON body must carry "data" or "inputs"')
                 as_npy = False
+            slo_class = normalize_class(slo_class)
+            timeout_ms = float(timeout_ms) if timeout_ms is not None \
+                else None
         except ValueError as e:
             self._error(400, f"unparseable request body: {e}")
             return
+        srv = self.model_server
         try:
-            outs = self.model_server.batcher.predict(*inputs)
+            if model is not None:
+                outs = srv.repository.predict(
+                    model, *inputs, timeout_ms=timeout_ms,
+                    slo_class=slo_class)
+            else:
+                outs = srv.batcher.predict(
+                    *inputs, timeout_ms=timeout_ms, slo_class=slo_class)
         except ValueError as e:
             self._error(400, str(e))
+            return
+        except ShedLoad as e:
+            # admission control said no BEFORE queueing: fast 503 with
+            # the backoff hint — a well-behaved client honors it
+            METRICS.bump("rejected")
+            self._error(503, str(e), headers={
+                "Retry-After": f"{max(e.retry_after_s, 0.0):.3f}"})
             return
         except (ServerBusy, CircuitOpen) as e:
             # both are "back off and retry later": queue backpressure,

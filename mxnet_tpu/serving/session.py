@@ -147,10 +147,14 @@ class InferenceSession:
 
     def __init__(self, block, example=None, input_shapes=None,
                  input_dtypes=None, buckets=None, max_batch=None,
-                 warm=True):
+                 warm=True, label=None):
         from .. import env as _env
 
         self._block = block
+        # display label for breaker names / repository healthz (the
+        # ModelRepository passes "name@vN" so operators can tell WHICH
+        # model's bucket degraded)
+        self.label = label
         self._lock = threading.Lock()
         self._entries = {}  # (bucket, amp_ver) -> _BucketEntry
         self._breakers = {}  # (bucket, amp_ver) -> CircuitBreaker
@@ -516,10 +520,11 @@ class InferenceSession:
 
         br = self._breakers.get((bucket, amp_ver))
         if br is None:
+            who = f"serving {self.label} " if self.label else "serving "
             with self._lock:
                 br = self._breakers.setdefault(
                     (bucket, amp_ver),
-                    CircuitBreaker(name=f"serving bucket {bucket}"))
+                    CircuitBreaker(name=f"{who}bucket {bucket}"))
         return br
 
     def _record_bucket_failure(self, bucket, amp_ver, err):

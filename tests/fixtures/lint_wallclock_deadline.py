@@ -1,0 +1,38 @@
+"""Seeded graft_lint L601 violation fixture (NOT imported by the
+package). graft-lint: scope(serving-deadline)
+
+The marker comment above opts this file into the monotonic-clock
+deadline discipline that ``mxnet_tpu/serving/`` gets automatically;
+the tier-1 lint test asserts every wall-clock species below is
+flagged. Keep this file OUTSIDE mxnet_tpu/ so
+``python -m tools.graft_lint mxnet_tpu`` stays clean on the shipped
+tree.
+"""
+import time
+from time import time as now
+
+
+def bad_deadline_math(timeout_s, queue):
+    # L601: wall-clock deadline — one NTP step expires every request
+    deadline = time.time() + timeout_s
+    while queue:
+        req = queue.pop()
+        # L601: wall-clock comparison at a queue exit
+        if time.time() > deadline:
+            return req
+    return None
+
+
+def bad_aliased_read():
+    # L601: `from time import time` must not hide the wall clock
+    return now()
+
+
+def good_monotonic(timeout_s):
+    deadline = time.monotonic() + timeout_s
+    return deadline - time.monotonic()
+
+
+def whitelisted_log_stamp():
+    # log/record timestamps are the blessed wall-clock use
+    return time.time()  # graft-lint: allow(L601)

@@ -96,6 +96,27 @@ def test_pipeline_bench_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_overload_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import overload_bench
+
+    out = str(tmp_path / "overload.json")
+    doc = overload_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["criteria"]["offered_2x"], doc["results"]
+    assert doc["criteria"]["best_effort_shed"], doc["overload"]
+    assert doc["criteria"]["critical_never_shed"]
+    assert doc["criteria"]["sheds_fast"]
+    assert doc["criteria"]["zero_critical_failures"]
+    assert doc["results"]["goodput_rps"] > 0
+    assert doc["results"]["shed_rate"] > 0
+    # the committed full run asserts <= 1.5x; smoke phases are short
+    # (noisy quantiles), so only gate against gross protection loss
+    assert doc["results"]["critical_p99_ratio"] < 2.5, doc["results"]
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "overload"
+
+
+@pytest.mark.slow
 def test_resilience_bench_smoke(tmp_path):
     from mxnet_tpu.benchmark import resilience_bench
 
@@ -182,6 +203,26 @@ def test_bench_compare_retrace_metrics_gated():
     assert "results.pad_ratio" not in rows       # not a perf direction
     same = {r[0]: r for r in bench_compare.compare(base, base)}
     assert not any(r[4] for r in same.values())
+
+
+def test_bench_compare_overload_metrics():
+    """BENCH_OVERLOAD_r13.json names: shed_rate and the p99s are
+    lower-is-better, goodput_rps higher-is-better, counts untracked."""
+    base = {"results": {"shed_rate": 0.70, "goodput_rps": 120.0,
+                        "overload_critical_p99_ms": 40.0,
+                        "shed_decision_p99_us": 400.0,
+                        "overload_x": 2.2}}
+    worse = {"results": {"shed_rate": 0.95, "goodput_rps": 40.0,
+                         "overload_critical_p99_ms": 90.0,
+                         "shed_decision_p99_us": 400.0,
+                         "overload_x": 2.2}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["results.shed_rate"][4]        # +36% shed: REGRESSED
+    assert rows["results.goodput_rps"][4]      # goodput collapsed
+    assert rows["results.overload_critical_p99_ms"][4]
+    assert not rows["results.shed_decision_p99_us"][4]
+    assert "results.overload_x" not in rows    # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
 
 
 def _doc(ms, speedup):

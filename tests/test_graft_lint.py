@@ -16,6 +16,8 @@ PIPE_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                             "pipeline_sync_violation.py")
 EXC_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_bare_except.py")
+CLOCK_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                             "lint_wallclock_deadline.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -71,6 +73,39 @@ def test_bare_except_fixture_triggers_l501():
         assert src[f.line - 1].lstrip().startswith("except"), \
             (f.line, src[f.line - 1])
     assert {f.code for f in findings} == {"L501"}, findings
+
+
+def test_wallclock_fixture_triggers_l601():
+    """L601: every wall-clock species in the seeded deadline fixture
+    is flagged — dotted time.time(), the aliased `from time import
+    time` form — and the monotonic and allow(L601) sites are not."""
+    findings = graft_lint.lint_paths([CLOCK_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l601 = [f for f in findings if f.code == "L601"]
+    assert len(l601) == 3, findings  # deadline + queue exit + alias
+    src = open(CLOCK_FIXTURE).read().splitlines()
+    for f in l601:
+        line = src[f.line - 1]
+        assert "time.time()" in line or "now()" in line, (f.line, line)
+    # the good_monotonic and pragma'd sites stay clean
+    assert all(f.line < 30 for f in l601), l601
+    assert {f.code for f in findings} == {"L601"}, findings
+
+
+def test_wallclock_scope_is_serving_plus_marker(tmp_path):
+    """The L601 discipline binds mxnet_tpu/serving/ automatically and
+    other files only via the scope(serving-deadline) marker."""
+    src = "import time\n\ndef stamp():\n    return time.time()\n"
+    free = tmp_path / "stamp_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    scoped = tmp_path / "mxnet_tpu" / "serving" / "frag.py"
+    scoped.parent.mkdir(parents=True)
+    scoped.write_text(src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(scoped)], repo_root=REPO, registry=False)]
+    assert codes == ["L601"]
 
 
 def test_l501_swallowed_variants(tmp_path):

@@ -158,3 +158,108 @@ def test_http_graceful_stop_is_idempotent(served):
     server.stop()  # idempotent
     with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
         urllib.request.urlopen(url + "/healthz", timeout=3)
+
+
+# ---------------------------------------------------------------------------
+# round 13: repository mode + SLO surface
+
+
+@pytest.fixture()
+def repo_served():
+    nets = {}
+    repo = serving.ModelRepository(max_latency_ms=2.0)
+    for i, name in enumerate(("alpha", "beta")):
+        mx.random.seed(20 + i)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        with autograd.pause(train_mode=False):
+            net(nd.zeros((1, 8)))
+        repo.deploy(name, serving.InferenceSession(
+            net, input_shapes=[(1, 8)], buckets=[1, 4]))
+        nets[name] = net
+    server = serving.ModelServer(repository=repo, port=0).start()
+    serving.reset_serving_counters()
+    yield nets, server, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def _eager(net, x):
+    with autograd.pause(train_mode=False):
+        return net(nd.array(x)).asnumpy()
+
+
+def test_http_repository_routing(repo_served):
+    nets, _, url = repo_served
+    x = onp.random.RandomState(3).rand(2, 8).astype("float32")
+    # bare /predict routes to the default (first-deployed) model
+    resp = json.load(_post(url + "/predict",
+                           json.dumps({"data": x.tolist()}).encode()))
+    assert onp.array_equal(
+        onp.array(resp["outputs"][0], dtype="float32"),
+        _eager(nets["alpha"], x))
+    # /models/<name>/predict targets a specific model
+    resp = json.load(_post(url + "/models/beta/predict",
+                           json.dumps({"data": x.tolist()}).encode()))
+    assert onp.array_equal(
+        onp.array(resp["outputs"][0], dtype="float32"),
+        _eager(nets["beta"], x))
+    # the listing names both, default first
+    doc = json.load(urllib.request.urlopen(url + "/models", timeout=30))
+    assert doc["default"] == "alpha"
+    assert sorted(doc["models"]) == ["alpha", "beta"]
+    assert doc["models"]["beta"]["state"] == "serving"
+    # unknown model -> 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url + "/models/ghost/predict",
+              json.dumps({"data": x.tolist()}).encode())
+    assert e.value.code == 404
+
+
+def test_http_slo_class_header_and_shed_maps_to_503(repo_served):
+    from mxnet_tpu.resilience import faults
+
+    _, _, url = repo_served
+    x = onp.random.RandomState(4).rand(1, 8).astype("float32")
+    body = json.dumps({"data": x.tolist()}).encode()
+
+    def post_cls(cls):
+        req = urllib.request.Request(
+            url + "/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-SLO-Class": cls})
+        return urllib.request.urlopen(req, timeout=30)
+
+    # unknown class -> 400 at the boundary, not silent best_effort
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_cls("vip")
+    assert e.value.code == 400
+    assert "unknown SLO class" in json.load(e.value)["error"]
+    # a forced admission shed -> fast 503 carrying Retry-After;
+    # the protected class still gets through
+    with faults.inject("serving_admission", every=1):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post_cls("best_effort")
+        assert e.value.code == 503
+        assert float(e.value.headers["Retry-After"]) > 0
+        assert "shed" in json.load(e.value)["error"]
+        resp = json.load(post_cls("critical"))
+        assert resp["shapes"] == [[1, 4]]
+
+
+def test_http_healthz_slo_surface(served, repo_served):
+    # single-session mode: per-class depths + the slo headroom block
+    _, _, url = served
+    h = json.load(urllib.request.urlopen(url + "/healthz", timeout=30))
+    assert set(h["queue_depths"]) == set(serving.SLO_CLASSES)
+    assert h["queue_depth"] == 0
+    assert h["slo"]["enabled"] is True
+    assert 0.0 <= h["slo"]["headroom"] <= 1.0
+    assert h["slo"]["shedding"] == []
+    # repository mode: same block, plus per-model lifecycle states
+    _, _, rurl = repo_served
+    h = json.load(urllib.request.urlopen(rurl + "/healthz", timeout=30))
+    assert h["status"] == "ok"
+    assert set(h["queue_depths"]) == set(serving.SLO_CLASSES)
+    assert h["slo"] is not None
+    assert h["models"]["alpha"]["active_version"] == 1

@@ -39,9 +39,9 @@ import sys
 
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "p50", "p95", "p99", "epoch_s", "idle", "stall",
-                   "overhead")
+                   "overhead", "shed")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
-                    "items_per", "_rps", "overlap")
+                    "items_per", "_rps", "overlap", "goodput")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)

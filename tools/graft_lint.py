@@ -39,6 +39,17 @@ package:
                        stay sync-free; deliberate sites (checkpointing,
                        epoch-end metric reads) carry
                        ``# graft-lint: allow(L401)``.
+``L601 wall-clock``    a ``time.time()`` call inside ``mxnet_tpu/
+                       serving/`` or any file carrying the
+                       ``# graft-lint: scope(serving-deadline)``
+                       marker. Serving deadline/flush math must use
+                       the monotonic clock (``time.monotonic()`` for
+                       deadlines, ``time.perf_counter()`` for
+                       timing): wall clock jumps under NTP steps and
+                       DST, and one jump expires every queued request
+                       at once (or holds batches forever). A
+                       deliberate wall-clock site (log timestamps)
+                       carries ``# graft-lint: allow(L601)``.
 ``L501 bare-except``   a bare ``except:`` clause, or a broad handler
                        (``except Exception``/``BaseException``, alone
                        or in a tuple) whose body is ONLY ``pass``/
@@ -371,6 +382,51 @@ def check_step_host_sync(path, tree, source, findings):
                 emit(node, f"blocking device→host transfer '{dn}(...)'")
 
 
+def _serving_deadline_scoped(path, source):
+    """Files the L601 monotonic-clock discipline applies to: the
+    serving package is scoped automatically (every queue exit there
+    does deadline math; a new serving module can't silently opt out);
+    other deadline code opts in with a
+    ``# graft-lint: scope(serving-deadline)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/serving/" in norm:
+        return True
+    return "graft-lint: scope(serving-deadline)" in source
+
+
+def check_wallclock_deadlines(path, tree, source, findings):
+    """L601: ``time.time()`` in deadline-scoped modules. Deadlines and
+    flush timers compare against ``time.monotonic()`` everywhere else
+    in serving/; one wall-clock read mixed in breaks the comparison
+    the moment NTP steps the clock."""
+    if not _serving_deadline_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+    # `from time import time` makes the call site a bare Name — track
+    # the aliases that import form introduces so it can't hide
+    bare_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    bare_aliases.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        dn = _dotted(f)
+        hit = (dn is not None and dn.split(".")[-1] == "time" and
+               dn.split(".")[0].lstrip("_") == "time") or \
+              (isinstance(f, ast.Name) and f.id in bare_aliases)
+        if hit and not pragmas.allows(node.lineno, "L601"):
+            findings.append(Finding(
+                "L601", path, node.lineno,
+                "wall-clock time.time() in a serving/deadline module; "
+                "deadline math must use time.monotonic() (and timing "
+                "time.perf_counter()) — annotate a deliberate "
+                "wall-clock site (log timestamps) with allow(L601)"))
+
+
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
@@ -528,6 +584,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_jit_safety(path, tree, source, findings)
         check_jit_nocache(path, tree, source, findings)
         check_step_host_sync(path, tree, source, findings)
+        check_wallclock_deadlines(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
