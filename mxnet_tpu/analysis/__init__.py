@@ -19,8 +19,14 @@ Two front ends share one diagnostic catalogue (diagnostics.CODES):
   same knob; the donation checks also run inline in the compiled
   dispatch cache and the fused train-step.
 
-Plus ``verify_shardings`` for the SPMD layer and the runtime donation
-guards in ``donation``. See docs/ANALYSIS.md for the full catalogue.
+Plus ``verify_shardings`` for the SPMD layer, the runtime donation
+guards in ``donation``, and — since round 14 — the **graph_opt rewrite
+pipeline** (``optimize_symbol``): the same pass machinery turned from
+check-only into analyze-and-rewrite (constant folding, CSE, dead-node
+elimination, transpose/reshape elision), gated by
+``MXNET_GRAPH_OPT={0,1,2}`` and sharing the verifier's ``PassContext``
+fact cache so verify-then-optimize analyzes a graph once. See
+docs/ANALYSIS.md for the full catalogue.
 """
 from __future__ import annotations
 
@@ -30,7 +36,15 @@ from .diagnostics import (CODES, Diagnostic, DiagnosticReport,
 from .donation import check_dispatch_donation, check_param_donation
 from .events import (GraphTrace, OpEvent, TRACE_PASSES, record_trace,
                      verify_trace)
-from .passes import PASSES, PassContext, run_passes, verify_symbol
+from .passes import (FactError, PASSES, PassContext, register_fact,
+                     run_passes, verify_symbol)
+from .graph_opt import (AnalysisPass, DEFAULT_REWRITE_PIPELINE,
+                        PIPELINE_VERSION, PassManager, REWRITE_PASSES,
+                        RewritePass, graph_opt_enabled, op_is_pure,
+                        opt_level, optimize_symbol)
+from .graph_opt import counters as graph_opt_counters
+from .graph_opt import fingerprint_salt as graph_opt_fingerprint_salt
+from .graph_opt import reset_counters as reset_graph_opt_counters
 from .sharding import verify_shardings
 
 __all__ = [
@@ -38,8 +52,13 @@ __all__ = [
     "SEV_ERROR", "SEV_WARNING", "counters", "reset_counters",
     "verify_mode", "check_dispatch_donation", "check_param_donation",
     "GraphTrace", "OpEvent", "TRACE_PASSES", "record_trace",
-    "verify_trace", "PASSES", "PassContext", "run_passes",
-    "verify_symbol", "verify_shardings", "verify_block_call",
+    "verify_trace", "FactError", "PASSES", "PassContext",
+    "register_fact", "run_passes", "verify_symbol",
+    "AnalysisPass", "RewritePass", "PassManager", "PIPELINE_VERSION",
+    "DEFAULT_REWRITE_PIPELINE", "REWRITE_PASSES", "opt_level",
+    "graph_opt_enabled", "optimize_symbol", "op_is_pure",
+    "graph_opt_counters", "graph_opt_fingerprint_salt",
+    "reset_graph_opt_counters", "verify_shardings", "verify_block_call",
 ]
 
 

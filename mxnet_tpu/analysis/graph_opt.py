@@ -1,0 +1,694 @@
+"""Graph-optimization pass manager: analyze-and-REWRITE symbol graphs.
+
+The round-8 verifier (passes.py) walks the DAG and checks; this module
+closes the loop the reference closed with nnvm graph passes (reference:
+src/nnvm/graph_editor.cc, exec pass registry; Relay/TVM for the
+analysis-vs-transform split): the same ``PassContext`` fact cache now
+feeds typed **rewrite** passes that return a transformed graph, so the
+lowering entry points (``Executor`` bind, ``SymbolBlock``
+forward/hybridize, serving ``InferenceSession``) hand XLA a smaller
+graph than the user wrote.
+
+Two pass kinds, scheduled by ``PassManager``:
+
+- ``AnalysisPass`` — produces a cached *fact* about the (original)
+  graph: shapes, dtypes, op purity/effects, use-counts, reachability.
+  Facts are memoized on the ``PassContext`` (one shape inference serves
+  verify AND optimize) and never mutate anything.
+- ``RewritePass`` — consumes facts, builds an ``old-node -> replacement``
+  mapping over the mutable ``_Graph`` work list, and applies it.
+  Rewrites never mutate existing ``Symbol`` nodes (graft_lint L601
+  enforces this outside ``mxnet_tpu/analysis/``): every change is a
+  freshly constructed node; untouched subgraphs are shared by identity.
+
+Shipped rewrite passes, in pipeline order:
+
+``fold``              constant folding: maximal pure const subgraphs
+                      (literal ``_sym_zeros``/``_sym_ones``/
+                      ``_sym_constant`` roots) are evaluated ONCE at
+                      optimize time via the eager op path and replaced
+                      by a ``_sym_constant`` literal node.
+``cse``               common-subexpression elimination: value numbering
+                      over (op, kwargs, attrs, input value-numbers);
+                      purity-gated so PRNG/effectful ops never merge.
+``transpose_elision`` cancels inverse ``transpose`` pairs (and
+                      composes non-inverse pairs into one net
+                      permutation), drops identity transposes, and
+                      collapses ``reshape``-of-``reshape`` chains when
+                      the outer spec is position-independent (all
+                      positive dims, at most one -1).
+``dce``               dead-node elimination: reachability from the
+                      heads over the work list; rewrite-orphaned
+                      subgraphs (a folded constant's old inputs) are
+                      dropped. Heads always survive — ``grad_req``
+                      outputs are never eliminated.
+
+Gating: ``MXNET_GRAPH_OPT=0`` (default, off) | ``1`` (one sweep) | ``2``
+(fixpoint, bounded iterations). Every optimized graph is re-verified
+(the cheap verifier passes run as a post-pass); a rewrite that
+introduces ANY new error diagnostic is rejected and the original graph
+served — the subsystem polices its own output. Counters surface via
+``profiler.graph_opt_counters()`` and the ``GRAPH_OPT`` runtime feature.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .passes import FactError, PassContext, register_fact, run_passes
+
+__all__ = [
+    "AnalysisPass", "RewritePass", "PassManager", "PIPELINE_VERSION",
+    "DEFAULT_REWRITE_PIPELINE", "REWRITE_PASSES", "opt_level",
+    "graph_opt_enabled", "optimize_symbol", "op_is_pure",
+    "fingerprint_salt", "counters", "reset_counters",
+]
+
+#: version stamp of the rewrite pipeline — part of every compile-cache
+#: fingerprint that can see optimized graphs, so optimized and
+#: unoptimized artifacts (or artifacts from different pipeline
+#: generations) never collide on disk
+PIPELINE_VERSION = "graphopt-r14.0"
+
+#: verifier passes run before/after rewriting (no eval_shape: the
+#: whole-graph jax.eval_shape cross-check would eat the trace-time win
+#: this subsystem exists to produce)
+PRE_PASSES = ("shape", "dtype", "structure")
+
+_FOLD_MAX_ELEMENTS = 65536
+
+_key = PassContext.node_key
+
+
+# ---------------------------------------------------------------------------
+# counters (surfaced through profiler.graph_opt_counters)
+
+_LOCK = threading.Lock()
+_COUNTERS = {
+    "graphs_seen": 0, "graphs_optimized": 0, "graphs_rejected": 0,
+    "nodes_before_total": 0, "nodes_after_total": 0, "rewrites_total": 0,
+    "shape_analysis_runs": 0, "dtype_analysis_runs": 0,
+    "fact_cache_hits": 0,
+}
+_PASS_COUNTERS = {}
+
+
+def _count(name, n=1):
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def _count_pass(name, rewrites, time_ms):
+    with _LOCK:
+        _PASS_COUNTERS[f"{name}_rewrites"] = \
+            _PASS_COUNTERS.get(f"{name}_rewrites", 0) + rewrites
+        _PASS_COUNTERS[f"{name}_time_ms"] = round(
+            _PASS_COUNTERS.get(f"{name}_time_ms", 0.0) + time_ms, 3)
+
+
+def counters():
+    """Live optimizer counters: graph totals, per-pass rewrite counts
+    and cumulative time, analysis-run/fact-cache tallies."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+        out.update(sorted(_PASS_COUNTERS.items()))
+        return out
+
+
+def reset_counters():
+    with _LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+        _PASS_COUNTERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# gating
+
+def opt_level():
+    """MXNET_GRAPH_OPT clamped to {0, 1, 2}. Read per optimization
+    point so tests can toggle without reimport."""
+    from .. import env as _env
+
+    return max(0, min(2, _env.get_int("MXNET_GRAPH_OPT", 0)))
+
+
+def graph_opt_enabled():
+    """True when the rewrite pipeline is armed (runtime feature)."""
+    return opt_level() > 0
+
+
+def fingerprint_salt(level=None):
+    """Compile-cache key element for graph-opt-aware fingerprints.
+    Includes the pipeline version only when optimization is armed, so
+    pre-existing level-0 disk entries keep their keys."""
+    lvl = opt_level() if level is None else lvl_clamp(level)
+    if lvl > 0:
+        return ("graph_opt", lvl, PIPELINE_VERSION)
+    return ("graph_opt", 0)
+
+
+def lvl_clamp(level):
+    return max(0, min(2, int(level)))
+
+
+# ---------------------------------------------------------------------------
+# purity / effects analysis
+
+#: ops whose execution draws from the PRNG stream — never folded (the
+#: fold would freeze one draw forever) and never CSE-merged (two
+#: textually identical dropouts are two independent draws)
+_IMPURE_SUBSTRINGS = ("dropout", "random")
+_IMPURE_PREFIXES = ("sample_", "_sample", "_random")
+_IMPURE_EXACT = {"uniform", "normal", "gamma", "shuffle", "multinomial",
+                 "rnn"}
+
+#: ops with observable side effects beyond their outputs (batch_norm
+#: folds batch statistics into its aux inputs in training mode) — never
+#: folded, never merged
+_EFFECTFUL_OPS = {"batch_norm"}
+
+
+def op_is_pure(op):
+    """Conservative purity: False for anything that draws PRNG state or
+    carries effects; variables and unknown pure-looking ops are pure."""
+    if op is None:
+        return True
+    low = op.lower()
+    if low in _EFFECTFUL_OPS:
+        return False
+    if any(t in low for t in _IMPURE_SUBSTRINGS):
+        return False
+    if low.startswith(_IMPURE_PREFIXES):
+        return False
+    return low not in _IMPURE_EXACT
+
+
+#: ops that ARE literal constants already (fold sources and fold
+#: fixed points: a graph of nothing but these has no fold work left)
+_CONST_OPS = {"_sym_zeros", "_sym_ones", "_sym_constant"}
+
+
+# ---------------------------------------------------------------------------
+# the mutable work list rewrite passes operate on
+
+class _Graph:
+    """Node work list + heads for one optimization run.
+
+    Unlike ``PassContext.nodes()`` (always re-walked from the symbol),
+    the work list persists across rewrites: a rewrite that re-points a
+    consumer leaves the orphaned producer chain IN the list, so dead-node
+    elimination is an observable, countable pass instead of an implicit
+    property of pointer reachability.
+    """
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.heads = list(symbol._group) if symbol._group else [symbol]
+        self.nodes = []
+        self._keys = set()
+        for s in symbol._walk():
+            if s._group is not None:
+                continue
+            k = _key(s)
+            if k not in self._keys:
+                self._keys.add(k)
+                self.nodes.append(s)
+
+    def by_key(self):
+        return {_key(n): n for n in self.nodes}
+
+    def apply(self, mapping):
+        """Rebuild the work list under ``old-node-key -> replacement``.
+
+        Replacement values: ``None`` removes the node; an existing node
+        redirects consumers onto it (CSE, elision-to-input); a fresh
+        node (not in the list) is inserted at the replaced position
+        with its input references resolved. Kept nodes whose inputs
+        changed are cloned (never mutated) — identity is preserved for
+        untouched subgraphs. Output views (``__getitem__``) share the
+        base node's key; consumer references with ``_output_index > 0``
+        are re-viewed off the rebuilt base.
+        """
+        if not mapping:
+            return
+        from ..symbol import Symbol
+
+        orig_keys = self._keys
+        rebuilt = {}
+        new_nodes, present = [], set()
+
+        def resolve_ref(ref):
+            r = rebuilt.get(_key(ref))
+            if r is None:
+                return ref
+            if ref._num_outputs > 1 and ref._output_index > 0:
+                return r[ref._output_index]
+            return r
+
+        def clone_with_inputs(node, new_inputs):
+            c = Symbol(op=node._op, name=node._name, inputs=new_inputs,
+                       kwargs=dict(node._kwargs),
+                       num_outputs=node._num_outputs)
+            c._attrs.update(node._attrs)
+            return c
+
+        def add(node):
+            k = _key(node)
+            if k not in present:
+                present.add(k)
+                new_nodes.append(node)
+
+        for node in self.nodes:
+            k = _key(node)
+            if k in mapping:
+                rep = mapping[k]
+                if rep is None:
+                    continue  # removed (dce / cse-duplicate)
+                if _key(rep) in orig_keys:
+                    # existing node (possibly itself rebuilt earlier —
+                    # topo order guarantees it was processed already)
+                    rebuilt[k] = resolve_ref(rep)
+                else:
+                    new_inputs = [resolve_ref(i) for i in rep._inputs]
+                    if any(a is not b for a, b in
+                           zip(new_inputs, rep._inputs)):
+                        rep = clone_with_inputs(rep, new_inputs)
+                    rebuilt[k] = rep
+                    add(rep)
+                continue
+            if node._op is None:
+                add(node)
+                continue
+            new_inputs = [resolve_ref(i) for i in node._inputs]
+            if any(a is not b for a, b in zip(new_inputs, node._inputs)):
+                clone = clone_with_inputs(node, new_inputs)
+                rebuilt[k] = clone
+                add(clone)
+            else:
+                add(node)
+
+        self.heads = [resolve_ref(h) for h in self.heads]
+        self.nodes = new_nodes
+        self._keys = present
+
+    def to_symbol(self):
+        from ..symbol import Group
+
+        if self.symbol._group is not None:
+            return Group(self.heads)
+        return self.heads[0]
+
+
+def _use_counts(graph):
+    counts = {}
+    for n in graph.nodes:
+        for i in n._inputs:
+            k = _key(i)
+            counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def _reachable(graph):
+    by_key = graph.by_key()
+    live = set()
+    stack = list(graph.heads)
+    while stack:
+        s = stack.pop()
+        k = _key(s)
+        if k in live:
+            continue
+        live.add(k)
+        stack.extend(by_key.get(k, s)._inputs)
+    return live
+
+
+# ---------------------------------------------------------------------------
+# typed passes
+
+class AnalysisPass:
+    """A named, cached analysis: ``run(ctx)`` computes the fact once
+    per ``PassContext`` and memoizes it (verify-then-optimize analyzes
+    the graph once). Registering the instance installs its provider."""
+
+    def __init__(self, name, compute, doc=""):
+        self.name = name
+        self.doc = doc
+        register_fact(name, compute)
+
+    def run(self, ctx):
+        return ctx.fact(self.name)
+
+
+class RewritePass:
+    """A named graph transform: ``run(graph, ctx)`` applies a rewrite
+    mapping to the work list and returns the rewrite count."""
+
+    def __init__(self, name, fn, doc=""):
+        self.name = name
+        self.fn = fn
+        self.doc = doc
+
+    def run(self, graph, ctx):
+        return self.fn(graph, ctx)
+
+
+def _purity_fact(ctx):
+    return {n._op: op_is_pure(n._op) for n in ctx.nodes()
+            if n._op is not None}
+
+
+def _use_counts_fact(ctx):
+    return _use_counts(_Graph(ctx.symbol))
+
+
+def _reachability_fact(ctx):
+    return _reachable(_Graph(ctx.symbol))
+
+
+purity_analysis = AnalysisPass(
+    "purity", _purity_fact, "op name -> pure? over the graph's ops")
+use_count_analysis = AnalysisPass(
+    "use_counts", _use_counts_fact, "node key -> consumer-edge count")
+reachability_analysis = AnalysisPass(
+    "reachability", _reachability_fact, "node keys reachable from heads")
+
+
+# ---------------------------------------------------------------------------
+# rewrite pass bodies
+
+def _fold_constants(graph, ctx):
+    """Evaluate maximal pure constant subgraphs once, via the eager op
+    path, and replace each root with a ``_sym_constant`` literal."""
+    from .. import autograd
+
+    from ..ndarray import registry as _registry
+    from ..symbol import Symbol
+
+    const = {}
+    for n in graph.nodes:
+        k = _key(n)
+        if n._op is None:
+            const[k] = False
+        elif n._op in _CONST_OPS:
+            const[k] = True
+        elif not op_is_pure(n._op) or _registry.get_op(n._op) is None:
+            const[k] = False
+        else:
+            const[k] = bool(n._inputs) and all(
+                const.get(_key(i), False) for i in n._inputs)
+
+    consumers = {}
+    for n in graph.nodes:
+        for i in n._inputs:
+            consumers.setdefault(_key(i), []).append(n)
+    head_keys = {_key(h) for h in graph.heads}
+
+    mapping, eval_cache = {}, {}
+    for n in graph.nodes:
+        k = _key(n)
+        if not const[k] or n._op in _CONST_OPS or n._num_outputs != 1:
+            continue
+        # fold only MAXIMAL const roots: interior const nodes get
+        # orphaned by the root's replacement and fall to dce
+        cons = consumers.get(k, ())
+        if k not in head_keys and all(const[_key(c)] for c in cons):
+            continue
+        try:
+            import jax
+
+            # ensure_compile_time_eval: optimization may run under an
+            # active jit trace (CachedOp / serving _pure); the fold
+            # evaluates literal subgraphs, so it must produce CONCRETE
+            # arrays even there, never tracers
+            with jax.ensure_compile_time_eval():
+                with autograd.pause():
+                    val = n._eval_nodes({}, eval_cache)
+            if isinstance(val, (list, tuple)):
+                continue
+            arr = val.asnumpy()
+        except Exception:
+            continue  # an unevaluable candidate is simply not folded
+        if arr.size > _FOLD_MAX_ELEMENTS:
+            continue
+        rep = Symbol(op="_sym_constant", name=n._name, inputs=[],
+                     kwargs={"value": arr.tolist(),
+                             "shape": tuple(int(d) for d in arr.shape),
+                             "dtype": str(arr.dtype)})
+        rep._attrs.update(n._attrs)
+        mapping[k] = rep
+    graph.apply(mapping)
+    return len(mapping)
+
+
+def _cse(graph, ctx):
+    """Value numbering over (op, kwargs, attrs, input VNs): later
+    occurrences of a computed value re-point at the first. Impure and
+    effectful ops get unique value numbers — two dropouts never merge."""
+    vn, table, mapping = {}, {}, {}
+    counter = 0
+    for n in graph.nodes:
+        k = _key(n)
+        if k in vn:
+            continue  # a view's base already numbered
+        sig = None
+        if n._op is None:
+            sig = ("var", n._name)
+        elif op_is_pure(n._op):
+            try:
+                sig = (n._op,
+                       repr(sorted(n._kwargs.items())),
+                       repr(sorted(n._attrs.items())),
+                       tuple((vn[_key(i)], i._output_index)
+                             for i in n._inputs),
+                       n._num_outputs)
+            except KeyError:
+                sig = None  # an input outside the work list: unique
+        if sig is None:
+            vn[k] = counter
+            counter += 1
+            continue
+        hit = table.get(sig)
+        if hit is not None:
+            prev_vn, rep = hit
+            vn[k] = prev_vn
+            if n._op is not None and n is not rep:
+                mapping[k] = rep
+        else:
+            vn[k] = counter
+            table[sig] = (counter, n)
+            counter += 1
+    graph.apply(mapping)
+    return len(mapping)
+
+
+def _norm_axes(axes):
+    if axes is None or (isinstance(axes, (list, tuple)) and not axes):
+        return None
+    return tuple(int(a) for a in axes)
+
+
+def _plain_shape(spec, positive_only=False):
+    """A reshape spec free of the MXNet positional codes (0/-2/-3/-4),
+    i.e. one whose meaning does not depend on the input shape."""
+    if not isinstance(spec, (list, tuple)) or not spec:
+        return False
+    try:
+        dims = [int(d) for d in spec]
+    except (TypeError, ValueError):
+        return False
+    if positive_only:
+        return all(d > 0 for d in dims)
+    return all(d > 0 or d == -1 for d in dims) and \
+        sum(1 for d in dims if d == -1) <= 1
+
+
+def _transpose_reshape_elision(graph, ctx):
+    """Cancel/compose adjacent layout ops: identity transposes,
+    transpose-of-transpose (both-None = double full reversal; explicit
+    perms composed, identity net dropped), reshape-of-reshape collapse,
+    and identity reshapes of variables with known shapes."""
+    shapes = ctx.fact("shapes")
+    var_shapes = {} if isinstance(shapes, FactError) else shapes[0]
+
+    mapping = {}
+    for n in graph.nodes:
+        if n._op == "transpose" and n._inputs:
+            inp = n._inputs[0]
+            q = _norm_axes(n._kwargs.get("axes"))
+            if q is not None and q == tuple(range(len(q))):
+                mapping[_key(n)] = inp
+                continue
+            if inp._op != "transpose" or not inp._inputs:
+                continue
+            p = _norm_axes(inp._kwargs.get("axes"))
+            src = inp._inputs[0]
+            if p is None and q is None:
+                # double full reversal is the identity at any rank
+                mapping[_key(n)] = src
+            elif p is not None and q is not None and len(p) == len(q):
+                net = tuple(p[i] for i in q)
+                if net == tuple(range(len(net))):
+                    mapping[_key(n)] = src
+                else:
+                    mapping[_key(n)] = _fresh_like(
+                        n, "transpose", [src], {"axes": net})
+            # mixed None/explicit: rank unknown here — leave it
+        elif n._op == "reshape" and n._inputs:
+            if n._kwargs.get("reverse"):
+                continue
+            spec = n._kwargs.get("shape")
+            inp = n._inputs[0]
+            if inp._op == "reshape" and inp._inputs \
+                    and not inp._kwargs.get("reverse") \
+                    and _plain_shape(spec):
+                # outer spec is position-independent, inner preserves
+                # the element count: collapse to one reshape
+                mapping[_key(n)] = _fresh_like(
+                    n, "reshape",
+                    [inp._inputs[0]],
+                    {"shape": tuple(int(d) for d in spec)})
+            elif inp._op is None and _plain_shape(spec,
+                                                  positive_only=True):
+                have = var_shapes.get(inp._name)
+                if have is not None and tuple(have) == tuple(
+                        int(d) for d in spec):
+                    mapping[_key(n)] = inp
+    graph.apply(mapping)
+    return len(mapping)
+
+
+def _fresh_like(old, op, inputs, kwargs):
+    from ..symbol import Symbol
+
+    rep = Symbol(op=op, name=old._name, inputs=list(inputs),
+                 kwargs=kwargs)
+    rep._attrs.update(old._attrs)
+    return rep
+
+
+def _dce(graph, ctx):
+    """Drop work-list nodes unreachable from the heads. Heads are the
+    roots — bound outputs (and their ``grad_req`` gradients) can never
+    be eliminated."""
+    live = _reachable(graph)
+    mapping = {k: None for k in graph._keys if k not in live}
+    graph.apply(mapping)
+    return len(mapping)
+
+
+fold_pass = RewritePass("fold", _fold_constants,
+                        "constant folding via the eager op path")
+cse_pass = RewritePass("cse", _cse,
+                       "purity-gated common-subexpression elimination")
+transpose_elision_pass = RewritePass(
+    "transpose_elision", _transpose_reshape_elision,
+    "cancel/compose inverse transpose + reshape chains")
+dce_pass = RewritePass("dce", _dce, "dead-node elimination from heads")
+
+REWRITE_PASSES = {p.name: p for p in
+                  (fold_pass, cse_pass, transpose_elision_pass, dce_pass)}
+
+DEFAULT_REWRITE_PIPELINE = ("fold", "cse", "transpose_elision", "dce")
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+
+class PassManager:
+    """Runs a rewrite pipeline over a ``_Graph``, once (level 1) or to
+    a bounded fixpoint (level 2), recording per-pass before/after node
+    counts and wall time."""
+
+    #: fixpoint bound: each iteration strictly shrinks the graph or
+    #: stops, so this is a safety net, not a tuning knob
+    MAX_ITERATIONS = 5
+
+    def __init__(self, passes=None):
+        names = passes or DEFAULT_REWRITE_PIPELINE
+        self.passes = [p if isinstance(p, RewritePass)
+                       else REWRITE_PASSES[p] for p in names]
+
+    def run(self, graph, ctx, fixpoint=False):
+        stats, total = [], 0
+        iters = self.MAX_ITERATIONS if fixpoint else 1
+        for it in range(iters):
+            iter_rewrites = 0
+            for rp in self.passes:
+                before = len(graph.nodes)
+                t0 = time.perf_counter()
+                n = rp.run(graph, ctx)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                stats.append({
+                    "pass": rp.name, "iteration": it,
+                    "nodes_before": before,
+                    "nodes_after": len(graph.nodes),
+                    "rewrites": n, "time_ms": round(dt_ms, 3),
+                })
+                _count_pass(rp.name, n, dt_ms)
+                iter_rewrites += n
+            total += iter_rewrites
+            if iter_rewrites == 0:
+                break
+        return total, stats
+
+
+def optimize_symbol(symbol, shapes=None, dtypes=None, level=None,
+                    ctx=None, subject=None, passes=None):
+    """Optimize a symbol graph; returns ``(symbol, stats)``.
+
+    ``level`` defaults to ``MXNET_GRAPH_OPT``; 0 is a passthrough. A
+    caller-provided ``ctx`` (the bind-time verifier's ``PassContext``)
+    shares its fact cache — shape/dtype inference runs once for
+    verify-then-optimize. The verifier's cheap passes run before (for
+    the error baseline, unless the ctx already ran them) and AFTER on
+    the optimized graph: any new error rejects the rewrite and returns
+    the original graph.
+    """
+    lvl = opt_level() if level is None else lvl_clamp(level)
+    stats = {"level": lvl, "subject": subject,
+             "pipeline_version": PIPELINE_VERSION, "passes": [],
+             "nodes_before": None, "nodes_after": None, "rewrites": 0,
+             "rejected": False}
+    if lvl <= 0:
+        return symbol, stats
+    _count("graphs_seen")
+    if ctx is None:
+        ctx = PassContext(symbol, shapes=shapes, dtypes=dtypes,
+                          subject=subject)
+    if "shape" not in ctx.passes_run:
+        run_passes(ctx, PRE_PASSES)
+    pre_errors = len(ctx.report.errors)
+
+    graph = _Graph(symbol)
+    stats["nodes_before"] = stats["nodes_after"] = len(graph.nodes)
+    total, pass_stats = PassManager(passes).run(graph, ctx,
+                                                fixpoint=(lvl >= 2))
+    stats["passes"] = pass_stats
+    stats["rewrites"] = total
+    _count("rewrites_total", total)
+    if total == 0:
+        return symbol, stats
+    stats["nodes_after"] = len(graph.nodes)
+    optimized = graph.to_symbol()
+
+    # optimize -> verify, one pipeline: the verifier is the post-pass
+    # on every optimized graph
+    post_ctx = PassContext(optimized, shapes=shapes, dtypes=dtypes,
+                           subject=f"{subject or 'graph'}:optimized")
+    run_passes(post_ctx, PRE_PASSES)
+    if len(post_ctx.report.errors) > pre_errors:
+        logging.warning(
+            "graph-opt: rejecting optimized graph for %s (%d new "
+            "error diagnostic(s)); serving the original",
+            subject or symbol._name,
+            len(post_ctx.report.errors) - pre_errors)
+        _count("graphs_rejected")
+        stats["rejected"] = True
+        stats["nodes_after"] = stats["nodes_before"]
+        return symbol, stats
+    _count("graphs_optimized")
+    _count("nodes_before_total", stats["nodes_before"])
+    _count("nodes_after_total", stats["nodes_after"])
+    return optimized, stats

@@ -19,6 +19,13 @@ diagnostics instead of CHECK-aborting:
 - ``structure``: duplicate node names (GV403 — ``tojson`` keys nodes by
   name, so duplicates silently merge on save/load) and dead outputs of
   multi-output nodes (GV401 — computed, never consumed, not a head).
+
+Expensive analyses (shape/dtype inference) are *facts*: named, memoized
+on the ``PassContext`` via ``ctx.fact(name)`` and shared between the
+verifier and the graph_opt rewrite pipeline, so verify-then-optimize on
+bind runs each inference exactly once. Providers register through
+``register_fact``; ``analysis/graph_opt.py`` adds purity, use-count and
+reachability facts on top of the shape/dtype ones here.
 """
 from __future__ import annotations
 
@@ -29,7 +36,40 @@ import numpy as onp
 from ..base import MXNetError
 from .diagnostics import DiagnosticReport
 
-__all__ = ["PassContext", "PASSES", "run_passes", "verify_symbol"]
+__all__ = ["FactError", "PassContext", "PASSES", "register_fact",
+           "run_passes", "verify_symbol"]
+
+
+class FactError:
+    """Sentinel fact value: the analysis itself failed. Cached like any
+    other fact so a failing inference is not re-attempted per pass."""
+
+    def __init__(self, message):
+        self.message = message
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"FactError({self.message!r})"
+
+
+#: fact name -> provider(ctx); see ``register_fact``
+FACT_PROVIDERS = {}
+
+
+def register_fact(name, provider):
+    """Install a fact provider. Facts are computed at most once per
+    ``PassContext`` (memoized by ``ctx.fact``)."""
+    FACT_PROVIDERS[name] = provider
+    return provider
+
+
+def _opt_count(name, n=1):
+    # analysis-run counters live with the optimizer's counter table;
+    # lazy import (graph_opt imports this module at load)
+    try:
+        from .graph_opt import _count
+    except Exception:  # pragma: no cover - partial-import window
+        return
+    _count(name, n)
 
 
 class PassContext:
@@ -41,6 +81,19 @@ class PassContext:
         self.report = DiagnosticReport(subject=subject)
         self.var_shapes = None  # filled by the shape pass
         self.out_shapes = None
+        self.facts = {}  # fact name -> cached analysis result
+        self.passes_run = set()  # verifier pass names already run
+
+    def fact(self, name):
+        """Memoized analysis result; computed by the registered
+        provider on first request, shared by every later consumer
+        (verifier passes and rewrite passes alike)."""
+        if name in self.facts:
+            _opt_count("fact_cache_hits")
+            return self.facts[name]
+        value = FACT_PROVIDERS[name](self)
+        self.facts[name] = value
+        return value
 
     # -- graph helpers ------------------------------------------------------
     def nodes(self):
@@ -112,20 +165,52 @@ def _merge_known(ctx):
     return merged
 
 
+def _shapes_fact(ctx):
+    """Partial shape inference as a cached fact: ``(var_shapes,
+    out_shapes)`` or a ``FactError``. Merging is silent here — conflict
+    diagnostics belong to ``shape_pass`` (via ``_merge_known``), which
+    may not have run when a rewrite pass asks for shapes."""
+    from ..symbol.infer import infer_shapes
+
+    known = dict(ctx.declared_shapes())
+    known.update(ctx.known_shapes)
+    _opt_count("shape_analysis_runs")
+    try:
+        return infer_shapes(ctx.symbol, known, allow_unknown=True)
+    except MXNetError as e:
+        return FactError(str(e))
+
+
+def _dtypes_fact(ctx):
+    """Forward dtype propagation as a cached fact: ``(var_types,
+    out_types)`` or a ``FactError``."""
+    from ..symbol.infer import infer_types
+
+    known = dict(ctx.declared_dtypes())
+    known.update(ctx.known_dtypes)
+    _opt_count("dtype_analysis_runs")
+    try:
+        return infer_types(ctx.symbol, known)
+    except Exception as e:
+        return FactError(str(e))
+
+
+register_fact("shapes", _shapes_fact)
+register_fact("dtypes", _dtypes_fact)
+
+
 def shape_pass(ctx):
-    from ..symbol.infer import (_array_arg_names, _param_shape_rules,
-                                infer_shapes)
+    from ..symbol.infer import _array_arg_names, _param_shape_rules
     from ..ndarray import registry as _registry
 
-    known = _merge_known(ctx)
-    try:
-        var_shapes, out_shapes = infer_shapes(ctx.symbol, known,
-                                              allow_unknown=True)
-    except MXNetError as e:
+    _merge_known(ctx)  # emits GV101 on declared-vs-bound conflicts
+    result = ctx.fact("shapes")
+    if isinstance(result, FactError):
         ctx.report.emit(
-            "GV101", str(e),
+            "GV101", result.message,
             hint="check the input shapes fed to this graph")
         return
+    var_shapes, out_shapes = result
     ctx.var_shapes, ctx.out_shapes = var_shapes, out_shapes
 
     # cross-check KNOWN parameter shapes against the layer rules the
@@ -224,10 +309,7 @@ def eval_shape_cross_check(ctx):
 # dtype pass
 
 def dtype_pass(ctx):
-    from ..symbol.infer import infer_types
-
     declared = ctx.declared_dtypes()
-    known = dict(declared)
     for name, dt in ctx.known_dtypes.items():
         if name in declared and declared[name] != onp.dtype(dt):
             ctx.report.emit(
@@ -237,12 +319,12 @@ def dtype_pass(ctx):
                 node=name,
                 hint="fix the Variable(dtype=...) declaration or cast "
                      "the bound array")
-        known[name] = onp.dtype(dt)
-    try:
-        var_types, _ = infer_types(ctx.symbol, known)
-    except Exception as e:
-        ctx.report.emit("GV102", f"dtype inference failed: {e}")
+    result = ctx.fact("dtypes")
+    if isinstance(result, FactError):
+        ctx.report.emit("GV102",
+                        f"dtype inference failed: {result.message}")
         return
+    var_types, _ = result
     for name, want in declared.items():
         have = var_types.get(name)
         if have is not None and onp.dtype(have) != onp.dtype(want):
@@ -329,6 +411,7 @@ DEFAULT_PIPELINE = ("shape", "eval_shape", "dtype", "structure")
 def run_passes(ctx, passes=None):
     for name in (passes or DEFAULT_PIPELINE):
         PASSES[name](ctx)
+        ctx.passes_run.add(name)
     return ctx.report
 
 

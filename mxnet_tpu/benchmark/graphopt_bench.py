@@ -1,0 +1,262 @@
+"""Graph-optimization benchmark: node reduction, trace+compile time,
+and eager execution time with ``MXNET_GRAPH_OPT`` off vs on.
+
+One deliberately redundant benchmark graph exercises every shipped
+rewrite pass: an inverse ``transpose`` pair feeding ``depth`` textually
+identical subexpression chains (CSE fodder), an all-literal
+``ones``-accumulation chain (constant-fold fodder, orphaned inputs for
+dce), and a three-deep ``reshape`` chain that collapses to one reshape
+(and to nothing under bind, where the input shape is known). Three
+measurements, matching the round-14 acceptance criteria:
+
+**Node reduction.** ``optimize_symbol`` at level 2 (fixpoint) on the
+benchmark graph: nodes before vs after, per-pass rewrite counts, and
+the optimizer's own wall time (the cost side of the ledger).
+
+**Trace+compile.** ``simple_bind`` + first ``forward`` — the Executor
+jit-traces the whole graph and XLA-compiles it on the first call, so a
+smaller graph is a cheaper trace and a cheaper compile. The process is
+warmed first (backend init, executor machinery, the eager entries
+fold's evaluation uses — all once-per-process costs); each level's
+whole-graph jit is a distinct closure and therefore still cold. Two
+timings per level: ``bind_ms`` (graph construction + the analyzer and
+rewriter at level 2 — the cost side) and ``trace_compile_ms`` (the
+first forward: jit trace + XLA compile of whatever graph bind
+produced — the win side). The optimized run goes FIRST so residual
+process-warm XLA state biases AGAINST the optimization, never for it.
+
+**Eager execution.** A paramless ``SymbolBlock`` evaluated eagerly —
+the interpreter walks the (optimized) graph node by node, so eliminated
+nodes are eliminated dispatches. Steady state: warmup first, then a
+timed loop at each level over the SAME block instance (the per-level
+``_optimized_outputs`` cache serves both).
+
+Criteria (full mode): optimized node count strictly below the original,
+``exec_speedup >= 1.1`` OR ``compile_speedup >= 1.1``, and bitwise
+parity (``onp.array_equal``) of bind and eager outputs across levels.
+
+Emits one JSON document (default ``BENCH_GRAPHOPT_r14.json``); also
+prints it.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.graphopt_bench [--smoke]
+        [--depth N] [--out FILE]
+
+``--smoke`` shrinks the graph/loop for a CPU tier-1 time budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as onp
+
+
+# ---------------------------------------------------------------------------
+# the benchmark graph
+
+def build_symbol(batch, feat, depth):
+    """A redundant graph with work for every pass: transpose pair
+    (elision), ``depth`` identical ``t*t + x`` chains (cse), a literal
+    ones-accumulation chain (fold + dce of the orphaned literals), and
+    a reshape-of-reshape-of-reshape round trip (elision)."""
+    from mxnet_tpu import sym
+
+    x = sym.var("x")
+    t = x.transpose((1, 0)).transpose((1, 0))
+    body = None
+    for _ in range(depth):
+        u = t * t
+        v = u + x
+        body = v if body is None else body + v
+    c = sym.ones((batch, feat))
+    for _ in range(depth):
+        c = c + sym.ones((batch, feat))
+    r = x.reshape((-1,)).reshape((batch * feat,)).reshape((batch, feat))
+    return (body + c) + r
+
+
+def _node_count(symbol):
+    from mxnet_tpu.analysis.graph_opt import _Graph
+
+    return len(_Graph(symbol).nodes)
+
+
+# ---------------------------------------------------------------------------
+# phase 1: the rewrite itself (node counts + optimizer cost)
+
+def _optimize_phase(batch, feat, depth):
+    from mxnet_tpu.analysis import graph_opt
+
+    s = build_symbol(batch, feat, depth)
+    t0 = time.perf_counter()
+    opt, st = graph_opt.optimize_symbol(
+        s, shapes={"x": (batch, feat)}, level=2, subject="graphopt_bench")
+    opt_ms = (time.perf_counter() - t0) * 1e3
+    per_pass = {}
+    for row in st["passes"]:
+        per_pass[row["pass"]] = per_pass.get(row["pass"], 0) \
+            + row["rewrites"]
+    return {
+        "graph_nodes_before": st["nodes_before"],
+        "graph_nodes_after": st["nodes_after"],
+        "node_reduction_x": round(
+            st["nodes_before"] / max(st["nodes_after"], 1), 2),
+        "optimize_ms": round(opt_ms, 2),
+        "rewrites": st["rewrites"],
+        "rewrites_per_pass": per_pass,
+        "rejected": st["rejected"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 2: Executor bind — whole-graph trace + XLA compile
+
+def _warm_process(batch, feat):
+    """Pay every once-per-process cost before the timed binds: backend
+    init, the executor jit machinery, and the eager dispatch entries
+    (``_sym_ones`` / ``broadcast_add``) fold's evaluation reuses. Each
+    measured graph's whole-graph jit is a fresh closure, so it stays
+    cold regardless."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    os.environ["MXNET_GRAPH_OPT"] = "0"
+    w = (sym.var("x") + sym.ones((batch, feat))).simple_bind(
+        grad_req="null", x=(batch, feat))
+    w.arg_dict["x"]._data = mx.nd.zeros((batch, feat)).data
+    w.forward(is_train=False)[0].wait_to_read()
+
+
+def _bind_first_forward(level, batch, feat, depth, xval):
+    import mxnet_tpu as mx
+
+    nd = mx.nd
+    os.environ["MXNET_GRAPH_OPT"] = str(level)
+    s = build_symbol(batch, feat, depth)
+    t0 = time.perf_counter()
+    ex = s.simple_bind(grad_req="null", x=(batch, feat))
+    bind_ms = (time.perf_counter() - t0) * 1e3
+    ex.arg_dict["x"]._data = nd.array(xval).data
+    t0 = time.perf_counter()
+    y = ex.forward(is_train=False)[0]
+    y.wait_to_read()
+    trace_ms = (time.perf_counter() - t0) * 1e3
+    return bind_ms, trace_ms, y.asnumpy(), _node_count(ex._symbol)
+
+
+# ---------------------------------------------------------------------------
+# phase 3: eager SymbolBlock — per-node dispatch count
+
+def _eager_exec(level, block, xnd, iters):
+    from mxnet_tpu import autograd
+
+    os.environ["MXNET_GRAPH_OPT"] = str(level)
+    with autograd.pause(train_mode=False):
+        for _ in range(3):  # compile/warm every dispatch entry
+            block(xnd).wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = block(xnd)
+            y.wait_to_read()
+        dt = time.perf_counter() - t0
+    return dt / iters * 1e3, y.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+
+def run(smoke=False, depth=None, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.analysis import graph_opt
+    from mxnet_tpu.gluon import SymbolBlock
+
+    nd = mx.nd
+    batch, feat = 8, 64
+    depth = depth or (6 if smoke else 24)
+    iters = 5 if smoke else 50
+    xval = onp.random.RandomState(14).rand(batch, feat).astype("float32")
+    xnd = nd.array(xval)
+
+    prev_opt = os.environ.get("MXNET_GRAPH_OPT")  # graft-lint: allow(L101)
+    graph_opt.reset_counters()
+    try:
+        rewrite = _optimize_phase(batch, feat, depth)
+
+        _warm_process(batch, feat)
+        # optimized level FIRST: process-warm XLA state can only bias
+        # against the win this phase exists to measure
+        bind2_ms, trace2_ms, y_bind2, nodes_bind2 = _bind_first_forward(
+            2, batch, feat, depth, xval)
+        bind0_ms, trace0_ms, y_bind0, nodes_bind0 = _bind_first_forward(
+            0, batch, feat, depth, xval)
+
+        block = SymbolBlock(build_symbol(batch, feat, depth),
+                            [sym.var("x")])
+        exec2_ms, y_eager2 = _eager_exec(2, block, xnd, iters)
+        exec0_ms, y_eager0 = _eager_exec(0, block, xnd, iters)
+    finally:
+        if prev_opt is None:
+            os.environ.pop("MXNET_GRAPH_OPT", None)
+        else:
+            os.environ["MXNET_GRAPH_OPT"] = prev_opt
+
+    doc = {
+        "benchmark": "graph_opt",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "graph": {"batch": batch, "feat": feat, "depth": depth,
+                  "exec_iters": iters,
+                  "pipeline_version": graph_opt.PIPELINE_VERSION},
+        "results": {
+            **rewrite,
+            "bind_nodes_opt0": nodes_bind0,
+            "bind_nodes_opt2": nodes_bind2,
+            # bind pays for the analysis+rewrite at level 2 ...
+            "bind_ms_opt0": round(bind0_ms, 1),
+            "bind_ms_opt2": round(bind2_ms, 1),
+            # ... and the first forward collects: whole-graph jit trace
+            # + XLA compile of the (smaller) graph
+            "trace_compile_ms_opt0": round(trace0_ms, 1),
+            "trace_compile_ms_opt2": round(trace2_ms, 1),
+            "compile_speedup": round(trace0_ms / trace2_ms, 2),
+            "bind_total_speedup": round(
+                (bind0_ms + trace0_ms) / (bind2_ms + trace2_ms), 2),
+            "eager_exec_ms_opt0": round(exec0_ms, 3),
+            "eager_exec_ms_opt2": round(exec2_ms, 3),
+            "exec_speedup": round(exec0_ms / exec2_ms, 2),
+        },
+        "bind_bitwise_equal": bool(onp.array_equal(y_bind0, y_bind2)),
+        "eager_bitwise_equal": bool(onp.array_equal(y_eager0, y_eager2)),
+        "counters": graph_opt.counters(),
+    }
+    r = doc["results"]
+    assert r["graph_nodes_after"] < r["graph_nodes_before"], r
+    assert r["bind_nodes_opt2"] < r["bind_nodes_opt0"], r
+    assert doc["bind_bitwise_equal"] and doc["eager_bitwise_equal"], doc
+    if not smoke:
+        assert r["exec_speedup"] >= 1.1 or r["compile_speedup"] >= 1.1, r
+    out_path = out_path or "BENCH_GRAPHOPT_r14.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small graph/loop; CPU tier-1 time budget")
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, depth=a.depth, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

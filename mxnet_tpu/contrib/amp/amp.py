@@ -143,7 +143,7 @@ def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
             base = Symbol(op=op, name=name, inputs=ins,
                           kwargs=dict(s._kwargs),
                           num_outputs=s._num_outputs)
-            base._attrs = dict(s._attrs)
+            base._attrs = dict(s._attrs)  # graft-lint: allow(L601)
             memo[key] = base
         if s._op is not None and s._num_outputs > 1:
             return base[s._output_index]

@@ -567,7 +567,7 @@ def _slice_imp(ctx, node, attrs):
             raise MXNetError("Slice import supports step 1 only")
         out = ctx.sym.slice_axis(out, axis=int(ax), begin=int(s),
                                  end=None if e >= big else int(e))
-    out._name = node.name or node.output[0]
+    out._name = node.name or node.output[0]  # graft-lint: allow(L601)
     _set(ctx, node, out)
 
 

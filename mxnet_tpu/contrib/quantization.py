@@ -463,7 +463,7 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
             newn = S.Symbol(op=node._op, name=node._name, inputs=ins,
                             kwargs=dict(node._kwargs),
                             num_outputs=node._num_outputs)
-            newn._attrs.update(node._attrs)
+            newn._attrs.update(node._attrs)  # graft-lint: allow(L601)
             rep[k] = {"fp32": newn}
             continue
         op = node._op

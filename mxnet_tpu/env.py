@@ -94,6 +94,15 @@ KNOBS = {
         "verify-on-bind (executor), verify-on-hybridize (gluon), "
         "donation/aliasing guards (dispatch + fused-step caches) and "
         "SPMD sharding checks; see docs/ANALYSIS.md"),
+    "MXNET_GRAPH_OPT": (
+        "wired", "analysis.graph_opt",
+        "graph-optimization rewrite pipeline (constant folding, CSE, "
+        "dead-node elimination, transpose/reshape elision) applied at "
+        "the lowering entry points (Executor bind, SymbolBlock "
+        "forward/hybridize, serving InferenceSession): 0 (default, "
+        "off) | 1 (one pipeline sweep) | 2 (fixpoint). Every optimized "
+        "graph is re-verified; new diagnostics reject the rewrite; "
+        "see docs/ANALYSIS.md"),
     "MXNET_TEST_SEED": (
         "wired", "test_utils",
         "fixed seed for test_utils.set_default_context/seeded test "

@@ -47,18 +47,25 @@ class Executor:
         self._fwd_jit = None
         self._label_names = [n for n in self.arg_names
                              if n.endswith("label")]
-        self._verify_on_bind()
+        self._analyze_on_bind()
 
-    def _verify_on_bind(self):
-        """MXNET_GRAPH_VERIFY-gated static verification of the bound
-        graph (the analog of the reference's bind-time attribute passes,
-        infer_graph_attr_pass.cc, run as diagnostics instead of
-        CHECKs): bound arg/aux shapes+dtypes are the known set, and the
-        full pipeline (shape cross-check, eval_shape desync, dtype,
-        structure) dispositions per the mode."""
+    def _analyze_on_bind(self):
+        """Bind-time static analysis: MXNET_GRAPH_VERIFY-gated
+        verification (the analog of the reference's bind-time attribute
+        passes, infer_graph_attr_pass.cc, run as diagnostics instead of
+        CHECKs) followed by the MXNET_GRAPH_OPT-gated rewrite pipeline.
+        Both phases share ONE ``PassContext`` fact cache, so
+        verify-then-optimize runs shape/dtype inference once. The
+        rewrite replaces ``self._symbol``; the optimizer re-verifies its
+        own output and falls back to the original on any new error.
+        Feeds are name-keyed, so the bound arg/aux lists stay valid for
+        any rewrite (rewrites never drop referenced variables)."""
         from . import analysis
+        from .analysis import graph_opt
 
-        if analysis.verify_mode() == "off":
+        mode = analysis.verify_mode()
+        level = graph_opt.opt_level()
+        if mode == "off" and level == 0:
             return
         shapes, dtypes = {}, {}
         for n, a in zip(self.arg_names + self.aux_names,
@@ -66,10 +73,16 @@ class Executor:
             if a is not None:
                 shapes[n] = tuple(a.shape)
                 dtypes[n] = a.dtype
-        analysis.verify_symbol(
-            self._symbol, shapes=shapes, dtypes=dtypes,
-            subject=f"bind:{self._symbol._name or 'symbol'}"
-        ).disposition()
+        subject = f"bind:{self._symbol._name or 'symbol'}"
+        ctx = analysis.PassContext(self._symbol, shapes=shapes,
+                                   dtypes=dtypes, subject=subject)
+        if mode != "off":
+            analysis.run_passes(ctx)
+            ctx.report.disposition()
+        if level > 0:
+            self._symbol, _ = graph_opt.optimize_symbol(
+                self._symbol, shapes=shapes, dtypes=dtypes, level=level,
+                ctx=ctx, subject=subject)
 
     @property
     def arg_dict(self):

@@ -706,10 +706,30 @@ class SymbolBlock(HybridBlock):
             ret._load_loaded_parameters(loaded, param_file)
         return ret
 
+    def _optimized_outputs(self):
+        """MXNET_GRAPH_OPT-gated rewrite of the output graph, cached per
+        (level, pipeline version). Every forward — eager, under the
+        hybridized CachedOp trace, and the serving session's ``_pure``
+        — evaluates this graph, so one rewrite covers all three."""
+        from ..analysis import graph_opt
+
+        level = graph_opt.opt_level()
+        if level <= 0:
+            return self._outputs
+        tag = (level, graph_opt.PIPELINE_VERSION)
+        cached = getattr(self, "_graph_opt_cache", None)
+        if cached is None or cached[0] != tag:
+            opt, _ = graph_opt.optimize_symbol(
+                self._outputs, level=level,
+                subject=f"hybridize:{self.name or 'symbol_block'}")
+            self._graph_opt_cache = (tag, opt)
+            cached = self._graph_opt_cache
+        return cached[1]
+
     def forward(self, *args):
         from .. import symbol as sym
 
         feed = {i.name: a for i, a in zip(self._inputs, args)}
         for name, p in self.collect_params().items():
             feed[name] = p.data()
-        return self._outputs.eval_with(feed)
+        return self._optimized_outputs().eval_with(feed)

@@ -625,8 +625,17 @@ def _sym_ones_body(shape=None, dtype="float32"):
     return jnp.ones(tuple(shape), dtype)
 
 
+def _sym_constant_body(value=None, shape=None, dtype="float32"):
+    """Literal constant node materialized by graph-opt constant folding
+    (analysis/graph_opt.py): ``value`` is a nested-list literal baked
+    into the node's kwargs at optimize time."""
+    return jnp.asarray(value, dtype=dtype).reshape(tuple(shape))
+
+
 register("_sym_zeros", differentiable=False, namespaces=())(_sym_zeros_body)
 register("_sym_ones", differentiable=False, namespaces=())(_sym_ones_body)
+register("_sym_constant", differentiable=False,
+         namespaces=())(_sym_constant_body)
 
 
 @register()

@@ -197,6 +197,19 @@ def graph_verify_counters():
         return {}
 
 
+def graph_opt_counters():
+    """Graph-optimizer counters (graphs optimized/rejected, node totals
+    before/after, per-pass rewrite counts and time, analysis-run and
+    fact-cache tallies), live from mxnet_tpu.analysis.graph_opt. Zeros
+    before the first optimization (MXNET_GRAPH_OPT gated)."""
+    try:
+        from .analysis.graph_opt import counters
+
+        return counters()
+    except Exception:
+        return {}
+
+
 def _record(domain, name, start_us, dur_us, cat="event", value=None,
             cached=None):
     with _lock:
@@ -246,6 +259,12 @@ def dump(finished=True, profile_process="worker"):
         payload["traceEvents"].append(
             {"name": f"graph_verify/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
+    for cname, cval in sorted(graph_opt_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"graph_opt/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
     for cname, cval in sorted(compile_cache_counters().items()):
         payload["traceEvents"].append(
             {"name": f"compile_cache/{cname}", "cat": "counter",

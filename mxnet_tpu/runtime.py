@@ -87,6 +87,14 @@ def _detect():
         feats["GRAPH_VERIFY"] = verify_mode() != "off"
     except Exception:
         feats["GRAPH_VERIFY"] = False
+    try:
+        from .analysis.graph_opt import graph_opt_enabled
+
+        # graph rewrite pipeline armed (MXNET_GRAPH_OPT,
+        # analysis/graph_opt.py)
+        feats["GRAPH_OPT"] = graph_opt_enabled()
+    except Exception:
+        feats["GRAPH_OPT"] = False
     feats["DIST_KVSTORE"] = True  # jax.distributed collectives
     feats["INT64_TENSOR_SIZE"] = True
     feats["SIGNAL_HANDLER"] = True

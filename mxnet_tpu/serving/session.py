@@ -362,6 +362,16 @@ class InferenceSession:
     def _fingerprint(self, bucket, amp_ver):
         if self._graph_sig is None:
             return None
+        from ..analysis import graph_opt
+        from ..gluon.block import SymbolBlock
+
+        # graph-opt rewrites change the lowered computation without
+        # changing the source graph signature: salt the key with the
+        # level + pipeline version so optimized and unoptimized AOT
+        # artifacts (and different pipeline generations) never collide
+        opt_salt = (graph_opt.fingerprint_salt()
+                    if isinstance(self._block, SymbolBlock)
+                    else ("graph_opt", 0))
         key = ("serving", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -369,7 +379,7 @@ class InferenceSession:
                   for v in self._param_vals),
             tuple((s.name, (bucket,) + s.row_shape, str(s.dtype))
                   for s in self._input_specs),
-            amp_ver, bucket)
+            amp_ver, bucket, opt_salt)
         code_of = [type(self)._pure, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
         return cc.fingerprint("serving", key, code_of=tuple(code_of))

@@ -135,8 +135,8 @@ def infer_shapes(symbol, known, allow_unknown=False):
             if node._name in var_shapes:
                 node_out[id(node)] = tuple(var_shapes[node._name])
             continue
-        if node._op in ("_sym_zeros", "_sym_ones"):
-            # literal-shaped constants (sym.zeros / sym.ones)
+        if node._op in ("_sym_zeros", "_sym_ones", "_sym_constant"):
+            # literal-shaped constants (sym.zeros / sym.ones / folded)
             node_out[id(node)] = tuple(node._kwargs["shape"])
             continue
         opdef = _registry.get_op(node._op)
@@ -233,7 +233,7 @@ def _node_out_dtype(op, kw, in_dtypes):
     if op == "requantize":
         return [_canon(kw.get("out_type", "int8")),
                 onp.dtype(onp.float32), onp.dtype(onp.float32)]
-    if op in ("_sym_zeros", "_sym_ones"):
+    if op in ("_sym_zeros", "_sym_ones", "_sym_constant"):
         return _canon(kw.get("dtype", "float32"))
     if op == "embedding":
         return in_dtypes.get(1, onp.dtype(onp.float32))  # weight dtype

@@ -1,0 +1,50 @@
+"""Seeded graft_lint L601 violation fixture (NOT imported by the
+package). graft-lint: scope(symbol-graph)
+
+The marker comment above opts this file into the no-graph-mutation
+discipline that ``mxnet_tpu/`` (outside ``analysis/`` and ``symbol/``)
+gets automatically; the tier-1 lint test asserts every mutation species
+below is flagged. Keep this file OUTSIDE mxnet_tpu/ so
+``python -m tools.graft_lint mxnet_tpu`` stays clean on the shipped
+tree.
+"""
+
+
+def bad_rewire(node, other):
+    # L601: re-pointing a node's op in place
+    node._op = "identity"
+    # L601: splicing an input edge under a shared DAG
+    node._inputs.append(other)
+    # L601: attr write through a subscript
+    node._attrs["__shape__"] = "(1,)"
+    # L601: mutating call on the kwargs dict
+    node._kwargs.update({"axes": (1, 0)})
+    return node
+
+
+def good_reads(node):
+    # reads are fine — only mutation rewires the graph
+    op = node._op
+    fan_in = len(node._inputs)
+    declared = node._attrs.get("__shape__")
+    return op, fan_in, declared
+
+
+class OwnFields:
+    """A class managing its OWN fields named like node attrs is not a
+    graph rewrite — self/cls receivers are exempt."""
+
+    def __init__(self):
+        self._inputs = []
+        self._attrs = {}
+
+    def add(self, x):
+        self._inputs.append(x)
+        self._attrs["n"] = len(self._inputs)
+
+
+def whitelisted_builder(node, attrs):
+    # constructor-adjacent sites (quantization/AMP/ONNX import) carry
+    # the pragma so the exemption is explicit and reviewable
+    node._attrs.update(attrs)  # graft-lint: allow(L601)
+    return node

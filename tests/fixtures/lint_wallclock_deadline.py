@@ -1,4 +1,4 @@
-"""Seeded graft_lint L601 violation fixture (NOT imported by the
+"""Seeded graft_lint L602 violation fixture (NOT imported by the
 package). graft-lint: scope(serving-deadline)
 
 The marker comment above opts this file into the monotonic-clock
@@ -13,18 +13,18 @@ from time import time as now
 
 
 def bad_deadline_math(timeout_s, queue):
-    # L601: wall-clock deadline — one NTP step expires every request
+    # L602: wall-clock deadline — one NTP step expires every request
     deadline = time.time() + timeout_s
     while queue:
         req = queue.pop()
-        # L601: wall-clock comparison at a queue exit
+        # L602: wall-clock comparison at a queue exit
         if time.time() > deadline:
             return req
     return None
 
 
 def bad_aliased_read():
-    # L601: `from time import time` must not hide the wall clock
+    # L602: `from time import time` must not hide the wall clock
     return now()
 
 
@@ -35,4 +35,4 @@ def good_monotonic(timeout_s):
 
 def whitelisted_log_stamp():
     # log/record timestamps are the blessed wall-clock use
-    return time.time()  # graft-lint: allow(L601)
+    return time.time()  # graft-lint: allow(L602)

@@ -135,6 +135,48 @@ def test_resilience_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "resilience"
 
 
+@pytest.mark.slow
+def test_graphopt_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import graphopt_bench
+
+    out = str(tmp_path / "graphopt.json")
+    doc = graphopt_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["bind_bitwise_equal"]
+    assert doc["eager_bitwise_equal"]
+    r = doc["results"]
+    assert r["graph_nodes_after"] < r["graph_nodes_before"]
+    assert r["bind_nodes_opt2"] < r["bind_nodes_opt0"]
+    assert not r["rejected"]
+    # every shipped pass fired on the redundant benchmark graph
+    assert set(r["rewrites_per_pass"]) == \
+        {"fold", "cse", "transpose_elision", "dce"}
+    assert all(v > 0 for v in r["rewrites_per_pass"].values())
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "graph_opt"
+
+
+def test_bench_compare_graphopt_metrics():
+    """BENCH_GRAPHOPT_r14.json names: node counts and trace+compile ms
+    are lower-is-better, the speedups higher-is-better, rewrite counts
+    untracked."""
+    base = {"results": {"graph_nodes_after": 29,
+                        "trace_compile_ms_opt2": 38.0,
+                        "exec_speedup": 3.7, "compile_speedup": 1.35,
+                        "rewrites": 103}}
+    worse = {"results": {"graph_nodes_after": 90,
+                         "trace_compile_ms_opt2": 60.0,
+                         "exec_speedup": 1.0, "compile_speedup": 1.35,
+                         "rewrites": 103}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["results.graph_nodes_after"][4]      # rewrites stopped
+    assert rows["results.trace_compile_ms_opt2"][4]  # +58%: REGRESSED
+    assert rows["results.exec_speedup"][4]
+    assert not rows["results.compile_speedup"][4]
+    assert "results.rewrites" not in rows            # not a direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_resilience_overhead_metrics():
     """BENCH_RESIL_r12.json names: checkpoint overhead percentages and
     epoch seconds are lower-is-better; counters untracked."""

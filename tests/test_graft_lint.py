@@ -18,6 +18,8 @@ EXC_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_bare_except.py")
 CLOCK_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                              "lint_wallclock_deadline.py")
+MUT_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                           "lint_graph_mutation.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -75,25 +77,25 @@ def test_bare_except_fixture_triggers_l501():
     assert {f.code for f in findings} == {"L501"}, findings
 
 
-def test_wallclock_fixture_triggers_l601():
-    """L601: every wall-clock species in the seeded deadline fixture
+def test_wallclock_fixture_triggers_l602():
+    """L602: every wall-clock species in the seeded deadline fixture
     is flagged — dotted time.time(), the aliased `from time import
-    time` form — and the monotonic and allow(L601) sites are not."""
+    time` form — and the monotonic and allow(L602) sites are not."""
     findings = graft_lint.lint_paths([CLOCK_FIXTURE], repo_root=REPO,
                                      registry=False)
-    l601 = [f for f in findings if f.code == "L601"]
-    assert len(l601) == 3, findings  # deadline + queue exit + alias
+    l602 = [f for f in findings if f.code == "L602"]
+    assert len(l602) == 3, findings  # deadline + queue exit + alias
     src = open(CLOCK_FIXTURE).read().splitlines()
-    for f in l601:
+    for f in l602:
         line = src[f.line - 1]
         assert "time.time()" in line or "now()" in line, (f.line, line)
     # the good_monotonic and pragma'd sites stay clean
-    assert all(f.line < 30 for f in l601), l601
-    assert {f.code for f in findings} == {"L601"}, findings
+    assert all(f.line < 30 for f in l602), l602
+    assert {f.code for f in findings} == {"L602"}, findings
 
 
 def test_wallclock_scope_is_serving_plus_marker(tmp_path):
-    """The L601 discipline binds mxnet_tpu/serving/ automatically and
+    """The L602 discipline binds mxnet_tpu/serving/ automatically and
     other files only via the scope(serving-deadline) marker."""
     src = "import time\n\ndef stamp():\n    return time.time()\n"
     free = tmp_path / "stamp_frag.py"
@@ -105,7 +107,46 @@ def test_wallclock_scope_is_serving_plus_marker(tmp_path):
     scoped.write_text(src)
     codes = [fi.code for fi in graft_lint.lint_paths(
         [str(scoped)], repo_root=REPO, registry=False)]
+    assert codes == ["L602"]
+
+
+def test_graph_mutation_fixture_triggers_l601():
+    """L601: every graph-node-mutation species in the seeded fixture is
+    flagged — field assignment, .append() on _inputs, subscripted attr
+    write, .update() on kwargs — while reads, self-receiver fields and
+    the allow(L601) site are not."""
+    findings = graft_lint.lint_paths([MUT_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l601 = [f for f in findings if f.code == "L601"]
+    assert len(l601) == 4, findings
+    src = open(MUT_FIXTURE).read().splitlines()
+    for f in l601:
+        assert "node._" in src[f.line - 1], (f.line, src[f.line - 1])
+    # everything below bad_rewire (reads, OwnFields, pragma) is clean
+    assert all(f.line < 24 for f in l601), l601
+    assert {f.code for f in findings} == {"L601"}, findings
+
+
+def test_graph_mutation_scope_binds_package_not_passes(tmp_path):
+    """L601 binds mxnet_tpu/ automatically but exempts the pass
+    manager (analysis/) and the Symbol constructors (symbol/); outside
+    the package it is opt-in via scope(symbol-graph)."""
+    src = "def rewire(node, y):\n    node._inputs.append(y)\n"
+    free = tmp_path / "rewire_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "contrib" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
     assert codes == ["L601"]
+    passes = tmp_path / "mxnet_tpu" / "analysis" / "frag.py"
+    passes.parent.mkdir(parents=True)
+    passes.write_text(src)
+    assert graft_lint.lint_paths([str(passes)], repo_root=REPO,
+                                 registry=False) == []
 
 
 def test_l501_swallowed_variants(tmp_path):

@@ -14,7 +14,10 @@ inferred from the leaf name:
   BENCH_PIPELINE_r11.json — the async pipeline exists to shrink them),
   ``*overhead*`` (checkpoint-overhead metrics from BENCH_RESIL_r12.json
   — async checkpointing is gated at <5% epoch overhead, so growth
-  there is a resilience-cost regression)
+  there is a resilience-cost regression), ``*nodes*`` / ``*trace*``
+  (graph-opt metrics from BENCH_GRAPHOPT_r14.json — a like-for-like
+  graph lowering to MORE nodes or a longer trace+compile means a
+  rewrite pass stopped firing)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
   ``*per_s`` (end-anchored: ``steps_per_s`` is throughput but
   ``fused_ms_per_step`` stays latency), ``*items_per*``, ``*_rps*``
@@ -39,7 +42,7 @@ import sys
 
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "p50", "p95", "p99", "epoch_s", "idle", "stall",
-                   "overhead", "shed")
+                   "overhead", "shed", "nodes", "trace")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'

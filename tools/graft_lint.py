@@ -39,7 +39,21 @@ package:
                        stay sync-free; deliberate sites (checkpointing,
                        epoch-end metric reads) carry
                        ``# graft-lint: allow(L401)``.
-``L601 wall-clock``    a ``time.time()`` call inside ``mxnet_tpu/
+``L601 graph-mutate``  direct mutation of a ``Symbol`` graph-node
+                       field (``_op``, ``_inputs``, ``_kwargs``,
+                       ``_attrs``, ``_name``, ``_num_outputs``,
+                       ``_output_index``, ``_group``) on a non-self
+                       receiver outside ``mxnet_tpu/analysis/`` and
+                       ``mxnet_tpu/symbol/``. Graph rewrites must go
+                       through the pass manager
+                       (``analysis/graph_opt.py``), which never
+                       mutates shared nodes — an in-place edit
+                       corrupts every executor/cache fingerprint that
+                       already hashed the graph. Legitimate
+                       constructor-adjacent sites (quantization/AMP
+                       graph builders, ONNX import) carry
+                       ``# graft-lint: allow(L601)``.
+``L602 wall-clock``    a ``time.time()`` call inside ``mxnet_tpu/
                        serving/`` or any file carrying the
                        ``# graft-lint: scope(serving-deadline)``
                        marker. Serving deadline/flush math must use
@@ -49,7 +63,7 @@ package:
                        DST, and one jump expires every queued request
                        at once (or holds batches forever). A
                        deliberate wall-clock site (log timestamps)
-                       carries ``# graft-lint: allow(L601)``.
+                       carries ``# graft-lint: allow(L602)``.
 ``L501 bare-except``   a bare ``except:`` clause, or a broad handler
                        (``except Exception``/``BaseException``, alone
                        or in a tuple) whose body is ONLY ``pass``/
@@ -383,7 +397,7 @@ def check_step_host_sync(path, tree, source, findings):
 
 
 def _serving_deadline_scoped(path, source):
-    """Files the L601 monotonic-clock discipline applies to: the
+    """Files the L602 monotonic-clock discipline applies to: the
     serving package is scoped automatically (every queue exit there
     does deadline math; a new serving module can't silently opt out);
     other deadline code opts in with a
@@ -395,7 +409,7 @@ def _serving_deadline_scoped(path, source):
 
 
 def check_wallclock_deadlines(path, tree, source, findings):
-    """L601: ``time.time()`` in deadline-scoped modules. Deadlines and
+    """L602: ``time.time()`` in deadline-scoped modules. Deadlines and
     flush timers compare against ``time.monotonic()`` everywhere else
     in serving/; one wall-clock read mixed in breaks the comparison
     the moment NTP steps the clock."""
@@ -418,13 +432,96 @@ def check_wallclock_deadlines(path, tree, source, findings):
         hit = (dn is not None and dn.split(".")[-1] == "time" and
                dn.split(".")[0].lstrip("_") == "time") or \
               (isinstance(f, ast.Name) and f.id in bare_aliases)
-        if hit and not pragmas.allows(node.lineno, "L601"):
+        if hit and not pragmas.allows(node.lineno, "L602"):
             findings.append(Finding(
-                "L601", path, node.lineno,
+                "L602", path, node.lineno,
                 "wall-clock time.time() in a serving/deadline module; "
                 "deadline math must use time.monotonic() (and timing "
                 "time.perf_counter()) — annotate a deliberate "
-                "wall-clock site (log timestamps) with allow(L601)"))
+                "wall-clock site (log timestamps) with allow(L602)"))
+
+
+#: Symbol graph-node fields whose in-place mutation rewires a graph
+#: other code may already hold / have fingerprinted
+_SYMBOL_NODE_ATTRS = {"_op", "_inputs", "_kwargs", "_attrs", "_name",
+                      "_num_outputs", "_output_index", "_group"}
+
+#: container methods that mutate their receiver
+_MUTATOR_METHODS = {"update", "append", "extend", "insert", "pop",
+                    "clear", "setdefault", "remove", "popitem"}
+
+
+def _graph_rewrite_scoped(path, source):
+    """Files the L601 no-graph-mutation discipline applies to: all of
+    ``mxnet_tpu/`` EXCEPT the pass manager itself (``analysis/``) and
+    the Symbol constructors (``symbol/``), which own those fields.
+    Code outside the package opts in with a
+    ``# graft-lint: scope(symbol-graph)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/analysis/" in norm or "mxnet_tpu/symbol/" in norm:
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(symbol-graph)" in source
+
+
+def _node_attr_target(expr):
+    """The ``x._inputs``-shaped Attribute under ``expr`` (direct, or
+    through a subscript like ``x._kwargs["shape"]``), or None."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute) and \
+            expr.attr in _SYMBOL_NODE_ATTRS:
+        return expr
+    return None
+
+
+def check_graph_mutation(path, tree, source, findings):
+    """L601: in-place mutation of Symbol graph-node fields outside the
+    pass manager. Symbols are shared DAG nodes: executors, the compile
+    caches and the serving fingerprints all key off a graph's identity
+    and serialized form, so an in-place ``node._inputs.append(...)`` or
+    ``node._op = ...`` silently invalidates every one of them. Rewrites
+    construct fresh nodes via ``analysis/graph_opt.py``; ``self``/
+    ``cls`` receivers (a class managing its own fields) are exempt."""
+    if not _graph_rewrite_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+
+    def self_receiver(attr_node):
+        return isinstance(attr_node.value, ast.Name) and \
+            attr_node.value.id in ("self", "cls")
+
+    def emit(node, attr_node, what):
+        if pragmas.allows(node.lineno, "L601"):
+            return
+        findings.append(Finding(
+            "L601", path, node.lineno,
+            f"direct graph-node mutation: {what} "
+            f"'{attr_node.attr}' outside mxnet_tpu/analysis/ — rewires "
+            "a possibly-shared Symbol DAG under executors and cache "
+            "fingerprints; build fresh nodes through the pass manager "
+            "(analysis/graph_opt.py) or annotate a constructor-"
+            "adjacent site with allow(L601)"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                (node.targets if isinstance(node, ast.Delete)
+                 else [node.target])
+            for t in targets:
+                attr = _node_attr_target(t)
+                if attr is not None and not self_receiver(attr):
+                    emit(node, attr, "deletion of"
+                         if isinstance(node, ast.Delete)
+                         else "assignment to")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS:
+            attr = _node_attr_target(node.func.value)
+            if attr is not None and not self_receiver(attr):
+                emit(node, attr,
+                     f"mutating call '.{node.func.attr}()' on")
 
 
 _BROAD_EXC = {"Exception", "BaseException"}
@@ -585,6 +682,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_jit_nocache(path, tree, source, findings)
         check_step_host_sync(path, tree, source, findings)
         check_wallclock_deadlines(path, tree, source, findings)
+        check_graph_mutation(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
