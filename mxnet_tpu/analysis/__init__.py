@@ -45,7 +45,7 @@ from .graph_opt import (AnalysisPass, DEFAULT_REWRITE_PIPELINE,
 from .graph_opt import counters as graph_opt_counters
 from .graph_opt import fingerprint_salt as graph_opt_fingerprint_salt
 from .graph_opt import reset_counters as reset_graph_opt_counters
-from .sharding import verify_shardings
+from .sharding import verify_plan, verify_shardings
 
 __all__ = [
     "CODES", "Diagnostic", "DiagnosticReport", "GraphVerifyError",
@@ -58,7 +58,8 @@ __all__ = [
     "DEFAULT_REWRITE_PIPELINE", "REWRITE_PASSES", "opt_level",
     "graph_opt_enabled", "optimize_symbol", "op_is_pure",
     "graph_opt_counters", "graph_opt_fingerprint_salt",
-    "reset_graph_opt_counters", "verify_shardings", "verify_block_call",
+    "reset_graph_opt_counters", "verify_shardings", "verify_plan",
+    "verify_block_call",
 ]
 
 

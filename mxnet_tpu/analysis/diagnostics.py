@@ -43,6 +43,7 @@ CODES = {
     "GV403": (SEV_ERROR, "duplicate node name"),
     "GV501": (SEV_ERROR, "sharding mismatch"),
     "GV502": (SEV_ERROR, "mesh mismatch"),
+    "GV503": (SEV_WARNING, "dead sharding-plan rule"),
 }
 
 

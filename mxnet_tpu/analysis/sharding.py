@@ -13,12 +13,20 @@ executor_group.py:282):
 - GV501: a PartitionSpec naming an axis the mesh doesn't have, a dim
   index out of range for the array's rank, or a sharded dimension not
   divisible by the product of its mesh axis sizes.
+
+``verify_plan`` runs the same GV501 checks over a ``ShardingPlan``'s
+RAW rule resolutions (before the plan's runtime divisibility fallback
+rewrites them to replication), plus:
+
+- GV503: a plan rule whose pattern matches none of the given names —
+  a dead rule is almost always a typo'd regex silently replicating the
+  tensors it meant to shard.
 """
 from __future__ import annotations
 
 from .diagnostics import DiagnosticReport
 
-__all__ = ["verify_shardings"]
+__all__ = ["verify_shardings", "verify_plan"]
 
 
 def _spec_entries(spec):
@@ -104,3 +112,44 @@ def verify_shardings(shapes, shardings, mesh=None, subject=None):
                     hint=f"pad dim {dim} to a multiple of {total} or "
                          "reshape the mesh")
     return report
+
+
+def verify_plan(plan, named_shapes, mesh, subject=None):
+    """Static plan-vs-mesh check for a ``sharding.ShardingPlan``.
+
+    Resolves every name's RAW matched spec (no divisibility fallback,
+    no scalar shortcut) and runs the GV501 axis/rank/divisibility
+    checks against ``mesh`` — exactly the mismatches the runtime
+    fallback would silently paper over with replication — then flags
+    rules that matched nothing (GV503). Returns the undispositioned
+    DiagnosticReport, like ``verify_shardings``.
+    """
+    report = DiagnosticReport(subject=subject or "sharding plan")
+    named_shapes = {n: tuple(s) for n, s in named_shapes.items()}
+    raw = {}
+    hits = set()
+    for name, shape in named_shapes.items():
+        hit = plan.match(name)
+        if hit is None:
+            continue
+        hits.add(hit[0])
+        raw[name] = _entries_to_spec(hit[1])
+    report.extend(verify_shardings(named_shapes, raw, mesh=mesh,
+                                   subject=subject or "sharding plan"))
+    for pat, _spec in plan.rules:
+        if pat not in hits:
+            report.emit(
+                "GV503",
+                f"plan rule {pat!r} matches none of the "
+                f"{len(named_shapes)} given names",
+                node=pat,
+                hint="dead rules usually mean a typo'd regex — the "
+                     "tensors it meant to shard are replicating")
+    return report
+
+
+def _entries_to_spec(entries):
+    """Plan-canonical entry tuple -> a PartitionSpec-like tuple that
+    ``_spec_entries`` understands (kept here so analysis does not
+    import jax.sharding)."""
+    return tuple(None if e is None else tuple(e) for e in entries)

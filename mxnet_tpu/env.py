@@ -310,6 +310,33 @@ KNOBS = {
         "seed for probabilistic fault clauses (default 0); each "
         "point folds its name in, so streams are deterministic per "
         "(seed, point)"),
+    "MXNET_SHARDING": (
+        "wired", "sharding",
+        "rule-based SPMD sharding subsystem (default 1): plan scopes "
+        "drive the fused step, tensor-parallel serving and sharded "
+        "checkpoints; 0 makes every plan scope inert (single-device "
+        "behavior) without touching caller code; see docs/SHARDING.md"),
+    "MXNET_SHARDING_RULES": (
+        "wired", "sharding.plan",
+        "declarative partition rules for sharding.plan_from_env(), "
+        "';'-separated 'regex=axis,axis' entries matched first-wins "
+        "against parameter names, e.g. "
+        "'.*weight=mp,*;.*embed.*=*,mp' ('*' or empty = replicate "
+        "that dim, 'a+b' shards one dim over two mesh axes); unset = "
+        "no env-declared plan"),
+    "MXNET_SHARDING_UNMATCHED": (
+        "wired", "sharding.plan",
+        "unmatched-parameter policy for the env-declared plan: "
+        "'replicate' (default) or 'error' (a name no rule matches "
+        "raises at resolution — audit mode for full-coverage plans)"),
+    "MXNET_SHARDING_ZERO1": (
+        "wired", "sharding.zero1",
+        "opt-in ZeRO-1 cross-replica weight-update sharding (default "
+        "0): optimizer-state leaves shard their leading dim over the "
+        "mesh's first axis (1/N bytes and 1/N update FLOPs per "
+        "device; GSPMD all-gathers the updated weights back to the "
+        "plan layout); dims the axis doesn't divide keep the "
+        "param-follow layout"),
     # accepted no-ops: the concern is owned by XLA/PJRT on TPU
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "accepted", "-", "XLA fuses whole programs; always bulk"),

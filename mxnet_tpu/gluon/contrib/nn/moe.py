@@ -103,7 +103,7 @@ class SwitchMoE(HybridBlock):
         # mesh-committed COPIES feed the computation; the caller's
         # buffers stay on their device (mutating them would poison
         # downstream eager math with mixed commitments)
-        datas = [jax.device_put(a.data, NamedSharding(mesh, s))
+        datas = [jax.device_put(a.data, NamedSharding(mesh, s))  # graft-lint: allow(L701)
                  for a, s in zip(args, specs)]
         if not autograd.is_recording():
             out_d, aux_d = pure(*datas)  # no vjp residuals at inference
@@ -113,8 +113,8 @@ class SwitchMoE(HybridBlock):
 
         def placed_vjp(cots, _vjp=vjp_fn):
             co, ca = cots
-            co = jax.device_put(co, NamedSharding(mesh, bspec))
-            ca = jax.device_put(ca, NamedSharding(mesh, rep))
+            co = jax.device_put(co, NamedSharding(mesh, bspec))  # graft-lint: allow(L701)
+            ca = jax.device_put(ca, NamedSharding(mesh, rep))  # graft-lint: allow(L701)
             grads = _vjp((co, ca))
             return [jax.device_put(g, caller_dev) for g in grads]
 

@@ -253,7 +253,7 @@ class _FusedEntry:
 
 
 def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
-                     cache_key=None):
+                     cache_key=None, shard_cfg=None):
     """One donated XLA executable for the whole weight-update phase.
 
     kernel(w, g, s, lr, wd, rescale, t) -> (w2, s2) is the optimizer's
@@ -274,6 +274,15 @@ def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
     full lr_mult/wd_mult logic so multipliers never retrace); rescale is
     the f32 scalar self._scale/batch_size. States and step_state are
     donated; params donated only when ``donate_params``.
+
+    ``shard_cfg`` (a ``sharding.FusedShardCfg``, built from the scoped
+    ShardingPlan) compiles the SAME program under the mesh: params and
+    grads laid out per plan, optimizer state per plan or ZeRO-1, the
+    scalar step-state/hyperparameters replicated — GSPMD inserts the
+    update-side collectives. Inputs not already resident at those
+    layouts are resharded by jit on entry (first step after a restore);
+    at steady state outputs feed back at the declared shardings and no
+    data moves.
     """
 
     def apply_all(pvals, gvals, svals, lrs, wds, eff, t1):
@@ -340,6 +349,16 @@ def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
             return jax.lax.cond(finite, do_apply, do_skip, None)
 
     donate = (0, 2, 3) if donate_params else (2, 3)
+    jit_kwargs = {}
+    if shard_cfg is not None:
+        pshard = tuple(shard_cfg.param_shardings)
+        sshard = tuple(shard_cfg.state_shardings)
+        srep = tuple(shard_cfg.rep for _ in
+                     range(1 if scaler_cfg is None else 4))
+        rep = shard_cfg.rep
+        jit_kwargs = dict(
+            in_shardings=(pshard, pshard, sshard, srep, rep, rep, rep),
+            out_shardings=(pshard, sshard, srep))
     # fingerprint only when the disk tier is armed (MXNET_COMPILE_CACHE=0
     # must mean the plain jit path, not a no-op GuardedCompiled layer),
     # salted with the bytecode of the optimizer kernel AND this builder
@@ -349,5 +368,6 @@ def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
                          code_of=(kernel, build_executable)) \
         if cache_key is not None and _cc.cache_enabled() else None
     return _FusedEntry(
-        _cc.counting_jit(step, label="fused_step", donate_argnums=donate),
+        _cc.counting_jit(step, label="fused_step", donate_argnums=donate,
+                         **jit_kwargs),
         fp)

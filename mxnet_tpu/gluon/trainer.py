@@ -263,7 +263,7 @@ class Trainer:
         from ..ndarray import registry as _registry
 
         token = (kernel_key, scaler_cfg, donate_params,
-                 _registry.amp_version(),
+                 _registry.amp_version(), self._shard_token(),
                  tuple(p._grad_req for p in self._params))
         cache = self._fused
         if cache is not None and cache["token"] == token and \
@@ -296,6 +296,7 @@ class Trainer:
                                 for p in params),
                 "params": params, "grads": grads, "work_states": states,
                 "work": work, "entry": entry,
+                "shard_cfg": group.get("shard_cfg"),
                 "lr_host": None, "lr_dev": None,
                 "wd_host": None, "wd_dev": None,
                 "rescale_host": None, "rescale_dev": None}
@@ -337,6 +338,12 @@ class Trainer:
         pv = tuple(p._ndarray._data for p in params)
         gv = tuple(g._data for g in grads)
         sv = tuple(_fs.state_data(s) for s in states)
+        shard_cfg = cache.get("shard_cfg")
+        if shard_cfg is not None:
+            # jit with in_shardings rejects committed buffers at another
+            # layout — place (and launder donated) inputs; identity at
+            # steady state
+            pv, gv, sv = shard_cfg.place_args(pv, gv, sv, donate_params)
         try:
             new_p, new_s, vals2 = entry(pv, gv, sv, st["vals"], lrs, wds,
                                         rescale)
@@ -356,6 +363,18 @@ class Trainer:
         for s, s2 in zip(states, new_s):
             _fs.rebind_state(s, s2)
         return True
+
+    def _shard_token(self):
+        """Cheap identity token for the active sharding declaration —
+        part of the per-step cache token so entering/leaving a
+        ``sharding.plan_scope`` (or toggling ZeRO-1) rebuilds the fused
+        group instead of reusing the other layout's executable."""
+        from .. import sharding as _shard
+
+        ctx = _shard.current_plan()
+        if ctx is None:
+            return None
+        return (id(ctx[0]), id(ctx[1]), _shard.zero1_enabled())
 
     def _fused_group(self, kernel_key, scaler_cfg, donate_params):
         """Work set + LRU cache key for a fused step over the current
@@ -383,11 +402,19 @@ class Trainer:
             (tuple(p.shape), str(p.data().data.dtype),
              str(g.data.dtype), _fs.state_sig(s))
             for p, g, s in zip(params, grads, states))
+        from .. import sharding as _shard
+
+        shard_cfg = _shard.fused_shard_cfg(
+            [(p.name, tuple(p.shape)) for p in params],
+            [_fs.state_sig(s) for s in states]) \
+            if self._shard_token() is not None else None
         key = (type(optim).__name__, kernel_key, mp_flags, sig,
                scaler_cfg, self._distributed, donate_params,
-               _registry.amp_version())
+               _registry.amp_version(),
+               None if shard_cfg is None else shard_cfg.salt)
         return {"work": work, "params": params, "grads": grads,
-                "states": states, "mp_flags": mp_flags, "key": key}
+                "states": states, "mp_flags": mp_flags, "key": key,
+                "shard_cfg": shard_cfg}
 
     def _fused_entry(self, group, kernel, scaler_cfg, donate_params):
         """The cached fused-step executable for a ``_fused_group`` —
@@ -398,7 +425,8 @@ class Trainer:
         if entry is None:
             entry = _fs.build_executable(kernel, group["mp_flags"],
                                          scaler_cfg, donate_params,
-                                         cache_key=key)
+                                         cache_key=key,
+                                         shard_cfg=group.get("shard_cfg"))
             _fs._CACHE.insert(key, entry)
         return entry
 
@@ -551,6 +579,9 @@ class Trainer:
         pv = tuple(p._ndarray._data for p in group["params"])
         gv = tuple(g._data for g in group["grads"])
         sv = tuple(_fs.state_data(s) for s in group["states"])
+        if group.get("shard_cfg") is not None:
+            pv, gv, sv = group["shard_cfg"].place_args(
+                pv, gv, sv, donate_params)
         n = len(group["work"])
         entry.prepare((pv, gv, sv, st["vals"],
                        jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),

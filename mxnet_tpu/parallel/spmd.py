@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import re
 
 import jax
 import jax.numpy as jnp
@@ -183,20 +182,26 @@ def shard_params(named_params, mesh, rules=None):
     """Compute a NamedSharding per parameter from {regex: PartitionSpec}
     rules; unmatched params are replicated. Returns {name: sharding}.
 
+    LEGACY SHIM: the rule matcher now lives in
+    ``mxnet_tpu.sharding.ShardingPlan`` — this keeps the original
+    signature and semantics (dict rules, first-match wins, specs applied
+    VERBATIM with no divisibility fallback, unmatched replicates) on top
+    of it. New code should build a plan directly: it adds the fallback,
+    the ``unmatched='error'`` policy, fingerprint salts and the consumer
+    wiring (fused step / serving / checkpoints).
+
     Under ``MXNET_GRAPH_VERIFY`` the resolved specs are validated
     against the mesh and the parameter shapes FIRST
     (analysis.verify_shardings): a bad axis name or a non-dividing
     sharded dim becomes a GV501 diagnostic naming the parameter, rather
     than a bare NamedSharding ValueError or a silent GSPMD reshard."""
-    rules = [(re.compile(k), v) for k, v in (rules or {}).items()]
-    specs = {}
-    for name, p in named_params.items():
-        spec = P()
-        for pat, s in rules:
-            if pat.search(name):
-                spec = s if isinstance(s, P) else P(*s)
-                break
-        specs[name] = spec
+    from ..sharding import ShardingPlan
+
+    plan = ShardingPlan(rules or {}, unmatched="replicate",
+                        fallback=False)
+    specs = {name: plan.spec_for(name, getattr(p, "shape", None) or (),
+                                 mesh)
+             for name, p in named_params.items()}
     from ..analysis import verify_mode, verify_shardings
 
     if verify_mode() != "off":

@@ -210,6 +210,20 @@ def graph_opt_counters():
         return {}
 
 
+def sharding_counters():
+    """Rule-based SPMD sharding counters (plans built, rules matched/
+    unmatched, divisibility fallbacks, fused-step groups compiled under
+    a plan, ZeRO-1 groups, sharded serving sessions, sharded-checkpoint
+    shard files/saves/restores/reshards), live from mxnet_tpu.sharding.
+    Zeros before the first plan scope (MXNET_SHARDING gated)."""
+    try:
+        from .sharding import sharding_counters as _sc
+
+        return _sc()
+    except Exception:
+        return {}
+
+
 def _record(domain, name, start_us, dur_us, cat="event", value=None,
             cached=None):
     with _lock:
@@ -286,6 +300,12 @@ def dump(finished=True, profile_process="worker"):
     for cname, cval in sorted(resilience_counters().items()):
         payload["traceEvents"].append(
             {"name": f"resilience/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0,
+             "args": {cname: float(cval) if isinstance(cval, float)
+                      else cval}})
+    for cname, cval in sorted(sharding_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"sharding/{cname}", "cat": "counter",
              "ph": "C", "ts": ts, "pid": 0,
              "args": {cname: float(cval) if isinstance(cval, float)
                       else cval}})

@@ -41,6 +41,21 @@ writer failure surfaces on the next ``save``/``wait``.
 
 Retention: ``keep`` newest checkpoints are kept (``MXNET_CKPT_KEEP``,
 default 3); older ones are pruned after each successful write.
+
+**Sharded checkpoints (round 15).** When training runs under a
+``sharding.plan_scope``, parameter and optimizer-state buffers live
+sharded across the mesh. Saving gathers nothing: each non-replicated
+device buffer becomes a placeholder in the main payload, and every
+device's local shards land in a per-device ``shard-NNN.pkl`` file
+(its own ``checkpoint_shard_write`` fault seam, same hash-manifested
+atomic-rename discipline). The manifest's ``sharding`` section records
+the mesh axes/shape and per-entry partition specs. Restore is
+**mesh-shape agnostic**: the saved global index slices reassemble the
+full host array regardless of the writer's mesh, so a checkpoint saved
+on a 1x4 mesh restores onto 2x2, a single device, or any other shape
+(``ckpt_reshards`` counts restores whose active mesh differs from the
+writer's); under an active plan scope the restored buffers are placed
+straight back at the plan's layouts.
 """
 from __future__ import annotations
 
@@ -61,6 +76,9 @@ __all__ = ["CheckpointManager"]
 FORMAT_VERSION = 1
 _PAYLOAD = "state.pkl"
 _MANIFEST = "manifest.json"
+#: placeholder marker substituted for mesh-sharded buffers in the main
+#: payload; ``load`` swaps the reassembled full array back in
+_SHARD_REF = "__mxnet_shard_ref__"
 
 
 def _log():
@@ -450,31 +468,115 @@ class CheckpointManager:
             raise MXNetError(
                 f"background checkpoint write failed: {err}") from err
 
+    @staticmethod
+    def _extract_shards(snap):
+        """Pull mesh-sharded buffers out of the snapshot tree.
+
+        Returns ``(snap, shard_meta, shard_blobs)``: the tree with each
+        non-replicated multi-device array replaced by a
+        ``(_SHARD_REF, idx)`` placeholder, the manifest ``sharding``
+        section, and ``{device_ordinal: [(idx, slices, np_shard), ...]}``
+        — every device's LOCAL shards plus their global index slices,
+        so restore reassembles the full array on ANY mesh shape.
+        Replicated and single-device buffers stay in the main payload
+        (no point writing N identical copies). ``(snap, None, {})``
+        when nothing is sharded."""
+        import numpy as onp
+
+        entries, blobs, mesh_info = [], {}, [None]
+
+        def sharded(x):
+            if not _is_device_array(x):
+                return False
+            sh = getattr(x, "sharding", None)
+            try:
+                return (sh is not None and len(x.devices()) > 1
+                        and not sh.is_fully_replicated)
+            except Exception:  # noqa: BLE001 — exotic sharding types
+                return False
+
+        def walk(tree):
+            if sharded(tree):
+                idx = len(entries)
+                sh = tree.sharding
+                mesh = getattr(sh, "mesh", None)
+                if mesh_info[0] is None and mesh is not None:
+                    axes = dict(mesh.shape)
+                    mesh_info[0] = {"axes": list(axes),
+                                    "shape": [int(s)
+                                              for s in axes.values()]}
+                entries.append({
+                    "idx": idx, "shape": [int(d) for d in tree.shape],
+                    "dtype": str(tree.dtype),
+                    "spec": repr(getattr(sh, "spec", None))})
+                devs = sorted(d.id for d in tree.devices())
+                ordinal = {d: i for i, d in enumerate(devs)}
+                for s in tree.addressable_shards:
+                    slices = [
+                        [0 if sl.start is None else int(sl.start),
+                         int(dim) if sl.stop is None else int(sl.stop)]
+                        for sl, dim in zip(s.index, tree.shape)]
+                    blobs.setdefault(ordinal[s.device.id], []).append(
+                        (idx, slices, onp.asarray(s.data)))
+                return (_SHARD_REF, idx)
+            if isinstance(tree, tuple):
+                return tuple(walk(v) for v in tree)
+            if isinstance(tree, list):
+                return [walk(v) for v in tree]
+            if isinstance(tree, dict):
+                return {k: walk(v) for k, v in tree.items()}
+            return tree
+
+        snap = walk(snap)
+        if not entries:
+            return snap, None, {}
+        meta = {"mesh": mesh_info[0], "entries": entries,
+                "shard_files": [f"shard-{di:03d}.pkl"
+                                for di in sorted(blobs)]}
+        return snap, meta, blobs
+
     def _write(self, snap):
         from . import _count
         from . import faults as _faults
+        from .. import sharding as _sharding
 
         t0 = time.perf_counter()
         _faults.maybe_fail("checkpoint_write")
         step = snap["step"]
+        shard_meta, shard_blobs = None, {}
+        if _sharding.sharding_enabled():
+            snap, shard_meta, shard_blobs = self._extract_shards(snap)
         content = pickle.dumps(_to_host(snap),
                                protocol=pickle.HIGHEST_PROTOCOL)
         salt = _salt()
+        files = {_PAYLOAD: content}
+        for di in sorted(shard_blobs):
+            files[f"shard-{di:03d}.pkl"] = pickle.dumps(
+                shard_blobs[di], protocol=pickle.HIGHEST_PROTOCOL)
         manifest = {
             "format": FORMAT_VERSION, "salt": salt, "step": step,
             "cursor": snap["cursor"],
-            "files": {_PAYLOAD: {"sha256": _hash(content, salt),
-                                 "bytes": len(content)}}}
+            "files": {name: {"sha256": _hash(blob, salt),
+                             "bytes": len(blob)}
+                      for name, blob in files.items()}}
+        if shard_meta is not None:
+            manifest["sharding"] = shard_meta
         final = self._dir_for(step)
         tmp = os.path.join(
             self.directory,
             f".tmp-ckpt-{step}-{os.getpid()}-{threading.get_ident()}")
         os.makedirs(tmp)
         try:
-            with open(os.path.join(tmp, _PAYLOAD), "wb") as f:
-                f.write(content)
-                f.flush()
-                os.fsync(f.fileno())
+            for name, blob in files.items():
+                if name != _PAYLOAD:
+                    # registered fault point: one per-device shard file
+                    # of a sharded checkpoint — a fire leaves only the
+                    # .tmp-* dir, never a torn visible checkpoint
+                    _faults.maybe_fail("checkpoint_shard_write")
+                with open(os.path.join(tmp, name), "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
                 f.flush()
@@ -486,8 +588,11 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         _count("ckpt_saves")
-        _count("ckpt_bytes", len(content))
+        _count("ckpt_bytes", sum(len(b) for b in files.values()))
         _count("ckpt_write_s", time.perf_counter() - t0)
+        if shard_meta is not None:
+            _sharding._count("ckpt_sharded_saves")
+            _sharding._count("ckpt_shard_files", len(files) - 1)
         self._prune()
 
     def _prune(self):
@@ -504,7 +609,9 @@ class CheckpointManager:
 
     def load(self, step=None):
         """The raw payload dict of a checkpoint (the latest valid one
-        by default). Raises when none validates."""
+        by default). Raises when none validates. A sharded checkpoint
+        is reassembled to full host arrays here — regardless of the
+        mesh (or absence of one) in THIS process."""
         if step is None:
             step = self.latest_valid()
             if step is None:
@@ -514,9 +621,59 @@ class CheckpointManager:
             raise MXNetError(
                 f"checkpoint {self._dir_for(step)!r} is missing or "
                 "corrupt")
-        with open(os.path.join(self._dir_for(step), _PAYLOAD),
-                  "rb") as f:
-            return pickle.load(f)
+        d = self._dir_for(step)
+        with open(os.path.join(d, _PAYLOAD), "rb") as f:
+            payload = pickle.load(f)
+        with open(os.path.join(d, _MANIFEST)) as f:
+            shard_meta = json.load(f).get("sharding")
+        if shard_meta is not None:
+            payload = self._reassemble(d, payload, shard_meta)
+        return payload
+
+    @staticmethod
+    def _reassemble(d, payload, meta):
+        """Stitch per-device shard files back into full host arrays and
+        substitute them for the payload's placeholders. The saved
+        global index slices make this mesh-shape agnostic — the
+        resharding-on-load half of the sharded-checkpoint contract
+        (place back per plan happens in ``restore``)."""
+        import numpy as onp
+
+        from .. import sharding as _sharding
+
+        full = {e["idx"]: onp.zeros(tuple(e["shape"]),
+                                    dtype=e["dtype"])
+                for e in meta["entries"]}
+        for fname in meta["shard_files"]:
+            with open(os.path.join(d, fname), "rb") as f:
+                for idx, slices, arr in pickle.load(f):
+                    full[idx][tuple(slice(a, b)
+                                    for a, b in slices)] = arr
+
+        def walk(tree):
+            if isinstance(tree, tuple):
+                if len(tree) == 2 and tree[0] == _SHARD_REF:
+                    return full[tree[1]]
+                return tuple(walk(v) for v in tree)
+            if isinstance(tree, list):
+                return [walk(v) for v in tree]
+            if isinstance(tree, dict):
+                return {k: walk(v) for k, v in tree.items()}
+            return tree
+
+        payload = walk(payload)
+        _sharding._count("ckpt_sharded_restores")
+        ctx = _sharding.current_plan()
+        cur = None
+        if ctx is not None:
+            axes = dict(ctx[1].shape)
+            cur = {"axes": list(axes),
+                   "shape": [int(s) for s in axes.values()]}
+        if cur != meta.get("mesh"):
+            # restoring onto a different mesh shape (or none at all):
+            # the writer's layout no longer exists — count the reshape
+            _sharding._count("ckpt_reshards")
+        return payload
 
     def restore(self, step=None):
         """Restore the latest valid (or given) checkpoint into the
@@ -541,9 +698,33 @@ class CheckpointManager:
             _mxrandom._STATE.key = jnp.asarray(payload["prng"]["key"])
         if payload.get("kvstore") is not None and self.kvstore is not None:
             self._restore_kvstore(self.kvstore, payload["kvstore"])
+        self._replace_per_plan()
         _count("ckpt_restores")
         return {"step": payload["step"], "cursor": payload["cursor"],
                 "extra": payload.get("extra")}
+
+    def _replace_per_plan(self):
+        """Under an active ``sharding.plan_scope``, put the restored
+        (host-reassembled, single-device) parameter buffers straight
+        back at the plan's layouts — the other half of
+        resharding-on-load. Optimizer state re-places itself on the
+        next fused step (``FusedShardCfg.place_args``); without a plan
+        scope this is a no-op and buffers stay where ``nd.array`` put
+        them."""
+        from .. import sharding as _sharding
+
+        ctx = _sharding.current_plan()
+        if ctx is None:
+            return
+        params = self._params
+        if params is None and self.trainer is not None:
+            params = self.trainer._params
+        if params is None:
+            return
+        _sharding.place_params(
+            [(p.name, p) for p in params
+             if getattr(p, "_ndarray", None) is not None],
+            plan=ctx[0], mesh=ctx[1])
 
     def _restore_params(self, saved):
         params = self._params

@@ -27,6 +27,10 @@ Registered fault points (the catalogue; ``FAULT_POINTS``):
                           engine (engine.py)
 ``checkpoint_write``      serializing/writing a checkpoint payload
                           (resilience/checkpoint.py)
+``checkpoint_shard_write``  one per-device shard file write of a
+                          plan-sharded checkpoint
+                          (resilience/checkpoint.py) — a fire mid-way
+                          leaves only the ``.tmp-*`` dir (atomicity)
 ``serving_admission``     the admission-control decision at submit()
                           (serving/admission.py) — a fire forces the
                           shed path for sheddable SLO classes
@@ -96,6 +100,8 @@ FAULT_POINTS = {
     "compile_cache_io": "persistent compile-cache disk load/store",
     "engine_push": "dependency-engine host-task push",
     "checkpoint_write": "checkpoint payload serialize/write",
+    "checkpoint_shard_write": "per-device shard file write of a "
+                              "plan-sharded checkpoint",
     "serving_admission": "admission-control decision (forces the shed "
                          "path for sheddable classes)",
     "model_swap": "ModelRepository atomic version activation "

@@ -95,6 +95,14 @@ def _detect():
         feats["GRAPH_OPT"] = graph_opt_enabled()
     except Exception:
         feats["GRAPH_OPT"] = False
+    try:
+        from .sharding import sharding_enabled
+
+        # rule-based SPMD sharding plans armed (MXNET_SHARDING,
+        # sharding/)
+        feats["SHARDING"] = sharding_enabled()
+    except Exception:
+        feats["SHARDING"] = False
     feats["DIST_KVSTORE"] = True  # jax.distributed collectives
     feats["INT64_TENSOR_SIZE"] = True
     feats["SIGNAL_HANDLER"] = True

@@ -180,6 +180,7 @@ class InferenceSession:
         self._param_vals = [p._ndarray._data for p in self._param_list]
         self._graph_sig = self._graph_signature()
         self._jitted_by_ver = {}
+        self._shard = None  # set by shard_params(): tensor-parallel mode
         if warm:
             self.warmup()
 
@@ -372,6 +373,10 @@ class InferenceSession:
         opt_salt = (graph_opt.fingerprint_salt()
                     if isinstance(self._block, SymbolBlock)
                     else ("graph_opt", 0))
+        # a plan-sharded session lowers a DIFFERENT program (GSPMD
+        # collectives baked in): salt with the plan + mesh identity
+        shard_salt = (self._shard["salt"] if self._shard is not None
+                      else ("sharding", 0))
         key = ("serving", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -379,7 +384,7 @@ class InferenceSession:
                   for v in self._param_vals),
             tuple((s.name, (bucket,) + s.row_shape, str(s.dtype))
                   for s in self._input_specs),
-            amp_ver, bucket, opt_salt)
+            amp_ver, bucket, opt_salt, shard_salt)
         code_of = [type(self)._pure, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
         return cc.fingerprint("serving", key, code_of=tuple(code_of))
@@ -392,10 +397,21 @@ class InferenceSession:
         # advance the ambient eager stream (PRNG neutrality, cf. the
         # round-9 Trainer.warmup contract)
         key = jax.random.PRNGKey(0)
-        param_avals = [sds(v.shape, v.dtype) for v in self._param_vals]
-        key_aval = sds(key.shape, key.dtype)
-        input_avals = [sds((bucket,) + s.row_shape, s.dtype)
-                       for s in self._input_specs]
+        if self._shard is not None:
+            rep = self._shard["rep"]
+            param_avals = [sds(v.shape, v.dtype, sharding=sh)
+                           for v, sh in zip(self._param_vals,
+                                            self._shard["shardings"])]
+            key_aval = sds(key.shape, key.dtype, sharding=rep)
+            input_avals = [sds((bucket,) + s.row_shape, s.dtype,
+                               sharding=rep)
+                           for s in self._input_specs]
+        else:
+            param_avals = [sds(v.shape, v.dtype)
+                           for v in self._param_vals]
+            key_aval = sds(key.shape, key.dtype)
+            input_avals = [sds((bucket,) + s.row_shape, s.dtype)
+                           for s in self._input_specs]
         return param_avals, key_aval, input_avals
 
     def _entry(self, bucket):
@@ -464,10 +480,69 @@ class InferenceSession:
 
     def refresh_params(self):
         """Re-snapshot parameter values from the block (after a live
-        weight update). Executables are shape-keyed, so no recompile."""
+        weight update). Executables are shape-keyed, so no recompile;
+        a sharded session re-places the fresh snapshot at the plan's
+        layouts (identity when the trainer already keeps them there)."""
         with self._lock:
             self._param_vals = [p._ndarray._data
                                 for p in self._param_list]
+            if self._shard is not None:
+                self._param_vals = self._place_param_vals(
+                    self._param_vals)
+
+    # -- tensor-parallel serving --------------------------------------
+
+    def _place_param_vals(self, vals):
+        import jax
+
+        return [v if getattr(v, "sharding", None) == sh
+                else jax.device_put(v, sh)
+                for v, sh in zip(vals, self._shard["shardings"])]
+
+    def shard_params(self, plan=None, mesh=None):
+        """Place the parameter snapshot per a :class:`ShardingPlan` and
+        serve tensor-parallel: every bucket executable is (re)compiled
+        with the plan's in-shardings, so a model bigger than one device
+        serves from ONE sharded AOT program (GSPMD inserts the
+        collectives). Defaults to the scoped ``sharding.plan_scope``
+        pair. The AOT disk fingerprint is salted with the plan + mesh,
+        so sharded and unsharded artifacts never collide; request
+        inputs are replicated onto the mesh at upload, so callers keep
+        passing plain host arrays. Returns ``self``."""
+        from .. import sharding as _sharding
+
+        if plan is None or mesh is None:
+            ctx = _sharding.current_plan()
+            if ctx is None:
+                raise MXNetError(
+                    "shard_params needs a plan: pass plan=/mesh= or "
+                    "call inside sharding.plan_scope")
+            plan = plan if plan is not None else ctx[0]
+            mesh = mesh if mesh is not None else ctx[1]
+        shardings = [
+            _sharding.named_sharding(
+                mesh, plan.spec_for(name, tuple(v.shape), mesh))
+            for name, v in zip(self._param_names, self._param_vals)]
+        with self._lock:
+            self._shard = {
+                "mesh": mesh,
+                "shardings": shardings,
+                "rep": _sharding.replicated(mesh),
+                "salt": plan.fingerprint_salt(mesh),
+            }
+            self._param_vals = self._place_param_vals(self._param_vals)
+            # compiled-at-old-layout executables (and their demotions)
+            # are stale: drop them; the salted fingerprint resolves
+            # fresh sharded ones on the next warmup()/request
+            self._entries.clear()
+            self._demoted.clear()
+        _sharding._count("serving_sharded_sessions")
+        return self
+
+    @property
+    def sharded(self):
+        """True when the session serves from a plan-sharded snapshot."""
+        return self._shard is not None
 
     def validate(self, *inputs):
         """Check request inputs against the session's input specs;
@@ -619,6 +694,15 @@ class InferenceSession:
                         a = padded
                     datas.append(nd.array(a).data)
             key = mxrandom.next_key()
+            if self._shard is not None:
+                # inputs ride the mesh replicated (eager arrays commit
+                # to one device; the sharded executable wants the full
+                # device set) — params are already placed
+                import jax
+
+                rep = self._shard["rep"]
+                datas = [jax.device_put(d, rep) for d in datas]
+                key = jax.device_put(key, rep)
             # registered fault point: one bucket execution on the
             # serving request path
             _faults.maybe_fail("serving_execute")

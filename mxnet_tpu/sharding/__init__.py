@@ -1,0 +1,103 @@
+"""Rule-based SPMD sharding: declare a partition plan ONCE, every
+subsystem honors it.
+
+The reference framework spreads placement decisions across kvstore
+types, ``__ctx_group__`` attributes and executor group construction;
+the TPU build replaces all of that with ONE declarative object — a
+:class:`ShardingPlan` of ``(regex, PartitionSpec)`` rules matched
+against parameter names (the ``match_partition_rules`` idiom from the
+EasyLM/t5x lineage) and resolved against a ``parallel.mesh`` Mesh.
+A plan installed with :func:`plan_scope` flows into:
+
+- the fused train step (``gluon/fused_step.py``): parameter, gradient
+  and optimizer-state buffers are laid out per plan and the ONE
+  donated executable is compiled with matching in/out shardings —
+  with opt-in ZeRO-1 cross-replica weight-update sharding
+  (``MXNET_SHARDING_ZERO1``, after "Automatic Cross-Replica Sharding
+  of Weight Update in Data-Parallel Training": optimizer state lives
+  1/N-per-device and GSPMD inserts the update-side collectives);
+- serving (``serving/session.py``): ``InferenceSession.shard_params``
+  places the parameter snapshot per plan for tensor-parallel
+  inference, and the AOT fingerprint is salted with the plan so
+  sharded and unsharded executables never collide;
+- checkpoints (``resilience/checkpoint.py``): mesh-sharded buffers
+  are saved per-shard with a sharding manifest and reassembled on
+  restore — onto a DIFFERENT mesh shape if the restoring process has
+  one (resharding-on-load).
+
+``parallel.spmd.shard_params`` is a thin shim over the same matcher.
+Plan-vs-mesh static validation lives in ``analysis.sharding``
+(``verify_plan``); counters surface via ``profiler.sharding_counters``
+and the ``SHARDING`` runtime feature flag.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ShardingPlan", "plan_scope", "current_plan", "sharding_enabled",
+           "zero1_enabled", "sharding_counters", "reset_sharding_counters",
+           "replicated", "named_sharding", "plan_from_env",
+           "place_params", "fused_shard_cfg"]
+
+
+def sharding_enabled():
+    """MXNET_SHARDING knob (default on); 0 disables every plan-driven
+    path (plan scopes become inert). Read per use so tests can toggle
+    without reimport."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_SHARDING", True)
+
+
+def zero1_enabled():
+    """MXNET_SHARDING_ZERO1 — OPT-IN (default 0) ZeRO-1 cross-replica
+    weight-update sharding: optimizer state shards its leading dim over
+    the mesh (1/N bytes per device) and GSPMD all-gathers the updated
+    weights, instead of every device carrying and updating a full
+    replica."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_SHARDING_ZERO1", False)
+
+
+_LOCK = threading.Lock()
+
+
+def _zero_counters():
+    return {"plans_built": 0, "rules_matched": 0, "rules_unmatched": 0,
+            "divisibility_fallbacks": 0, "fused_sharded_groups": 0,
+            "zero1_groups": 0, "serving_sharded_sessions": 0,
+            "ckpt_shard_files": 0, "ckpt_sharded_saves": 0,
+            "ckpt_sharded_restores": 0, "ckpt_reshards": 0}
+
+
+_COUNTERS = _zero_counters()
+
+
+def _count(name, delta=1):
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+
+
+def sharding_counters():
+    """Plan/consumer counters (zeros before first use):
+    rule matching (``rules_matched``/``rules_unmatched``/
+    ``divisibility_fallbacks``), fused-step groups compiled under a plan
+    (``fused_sharded_groups``/``zero1_groups``), serving sessions with
+    sharded snapshots, and sharded-checkpoint traffic
+    (``ckpt_shard_files``/``ckpt_reshards``/...)."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+    out["enabled"] = sharding_enabled()
+    return out
+
+
+def reset_sharding_counters():
+    global _COUNTERS
+    with _LOCK:
+        _COUNTERS = _zero_counters()
+
+
+from .plan import (ShardingPlan, plan_scope, current_plan,  # noqa: E402
+                   replicated, named_sharding, place_params, plan_from_env)
+from .zero1 import fused_shard_cfg  # noqa: E402

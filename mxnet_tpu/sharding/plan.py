@@ -1,0 +1,328 @@
+"""ShardingPlan: regex partition rules -> PartitionSpecs vs a mesh.
+
+The rule shape follows the proven ``match_partition_rules`` idiom:
+ordered ``(regex, spec)`` pairs, first ``re.search`` hit wins, scalars
+and size-1 leaves replicate unconditionally. On top of that the plan
+adds what a production trainer needs:
+
+- a **divisibility fallback**: a matched dim whose size the mesh extent
+  doesn't divide (or a spec entry naming an axis the mesh lacks) falls
+  back to replicating THAT dim instead of erroring mid-train — each
+  fallback ticks ``sharding_counters()['divisibility_fallbacks']`` and
+  ``analysis.verify_plan`` reports the static mismatch;
+- an ``unmatched='replicate' | 'error'`` policy for names no rule
+  covers;
+- a process-stable ``fingerprint_salt`` so compile caches (fused step,
+  serving AOT) key sharded executables separately per plan;
+- a scope stack (``plan_scope`` / ``current_plan``) mirroring
+  ``parallel.mesh.mesh_scope`` that consumers read.
+"""
+from __future__ import annotations
+
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from . import _count
+
+__all__ = ["ShardingPlan", "plan_scope", "current_plan", "replicated",
+           "named_sharding", "plan_from_env"]
+
+
+def _normalize_entry(entry):
+    """One PartitionSpec position -> None | (axis names...)."""
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        return tuple(str(a) for a in entry)
+    return (str(entry),)
+
+
+def _normalize_spec(spec):
+    """PartitionSpec | iterable of entries -> tuple of normalized
+    entries (the plan's canonical, hashable spec form)."""
+    if isinstance(spec, PartitionSpec):
+        spec = tuple(spec)
+    elif spec is None:
+        spec = ()
+    elif isinstance(spec, str):
+        spec = (spec,)
+    return tuple(_normalize_entry(e) for e in tuple(spec))
+
+
+def _to_pspec(entries):
+    return PartitionSpec(*[None if e is None else
+                           (e[0] if len(e) == 1 else e)
+                           for e in entries])
+
+
+def replicated(mesh):
+    """The fully-replicated NamedSharding on ``mesh`` — the blessed
+    constructor consumers outside ``sharding/``/``parallel/`` use
+    instead of raw ``NamedSharding(mesh, PartitionSpec())`` (graft_lint
+    L701)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def named_sharding(mesh, spec):
+    """NamedSharding from a plan-canonical entry tuple, a PartitionSpec,
+    or anything ``_normalize_spec`` accepts."""
+    return NamedSharding(mesh, _to_pspec(_normalize_spec(spec)))
+
+
+class ShardingPlan:
+    """Ordered ``(regex, spec)`` partition rules over named arrays.
+
+    ``spec`` per rule is a ``PartitionSpec``, or a tuple of per-dim
+    entries (``None`` | axis name | tuple of axis names). ``unmatched``
+    decides names no rule covers: ``'replicate'`` (default) or
+    ``'error'``. ``fallback=False`` disables the per-dim divisibility
+    fallback and the scalar shortcut — specs then apply verbatim (the
+    legacy ``parallel.spmd.shard_params`` contract, where validation is
+    the caller's job).
+    """
+
+    def __init__(self, rules, unmatched="replicate", fallback=True):
+        if unmatched not in ("replicate", "error"):
+            raise ValueError(
+                f"unmatched must be 'replicate' or 'error', got "
+                f"{unmatched!r}")
+        if hasattr(rules, "items"):
+            rules = list(rules.items())
+        self._rules = tuple(
+            (str(pat), re.compile(str(pat)), _normalize_spec(spec))
+            for pat, spec in rules)
+        self.unmatched = unmatched
+        self.fallback = bool(fallback)
+        self._salts = {}
+        _count("plans_built")
+
+    @property
+    def rules(self):
+        """Canonical ``(pattern, spec entries)`` pairs, in match order."""
+        return tuple((pat, spec) for pat, _, spec in self._rules)
+
+    def match(self, name):
+        """The first rule matching ``name`` -> (pattern, spec entries),
+        or None."""
+        for pat, rx, spec in self._rules:
+            if rx.search(name):
+                return pat, spec
+        return None
+
+    def _raw_spec(self, name, shape):
+        """Pre-fallback resolution: the matched rule's entries padded /
+        truncated to the array's rank, or the unmatched policy."""
+        hit = self.match(name)
+        if hit is None:
+            if self.unmatched == "error":
+                raise ValueError(
+                    f"no sharding rule matches '{name}' and the plan's "
+                    f"unmatched policy is 'error' (patterns: "
+                    f"{[p for p, _ in self.rules]})")
+            _count("rules_unmatched")
+            return ()
+        _count("rules_matched")
+        return hit[1]
+
+    def spec_for(self, name, shape, mesh):
+        """The PartitionSpec for one named array, divisibility fallback
+        applied (unless ``fallback=False``)."""
+        shape = tuple(shape)
+        raw = self._raw_spec(name, shape)
+        if not self.fallback:
+            return _to_pspec(raw)
+        if len(shape) == 0 or all(d <= 1 for d in shape):
+            return PartitionSpec()  # scalars / size-1 leaves replicate
+        axis_sizes = dict(mesh.shape)
+        entries = []
+        for dim, axes in enumerate(raw):
+            if dim >= len(shape):
+                break  # spec longer than rank: extra entries dropped
+            if axes is None:
+                entries.append(None)
+                continue
+            extent = 1
+            known = all(a in axis_sizes for a in axes)
+            if known:
+                for a in axes:
+                    extent *= axis_sizes[a]
+            if not known or extent <= 0 or shape[dim] % extent != 0:
+                _count("divisibility_fallbacks")
+                entries.append(None)  # replicate just this dim
+                continue
+            entries.append(axes)
+        return _to_pspec(entries)
+
+    def specs(self, named_shapes, mesh):
+        """{name: PartitionSpec} for a {name: shape} tree."""
+        return {name: self.spec_for(name, shape, mesh)
+                for name, shape in named_shapes.items()}
+
+    def shardings(self, named_shapes, mesh=None):
+        """{name: NamedSharding} resolved against ``mesh`` (default:
+        the scoped/current mesh). Final specs are re-checked through
+        ``analysis.verify_shardings`` under MXNET_GRAPH_VERIFY — with
+        the fallback on they are clean by construction, so this is the
+        safety net for ``fallback=False`` plans."""
+        from ..parallel.mesh import current_mesh
+
+        mesh = mesh if mesh is not None else current_mesh()
+        if mesh is None:
+            raise ValueError(
+                "ShardingPlan.shardings needs a mesh (pass one, or "
+                "enter parallel.mesh_scope / sharding.plan_scope)")
+        specs = self.specs(named_shapes, mesh)
+        from ..analysis import verify_mode, verify_shardings
+
+        if verify_mode() != "off":
+            verify_shardings(
+                {n: tuple(s) for n, s in named_shapes.items()},
+                specs, mesh=mesh,
+                subject="sharding plan").disposition()
+        return {name: NamedSharding(mesh, spec)
+                for name, spec in specs.items()}
+
+    def fingerprint_salt(self, mesh=None):
+        """Process-stable tuple identifying (plan, mesh layout) for
+        compile-cache keys — the serving fingerprint and the fused-step
+        LRU key both append this so plan or mesh-shape changes miss
+        instead of serving a stale layout."""
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = tuple(
+                (str(a), int(s)) for a, s in dict(mesh.shape).items())
+        cached = self._salts.get(mesh_key)
+        if cached is None:
+            cached = ("sharding_plan", self.rules, self.unmatched,
+                      self.fallback, mesh_key)
+            self._salts[mesh_key] = cached
+        return cached
+
+
+# -- rules grammar (MXNET_SHARDING_RULES) -----------------------------------
+#
+#   rule  ; rule ; ...          rules are ';'-separated, matched in order
+#   rule  := pattern = entries  pattern is a Python regex (no '=' or ';')
+#   entries := entry , entry    one entry per array dim, ',' separated
+#   entry := *                  replicate this dim
+#          | axis               shard over one mesh axis
+#          | axis+axis          shard over multiple axes (row-major)
+#
+# e.g. MXNET_SHARDING_RULES='.*dense.*weight=mp,*; .*=*'
+
+def parse_rules(text):
+    """The MXNET_SHARDING_RULES grammar -> canonical rule pairs."""
+    rules = []
+    for clause in text.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(
+                f"bad sharding rule {clause!r}: expected "
+                "'pattern=entry,entry,...'")
+        pat, _, entries = clause.partition("=")
+        spec = []
+        for entry in entries.split(","):
+            entry = entry.strip()
+            if entry in ("*", ""):
+                spec.append(None)
+            elif "+" in entry:
+                spec.append(tuple(a.strip() for a in entry.split("+")))
+            else:
+                spec.append(entry)
+        rules.append((pat.strip(), tuple(spec)))
+    return rules
+
+
+def plan_from_env():
+    """The plan MXNET_SHARDING_RULES declares (None when unset/empty);
+    MXNET_SHARDING_UNMATCHED picks the unmatched policy."""
+    from .. import env as _env
+
+    text = _env.get_str("MXNET_SHARDING_RULES", "")
+    if not text.strip():
+        return None
+    return ShardingPlan(
+        parse_rules(text),
+        unmatched=_env.get_str("MXNET_SHARDING_UNMATCHED", "replicate"))
+
+
+# -- scope ------------------------------------------------------------------
+
+_CURRENT = []
+
+
+class plan_scope:
+    """Install (plan, mesh) as the active sharding declaration; the
+    fused step, serving and the CheckpointManager read it via
+    ``current_plan``. Mirrors ``parallel.mesh.mesh_scope`` (and nests
+    the same way); does NOT enter a mesh_scope itself — the plan's mesh
+    binding is explicit."""
+
+    def __init__(self, plan, mesh=None):
+        from ..parallel.mesh import current_mesh
+
+        if mesh is None:
+            mesh = current_mesh()
+        if mesh is None:
+            raise ValueError("plan_scope needs a mesh (pass one or "
+                             "enter parallel.mesh_scope first)")
+        self._pair = (plan, mesh)
+
+    def __enter__(self):
+        _CURRENT.append(self._pair)
+        return self._pair
+
+    def __exit__(self, *exc):
+        _CURRENT.pop()
+
+
+def current_plan():
+    """The innermost active (plan, mesh) pair, or None. Inert (None)
+    while MXNET_SHARDING=0 so one knob disables every consumer."""
+    from . import sharding_enabled
+
+    if not _CURRENT or not sharding_enabled():
+        return None
+    return _CURRENT[-1]
+
+
+def place_params(params, plan=None, mesh=None):
+    """Move initialized parameter buffers (and their grads) to the
+    plan's layouts — the entry ritual of a plan scope.
+
+    Eager JAX refuses to mix arrays committed to different device sets,
+    so once anything rides the mesh *everything* in the model must:
+    call this right after entering ``plan_scope`` (params still on one
+    device) and place each batch with ``parallel.replicate`` /
+    ``parallel.shard_batch``. Buffers already at their declared layout
+    pass through untouched, so calling it again (e.g. after a
+    checkpoint restore re-binds single-device buffers) is cheap.
+
+    ``params`` is a ParameterDict or iterable of (name, Parameter);
+    uninitialized (deferred) parameters are skipped — run one forward
+    first or pass explicit in-shapes. Defaults to the scoped plan/mesh.
+    """
+    import jax
+
+    if plan is None or mesh is None:
+        ctx = current_plan()
+        if ctx is None:
+            raise ValueError("place_params needs a plan: pass one or "
+                             "call inside sharding.plan_scope")
+        plan = plan if plan is not None else ctx[0]
+        mesh = mesh if mesh is not None else ctx[1]
+    items = params.items() if hasattr(params, "items") else params
+    for name, p in items:
+        nd_obj = getattr(p, "_ndarray", None)
+        if nd_obj is None:
+            continue  # deferred init: first forward will create it
+        sh = named_sharding(
+            mesh, plan.spec_for(name, tuple(nd_obj.shape), mesh))
+        if getattr(nd_obj._data, "sharding", None) != sh:
+            nd_obj._data = jax.device_put(nd_obj._data, sh)
+        g = getattr(nd_obj, "_grad", None)
+        if g is not None and getattr(g._data, "sharding", None) != sh:
+            g._data = jax.device_put(g._data, sh)

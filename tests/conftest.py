@@ -76,6 +76,36 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(mark)
 
 
+@pytest.fixture
+def forced_device_subprocess():
+    """Run a python snippet in a subprocess with a FORCED virtual
+    device count (1 by default — this session's 8-device forcing is
+    process-wide and cannot be undone in-process). The snippet must
+    print a single JSON document on its last stdout line; the helper
+    returns it parsed. Used by the resharding-on-load tests to restore
+    a mesh-sharded checkpoint into a genuinely single-device process."""
+    import json
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(snippet, num_devices=1, env=None, timeout=600):
+        code = (f"import sys; sys.path.insert(0, {root!r})\n"
+                "from _cpu_platform import force_cpu_platform\n"
+                f"force_cpu_platform(num_devices={num_devices})\n"
+                + snippet)
+        full_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        full_env.update(env or {})
+        out = subprocess.run([sys.executable, "-c", code], cwd=root,
+                             env=full_env, capture_output=True,
+                             text=True, timeout=timeout)
+        assert out.returncode == 0, \
+            f"forced-device child failed:\n{out.stderr[-4000:]}"
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    return run
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import mxnet_tpu as mx

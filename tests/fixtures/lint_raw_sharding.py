@@ -1,0 +1,34 @@
+# graft-lint: scope(sharding-plan)
+"""Seeded graft_lint L701 fixture: raw sharding construction.
+
+NOT part of the framework — tests/test_graft_lint.py lints this file
+and asserts the rule catches every construction form (direct, aliased,
+module-dotted) and honors the pragma'd site. Keep the violation
+inventory in sync with the test.
+"""
+import jax.sharding
+import jax.sharding as js
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def bad_direct(mesh):
+    """Violation: direct NamedSharding construction."""
+    return NamedSharding(mesh, P())  # two violations: both classes
+
+
+def bad_module_dotted(mesh):
+    """Violation: fully-dotted and module-aliased forms."""
+    spec = jax.sharding.PartitionSpec("dp")
+    return js.NamedSharding(mesh, spec)
+
+
+def allowed_site(mesh):
+    """A deliberate pre-plan site, pragma'd — must stay clean."""
+    return NamedSharding(mesh, P("dp"))  # graft-lint: allow(L701)
+
+
+def not_a_construction(arr, other):
+    """Reads and same-named attrs on OTHER modules must stay clean."""
+    spec = arr.sharding.spec  # attribute read, not a call
+    return other.PartitionSpec(spec)  # not jax.sharding's class

@@ -156,6 +156,48 @@ def test_graphopt_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "graph_opt"
 
 
+@pytest.mark.slow
+def test_sharding_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import sharding_bench
+
+    out = str(tmp_path / "shard.json")
+    doc = sharding_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["config"]["devices"] >= 4  # conftest forces 8
+    # correctness gates must hold even at smoke sizes; the efficiency
+    # and speedup gates are timing-dependent and only enforced on the
+    # committed full run (BENCH_SHARD_r15.json)
+    assert doc["gates"]["scaling_parity_ulp"]
+    assert doc["gates"]["zero1_state_1_over_n"]
+    assert doc["gates"]["zero1_parity_ulp"]
+    assert doc["gates"]["serving_bitwise"]
+    assert doc["gates"]["ckpt_reshape_bitwise"]
+    assert doc["gates"]["ckpt_resharded_on_load"]
+    assert doc["checkpoint_reshape"]["shard_files"] == 4
+    assert doc["checkpoint_reshape"]["post_restore_step_ok"]
+    assert doc["fused_scaling"]["update_ms_sharded"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "sharding_r15"
+
+
+def test_bench_compare_sharding_metrics():
+    """BENCH_SHARD_r15.json names: efficiency and the plan-vs-replicated
+    speedup are higher-is-better, update/step ms lower-is-better, the
+    state-bytes ratio untracked (it is a layout fact, not a speed)."""
+    base = {"fused_scaling": {"efficiency": 0.93, "update_ms_sharded":
+                              21.0, "plan_vs_replicated_speedup": 4.8},
+            "zero1": {"state_ratio": 0.25}}
+    worse = {"fused_scaling": {"efficiency": 0.5, "update_ms_sharded":
+                               40.0, "plan_vs_replicated_speedup": 1.1},
+             "zero1": {"state_ratio": 0.25}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["fused_scaling.efficiency"][4]       # scaling collapsed
+    assert rows["fused_scaling.update_ms_sharded"][4]
+    assert rows["fused_scaling.plan_vs_replicated_speedup"][4]
+    assert "zero1.state_ratio" not in rows           # not a direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_graphopt_metrics():
     """BENCH_GRAPHOPT_r14.json names: node counts and trace+compile ms
     are lower-is-better, the speedups higher-is-better, rewrite counts

@@ -20,6 +20,8 @@ CLOCK_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                              "lint_wallclock_deadline.py")
 MUT_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_graph_mutation.py")
+SHARD_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                             "lint_raw_sharding.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -147,6 +149,51 @@ def test_graph_mutation_scope_binds_package_not_passes(tmp_path):
     passes.write_text(src)
     assert graft_lint.lint_paths([str(passes)], repo_root=REPO,
                                  registry=False) == []
+
+
+def test_raw_sharding_fixture_triggers_l701():
+    """L701: every construction form in the seeded fixture is flagged
+    — direct NamedSharding + aliased PartitionSpec on one line, the
+    fully-dotted and module-aliased forms — while the pragma'd site,
+    attribute reads and same-named classes on other modules are not."""
+    findings = graft_lint.lint_paths([SHARD_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l701 = [f for f in findings if f.code == "L701"]
+    assert len(l701) == 4, findings
+    msgs = "\n".join(f.message for f in l701)
+    assert "NamedSharding" in msgs and "PartitionSpec" in msgs
+    src = open(SHARD_FIXTURE).read().splitlines()
+    for f in l701:
+        assert "Sharding" in src[f.line - 1] or \
+            "PartitionSpec" in src[f.line - 1], (f.line, src[f.line - 1])
+    # the allow(L701) site and the non-construction sites stay clean
+    assert all(f.line < 25 for f in l701), l701
+    assert {f.code for f in findings} == {"L701"}, findings
+
+
+def test_raw_sharding_scope_exempts_subsystem(tmp_path):
+    """L701 binds mxnet_tpu/ automatically but exempts the sharding
+    subsystem and parallel/ (which own the primitives); outside the
+    package it is opt-in via scope(sharding-plan)."""
+    src = ("from jax.sharding import NamedSharding, PartitionSpec\n"
+           "def place(mesh):\n"
+           "    return NamedSharding(mesh, PartitionSpec('dp'))\n")
+    free = tmp_path / "place_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "serving" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
+    assert codes == ["L701", "L701"], codes
+    for exempt in ("sharding", "parallel"):
+        own = tmp_path / "mxnet_tpu" / exempt / "frag.py"
+        own.parent.mkdir(parents=True)
+        own.write_text(src)
+        assert graft_lint.lint_paths([str(own)], repo_root=REPO,
+                                     registry=False) == [], exempt
 
 
 def test_l501_swallowed_variants(tmp_path):

@@ -1,0 +1,189 @@
+"""Sharded checkpoints: per-shard files, resharding-on-load, faults.
+
+Save runs on a 1x4 ``mp`` mesh; restores land on a 2x2 mesh (same
+process) and on a genuinely single-device process (the conftest's
+``forced_device_subprocess`` helper) — parameters AND optimizer
+counters must come back bitwise either way.
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, parallel, sharding
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import CheckpointManager, faults
+from mxnet_tpu.sharding import ShardingPlan
+
+DIM, OUT, BATCH, STEPS = 16, 8, 4, 3
+
+
+def _build(seed=51):
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix="net_")
+    net.add(nn.Dense(OUT, prefix="d0_"))
+    net.initialize()
+    net(nd.zeros((1, DIM)))
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.02})
+    return net, trainer
+
+
+def _train(net, trainer, mesh, steps=STEPS, seed=57):
+    rs = onp.random.RandomState(seed)
+    for _ in range(steps):
+        x = parallel.replicate(
+            nd.array(rs.rand(BATCH, DIM).astype("f")), mesh)
+        y = parallel.replicate(
+            nd.array(rs.rand(BATCH, OUT).astype("f")), mesh)
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(BATCH)
+
+
+def _params(net):
+    return {p.name: p.data().asnumpy()
+            for p in net.collect_params().values()}
+
+
+def _plan():
+    return ShardingPlan({r"weight$": ("mp", None)})
+
+
+def _save_sharded(tmp_path, seed=51):
+    """Train + save under the 1x4 plan; returns (params, num_update)."""
+    mesh = parallel.make_mesh({"mp": 4})
+    with sharding.plan_scope(_plan(), mesh):
+        net, trainer = _build(seed)
+        sharding.place_params(net.collect_params())
+        _train(net, trainer, mesh)
+        mgr = CheckpointManager(str(tmp_path), trainer=trainer,
+                                async_mode=False)
+        mgr.save(STEPS)
+    return _params(net), trainer._optimizer.num_update
+
+
+def test_sharded_save_writes_shard_files_and_manifest(tmp_path):
+    sharding.reset_sharding_counters()
+    _save_sharded(tmp_path)
+    c = sharding.sharding_counters()
+    assert c["ckpt_sharded_saves"] == 1
+    assert c["ckpt_shard_files"] == 4
+    step_dir = os.path.join(str(tmp_path), f"ckpt-{STEPS:012d}")
+    names = sorted(os.listdir(step_dir))
+    shards = [n for n in names if n.startswith("shard-")]
+    assert len(shards) == 4
+    with open(os.path.join(step_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    meta = manifest["sharding"]
+    assert meta["mesh"]["axes"] == ["mp"]
+    assert meta["mesh"]["shape"] == [4]
+    assert meta["shard_files"] == shards
+    assert any(e["spec"] for e in meta["entries"])
+    # every shard file is hash-pinned like the payload
+    for n in shards:
+        assert n in manifest["files"]
+
+
+def test_restore_onto_different_mesh_shape(tmp_path):
+    ref, ref_updates = _save_sharded(tmp_path)
+    sharding.reset_sharding_counters()
+    mesh22 = parallel.make_mesh({"dp": 2, "mp": 2})
+    with sharding.plan_scope(_plan(), mesh22):
+        net2, trainer2 = _build(seed=61)
+        sharding.place_params(net2.collect_params())
+        CheckpointManager(str(tmp_path), trainer=trainer2,
+                          async_mode=False).restore()
+        got = _params(net2)
+        assert {k: v.tobytes() for k, v in got.items()} == \
+            {k: v.tobytes() for k, v in ref.items()}
+        assert trainer2._optimizer.num_update == ref_updates
+        # restored buffers landed on the NEW mesh at the plan layout
+        w = net2.collect_params()["d0_weight"]
+        assert tuple(w.data().data.sharding.spec)[0] == "mp"
+        # and the restored state is live: one more step on 2x2
+        _train(net2, trainer2, mesh22, steps=1)
+        assert not trainer2._fused_broken
+    c = sharding.sharding_counters()
+    assert c["ckpt_sharded_restores"] == 1
+    assert c["ckpt_reshards"] == 1
+
+
+def test_restore_into_single_device_process(tmp_path,
+                                            forced_device_subprocess):
+    """A plan-sharded checkpoint restores into a 1-device process with
+    no plan at all — reassembly is mesh-agnostic."""
+    ref, ref_updates = _save_sharded(tmp_path)
+    snippet = f"""
+import json
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import CheckpointManager
+
+mx.random.seed(99)
+net = nn.HybridSequential(prefix="net_")
+net.add(nn.Dense({OUT}, prefix="d0_"))
+net.initialize()
+net(nd.zeros((1, {DIM})))
+trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                           {{"learning_rate": 0.02}})
+CheckpointManager({str(tmp_path)!r}, trainer=trainer,
+                  async_mode=False).restore()
+import jax
+assert jax.device_count() == 1
+print(json.dumps({{
+    "num_update": trainer._optimizer.num_update,
+    "params": {{p.name: p.data().asnumpy().tolist()
+               for p in net.collect_params().values()}},
+}}))
+"""
+    out = forced_device_subprocess(snippet, num_devices=1)
+    assert out["num_update"] == ref_updates
+    for name, vals in out["params"].items():
+        got = onp.asarray(vals, dtype="f")
+        assert got.tobytes() == ref[name].tobytes()
+
+
+def test_unsharded_checkpoints_unchanged(tmp_path):
+    """No plan -> no shard files, manifest has no sharding section."""
+    net, trainer = _build(seed=63)
+    rs = onp.random.RandomState(3)
+    x = nd.array(rs.rand(BATCH, DIM).astype("f"))
+    y = nd.array(rs.rand(BATCH, OUT).astype("f"))
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    trainer.step(BATCH)
+    CheckpointManager(str(tmp_path), trainer=trainer,
+                      async_mode=False).save(1)
+    step_dir = os.path.join(str(tmp_path), "ckpt-" + "0" * 11 + "1")
+    names = os.listdir(step_dir)
+    assert not [n for n in names if n.startswith("shard-")]
+    with open(os.path.join(step_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert "sharding" not in manifest
+
+
+def test_shard_write_fault_keeps_checkpoint_invisible(tmp_path):
+    """A crash mid shard-file write must leave no visible ckpt dir —
+    the atomic tmpdir+rename protocol covers the new files too."""
+    assert "checkpoint_shard_write" in faults.FAULT_POINTS
+    mesh = parallel.make_mesh({"mp": 4})
+    with sharding.plan_scope(_plan(), mesh):
+        net, trainer = _build(seed=67)
+        sharding.place_params(net.collect_params())
+        _train(net, trainer, mesh, steps=1)
+        mgr = CheckpointManager(str(tmp_path), trainer=trainer,
+                                async_mode=False)
+        with faults.inject("checkpoint_shard_write", at=2):
+            with pytest.raises(Exception):
+                mgr.save(1)
+    assert mgr.latest_valid() is None
+    leftovers = [n for n in os.listdir(str(tmp_path))
+                 if not n.startswith(".")]
+    assert leftovers == []

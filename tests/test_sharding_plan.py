@@ -1,0 +1,189 @@
+"""Rule-based ShardingPlan: matching, fallback, env grammar, analysis.
+
+The suite runs on the conftest's forced 8-device host platform, so
+real meshes (and real NamedShardings) are available everywhere.
+"""
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (backend init order)
+from mxnet_tpu import parallel, sharding
+from mxnet_tpu.sharding import ShardingPlan
+from mxnet_tpu.sharding.plan import parse_rules, plan_from_env
+
+
+@pytest.fixture
+def mesh():
+    return parallel.make_mesh({"dp": 2, "mp": 4})
+
+
+def _spec(plan, name, shape, mesh):
+    return tuple(plan.spec_for(name, shape, mesh))
+
+
+def test_first_match_wins(mesh):
+    plan = ShardingPlan([
+        (r"dense0_weight", ("mp", None)),
+        (r"weight$", ("dp", None)),
+    ])
+    assert _spec(plan, "dense0_weight", (8, 4), mesh) == ("mp", None)
+    assert _spec(plan, "dense1_weight", (8, 4), mesh) == ("dp", None)
+
+
+def test_unmatched_replicates_by_default(mesh):
+    plan = ShardingPlan({r"weight$": ("mp", None)})
+    assert _spec(plan, "dense0_bias", (8,), mesh) == ()
+
+
+def test_unmatched_error_policy(mesh):
+    plan = ShardingPlan({r"weight$": ("mp", None)}, unmatched="error")
+    with pytest.raises(ValueError, match="no sharding rule matches"):
+        plan.spec_for("dense0_bias", (8,), mesh)
+    with pytest.raises(ValueError, match="unmatched"):
+        ShardingPlan({}, unmatched="bogus")
+
+
+def test_scalars_replicate_under_fallback(mesh):
+    plan = ShardingPlan({r".*": ("mp",)})
+    assert _spec(plan, "loss_scale", (), mesh) == ()
+    assert _spec(plan, "one", (1,), mesh) == ()
+
+
+def test_divisibility_fallback_per_dim(mesh):
+    sharding.reset_sharding_counters()
+    plan = ShardingPlan({r"w": ("mp", "dp")})
+    # dim0 = 6 is not divisible by mp=4 -> that dim replicates; dim1
+    # stays sharded over dp
+    assert _spec(plan, "w", (6, 4), mesh) == (None, "dp")
+    assert sharding.sharding_counters()["divisibility_fallbacks"] == 1
+
+
+def test_unknown_axis_falls_back(mesh):
+    plan = ShardingPlan({r"w": ("tp", None)})
+    assert _spec(plan, "w", (8, 4), mesh) == (None, None)
+
+
+def test_fallback_false_applies_verbatim(mesh):
+    plan = ShardingPlan({r"w": ("mp", None)}, fallback=False)
+    # 6 % 4 != 0, but verbatim mode hands the spec through untouched
+    assert _spec(plan, "w", (6, 4), mesh) == ("mp", None)
+
+
+def test_spec_longer_than_rank_truncates(mesh):
+    plan = ShardingPlan({r"b": ("mp", "dp", None)})
+    assert _spec(plan, "b", (8,), mesh) == ("mp",)
+
+
+def test_parse_rules_grammar():
+    rules = parse_rules(
+        ".*dense.*weight = mp , * ; bias$ = * ; emb = dp+mp, *")
+    assert rules == [
+        (".*dense.*weight", ("mp", None)),
+        ("bias$", (None,)),
+        ("emb", (("dp", "mp"), None)),
+    ]
+    with pytest.raises(ValueError, match="bad sharding rule"):
+        parse_rules("no-equals-here")
+
+
+def test_plan_from_env(monkeypatch, mesh):
+    monkeypatch.delenv("MXNET_SHARDING_RULES", raising=False)
+    assert plan_from_env() is None
+    monkeypatch.setenv("MXNET_SHARDING_RULES", r"weight$=mp,*")
+    monkeypatch.setenv("MXNET_SHARDING_UNMATCHED", "error")
+    plan = plan_from_env()
+    assert _spec(plan, "d0_weight", (8, 4), mesh) == ("mp", None)
+    assert plan.unmatched == "error"
+
+
+def test_fingerprint_salt_varies_with_mesh_and_rules(mesh):
+    p1 = ShardingPlan({r"weight$": ("mp", None)})
+    p2 = ShardingPlan({r"weight$": ("dp", None)})
+    small = parallel.make_mesh({"mp": 4})
+    assert p1.fingerprint_salt(mesh) != p2.fingerprint_salt(mesh)
+    assert p1.fingerprint_salt(mesh) != p1.fingerprint_salt(small)
+    # process-stable: same inputs, same (cached) tuple
+    assert p1.fingerprint_salt(mesh) is p1.fingerprint_salt(mesh)
+
+
+def test_plan_scope_and_kill_switch(monkeypatch, mesh):
+    plan = ShardingPlan({})
+    assert sharding.current_plan() is None
+    with sharding.plan_scope(plan, mesh) as (p, m):
+        assert (p, m) == (plan, mesh)
+        assert sharding.current_plan() == (plan, mesh)
+        monkeypatch.setenv("MXNET_SHARDING", "0")
+        assert sharding.current_plan() is None  # one knob kills it all
+        monkeypatch.delenv("MXNET_SHARDING")
+    assert sharding.current_plan() is None
+
+
+def test_plan_scope_needs_a_mesh():
+    with pytest.raises(ValueError, match="needs a mesh"):
+        sharding.plan_scope(ShardingPlan({}))
+
+
+def test_shardings_and_named_sharding(mesh):
+    import jax
+
+    plan = ShardingPlan({r"weight$": ("mp", None)})
+    sh = plan.shardings({"d0_weight": (8, 4), "d0_bias": (8,)},
+                        mesh=mesh)
+    assert isinstance(sh["d0_weight"], jax.sharding.NamedSharding)
+    assert tuple(sh["d0_weight"].spec) == ("mp", None)
+    assert tuple(sh["d0_bias"].spec) == ()
+    rep = sharding.replicated(mesh)
+    assert rep.is_fully_replicated
+
+
+def test_spmd_shard_params_shim(mesh):
+    """The legacy parallel.spmd entry point rides the plan matcher but
+    keeps verbatim specs + unmatched-replicate semantics."""
+
+    class _P:
+        def __init__(self, shape):
+            self.shape = shape
+
+    out = parallel.shard_params(
+        {"d0_weight": _P((8, 4)), "d0_bias": _P((8,))},
+        mesh, rules={r"weight$": ("mp", None)})
+    assert tuple(out["d0_weight"].spec) == ("mp", None)
+    assert out["d0_bias"].is_fully_replicated
+
+
+def test_verify_plan_gv_diagnostics(mesh):
+    from mxnet_tpu.analysis import verify_plan
+
+    plan = ShardingPlan([
+        (r"weight$", ("tp", None)),   # axis the mesh doesn't have
+        (r"typo_never_matches", ("mp",)),
+    ])
+    report = verify_plan(plan, {"d0_weight": (8, 4), "d0_bias": (8,)},
+                         mesh)
+    codes = report.codes()
+    assert "GV501" in codes  # bad axis
+    assert "GV503" in codes  # dead rule
+    clean = verify_plan(ShardingPlan({r"weight$": ("mp", None)}),
+                        {"d0_weight": (8, 4)}, mesh)
+    assert not clean
+
+
+def test_counters_roundtrip(mesh):
+    sharding.reset_sharding_counters()
+    plan = ShardingPlan({r"weight$": ("mp", None)})
+    plan.spec_for("d0_weight", (8, 4), mesh)
+    plan.spec_for("d0_bias", (8,), mesh)
+    c = sharding.sharding_counters()
+    assert c["plans_built"] == 1
+    assert c["rules_matched"] == 1
+    assert c["rules_unmatched"] == 1
+    assert c["enabled"] is True
+    from mxnet_tpu import profiler
+
+    assert profiler.sharding_counters() == c
+
+
+def test_runtime_feature_flag():
+    from mxnet_tpu import runtime
+
+    feats = runtime.Features()
+    assert feats.is_enabled("SHARDING")

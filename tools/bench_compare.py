@@ -24,7 +24,11 @@ inferred from the leaf name:
   (serving requests/sec), ``*overlap*`` (BENCH_PIPELINE_r11.json
   overlap_ratio
   — the fraction of the feed window not spent stalled; a drop means
-  the pipeline stopped hiding the host path)
+  the pipeline stopped hiding the host path), ``*efficiency*``
+  (BENCH_SHARD_r15.json scaling-efficiency ratios — the fraction of
+  ideal multi-device speedup the sharded fused step actually
+  delivers; a drop means the plan-driven partitioning stopped
+  scaling)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -44,7 +48,8 @@ LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "p50", "p95", "p99", "epoch_s", "idle", "stall",
                    "overhead", "shed", "nodes", "trace")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
-                    "items_per", "_rps", "overlap", "goodput")
+                    "items_per", "_rps", "overlap", "goodput",
+                    "efficiency")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)

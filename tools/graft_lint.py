@@ -75,6 +75,22 @@ package:
                        ``# graft-lint: allow(L501)`` on the except
                        line so the suppression is explicit and
                        reviewable.
+``L701 raw-sharding``  a ``NamedSharding(...)`` or ``PartitionSpec``
+                       construction inside ``mxnet_tpu/`` but outside
+                       ``mxnet_tpu/sharding/`` and
+                       ``mxnet_tpu/parallel/`` (alias-aware: the
+                       ``from jax.sharding import ... as P`` and
+                       ``import jax.sharding as js`` forms are
+                       tracked too). Placement decisions must flow
+                       from the ShardingPlan rule matcher
+                       (``sharding.named_sharding`` / ``replicated`` /
+                       ``plan.spec_for``) so ONE declaration drives
+                       every consumer; an ad-hoc spec constructed
+                       elsewhere silently diverges from the plan. The
+                       pre-plan sites that legitimately build their
+                       own specs (executor dp-sharding, kvstore
+                       key-sharding, MoE expert placement) carry
+                       ``# graft-lint: allow(L701)``.
 ``jit-nocache``        a raw ``jax.jit`` call site inside ``mxnet_tpu/``
                        that bypasses the compile-cache helpers
                        (``utils.compile_cache.counting_jit`` or the AOT
@@ -524,6 +540,73 @@ def check_graph_mutation(path, tree, source, findings):
                      f"mutating call '.{node.func.attr}()' on")
 
 
+#: jax.sharding classes whose raw construction outside the sharding
+#: subsystem bypasses the plan rule matcher
+_SHARDING_CLASSES = {"NamedSharding", "PartitionSpec"}
+
+
+def _sharding_construction_scoped(path, source):
+    """Files the L701 plan-discipline applies to: all of ``mxnet_tpu/``
+    EXCEPT the sharding subsystem itself and ``parallel/`` (the mesh/
+    spec primitives those two own). Code outside the package opts in
+    with a ``# graft-lint: scope(sharding-plan)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/sharding/" in norm or "mxnet_tpu/parallel/" in norm:
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(sharding-plan)" in source
+
+
+def check_raw_sharding_construction(path, tree, source, findings):
+    """L701: raw ``NamedSharding``/``PartitionSpec`` construction
+    outside the sharding subsystem. The round-15 contract is ONE
+    declaration (the ShardingPlan) driving every consumer; a spec
+    hand-built elsewhere is invisible to the plan (and to its
+    fingerprint salt), so the fused step, serving and checkpoints
+    would disagree about a buffer's layout. Alias-tracked like L602:
+    ``from jax.sharding import PartitionSpec as P`` and
+    ``import jax.sharding as js`` can't hide the call site."""
+    if not _sharding_construction_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+    aliases = {}      # local callable name -> jax.sharding class
+    mod_aliases = set()  # names bound to the jax.sharding module
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == "jax.sharding":
+            for a in node.names:
+                if a.name in _SHARDING_CLASSES:
+                    aliases[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.sharding":
+                    mod_aliases.add(a.asname or "jax.sharding")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        cls = None
+        if isinstance(f, ast.Name) and f.id in aliases:
+            cls = aliases[f.id]
+        else:
+            dn = _dotted(f)
+            if dn is not None:
+                head, _, last = dn.rpartition(".")
+                if last in _SHARDING_CLASSES and (
+                        head == "jax.sharding" or head in mod_aliases):
+                    cls = last
+        if cls is not None and not pragmas.allows(node.lineno, "L701"):
+            findings.append(Finding(
+                "L701", path, node.lineno,
+                f"raw {cls} construction outside mxnet_tpu/sharding/ "
+                "+ parallel/ — placement must flow from the "
+                "ShardingPlan (sharding.named_sharding/replicated or "
+                "plan.spec_for), so one declaration drives every "
+                "consumer; annotate a deliberate pre-plan site with "
+                "allow(L701)"))
+
+
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
@@ -683,6 +766,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_step_host_sync(path, tree, source, findings)
         check_wallclock_deadlines(path, tree, source, findings)
         check_graph_mutation(path, tree, source, findings)
+        check_raw_sharding_construction(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
