@@ -1,0 +1,378 @@
+"""Decode benchmark: incremental stateful decode vs prefix re-execution.
+
+The round-16 acceptance scenario, in two parts:
+
+**Part 1 — incremental vs full-prefix.** One warmed stateful
+``InferenceSession`` (GRU cell + projection head) decodes a sequence of
+length ``T`` two ways with the SAME compiled step executable:
+
+- *incremental*: one ``step()`` per token, recurrent state threaded
+  step to step — ``T`` cell applications total;
+- *full-prefix*: what a server WITHOUT session state forces on every
+  client — token ``t`` re-runs the whole prefix ``1..t`` from zero
+  state, ``T(T+1)/2`` cell applications total.
+
+Both paths must land on bitwise-identical final outputs (and match an
+offline hybridized unroll), so the reported ``decode_speedup`` is pure
+algorithm — state carried server-side vs prefix re-executed — with
+zero numerics drift. The acceptance gate is >= 3x at ``T = 64``
+(the asymptotic ratio is ``(T+1)/2``).
+
+**Part 2 — continuous batching vs flush-cycle.** N concurrent clients
+stream mixed-length sequences as an OPEN-LOOP token stream:
+
+- *continuous*: the stateful ``DynamicBatcher`` step loop. Because
+  the server holds each stream's state, a client submits its WHOLE
+  token stream up front (per-session FIFOs keep step order) and the
+  scheduler drains the streams at full batch occupancy — sequences
+  join/leave between decode steps, no per-token round trip;
+- *flush-cycle*: what the pre-round-16 stack forces on a recurrent
+  stream — serving is stateless and coalesce-flush batched, so token
+  ``t`` re-executes its whole prefix ``0..t`` from zero state through
+  the stateless batcher: ``T(T+1)/2`` cell applications per client
+  instead of ``T``. The replay threads the same per-step executable
+  so the comparison is bitwise-clean and measures the serving
+  algorithm, not kernel differences.
+
+Throughput is USEFUL tokens/s (``sum(lengths)`` over wall time) for
+both paths; ``continuous_vs_flush_speedup`` must be >= 1.0. One
+client's final output is checked bitwise against the offline unroll
+here too, and every stream bitwise across the two serving paths.
+
+Emits one JSON document (default ``BENCH_DECODE_r16.json``); also
+prints it. ``*_tokens_per_s`` leaves are higher-is-better under
+``tools/bench_compare.py``; ``gates`` carries the regression bars and
+``gates_passed`` the verdict.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.decode_bench [--smoke] [--out FILE]
+
+``--smoke`` shrinks the model, sequence lengths and client count to a
+CPU tier-1 budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as onp
+
+GATES = {"decode_speedup_min": 3.0, "continuous_vs_flush_min": 1.0}
+
+
+def _build_net(n_in, hidden, n_out, seed=16):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import HybridBlock, nn, rnn
+
+    class DecodeStep(HybridBlock):
+        """One decode step: GRU cell + projection head. forward is
+        ``(x, h) -> (out, h')`` — the flat state-threading contract a
+        stateful session compiles."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.cell = rnn.GRUCell(hidden, input_size=n_in)
+                self.head = nn.Dense(n_out)
+
+        def hybrid_forward(self, F, x, h):
+            out, states = self.cell(x, [h])
+            return self.head(out), states[0]
+
+    mx.random.seed(seed)
+    net = DecodeStep()
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(nd.zeros((1, n_in)), nd.zeros((1, hidden)))
+    return net, DecodeStep
+
+
+def _offline_unroll(net_factory, src_net, xs, hidden):
+    """Reference chain: a hybridized copy of the model stepped offline
+    over ``xs`` — the bitwise ground truth for both parts."""
+    from mxnet_tpu import autograd, nd
+
+    ref = net_factory()
+    ref.initialize()
+    with autograd.pause(train_mode=False):
+        ref(nd.zeros((1, xs[0].shape[1])), nd.zeros((1, hidden)))
+    # match params by suffix past the auto-numbered block prefix
+    # ("decodestep0_" vs "decodestep1_")
+    src = {p.name.split("_", 1)[1]: p
+           for p in src_net.collect_params().values()}
+    for q in ref.collect_params().values():
+        q.set_data(src[q.name.split("_", 1)[1]].data())
+    ref.hybridize()
+    h = nd.zeros((1, hidden))
+    out = None
+    with autograd.pause(train_mode=False):
+        for x in xs:
+            out, h = ref(nd.array(x), h)
+    return onp.asarray(out.data), onp.asarray(h.data)
+
+
+def _part1_incremental_vs_prefix(sess, xs, hidden):
+    """T incremental steps vs T full-prefix re-executions, same
+    executable. Returns (doc, final incremental output)."""
+    from mxnet_tpu import nd
+
+    T = len(xs)
+    zero = [nd.zeros((1, hidden))]
+
+    def incremental():
+        states = [nd.zeros((1, hidden))]
+        out = None
+        for x in xs:
+            out, states = sess.step(nd.array(x), states=states)
+        return onp.asarray(out.data)
+
+    def full_prefix():
+        out = None
+        for t in range(1, T + 1):
+            states = list(zero)
+            for x in xs[:t]:  # no server-side state: replay the prefix
+                out, states = sess.step(nd.array(x), states=states)
+        return onp.asarray(out.data)
+
+    incremental()  # warm both paths out of the timed region
+    t0 = time.perf_counter()
+    inc_out = incremental()
+    inc_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pre_out = full_prefix()
+    pre_s = time.perf_counter() - t0
+    speedup = pre_s / max(inc_s, 1e-9)
+    return {
+        "seq_len": T,
+        "incremental_s": round(inc_s, 4),
+        "full_prefix_s": round(pre_s, 4),
+        "incremental_tokens_per_s": round(T / max(inc_s, 1e-9), 1),
+        "full_prefix_tokens_per_s": round(T / max(pre_s, 1e-9), 1),
+        "decode_speedup": round(speedup, 2),
+        "bitwise_incremental_vs_prefix":
+            bool((inc_out == pre_out).all()),
+    }, inc_out
+
+
+def _stream_prefix_replay(predict, lengths, make_x, hidden):
+    """The flush-cycle baseline: concurrent clients, one thread each,
+    where no state survives on the server between requests — token
+    ``t`` replays its whole prefix ``0..t`` from zero state through
+    the stateless batcher (``h`` threaded request to request only
+    WITHIN one replay, which is how a prefix forward decomposes onto
+    the per-step executable). Returns (wall_s, {cid: final out})."""
+    finals = {}
+    errs = []
+
+    def client(cid, n):
+        try:
+            out = None
+            for t in range(n):
+                h = onp.zeros((1, hidden), "float32")
+                for k in range(t + 1):
+                    out, h = predict(make_x(cid, k), h)
+                    h = onp.asarray(h)
+            finals[cid] = out
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((cid, e))
+
+    threads = [threading.Thread(target=client, args=(cid, n))
+               for cid, n in enumerate(lengths)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError(f"stream clients failed: {errs!r}")
+    return wall, finals
+
+
+def _stream_pipelined(batcher, sid_prefix, lengths, make_x):
+    """Open-loop streams against the stateful batcher: each client
+    fires its ENTIRE token stream as submits (the server's per-session
+    FIFO keeps step order; server-side state removes the per-token
+    round trip), then waits the futures. Returns
+    (wall_s, {cid: final out})."""
+    t0 = time.perf_counter()
+    futs = {
+        cid: [batcher.submit(make_x(cid, t),
+                             session_id=f"{sid_prefix}{cid}",
+                             block=True)
+              for t in range(n)]
+        for cid, n in enumerate(lengths)}
+    finals = {cid: fs[-1].result(timeout=120)
+              for cid, fs in futs.items()}
+    for fs in futs.values():  # every step resolved, not just the last
+        for f in fs:
+            f.result(timeout=120)
+    wall = time.perf_counter() - t0
+    return wall, finals
+
+
+def _part2_continuous_vs_flush(net, net_factory, n_in, hidden,
+                               lengths, smoke):
+    """Mixed-length streams: stateful continuous batcher vs stateless
+    flush-cycle batcher paying O(prefix) re-execution per token."""
+    from mxnet_tpu import nd, serving
+
+    rng = onp.random.RandomState(216)
+    steps = {(cid, t): rng.randn(1, n_in).astype("float32")
+             for cid, n in enumerate(lengths) for t in range(n)}
+    total_tokens = sum(lengths)
+    kw = dict(max_batch_size=max(len(lengths), 2), max_latency_ms=2.0,
+              timeout_ms=30000.0, admission=False)
+
+    # -- continuous: stateful session + step-loop batcher -------------
+    sess = serving.InferenceSession(
+        net, input_shapes=[(1, n_in)], state_shapes=[(hidden,)],
+        label="decode_bench_stateful")
+    sess.warmup()  # every occupancy bucket compiled OUT of the timing
+    bat = serving.DynamicBatcher(sess, **kw)
+
+    # steady-state warmup: one full throwaway stream pass — the first
+    # step at each batch occupancy traces its gather/scatter once
+    # (cached per shape after that); throwaway session slots are
+    # evicted so the timed pass joins on fresh ids
+    _stream_pipelined(bat, "warm-", lengths,
+                      lambda cid, t: steps[(cid, t)])
+    for cid in range(len(lengths)):
+        sess.state_store.evict(f"warm-{cid}", reason="bench warmup")
+    wall_c, finals_c = _stream_pipelined(
+        bat, "bench-", lengths, lambda cid, t: steps[(cid, t)])
+    continuous_tps = total_tokens / max(wall_c, 1e-9)
+    bat.close()
+    sess.close()
+
+    # -- flush-cycle: stateless session, O(prefix) per token ----------
+    sess0 = serving.InferenceSession(
+        net, input_shapes=[(1, n_in), (1, hidden)],
+        label="decode_bench_stateless")
+    sess0.warmup()  # same courtesy: compiles out of the timing
+    bat0 = serving.DynamicBatcher(sess0, **kw)
+
+    # light steady-state warmup: two-token replays reach every batch
+    # occupancy the timed pass sees (the replay itself is the load)
+    _stream_prefix_replay(bat0.predict,
+                          [min(n, 2) for n in lengths],
+                          lambda cid, t: steps[(cid, t)], hidden)
+    wall_f, finals_f = _stream_prefix_replay(
+        bat0.predict, lengths, lambda cid, t: steps[(cid, t)], hidden)
+    flush_tps = total_tokens / max(wall_f, 1e-9)
+    bat0.close()
+    sess0.close()
+
+    # bitwise: the longest stream against the offline unroll, and the
+    # two serving paths against each other on every stream
+    longest = max(range(len(lengths)), key=lambda c: lengths[c])
+    ref_out, _ = _offline_unroll(
+        net_factory, net,
+        [steps[(longest, t)] for t in range(lengths[longest])], hidden)
+    bitwise_ref = bool(
+        (onp.asarray(finals_c[longest]) == ref_out).all())
+    bitwise_paths = all(
+        bool((onp.asarray(finals_c[c]) ==
+              onp.asarray(finals_f[c])).all())
+        for c in range(len(lengths)))
+    return {
+        "clients": len(lengths),
+        "lengths": list(lengths),
+        "total_tokens": total_tokens,
+        "continuous_s": round(wall_c, 4),
+        "flush_cycle_s": round(wall_f, 4),
+        "continuous_tokens_per_s": round(continuous_tps, 1),
+        "flush_tokens_per_s": round(flush_tps, 1),
+        "continuous_vs_flush_speedup": round(
+            continuous_tps / max(flush_tps, 1e-9), 2),
+        "bitwise_vs_offline_unroll": bitwise_ref,
+        "bitwise_continuous_vs_flush": bitwise_paths,
+    }
+
+
+def run(smoke=False, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    import jax
+
+    from mxnet_tpu import serving
+
+    n_in = 16 if smoke else 32
+    hidden = 32 if smoke else 64
+    T = 8 if smoke else 64
+    lengths = [2, 4, 5] if smoke else [16, 24, 32, 40, 48, 56, 64, 48]
+    net, DecodeStep = _build_net(n_in, hidden, 8)
+
+    # Part 1: one stateful session, direct step() — scheduler out of
+    # the picture, pure incremental-vs-prefix arithmetic
+    sess = serving.InferenceSession(
+        net, input_shapes=[(1, n_in)], state_shapes=[(hidden,)],
+        label="decode_bench_part1")
+    rng = onp.random.RandomState(16)
+    xs = [rng.randn(1, n_in).astype("float32") for _ in range(T)]
+    serving.reset_serving_counters()
+    part1, inc_out = _part1_incremental_vs_prefix(sess, xs, hidden)
+    ref_out, _ = _offline_unroll(DecodeStep, net, xs, hidden)
+    part1["bitwise_vs_offline_unroll"] = bool(
+        (inc_out == ref_out).all())
+    sess.close()
+
+    # Part 2: the serving stack end to end
+    part2 = _part2_continuous_vs_flush(
+        net, DecodeStep, n_in, hidden, lengths, smoke)
+    stats = serving.serving_stats()
+
+    gates_passed = (
+        part1["decode_speedup"] >= GATES["decode_speedup_min"]
+        and part2["continuous_vs_flush_speedup"] >=
+        GATES["continuous_vs_flush_min"]
+        and part1["bitwise_vs_offline_unroll"]
+        and part1["bitwise_incremental_vs_prefix"]
+        and part2["bitwise_vs_offline_unroll"]
+        and part2["bitwise_continuous_vs_flush"])
+    doc = {
+        "benchmark": "decode",
+        "smoke": bool(smoke),
+        "platform": jax.default_backend(),
+        "model": {"n_in": n_in, "hidden": hidden, "n_out": 8,
+                  "cell": "GRU"},
+        "incremental": part1,
+        "continuous_batching": part2,
+        "results": {
+            "decode_speedup": part1["decode_speedup"],
+            "incremental_tokens_per_s":
+                part1["incremental_tokens_per_s"],
+            "full_prefix_tokens_per_s":
+                part1["full_prefix_tokens_per_s"],
+            "continuous_tokens_per_s":
+                part2["continuous_tokens_per_s"],
+            "flush_tokens_per_s": part2["flush_tokens_per_s"],
+            "continuous_vs_flush_speedup":
+                part2["continuous_vs_flush_speedup"],
+            "decode_steps": stats.get("decode_steps", 0),
+        },
+        "gates": dict(GATES),
+        "gates_passed": bool(gates_passed),
+    }
+    out_path = out_path or "BENCH_DECODE_r16.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small model/short streams; CPU tier-1 budget")
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -215,6 +215,21 @@ KNOBS = {
         "latency-regression multiplier (default 3.0): a canary whose "
         "smoothed latency exceeds this multiple of the incumbent's "
         "counts failures against its breaker"),
+    "MXNET_SERVING_STATE_SLOTS": (
+        "wired", "serving.state",
+        "session-state pool size (default 64): concurrent stateful "
+        "streams one SessionStateStore holds device-resident; the "
+        "byte budget may shrink the effective count"),
+    "MXNET_SERVING_STATE_BUDGET_MB": (
+        "wired", "serving.state",
+        "session-state pool byte budget in MiB (default 64): caps "
+        "slots x per-session state bytes; admission folds the pool's "
+        "free fraction into the decision for NEW streams"),
+    "MXNET_SERVING_STATE_TTL_S": (
+        "wired", "serving.state",
+        "idle session time-to-live in seconds (default 600): a "
+        "stream untouched this long is evicted before LRU kicks in; "
+        "its next step gets a clean retryable SessionEvicted"),
     "MXNET_DEVICE_PREFETCH": (
         "wired", "pipeline.DeviceFeed",
         "device-feed prefetch depth (default 2): batches staged onto "

@@ -43,6 +43,14 @@ class RecurrentCell(HybridBlock):
     def state_info(self, batch_size=0):
         raise NotImplementedError()
 
+    def state_row_shapes(self):
+        """Per-state PER-ROW shapes (batch axis dropped) — the
+        ``state_shapes`` a stateful serving session or
+        :class:`~mxnet_tpu.serving.state.SessionStateStore` wants for
+        this cell."""
+        return [tuple(info["shape"][1:])
+                for info in self.state_info(0)]
+
     def begin_state(self, batch_size=0, func=None, **kwargs):
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called "\

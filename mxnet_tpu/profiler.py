@@ -149,8 +149,10 @@ def serving_counters():
     (``latency_p99_ms:critical`` etc.), queue depth, SLO headroom,
     shed/goodput (``shed_rate``, ``goodput_rps``), canary/model-swap
     transitions, batch-size stats, QPS, warm-start disk hits vs
-    compiles), live from mxnet_tpu.serving.metrics. Zeros before the
-    first request."""
+    compiles, and the round-16 stateful-decode family —
+    ``decode_steps`` fused continuous-batching steps, live
+    ``slot_occupancy``, ``evictions`` and ``resumed_sessions``), live
+    from mxnet_tpu.serving.metrics. Zeros before the first request."""
     try:
         from .serving.metrics import serving_stats
 

@@ -56,6 +56,14 @@ on a 1x4 mesh restores onto 2x2, a single device, or any other shape
 (``ckpt_reshards`` counts restores whose active mesh differs from the
 writer's); under an active plan scope the restored buffers are placed
 straight back at the plan's layouts.
+
+**Serving session state (round 16).** ``session_state=`` attaches a
+:class:`~mxnet_tpu.serving.state.SessionStateStore`: each save rides a
+host snapshot of every live client's recurrent/KV state rows
+(``export_state``), and ``restore`` re-opens those sessions into the
+attached store (``restore_state``), so a server restart — or a canary
+promote that hands the checkpoint to the successor — resumes mid-stream
+decodes instead of dropping them.
 """
 from __future__ import annotations
 
@@ -172,11 +180,14 @@ class CheckpointManager:
     include_prng : bool — snapshot/restore the global PRNG stream
         position (default True; bitwise resume needs it whenever the
         forward draws keys — dropout, sampled ops)
+    session_state : serving.SessionStateStore, optional — snapshots
+        every live serving session's state rows and resumes them on
+        restore (stateful continuous-batching serving)
     """
 
     def __init__(self, directory=None, trainer=None, params=None,
                  kvstore=None, keep=None, async_mode=None,
-                 include_prng=True):
+                 include_prng=True, session_state=None):
         from .. import env as _env
 
         if directory is None:
@@ -196,6 +207,7 @@ class CheckpointManager:
             async_mode if async_mode is not None else
             _env.get_bool("MXNET_CKPT_ASYNC", True))
         self.include_prng = bool(include_prng)
+        self.session_state = session_state
         # one persistent writer thread over a BOUNDED job queue: the
         # step loop pays only the capture; serialize + IO overlap the
         # next steps, and a producer outrunning the writer blocks at
@@ -287,7 +299,11 @@ class CheckpointManager:
         snap = {"step": int(step), "cursor": dict(cursor or {}),
                 "extra": extra,
                 "trainer": None, "params": None, "prng": None,
-                "kvstore": None}
+                "kvstore": None, "session_state": None}
+        if self.session_state is not None:
+            # already pure host primitives — the writer thread pickles
+            # it unchanged, and a promote can hand it to the successor
+            snap["session_state"] = self.session_state.export_state()
         trainer = self.trainer
         params = self._params
         if params is None and trainer is not None:
@@ -698,6 +714,9 @@ class CheckpointManager:
             _mxrandom._STATE.key = jnp.asarray(payload["prng"]["key"])
         if payload.get("kvstore") is not None and self.kvstore is not None:
             self._restore_kvstore(self.kvstore, payload["kvstore"])
+        if payload.get("session_state") is not None and \
+                self.session_state is not None:
+            self.session_state.restore_state(payload["session_state"])
         self._replace_per_plan()
         _count("ckpt_restores")
         return {"step": payload["step"], "cursor": payload["cursor"],

@@ -40,6 +40,11 @@ Registered fault points (the catalogue; ``FAULT_POINTS``):
                           the incumbent active; rollback is
                           deliberately seam-free (it must always
                           succeed)
+``session_state_evict``   SessionStateStore.acquire on the decode path
+                          (serving/state.py) — a fire evicts the
+                          acquiring session's state slot and raises
+                          ``SessionEvicted``, so the blast radius of a
+                          mid-stream eviction is exactly one client
 ========================  ==================================================
 
 A **plan** maps fault points to firing clauses. From the environment::
@@ -106,6 +111,10 @@ FAULT_POINTS = {
                          "path for sheddable classes)",
     "model_swap": "ModelRepository atomic version activation "
                   "(first deploy / promote; rollback is seam-free)",
+    "session_state_evict": "SessionStateStore slot acquire on the "
+                           "decode path (a fire evicts the acquiring "
+                           "session, surfacing SessionEvicted to "
+                           "exactly that one client)",
 }
 
 _EXC_BY_NAME = {

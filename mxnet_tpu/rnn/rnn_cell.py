@@ -29,6 +29,13 @@ class BaseRNNCell:
     def state_info(self):
         raise NotImplementedError
 
+    def state_row_shapes(self):
+        """Per-state PER-ROW shapes (batch axis dropped) — what a
+        serving :class:`~mxnet_tpu.serving.state.SessionStateStore`
+        needs as its ``state_shapes``: the symbolic ``state_info``
+        shapes lead with the 0 batch placeholder."""
+        return [tuple(info["shape"][1:]) for info in self.state_info]
+
     @property
     def _gate_names(self):
         return ()
@@ -135,10 +142,6 @@ class LSTMCell(BaseRNNCell):
         b = onp.zeros(4 * self._num_hidden, "float32")
         b[self._num_hidden:2 * self._num_hidden] = self._forget_bias
         return b
-        self._iW = self._var("i2h_weight")
-        self._iB = self._var("i2h_bias")
-        self._hW = self._var("h2h_weight")
-        self._hB = self._var("h2h_bias")
 
     @property
     def state_info(self):

@@ -13,6 +13,17 @@ built directly on the round-9 compile-cache primitives:
   priority lanes with backpressure, deadline-aware micro-batch
   coalescing under a ``max_latency_ms`` flush deadline, per-request
   validation/timeout isolation, engine.close()-style graceful drain.
+  For STATEFUL sessions it runs a continuous-batching step loop:
+  sequences join and leave the executing batch between decode steps
+  (gather live slots -> one fused step -> scatter), no prefix
+  re-execution.
+- :class:`~mxnet_tpu.serving.state.SessionStateStore` — slot-indexed,
+  device-resident per-client recurrent/KV state pool with session
+  affinity, TTL + LRU eviction under a byte budget
+  (:class:`~mxnet_tpu.serving.state.SessionEvicted` is the clean
+  retryable eviction error), and checkpoint/migration payloads
+  (``export_state``/``restore_state``) so restarts and canary
+  promotes resume live streams.
 - :class:`~mxnet_tpu.serving.admission.AdmissionController` —
   SLO-aware admission control: sheds best-effort load with a fast 503
   + ``Retry-After`` (:class:`~mxnet_tpu.serving.admission.ShedLoad`)
@@ -46,14 +57,16 @@ pass-through), ``MXNET_SERVING_MAX_BATCH`` / ``_MAX_LATENCY_MS`` /
 ``_HOST`` / ``_PORT``, plus the round-13 SLO/canary family
 (``_ADMISSION`` / ``_SLO_MS`` / ``_SHED_HEADROOM`` /
 ``_RETRY_AFTER_MS`` / ``_CANARY_FRACTION`` / ``_CANARY_MIN_REQUESTS``
-/ ``_CANARY_THRESHOLD`` / ``_CANARY_LATENCY_X``) — see docs/SERVING.md
-and docs/ENV_VARS.md.
+/ ``_CANARY_THRESHOLD`` / ``_CANARY_LATENCY_X``) and the round-16
+session-state family (``_STATE_SLOTS`` / ``_STATE_BUDGET_MB`` /
+``_STATE_TTL_S``) — see docs/SERVING.md and docs/ENV_VARS.md.
 """
 from __future__ import annotations
 
 __all__ = ["InferenceSession", "DynamicBatcher", "ModelServer",
            "ModelRepository", "AdmissionController", "ShedLoad",
            "ServerBusy", "RequestTimeout", "SLO_CLASSES",
+           "SessionStateStore", "SessionEvicted",
            "parse_buckets", "serving_enabled", "serving_stats",
            "reset_serving_counters", "prometheus_text", "METRICS"]
 
@@ -70,6 +83,7 @@ def serving_enabled():
 
 from .metrics import (METRICS, SLO_CLASSES, prometheus_text,  # noqa: E402
                       reset_serving_counters, serving_stats)
+from .state import SessionEvicted, SessionStateStore  # noqa: E402
 from .session import InferenceSession, parse_buckets  # noqa: E402
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy  # noqa: E402
 from .admission import AdmissionController, ShedLoad  # noqa: E402

@@ -29,6 +29,14 @@ Classes shed at graduated thresholds: ``best_effort`` below
 backpressure can reject it). Deterministic testing rides the round-12
 fault grammar: ``MXNET_FAULT_PLAN=serving_admission:...`` forces the
 shed path for sheddable classes regardless of headroom.
+
+Round 16 adds a third signal for STATEFUL serving: *slot headroom* —
+``1 - occupancy / slots`` over the session's state pool. It is folded
+into the decision only for a submit that would ALLOCATE a new state
+slot (``allocates_state=True``): steps of already-live streams hold
+their slot and must not be shed by pool pressure, but admitting a new
+stream into a nearly-full pool would evict someone's state to serve
+it — exactly the trade admission control exists to refuse.
 """
 from __future__ import annotations
 
@@ -118,6 +126,16 @@ class AdmissionController:
                 return 1.0 - min(p99 / self._slo_s, 1.0)
         return 1.0
 
+    def _slot_headroom(self):
+        """Free fraction of the session state pool (1.0 for stateless
+        batchers — no pool, nothing to protect)."""
+        store = getattr(getattr(self._batcher, "session", None),
+                        "state_store", None)
+        if store is None:
+            return 1.0
+        slots = max(store.num_slots, 1)
+        return 1.0 - min(store.occupancy, slots) / slots
+
     def headroom(self):
         """Live SLO headroom in [0, 1]: min(queue, latency) signals.
         1.0 = idle, 0.0 = the protected SLO is already blown."""
@@ -133,10 +151,14 @@ class AdmissionController:
 
     # -- the decision (request path) -----------------------------------
 
-    def check(self, slo_class):
+    def check(self, slo_class, allocates_state=False):
         """Admit or raise :class:`ShedLoad`. Called by
         ``DynamicBatcher.submit`` after validation, before enqueue —
-        a shed request never occupies a queue slot."""
+        a shed request never occupies a queue slot.
+        ``allocates_state=True`` (a stateful submit opening a NEW
+        stream) additionally folds slot headroom into the decision, so
+        sheddable classes stop claiming state slots before the pool
+        starts evicting live streams to make room."""
         if not self.enabled:
             return
         try:
@@ -150,6 +172,8 @@ class AdmissionController:
         if _PRIORITY[slo_class] == 0:
             return  # protected class: backpressure only
         head = self.headroom()
+        if allocates_state:
+            head = min(head, self._slot_headroom())
         if head < self.shed_threshold(slo_class):
             self._shed(slo_class, headroom=head)
 
@@ -175,6 +199,7 @@ class AdmissionController:
             "headroom": round(max(min(qh, lh), 0.0), 4),
             "queue_headroom": round(max(qh, 0.0), 4),
             "latency_headroom": round(max(lh, 0.0), 4),
+            "slot_headroom": round(max(self._slot_headroom(), 0.0), 4),
             "slo_ms": self._slo_s * 1e3,
             "shedding": [c for c in SLO_CLASSES if _PRIORITY[c] > 0 and
                          min(qh, lh) < self.shed_threshold(c)],

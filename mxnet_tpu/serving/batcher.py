@@ -31,6 +31,29 @@ accepting queued work, drains everything already accepted, joins the
 workers, and is idempotent; after close (or with ``MXNET_SERVING=0``)
 ``submit`` degrades to inline single-request execution so late callers
 stay correct — exactly the engine's post-close inline semantics.
+
+Round 16 — **continuous batching** for stateful sessions: a batcher
+over a ``state_shapes=`` InferenceSession replaces the coalesce-flush
+cycle with a STEP LOOP. Each submit is one decode step of one session
+(``session_id=``, one row); the loop keeps per-session FIFO queues and
+between decode steps re-forms the executing batch from the head step
+of every live session — sequences JOIN the batch the moment they
+arrive and LEAVE the moment their queue empties, instead of the whole
+batch blocking on its slowest member. One fused step per iteration:
+gather the live sessions' state slots from the
+:class:`~.state.SessionStateStore`, execute the occupancy-bucket step
+executable, scatter the new states back. Affinity holds by
+construction — the loop is single-threaded and admits at most one
+queued step per session per batch, so a client's steps never
+interleave or reorder. SLO admission, per-class queues and
+deadline-at-every-exit all survive: admission sheds at submit (with a
+slot-occupancy term when the step would allocate a new state slot),
+higher classes win batch membership under contention, and expired
+steps fail with ``RequestTimeout`` at formation time — their session
+state stays put, so a timed-out step is retryable. ``close()`` runs
+every accepted step to its boundary and, when a ``state_checkpoint``
+manager is attached, checkpoints the session states instead of
+dropping them.
 """
 from __future__ import annotations
 
@@ -60,15 +83,17 @@ _STOP = object()  # queue sentinel, one per worker at close()
 
 class _Request:
     __slots__ = ("arrs", "rows", "future", "t_submit", "deadline",
-                 "slo_class")
+                 "slo_class", "session_id")
 
-    def __init__(self, arrs, rows, deadline, slo_class="standard"):
+    def __init__(self, arrs, rows, deadline, slo_class="standard",
+                 session_id=None):
         self.arrs = arrs  # list[NDArray], one per session input
         self.rows = rows
         self.future = Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline
         self.slo_class = slo_class
+        self.session_id = session_id  # stateful decode: one step of sid
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -184,15 +209,24 @@ class DynamicBatcher:
     admission : bool | None — SLO-aware admission control (None reads
         MXNET_SERVING_ADMISSION; False gives round-10 pure-FIFO
         backpressure semantics)
+    state_checkpoint : CheckpointManager | None — stateful batchers
+        only: ``close()`` checkpoints the drained session states
+        through it (a manager built with ``session_state=`` the
+        session's store) instead of dropping live streams
     """
 
     def __init__(self, session, max_batch_size=None, max_latency_ms=None,
                  max_queue=None, timeout_ms=None, num_workers=None,
-                 admission=None):
+                 admission=None, state_checkpoint=None):
         from .. import env as _env
         from . import serving_enabled
 
         self.session = session
+        self._stateful = bool(getattr(session, "stateful", False))
+        self._state_ckpt = state_checkpoint
+        if state_checkpoint is not None and not self._stateful:
+            raise MXNetError("state_checkpoint= requires a stateful "
+                             "session (state_shapes=)")
         self._max_batch = int(max_batch_size or _env.get_int(
             "MXNET_SERVING_MAX_BATCH", 32))
         sess_max = getattr(session, "max_batch", None)
@@ -220,11 +254,18 @@ class DynamicBatcher:
                 self, enabled=admission)
         self._workers = []
         if not self._pass_through:
+            # continuous batching is a single-scheduler discipline:
+            # one step-loop thread owns batch membership, which is
+            # what makes session affinity hold by construction
+            if self._stateful:
+                nworkers = 1
+            loop = self._step_loop if self._stateful \
+                else self._worker_loop
             ready = []
             for i in range(max(nworkers, 1)):
                 ev = threading.Event()
                 ready.append(ev)
-                t = threading.Thread(target=self._worker_loop,
+                t = threading.Thread(target=loop,
                                      args=(ev,),
                                      name=f"mxnet-serving-batcher-{i}",
                                      daemon=True)
@@ -241,7 +282,7 @@ class DynamicBatcher:
     # -- client side ---------------------------------------------------
 
     def submit(self, *inputs, timeout_ms=None, block=False,
-               slo_class=None):
+               slo_class=None, session_id=None):
         """Validate and enqueue one request; returns a
         ``concurrent.futures.Future`` resolving to the request's output
         rows as HOST numpy arrays (one array, or a tuple for
@@ -259,7 +300,15 @@ class DynamicBatcher:
         occupying a queue slot. A full class lane raises
         :class:`ServerBusy` (or blocks when ``block=True``). After
         ``close()`` / under ``MXNET_SERVING=0`` the request runs
-        inline."""
+        inline.
+
+        Stateful batchers: every submit is ONE decode step of the
+        stream named by ``session_id`` (required, one row per step) —
+        the server keeps the state, so the payload is just the step's
+        input token/frame. The future resolves to that step's output
+        row(s); a reclaimed slot rejects with
+        :class:`~.state.SessionEvicted` (retryable 503) on exactly
+        this stream."""
         import numpy as onp
 
         from .admission import normalize_class
@@ -268,7 +317,20 @@ class DynamicBatcher:
         METRICS.bump("requests")
         METRICS.bump_class("requests", cls)
         try:
+            if self._stateful:
+                if session_id is None:
+                    raise ValueError(
+                        "stateful serving: submit needs session_id= "
+                        "(one decode step of one session)")
+            elif session_id is not None:
+                raise ValueError(
+                    "session_id= requires a stateful session "
+                    "(state_shapes=)")
             arrs, rows = self.session.validate(*inputs)
+            if self._stateful and rows != 1:
+                raise ValueError(
+                    f"stateful serving: one decode step is one row "
+                    f"(got {rows}); stream steps, not batches")
             arrs = [a.asnumpy() if isinstance(a, NDArray)
                     else onp.asarray(a) for a in arrs]
         except ValueError:
@@ -282,15 +344,25 @@ class DynamicBatcher:
         t = self._timeout_s if timeout_ms is None else \
             float(timeout_ms) / 1e3
         deadline = time.monotonic() + t if t > 0 else None
-        req = _Request(arrs, rows, deadline, cls)
+        req = _Request(arrs, rows, deadline, cls,
+                       session_id=None if session_id is None
+                       else str(session_id))
         with self._lock:
             inline = self._closed or self._pass_through
         if inline:
             METRICS.bump("inline")
-            self._execute([req])
+            if self._stateful:
+                self._execute_step_batch([req])
+            else:
+                self._execute([req])
             return req.future
         if self._admission is not None:
-            self._admission.check(cls)  # may raise ShedLoad (503)
+            # a step that must ALLOCATE a state slot competes for pool
+            # space; steps of already-live sessions never re-pay the
+            # occupancy term (their slot is held)
+            allocates = self._stateful and \
+                not self.session.state_store.has(req.session_id)
+            self._admission.check(cls, allocates_state=allocates)
         if block:
             # bounded waits that re-check _closed: a blocking put on a
             # full queue whose consumers close() just joined would
@@ -325,11 +397,12 @@ class DynamicBatcher:
             self._drain_queue()
         return req.future
 
-    def predict(self, *inputs, timeout_ms=None, slo_class=None):
+    def predict(self, *inputs, timeout_ms=None, slo_class=None,
+                session_id=None):
         """Blocking convenience: ``submit(...).result()`` with a result
         wait bounded by the request deadline (plus execution slack)."""
         fut = self.submit(*inputs, timeout_ms=timeout_ms,
-                          slo_class=slo_class)
+                          slo_class=slo_class, session_id=session_id)
         t = self._timeout_s if timeout_ms is None else \
             float(timeout_ms) / 1e3
         return fut.result(timeout=(t + 60.0) if t > 0 else None)
@@ -481,6 +554,190 @@ class DynamicBatcher:
                 now - r.t_submit, slo_class=r.slo_class,
                 met_deadline=r.deadline is None or now <= r.deadline)
 
+    # -- continuous batching (stateful sessions) -----------------------
+
+    def _step_loop(self, ready=None):
+        """The continuous-batching scheduler: between decode steps,
+        re-form the executing batch from the HEAD step of every live
+        session — sequences join and leave at step boundaries, never
+        blocking on each other's lengths. Single-threaded on purpose
+        (see the constructor); per-session FIFO queues keep each
+        stream's steps ordered, and one-head-per-session batch
+        membership keeps them from ever sharing a fused step."""
+        try:
+            from .. import random as mxrandom
+
+            mxrandom.next_key()
+        except Exception:  # graft-lint: allow(L501)
+            pass
+        finally:
+            if ready is not None:
+                ready.set()
+        pending = {}  # session_id -> deque[_Request] (FIFO per stream)
+        arrival = deque()  # session_ids, join order (stable membership)
+        stop = False
+
+        def admit(item):
+            q = pending.get(item.session_id)
+            if q is None:
+                pending[item.session_id] = q = deque()
+                arrival.append(item.session_id)
+            q.append(item)
+
+        while True:
+            # drain the queue without blocking: joiners enter pending
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    stop = True
+                else:
+                    admit(item)
+            if not pending:
+                if stop:
+                    break
+                try:
+                    item = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is _STOP:
+                    stop = True
+                else:
+                    admit(item)
+                continue
+            # form the step batch: the head step of each live session,
+            # failing expired heads first (deadline-at-every-exit —
+            # the state slot stays put, so a timed-out step retries)
+            heads = []
+            for sid in list(arrival):
+                q = pending[sid]
+                now = time.monotonic()
+                while q and q[0].expired(now):
+                    self._fail_timeout(q.popleft())
+                if not q:
+                    del pending[sid]
+                    arrival.remove(sid)
+                else:
+                    heads.append(q[0])
+            if not heads:
+                continue
+            if len(heads) > self._max_batch:
+                # contention: higher SLO classes win membership; the
+                # stable sort keeps join order within a class
+                order = {c: i for i, c in enumerate(SLO_CLASSES)}
+                heads.sort(key=lambda r: order.get(r.slo_class, 1))
+                heads = heads[:self._max_batch]
+            # coalescing window: hold for joiners only while the batch
+            # is under-occupied and no member's flush deadline passed.
+            # When every live session already contributed its head the
+            # window is skipped — holding can only serve sessions that
+            # don't exist yet, and those join at the next boundary.
+            if (not stop and len(heads) < self._max_batch
+                    and len(heads) < len(pending)):
+                margin = METRICS.exec_estimate_s()
+                flush_at = min(
+                    r.t_submit + self._max_latency_s if r.deadline is
+                    None else min(r.t_submit + self._max_latency_s,
+                                  r.deadline - margin)
+                    for r in heads)
+                remaining = flush_at - time.monotonic()
+                if remaining > 0:
+                    try:
+                        item = self._queue.get(timeout=remaining)
+                        if item is _STOP:
+                            stop = True
+                        else:
+                            admit(item)
+                    except queue.Empty:
+                        pass
+                    else:
+                        continue  # re-form with the joiner aboard
+            METRICS.observe_flush(
+                time.monotonic() - min(r.t_submit for r in heads))
+            self._execute_step_batch(heads)
+            # executed heads leave their stream queues; drained
+            # streams leave the batch (join/leave at step boundaries)
+            for r in heads:
+                q = pending.get(r.session_id)
+                if q and q[0] is r:
+                    q.popleft()
+                if q is not None and not q:
+                    del pending[r.session_id]
+                    arrival.remove(r.session_id)
+
+    def _execute_step_batch(self, batch):
+        """One fused decode step over the batch's sessions: acquire
+        each stream's state slot (per-request failures — eviction, a
+        full pool — reject that ONE future), gather the live slots
+        into a dense block, run the occupancy-bucket step executable,
+        scatter the new states back, resolve each step's output row.
+        A session/executable failure past acquire is systemic: it
+        fails every live member and releases the slots UN-stepped, so
+        the states still describe the last completed step."""
+        import numpy as onp
+
+        store = self.session.state_store
+        live, recs = [], []
+        for r in batch:
+            try:
+                if not store.has(r.session_id):
+                    store.open_for_step(r.session_id)
+                recs.append(store.acquire(r.session_id))
+                live.append(r)
+            except Exception as e:  # noqa: BLE001 — per-future
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(e)
+                METRICS.observe_request(
+                    time.monotonic() - r.t_submit, failed=True,
+                    slo_class=r.slo_class, met_deadline=False)
+        if not live:
+            return
+        t0 = time.perf_counter()
+        slots = [rec.slot for rec in recs]
+        try:
+            if len(live) == 1:
+                arrs = live[0].arrs
+            else:
+                arrs = [onp.concatenate([r.arrs[i] for r in live],
+                                        axis=0)
+                        for i in range(len(live[0].arrs))]
+            states = store.gather(slots)
+            outs, news = self.session._run_step(
+                arrs, states, len(live), adopted=True)
+            import jax
+
+            # surface step failures BEFORE the scatter: a poisoned
+            # write would corrupt every member's resume point
+            jax.block_until_ready(news)
+            store.scatter(slots, news)
+            host = [onp.asarray(o) for o in outs]
+        except Exception as e:  # noqa: BLE001 — delivered per-future
+            for rec in recs:
+                store.release(rec, stepped=False)
+            now = time.monotonic()
+            for r in live:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(e)
+                METRICS.observe_request(
+                    now - r.t_submit, failed=True,
+                    slo_class=r.slo_class, met_deadline=False)
+            return
+        for rec in recs:
+            store.release(rec)
+        METRICS.bump("decode_steps")
+        METRICS.observe_batch(len(live), time.perf_counter() - t0)
+        now = time.monotonic()
+        for i, r in enumerate(live):
+            sliced = tuple(h[i:i + 1] for h in host)
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(
+                    sliced[0] if len(sliced) == 1 else sliced)
+            METRICS.observe_request(
+                now - r.t_submit, slo_class=r.slo_class,
+                met_deadline=r.deadline is None or now <= r.deadline)
+
     def _fail_timeout(self, req):
         if req.future.set_running_or_notify_cancel():
             # the REQUEST's own deadline (submit may have overridden
@@ -498,7 +755,13 @@ class DynamicBatcher:
     def close(self):
         """Graceful shutdown: stop accepting queued work, drain every
         accepted request, join the workers. Idempotent; post-close
-        submits run inline (the ``engine.close()`` contract)."""
+        submits run inline (the ``engine.close()`` contract).
+
+        Stateful batchers drain every accepted step to its boundary
+        (the step EXECUTES — in-flight streams advance, never drop)
+        and then, when a ``state_checkpoint`` manager is attached,
+        checkpoint the session states so the streams resume in the
+        next process / model version."""
         with self._lock:
             if self._closed:
                 return
@@ -510,6 +773,18 @@ class DynamicBatcher:
         self._workers = []
         # anything a racing submit slipped in behind the sentinels
         self._drain_queue()
+        if self._stateful and self._state_ckpt is not None:
+            try:
+                store = self.session.state_store
+                self._state_ckpt.save(step=store.steps_total)
+                self._state_ckpt.wait()
+            except Exception:  # graft-lint: allow(L501)
+                # close() must complete; a failed state checkpoint is
+                # an availability loss, not a shutdown blocker
+                import logging
+
+                logging.exception(
+                    "serving: session-state checkpoint at close failed")
         METRICS.unregister_depth_probe(self._depth_token)
         if self._admission is not None:
             self._admission.close()
@@ -531,6 +806,10 @@ class DynamicBatcher:
                 continue
             if item.expired():
                 self._fail_timeout(item)
+            elif self._stateful:
+                # run the stream to its step boundary (state advances
+                # and is checkpointable) instead of dropping the step
+                self._execute_step_batch([item])
             else:
                 self._execute([item])
 

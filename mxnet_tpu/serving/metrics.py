@@ -31,6 +31,13 @@ plus per-class ROLLING latency histograms (:class:`RollingHistogram`) —
 cumulative histograms never forget an overload spike, but admission
 control needs a p99 that recovers once the spike passes, so headroom
 is computed over a sliding window instead.
+
+Round 16 adds the incremental-decode dimension: ``decode_steps``
+(fused continuous-batching step executions), ``evictions`` /
+``resumed_sessions`` (session-state lifecycle), and a
+``slot_occupancy`` gauge probed from live :class:`SessionStateStore`
+instances — all of which flow through ``serving_counters()``,
+``profiler.dump()`` samples, and the Prometheus families for free.
 """
 from __future__ import annotations
 
@@ -162,6 +169,8 @@ _COUNTER_NAMES = (
     "shed", "deadline_met", "canary_requests", "canary_failures",
     "canary_fallbacks", "canary_deploys", "canary_promotions",
     "canary_rollbacks", "model_swaps",
+    # round 16: stateful continuous-batching decode
+    "decode_steps", "evictions", "resumed_sessions",
 )
 
 #: the per-SLO-class slice of the counters (suffixed ``:<class>``)
@@ -179,6 +188,7 @@ class ServingMetrics:
         self._reset_locked()
         self._depth_probes = {}  # token -> callable() -> int
         self._headroom_probes = {}  # token -> callable() -> float
+        self._occupancy_probes = {}  # token -> callable() -> int
 
     def _reset_locked(self):
         self.counters = dict.fromkeys(_COUNTER_NAMES, 0)
@@ -329,6 +339,32 @@ class ServingMetrics:
         with self._lock:
             self._headroom_probes.pop(token, None)
 
+    def register_occupancy_probe(self, probe):
+        """Register a live session-slot occupancy callable (a
+        ``SessionStateStore``'s live-session count); returns a token
+        for :meth:`unregister_occupancy_probe`. Probed at read time
+        only, like queue depth."""
+        token = object()
+        with self._lock:
+            self._occupancy_probes[token] = probe
+        return token
+
+    def unregister_occupancy_probe(self, token):
+        with self._lock:
+            self._occupancy_probes.pop(token, None)
+
+    def slot_occupancy(self):
+        """Total live sessions across registered state stores."""
+        with self._lock:
+            probes = list(self._occupancy_probes.values())
+        occ = 0
+        for p in probes:
+            try:
+                occ += int(p())
+            except Exception:  # graft-lint: allow(L501)
+                pass
+        return occ
+
     def slo_headroom(self):
         """Minimum live headroom across registered admission
         controllers, 0..1 (1.0 with none registered — no controller
@@ -382,6 +418,7 @@ class ServingMetrics:
                 if st["true_rows"] else 0.0
         st["queue_depth"] = self.queue_depth()
         st["slo_headroom"] = round(self.slo_headroom(), 4)
+        st["slot_occupancy"] = self.slot_occupancy()
         return st
 
     def reset(self):
@@ -441,6 +478,9 @@ class ServingMetrics:
         emit("mxnet_serving_slo_headroom", self.slo_headroom(),
              help_="min live SLO headroom across admission controllers "
                    "(0..1)", typ="gauge")
+        emit("mxnet_serving_slot_occupancy", self.slot_occupancy(),
+             help_="live sessions holding server-side state slots",
+             typ="gauge")
         for name, snap, bounds, help_ in hists:
             lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} histogram")

@@ -35,6 +35,15 @@ active — rollback itself is deliberately seam-free).
 Every transition (deploy/promote/rollback/swap) bumps a process
 counter surfaced through ``profiler.serving_counters()``, Prometheus
 ``/metrics`` and the repository's ``healthz()`` block.
+
+Stateful sessions (round 16): a request carrying a ``session_id`` is
+PINNED to the incumbent — its recurrent/KV state lives in the
+incumbent's :class:`~mxnet_tpu.serving.state.SessionStateStore`, and a
+canary has no copy of it, so the canary slice only ever samples
+stateless traffic. ``promote`` migrates the incumbent's live sessions
+into the successor's store (``export_state``/``restore_state``) before
+the pointer moves on, so a rollout completes with zero dropped
+mid-stream decodes (``resumed_sessions`` counts them).
 """
 from __future__ import annotations
 
@@ -214,20 +223,51 @@ class ModelRepository:
     def promote(self, name):
         """Promote the canary to active (atomic hot-swap). The old
         version's batcher stays alive — rollback after promote is
-        instant re-activation, no recompile."""
+        instant re-activation, no recompile. When both versions are
+        stateful, the incumbent's live sessions MIGRATE into the
+        successor's state store under the model lock (submit also takes
+        it), so no request can observe the new active version without
+        its state — a promote drops zero mid-stream decodes."""
         m = self._model(name)
         with m.lock:
             if m.canary is None:
                 raise MXNetError(f"model {name!r} has no canary to "
                                  "promote")
+            incumbent = m.versions.get(m.active)
             self._activate_locked(m, m.canary)
             m.canary = None
             m.canary_breaker = None
             m.state = "serving"
             m.last_transition = f"canary v{m.active} promoted"
             METRICS.bump("canary_promotions")
+            self._migrate_sessions_locked(
+                m, incumbent, m.versions[m.active])
             logging.info("serving: model %s canary v%d promoted",
                          name, m.active)
+
+    @staticmethod
+    def _migrate_sessions_locked(m, src_vh, dst_vh):
+        """Hand the outgoing version's live session state to the new
+        active one. Failures are logged, never raised — the swap
+        already happened, and an un-migrated session surfaces as a
+        clean retryable SessionEvicted on its next step, not a torn
+        promote."""
+        src = getattr(getattr(src_vh, "session", None),
+                      "state_store", None)
+        dst = getattr(getattr(dst_vh, "session", None),
+                      "state_store", None)
+        if src is None or dst is None or src is dst:
+            return
+        try:
+            n = dst.restore_state(src.export_state())
+            if n:
+                logging.info(
+                    "serving: model %s promote migrated %d live "
+                    "session(s) to v%d", m.name, n, dst_vh.version)
+        except Exception:  # noqa: BLE001 — promote must not unwind
+            logging.exception(
+                "serving: model %s promote could not migrate live "
+                "sessions to v%d", m.name, dst_vh.version)
 
     def rollback(self, name, reason="operator request"):
         """Cancel the canary; all traffic returns to the incumbent.
@@ -255,7 +295,8 @@ class ModelRepository:
 
     def close(self):
         """Drain every batcher of every version (engine.close()
-        order). Idempotent."""
+        order), then release session resources (a stateful session's
+        state-store metrics probe). Idempotent."""
         with self._lock:
             if self._closed:
                 return
@@ -266,6 +307,9 @@ class ModelRepository:
                 versions = list(m.versions.values())
             for vh in versions:
                 vh.batcher.close()
+                close = getattr(vh.session, "close", None)
+                if close is not None:
+                    close()
 
     def __enter__(self):
         return self
@@ -276,10 +320,12 @@ class ModelRepository:
     # -- the request path ----------------------------------------------
 
     def submit(self, name, *inputs, timeout_ms=None, slo_class=None,
-               block=False):
+               block=False, session_id=None):
         """Route one request: canary slice (deterministic, non-critical
         only) or incumbent. Returns a Future; canary execution
-        failures fall back to the incumbent transparently."""
+        failures fall back to the incumbent transparently. A stateful
+        request (``session_id``) never rides the canary — its state
+        slot lives in the incumbent's store."""
         from .admission import normalize_class
 
         m = self._model(name)
@@ -291,18 +337,22 @@ class ModelRepository:
             canary = m.versions.get(m.canary) \
                 if m.canary is not None else None
             use_canary = False
-            if canary is not None and cls != SLO_CLASSES[0]:
+            if canary is not None and cls != SLO_CLASSES[0] and \
+                    session_id is None:
                 # counter routing: request k rides the canary iff the
                 # integer part of k*fraction advanced — exactly
                 # fraction of eligible traffic, deterministically
+                # (stateful requests are not eligible and do not tick)
                 m._tick += 1
                 f = m.canary_fraction
                 use_canary = int(m._tick * f) != int((m._tick - 1) * f)
         if not use_canary:
             t0 = time.monotonic()
+            kw = {} if session_id is None else \
+                {"session_id": session_id}
             fut = incumbent.batcher.submit(
                 *inputs, timeout_ms=timeout_ms, slo_class=cls,
-                block=block)
+                block=block, **kw)
             if canary is not None:
                 # sample incumbent latency while a canary is under
                 # evaluation — the baseline for the regression check
@@ -312,10 +362,11 @@ class ModelRepository:
         return self._submit_canary(m, canary, incumbent, inputs,
                                    timeout_ms, cls, block)
 
-    def predict(self, name, *inputs, timeout_ms=None, slo_class=None):
+    def predict(self, name, *inputs, timeout_ms=None, slo_class=None,
+                session_id=None):
         """Blocking convenience over :meth:`submit`."""
         fut = self.submit(name, *inputs, timeout_ms=timeout_ms,
-                          slo_class=slo_class)
+                          slo_class=slo_class, session_id=session_id)
         return fut.result(timeout=60.0)
 
     def _submit_canary(self, m, canary, incumbent, inputs, timeout_ms,
@@ -474,6 +525,9 @@ class ModelRepository:
             if vh is not None:
                 sess = vh.session
                 info["warm"] = bool(getattr(sess, "warm", True))
+                store = getattr(sess, "state_store", None)
+                if store is not None:
+                    info["session_state"] = store.stats()
                 info["degraded_buckets"] = list(
                     getattr(sess, "degraded", []))
                 info["open_buckets"] = sorted(

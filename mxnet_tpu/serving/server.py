@@ -15,7 +15,10 @@ Endpoints:
   :class:`~mxnet_tpu.serving.repository.ModelRepository`. Requests
   carry their SLO class and deadline via the ``X-SLO-Class`` /
   ``X-Timeout-Ms`` headers or the JSON fields ``slo_class`` /
-  ``timeout_ms`` (body wins).
+  ``timeout_ms`` (body wins). Stateful (continuous-batching) models
+  additionally take a session affinity key via ``X-Session-Id`` or the
+  JSON field ``session_id`` (body wins) — every decode step of one
+  stream must carry the same id.
 - ``GET /healthz`` — liveness + warm state (``200`` once every bucket
   executable is resolved; load balancers gate on this so a cold
   replica never takes traffic) plus the degradation ladder: per-class
@@ -26,7 +29,9 @@ Endpoints:
   serving registry.
 
 Error mapping: validation ``ValueError`` -> 400, queue backpressure
-(:class:`~mxnet_tpu.serving.batcher.ServerBusy`) -> 503, admission
+(:class:`~mxnet_tpu.serving.batcher.ServerBusy`) -> 503, a mid-stream
+state eviction (:class:`~mxnet_tpu.serving.state.SessionEvicted`) ->
+503 with ``Retry-After`` (the client restarts its stream), admission
 shed (:class:`~mxnet_tpu.serving.admission.ShedLoad`) -> fast 503
 with a ``Retry-After`` header, deadline
 (:class:`~mxnet_tpu.serving.batcher.RequestTimeout` or a result-wait
@@ -49,6 +54,7 @@ from ..resilience.breaker import CircuitOpen
 from .admission import ShedLoad, normalize_class
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy
 from .metrics import METRICS, prometheus_text
+from .state import SessionEvicted
 
 __all__ = ["ModelServer"]
 
@@ -190,6 +196,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             if warm and (degraded or open_buckets):
                 status = "degraded"
             adm = getattr(srv.batcher, "admission", None)
+            store = getattr(session, "state_store", None)
             # 503 until warm so a status-code health check (the
             # standard LB kind) keeps traffic off a cold replica
             self._reply(200 if warm else 503, {
@@ -202,7 +209,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 # the ROADMAP "budget signal": how much SLO headroom is
                 # left (1.0 idle .. 0.0 blown) and who is shedding
                 "queue_depths": srv.batcher.qsize_by_class(),
-                "slo": adm.snapshot() if adm is not None else None})
+                "slo": adm.snapshot() if adm is not None else None,
+                # stateful serving: live session-state pool occupancy
+                "state": store.stats() if store is not None else None})
         elif self.path == "/models":
             if srv.repository is None:
                 self._error(404, "no model repository behind this "
@@ -263,6 +272,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
         # payload through proxies that strip custom headers)
         slo_class = self.headers.get("X-SLO-Class")
         timeout_ms = self.headers.get("X-Timeout-Ms")
+        session_id = self.headers.get("X-Session-Id")
         try:
             if ctype == "application/x-npy":
                 inputs = [onp.load(io.BytesIO(body), allow_pickle=False)]
@@ -272,6 +282,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 if isinstance(doc, dict):
                     slo_class = doc.get("slo_class", slo_class)
                     timeout_ms = doc.get("timeout_ms", timeout_ms)
+                    session_id = doc.get("session_id", session_id)
                 if isinstance(doc, dict) and "inputs" in doc:
                     inputs = [onp.asarray(x) for x in doc["inputs"]]
                 elif isinstance(doc, dict) and "data" in doc:
@@ -287,14 +298,16 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._error(400, f"unparseable request body: {e}")
             return
         srv = self.model_server
+        kw = {} if session_id is None else {"session_id": session_id}
         try:
             if model is not None:
                 outs = srv.repository.predict(
                     model, *inputs, timeout_ms=timeout_ms,
-                    slo_class=slo_class)
+                    slo_class=slo_class, **kw)
             else:
                 outs = srv.batcher.predict(
-                    *inputs, timeout_ms=timeout_ms, slo_class=slo_class)
+                    *inputs, timeout_ms=timeout_ms, slo_class=slo_class,
+                    **kw)
         except ValueError as e:
             self._error(400, str(e))
             return
@@ -304,6 +317,13 @@ class _ServingHandler(BaseHTTPRequestHandler):
             METRICS.bump("rejected")
             self._error(503, str(e), headers={
                 "Retry-After": f"{max(e.retry_after_s, 0.0):.3f}"})
+            return
+        except SessionEvicted as e:
+            # the stream's state slot is gone (TTL/LRU/injected): a
+            # clean retryable 503 — the client re-opens its stream and
+            # replays; ordered before the plain ServerBusy mapping
+            # (SessionEvicted subclasses it)
+            self._error(503, str(e), headers={"Retry-After": "0.000"})
             return
         except (ServerBusy, CircuitOpen) as e:
             # both are "back off and retry later": queue backpressure,

@@ -25,6 +25,21 @@ zero XLA compiles** before the first request, verifiable via
 ``profiler.compile_cache_counters()['retraces']``. Models that cannot
 symbol-trace fall back to memory-only executables (first process pays
 the compile; correctness unchanged).
+
+Round 16 — stateful incremental decode: a session constructed with
+``state_shapes=`` compiles a **step executable** instead, the pure
+function ``(params, key, inputs, states) -> (outputs, new_states)``
+with the state arguments DONATED (state-in/state-out at zero copies)
+and bucketed on **batch occupancy** — how many live sequences ride
+this step — so one AOT program serves any batch membership of the
+continuous batcher. Step executables are fingerprinted with a
+state-shape salt (kind ``serving_step``), so stateless and stateful
+artifacts of the same graph never collide on disk. The block contract:
+``forward(*inputs, *states)`` returns the flat tuple
+``(*outputs, *new_states)`` — exactly what ``RecurrentCell``-style
+cells emit. :meth:`step` is the single-process API;
+``DynamicBatcher`` drives :meth:`_run_step` directly with slots
+gathered from the session's :class:`~.state.SessionStateStore`.
 """
 from __future__ import annotations
 
@@ -143,11 +158,22 @@ class InferenceSession:
         Resolve every bucket executable in the constructor (AOT compile
         or disk deserialize). ``warm=False`` defers each bucket to its
         first request.
+    state_shapes : sequence of shape tuples, optional
+        Per-state ROW shapes (no batch axis) the block threads —
+        ``RecurrentCell.state_row_shapes()`` emits them. Makes the
+        session STATEFUL: it compiles occupancy-bucketed step
+        executables and owns a :class:`~.state.SessionStateStore`
+        (see :meth:`step`); :meth:`predict` is disabled.
+    state_dtypes : sequence of dtypes, optional (default float32)
+    state_store : SessionStateStore, optional
+        Share an existing store instead of constructing one (canary
+        versions of one model each get their own by default).
     """
 
     def __init__(self, block, example=None, input_shapes=None,
                  input_dtypes=None, buckets=None, max_batch=None,
-                 warm=True, label=None):
+                 warm=True, label=None, state_shapes=None,
+                 state_dtypes=None, state_store=None):
         from .. import env as _env
 
         self._block = block
@@ -172,6 +198,28 @@ class InferenceSession:
                              f"positive batch sizes (got {buckets})")
         self._input_specs = self._resolve_input_specs(
             example, input_shapes, input_dtypes)
+        self._state_specs = []
+        self.state_store = None
+        self._owns_store = False
+        self._step_entries = {}  # (occupancy, amp_ver) -> _BucketEntry
+        self._step_jitted_by_ver = {}
+        if state_store is not None or state_shapes is not None:
+            from .state import SessionStateStore
+
+            if state_store is not None:
+                self.state_store = state_store
+                state_shapes = state_store.state_shapes
+                if state_dtypes is None:
+                    state_dtypes = [str(dt)
+                                    for dt in state_store.state_dtypes]
+            dts = state_dtypes or ["float32"] * len(state_shapes)
+            self._state_specs = [
+                _InputSpec(f"state{i}", s, dt)
+                for i, (s, dt) in enumerate(zip(state_shapes, dts))]
+            if self.state_store is None:
+                self.state_store = SessionStateStore(
+                    state_shapes, dts, label=label)
+                self._owns_store = True
         self._ensure_initialized()
         self._param_list = [p for _, p in
                             sorted(block.collect_params().items())]
@@ -245,8 +293,9 @@ class InferenceSession:
         if all(p._ndarray is not None for p in params.values()):
             return
         # one throwaway eager forward over zeros finishes deferred init
+        # (a stateful block's forward also takes its state tensors)
         zeros = [nd.zeros((1,) + s.row_shape, dtype=str(s.dtype))
-                 for s in self._input_specs]
+                 for s in self._input_specs + self._state_specs]
         with autograd.pause(train_mode=False):
             self._block.forward(*zeros)
 
@@ -270,7 +319,8 @@ class InferenceSession:
             # variables) pass through untouched.
             with _name_mod.NameManager():
                 out = self._block(*[sym.var(s.name)
-                                    for s in self._input_specs])
+                                    for s in self._input_specs
+                                    + self._state_specs])
             if isinstance(out, (list, tuple)):
                 out = sym.Group(list(out))
             return out.tojson()
@@ -312,6 +362,37 @@ class InferenceSession:
             for p, v in zip(pnds, saved):
                 p._data = v
 
+    def _pure_step(self, param_vals, key, input_datas, state_datas):
+        """The stateful decode step :meth:`_pure` — ``(params, key,
+        inputs, states) -> (*outputs, *new_states)`` flat. The state
+        argument is DONATED by the compiled wrapper, so the block's
+        new states reuse the old states' device buffers (state-in/
+        state-out at zero copies); callers must hand in computation
+        outputs, never device_put uploads (the fused_step.state_adopt
+        laundering rule)."""
+        pnds = [p._ndarray for p in self._param_list]
+        saved = [p._data for p in pnds]
+        try:
+            for p, v in zip(pnds, param_vals):
+                p._data = v
+            with autograd.pause(train_mode=False), \
+                    mxrandom.key_provider(key):
+                args = [NDArray(d) for d in input_datas]
+                sargs = [NDArray(d) for d in state_datas]
+                outs = self._block.forward(*args, *sargs)
+            flat = [outs] if isinstance(outs, NDArray) else list(outs)
+            n_states = len(self._state_specs)
+            if len(flat) <= n_states:
+                raise MXNetError(
+                    f"stateful forward returned {len(flat)} value(s); "
+                    f"expected outputs followed by {n_states} new "
+                    "state(s)")
+            self._num_outputs = len(flat) - n_states
+            return tuple(o.data for o in flat)
+        finally:
+            for p, v in zip(pnds, saved):
+                p._data = v
+
     # -- bucket resolution --------------------------------------------
 
     def _amp_version(self):
@@ -335,6 +416,24 @@ class InferenceSession:
             pure.__doc__ = pure.__doc__ % amp_ver
             jf = cc.counting_jit(pure, label="serving")
             self._jitted_by_ver[amp_ver] = jf
+        return jf
+
+    def _step_jitted_for(self, amp_ver):
+        """The step-executable analog of :meth:`_jitted_for`, with the
+        state argument donated: each decode step's new states reuse
+        the previous states' buffers instead of growing the pool's
+        working set per step."""
+        jf = self._step_jitted_by_ver.get(amp_ver)
+        if jf is None:
+            def pure_step(param_vals, key, input_datas, state_datas):
+                """Serving decode step (AMP policy version %d)."""
+                return self._pure_step(param_vals, key, input_datas,
+                                       state_datas)
+
+            pure_step.__doc__ = pure_step.__doc__ % amp_ver
+            jf = cc.counting_jit(pure_step, label="serving_step",
+                                 donate_argnums=(3,))
+            self._step_jitted_by_ver[amp_ver] = jf
         return jf
 
     def _graph_op_bodies(self):
@@ -444,13 +543,89 @@ class InferenceSession:
             self._entries[(bucket, amp_ver)] = ent
             return ent
 
+    def _step_fingerprint(self, occupancy, amp_ver):
+        """The :meth:`_fingerprint` analog for step executables, kind
+        ``serving_step`` with a **state-shape salt**: the same graph
+        served stateless and stateful lowers different programs (state
+        threading + donation), so their disk artifacts must never
+        collide."""
+        if self._graph_sig is None:
+            return None
+        from ..analysis import graph_opt
+        from ..gluon.block import SymbolBlock
+
+        opt_salt = (graph_opt.fingerprint_salt()
+                    if isinstance(self._block, SymbolBlock)
+                    else ("graph_opt", 0))
+        key = ("serving_step", hashlib.sha256(
+            self._graph_sig.encode()).hexdigest(),
+            tuple(self._param_names),
+            tuple((tuple(v.shape), str(v.dtype))
+                  for v in self._param_vals),
+            tuple((s.name, (occupancy,) + s.row_shape, str(s.dtype))
+                  for s in self._input_specs),
+            ("state",) + tuple(
+                (s.name, (occupancy,) + s.row_shape, str(s.dtype))
+                for s in self._state_specs),
+            amp_ver, occupancy, opt_salt)
+        code_of = [type(self)._pure_step, type(self._block).forward]
+        code_of.extend(self._graph_op_bodies())
+        return cc.fingerprint("serving_step", key,
+                              code_of=tuple(code_of))
+
+    def _step_avals(self, occupancy):
+        import jax
+
+        sds = jax.ShapeDtypeStruct
+        key = jax.random.PRNGKey(0)
+        param_avals = [sds(v.shape, v.dtype) for v in self._param_vals]
+        input_avals = [sds((occupancy,) + s.row_shape, s.dtype)
+                       for s in self._input_specs]
+        state_avals = [sds((occupancy,) + s.row_shape, s.dtype)
+                       for s in self._state_specs]
+        return (param_avals, sds(key.shape, key.dtype), input_avals,
+                state_avals)
+
+    def _step_entry(self, occupancy):
+        """The resolved step executable for an occupancy bucket under
+        the current AMP policy (the :meth:`_entry` pattern). The step
+        path is deliberately breaker-free: a systemic step failure
+        fails the whole decode batch loudly in the batcher rather than
+        demoting a bucket, and mixing step keys into ``_breakers``
+        would poison ``degraded``'s sort."""
+        amp_ver = self._amp_version()
+        ent = self._step_entries.get((occupancy, amp_ver))
+        if ent is not None:
+            return ent
+        with self._lock:
+            ent = self._step_entries.get((occupancy, amp_ver))
+            if ent is not None:
+                return ent
+            fp = self._step_fingerprint(occupancy, amp_ver)
+            fn, meta, from_disk = cc.load_or_compile(
+                fp, self._step_jitted_for(amp_ver),
+                self._step_avals(occupancy),
+                meta=lambda: {"num_outputs": self._num_outputs})
+            if from_disk:
+                METRICS.bump("warm_disk_hits")
+                if self._num_outputs is None:
+                    self._num_outputs = meta.get("num_outputs")
+            else:
+                METRICS.bump("warm_compiles")
+            ent = _BucketEntry(occupancy, amp_ver, fn,
+                               self._num_outputs, from_disk)
+            self._step_entries[(occupancy, amp_ver)] = ent
+            return ent
+
     def warmup(self, buckets=None):
         """Resolve every bucket executable now (AOT compile, or disk
-        deserialize on a warm start). Returns ``{"disk_hits": n,
-        "compiles": m}`` for this call."""
+        deserialize on a warm start); stateful sessions resolve their
+        occupancy-bucketed STEP executables instead. Returns
+        ``{"disk_hits": n, "compiles": m}`` for this call."""
         hits = compiles = 0
+        resolve = self._step_entry if self._state_specs else self._entry
         for b in (buckets or self.buckets):
-            ent = self._entry(int(b))
+            ent = resolve(int(b))
             if ent.from_disk:
                 hits += 1
             else:
@@ -462,7 +637,9 @@ class InferenceSession:
         """True when every configured bucket is resolved under the
         current AMP policy."""
         amp_ver = self._amp_version()
-        return all((b, amp_ver) in self._entries for b in self.buckets)
+        entries = self._step_entries if self._state_specs \
+            else self._entries
+        return all((b, amp_ver) in entries for b in self.buckets)
 
     # -- the request path ---------------------------------------------
 
@@ -477,6 +654,16 @@ class InferenceSession:
     @property
     def max_batch(self):
         return self.buckets[-1]
+
+    @property
+    def stateful(self):
+        """True when this session threads server-side state
+        (constructed with ``state_shapes=``)."""
+        return bool(self._state_specs)
+
+    @property
+    def state_specs(self):
+        return list(self._state_specs)
 
     def refresh_params(self):
         """Re-snapshot parameter values from the block (after a live
@@ -511,6 +698,11 @@ class InferenceSession:
         passing plain host arrays. Returns ``self``."""
         from .. import sharding as _sharding
 
+        if self._state_specs:
+            raise MXNetError(
+                "shard_params is not supported on stateful sessions "
+                "(the state pool is single-device; shard the stateless "
+                "prefill model instead)")
         if plan is None or mesh is None:
             ctx = _sharding.current_plan()
             if ctx is None:
@@ -718,11 +910,150 @@ class InferenceSession:
             return list(out)  # nothing padded: no slice op to pay
         return [cc.slice_batch(o, bucket, n) for o in out]
 
+    # -- the stateful decode path -------------------------------------
+
+    def _validate_states(self, states, batch):
+        """Check explicit state arrays against the state specs (the
+        :meth:`validate` contract applied to states: host arrays stay
+        host-side, ``ValueError`` for per-request rejection)."""
+        if len(states) != len(self._state_specs):
+            raise ValueError(
+                f"expected {len(self._state_specs)} state(s), got "
+                f"{len(states)}")
+        out = []
+        for s, spec in zip(states, self._state_specs):
+            if isinstance(s, NDArray):
+                if onp.dtype(s.dtype) != spec.dtype:
+                    raise ValueError(
+                        f"state {spec.name!r} dtype {s.dtype} != "
+                        f"expected {spec.dtype}")
+                arr = s
+            else:
+                try:
+                    arr = onp.asarray(s, dtype=spec.dtype)
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"state {spec.name!r} is not convertible to "
+                        f"dtype {spec.dtype}: {e}") from None
+            if tuple(arr.shape[1:]) != spec.row_shape:
+                raise ValueError(
+                    f"state {spec.name!r} row shape "
+                    f"{tuple(arr.shape[1:])} != expected "
+                    f"{spec.row_shape}")
+            if arr.shape[0] != batch:
+                raise ValueError(
+                    f"state {spec.name!r} batch {arr.shape[0]} != "
+                    f"input batch {batch}")
+            out.append(arr)
+        return out
+
+    def _run_step(self, arrs, states, n, adopted=False):
+        """Execute one decode step at occupancy ``n`` through its
+        occupancy-bucket step executable; returns ``(outputs,
+        new_states)`` as jax arrays sliced back to ``n`` rows.
+
+        The state argument is donated into the executable, and on
+        jaxlib-0.4.37 CPU donating a ``device_put``-uploaded buffer
+        corrupts unrelated live arrays (the fused_step ``state_adopt``
+        hazard) — so host-origin states are laundered through
+        ``jnp.array(..., copy=True)`` after upload, making every
+        donated buffer an XLA computation output. ``adopted=True`` is
+        the batcher's fast path: the states are ``SessionStateStore.
+        gather`` outputs (already computation outputs), donated
+        as-is."""
+        import jax.numpy as jnp
+
+        from ..resilience import faults as _faults
+
+        bucket = self._bucket_for(n)
+        ent = self._step_entry(bucket)
+        datas = []
+        for a in arrs:
+            if isinstance(a, NDArray):
+                datas.append(cc.pad_batch(a.data, bucket))
+            else:
+                if a.shape[0] != bucket:
+                    padded = onp.zeros((bucket,) + a.shape[1:], a.dtype)
+                    padded[:a.shape[0]] = a
+                    a = padded
+                datas.append(nd.array(a).data)
+        sdatas = []
+        for s, spec in zip(states, self._state_specs):
+            if adopted:
+                # gather/pad outputs are computation outputs:
+                # donation-safe without laundering
+                sdatas.append(s if s.shape[0] == bucket
+                              else cc.pad_batch(s, bucket))
+                continue
+            if isinstance(s, NDArray):
+                d = cc.pad_batch(s.data, bucket)
+            else:
+                if s.shape[0] != bucket:
+                    padded = onp.zeros((bucket,) + s.shape[1:], s.dtype)
+                    padded[:s.shape[0]] = s
+                    s = padded
+                d = nd.array(s).data
+            sdatas.append(jnp.array(d, copy=True))
+        key = mxrandom.next_key()
+        # same registered fault point as the stateless request path:
+        # one executable invocation on the serving hot path
+        _faults.maybe_fail("serving_execute")
+        out = ent.fn(self._param_vals, key, datas, sdatas)
+        METRICS.bump("bucket_execs")
+        METRICS.bump("padded_rows", bucket - n)
+        METRICS.bump("true_rows", n)
+        outs = list(out[:ent.num_outputs])
+        news = list(out[ent.num_outputs:])
+        if bucket != n:
+            outs = [cc.slice_batch(o, bucket, n) for o in outs]
+            news = [cc.slice_batch(s, bucket, n) for s in news]
+        return outs, news
+
+    def step(self, *inputs, states):
+        """One incremental decode step with EXPLICIT states: ``(one
+        row-batch of inputs, current states) -> (outputs, new
+        states)``. This is the single-process stateful API (offline
+        decode loops, tests, benchmarks); served traffic goes through
+        a stateful ``DynamicBatcher``, which keeps states server-side
+        in the session's :class:`~.state.SessionStateStore` and only
+        ever passes slot gathers. Occupancy above ``max_batch`` is
+        rejected (a decode step is never chunked — states would
+        cross-talk)."""
+        if not self._state_specs:
+            raise MXNetError("step() requires a stateful session "
+                             "(construct with state_shapes=)")
+        arrs, batch = self.validate(*inputs)
+        if batch > self.max_batch:
+            raise ValueError(
+                f"step occupancy {batch} exceeds max_batch "
+                f"{self.max_batch}")
+        svals = self._validate_states(states, batch)
+        t0 = time.perf_counter()
+        outs, news = self._run_step(arrs, svals, batch)
+        import jax
+
+        jax.block_until_ready(outs + news)
+        METRICS.bump("decode_steps")
+        METRICS.observe_batch(batch, time.perf_counter() - t0)
+        result = tuple(NDArray(o) for o in outs)
+        return (result[0] if len(result) == 1 else result,
+                [NDArray(s) for s in news])
+
+    def close(self):
+        """Release resources a stateful session owns (its state
+        store's metrics probe). Stateless sessions: no-op."""
+        if self._owns_store and self.state_store is not None:
+            self.state_store.close()
+
     def predict(self, *inputs):
         """Run eval-mode inference. Inputs may be NDArrays or anything
         ``numpy.asarray`` accepts (batch axis first). Batches larger
         than ``max_batch`` are chunked. Returns an NDArray (single
         output) or tuple of NDArrays."""
+        if self._state_specs:
+            raise MXNetError(
+                "predict() is stateless; this session threads state — "
+                "use step() or a stateful DynamicBatcher")
         arrs, batch = self.validate(*inputs)
         t0 = time.perf_counter()
         chunks = []

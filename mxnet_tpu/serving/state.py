@@ -1,0 +1,415 @@
+"""Server-side session state: the memory of incremental decode.
+
+Stateless serving re-executes a sequence's whole prefix on every token
+— O(prefix) work per step. This module keeps each client's recurrent /
+KV state ON THE SERVER, in a preallocated device-resident pool, so a
+decode step costs exactly one cell forward regardless of position
+(the continuous-batching literature's KV-cache discipline applied to
+the round-10 serving stack).
+
+:class:`SessionStateStore` holds one **slot** per live session: for
+every state tensor the model threads, the store owns a device array of
+shape ``(num_slots,) + row_shape`` allocated once at construction.
+Sessions are *slot-indexed*, not shape-indexed — a decode batch gathers
+whichever slots are live into a dense ``(occupancy, ...)`` block, runs
+ONE compiled step executable, and scatters the new state back — so a
+single AOT program serves any batch membership, exactly the bucketing
+discipline the rest of the stack lives by.
+
+Policies:
+
+- **Affinity** — a session's steps never interleave: the store marks a
+  slot ``in_flight`` while a step batch holds it, the continuous
+  batcher admits at most one queued step per session into a batch, and
+  eviction never touches an in-flight slot.
+- **TTL + LRU under a byte budget** — the pool is sized by
+  ``MXNET_SERVING_STATE_SLOTS`` capped by
+  ``MXNET_SERVING_STATE_BUDGET_MB``; opening a session when every slot
+  is taken first reclaims idle-expired sessions
+  (``MXNET_SERVING_STATE_TTL_S``), then the least-recently-stepped one.
+  An evicted session's next step raises :class:`SessionEvicted` — a
+  clean, retryable 503 telling exactly that one client to re-open.
+- **Checkpointable** — :meth:`export_state` / :meth:`restore_state`
+  round-trip every live session as host arrays; the round-12
+  ``CheckpointManager(session_state=store)`` rides them in its
+  manifest-hashed payload, and a round-13 canary promote migrates live
+  sessions into the new version's store instead of dropping them
+  (``resumed_sessions`` counts both paths).
+
+The ``session_state_evict`` fault seam fires in :meth:`acquire` —
+chaos drills can evict any session mid-stream and assert the blast
+radius is one client.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as onp
+
+from ..base import MXNetError
+from .batcher import ServerBusy
+from .metrics import METRICS
+
+__all__ = ["SessionStateStore", "SessionEvicted"]
+
+#: evicted-session tombstones kept for clean error reporting; past the
+#: bound the oldest fold into the generic "unknown session" error
+_TOMBSTONES = 4096
+
+
+class SessionEvicted(ServerBusy):
+    """This session's server-side state slot was reclaimed (idle TTL,
+    LRU pressure under the byte budget, or an injected fault) — the
+    stream cannot continue from server state. Retryable: re-open the
+    session (optionally from a checkpoint) and resume. Maps to HTTP
+    503 with a Retry-After hint, and is delivered to exactly the one
+    client whose slot went away."""
+
+
+class _Slot:
+    """One live session's bookkeeping (state lives in the pool)."""
+
+    __slots__ = ("sid", "slot", "created", "last_used", "steps",
+                 "in_flight")
+
+    def __init__(self, sid, slot, now):
+        self.sid = sid
+        self.slot = slot
+        self.created = now
+        self.last_used = now
+        self.steps = 0
+        self.in_flight = False
+
+
+class SessionStateStore:
+    """Slot-indexed, device-resident per-session state pool.
+
+    Parameters
+    ----------
+    state_shapes : sequence of shape tuples
+        Per-state ROW shapes (no batch axis), e.g. ``[(256,), (256,)]``
+        for an LSTM — ``RecurrentCell.state_row_shapes()`` emits them.
+    state_dtypes : sequence of dtypes, optional (default float32)
+    max_sessions : int, optional — slot count before the byte budget
+        (default ``MXNET_SERVING_STATE_SLOTS``)
+    byte_budget : int, optional — pool byte cap; shrinks the slot
+        count to fit (default ``MXNET_SERVING_STATE_BUDGET_MB`` MiB)
+    ttl_s : float, optional — idle expiry (default
+        ``MXNET_SERVING_STATE_TTL_S``); <= 0 disables
+    label : str, optional — logging/debug tag
+    """
+
+    def __init__(self, state_shapes, state_dtypes=None, max_sessions=None,
+                 byte_budget=None, ttl_s=None, label=None):
+        import jax.numpy as jnp
+
+        from .. import env as _env
+
+        self.label = label
+        self.state_shapes = tuple(tuple(int(d) for d in s)
+                                  for s in state_shapes)
+        if not self.state_shapes:
+            raise MXNetError("state_shapes must name at least one "
+                             "state tensor")
+        dts = state_dtypes or ["float32"] * len(self.state_shapes)
+        if len(dts) != len(self.state_shapes):
+            raise MXNetError("state_dtypes length must match "
+                             "state_shapes")
+        self.state_dtypes = tuple(onp.dtype(d) for d in dts)
+        self.bytes_per_session = int(sum(
+            int(onp.prod(s or (1,))) * dt.itemsize
+            for s, dt in zip(self.state_shapes, self.state_dtypes)))
+        slots = int(max_sessions if max_sessions is not None else
+                    _env.get_int("MXNET_SERVING_STATE_SLOTS", 64))
+        budget = int(byte_budget if byte_budget is not None else
+                     _env.get_int("MXNET_SERVING_STATE_BUDGET_MB", 64)
+                     * 1024 * 1024)
+        if budget > 0:
+            slots = min(slots, max(budget // self.bytes_per_session, 1))
+        self.num_slots = max(slots, 1)
+        self.ttl_s = float(ttl_s if ttl_s is not None else
+                           _env.get_float("MXNET_SERVING_STATE_TTL_S",
+                                          600.0))
+        # the pool: ONE preallocated device array per state tensor —
+        # gather/scatter are XLA ops over it, never per-session uploads
+        self._pools = [jnp.zeros((self.num_slots,) + s, dtype=str(dt))
+                       for s, dt in zip(self.state_shapes,
+                                        self.state_dtypes)]
+        self._lock = threading.RLock()
+        self._slots = OrderedDict()  # sid -> _Slot, LRU order
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self._evicted = OrderedDict()  # sid -> reason (tombstones)
+        self.steps_total = 0
+        self._occupancy_token = METRICS.register_occupancy_probe(
+            lambda: len(self._slots))
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def occupancy(self):
+        with self._lock:
+            return len(self._slots)
+
+    def has(self, sid):
+        with self._lock:
+            return sid in self._slots
+
+    def live_sessions(self):
+        with self._lock:
+            return list(self._slots)
+
+    def stats(self):
+        """Flat description for /healthz and admission probes."""
+        with self._lock:
+            return {"sessions": len(self._slots),
+                    "slots": self.num_slots,
+                    "bytes_per_session": self.bytes_per_session,
+                    "ttl_s": self.ttl_s,
+                    "steps_total": self.steps_total}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def open(self, sid, init_states=None, _resumed=False):
+        """Allocate (or return) the state slot for ``sid``. A fresh
+        slot starts at zeros unless ``init_states`` (per-state ROW
+        arrays) seeds it. Reclaims TTL-expired then LRU slots when
+        full; raises :class:`ServerBusy` only when every slot is
+        pinned by an in-flight step batch. Idempotent for an already
+        open session (``init_states`` then rewrites its state)."""
+        import jax.numpy as jnp
+
+        sid = str(sid)
+        with self._lock:
+            rec = self._slots.get(sid)
+            if rec is None:
+                if not self._free:
+                    self._reclaim_locked()
+                if not self._free:
+                    raise ServerBusy(
+                        f"no free session-state slot ({self.num_slots} "
+                        "slots, all in flight); retry later")
+                rec = _Slot(sid, self._free.pop(), time.monotonic())
+                self._slots[sid] = rec
+                self._evicted.pop(sid, None)
+                # a reused slot still holds the previous tenant's
+                # state: reset it (zeros) or seed it before anyone
+                # gathers
+                if init_states is None:
+                    for i, pool in enumerate(self._pools):
+                        self._pools[i] = pool.at[rec.slot].set(0)
+            if init_states is not None:
+                if len(init_states) != len(self._pools):
+                    raise MXNetError(
+                        f"expected {len(self._pools)} state tensor(s), "
+                        f"got {len(init_states)}")
+                for i, (pool, s) in enumerate(zip(self._pools,
+                                                  init_states)):
+                    row = jnp.asarray(onp.asarray(
+                        s, dtype=self.state_dtypes[i]))
+                    if tuple(row.shape) != self.state_shapes[i]:
+                        raise MXNetError(
+                            f"state {i} row shape {tuple(row.shape)} "
+                            f"!= expected {self.state_shapes[i]}")
+                    self._pools[i] = pool.at[rec.slot].set(row)
+            if _resumed:
+                METRICS.bump("resumed_sessions")
+            return rec.slot
+
+    def open_for_step(self, sid):
+        """The batcher's IMPLICIT open — a stream's first step
+        allocates its slot on arrival. Unlike :meth:`open` (the
+        explicit client re-open, which clears any tombstone), this
+        refuses evicted sessions: a pipelined stream whose slot went
+        away must see :class:`SessionEvicted` on every remaining step,
+        never a silent restart from zero state."""
+        with self._lock:
+            if sid not in self._slots:
+                reason = self._evicted.get(sid)
+                if reason is not None:
+                    raise SessionEvicted(
+                        f"session {sid!r} state was evicted ({reason}); "
+                        "re-open the session and retry")
+            return self.open(sid)
+
+    def _reclaim_locked(self):
+        """Refill ``_free`` by one slot: TTL-expired sessions first
+        (all of them — they are dead weight), then the LRU session.
+        In-flight slots are never reclaimed (affinity)."""
+        now = time.monotonic()
+        if self.ttl_s > 0:
+            for sid in [s for s, r in self._slots.items()
+                        if not r.in_flight and
+                        now - r.last_used > self.ttl_s]:
+                self._evict_locked(sid, "idle TTL expired")
+        if self._free:
+            return
+        for sid, rec in self._slots.items():  # OrderedDict = LRU order
+            if not rec.in_flight:
+                self._evict_locked(sid, "LRU pressure (pool full)")
+                return
+
+    def _evict_locked(self, sid, reason):
+        rec = self._slots.pop(sid)
+        self._free.append(rec.slot)
+        self._evicted[sid] = reason
+        while len(self._evicted) > _TOMBSTONES:
+            self._evicted.popitem(last=False)
+        METRICS.bump("evictions")
+        logging.info("serving%s: session %s evicted after %d step(s): "
+                     "%s", f" {self.label}" if self.label else "", sid,
+                     rec.steps, reason)
+
+    def evict(self, sid, reason="operator request"):
+        """Explicitly drop one session's state (no-op if unknown)."""
+        with self._lock:
+            if sid in self._slots:
+                self._evict_locked(sid, reason)
+
+    def acquire(self, sid):
+        """Pin ``sid``'s slot for one decode step; returns the slot
+        record. The ``session_state_evict`` fault seam fires here —
+        an injected fire evicts THIS session and raises
+        :class:`SessionEvicted`, so chaos drills hit exactly one
+        client. TTL expiry is also enforced here (the lazy half of
+        reclamation). Pair with :meth:`release`."""
+        from ..resilience import faults as _faults
+        from ..resilience.faults import InjectedFault
+
+        with self._lock:
+            rec = self._slots.get(sid)
+            if rec is None:
+                reason = self._evicted.get(sid)
+                if reason is not None:
+                    raise SessionEvicted(
+                        f"session {sid!r} state was evicted ({reason}); "
+                        "re-open the session and retry")
+                raise MXNetError(
+                    f"unknown session {sid!r} (never opened on this "
+                    "server)")
+            if rec.in_flight:
+                raise MXNetError(
+                    f"session {sid!r} already has a step in flight "
+                    "(affinity violation — one step at a time)")
+            try:
+                _faults.maybe_fail("session_state_evict")
+            except InjectedFault as e:
+                self._evict_locked(sid, f"injected fault ({e})")
+                raise SessionEvicted(
+                    f"session {sid!r} state was evicted (injected "
+                    "fault); re-open the session and retry") from e
+            now = time.monotonic()
+            if self.ttl_s > 0 and now - rec.last_used > self.ttl_s:
+                self._evict_locked(sid, "idle TTL expired")
+                raise SessionEvicted(
+                    f"session {sid!r} state expired after "
+                    f"{self.ttl_s:g}s idle; re-open the session and "
+                    "retry")
+            rec.in_flight = True
+            rec.last_used = now
+            self._slots.move_to_end(sid)
+            return rec
+
+    def release(self, rec, stepped=True):
+        """Unpin a slot after its step batch resolves."""
+        with self._lock:
+            rec.in_flight = False
+            if stepped:
+                rec.steps += 1
+                rec.last_used = time.monotonic()
+                self.steps_total += 1
+
+    # -- the device path: gather / scatter -----------------------------
+
+    def gather(self, slots):
+        """Dense ``(occupancy,) + row_shape`` block per state tensor
+        for the given slot indices — XLA gathers over the pool, so the
+        results are computation outputs (donation-safe into the step
+        executable without laundering)."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(onp.asarray(slots, onp.int32))
+        with self._lock:
+            pools = list(self._pools)
+        return [pool[idx] for pool in pools]
+
+    def scatter(self, slots, new_states):
+        """Write a step's output states back into the pool rows."""
+        idx = onp.asarray(slots, onp.int32)
+        import jax.numpy as jnp
+
+        jidx = jnp.asarray(idx)
+        with self._lock:
+            for i, ns in enumerate(new_states):
+                self._pools[i] = self._pools[i].at[jidx].set(ns)
+
+    def read(self, sid):
+        """Host copies of one session's state rows (tests, export)."""
+        with self._lock:
+            rec = self._slots.get(sid)
+            if rec is None:
+                raise MXNetError(f"unknown session {sid!r}")
+            return [onp.asarray(pool[rec.slot]) for pool in self._pools]
+
+    # -- checkpoint / migration ----------------------------------------
+
+    def export_state(self):
+        """Host snapshot of every live session — the payload the
+        round-12 ``CheckpointManager`` rides (``session_state=``) and
+        a canary promote migrates. Pure host primitives, so it pickles
+        under the manifest's content hashes unchanged."""
+        with self._lock:
+            recs = list(self._slots.values())
+            pools = list(self._pools)
+        sessions = {}
+        for rec in recs:
+            sessions[rec.sid] = {
+                "steps": rec.steps,
+                "states": [onp.asarray(pool[rec.slot])
+                           for pool in pools]}
+        return {"format": 1,
+                "state_shapes": [list(s) for s in self.state_shapes],
+                "state_dtypes": [str(dt) for dt in self.state_dtypes],
+                "sessions": sessions}
+
+    def restore_state(self, payload):
+        """Re-open every session in an :meth:`export_state` payload
+        (checkpoint restore, or live migration at canary promote).
+        Returns the number of sessions resumed; each bumps the
+        ``resumed_sessions`` counter. A shape/dtype mismatch raises —
+        resuming garbage into the pool would serve silent corruption."""
+        if payload is None:
+            return 0
+        shapes = tuple(tuple(s) for s in payload.get("state_shapes", ()))
+        if shapes != self.state_shapes:
+            raise MXNetError(
+                f"session-state payload shapes {shapes} do not match "
+                f"this store's {self.state_shapes}; cannot resume")
+        restored = 0
+        for sid, ent in payload.get("sessions", {}).items():
+            with self._lock:
+                if not self._free and sid not in self._slots:
+                    self._reclaim_locked()
+                if not self._free and sid not in self._slots:
+                    logging.warning(
+                        "serving: session-state restore ran out of "
+                        "slots; %s (and later sessions) not resumed",
+                        sid)
+                    break
+                self.open(sid, init_states=ent["states"], _resumed=True)
+                self._slots[sid].steps = int(ent.get("steps", 0))
+            restored += 1
+        return restored
+
+    def close(self):
+        """Unregister the occupancy probe (the pool itself is freed by
+        refcount)."""
+        METRICS.unregister_occupancy_probe(self._occupancy_token)
+
+    def __repr__(self):
+        return (f"SessionStateStore(slots={self.num_slots}, "
+                f"live={self.occupancy}, "
+                f"bytes_per_session={self.bytes_per_session}, "
+                f"ttl_s={self.ttl_s:g})")

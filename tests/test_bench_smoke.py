@@ -180,6 +180,52 @@ def test_sharding_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "sharding_r15"
 
 
+@pytest.mark.slow
+def test_decode_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import decode_bench
+
+    out = str(tmp_path / "decode.json")
+    doc = decode_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    # the bitwise contracts must hold at any scale; the >= 3x decode
+    # gate is a seq-64 property only enforced on the committed full
+    # run (BENCH_DECODE_r16.json)
+    assert doc["incremental"]["bitwise_incremental_vs_prefix"]
+    assert doc["incremental"]["bitwise_vs_offline_unroll"]
+    assert doc["continuous_batching"]["bitwise_vs_offline_unroll"]
+    assert doc["continuous_batching"]["bitwise_continuous_vs_flush"]
+    assert doc["results"]["decode_speedup"] > 1.0
+    assert doc["results"]["continuous_vs_flush_speedup"] > 1.0
+    assert doc["results"]["decode_steps"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "decode"
+
+
+def test_bench_compare_decode_metrics():
+    """BENCH_DECODE_r16.json names: tokens/s throughputs and the two
+    speedup ratios are higher-is-better, step counts untracked."""
+    base = {"results": {"decode_speedup": 30.0,
+                        "incremental_tokens_per_s": 2500.0,
+                        "continuous_tokens_per_s": 2200.0,
+                        "continuous_vs_flush_speedup": 40.0,
+                        "decode_steps": 2336}}
+    worse = {"results": {"decode_speedup": 4.0,
+                         "incremental_tokens_per_s": 900.0,
+                         "continuous_tokens_per_s": 2200.0,
+                         "continuous_vs_flush_speedup": 40.0,
+                         "decode_steps": 2336}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "results.incremental_tokens_per_s") == "higher"
+    assert bench_compare._direction(
+        "results.decode_speedup") == "higher"
+    assert rows["results.decode_speedup"][4]  # prefix re-execution back
+    assert rows["results.incremental_tokens_per_s"][4]
+    assert not rows["results.continuous_tokens_per_s"][4]
+    assert "results.decode_steps" not in rows  # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_sharding_metrics():
     """BENCH_SHARD_r15.json names: efficiency and the plan-vs-replicated
     speedup are higher-is-better, update/step ms lower-is-better, the

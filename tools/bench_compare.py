@@ -28,7 +28,10 @@ inferred from the leaf name:
   (BENCH_SHARD_r15.json scaling-efficiency ratios — the fraction of
   ideal multi-device speedup the sharded fused step actually
   delivers; a drop means the plan-driven partitioning stopped
-  scaling)
+  scaling), ``*tokens_per*`` (BENCH_DECODE_r16.json decode
+  throughput — incremental/continuous-batching tokens per second;
+  fewer tokens/s at like-for-like load means the stateful serving
+  path re-executed work it should have carried in state slots)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -49,7 +52,7 @@ LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "overhead", "shed", "nodes", "trace")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput",
-                    "efficiency")
+                    "efficiency", "tokens_per")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)
