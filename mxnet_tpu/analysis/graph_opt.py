@@ -37,11 +37,18 @@ Shipped rewrite passes, in pipeline order:
                       collapses ``reshape``-of-``reshape`` chains when
                       the outer spec is position-independent (all
                       positive dims, at most one -1).
+``fusion``            fusion clustering (round 17, analysis/fusion.py):
+                      elementwise chains, layer_norm+activation, and
+                      score→softmax→weighted-sum attention collapse
+                      into single fused ops from ``mxnet_tpu.kernels``
+                      when the cost model says the cluster wins; gated
+                      by ``MXNET_FUSION`` / ``MXNET_FUSION_PATTERNS``.
 ``dce``               dead-node elimination: reachability from the
                       heads over the work list; rewrite-orphaned
-                      subgraphs (a folded constant's old inputs) are
-                      dropped. Heads always survive — ``grad_req``
-                      outputs are never eliminated.
+                      subgraphs (a folded constant's old inputs, a
+                      fused cluster's interior) are dropped. Heads
+                      always survive — ``grad_req`` outputs are never
+                      eliminated.
 
 Gating: ``MXNET_GRAPH_OPT=0`` (default, off) | ``1`` (one sweep) | ``2``
 (fixpoint, bounded iterations). Every optimized graph is re-verified
@@ -69,7 +76,7 @@ __all__ = [
 #: fingerprint that can see optimized graphs, so optimized and
 #: unoptimized artifacts (or artifacts from different pipeline
 #: generations) never collide on disk
-PIPELINE_VERSION = "graphopt-r14.0"
+PIPELINE_VERSION = "graphopt-r17.0"
 
 #: verifier passes run before/after rewriting (no eval_shape: the
 #: whole-graph jax.eval_shape cross-check would eat the trace-time win
@@ -145,7 +152,10 @@ def fingerprint_salt(level=None):
     pre-existing level-0 disk entries keep their keys."""
     lvl = opt_level() if level is None else lvl_clamp(level)
     if lvl > 0:
-        return ("graph_opt", lvl, PIPELINE_VERSION)
+        from .. import kernels
+
+        return ("graph_opt", lvl, PIPELINE_VERSION,
+                kernels.fusion_salt())
     return ("graph_opt", 0)
 
 
@@ -590,7 +600,8 @@ dce_pass = RewritePass("dce", _dce, "dead-node elimination from heads")
 REWRITE_PASSES = {p.name: p for p in
                   (fold_pass, cse_pass, transpose_elision_pass, dce_pass)}
 
-DEFAULT_REWRITE_PIPELINE = ("fold", "cse", "transpose_elision", "dce")
+DEFAULT_REWRITE_PIPELINE = ("fold", "cse", "transpose_elision",
+                            "fusion", "dce")
 
 
 # ---------------------------------------------------------------------------
@@ -687,8 +698,20 @@ def optimize_symbol(symbol, shapes=None, dtypes=None, level=None,
         _count("graphs_rejected")
         stats["rejected"] = True
         stats["nodes_after"] = stats["nodes_before"]
+        if any(p["pass"] == "fusion" and p["rewrites"]
+               for p in pass_stats):
+            # the fused graph was among what verify threw out: record
+            # the clean fallback on the fusion side too
+            from .. import kernels
+
+            kernels._count("fallback_post_verify")
         return symbol, stats
     _count("graphs_optimized")
     _count("nodes_before_total", stats["nodes_before"])
     _count("nodes_after_total", stats["nodes_after"])
     return optimized, stats
+
+
+# registers the round-17 fusion pass (+ its facts) into
+# REWRITE_PASSES; imported last so the pass infra above is complete
+from . import fusion  # noqa: E402,F401

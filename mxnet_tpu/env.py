@@ -103,6 +103,27 @@ KNOBS = {
         "off) | 1 (one pipeline sweep) | 2 (fixpoint). Every optimized "
         "graph is re-verified; new diagnostics reject the rewrite; "
         "see docs/ANALYSIS.md"),
+    "MXNET_FUSION": (
+        "wired", "kernels + analysis.fusion",
+        "fusion-clustering kill switch for the round-17 graph-opt "
+        "pass: 1 (default) clusters elementwise chains, "
+        "layer_norm+activation, and score/softmax/weighted-sum "
+        "attention into single fused kernels-package ops (and arms the "
+        "serving fused pad/slice); 0 disables every fusion path while "
+        "leaving the rest of MXNET_GRAPH_OPT intact"),
+    "MXNET_FUSION_PATTERNS": (
+        "wired", "kernels + analysis.fusion",
+        "comma list of armed cluster patterns out of elementwise, "
+        "norm_act, attention, serving (default: all four); unknown "
+        "names are ignored. Part of the compile-cache fingerprint "
+        "salt, so toggling never collides cached executables"),
+    "MXNET_FUSION_COST_MODEL": (
+        "wired", "kernels.cost_model",
+        "cluster profitability policy: heuristic (default — fuse when "
+        "the saved dispatches beat the estimated bandwidth cost, "
+        "Pallas only on TPU at tile-aligned shapes) | always (fuse "
+        "every match; bench/debug) | never (match + count but keep "
+        "the 1:1 lowering)"),
     "MXNET_TEST_SEED": (
         "wired", "test_utils",
         "fixed seed for test_utils.set_default_context/seeded test "

@@ -708,7 +708,8 @@ class SymbolBlock(HybridBlock):
 
     def _optimized_outputs(self):
         """MXNET_GRAPH_OPT-gated rewrite of the output graph, cached per
-        (level, pipeline version). Every forward — eager, under the
+        (level, pipeline version, fusion salt) so toggling the fusion
+        knobs re-optimizes. Every forward — eager, under the
         hybridized CachedOp trace, and the serving session's ``_pure``
         — evaluates this graph, so one rewrite covers all three."""
         from ..analysis import graph_opt
@@ -716,7 +717,10 @@ class SymbolBlock(HybridBlock):
         level = graph_opt.opt_level()
         if level <= 0:
             return self._outputs
-        tag = (level, graph_opt.PIPELINE_VERSION)
+        from .. import kernels
+
+        tag = (level, graph_opt.PIPELINE_VERSION,
+               kernels.fusion_salt())
         cached = getattr(self, "_graph_opt_cache", None)
         if cached is None or cached[0] != tag:
             opt, _ = graph_opt.optimize_symbol(

@@ -1,0 +1,53 @@
+"""Fused attention cluster op: score → softmax → weighted sum.
+
+The clustering pass matches the composed primitive form
+``batch_dot(softmax(batch_dot(q, k, transpose_b=True) [*/ scale]), v)``
+and lowers it here. Two implementations:
+
+- ``lax`` (portable fallback, bit-identical): replay the registered
+  ``batch_dot`` / scalar-scale / ``softmax`` bodies in one dispatch.
+- ``pallas`` (TPU): the blockwise online-softmax flash kernel from
+  ``kernels/flash_attention.py`` — O(S·D) memory instead of the
+  materialized S² score matrix (documented-ulp: online softmax
+  reassociates the reduction). ``impl="interpret"`` runs the same
+  kernel interpreted for off-TPU parity tests.
+"""
+from __future__ import annotations
+
+from ..ndarray.registry import get_op, register
+
+
+def _replay_lax(q, k, v, scale_op, scale, softmax_kw):
+    """The unfused graph, replayed body-for-body in one dispatch."""
+    bd = get_op("batch_dot").fn
+    s = bd(q, k, transpose_b=True)
+    if scale_op == "mul":
+        s = get_op("broadcast_mul_scalar").fn(s, scalar=scale)
+    elif scale_op == "div":
+        s = get_op("broadcast_div_scalar").fn(s, scalar=scale)
+    p = get_op("softmax").fn(s, **dict(softmax_kw))
+    return bd(p, v)
+
+
+@register("_fused_attention", namespaces=())
+def _fused_attention(q, k, v, scale_op="none", scale=1.0, softmax_kw=(),
+                     impl="lax"):
+    """Fused score→softmax→weighted-sum attention cluster emitted by
+    the analysis/fusion clustering pass over (B, S, D) operands.
+    ``impl="lax"`` replays the registered batch_dot/softmax bodies in
+    one dispatch (bit-identical to the unfused subgraph);
+    ``impl="pallas"`` runs the flash-attention TPU kernel
+    (documented-ulp: online softmax); ``impl="interpret"`` interprets
+    that kernel off-TPU for parity tests. (Reference: the composed
+    src/operator/tensor/dot.cc + nn/softmax.cc subgraph.)"""
+    if impl in ("pallas", "interpret"):
+        from .flash_attention import _flash
+
+        sm_scale = (float(scale) if scale_op == "mul"
+                    else 1.0 / float(scale) if scale_op == "div"
+                    else 1.0)
+        # flash operates on (B, H, S, D): ride a singleton head axis
+        out = _flash(q[:, None], k[:, None], v[:, None], sm_scale,
+                     False, impl)
+        return out[:, 0]
+    return _replay_lax(q, k, v, scale_op, scale, softmax_kw)

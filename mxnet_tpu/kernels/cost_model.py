@@ -1,0 +1,75 @@
+"""Per-cluster cost model: fuse or keep the 1:1 lowering, and with
+which implementation.
+
+The decision is dispatch-oriented, per the round-14 measurement that
+eager/serving hot paths are dominated by per-node dispatch (128→29
+nodes bought 3.73x): a cluster of N ops saves N-1 dispatches whatever
+the backend, so the lax fallback is profitable as soon as a cluster is
+non-trivial. Pallas is only ever *selected* on TPU and only when the
+shapes meet the fp32 tile floor — everywhere else the kernel would run
+interpreted (orders of magnitude slower), so the model never picks it
+off-TPU (tests force it via ``impl=`` for parity checks).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: fp32 minimum tile (sublane, lane) a Pallas TPU kernel wants aligned
+_TILE_ROWS = 8
+_TILE_COLS = 128
+
+#: a fused elementwise cluster must absorb at least this many ops —
+#: below it there is no dispatch to save
+MIN_CLUSTER = 2
+
+
+@dataclass(frozen=True)
+class Decision:
+    """Outcome of one cluster decision. ``fuse=False`` keeps the 1:1
+    lowering; ``reason`` names why (the fallbacks-by-reason counter
+    family); ``impl`` is ``lax`` or ``pallas`` when fusing."""
+    fuse: bool
+    impl: str = "lax"
+    reason: str = "ok"
+
+
+def _pallas_viable(pattern, out_shape):
+    """True when the pattern has a TPU kernel AND the output shape meets
+    the tile floor (misaligned shapes pay relayout more than the kernel
+    wins)."""
+    if pattern not in ("norm_act", "attention"):
+        return False
+    if not out_shape or len(out_shape) < 2:
+        return False
+    return (out_shape[-1] % _TILE_COLS == 0
+            and out_shape[-2] % _TILE_ROWS == 0)
+
+
+def decide(pattern, n_nodes, out_shape=None, backend="cpu",
+           mode="heuristic"):
+    """Decide one cluster: ``Decision(fuse, impl, reason)``.
+
+    ``pattern`` is the cluster kind, ``n_nodes`` the member-op count,
+    ``out_shape`` the cluster output shape when the shape fact resolved
+    it (None otherwise), ``backend`` the jax default backend, ``mode``
+    the ``MXNET_FUSION_COST_MODEL`` knob.
+    """
+    if mode == "never":
+        return Decision(False, reason="cost_model_never")
+    impl = ("pallas" if backend == "tpu"
+            and _pallas_viable(pattern, out_shape) else "lax")
+    if mode == "always":
+        return Decision(True, impl=impl)
+    if n_nodes < MIN_CLUSTER:
+        # a 1-op "cluster" saves zero dispatches and costs a retrace
+        return Decision(False, reason="too_small")
+    if pattern == "elementwise" and out_shape is not None:
+        size = 1
+        for d in out_shape:
+            size *= int(d)
+        if size > (1 << 22):
+            # past ~4M elements the chain is bandwidth-bound and XLA's
+            # own loop fusion already covers it; the fused dispatch
+            # saves nothing but costs a fresh executable
+            return Decision(False, reason="bandwidth_bound")
+    return Decision(True, impl=impl)

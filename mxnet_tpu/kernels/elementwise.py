@@ -1,0 +1,67 @@
+"""Fused elementwise-chain cluster op.
+
+XLA fuses elementwise chains *inside* one compiled program, but every
+eager / serving dispatch pays one executable call per node — the gap
+"Operator Fusion in XLA" documents. The cluster op replays the member
+ops' REGISTERED bodies inside one dispatch: same primitives in the
+same order, so results are bit-identical to the unfused graph, and the
+chain costs one compiled-executable call instead of N.
+
+The cluster program is carried in the (static, hashable) ``program``
+kwarg: a tuple of ``(opname, arg_slots, kw_items)`` steps over a slot
+file whose first ``len(data)`` slots are the cluster inputs; each step
+appends one slot and the last slot is the cluster output.
+"""
+from __future__ import annotations
+
+from ..ndarray.registry import get_op, register
+
+#: ops the clustering pass may absorb into an elementwise chain — pure,
+#: single-output, shape-broadcasting bodies only (comparisons/logicals
+#: stay out: their bool→input-dtype casts interact with promotion in
+#: ways a cluster should not re-derive)
+ELEMENTWISE_OPS = frozenset({
+    # unary
+    "relu", "sigmoid", "hard_sigmoid", "softsign", "rsqrt", "rcbrt",
+    "exp", "expm1", "log", "log1p", "log2", "log10", "sqrt", "cbrt",
+    "square", "abs", "sign", "negative", "reciprocal", "erf", "erfinv",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "tanh", "arcsinh", "arccosh", "arctanh", "floor", "ceil", "round",
+    "rint", "trunc", "fix", "gamma", "gammaln", "clip",
+    # binary (broadcasting + equal-shape aliases)
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_power", "broadcast_maximum", "broadcast_minimum",
+    "broadcast_hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div", "maximum", "minimum", "hypot", "add_n",
+    # scalar forms (scalar rides in kwargs — static under jit)
+    "broadcast_add_scalar", "broadcast_sub_scalar",
+    "broadcast_mul_scalar", "broadcast_div_scalar",
+    "broadcast_power_scalar", "maximum_scalar", "minimum_scalar",
+    # parameterized activations (elementwise over their one input)
+    "activation", "leaky_relu",
+})
+
+
+def run_program(program, slots):
+    """Replay ``program`` over the slot file (shared by the fused op
+    body and the fusion pass's golden tests)."""
+    for opname, arg_slots, kw_items in program:
+        opdef = get_op(opname)
+        if opdef is None:
+            raise ValueError(
+                f"fused elementwise program references unregistered op "
+                f"{opname!r}")
+        slots.append(opdef.fn(*[slots[i] for i in arg_slots],
+                              **dict(kw_items)))
+    return slots[-1]
+
+
+@register("_fused_elementwise", namespaces=())
+def _fused_elementwise(*data, program=()):
+    """Fused elementwise cluster: replay ``program`` (tuple of
+    ``(opname, arg_slots, kw_items)`` steps over a slot file seeded
+    with ``data``) in one dispatch. Emitted by the analysis/fusion
+    clustering pass; bit-identical to the unfused chain (reference:
+    src/operator/fusion/fused_op.cu — the reference's RTC pointwise
+    fusion, rebuilt as registered-body replay under one jit)."""
+    return run_program(program, list(data))

@@ -1,0 +1,86 @@
+"""Serving specialization: fused pad and fused slice for the bucket
+request path.
+
+``InferenceSession._run_bucket`` pays one eager dispatch PER INPUT to
+pad device arrays up to the bucket boundary and one PER OUTPUT to
+slice the padded rows back off. For multi-tensor models that overhead
+scales with arity, not with work. The fused helpers here collapse each
+side to a single jitted call: all inputs pad in one executable, all
+outputs slice in one executable (keyed by bucket/true-rows + avals,
+so steady-state traffic replays cached executables).
+
+The pad math replays ``compile_cache.pad_batch`` exactly (zero-fill
+concat) and the slice is ``[:n]`` per array — results are
+bit-identical to the unfused path. Gated by the ``serving`` entry in
+``MXNET_FUSION_PATTERNS`` and the ``MXNET_FUSION`` kill switch.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..utils import compile_cache as cc
+from . import _count, enabled_patterns, fusion_enabled
+
+_LOCK = threading.Lock()
+_PAD_JITS = {}  # bucket -> jitted tuple-pad
+_SLICE_JITS = {}  # (bucket, true_rows) -> jitted tuple-slice
+
+
+def serving_fusion_enabled():
+    """True when the serving pad/slice specialization is armed."""
+    return fusion_enabled() and "serving" in enabled_patterns()
+
+
+def _pad_jit(bucket):
+    fn = _PAD_JITS.get(bucket)
+    if fn is None:
+        with _LOCK:
+            fn = _PAD_JITS.get(bucket)
+            if fn is None:
+                def pad_all(*datas):
+                    """Fused bucket pad (bucket %d)."""
+                    return tuple(cc.pad_batch(d, bucket) for d in datas)
+
+                pad_all.__doc__ = pad_all.__doc__ % bucket
+                fn = cc.counting_jit(pad_all, label="fusion_pad")
+                _PAD_JITS[bucket] = fn
+    return fn
+
+
+def _slice_jit(bucket, true):
+    fn = _SLICE_JITS.get((bucket, true))
+    if fn is None:
+        with _LOCK:
+            fn = _SLICE_JITS.get((bucket, true))
+            if fn is None:
+                def slice_all(*outs):
+                    """Fused bucket slice (%d -> %d rows)."""
+                    # slice_batch semantics: only axis-0-padded outputs
+                    # shrink; anything else passes through untouched
+                    return tuple(
+                        o[:true] if o.ndim and o.shape[0] == bucket
+                        else o for o in outs)
+
+                slice_all.__doc__ = slice_all.__doc__ % (bucket, true)
+                fn = cc.counting_jit(slice_all, label="fusion_slice")
+                _SLICE_JITS[(bucket, true)] = fn
+    return fn
+
+
+def pad_all(datas, bucket):
+    """Pad every array in ``datas`` up to ``bucket`` rows in ONE
+    dispatch. Arrays already at the boundary pass through inside the
+    same executable (XLA elides the no-op concat)."""
+    if all(d.shape[0] == bucket for d in datas):
+        return list(datas)  # nothing to pad: no dispatch at all
+    _count("serving_pad_fused")
+    return list(_pad_jit(bucket)(*datas))
+
+
+def slice_all(outs, bucket, true):
+    """Slice every padded output back to ``true`` rows in ONE
+    dispatch (the fused inverse of :func:`pad_all`)."""
+    if bucket == true:
+        return list(outs)
+    _count("serving_slice_fused")
+    return list(_slice_jit(bucket, true)(*outs))

@@ -1,10 +1,11 @@
-"""Pallas/Mosaic TPU kernels.
+"""High-level entry points for hand-scheduled ops.
 
-Home of hand-written kernels for ops the reference implements in raw CUDA
-(reference: src/operator/contrib/ multibox*, roi_align, deformable conv,
-nms; SURVEY §2.2 contrib row). Standard ops live as XLA-lowered bodies in
-mxnet_tpu.ndarray.ops_*; only genuinely fusion-resistant ops get Pallas
-kernels here.
+The Pallas kernels themselves moved to ``mxnet_tpu.kernels`` in round
+17 (the only package allowed to import Pallas — graft_lint L801); this
+package keeps the public op-level API for ops the reference implements
+in raw CUDA (reference: src/operator/contrib/ multibox*, roi_align,
+deformable conv, nms; SURVEY §2.2 contrib row). Standard ops live as
+XLA-lowered bodies in mxnet_tpu.ndarray.ops_*.
 """
 from .flash_attention import flash_attention  # noqa: F401,E402
 

@@ -212,6 +212,19 @@ def graph_opt_counters():
         return {}
 
 
+def fusion_counters():
+    """Fusion-clustering counters (clusters formed per pattern, nodes
+    absorbed, impl selections, fallbacks by reason, serving fused
+    pad/slice hits), live from mxnet_tpu.kernels. Zeros before the
+    first fused optimization (MXNET_FUSION gated)."""
+    try:
+        from .kernels import counters
+
+        return counters()
+    except Exception:
+        return {}
+
+
 def sharding_counters():
     """Rule-based SPMD sharding counters (plans built, rules matched/
     unmatched, divisibility fallbacks, fused-step groups compiled under
@@ -281,6 +294,10 @@ def dump(finished=True, profile_process="worker"):
              "ph": "C", "ts": ts, "pid": 0,
              "args": {cname: float(cval) if isinstance(cval, float)
                       else cval}})
+    for cname, cval in sorted(fusion_counters().items()):
+        payload["traceEvents"].append(
+            {"name": f"fusion/{cname}", "cat": "counter",
+             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
     for cname, cval in sorted(compile_cache_counters().items()):
         payload["traceEvents"].append(
             {"name": f"compile_cache/{cname}", "cat": "counter",

@@ -96,6 +96,14 @@ def _detect():
     except Exception:
         feats["GRAPH_OPT"] = False
     try:
+        from .kernels import fusion_enabled
+
+        # fusion clustering armed (MXNET_FUSION, kernels/ +
+        # analysis/fusion.py)
+        feats["FUSION"] = fusion_enabled()
+    except Exception:
+        feats["FUSION"] = False
+    try:
         from .sharding import sharding_enabled
 
         # rule-based SPMD sharding plans armed (MXNET_SHARDING,

@@ -481,6 +481,15 @@ class ServingMetrics:
         emit("mxnet_serving_slot_occupancy", self.slot_occupancy(),
              help_="live sessions holding server-side state slots",
              typ="gauge")
+        try:
+            from ..kernels import counters as _fusion_counters
+
+            fam = "mxnet_fusion"
+            for name, value in sorted(_fusion_counters().items()):
+                emit(f"{fam}_{name}_total", value,
+                     help_=f"fusion clustering counter {name}")
+        except Exception:  # graft-lint: allow(L501)
+            pass  # fusion counters are best-effort on this surface
         for name, snap, bounds, help_ in hists:
             lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} histogram")

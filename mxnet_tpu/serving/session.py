@@ -873,18 +873,33 @@ class InferenceSession:
         # recorded outcome would leak the probe slot and wedge the
         # bucket in fail-fast forever.
         try:
+            from ..kernels import serving_fused as _sf
+
             ent = self._entry(bucket)
-            datas = []
-            for a in arrs:
+            fuse_pad = _sf.serving_fusion_enabled()
+            datas = [None] * len(arrs)
+            dev_idx, dev_arrs = [], []
+            for i, a in enumerate(arrs):
                 if isinstance(a, NDArray):
-                    datas.append(cc.pad_batch(a.data, bucket))
+                    # device inputs: fused path pads ALL of them in
+                    # one dispatch; legacy path pays one per input
+                    dev_idx.append(i)
+                    dev_arrs.append(a.data)
                 else:
                     if a.shape[0] != bucket:
                         padded = onp.zeros((bucket,) + a.shape[1:],
                                            a.dtype)
                         padded[:a.shape[0]] = a
                         a = padded
-                    datas.append(nd.array(a).data)
+                    datas[i] = nd.array(a).data
+            if dev_arrs:
+                if fuse_pad:
+                    padded = _sf.pad_all(dev_arrs, bucket)
+                else:
+                    padded = [cc.pad_batch(d, bucket)
+                              for d in dev_arrs]
+                for i, p in zip(dev_idx, padded):
+                    datas[i] = p
             key = mxrandom.next_key()
             if self._shard is not None:
                 # inputs ride the mesh replicated (eager arrays commit
@@ -908,6 +923,8 @@ class InferenceSession:
         METRICS.bump("true_rows", n)
         if bucket == n:
             return list(out)  # nothing padded: no slice op to pay
+        if fuse_pad:
+            return _sf.slice_all(list(out), bucket, n)
         return [cc.slice_batch(o, bucket, n) for o in out]
 
     # -- the stateful decode path -------------------------------------

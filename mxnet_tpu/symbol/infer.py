@@ -117,12 +117,16 @@ def _array_arg_names(opdef):
             if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
 
 
-def infer_shapes(symbol, known, allow_unknown=False):
+def infer_shapes(symbol, known, allow_unknown=False,
+                 return_node_shapes=False):
     """Walk the DAG; return ({var_name: shape}, [output shapes]).
 
     `known` maps variable names to shapes. Unknown parameter shapes are
     filled by layer rules; raises if a needed shape stays unknown
-    (unless allow_unknown).
+    (unless allow_unknown). With ``return_node_shapes`` the per-node
+    table (``id(node) -> shape | list-of-shapes``) rides along as a
+    third element — the fusion cost model prices clusters off it
+    without a second walk.
     """
     order = symbol._walk()
     var_shapes = dict(known)
@@ -196,6 +200,8 @@ def infer_shapes(symbol, known, allow_unknown=False):
         if isinstance(s, list):
             s = s[h._output_index]
         out_shapes.append(s)
+    if return_node_shapes:
+        return var_shapes, out_shapes, node_out
     return var_shapes, out_shapes
 
 

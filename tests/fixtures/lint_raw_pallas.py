@@ -1,0 +1,25 @@
+# graft-lint: scope(pallas-kernels)
+"""Seeded graft_lint L801 fixture: raw Pallas imports.
+
+NOT part of the framework — tests/test_graft_lint.py lints this file
+and asserts the rule catches every import form (module import, dotted
+tpu submodule, from-experimental, from-pallas) and honors the pragma'd
+site. Keep the violation inventory in sync with the test.
+"""
+import jax.experimental.pallas
+import jax.experimental.pallas.tpu as pltpu
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import BlockSpec
+
+
+def allowed_site():
+    """A deliberate non-kernels Pallas site, pragma'd — stays clean."""
+    from jax.experimental import pallas  # graft-lint: allow(L801)
+    return pallas
+
+
+def not_pallas():
+    """Sibling experimental imports must stay clean."""
+    from jax.experimental import mesh_utils
+    import jax.experimental.shard_map as sm
+    return mesh_utils, sm

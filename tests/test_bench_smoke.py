@@ -148,12 +148,38 @@ def test_graphopt_bench_smoke(tmp_path):
     assert r["graph_nodes_after"] < r["graph_nodes_before"]
     assert r["bind_nodes_opt2"] < r["bind_nodes_opt0"]
     assert not r["rejected"]
-    # every shipped pass fired on the redundant benchmark graph
+    # every shipped pass fired on the redundant benchmark graph —
+    # except fusion, which the legacy run pins off (MXNET_FUSION=0)
+    # to keep the r14 ledger comparable; --fusion measures it
     assert set(r["rewrites_per_pass"]) == \
-        {"fold", "cse", "transpose_elision", "dce"}
-    assert all(v > 0 for v in r["rewrites_per_pass"].values())
+        {"fold", "cse", "transpose_elision", "fusion", "dce"}
+    assert r["rewrites_per_pass"]["fusion"] == 0
+    assert all(v > 0 for k, v in r["rewrites_per_pass"].items()
+               if k != "fusion")
     with open(out) as f:
         assert json.load(f)["benchmark"] == "graph_opt"
+
+
+@pytest.mark.slow
+def test_fusion_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import graphopt_bench
+
+    out = str(tmp_path / "fusion.json")
+    doc = graphopt_bench.run_fusion(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    # parity contract (bitwise or documented ulp) holds at any scale;
+    # the >=1.1x two-pattern speedup gate is only enforced on the
+    # committed full run (BENCH_FUSION_r17.json)
+    assert set(doc["patterns"]) == \
+        {"elementwise", "norm_act", "attention", "serving"}
+    for row in doc["patterns"].values():
+        assert row["bitwise_equal"] or row["max_abs_err"] <= 1e-6
+        assert row["fused_ms"] > 0 and row["unfused_ms"] > 0
+    for zoo_row in doc["zoo"].values():
+        assert zoo_row["clusters_total"] >= 1
+        assert 0.0 < zoo_row["hit_rate"] <= 1.0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "fusion"
 
 
 @pytest.mark.slow
@@ -262,6 +288,31 @@ def test_bench_compare_graphopt_metrics():
     assert rows["results.exec_speedup"][4]
     assert not rows["results.compile_speedup"][4]
     assert "results.rewrites" not in rows            # not a direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
+def test_bench_compare_fusion_metrics():
+    """BENCH_FUSION_r17.json names: fused/unfused ms lower-is-better,
+    speedup and the zoo cluster hit_rate higher-is-better; cluster
+    counters and max_abs_err untracked."""
+    base = {"patterns": {"elementwise": {
+                "unfused_ms": 0.37, "fused_ms": 0.12, "speedup": 3.2,
+                "max_abs_err": 0.0}},
+            "zoo": {"resnet18_v1": {"hit_rate": 0.18,
+                                    "clusters_total": 8}}}
+    worse = {"patterns": {"elementwise": {
+                "unfused_ms": 0.37, "fused_ms": 0.30, "speedup": 1.2,
+                "max_abs_err": 0.0}},
+            "zoo": {"resnet18_v1": {"hit_rate": 0.05,
+                                    "clusters_total": 2}}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert rows["patterns.elementwise.fused_ms"][4]   # 2.5x: REGRESSED
+    assert rows["patterns.elementwise.speedup"][4]
+    assert rows["zoo.resnet18_v1.hit_rate"][4]        # matchers quiet
+    assert bench_compare._direction(
+        "zoo.resnet18_v1.hit_rate") == "higher"
+    assert "zoo.resnet18_v1.clusters_total" not in rows
+    assert "patterns.elementwise.max_abs_err" not in rows
     assert not any(r[4] for r in bench_compare.compare(base, base))
 
 

@@ -22,6 +22,8 @@ MUT_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_graph_mutation.py")
 SHARD_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                              "lint_raw_sharding.py")
+PALLAS_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                              "lint_raw_pallas.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -194,6 +196,47 @@ def test_raw_sharding_scope_exempts_subsystem(tmp_path):
         own.write_text(src)
         assert graft_lint.lint_paths([str(own)], repo_root=REPO,
                                      registry=False) == [], exempt
+
+
+def test_raw_pallas_fixture_triggers_l801():
+    """L801: every Pallas import form in the seeded fixture is flagged
+    — module import, dotted tpu submodule, from-experimental, and
+    from-pallas — while the pragma'd site and sibling experimental
+    imports stay clean."""
+    findings = graft_lint.lint_paths([PALLAS_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l801 = [f for f in findings if f.code == "L801"]
+    assert len(l801) == 4, findings
+    src = open(PALLAS_FIXTURE).read().splitlines()
+    for f in l801:
+        assert "pallas" in src[f.line - 1], (f.line, src[f.line - 1])
+    # the allow(L801) site and the non-pallas imports stay clean
+    assert all(f.line < 15 for f in l801), l801
+    assert {f.code for f in findings} == {"L801"}, findings
+
+
+def test_raw_pallas_scope_exempts_kernels_package(tmp_path):
+    """L801 binds mxnet_tpu/ automatically but exempts
+    mxnet_tpu/kernels/ (which owns the Pallas code); outside the
+    package it is opt-in via scope(pallas-kernels)."""
+    src = ("from jax.experimental import pallas as pl\n"
+           "def kern(x_ref, o_ref):\n"
+           "    o_ref[...] = x_ref[...]\n")
+    free = tmp_path / "kern_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "ndarray" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
+    assert codes == ["L801"], codes
+    own = tmp_path / "mxnet_tpu" / "kernels" / "frag.py"
+    own.parent.mkdir(parents=True)
+    own.write_text(src)
+    assert graft_lint.lint_paths([str(own)], repo_root=REPO,
+                                 registry=False) == []
 
 
 def test_l501_swallowed_variants(tmp_path):

@@ -31,7 +31,11 @@ inferred from the leaf name:
   scaling), ``*tokens_per*`` (BENCH_DECODE_r16.json decode
   throughput — incremental/continuous-batching tokens per second;
   fewer tokens/s at like-for-like load means the stateful serving
-  path re-executed work it should have carried in state slots)
+  path re-executed work it should have carried in state slots),
+  ``*hit_rate*`` (BENCH_FUSION_r17.json model-zoo cluster hit rate —
+  the fraction of fusion-pass decision points that formed a cluster;
+  a drop means a matcher or the cost model stopped firing on graphs
+  it used to fuse)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -52,7 +56,7 @@ LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "overhead", "shed", "nodes", "trace")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput",
-                    "efficiency", "tokens_per")
+                    "efficiency", "tokens_per", "hit_rate")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)

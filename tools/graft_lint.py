@@ -91,6 +91,19 @@ package:
                        own specs (executor dp-sharding, kvstore
                        key-sharding, MoE expert placement) carry
                        ``# graft-lint: allow(L701)``.
+``L801 raw-pallas``    a Pallas import (``import
+                       jax.experimental.pallas[.tpu]``, ``from
+                       jax.experimental import pallas``, or ``from
+                       jax.experimental.pallas[...] import ...``)
+                       inside ``mxnet_tpu/`` but outside
+                       ``mxnet_tpu/kernels/``. Hand-scheduled kernels
+                       live in ONE package behind registered fused ops
+                       with lax fallbacks, so every Pallas call site
+                       sits behind the fusion cost model, the
+                       ``MXNET_FUSION`` kill switch and the
+                       interpret-mode parity tests; an import
+                       elsewhere bypasses all three. A deliberate
+                       site carries ``# graft-lint: allow(L801)``.
 ``jit-nocache``        a raw ``jax.jit`` call site inside ``mxnet_tpu/``
                        that bypasses the compile-cache helpers
                        (``utils.compile_cache.counting_jit`` or the AOT
@@ -607,6 +620,56 @@ def check_raw_sharding_construction(path, tree, source, findings):
                 "allow(L701)"))
 
 
+_PALLAS_MODULE = "jax.experimental.pallas"
+
+
+def _pallas_import_scoped(path, source):
+    """Files the L801 kernel-discipline applies to: all of
+    ``mxnet_tpu/`` EXCEPT ``mxnet_tpu/kernels/`` (the one package that
+    owns Pallas code). Code outside the package opts in with a
+    ``# graft-lint: scope(pallas-kernels)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/kernels/" in norm:
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(pallas-kernels)" in source
+
+
+def check_raw_pallas_import(path, tree, source, findings):
+    """L801: a Pallas import outside ``mxnet_tpu/kernels/``. The
+    round-17 contract mirrors L701's: hand-scheduled kernels live in
+    ONE package, behind registered fused ops with lax fallbacks, so
+    every Pallas call site is reachable by the cost model, the
+    ``MXNET_FUSION`` kill switch, and the interpret-mode parity tests.
+    A Pallas import elsewhere bypasses all three. Catches ``import
+    jax.experimental.pallas[.tpu]``, ``from jax.experimental import
+    pallas``, and ``from jax.experimental.pallas[.x] import ...``."""
+    if not _pallas_import_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+    for node in ast.walk(tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(a.name == _PALLAS_MODULE or
+                      a.name.startswith(_PALLAS_MODULE + ".")
+                      for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            hit = (mod == _PALLAS_MODULE or
+                   mod.startswith(_PALLAS_MODULE + ".") or
+                   (mod == "jax.experimental" and
+                    any(a.name == "pallas" for a in node.names)))
+        if hit and not pragmas.allows(node.lineno, "L801"):
+            findings.append(Finding(
+                "L801", path, node.lineno,
+                "Pallas import outside mxnet_tpu/kernels/ — "
+                "hand-scheduled kernels live in the kernels package "
+                "behind registered fused ops (cost model + "
+                "MXNET_FUSION gate + interpret parity tests); "
+                "annotate a deliberate site with allow(L801)"))
+
+
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
@@ -767,6 +830,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_wallclock_deadlines(path, tree, source, findings)
         check_graph_mutation(path, tree, source, findings)
         check_raw_sharding_construction(path, tree, source, findings)
+        check_raw_pallas_import(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
