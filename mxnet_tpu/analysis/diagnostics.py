@@ -17,9 +17,9 @@ according to ``MXNET_GRAPH_VERIFY``:
 from __future__ import annotations
 
 import logging
-import threading
 
 from ..base import MXNetError
+from ..telemetry import metrics as _telemetry
 
 __all__ = ["Diagnostic", "DiagnosticReport", "GraphVerifyError", "CODES",
            "SEV_ERROR", "SEV_WARNING", "verify_mode", "counters",
@@ -145,34 +145,32 @@ def verify_mode():
 
 
 # ---------------------------------------------------------------------------
-# counters (surfaced through profiler.graph_verify_counters)
+# counters (surfaced through profiler.graph_verify_counters;
+# registry-owned telemetry families since round 18)
 
-_LOCK = threading.Lock()
-_COUNTERS = {"graphs_checked": 0, "diagnostics": 0, "errors": 0,
-             "warnings": 0}
-_BY_CODE = {}
+_COUNTERS = _telemetry.counter_family(
+    "graph_verify", {"graphs_checked": 0, "diagnostics": 0, "errors": 0,
+                     "warnings": 0})
+# "_"-prefixed: merged into the "graph_verify" probe by counters()
+_BY_CODE = _telemetry.counter_family("_graph_verify_codes")
 
 
 def _count(report):
-    with _LOCK:
-        _COUNTERS["graphs_checked"] += 1
-        _COUNTERS["diagnostics"] += len(report)
-        _COUNTERS["errors"] += len(report.errors)
-        _COUNTERS["warnings"] += len(report.warnings)
-        for d in report:
-            _BY_CODE[d.code] = _BY_CODE.get(d.code, 0) + 1
+    _COUNTERS.add("graphs_checked")
+    _COUNTERS.add("diagnostics", len(report))
+    _COUNTERS.add("errors", len(report.errors))
+    _COUNTERS.add("warnings", len(report.warnings))
+    for d in report:
+        _BY_CODE.add(d.code)
 
 
 def counters():
     """Live verifier counters: totals + per-diagnostic-code tallies."""
-    with _LOCK:
-        out = dict(_COUNTERS)
-        out.update({f"code_{c}": n for c, n in sorted(_BY_CODE.items())})
-        return out
+    out = _COUNTERS.snapshot()
+    out.update({f"code_{c}": n for c, n in sorted(_BY_CODE.items())})
+    return out
 
 
 def reset_counters():
-    with _LOCK:
-        for k in _COUNTERS:
-            _COUNTERS[k] = 0
-        _BY_CODE.clear()
+    _COUNTERS.reset()
+    _BY_CODE.clear()

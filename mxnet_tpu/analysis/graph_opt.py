@@ -60,10 +60,11 @@ served — the subsystem polices its own output. Counters surface via
 from __future__ import annotations
 
 import logging
-import threading
 import time
 
 from .passes import FactError, PassContext, register_fact, run_passes
+from ..telemetry import metrics as _telemetry
+from ..telemetry import tracer as _telem
 
 __all__ = [
     "AnalysisPass", "RewritePass", "PassManager", "PIPELINE_VERSION",
@@ -89,45 +90,41 @@ _key = PassContext.node_key
 
 
 # ---------------------------------------------------------------------------
-# counters (surfaced through profiler.graph_opt_counters)
+# counters (surfaced through profiler.graph_opt_counters; registry-owned
+# telemetry families since round 18 — same mutation idiom, scrapeable)
 
-_LOCK = threading.Lock()
-_COUNTERS = {
+_COUNTERS = _telemetry.counter_family("graph_opt", {
     "graphs_seen": 0, "graphs_optimized": 0, "graphs_rejected": 0,
     "nodes_before_total": 0, "nodes_after_total": 0, "rewrites_total": 0,
     "shape_analysis_runs": 0, "dtype_analysis_runs": 0,
     "fact_cache_hits": 0,
-}
-_PASS_COUNTERS = {}
+})
+# "_"-prefixed: merged into the "graph_opt" probe by counters(), so it
+# must not ALSO surface as its own registry family
+_PASS_COUNTERS = _telemetry.counter_family("_graph_opt_passes")
 
 
 def _count(name, n=1):
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+    _COUNTERS.add(name, n)
 
 
 def _count_pass(name, rewrites, time_ms):
-    with _LOCK:
-        _PASS_COUNTERS[f"{name}_rewrites"] = \
-            _PASS_COUNTERS.get(f"{name}_rewrites", 0) + rewrites
-        _PASS_COUNTERS[f"{name}_time_ms"] = round(
-            _PASS_COUNTERS.get(f"{name}_time_ms", 0.0) + time_ms, 3)
+    _PASS_COUNTERS.add(f"{name}_rewrites", rewrites)
+    _PASS_COUNTERS.add(f"{name}_time_ms", time_ms)
 
 
 def counters():
     """Live optimizer counters: graph totals, per-pass rewrite counts
     and cumulative time, analysis-run/fact-cache tallies."""
-    with _LOCK:
-        out = dict(_COUNTERS)
-        out.update(sorted(_PASS_COUNTERS.items()))
-        return out
+    out = _COUNTERS.snapshot()
+    out.update((k, round(v, 3) if k.endswith("_time_ms") else v)
+               for k, v in sorted(_PASS_COUNTERS.items()))
+    return out
 
 
 def reset_counters():
-    with _LOCK:
-        for k in _COUNTERS:
-            _COUNTERS[k] = 0
-        _PASS_COUNTERS.clear()
+    _COUNTERS.reset()
+    _PASS_COUNTERS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -629,7 +626,11 @@ class PassManager:
             for rp in self.passes:
                 before = len(graph.nodes)
                 t0 = time.perf_counter()
-                n = rp.run(graph, ctx)
+                with _telem.span(f"graph_opt.{rp.name}", cat="graph_opt",
+                                 need=2, iteration=it,
+                                 nodes_before=before) as sp:
+                    n = rp.run(graph, ctx)
+                    sp.set(rewrites=n)
                 dt_ms = (time.perf_counter() - t0) * 1e3
                 stats.append({
                     "pass": rp.name, "iteration": it,
@@ -665,6 +666,16 @@ def optimize_symbol(symbol, shapes=None, dtypes=None, level=None,
     if lvl <= 0:
         return symbol, stats
     _count("graphs_seen")
+    with _telem.span("graph_opt.optimize", cat="graph_opt",
+                     subject=subject or "graph", level=lvl) as _osp:
+        out_symbol, stats = _optimize_inner(symbol, shapes, dtypes, lvl,
+                                            ctx, subject, passes, stats)
+        _osp.set(rewrites=stats["rewrites"], rejected=stats["rejected"])
+        return out_symbol, stats
+
+
+def _optimize_inner(symbol, shapes, dtypes, lvl, ctx, subject, passes,
+                    stats):
     if ctx is None:
         ctx = PassContext(symbol, shapes=shapes, dtypes=dtypes,
                           subject=subject)

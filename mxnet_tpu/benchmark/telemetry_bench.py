@@ -1,0 +1,393 @@
+"""Telemetry overhead benchmark + the committed sample trace.
+
+Round 18's tracer claims to be cheap enough to leave compiled into the
+hot paths (one env read per site disabled, a few microseconds per span
+enabled). This bench prices that claim on the two hottest loops and
+emits the Chrome-trace sample the docs point at:
+
+**Fused-step overhead.** ``Trainer.step`` over the round-7 parameter
+count at width 256 with ``MXNET_FUSED_STEP=1``, timed at
+``MXNET_TELEMETRY=0`` vs ``1`` — one structural span per warmed step
+(``fused_step.execute``; ``resolve``/``trace_compile`` only fire on
+cache misses). Both measurements use adjacent alternating pairs, each
+half is the min of two windows (filters one-sided preemption spikes),
+and the overhead is the MEDIAN of per-pair ratios, so CPU-frequency
+and scheduler drift (which moves on a scale of seconds) cancels
+instead of being charged to whichever side ran second. Every timed
+window starts from an empty ring and a collected heap, so level-0
+windows don't pay GC scans over event dicts a previous level-1 window
+allocated. Criterion (full mode): ``fused_step_overhead_pct < 2``.
+
+**Serving-throughput overhead.** Sustained drain rate of a warmed
+``DynamicBatcher`` sized to hold the whole request set (a deep
+8-layer serving model, 4-row payloads): one thread enqueues every
+request back to back while the worker drains full batches, timed from
+first submit to last future — a window the worker drain dominates, so
+the comparison prices the instrumented path (admission + queue-wait
+emits, four batch-level spans) without the multi-client GIL
+scheduling jitter that drowns a sub-5% signal. Same paired-median
+methodology and ring/GC hygiene. Criterion (full mode):
+``serving_overhead_pct < 3``.
+
+**Sample trace.** One level-1 recording of a pipelined training slice
+(``DeviceFeed`` prefetch feeding fused steps — the round-11 overlap,
+visible as ``pipeline.prefetch_stage`` on the feed worker lane running
+under ``fused_step.execute`` on the step lane) followed by one request
+through the batcher under ``trace_context``, so the whole serving
+lifecycle shares one trace id across the submit and worker lanes.
+Dumped via ``telemetry.dump_trace`` (default
+``BENCH_TELEM_r18.trace.json``) and re-loaded with ``json.load`` — the
+acceptance bar for the committed artifact. Full mode asserts the
+overlap was actually captured and the lifecycle is complete.
+
+Emits one JSON document (default ``BENCH_TELEM_r18.json``); also
+prints it. ``overhead_pct`` leaves are lower-is-better under
+``tools/bench_compare.py`` (the ``overhead`` name tag).
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.telemetry_bench [--smoke]
+        [--out FILE] [--trace-out FILE]
+
+``--smoke`` shrinks the loops for a CPU tier-1 time budget (structural
+checks only — sub-percent overhead gates need the full loop lengths).
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import statistics
+import time
+
+import numpy as onp
+
+_REQUEST_ID = "req-sample-0001"
+_LIFECYCLE = {"serving.admission", "serving.queue_wait",
+              "serving.execute", "serving.respond"}
+
+
+# ---------------------------------------------------------------------------
+# phase 1: fused-step loop, telemetry off vs on
+
+def _paired_overhead(measure, pairs, reps=1):
+    """Measure back-to-back (telem1, telem0) pairs and take the MEDIAN
+    of the per-pair ratios. CPU-frequency/scheduler drift moves on a
+    scale of seconds, so it hits both halves of an adjacent pair
+    equally and cancels in the ratio — where best-of-independent-runs
+    would credit whichever side happened to land on the quiet
+    interval. Pair order alternates so within-pair drift cancels in
+    the median too; each half takes the min of ``reps`` calls, which
+    filters one-sided preemption spikes (a slow patch landing on one
+    half of a pair skews that ratio by far more than the effect being
+    measured). ``measure`` returns seconds-like cost (lower is
+    better); returns (best0, best1, overhead_pct)."""
+    best = {0: float("inf"), 1: float("inf")}
+    ratios = []
+    for i in range(pairs):
+        order = (1, 0) if i % 2 == 0 else (0, 1)
+        got = {}
+        for lvl in order:
+            os.environ["MXNET_TELEMETRY"] = str(lvl)
+            got[lvl] = min(measure() for _ in range(reps))
+            best[lvl] = min(best[lvl], got[lvl])
+        ratios.append(got[1] / got[0])
+    overhead = (statistics.median(ratios) - 1.0) * 100
+    return best[0], best[1], overhead
+
+
+def _fused_step_phase(smoke):
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.benchmark.train_step_bench import (_make_params,
+                                                      _set_grads)
+
+    # r7's parameter count at a realistic layer width: the span prices
+    # against a real step, not a toy one
+    n_params, dim = (12, 8) if smoke else (60, 256)
+    steps = 10 if smoke else 15
+    pairs = 2 if smoke else 40
+    reps = 1 if smoke else 2
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    params = _make_params(n_params, dim)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    _set_grads(params, 0)
+    # warm BOTH sides: the compile under level 0, the tracer's
+    # first-touch thread state under level 1
+    for lvl in ("0", "1"):
+        os.environ["MXNET_TELEMETRY"] = lvl
+        for _ in range(max(3, steps // 10)):
+            trainer.step(1)
+    params[0].data().wait_to_read()
+
+    def measure():
+        # empty ring + collected heap per window: otherwise level-0
+        # windows pay GC scans over event dicts the PREVIOUS level-1
+        # window allocated, which bills tracer cost to the wrong side
+        telemetry.reset_trace()
+        gc.collect()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            trainer.step(1)
+        params[0].data().wait_to_read()
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    ms0, ms1, overhead = _paired_overhead(measure, pairs, reps)
+    telemetry.reset_trace()
+    return {
+        "n_params": n_params, "dim": dim, "steps": steps,
+        "pairs": pairs, "reps_per_half": reps,
+        "ms_per_step_telem0": round(ms0, 4),
+        "ms_per_step_telem1": round(ms1, 4),
+        "overhead_pct": round(overhead, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 2: serving throughput, telemetry off vs on
+
+def _serving_phase(smoke):
+    from mxnet_tpu import serving, telemetry
+    from mxnet_tpu.benchmark.serving_bench import _build_net
+
+    # a DEEP round-10-style model: depth is sequential under XLA (width
+    # just fans out across the threadpool without moving wall time), so
+    # eight layers both push per-request time to ~0.3ms — the batched-
+    # execute-dominated regime the <3% claim is about — and calm the
+    # run-to-run threadpool-contention noise that drowns a small ratio.
+    # A single-row toy request is ~70us of pure Python, a regime where
+    # ANY host-side instrumentation is visible and no one deploys.
+    hidden = 64 if smoke else 512
+    layers = 2 if smoke else 8
+    max_batch = 8 if smoke else 64
+    rows = 1 if smoke else 4
+    n_requests = 48 if smoke else 256
+    pairs = 1 if smoke else 12
+    reps = 1 if smoke else 2
+    # measuring tracer cost, not overload policy: a sustained
+    # full-throttle drain legitimately trips SLO shedding, which would
+    # turn the comparison into admission noise
+    os.environ["MXNET_SERVING_ADMISSION"] = "0"
+    net = _build_net(hidden, layers)
+    sess = serving.InferenceSession(
+        net, input_shapes=[(1, hidden)],
+        buckets=serving.parse_buckets("pow2", max_batch))
+    # queue sized to swallow the whole request set: the enqueue loop
+    # never blocks, so the timed window is the worker's drain rate
+    batcher = serving.DynamicBatcher(sess, max_batch_size=max_batch,
+                                     max_latency_ms=2.0,
+                                     max_queue=n_requests,
+                                     timeout_ms=300_000)
+    xs = [onp.random.RandomState(i).rand(rows, hidden).astype("float32")
+          for i in range(n_requests)]
+    # untimed warm burst with spans live: compiles + tracer first-touch
+    os.environ["MXNET_TELEMETRY"] = "1"
+    for f in [batcher.submit(x, block=True)
+              for x in xs[:2 * max_batch]]:
+        f.result(timeout=120)
+
+    def drain():
+        # one enqueue thread races ahead of the worker; the drain of a
+        # saturated queue dominates the window, so both the client-side
+        # emits (inside the loop) and the worker-side spans are priced
+        # without multi-client scheduling noise. Ring + GC hygiene as
+        # in the fused phase: don't bill one window's garbage to the
+        # next.
+        telemetry.reset_trace()
+        gc.collect()
+        t0 = time.perf_counter()
+        futs = [batcher.submit(x, block=True) for x in xs]
+        for f in futs:
+            f.result(timeout=300)
+        return n_requests / (time.perf_counter() - t0)
+
+    # _paired_overhead wants lower-is-better; feed it seconds-per-drain
+    s0, s1, overhead = _paired_overhead(lambda: 1.0 / drain(), pairs,
+                                        reps)
+    batcher.close()
+    telemetry.reset_trace()
+    return {
+        "model": {"hidden": hidden, "layers": layers,
+                  "max_batch": max_batch},
+        "n_requests": n_requests, "rows_per_request": rows,
+        "pairs": pairs, "reps_per_half": reps,
+        "rps_telem0": round(1.0 / s0, 1),
+        "rps_telem1": round(1.0 / s1, 1),
+        "overhead_pct": round(overhead, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 3: the sample trace (round-11 overlap + one-trace-id request)
+
+def _trace_phase(smoke, trace_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, serving, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.pipeline import DeviceFeed
+
+    nd = mx.nd
+    dim, steps = (16, 6) if smoke else (64, 12)
+    batch = 8
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    os.environ["MXNET_TELEMETRY"] = "1"
+
+    mx.random.seed(18)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize()
+    net(nd.zeros((1, dim)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+
+    def source():
+        # IO wait shorter than a step: the feed worker wakes and
+        # stages the next batch WHILE the step lane is inside
+        # fused_step.execute — the round-11 overlap, on the timeline
+        rs = onp.random.RandomState(11)
+        for _ in range(steps):
+            time.sleep(0.001)
+            yield (rs.rand(batch, dim).astype("f"),
+                   rs.rand(batch, 10).astype("f"))
+
+    # warm the whole-step compile OUTSIDE the recording, so the trace
+    # shows the steady-state overlap, not one giant first-step compile
+    xb0 = nd.array(onp.zeros((batch, dim), "f"))
+    yb0 = nd.array(onp.zeros((batch, 10), "f"))
+    with autograd.record():
+        loss = ((net(xb0) - yb0) ** 2).mean()
+    loss.backward()
+    trainer.step(batch)
+    sess = serving.InferenceSession(net, input_shapes=[(1, dim)],
+                                    buckets=[1, 2])
+    batcher = serving.DynamicBatcher(sess, max_latency_ms=2.0,
+                                     num_workers=1)
+    batcher.predict(onp.zeros((1, dim), "f"))
+
+    telemetry.reset_trace()
+    feed = DeviceFeed(source(), depth=2)
+    try:
+        for xb, yb in feed:
+            with autograd.record():
+                loss = ((net(xb) - yb) ** 2).mean()
+            loss.backward()
+            trainer.step(batch)
+    finally:
+        feed.close()
+    try:
+        x = onp.random.RandomState(0).rand(1, dim).astype("float32")
+        with telemetry.trace_context(_REQUEST_ID):
+            batcher.predict(x)
+    finally:
+        batcher.close()
+    telemetry.dump_trace(trace_path)
+
+    with open(trace_path) as f:
+        doc = json.load(f)  # the committed artifact must json.load
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    pref = [e for e in spans if e["name"] == "pipeline.prefetch_stage"]
+    fexec = [e for e in spans if e["name"] == "fused_step.execute"]
+    # the r11 overlap: a prefetch_stage on the feed-worker lane inside
+    # the step lane's BUSY window — the gap between consecutive
+    # feed_waits, i.e. forward/backward/step, which at level 1 has no
+    # wall-to-wall span of its own (dispatch spans are level 2)
+    fw = sorted((e for e in spans if e["name"] == "pipeline.feed_wait"),
+                key=lambda e: e["ts"])
+    busy = [(a["ts"] + a["dur"], b["ts"], a["tid"])
+            for a, b in zip(fw, fw[1:])
+            if a["tid"] == b["tid"] and b["ts"] > a["ts"] + a["dur"]]
+    overlap = any(
+        p["tid"] != lane and p["ts"] < end and t0 < p["ts"] + p["dur"]
+        for p in pref for (t0, end, lane) in busy)
+    req = [e for e in spans
+           if e.get("args", {}).get("trace_id") == _REQUEST_ID]
+    req_names = {e["name"] for e in req}
+    return {
+        "path": trace_path,
+        "events": len(doc["traceEvents"]),
+        "train_steps": steps,
+        "prefetch_spans": len(pref),
+        "fused_step_spans": len(fexec),
+        "overlap_observed": overlap,
+        "request_trace_id": _REQUEST_ID,
+        "request_span_names": sorted(req_names),
+        "request_lifecycle_complete": _LIFECYCLE <= req_names,
+        "request_lanes": len({e["tid"] for e in req}),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def run(smoke=False, out_path=None, trace_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon import fused_step
+
+    # raw save/restore of the user's settings (not knob READs):
+    prev = {k: os.environ.get(k)  # graft-lint: allow(L101)
+            for k in ("MXNET_TELEMETRY", "MXNET_FUSED_STEP",
+                      "MXNET_SERVING_ADMISSION")}
+    try:
+        fs = _fused_step_phase(smoke)
+        fused_step.reset_fused_step_cache()
+        sv = _serving_phase(smoke)
+        trace_path = trace_path or "BENCH_TELEM_r18.trace.json"
+        tr = _trace_phase(smoke, trace_path)
+    finally:
+        telemetry.reset_trace()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    doc = {
+        "benchmark": "telemetry",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "fused_step": fs,
+        "serving": sv,
+        "trace": tr,
+        "results": {
+            "fused_step_ms_telem0": fs["ms_per_step_telem0"],
+            "fused_step_ms_telem1": fs["ms_per_step_telem1"],
+            "fused_step_overhead_pct": fs["overhead_pct"],
+            "serving_rps_telem0": sv["rps_telem0"],
+            "serving_rps_telem1": sv["rps_telem1"],
+            "serving_overhead_pct": sv["overhead_pct"],
+        },
+    }
+    # structural gates hold at any scale
+    assert tr["request_lifecycle_complete"], tr
+    assert tr["request_lanes"] >= 2, tr
+    assert tr["prefetch_spans"] > 0 and tr["fused_step_spans"] > 0, tr
+    if not smoke:
+        # the acceptance gates: tracing must stay in the noise floor,
+        # and the committed trace must actually show the r11 overlap
+        r = doc["results"]
+        assert r["fused_step_overhead_pct"] < 2.0, r
+        assert r["serving_overhead_pct"] < 3.0, r
+        assert tr["overlap_observed"], tr
+    out_path = out_path or "BENCH_TELEM_r18.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small loops; CPU tier-1 time budget")
+    p.add_argument("--out", default=None)
+    p.add_argument("--trace-out", default=None,
+                   help="sample Chrome-trace path "
+                        "(BENCH_TELEM_r18.trace.json)")
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, out_path=a.out, trace_path=a.trace_out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

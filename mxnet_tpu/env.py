@@ -40,6 +40,17 @@ KNOBS = {
         "INT64_TENSOR_SIZE build flag"),
     "MXNET_PROFILER_AUTOSTART": (
         "wired", "profiler", "start profiling at import when 1"),
+    "MXNET_TELEMETRY": (
+        "wired", "telemetry.tracer",
+        "span tracing detail: 0 off (default; cost is one env read "
+        "per site), 1 structural spans (fused step, serving "
+        "lifecycle, pipeline, checkpoint, cache IO), 2 adds "
+        "high-frequency spans (per-op dispatch, per-pass graph opt)"),
+    "MXNET_TELEMETRY_BUFFER": (
+        "wired", "telemetry.tracer",
+        "span ring-buffer capacity (default 65536 events); on "
+        "overflow the oldest events drop and dropped_spans counts "
+        "them"),
     "MXNET_ENFORCE_DETERMINISM": (
         "wired", "random/io", "thread-pool decode keeps input order; "
         "all compute is already deterministic under XLA"),

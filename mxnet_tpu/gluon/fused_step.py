@@ -40,6 +40,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import tracer as _telem
 from ..utils import compile_cache as _cc
 from ..utils.lru import CountedLRUCache
 
@@ -231,25 +232,36 @@ class _FusedEntry:
             self._resolve(args)
 
     def _resolve(self, args):
+        with _telem.span("fused_step.resolve", cat="train") as sp:
+            return self._resolve_inner(args, sp)
+
+    def _resolve_inner(self, args, sp):
         if self._fp is not None:
             loaded = _cc.disk_load(self._fp)
             if loaded is not None:
+                sp.set(source="disk")
                 self._call = _cc.GuardedCompiled(loaded[0], self._jfn)
                 return self._call
             try:
-                compiled = _cc.aot_compile(self._jfn, *args)
+                with _telem.span("fused_step.trace_compile",
+                                 cat="train"):
+                    compiled = _cc.aot_compile(self._jfn, *args)
             except Exception:
+                sp.set(source="jit_fallback")
                 self._call = self._jfn
                 return self._call
+            sp.set(source="compile")
             _cc.disk_store(self._fp, compiled)
             self._call = _cc.GuardedCompiled(compiled, self._jfn)
             return self._call
+        sp.set(source="jit")
         self._call = self._jfn
         return self._call
 
     def __call__(self, *args):
         call = self._call or self._resolve(args)
-        return call(*args)
+        with _telem.span("fused_step.execute", cat="train"):
+            return call(*args)
 
 
 def build_executable(kernel, mp_flags, scaler_cfg, donate_params,

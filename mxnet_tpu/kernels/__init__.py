@@ -25,20 +25,19 @@ Knobs: ``MXNET_FUSION=0`` kill switch, ``MXNET_FUSION_PATTERNS``
 """
 from __future__ import annotations
 
-import threading
-
 from .. import env
+from ..telemetry import metrics as _telemetry
 
-_LOCK = threading.Lock()
-_COUNTERS = {}
+# registry-owned since round 18: the family keys grow on first use
+# (clusters_<pattern>, fallback_<reason>...), so no zero template
+_COUNTERS = _telemetry.counter_family("fusion")
 
 #: every pattern the clustering pass + serving specialization know
 ALL_PATTERNS = ("elementwise", "norm_act", "attention", "serving")
 
 
 def _count(name, n=1):
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+    _COUNTERS.add(name, n)
 
 
 def counters():
@@ -46,13 +45,11 @@ def counters():
     counts, ``nodes_absorbed``, ``impl_<lax|pallas>`` selections,
     ``fallback_<reason>`` rejections, and the serving
     ``serving_pad_fused`` / ``serving_slice_fused`` call counts."""
-    with _LOCK:
-        return dict(_COUNTERS)
+    return _COUNTERS.snapshot()
 
 
 def reset_counters():
-    with _LOCK:
-        _COUNTERS.clear()
+    _COUNTERS.clear()
 
 
 # ------------------------------------------------------------- knobs ------

@@ -22,6 +22,7 @@ import time as _time
 from .. import profiler as _prof
 
 from ..base import MXNetError
+from ..telemetry import tracer as _telem
 from ..utils import compile_cache as _cc
 from ..utils.lru import CountedLRUCache
 
@@ -606,6 +607,16 @@ def invoke(opdef, args, kwargs):
             _prof.record_op(opdef.name, t0 * 1e6,
                             (_time.perf_counter() - t0) * 1e6,
                             cached=_DISPATCH_FLAG.cached)
+    if _telem.tracing(2):
+        # level 2 only: per-op dispatch spans are high-frequency, and
+        # the level-1 hot path must stay at one env read
+        _DISPATCH_FLAG.cached = False
+        with _telem.span(f"dispatch.{opdef.name}", cat="dispatch",
+                         need=2) as sp:
+            ok = _invoke_inner(opdef, args, kwargs, out, arr_args,
+                               arg_template, kw_arrays)
+            sp.set(cached=_DISPATCH_FLAG.cached)
+            return ok
     return _invoke_inner(opdef, args, kwargs, out, arr_args, arg_template,
                          kw_arrays)
 

@@ -34,7 +34,7 @@ master knob. See docs/PIPELINE.md.
 """
 from __future__ import annotations
 
-import threading
+from ..telemetry import metrics as _telemetry
 
 __all__ = ["DeviceFeed", "AsyncGradReducer", "pipeline_enabled",
            "prefetch_depth", "async_grad_sync_enabled",
@@ -80,9 +80,10 @@ def kvstore_async_enabled():
 
 # ---------------------------------------------------------------------------
 # counters (thread-safe: feed workers, the consumer, and kvstore's
-# applier thread all tick them)
-
-_LOCK = threading.Lock()
+# applier thread all tick them). Since round 18 the dict is a
+# registry-owned telemetry.CounterFamily — same mutation idiom, but the
+# family is scrapeable from the unified Prometheus exposition and rides
+# telemetry.dump_trace() counter samples.
 
 
 def _zero_counters():
@@ -106,17 +107,15 @@ def _zero_counters():
     }
 
 
-_COUNTERS = _zero_counters()
+_COUNTERS = _telemetry.counter_family("pipeline", _zero_counters())
 
 
 def _count(name, delta=1):
-    with _LOCK:
-        _COUNTERS[name] += delta
+    _COUNTERS.add(name, delta)
 
 
 def _count_set(name, value):
-    with _LOCK:
-        _COUNTERS[name] = value
+    _COUNTERS.set(name, value)
 
 
 def pipeline_counters():
@@ -125,8 +124,7 @@ def pipeline_counters():
     the prefetcher exists to close) and ``overlap_ratio`` (fraction of
     the feed's consumption window NOT spent stalled; 1.0 = the source
     was always ahead of the step)."""
-    with _LOCK:
-        out = dict(_COUNTERS)
+    out = _COUNTERS.snapshot()
     out["engine_idle_s"] = out["prefetch_stall_s"]
     active = out["feed_active_s"]
     out["overlap_ratio"] = (
@@ -137,9 +135,7 @@ def pipeline_counters():
 
 def reset_pipeline_counters():
     """Zero every counter (tests, benchmarks)."""
-    global _COUNTERS
-    with _LOCK:
-        _COUNTERS = _zero_counters()
+    _COUNTERS.reset()
 
 
 from .device_feed import DeviceFeed  # noqa: E402

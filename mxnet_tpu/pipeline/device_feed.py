@@ -37,6 +37,7 @@ import time
 import numpy as onp
 
 from ..resilience import faults as _faults
+from ..telemetry import tracer as _telem
 from . import (_count, _count_set, prefetch_depth)
 
 __all__ = ["DeviceFeed"]
@@ -150,7 +151,13 @@ class DeviceFeed:
             for batch in self.source:
                 if ep.stop.is_set():
                     return
-                if not self._put(ep, self._stage(batch)):
+                # its own lane in the trace: staging runs on the
+                # device-feed thread, parallel to the consumer's step
+                # spans — the round-11 overlap, visible
+                with _telem.span("pipeline.prefetch_stage",
+                                 cat="pipeline"):
+                    staged = self._stage(batch)
+                if not self._put(ep, staged):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._put(ep, _Raised(e))
@@ -222,7 +229,11 @@ class DeviceFeed:
         if self._t_first is None:
             self._t_first = t0
         stalled = ep.q.empty()
+        tm0 = time.monotonic() if _telem.tracing() else 0.0
         item = ep.q.get()
+        if tm0:
+            _telem.emit_span("pipeline.feed_wait", "pipeline", tm0,
+                             time.monotonic(), stalled=stalled)
         wait = time.perf_counter() - t0
         if item is _END:
             self._end_pass()

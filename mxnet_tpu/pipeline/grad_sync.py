@@ -37,6 +37,7 @@ collective (tests, custom comm backends, gradient compression via
 """
 from __future__ import annotations
 
+from ..telemetry import tracer as _telem
 from . import (_count, async_grad_sync_enabled, grad_bucket_bytes)
 
 __all__ = ["AsyncGradReducer"]
@@ -151,9 +152,11 @@ class AsyncGradReducer:
         # abandon() (AutoResume restore / the load_states boundary).
         _faults.maybe_fail("grad_bucket_dispatch")
         datas = [d for _, d in bucket]
-        reduced = parallel.all_reduce_coalesced(
-            datas, reduce_fn=self._reduce_fn)
         nbytes = sum(d.size * d.dtype.itemsize for d in datas)
+        with _telem.span("pipeline.grad_bucket", cat="pipeline",
+                         grads=len(bucket), bytes=nbytes):
+            reduced = parallel.all_reduce_coalesced(
+                datas, reduce_fn=self._reduce_fn)
         for (g, captured), r in zip(bucket, reduced):
             self._spec[id(g)] = (captured, _raw(r))
         _count("grad_buckets")
@@ -196,8 +199,10 @@ class AsyncGradReducer:
                     _count("grad_stale_discards")
                 todo.append(g)
         if todo:
-            reduced = parallel.all_reduce_coalesced(
-                [g._data for g in todo], reduce_fn=self._reduce_fn)
+            with _telem.span("pipeline.grad_flush", cat="pipeline",
+                             grads=len(todo)):
+                reduced = parallel.all_reduce_coalesced(
+                    [g._data for g in todo], reduce_fn=self._reduce_fn)
             for g, r in zip(todo, reduced):
                 g._data = _raw(r)
             _count("grad_flush_grads", len(todo))

@@ -106,15 +106,21 @@ def record_op(name, start_us, dur_us, cached=None):
             cached=cached)
 
 
+def _family(name):
+    """One registry family as a flat dict — the compat-view plumbing.
+    Every ``*_counters()`` function below is a thin view over the
+    round-18 unified telemetry registry ({} when the owning subsystem
+    cannot import)."""
+    from .telemetry import metrics as _tm
+
+    _tm._bootstrap_probes()
+    return _tm.family_snapshot(name)
+
+
 def dispatch_cache_counters():
     """Eager-dispatch executable-cache counters (hit/miss/evict/bypass/
     fallback + size), live from the registry. Zeros before first use."""
-    try:
-        from .ndarray.registry import dispatch_cache_stats
-
-        return dispatch_cache_stats()
-    except Exception:
-        return {}
+    return _family("eager_jit_cache")
 
 
 def fused_step_counters():
@@ -123,24 +129,14 @@ def fused_step_counters():
     gluon.fused_step. Zeros before first use. NB: ``skipped_steps``
     reads a device-resident scalar per live trainer, which blocks on
     any in-flight step."""
-    try:
-        from .gluon.fused_step import fused_step_stats
-
-        return fused_step_stats()
-    except Exception:
-        return {}
+    return _family("fused_step")
 
 
 def compile_cache_counters():
     """Persistent compile-cache counters (disk hit/miss/write/corrupt,
     serialize skips, retrace count, bucket pad-ratio), live from
     utils.compile_cache. Zeros before first use."""
-    try:
-        from .utils.compile_cache import compile_cache_stats
-
-        return compile_cache_stats()
-    except Exception:
-        return {}
+    return _family("compile_cache")
 
 
 def serving_counters():
@@ -153,12 +149,7 @@ def serving_counters():
     ``decode_steps`` fused continuous-batching steps, live
     ``slot_occupancy``, ``evictions`` and ``resumed_sessions``), live
     from mxnet_tpu.serving.metrics. Zeros before the first request."""
-    try:
-        from .serving.metrics import serving_stats
-
-        return serving_stats()
-    except Exception:
-        return {}
+    return _family("serving")
 
 
 def pipeline_counters():
@@ -166,12 +157,7 @@ def pipeline_counters():
     stall = engine idle seconds, overlap ratio, dispatch-as-ready grad
     buckets, async kvstore pushes), live from mxnet_tpu.pipeline.
     Zeros before the first DeviceFeed/AsyncGradReducer use."""
-    try:
-        from .pipeline import pipeline_counters as _pc
-
-        return _pc()
-    except Exception:
-        return {}
+    return _family("pipeline")
 
 
 def resilience_counters():
@@ -179,24 +165,14 @@ def resilience_counters():
     skips, AutoResume restarts, retry attempts/giveups, circuit-breaker
     trips/demotions, injected-fault fires per point), live from
     mxnet_tpu.resilience. Zeros before first use."""
-    try:
-        from .resilience import resilience_counters as _rc
-
-        return _rc()
-    except Exception:
-        return {}
+    return _family("resilience")
 
 
 def graph_verify_counters():
     """Static graph-verifier counters (graphs checked, diagnostics by
     severity and code), live from mxnet_tpu.analysis. Zeros before the
     first verification (MXNET_GRAPH_VERIFY gated)."""
-    try:
-        from .analysis import counters
-
-        return counters()
-    except Exception:
-        return {}
+    return _family("graph_verify")
 
 
 def graph_opt_counters():
@@ -204,12 +180,7 @@ def graph_opt_counters():
     before/after, per-pass rewrite counts and time, analysis-run and
     fact-cache tallies), live from mxnet_tpu.analysis.graph_opt. Zeros
     before the first optimization (MXNET_GRAPH_OPT gated)."""
-    try:
-        from .analysis.graph_opt import counters
-
-        return counters()
-    except Exception:
-        return {}
+    return _family("graph_opt")
 
 
 def fusion_counters():
@@ -217,12 +188,7 @@ def fusion_counters():
     absorbed, impl selections, fallbacks by reason, serving fused
     pad/slice hits), live from mxnet_tpu.kernels. Zeros before the
     first fused optimization (MXNET_FUSION gated)."""
-    try:
-        from .kernels import counters
-
-        return counters()
-    except Exception:
-        return {}
+    return _family("fusion")
 
 
 def sharding_counters():
@@ -231,12 +197,7 @@ def sharding_counters():
     a plan, ZeRO-1 groups, sharded serving sessions, sharded-checkpoint
     shard files/saves/restores/reshards), live from mxnet_tpu.sharding.
     Zeros before the first plan scope (MXNET_SHARDING gated)."""
-    try:
-        from .sharding import sharding_counters as _sc
-
-        return _sc()
-    except Exception:
-        return {}
+    return _family("sharding")
 
 
 def _record(domain, name, start_us, dur_us, cat="event", value=None,
@@ -268,68 +229,24 @@ def _record(domain, name, start_us, dur_us, cat="event", value=None,
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write accumulated host events as chrome://tracing JSON. The
-    eager-dispatch and fused-step cache counters ride along as chrome
-    counter samples ('eager_jit_cache/<name>', 'fused_step/<name>')
-    stamped at dump time."""
+    """Write accumulated host events as chrome://tracing JSON.
+
+    Since round 18 this routes through ``telemetry.exporter``: the
+    legacy profiler event list (Domain/Task scopes, ``record_op``
+    dispatch timings) rides along verbatim, the telemetry spans land in
+    the same timeline, and every registry family is stamped as one
+    counter sample per counter at dump time. Sample names are unchanged
+    (``eager_jit_cache/<name>``, ``compile_cache/<name>``, ...) — but
+    that ad-hoc ``<family>/<counter>`` naming is DEPRECATED as a parse
+    target: it survives this release as a compatibility shim; new
+    consumers should read ``telemetry.snapshot()`` (structured) or the
+    Prometheus exposition instead of string-splitting sample names."""
+    from .telemetry import exporter as _exporter
+
     fname = _config.get("filename") or "profile.json"
     with _lock:
-        payload = {"traceEvents": list(_events)}
-    ts = time.perf_counter() * 1e6
-    for cname, cval in sorted(dispatch_cache_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"eager_jit_cache/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
-    for cname, cval in sorted(fused_step_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"fused_step/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
-    for cname, cval in sorted(graph_verify_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"graph_verify/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
-    for cname, cval in sorted(graph_opt_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"graph_opt/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    for cname, cval in sorted(fusion_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"fusion/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0, "args": {cname: cval}})
-    for cname, cval in sorted(compile_cache_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"compile_cache/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    for cname, cval in sorted(serving_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"serving/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    for cname, cval in sorted(pipeline_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"pipeline/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    for cname, cval in sorted(resilience_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"resilience/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    for cname, cval in sorted(sharding_counters().items()):
-        payload["traceEvents"].append(
-            {"name": f"sharding/{cname}", "cat": "counter",
-             "ph": "C", "ts": ts, "pid": 0,
-             "args": {cname: float(cval) if isinstance(cval, float)
-                      else cval}})
-    with open(fname, "w") as f:
-        json.dump(payload, f)
+        legacy = list(_events)
+    _exporter.dump_trace(fname, extra_events=legacy)
     return fname
 
 

@@ -45,7 +45,7 @@ docs/RESILIENCE.md.
 """
 from __future__ import annotations
 
-import threading
+from ..telemetry import metrics as _telemetry
 
 __all__ = ["CheckpointManager", "AutoResume", "ResumeExhausted",
            "RetryPolicy", "RetryExhausted", "CircuitBreaker",
@@ -69,9 +69,8 @@ def resilience_enabled():
 
 # ---------------------------------------------------------------------------
 # counters (thread-safe: the checkpoint writer thread, serving workers,
-# and the training thread all tick them)
-
-_LOCK = threading.Lock()
+# and the training thread all tick them). Registry-owned since round 18
+# — same mutation idiom, unified Prometheus/trace-sample surface.
 
 
 def _zero_counters():
@@ -102,20 +101,18 @@ def _zero_counters():
     }
 
 
-_COUNTERS = _zero_counters()
+_COUNTERS = _telemetry.counter_family("resilience", _zero_counters())
 
 
 def _count(name, delta=1):
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+    _COUNTERS.add(name, delta)
 
 
 def resilience_counters():
     """Live resilience counters, plus one ``fault_fires:<point>`` entry
     per fault point that fired and ``enabled`` mirroring the master
     knob (the profiler surface; see the module docstring)."""
-    with _LOCK:
-        out = dict(_COUNTERS)
+    out = _COUNTERS.snapshot()
     from . import faults as _faults
 
     for point, n in _faults.fire_counts().items():
@@ -128,9 +125,7 @@ def resilience_counters():
 def reset_resilience_counters():
     """Zero every counter (tests, benchmarks). Does not disarm an
     active fault plan — ``faults.disarm()`` owns that."""
-    global _COUNTERS
-    with _LOCK:
-        _COUNTERS = _zero_counters()
+    _COUNTERS.reset()
     from . import faults as _faults
 
     _faults.reset_fire_counts()

@@ -555,7 +555,14 @@ class CheckpointManager:
         from . import _count
         from . import faults as _faults
         from .. import sharding as _sharding
+        from ..telemetry import tracer as _telem
 
+        with _telem.span("checkpoint.write", cat="checkpoint",
+                         step=snap["step"],
+                         mode="async" if self.async_mode else "sync"):
+            self._write_inner(snap, _count, _faults, _sharding)
+
+    def _write_inner(self, snap, _count, _faults, _sharding):
         t0 = time.perf_counter()
         _faults.maybe_fail("checkpoint_write")
         step = snap["step"]
@@ -698,7 +705,14 @@ class CheckpointManager:
         pipeline. Any pending async write is joined first (restoring
         over a half-captured newer state would race the writer)."""
         from . import _count
+        from ..telemetry import tracer as _telem
 
+        with _telem.span("checkpoint.restore", cat="checkpoint") as _sp:
+            out = self._restore_inner(step, _count)
+            _sp.set(step=out["step"])
+            return out
+
+    def _restore_inner(self, step, _count):
         self.wait()
         payload = self.load(step)
         if payload.get("params") is not None:

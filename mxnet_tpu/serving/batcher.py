@@ -65,6 +65,7 @@ from concurrent.futures import Future
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..telemetry import tracer as _telem
 from .metrics import METRICS, SLO_CLASSES
 
 __all__ = ["DynamicBatcher", "ServerBusy", "RequestTimeout"]
@@ -83,7 +84,7 @@ _STOP = object()  # queue sentinel, one per worker at close()
 
 class _Request:
     __slots__ = ("arrs", "rows", "future", "t_submit", "deadline",
-                 "slo_class", "session_id")
+                 "slo_class", "session_id", "trace_id")
 
     def __init__(self, arrs, rows, deadline, slo_class="standard",
                  session_id=None):
@@ -94,6 +95,12 @@ class _Request:
         self.deadline = deadline
         self.slo_class = slo_class
         self.session_id = session_id  # stateful decode: one step of sid
+        # the request's trace id crosses the queue with it: submit runs
+        # on the HTTP handler thread (inside its trace_context), the
+        # batch executes on a worker — stamping every worker-side span
+        # with the member ids is what threads one request's lifecycle
+        # back together in the exported trace
+        self.trace_id = _telem.current_trace_id()
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -309,6 +316,31 @@ class DynamicBatcher:
         row(s); a reclaimed slot rejects with
         :class:`~.state.SessionEvicted` (retryable 503) on exactly
         this stream."""
+        # the lifecycle's first span: validation + SLO admission +
+        # the queue put, on the caller's thread (inside the HTTP
+        # layer's trace_context when one is active). Rejections —
+        # ValueError / ShedLoad / ServerBusy — surface as the span's
+        # error attr, so shed load is visible in the trace, not just
+        # the counters. emit_span (not span): this runs once per
+        # request on the client thread, and the flat form skips the
+        # nesting bookkeeping — viewers nest by time containment.
+        if not _telem.tracing():
+            return self._submit_inner(inputs, timeout_ms, block,
+                                      slo_class, session_id, None)
+        t0 = time.monotonic()
+        attrs = {"slo_class": slo_class or "standard"}
+        try:
+            return self._submit_inner(inputs, timeout_ms, block,
+                                      slo_class, session_id, attrs)
+        except Exception as e:
+            attrs["error"] = type(e).__name__
+            raise
+        finally:
+            _telem.emit_span("serving.admission", "serving", t0,
+                             time.monotonic(), **attrs)
+
+    def _submit_inner(self, inputs, timeout_ms, block, slo_class,
+                      session_id, sp):
         import numpy as onp
 
         from .admission import normalize_class
@@ -351,6 +383,8 @@ class DynamicBatcher:
             inline = self._closed or self._pass_through
         if inline:
             METRICS.bump("inline")
+            if sp is not None:
+                sp["path"] = "inline"
             if self._stateful:
                 self._execute_step_batch([req])
             else:
@@ -387,6 +421,8 @@ class DynamicBatcher:
                     f"serving queue full ({self._queue.maxsize} "
                     f"{cls} requests); backpressure — retry later"
                 ) from None
+        if sp is not None:
+            sp["path"] = "queued"
         # close() may have finished (workers joined, queue drained)
         # between the _closed check above and our put landing — nobody
         # would ever consume this request. Drain it ourselves;
@@ -468,6 +504,7 @@ class DynamicBatcher:
             flush_at = req.t_submit + self._max_latency_s
             if req.deadline is not None:
                 flush_at = min(flush_at, req.deadline - margin)
+            t_co = time.monotonic() if _telem.tracing() else 0.0
             while rows < self._max_batch:
                 remaining = flush_at - time.monotonic()
                 try:
@@ -492,6 +529,11 @@ class DynamicBatcher:
                 rows += nxt.rows
                 if nxt.deadline is not None:
                     flush_at = min(flush_at, nxt.deadline - margin)
+            if t_co:
+                _telem.emit_span("serving.coalesce", "serving", t_co,
+                                 time.monotonic(),
+                                 trace_id=batch[0].trace_id,
+                                 requests=len(batch), rows=rows)
             METRICS.observe_flush(time.monotonic() - batch[0].t_submit)
             self._execute(batch)
 
@@ -502,19 +544,37 @@ class DynamicBatcher:
         were validated at submit), so it fails the whole batch."""
         import numpy as onp
 
+        tid = batch[0].trace_id
+        if _telem.tracing():
+            # each member's queue wait, measured from its own submit
+            # to batch formation — the span every latency postmortem
+            # starts from. emit_span because t_submit predates the
+            # tracer's involvement (it was stamped on the HTTP thread).
+            now = time.monotonic()
+            for r in batch:
+                _telem.emit_span("serving.queue_wait", "serving",
+                                 r.t_submit, now, trace_id=r.trace_id,
+                                 slo_class=r.slo_class)
         try:
-            if len(batch) == 1:
-                arrs = batch[0].arrs
-            else:
-                arrs = [onp.concatenate([r.arrs[i] for r in batch],
-                                        axis=0)
+            # host-side batch assembly (the session pads to its shape
+            # bucket inside predict)
+            with _telem.span("serving.pad", cat="serving", trace_id=tid,
+                             requests=len(batch)):
+                if len(batch) == 1:
+                    arrs = batch[0].arrs
+                else:
+                    arrs = [onp.concatenate(
+                        [r.arrs[i] for r in batch], axis=0)
                         for i in range(len(batch[0].arrs))]
-            outs = self.session.predict(*arrs)
-            outs = outs if isinstance(outs, tuple) else (outs,)
-            # ONE device->host transfer per output; per-request slices
-            # are free numpy views
-            host = [o.asnumpy() if isinstance(o, NDArray)
-                    else onp.asarray(o) for o in outs]
+            with _telem.span("serving.execute", cat="serving",
+                             trace_id=tid,
+                             rows=sum(r.rows for r in batch)):
+                outs = self.session.predict(*arrs)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                # ONE device->host transfer per output; per-request
+                # slices are free numpy views
+                host = [o.asnumpy() if isinstance(o, NDArray)
+                        else onp.asarray(o) for o in outs]
             if len(batch) > 1:
                 # every output must be batch-major over exactly the
                 # coalesced rows, or per-request slicing is impossible
@@ -539,20 +599,23 @@ class DynamicBatcher:
                     time.monotonic() - r.t_submit, failed=True,
                     slo_class=r.slo_class, met_deadline=False)
             return
-        offset = 0
-        now = time.monotonic()
-        for r in batch:
-            if len(batch) == 1:
-                sliced = tuple(host)
-            else:
-                sliced = tuple(h[offset:offset + r.rows] for h in host)
-            offset += r.rows
-            if r.future.set_running_or_notify_cancel():
-                r.future.set_result(
-                    sliced[0] if len(sliced) == 1 else sliced)
-            METRICS.observe_request(
-                now - r.t_submit, slo_class=r.slo_class,
-                met_deadline=r.deadline is None or now <= r.deadline)
+        with _telem.span("serving.respond", cat="serving", trace_id=tid,
+                         requests=len(batch)):
+            offset = 0
+            now = time.monotonic()
+            for r in batch:
+                if len(batch) == 1:
+                    sliced = tuple(host)
+                else:
+                    sliced = tuple(h[offset:offset + r.rows]
+                                   for h in host)
+                offset += r.rows
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_result(
+                        sliced[0] if len(sliced) == 1 else sliced)
+                METRICS.observe_request(
+                    now - r.t_submit, slo_class=r.slo_class,
+                    met_deadline=r.deadline is None or now <= r.deadline)
 
     # -- continuous batching (stateful sessions) -----------------------
 
@@ -694,25 +757,35 @@ class DynamicBatcher:
                     slo_class=r.slo_class, met_deadline=False)
         if not live:
             return
+        if _telem.tracing():
+            now = time.monotonic()
+            for r in live:
+                _telem.emit_span("serving.queue_wait", "serving",
+                                 r.t_submit, now, trace_id=r.trace_id,
+                                 slo_class=r.slo_class,
+                                 session=r.session_id)
         t0 = time.perf_counter()
         slots = [rec.slot for rec in recs]
         try:
-            if len(live) == 1:
-                arrs = live[0].arrs
-            else:
-                arrs = [onp.concatenate([r.arrs[i] for r in live],
-                                        axis=0)
+            with _telem.span("serving.decode_step", cat="serving",
+                             trace_id=live[0].trace_id,
+                             sessions=len(live)):
+                if len(live) == 1:
+                    arrs = live[0].arrs
+                else:
+                    arrs = [onp.concatenate(
+                        [r.arrs[i] for r in live], axis=0)
                         for i in range(len(live[0].arrs))]
-            states = store.gather(slots)
-            outs, news = self.session._run_step(
-                arrs, states, len(live), adopted=True)
-            import jax
+                states = store.gather(slots)
+                outs, news = self.session._run_step(
+                    arrs, states, len(live), adopted=True)
+                import jax
 
-            # surface step failures BEFORE the scatter: a poisoned
-            # write would corrupt every member's resume point
-            jax.block_until_ready(news)
-            store.scatter(slots, news)
-            host = [onp.asarray(o) for o in outs]
+                # surface step failures BEFORE the scatter: a poisoned
+                # write would corrupt every member's resume point
+                jax.block_until_ready(news)
+                store.scatter(slots, news)
+                host = [onp.asarray(o) for o in outs]
         except Exception as e:  # noqa: BLE001 — delivered per-future
             for rec in recs:
                 store.release(rec, stepped=False)
@@ -739,6 +812,8 @@ class DynamicBatcher:
                 met_deadline=r.deadline is None or now <= r.deadline)
 
     def _fail_timeout(self, req):
+        _telem.instant("serving.timeout", cat="serving",
+                       trace_id=req.trace_id, slo_class=req.slo_class)
         if req.future.set_running_or_notify_cancel():
             # the REQUEST's own deadline (submit may have overridden
             # the batcher default)

@@ -51,9 +51,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as onp
 
 from ..resilience.breaker import CircuitOpen
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import tracer as _telem
 from .admission import ShedLoad, normalize_class
 from .batcher import DynamicBatcher, RequestTimeout, ServerBusy
-from .metrics import METRICS, prometheus_text
+from .metrics import METRICS
 from .state import SessionEvicted
 
 __all__ = ["ModelServer"]
@@ -151,6 +153,8 @@ class ModelServer:
 class _ServingHandler(BaseHTTPRequestHandler):
     model_server = None  # bound per-server by ModelServer.start
     protocol_version = "HTTP/1.1"
+    _request_id = None  # set per-request at the top of do_POST
+    _status = None      # last reply's status code (span attr)
 
     # -- plumbing ------------------------------------------------------
 
@@ -161,16 +165,35 @@ class _ServingHandler(BaseHTTPRequestHandler):
                headers=None):
         if isinstance(body, (dict, list)):
             body = json.dumps(body).encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self._request_id is not None:
+            # the request's trace id, echoed on EVERY response —
+            # success or error — so a client log line joins the
+            # server-side trace without guessing
+            self.send_header("X-Request-Id", self._request_id)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code, message, headers=None):
-        self._reply(code, {"error": message}, headers=headers)
+    def _error(self, code, message, headers=None, retry_after_s=None):
+        """One error envelope for every failure class: ``error`` +
+        ``request_id`` (when the request reached routing) +
+        ``retry_after_s`` (the backoff hint, null when retrying can't
+        help — 400s, timeouts). A non-null hint also rides the
+        standard ``Retry-After`` header for clients that only read
+        headers."""
+        doc = {"error": message,
+               "request_id": self._request_id,
+               "retry_after_s": retry_after_s}
+        if retry_after_s is not None:
+            headers = dict(headers or {})
+            headers.setdefault("Retry-After",
+                               f"{max(retry_after_s, 0.0):.3f}")
+        self._reply(code, doc, headers=headers)
 
     # -- GET -----------------------------------------------------------
 
@@ -221,7 +244,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 "default": srv.repository.default_model,
                 "models": srv.repository.model_states()})
         elif self.path == "/metrics":
-            self._reply(200, prometheus_text().encode(),
+            # round 18: the UNIFIED exposition — the serving
+            # histogram/label block exactly as before, plus every
+            # training-side counter family (fused_step, pipeline,
+            # compile_cache, ...), scrapeable from one endpoint
+            self._reply(200, _tmetrics.prometheus_text().encode(),
                         content_type="text/plain; version=0.0.4")
         else:
             self._error(404, f"no route {self.path!r}")
@@ -250,6 +277,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
         raise LookupError(f"no route {self.path!r}")
 
     def do_POST(self):
+        # request-scoped trace propagation: adopt the client's
+        # ``X-Request-Id`` (minting one when absent), scope every span
+        # of this request to it — on this handler thread via
+        # trace_context, across the queue via ``_Request.trace_id`` —
+        # and echo it on the response, errors included.
+        self._request_id = (self.headers.get("X-Request-Id") or
+                            _telem.new_trace_id())
+        with _telem.trace_context(self._request_id):
+            with _telem.span("serving.request", cat="serving",
+                             path=self.path) as sp:
+                self._do_post()
+                sp.set(status=self._status)
+
+    def _do_post(self):
         try:
             model = self._route_model()
         except LookupError as e:
@@ -315,20 +356,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
             # admission control said no BEFORE queueing: fast 503 with
             # the backoff hint — a well-behaved client honors it
             METRICS.bump("rejected")
-            self._error(503, str(e), headers={
-                "Retry-After": f"{max(e.retry_after_s, 0.0):.3f}"})
+            self._error(503, str(e),
+                        retry_after_s=max(e.retry_after_s, 0.0))
             return
         except SessionEvicted as e:
             # the stream's state slot is gone (TTL/LRU/injected): a
             # clean retryable 503 — the client re-opens its stream and
             # replays; ordered before the plain ServerBusy mapping
             # (SessionEvicted subclasses it)
-            self._error(503, str(e), headers={"Retry-After": "0.000"})
+            self._error(503, str(e), retry_after_s=0.0)
             return
         except (ServerBusy, CircuitOpen) as e:
             # both are "back off and retry later": queue backpressure,
             # or this bucket's circuit is open during its cooldown
-            self._error(503, str(e))
+            self._error(503, str(e), retry_after_s=0.05)
             return
         except (RequestTimeout, _FutureTimeout) as e:
             self._error(504, str(e) or "request timed out")

@@ -32,7 +32,7 @@ and the ``SHARDING`` runtime feature flag.
 """
 from __future__ import annotations
 
-import threading
+from ..telemetry import metrics as _telemetry
 
 __all__ = ["ShardingPlan", "plan_scope", "current_plan", "sharding_enabled",
            "zero1_enabled", "sharding_counters", "reset_sharding_counters",
@@ -60,9 +60,6 @@ def zero1_enabled():
     return _env.get_bool("MXNET_SHARDING_ZERO1", False)
 
 
-_LOCK = threading.Lock()
-
-
 def _zero_counters():
     return {"plans_built": 0, "rules_matched": 0, "rules_unmatched": 0,
             "divisibility_fallbacks": 0, "fused_sharded_groups": 0,
@@ -71,12 +68,12 @@ def _zero_counters():
             "ckpt_sharded_restores": 0, "ckpt_reshards": 0}
 
 
-_COUNTERS = _zero_counters()
+# registry-owned since round 18 (unified Prometheus/trace surface)
+_COUNTERS = _telemetry.counter_family("sharding", _zero_counters())
 
 
 def _count(name, delta=1):
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+    _COUNTERS.add(name, delta)
 
 
 def sharding_counters():
@@ -86,16 +83,13 @@ def sharding_counters():
     (``fused_sharded_groups``/``zero1_groups``), serving sessions with
     sharded snapshots, and sharded-checkpoint traffic
     (``ckpt_shard_files``/``ckpt_reshards``/...)."""
-    with _LOCK:
-        out = dict(_COUNTERS)
+    out = _COUNTERS.snapshot()
     out["enabled"] = sharding_enabled()
     return out
 
 
 def reset_sharding_counters():
-    global _COUNTERS
-    with _LOCK:
-        _COUNTERS = _zero_counters()
+    _COUNTERS.reset()
 
 
 from .plan import (ShardingPlan, plan_scope, current_plan,  # noqa: E402

@@ -1,0 +1,307 @@
+"""Unified metrics registry: every counter family in one place.
+
+Before round 18 the process had ELEVEN disconnected counter surfaces:
+ten ``profiler.*_counters()`` families (dispatch cache, fused step,
+compile cache, pipeline, resilience, graph verify/opt, fusion,
+sharding, serving) each backed by its own module-level dict + lock,
+plus a serving-only Prometheus endpoint that exposed exactly one of
+them. This module is the one registry they all live in now:
+
+- **Owned families** (:class:`CounterFamily`): subsystems whose state
+  IS a flat counter dict bind it here —
+  ``_COUNTERS = telemetry.counter_family("pipeline", _zero_counters())``
+  — and keep mutating it with the same ``_COUNTERS[name] += n`` code,
+  now under the family's lock. graft_lint L901 enforces the discipline:
+  a raw module-level counter dict mutated outside ``telemetry/`` is a
+  lint error, so new counters can't regrow outside the registry.
+- **Probed families** (:func:`register_family`): subsystems whose
+  snapshot is computed (LRU ``.stats()``, latency quantiles, live
+  gauges) register a snapshot callable instead; the registry calls it
+  at read time, never on the hot path.
+
+Both kinds surface identically: :func:`family_snapshot` /
+:func:`snapshot` feed the ``profiler.*_counters()`` compatibility
+views and the counter samples in ``profiler.dump()`` /
+``telemetry.dump_trace``, and :func:`prometheus_text` renders ONE text
+exposition — the serving histograms exactly as before (the serving
+registry keeps its purpose-built exposition and plugs it in as a
+block) plus every training-side family as ``mxnet_<family>_<name>``
+gauges, scrapeable for the first time.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["CounterFamily", "MetricsRegistry", "REGISTRY",
+           "counter_family", "register_family", "register_exposition",
+           "family_snapshot", "snapshot", "prometheus_text"]
+
+
+class CounterFamily:
+    """A registry-owned, thread-safe, flat numeric counter dict.
+
+    Implements the mapping slice the subsystems' counter code already
+    uses (``[]``, ``get``, ``items``, ``clear``, iteration), so
+    adopting the registry is a one-line binding change. Every mutation
+    takes the family lock — the previous per-module locks moved here.
+    """
+
+    __slots__ = ("name", "_lock", "_zeros", "_data")
+
+    def __init__(self, name, zeros=None):
+        self.name = name
+        self._lock = threading.Lock()
+        self._zeros = dict(zeros) if zeros else {}
+        self._data = dict(self._zeros)
+
+    # -- mutation (hot path: a lock + a couple of int ops) ------------
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def add(self, key, delta=1):
+        with self._lock:
+            self._data[key] = self._data.get(key, 0) + delta
+
+    def set(self, key, value):
+        self[key] = value
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+
+    def reset(self):
+        """Back to the zero template (tests, benchmarks)."""
+        with self._lock:
+            self._data = dict(self._zeros)
+
+    # -- reading ------------------------------------------------------
+
+    def __getitem__(self, key):
+        with self._lock:
+            return self._data[key]
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._data.get(key, default)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._data
+
+    def __iter__(self):
+        return iter(self.snapshot())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._data)
+
+    def items(self):
+        return self.snapshot().items()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._data)
+
+
+class MetricsRegistry:
+    """Named counter/gauge families + pluggable Prometheus expositions.
+
+    One process-wide instance (:data:`REGISTRY`); families register
+    lazily at subsystem import, probes resolve at read time, and
+    nothing here imports a subsystem — the registry must be importable
+    before (and without) any of them."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owned = {}        # name -> CounterFamily
+        self._probes = {}       # name -> callable() -> flat dict
+        self._expositions = []  # (name, callable() -> prometheus text)
+
+    def counter_family(self, name, zeros=None):
+        """Create-or-fetch the owned family ``name``. Idempotent so a
+        module reimport (tests) rebinds to the same live family."""
+        with self._lock:
+            fam = self._owned.get(name)
+            if fam is None:
+                fam = self._owned[name] = CounterFamily(name, zeros)
+            return fam
+
+    def register_family(self, name, probe):
+        """Register (or replace) a probed family: ``probe()`` returns
+        the family's flat numeric snapshot; it is called at read time
+        only. Returns ``probe`` so import-time registration can
+        decorate."""
+        with self._lock:
+            self._probes[name] = probe
+        return probe
+
+    def register_exposition(self, name, render):
+        """Register a purpose-built Prometheus text block (the serving
+        registry's histogram exposition) appended verbatim by
+        :meth:`prometheus_text`. Idempotent by name."""
+        with self._lock:
+            self._expositions = [(n, r) for n, r in self._expositions
+                                 if n != name]
+            self._expositions.append((name, render))
+        return render
+
+    def families(self):
+        """Public family names. A leading underscore marks an internal
+        family (a sub-dict some probe already merges into its public
+        view) — owned and mutable, but not separately surfaced."""
+        with self._lock:
+            return sorted(n for n in set(self._owned) | set(self._probes)
+                          if not n.startswith("_"))
+
+    def family_snapshot(self, name):
+        """Flat numeric dict for one family ({} for unknown names —
+        the profiler compatibility views must never raise). A probed
+        family shadows an owned one of the same name: the probe is the
+        richer, derived view."""
+        with self._lock:
+            probe = self._probes.get(name)
+            fam = self._owned.get(name)
+        if probe is not None:
+            try:
+                return dict(probe())
+            except Exception:  # graft-lint: allow(L501)
+                # a probe touching a half-torn-down subsystem (interp
+                # shutdown) must not take the whole surface with it
+                return {}
+        return fam.snapshot() if fam is not None else {}
+
+    def snapshot(self):
+        """{family: {name: value}} across every registered family."""
+        return {name: self.family_snapshot(name)
+                for name in self.families()}
+
+    # -- prometheus ---------------------------------------------------
+
+    @staticmethod
+    def _sanitize(name):
+        out = []
+        for ch in name:
+            out.append(ch if ch.isalnum() or ch == "_" else "_")
+        s = "".join(out)
+        return s if not s[:1].isdigit() else "_" + s
+
+    def prometheus_text(self):
+        """ONE text exposition: every registered exposition block
+        (serving's histograms/labels, exactly the pre-round-18 body),
+        then every OTHER family as ``mxnet_<family>_<name>`` gauges.
+        Families already covered by an exposition block are skipped —
+        the serving counters must not appear twice under two names."""
+        with self._lock:
+            expositions = list(self._expositions)
+        parts = []
+        covered = set()
+        for name, render in expositions:
+            covered.add(name)
+            try:
+                parts.append(render().rstrip("\n"))
+            except Exception:  # graft-lint: allow(L501)
+                pass  # a broken block must not 500 the /metrics scrape
+        for family in self.families():
+            if family in covered:
+                continue
+            snap = self.family_snapshot(family)
+            if not snap:
+                continue
+            fam_prefix = f"mxnet_{self._sanitize(family)}"
+            lines = [f"# HELP {fam_prefix} {family} counters "
+                     "(mxnet_tpu telemetry registry)",
+                     f"# TYPE {fam_prefix} gauge"]
+            for key in sorted(snap):
+                val = snap[key]
+                if isinstance(val, bool):
+                    val = int(val)
+                if not isinstance(val, (int, float)):
+                    continue
+                lines.append(
+                    f"{fam_prefix}_{self._sanitize(key)} {val}")
+            parts.append("\n".join(lines))
+        return "\n".join(parts) + "\n"
+
+
+#: the process-wide registry (module-level: importable before any
+#: subsystem, and exactly one per process like serving's METRICS)
+REGISTRY = MetricsRegistry()
+
+
+def counter_family(name, zeros=None):
+    """Module-level convenience for ``REGISTRY.counter_family``."""
+    return REGISTRY.counter_family(name, zeros)
+
+
+def register_family(name, probe):
+    return REGISTRY.register_family(name, probe)
+
+
+def register_exposition(name, render):
+    return REGISTRY.register_exposition(name, render)
+
+
+def family_snapshot(name):
+    return REGISTRY.family_snapshot(name)
+
+
+def snapshot():
+    return REGISTRY.snapshot()
+
+
+def prometheus_text():
+    """The unified exposition (the serving ``/metrics`` body since
+    round 18): serving histograms + every training-side family."""
+    _bootstrap_probes()
+    return REGISTRY.prometheus_text()
+
+
+# -- probe bootstrap --------------------------------------------------------
+
+_BOOT_LOCK = threading.Lock()
+_BOOTED = False
+
+
+def _bootstrap_probes():
+    """Register the probed families whose owners are instance-based
+    (LRU caches, the serving registry) or whose snapshot derives
+    values. Lazy + idempotent: called before a full read surface
+    (prometheus, dump_trace, snapshot-all), never at import — the
+    registry must not drag jax in. Probes import inside try: a family
+    whose subsystem can't import just reads empty."""
+    global _BOOTED
+    with _BOOT_LOCK:
+        if _BOOTED:
+            return
+        _BOOTED = True
+
+    def _probe(modpath, attr):
+        def probe():
+            import importlib
+
+            mod = importlib.import_module(modpath)
+            return getattr(mod, attr)()
+        return probe
+
+    for family, modpath, attr in (
+            ("eager_jit_cache", "mxnet_tpu.ndarray.registry",
+             "dispatch_cache_stats"),
+            ("fused_step", "mxnet_tpu.gluon.fused_step",
+             "fused_step_stats"),
+            ("compile_cache", "mxnet_tpu.utils.compile_cache",
+             "compile_cache_stats"),
+            ("serving", "mxnet_tpu.serving.metrics", "serving_stats"),
+            ("pipeline", "mxnet_tpu.pipeline", "pipeline_counters"),
+            ("resilience", "mxnet_tpu.resilience",
+             "resilience_counters"),
+            ("graph_verify", "mxnet_tpu.analysis", "counters"),
+            ("graph_opt", "mxnet_tpu.analysis.graph_opt", "counters"),
+            ("fusion", "mxnet_tpu.kernels", "counters"),
+            ("sharding", "mxnet_tpu.sharding", "sharding_counters"),
+    ):
+        REGISTRY.register_family(family, _probe(modpath, attr))
+    REGISTRY.register_exposition(
+        "serving", _probe("mxnet_tpu.serving.metrics",
+                          "prometheus_text"))

@@ -58,6 +58,9 @@ import warnings
 
 import numpy as onp
 
+from ..telemetry import metrics as _telemetry
+from ..telemetry import tracer as _telem
+
 __all__ = ["cache_enabled", "cache_dir", "fingerprint", "disk_load",
            "disk_store", "counting_jit", "note_retrace", "aot_compile",
            "load_or_compile", "GuardedCompiled", "bucket_spec",
@@ -66,8 +69,6 @@ __all__ = ["cache_enabled", "cache_dir", "fingerprint", "disk_load",
 
 FORMAT_VERSION = 1
 
-_LOCK = threading.Lock()
-
 
 def _zero_stats():
     return {"disk_hits": 0, "disk_misses": 0, "disk_writes": 0,
@@ -75,12 +76,13 @@ def _zero_stats():
             "bucketed_calls": 0, "padded_rows": 0, "true_rows": 0}
 
 
-_STATS = _zero_stats()
+# registry-owned since round 18; the registered "compile_cache" probe
+# (compile_cache_stats, + derived pad_ratio) shadows it on read surfaces
+_STATS = _telemetry.counter_family("compile_cache", _zero_stats())
 
 
 def _bump(name, n=1):
-    with _LOCK:
-        _STATS[name] += n
+    _STATS.add(name, n)
 
 
 def compile_cache_stats():
@@ -88,8 +90,7 @@ def compile_cache_stats():
 
     ``pad_ratio`` is total padded rows / total true rows over all
     bucketed dispatches (0.0 when nothing was bucketed)."""
-    with _LOCK:
-        st = dict(_STATS)
+    st = _STATS.snapshot()
     st["pad_ratio"] = (st["padded_rows"] / st["true_rows"]
                        if st["true_rows"] else 0.0)
     st["enabled"] = cache_enabled()
@@ -99,9 +100,7 @@ def compile_cache_stats():
 def reset_compile_cache_counters():
     """Zero the counters (tests, benchmarks). Does not touch the disk
     cache contents — remove the directory for that."""
-    global _STATS
-    with _LOCK:
-        _STATS = _zero_stats()
+    _STATS.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -281,6 +280,14 @@ def disk_load(fp):
     effort so they don't fail every future start."""
     if fp is None or not cache_enabled():
         return None
+    with _telem.span("compile_cache.disk_load", cat="io",
+                     fp=fp[:16]) as sp:
+        out = _disk_load_inner(fp)
+        sp.set(hit=out is not None)
+        return out
+
+
+def _disk_load_inner(fp):
     _ensure_jax_fallback_cache(cache_dir())
     path = _entry_path(fp)
     if not os.path.exists(path):
@@ -330,6 +337,14 @@ def disk_store(fp, compiled, meta=None, key_repr=None):
     step loop)."""
     if fp is None or not cache_enabled():
         return False
+    with _telem.span("compile_cache.disk_store", cat="io",
+                     fp=fp[:16]) as sp:
+        ok = _disk_store_inner(fp, compiled, meta, key_repr)
+        sp.set(written=ok)
+        return ok
+
+
+def _disk_store_inner(fp, compiled, meta, key_repr):
     _ensure_jax_fallback_cache(cache_dir())
     try:
         from jax.experimental import serialize_executable as _se

@@ -1,0 +1,51 @@
+"""Seeded graft_lint L901 violation fixture (NOT imported by the
+package). graft-lint: scope(counter-registry)
+
+The marker comment above opts this file into the counter-registry
+discipline that ``mxnet_tpu/`` (outside ``telemetry/``) gets
+automatically; the tier-1 lint test asserts every raw-mutation
+species below is flagged. Keep this file OUTSIDE mxnet_tpu/ so
+``python -m tools.graft_lint mxnet_tpu`` stays clean on the shipped
+tree.
+"""
+import threading
+
+
+def _zero_counters():
+    return {"hits": 0, "misses": 0}
+
+
+_COUNTERS = _zero_counters()
+_STATS = {"evictions": 0}
+_LOCK = threading.Lock()
+
+
+def bad_increment(name):
+    # L901: subscript write to a module-level raw counter dict
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + 1
+
+
+def bad_augassign():
+    # L901: augmented in-place bump
+    _STATS["evictions"] += 1
+
+
+def bad_bulk_update(snapshot):
+    # L901: mutating call
+    _COUNTERS.update(snapshot)
+
+
+def bad_clear():
+    # L901: mutating call (a lock does not make it registry-visible)
+    with _LOCK:
+        _COUNTERS.clear()
+
+
+def good_read(name):
+    # reads are fine — the rule is about writes bypassing the registry
+    return dict(_COUNTERS), _COUNTERS.get(name, 0)
+
+
+def whitelisted_bootstrap():
+    # a deliberate seed/bootstrap site carries the pragma
+    _STATS["evictions"] = 0  # graft-lint: allow(L901)

@@ -227,6 +227,57 @@ def test_decode_bench_smoke(tmp_path):
         assert json.load(f)["benchmark"] == "decode"
 
 
+@pytest.mark.slow
+def test_telemetry_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import telemetry_bench
+
+    out = str(tmp_path / "telem.json")
+    trace = str(tmp_path / "telem.trace.json")
+    doc = telemetry_bench.run(smoke=True, out_path=out,
+                              trace_path=trace)
+    assert doc["smoke"] is True
+    # the <2%/<3% overhead gates are timing properties of the full
+    # loop lengths and only enforced on the committed run
+    # (BENCH_TELEM_r18.json); the structural contracts hold at any
+    # scale: one trace id stitches the request lifecycle across >= 2
+    # lanes, and the pipelined slice records prefetch + fused-step
+    # spans
+    tr = doc["trace"]
+    assert tr["request_lifecycle_complete"], tr
+    assert tr["request_lanes"] >= 2
+    assert tr["prefetch_spans"] > 0 and tr["fused_step_spans"] > 0
+    with open(trace) as f:
+        trace_doc = json.load(f)  # the Perfetto acceptance bar
+    assert any(e["ph"] == "X" for e in trace_doc["traceEvents"])
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "telemetry"
+
+
+def test_bench_compare_telemetry_metrics():
+    """BENCH_TELEM_r18.json names: the tracer overhead percentages are
+    lower-is-better, the drain rps higher-is-better, per-step ms
+    lower-is-better; pair counts untracked."""
+    base = {"results": {"fused_step_overhead_pct": 0.8,
+                        "serving_overhead_pct": 2.5,
+                        "serving_rps_telem1": 3280.0,
+                        "fused_step_ms_telem1": 3.11},
+            "serving": {"pairs": 12}}
+    worse = {"results": {"fused_step_overhead_pct": 6.0,
+                         "serving_overhead_pct": 9.0,
+                         "serving_rps_telem1": 1500.0,
+                         "fused_step_ms_telem1": 3.11},
+             "serving": {"pairs": 12}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "results.serving_overhead_pct") == "lower"
+    assert rows["results.fused_step_overhead_pct"][4]  # span got hot
+    assert rows["results.serving_overhead_pct"][4]
+    assert rows["results.serving_rps_telem1"][4]       # drain halved
+    assert not rows["results.fused_step_ms_telem1"][4]
+    assert "serving.pairs" not in rows     # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_decode_metrics():
     """BENCH_DECODE_r16.json names: tokens/s throughputs and the two
     speedup ratios are higher-is-better, step counts untracked."""

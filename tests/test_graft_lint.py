@@ -24,6 +24,8 @@ SHARD_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                              "lint_raw_sharding.py")
 PALLAS_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                               "lint_raw_pallas.py")
+CTR_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                           "lint_raw_counter.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -213,6 +215,59 @@ def test_raw_pallas_fixture_triggers_l801():
     # the allow(L801) site and the non-pallas imports stay clean
     assert all(f.line < 15 for f in l801), l801
     assert {f.code for f in findings} == {"L801"}, findings
+
+
+def test_raw_counter_fixture_triggers_l901():
+    """L901: every raw-counter-mutation species in the seeded fixture
+    is flagged — subscript write, augmented bump, .update(), .clear()
+    — while reads, the registry-bound form and the allow(L901)
+    bootstrap site are not."""
+    findings = graft_lint.lint_paths([CTR_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l901 = [f for f in findings if f.code == "L901"]
+    assert len(l901) == 4, findings
+    msgs = "\n".join(f.message for f in l901)
+    assert "counter_family" in msgs
+    src = open(CTR_FIXTURE).read().splitlines()
+    for f in l901:
+        line = src[f.line - 1]
+        assert "_COUNTERS" in line or "_STATS" in line, (f.line, line)
+    # good_read and the pragma'd bootstrap site stay clean
+    assert all(f.line < 43 for f in l901), l901
+    assert {f.code for f in findings} == {"L901"}, findings
+
+
+def test_raw_counter_scope_exempts_telemetry_package(tmp_path):
+    """L901 binds mxnet_tpu/ automatically but exempts
+    mxnet_tpu/telemetry/ (which owns the CounterFamily primitive);
+    outside the package it is opt-in via scope(counter-registry), and
+    a counter_family(...) binding is never flagged."""
+    src = ('_COUNTERS = {"hits": 0}\n'
+           "def bump():\n"
+           '    _COUNTERS["hits"] += 1\n')
+    free = tmp_path / "ctr_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "utils" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [fi.code for fi in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
+    assert codes == ["L901"], codes
+    own = tmp_path / "mxnet_tpu" / "telemetry" / "frag.py"
+    own.parent.mkdir(parents=True)
+    own.write_text(src)
+    assert graft_lint.lint_paths([str(own)], repo_root=REPO,
+                                 registry=False) == []
+    blessed = tmp_path / "mxnet_tpu" / "utils" / "frag2.py"
+    blessed.write_text(
+        "from ..telemetry import metrics as _telemetry\n"
+        '_COUNTERS = _telemetry.counter_family("frag")\n'
+        "def bump():\n"
+        '    _COUNTERS.add("hits")\n')
+    assert graft_lint.lint_paths([str(blessed)], repo_root=REPO,
+                                 registry=False) == []
 
 
 def test_raw_pallas_scope_exempts_kernels_package(tmp_path):

@@ -14,7 +14,12 @@ inferred from the leaf name:
   BENCH_PIPELINE_r11.json — the async pipeline exists to shrink them),
   ``*overhead*`` (checkpoint-overhead metrics from BENCH_RESIL_r12.json
   — async checkpointing is gated at <5% epoch overhead, so growth
-  there is a resilience-cost regression), ``*nodes*`` / ``*trace*``
+  there is a resilience-cost regression — and the tracer-overhead
+  gates from BENCH_TELEM_r18.json: ``fused_step_overhead_pct`` /
+  ``serving_overhead_pct`` price ``MXNET_TELEMETRY=1`` against ``0``
+  on the fused-step loop and serving drain throughput, so growth
+  there means instrumentation crept into a hot path), ``*nodes*`` /
+  ``*trace*``
   (graph-opt metrics from BENCH_GRAPHOPT_r14.json — a like-for-like
   graph lowering to MORE nodes or a longer trace+compile means a
   rewrite pass stopped firing)

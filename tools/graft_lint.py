@@ -64,6 +64,18 @@ package:
                        at once (or holds batches forever). A
                        deliberate wall-clock site (log timestamps)
                        carries ``# graft-lint: allow(L602)``.
+``L901 raw-counter``   in-place mutation of a module-level counter/
+                       stats dict inside ``mxnet_tpu/`` but outside
+                       ``mxnet_tpu/telemetry/``. Round 18 moved every
+                       counter family into the telemetry
+                       MetricsRegistry (``telemetry.metrics.
+                       counter_family(...)`` — a one-line binding),
+                       so ONE registry feeds the ``/metrics``
+                       Prometheus exposition and the Chrome-trace
+                       counter samples; a raw ``_COUNTERS[k] += 1``
+                       against a module-level dict is invisible to
+                       both. Legitimate seed/bootstrap sites carry
+                       ``# graft-lint: allow(L901)``.
 ``L501 bare-except``   a bare ``except:`` clause, or a broad handler
                        (``except Exception``/``BaseException``, alone
                        or in a tuple) whose body is ONLY ``pass``/
@@ -670,6 +682,99 @@ def check_raw_pallas_import(path, tree, source, findings):
                 "annotate a deliberate site with allow(L801)"))
 
 
+def _counter_registry_scoped(path, source):
+    """Files the L901 counter-registry discipline applies to: all of
+    ``mxnet_tpu/`` EXCEPT the telemetry package itself (which owns the
+    CounterFamily primitive). Code outside the package opts in with a
+    ``# graft-lint: scope(counter-registry)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/telemetry/" in norm:
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(counter-registry)" in source
+
+
+def _counterish_name(name):
+    """Module-level names that read as counter/stat stores."""
+    return name == name.upper() and (
+        "COUNTER" in name or "STATS" in name or
+        name.endswith("_COUNTS"))
+
+
+def _raw_counter_value(value):
+    """True when the bound value is a raw mutable mapping — a dict
+    literal/comprehension, ``dict(...)``, ``dict.fromkeys(...)`` or a
+    ``_zero*()`` template builder — rather than a registry-owned
+    ``counter_family(...)`` binding."""
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return True
+    if isinstance(value, ast.Call):
+        dn = _dotted(value.func) or ""
+        last = dn.split(".")[-1]
+        return dn == "dict" or last == "fromkeys" or \
+            last.startswith("_zero") or last.startswith("zero_")
+    return False
+
+
+def check_raw_counter_mutation(path, tree, source, findings):
+    """L901: in-place mutation of a module-level raw counter dict.
+    Since round 18 every counter family lives in the telemetry
+    MetricsRegistry (``telemetry.metrics.counter_family``) so the
+    unified ``/metrics`` exposition and the Chrome-trace counter
+    samples see one source of truth; a module-level ``{...}`` bumped
+    in place is invisible to both surfaces and races without the
+    family's lock."""
+    if not _counter_registry_scoped(path, source):
+        return
+    raw = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                _raw_counter_value(node.value):
+            raw.update(t.id for t in node.targets
+                       if isinstance(t, ast.Name)
+                       and _counterish_name(t.id))
+    if not raw:
+        return
+    pragmas = _Pragmas(source)
+    seen = set()
+
+    def emit(node, what, name):
+        if pragmas.allows(node.lineno, "L901") or node.lineno in seen:
+            return
+        seen.add(node.lineno)
+        findings.append(Finding(
+            "L901", path, node.lineno,
+            f"{what} module-level raw counter dict '{name}' — bind it "
+            "through telemetry.metrics.counter_family(...) so the "
+            "unified /metrics exposition and trace counter samples "
+            "see it (one-line change), or annotate a deliberate "
+            "bootstrap site with allow(L901)"))
+
+    def raw_subscript(t):
+        return isinstance(t, ast.Subscript) and \
+            isinstance(t.value, ast.Name) and t.value.id in raw
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if raw_subscript(t):
+                    emit(node, "in-place write to", t.value.id)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if raw_subscript(t):
+                    emit(node, "deletion from", t.value.id)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in raw:
+            emit(node, f"mutating call '.{node.func.attr}()' on",
+                 node.func.value.id)
+
+
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
@@ -831,6 +936,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_graph_mutation(path, tree, source, findings)
         check_raw_sharding_construction(path, tree, source, findings)
         check_raw_pallas_import(path, tree, source, findings)
+        check_raw_counter_mutation(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
