@@ -199,10 +199,11 @@ def _fusion(graph, ctx):
                 and use_counts.get(k, 0) == 1 and node._num_outputs == 1
                 and node._output_index == 0)
 
-    def decide(pattern, members, root):
+    def decide(pattern, members, root, score_shape=None):
         d = cost_model.decide(pattern, len(members),
                               out_shape=_shape_of(root, shapes),
-                              backend=backend, mode=mode)
+                              backend=backend, mode=mode,
+                              score_shape=score_shape)
         if d.fuse:
             kernels._count(f"clusters_{pattern}")
             kernels._count(f"impl_{d.impl}")
@@ -250,7 +251,8 @@ def _fusion(graph, ctx):
             softmax_kw = _frozen_kwargs(p)
             if softmax_kw is None:
                 continue
-            d = decide("attention", members, n)
+            d = decide("attention", members, n,
+                       score_shape=_shape_of(score, shapes))
             if not d.fuse:
                 continue
             q, kk = score._inputs

@@ -77,7 +77,7 @@ __all__ = [
 #: fingerprint that can see optimized graphs, so optimized and
 #: unoptimized artifacts (or artifacts from different pipeline
 #: generations) never collide on disk
-PIPELINE_VERSION = "graphopt-r17.0"
+PIPELINE_VERSION = "graphopt-r19.0"
 
 #: verifier passes run before/after rewriting (no eval_shape: the
 #: whole-graph jax.eval_shape cross-check would eat the trace-time win
@@ -723,6 +723,8 @@ def _optimize_inner(symbol, shapes, dtypes, lvl, ctx, subject, passes,
     return optimized, stats
 
 
-# registers the round-17 fusion pass (+ its facts) into
-# REWRITE_PASSES; imported last so the pass infra above is complete
+# registers the round-17 fusion pass (+ its facts) and the round-19
+# int8 quantization passes into REWRITE_PASSES; imported last so the
+# pass infra above is complete
 from . import fusion  # noqa: E402,F401
+from . import quantize  # noqa: E402,F401

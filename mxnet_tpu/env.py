@@ -135,6 +135,27 @@ KNOBS = {
         "Pallas only on TPU at tile-aligned shapes) | always (fuse "
         "every match; bench/debug) | never (match + count but keep "
         "the 1:1 lowering)"),
+    "MXNET_QUANTIZE_LOWERING": (
+        "wired", "ndarray.ops_quant",
+        "how quantized conv/fc/batch_dot execute: auto (default — "
+        "native int8 on TPU where the MXU has a fast int8 path, "
+        "dequant elsewhere) | native (int8 operands, int32 "
+        "accumulation via preferred_element_type) | dequant (operands "
+        "converted to fp32 inline, fp32 accumulation rounded back to "
+        "the int32 lattice — the fast path on CPU XLA, which has no "
+        "native int8 kernels). Part of the quantized-graph "
+        "compile-cache fingerprint salt"),
+    "MXNET_QUANTIZE_SHADOW": (
+        "wired", "serving.repository",
+        "fraction (0..1, default 0) of canary requests whose response "
+        "is shadow-checked against the incumbent model; used by int8 "
+        "canary rollouts to catch accuracy regressions before promote"),
+    "MXNET_QUANTIZE_SHADOW_TOL": (
+        "wired", "serving.repository",
+        "max relative deviation a shadow-checked canary response may "
+        "show against the incumbent before the request counts as a "
+        "canary failure (default 0.1); failures feed the existing "
+        "circuit-breaker rollback"),
     "MXNET_TEST_SEED": (
         "wired", "test_utils",
         "fixed seed for test_utils.set_default_context/seeded test "

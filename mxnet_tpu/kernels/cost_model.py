@@ -45,14 +45,23 @@ def _pallas_viable(pattern, out_shape):
             and out_shape[-2] % _TILE_ROWS == 0)
 
 
+#: sequence length at which a lax attention cluster goes compute-bound:
+#: BENCH_FUSION_r17 measured the fused lax replay at 0.92x of the 1:1
+#: lowering once both score dims reach 64 — the QK^T/PV matmuls dominate
+#: and the fused executable only denies XLA its own gemm scheduling
+_ATTN_COMPUTE_BOUND_SEQ = 64
+
+
 def decide(pattern, n_nodes, out_shape=None, backend="cpu",
-           mode="heuristic"):
+           mode="heuristic", score_shape=None):
     """Decide one cluster: ``Decision(fuse, impl, reason)``.
 
     ``pattern`` is the cluster kind, ``n_nodes`` the member-op count,
     ``out_shape`` the cluster output shape when the shape fact resolved
     it (None otherwise), ``backend`` the jax default backend, ``mode``
-    the ``MXNET_FUSION_COST_MODEL`` knob.
+    the ``MXNET_FUSION_COST_MODEL`` knob. For ``attention`` clusters,
+    ``score_shape`` is the (..., seq_q, seq_k) shape of the QK^T score
+    tensor when known.
     """
     if mode == "never":
         return Decision(False, reason="cost_model_never")
@@ -63,6 +72,11 @@ def decide(pattern, n_nodes, out_shape=None, backend="cpu",
     if n_nodes < MIN_CLUSTER:
         # a 1-op "cluster" saves zero dispatches and costs a retrace
         return Decision(False, reason="too_small")
+    if (pattern == "attention" and impl == "lax"
+            and score_shape is not None and len(score_shape) >= 2
+            and score_shape[-2] >= _ATTN_COMPUTE_BOUND_SEQ
+            and score_shape[-1] >= _ATTN_COMPUTE_BOUND_SEQ):
+        return Decision(False, reason="compute_bound_attention")
     if pattern == "elementwise" and out_shape is not None:
         size = 1
         for d in out_shape:

@@ -12,6 +12,54 @@ import jax.numpy as jnp
 from .registry import register
 
 
+def lowering():
+    """Resolved execution strategy for the int32-accumulating quantized
+    ops (conv / fully_connected / batch_dot), from
+    ``MXNET_QUANTIZE_LOWERING``:
+
+    - ``native``: int8 operands, ``preferred_element_type=int32`` —
+      the MXU path on TPU.
+    - ``dequant``: operands converted to fp32 inline and accumulated in
+      fp32, rounded back onto the int32 lattice. CPU XLA has no native
+      int8 contraction kernels (int8 dots/convs run 6-30x slower than
+      fp32 there), so this is the fast path everywhere without an MXU.
+    - ``auto`` (default): native on TPU, dequant elsewhere.
+
+    The elementwise quantized ops (quantize/dequantize/requantize,
+    act/pool/add/concat/bn) are lowering-independent. Serving salts
+    quantized-graph fingerprints with the resolved value so AOT
+    artifacts compiled under different lowerings never collide.
+    """
+    from .. import env
+
+    mode = (env.get_str("MXNET_QUANTIZE_LOWERING", "auto") or
+            "auto").lower()
+    if mode not in ("auto", "native", "dequant"):
+        raise ValueError("MXNET_QUANTIZE_LOWERING must be auto, native "
+                         f"or dequant (got {mode!r})")
+    if mode != "auto":
+        return mode
+    import jax
+
+    return "native" if jax.default_backend() == "tpu" else "dequant"
+
+
+def _acc_cast(x):
+    """Operand dtype for the accumulating contraction under the
+    resolved lowering."""
+    return x if lowering() == "native" else x.astype(jnp.float32)
+
+
+def _acc_finish(acc):
+    """Accumulator back onto the int32 lattice. The native path is
+    already int32; the dequant path accumulated exact integer values in
+    fp32 (rounding error only past 2^24, far inside the quantization
+    noise floor), so rint+cast reproduces the lattice."""
+    if acc.dtype == jnp.int32:
+        return acc
+    return jnp.rint(acc).astype(jnp.int32)
+
+
 def _qparams(min_range, max_range, out_type):
     amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     if out_type == "int8":
@@ -276,11 +324,12 @@ def _contrib_quantized_conv(data, weight, min_data=None, max_data=None,
     ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
     dn = _lax.conv_dimension_numbers(data.shape, weight.shape,
                                      _conv_dims(nd, layout))
-    acc = _lax.conv_general_dilated(
-        data, weight, window_strides=stride_,
+    native = lowering() == "native"
+    acc = _acc_finish(_lax.conv_general_dilated(
+        _acc_cast(data), _acc_cast(weight), window_strides=stride_,
         padding=[(p, p) for p in pad_], rhs_dilation=dilate_,
         dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.int32)
+        **({"preferred_element_type": jnp.int32} if native else {})))
     if bias is not None and not no_bias:
         from .ops_nn import _CHANNEL_LAST
 
@@ -310,11 +359,38 @@ def _contrib_quantized_fully_connected(data, weight, min_data=None,
         data = data.reshape(data.shape[0], -1)
     data, ds = _to_s8_lattice(data, min_data, max_data)
     ws = _sym_scale(_scalar(min_weight), _scalar(max_weight))
-    acc = _lax.dot(data, weight.T, preferred_element_type=jnp.int32)
+    native = lowering() == "native"
+    acc = _acc_finish(_lax.dot(
+        _acc_cast(data), _acc_cast(weight).T,
+        **({"preferred_element_type": jnp.int32} if native else {})))
     if bias is not None and not no_bias:
         bq = jnp.rint(bias.astype(jnp.float32) / (ds * ws)).astype(jnp.int32)
         acc = acc + bq
     omax = 127.0 * 127.0 * ds * ws
+    return acc, -omax, omax
+
+
+@register(differentiable=False)
+def _contrib_quantized_batch_dot(lhs, rhs, min_lhs=None, max_lhs=None,
+                                 min_rhs=None, max_rhs=None,
+                                 transpose_a=False, transpose_b=False):
+    """Quantized batched matmul (reference: the quantized_batch_dot
+    MKLDNN op; fp32 semantics match dot.cc batch_dot). Both operands
+    are activations — there is no offline weight — so the pass
+    quantizes both inputs and follows with `requantize`. int8×int8
+    accumulating int32 under the native lowering; shares the conv/fc
+    encode rule: amax = 127*127*ls*rs."""
+    lhs, ls = _to_s8_lattice(lhs, min_lhs, max_lhs)
+    rhs, rs = _to_s8_lattice(rhs, min_rhs, max_rhs)
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    native = lowering() == "native"
+    acc = _acc_finish(jnp.matmul(
+        _acc_cast(lhs), _acc_cast(rhs),
+        **({"preferred_element_type": jnp.int32} if native else {})))
+    omax = 127.0 * 127.0 * ls * rs
     return acc, -omax, omax
 
 

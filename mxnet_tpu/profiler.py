@@ -191,6 +191,14 @@ def fusion_counters():
     return _family("fusion")
 
 
+def quantize_counters():
+    """Int8 quantization pass counters (graphs/nodes quantized, islands
+    elided, boundaries calibrated, scales folded, uint8 upgrades,
+    offline weight bytes saved), live from mxnet_tpu.analysis.quantize.
+    Zeros before the first ``quantize_symbol``/``quantize_model``."""
+    return _family("quantize")
+
+
 def sharding_counters():
     """Rule-based SPMD sharding counters (plans built, rules matched/
     unmatched, divisibility fallbacks, fused-step groups compiled under

@@ -171,6 +171,8 @@ _COUNTER_NAMES = (
     "canary_rollbacks", "model_swaps",
     # round 16: stateful continuous-batching decode
     "decode_steps", "evictions", "resumed_sessions",
+    # round 19: the MXNET_QUANTIZE_SHADOW accuracy gate
+    "canary_shadow_checks", "canary_shadow_mismatches",
 )
 
 #: the per-SLO-class slice of the counters (suffixed ``:<class>``)

@@ -32,6 +32,14 @@ version auto-promotes via an atomic hot-swap (the ``model_swap``
 fault seam; an injected fire aborts the swap and the incumbent stays
 active — rollback itself is deliberately seam-free).
 
+Round 19 adds the **shadow accuracy gate** for quantized rollouts:
+with ``MXNET_QUANTIZE_SHADOW`` > 0, that fraction of canary requests
+is ALSO run on the incumbent and the answers are diffed; a relative
+deviation past ``MXNET_QUANTIZE_SHADOW_TOL`` feeds the same breaker.
+An int8 canary that is fast but numerically wrong — invisible to both
+the failure and latency checks — rolls back automatically, and the
+client never sees it (shadow verdicts land after the answer).
+
 Every transition (deploy/promote/rollback/swap) bumps a process
 counter surfaced through ``profiler.serving_counters()``, Prometheus
 ``/metrics`` and the repository's ``healthz()`` block.
@@ -65,6 +73,28 @@ _LAT_ALPHA = 0.2
 _MIN_LAT_SAMPLES = 8
 
 
+def _rel_deviation(a, b):
+    """max |a-b| / max |b| across (possibly nested) outputs — the
+    shadow-check distance between a canary answer and the incumbent's.
+    Normalizing by the incumbent's max keeps the tolerance meaningful
+    for logits near zero, where elementwise relative error explodes."""
+    import numpy as onp
+
+    if isinstance(a, (list, tuple)):
+        if not isinstance(b, (list, tuple)) or len(a) != len(b):
+            return float("inf")
+        return max((_rel_deviation(x, y) for x, y in zip(a, b)),
+                   default=0.0)
+    a = onp.asarray(a.asnumpy() if hasattr(a, "asnumpy") else a,
+                    dtype="float64")
+    b = onp.asarray(b.asnumpy() if hasattr(b, "asnumpy") else b,
+                    dtype="float64")
+    if a.shape != b.shape:
+        return float("inf")
+    denom = max(float(onp.max(onp.abs(b))), 1e-12) if b.size else 1.0
+    return float(onp.max(onp.abs(a - b))) / denom if a.size else 0.0
+
+
 class _Version:
     __slots__ = ("version", "session", "batcher")
 
@@ -92,6 +122,7 @@ class _Model:
         self.canary_lat_ema = None
         self.incumbent_lat_ema = None
         self._tick = 0  # deterministic canary routing counter
+        self._shadow_tick = 0  # deterministic shadow-check sampling
         self.state = "empty"
         self.last_transition = "created"
 
@@ -125,6 +156,15 @@ class ModelRepository:
         self._canary_latency_x = float(
             canary_latency_x if canary_latency_x is not None else
             _env.get_float("MXNET_SERVING_CANARY_LATENCY_X", 3.0))
+        # shadow accuracy gate (round 19): a fraction of canary
+        # requests ALSO run on the incumbent and the outputs are
+        # compared — the int8-rollout guard, where a quantized canary
+        # can be fast AND wrong, which neither the failure nor the
+        # latency check would ever catch
+        self._shadow_fraction = min(1.0, max(0.0, _env.get_float(
+            "MXNET_QUANTIZE_SHADOW", 0.0)))
+        self._shadow_tol = _env.get_float(
+            "MXNET_QUANTIZE_SHADOW_TOL", 0.1)
 
     # -- registration / lifecycle --------------------------------------
 
@@ -202,6 +242,7 @@ class ModelRepository:
             m.canary_lat_ema = None
             m.incumbent_lat_ema = None
             m._tick = 0
+            m._shadow_tick = 0
             m.state = "canary"
             m.last_transition = f"canary v{ver} deployed"
             METRICS.bump("canary_deploys")
@@ -376,6 +417,23 @@ class ModelRepository:
         METRICS.bump("canary_requests")
         outer = Future()
         t0 = time.monotonic()
+        shadow = None
+        if self._shadow_fraction > 0.0:
+            with m.lock:
+                # same counter routing as the canary slice: exactly
+                # shadow_fraction of canary requests get a duplicate
+                # incumbent run to diff against, no RNG flakes
+                m._shadow_tick += 1
+                sf = self._shadow_fraction
+                take = int(m._shadow_tick * sf) != \
+                    int((m._shadow_tick - 1) * sf)
+            if take:
+                try:
+                    shadow = incumbent.batcher.submit(
+                        *inputs, timeout_ms=timeout_ms, slo_class=cls)
+                except Exception:  # noqa: BLE001 — shadow is advisory;
+                    # a full incumbent queue must not fail the request
+                    shadow = None
         try:
             inner = canary.batcher.submit(
                 *inputs, timeout_ms=timeout_ms, slo_class=cls,
@@ -393,6 +451,10 @@ class ModelRepository:
         def _done(f):
             err = f.exception()
             if err is None:
+                if shadow is not None:
+                    shadow.add_done_callback(
+                        lambda g: self._shadow_check(
+                            m, canary.version, f, g))
                 self._canary_success(m, canary.version,
                                      time.monotonic() - t0)
                 if outer.set_running_or_notify_cancel():
@@ -473,6 +535,37 @@ class ModelRepository:
                                 "(%s: %s); canary stays under "
                                 "evaluation", m.name,
                                 type(e).__name__, e)
+
+    def _shadow_check(self, m, version, canary_fut, shadow_fut):
+        """The MXNET_QUANTIZE_SHADOW accuracy gate: diff one canary
+        answer against the incumbent's for the same inputs. A relative
+        deviation past MXNET_QUANTIZE_SHADOW_TOL is ``record_failure``
+        on the canary breaker — same single rollback mechanism as
+        execution failures and latency regressions — so a quantized
+        canary that is fast but numerically wrong still rolls back with
+        zero client-visible errors (the client already has its
+        answer)."""
+        if shadow_fut.exception() is not None:
+            return  # incumbent trouble is not canary badness
+        METRICS.bump("canary_shadow_checks")
+        try:
+            dev = _rel_deviation(canary_fut.result(),
+                                 shadow_fut.result())
+        except Exception:  # noqa: BLE001 — advisory path, never raise
+            logging.exception("serving: model %s shadow comparison "
+                              "failed", m.name)
+            return
+        if dev <= self._shadow_tol:
+            return
+        METRICS.bump("canary_shadow_mismatches")
+        with m.lock:
+            if m.canary != version:
+                return
+            m.canary_breaker.record_failure()
+            if m.canary_breaker.state != "closed":
+                self._rollback_locked(
+                    m, f"shadow accuracy deviation {dev:.4f} > "
+                       f"tolerance {self._shadow_tol:g}")
 
     def _canary_failure(self, m, version, err):
         with m.lock:

@@ -462,7 +462,7 @@ class InferenceSession:
     def _fingerprint(self, bucket, amp_ver):
         if self._graph_sig is None:
             return None
-        from ..analysis import graph_opt
+        from ..analysis import graph_opt, quantize
         from ..gluon.block import SymbolBlock
 
         # graph-opt rewrites change the lowered computation without
@@ -476,6 +476,9 @@ class InferenceSession:
         # collectives baked in): salt with the plan + mesh identity
         shard_salt = (self._shard["salt"] if self._shard is not None
                       else ("sharding", 0))
+        # int8 graphs lower differently per MXNET_QUANTIZE_LOWERING;
+        # () for fp32 graphs so their keys never vary with the knob
+        quant_salt = quantize.fingerprint_salt(self._graph_sig)
         key = ("serving", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -483,7 +486,7 @@ class InferenceSession:
                   for v in self._param_vals),
             tuple((s.name, (bucket,) + s.row_shape, str(s.dtype))
                   for s in self._input_specs),
-            amp_ver, bucket, opt_salt, shard_salt)
+            amp_ver, bucket, opt_salt, shard_salt, quant_salt)
         code_of = [type(self)._pure, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
         return cc.fingerprint("serving", key, code_of=tuple(code_of))
@@ -551,12 +554,13 @@ class InferenceSession:
         collide."""
         if self._graph_sig is None:
             return None
-        from ..analysis import graph_opt
+        from ..analysis import graph_opt, quantize
         from ..gluon.block import SymbolBlock
 
         opt_salt = (graph_opt.fingerprint_salt()
                     if isinstance(self._block, SymbolBlock)
                     else ("graph_opt", 0))
+        quant_salt = quantize.fingerprint_salt(self._graph_sig)
         key = ("serving_step", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -567,7 +571,7 @@ class InferenceSession:
             ("state",) + tuple(
                 (s.name, (occupancy,) + s.row_shape, str(s.dtype))
                 for s in self._state_specs),
-            amp_ver, occupancy, opt_salt)
+            amp_ver, occupancy, opt_salt, quant_salt)
         code_of = [type(self)._pure_step, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
         return cc.fingerprint("serving_step", key,

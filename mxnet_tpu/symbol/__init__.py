@@ -30,8 +30,13 @@ class Symbol:
                  num_outputs=1, output_index=0, group=None):
         self._op = op  # str op name; None for variables/groups
         self._name = name
-        self._inputs = inputs or []  # list[Symbol]
-        self._kwargs = kwargs or {}
+        # `is not None` (not truthiness): __getitem__ views must share
+        # the SAME list/dict objects as their base even when empty —
+        # node identity keys are (op, id(_inputs), id(_kwargs)), and an
+        # `or {}` here would give every view of an empty-kwargs
+        # multi-output node a fresh dict, i.e. a fresh identity
+        self._inputs = inputs if inputs is not None else []  # list[Symbol]
+        self._kwargs = kwargs if kwargs is not None else {}
         self._num_outputs = num_outputs
         self._output_index = output_index
         self._group = group  # list[Symbol] when this is a Group

@@ -38,20 +38,32 @@ def _param_shape_rules(op, kw, in_shapes, arg_names):
     def named(name):
         return arg_names.index(name) if name in arg_names else None
 
-    if op == "fully_connected":
+    def range_scalars():
+        # offline-quantized range variables (`*_min` / `*_max`) are
+        # (1,)-shaped, matching quantize_model's nd.array([±amax])
+        for r in ("min_data", "max_data", "min_weight", "max_weight",
+                  "min_bias", "max_bias"):
+            if named(r) is not None:
+                out[named(r)] = (1,)
+
+    if op in ("fully_connected", "_contrib_quantized_fully_connected"):
         num_hidden = kw.get("num_hidden")
         flatten = kw.get("flatten", True)
         in_units = _prod(data[1:]) if flatten else data[-1]
         out[named("weight")] = (num_hidden, in_units)
         if named("bias") is not None:
             out[named("bias")] = (num_hidden,)
-    elif op == "convolution":
+        if op.startswith("_contrib_quantized_"):
+            range_scalars()
+    elif op in ("convolution", "_contrib_quantized_conv"):
         kernel = tuple(kw.get("kernel"))
         nf = kw.get("num_filter")
         g = kw.get("num_group", 1)
         out[named("weight")] = (nf, data[1] // g) + kernel
         if named("bias") is not None:
             out[named("bias")] = (nf,)
+        if op.startswith("_contrib_quantized_"):
+            range_scalars()
     elif op == "deconvolution":
         kernel = tuple(kw.get("kernel"))
         nf = kw.get("num_filter")
@@ -59,7 +71,8 @@ def _param_shape_rules(op, kw, in_shapes, arg_names):
         out[named("weight")] = (data[1], nf // g) + kernel
         if named("bias") is not None:
             out[named("bias")] = (nf,)
-    elif op in ("batch_norm",):
+    elif op in ("batch_norm", "_contrib_quantized_batch_norm"):
+        # quantized BN is only formed for axis=1 (the pass gates on it)
         axis = kw.get("axis", 1)
         c = (data[axis],)
         for pname in ("gamma", "beta", "moving_mean", "moving_var"):
@@ -115,6 +128,24 @@ def _array_arg_names(opdef):
     sig = inspect.signature(opdef.fn)
     return [p.name for p in sig.parameters.values()
             if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+
+
+#: quantized MXU ops accumulate via preferred_element_type=int32, which
+#: XLA only accepts over integer operands — their eval_shape specs must
+#: be int8 at (data, weight). Every other op is shape-polymorphic over
+#: dtype, so the fp32 default stays (and stays bit-identical for
+#: existing fp32 graphs).
+_INT8_SPEC_SLOTS = {
+    "_contrib_quantized_conv": (0, 1),
+    "_contrib_quantized_fully_connected": (0, 1),
+    "_contrib_quantized_batch_dot": (0, 1),
+}
+
+
+def _spec_dtype(op, idx):
+    if idx in _INT8_SPEC_SLOTS.get(op, ()):
+        return onp.int8
+    return onp.float32
 
 
 def infer_shapes(symbol, known, allow_unknown=False,
@@ -174,7 +205,8 @@ def infer_shapes(symbol, known, allow_unknown=False,
                 f"cannot infer shape for inputs {missing} of op "
                 f"'{node._op}' ({node._name})")
 
-        specs = [jax.ShapeDtypeStruct(in_shapes[i], onp.float32)
+        specs = [jax.ShapeDtypeStruct(in_shapes[i],
+                                      _spec_dtype(node._op, i))
                  for i in range(len(node._inputs))]
         kwargs = dict(node._kwargs)
 
@@ -222,8 +254,28 @@ _FIXED_OUT_DTYPE = {
 }
 
 # ops whose non-data variable inputs have a fixed default dtype instead
-# of the same-type sibling constraint (reference FInferType specifics)
-_PARAM_DTYPE_DEFAULTS = {"embedding": {1: onp.float32}}
+# of the same-type sibling constraint (reference FInferType specifics).
+# Quantized conv/fc weight variables (`*_quantized`, offline weight
+# quantization) are int8 by construction — without the entry the
+# sibling constraint would promote them to the fp32 of the range inputs
+_PARAM_DTYPE_DEFAULTS = {
+    "embedding": {1: onp.float32},
+    "_contrib_quantized_conv": {1: onp.int8},
+    "_contrib_quantized_fully_connected": {1: onp.int8},
+}
+
+#: quantized int32-accumulator ops (a following requantize narrows)
+_QUANT_ACC_OPS = ("_contrib_quantized_conv",
+                  "_contrib_quantized_fully_connected",
+                  "_contrib_quantized_batch_dot")
+#: quantized ops whose payload output is int8 on a fresh lattice
+_QUANT_S8_OPS = ("_contrib_quantized_elemwise_add",
+                 "_contrib_quantized_concat",
+                 "_contrib_quantized_batch_norm")
+#: quantized ops that pass the input lattice (int8 OR uint8) through
+_QUANT_PASSTHROUGH_OPS = ("_contrib_quantized_act",
+                          "_contrib_quantized_flatten",
+                          "_contrib_quantized_pooling")
 
 
 def _node_out_dtype(op, kw, in_dtypes):
@@ -239,6 +291,13 @@ def _node_out_dtype(op, kw, in_dtypes):
     if op == "requantize":
         return [_canon(kw.get("out_type", "int8")),
                 onp.dtype(onp.float32), onp.dtype(onp.float32)]
+    f32 = onp.dtype(onp.float32)
+    if op in _QUANT_ACC_OPS:
+        return [onp.dtype(onp.int32), f32, f32]
+    if op in _QUANT_S8_OPS:
+        return [onp.dtype(onp.int8), f32, f32]
+    if op in _QUANT_PASSTHROUGH_OPS:
+        return [onp.dtype(in_dtypes.get(0, onp.int8)), f32, f32]
     if op in ("_sym_zeros", "_sym_ones", "_sym_constant"):
         return _canon(kw.get("dtype", "float32"))
     if op == "embedding":

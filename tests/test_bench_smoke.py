@@ -479,6 +479,46 @@ def test_bench_compare_flags_regression():
     assert any(r[4] for r in rows)  # speedup halved
 
 
+@pytest.mark.slow
+def test_quant_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import quant_bench
+
+    out = str(tmp_path / "quant.json")
+    doc = quant_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["lowering"] in ("native", "dequant")
+    assert doc["weights"]["reduction_x"] > 2.0
+    assert doc["results"][0]["accuracy_delta"] < 0.1
+    assert doc["quantize_counters"]["graphs_quantized"] >= 1
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "quantized_serving"
+
+
+def test_bench_compare_quant_metrics():
+    """BENCH_QUANT_r19.json names: bytes_moved and accuracy_delta are
+    lower-is-better, the int8 speedup/rps higher-is-better, the weight
+    reduction ratio untracked (a layout fact, not a speed)."""
+    base = {"weights": {"int8_bytes_moved": 11759880,
+                        "reduction_x": 3.98},
+            "results": [{"speedup": 1.34, "int8_rps": 10.4,
+                         "accuracy_delta": 0.03}]}
+    worse = {"weights": {"int8_bytes_moved": 46796448,
+                         "reduction_x": 3.98},
+             "results": [{"speedup": 0.9, "int8_rps": 6.1,
+                          "accuracy_delta": 0.21}]}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "weights.int8_bytes_moved") == "lower"
+    assert bench_compare._direction(
+        "results[0].accuracy_delta") == "lower"
+    assert rows["weights.int8_bytes_moved"][4]   # weights grew back
+    assert rows["results[0].accuracy_delta"][4]  # int8 went numerically bad
+    assert rows["results[0].speedup"][4]
+    assert rows["results[0].int8_rps"][4]
+    assert "weights.reduction_x" not in rows     # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_cli_exit_codes(tmp_path):
     base, new_ok, new_bad = (str(tmp_path / n) for n in
                              ("base.json", "ok.json", "bad.json"))

@@ -95,6 +95,18 @@ def test_cost_model_goldens():
     assert (d.fuse, d.reason) == (False, "cost_model_never")
     d = cost_model.decide("attention", 1, mode="always")
     assert d.fuse
+    # BENCH_FUSION_r17: lax attention at seq>=64 is compute-bound
+    # (0.92x) — reject; below the floor or on either short axis, fuse
+    d = cost_model.decide("attention", 3, score_shape=(2, 64, 64))
+    assert (d.fuse, d.reason) == (False, "compute_bound_attention")
+    d = cost_model.decide("attention", 3, score_shape=(2, 63, 64))
+    assert d.fuse
+    d = cost_model.decide("attention", 3, score_shape=(2, 6, 6))
+    assert d.fuse
+    # the Pallas TPU kernel stays profitable at long sequence lengths
+    d = cost_model.decide("attention", 3, out_shape=(256, 512),
+                          backend="tpu", score_shape=(2, 128, 128))
+    assert (d.fuse, d.impl) == (True, "pallas")
 
 
 def test_cost_model_never_keeps_lowering(monkeypatch):
@@ -139,6 +151,21 @@ def test_attention_golden_and_bitwise(scale_op):
     assert _ops(opt) == ["_fused_attention"]
     assert kernels.counters()["clusters_attention"] == 1
     feed = _feed(q=(2, 6, 8), k=(2, 6, 8), v=(2, 6, 8))
+    assert (_eval(out, feed) == _eval(opt, feed)).all()
+
+
+def test_attention_compute_bound_seq_not_fused():
+    """seq>=64 lax attention is compute-bound (BENCH_FUSION_r17 showed
+    the fused replay at 0.92x): the shape-aware cost model must keep
+    the 1:1 lowering and count the fallback."""
+    out = _attention("mul")
+    shapes = {k: (2, 64, 8) for k in ("q", "k", "v")}
+    opt, _ = optimize_symbol(out, shapes=shapes, subject="att_cb")
+    assert "_fused_attention" not in _ops(opt)
+    c = kernels.counters()
+    assert c["fallback_compute_bound_attention"] == 1
+    assert c.get("clusters_attention", 0) == 0
+    feed = _feed(q=(2, 64, 8), k=(2, 64, 8), v=(2, 64, 8))
     assert (_eval(out, feed) == _eval(opt, feed)).all()
 
 

@@ -22,7 +22,11 @@ inferred from the leaf name:
   ``*trace*``
   (graph-opt metrics from BENCH_GRAPHOPT_r14.json — a like-for-like
   graph lowering to MORE nodes or a longer trace+compile means a
-  rewrite pass stopped firing)
+  rewrite pass stopped firing), ``*bytes_moved*`` / ``*accuracy_delta*``
+  (int8 serving metrics from BENCH_QUANT_r19.json — the quantized
+  path's weight traffic and its deviation from the fp32 answer; growth
+  in either means the quantize passes stopped shrinking the model or
+  started costing accuracy)
 - higher is better: ``*speedup*``, ``*throughput*``, ``*per_sec*``,
   ``*per_s`` (end-anchored: ``steps_per_s`` is throughput but
   ``fused_ms_per_step`` stays latency), ``*items_per*``, ``*_rps*``
@@ -58,7 +62,8 @@ import sys
 
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "p50", "p95", "p99", "epoch_s", "idle", "stall",
-                   "overhead", "shed", "nodes", "trace")
+                   "overhead", "shed", "nodes", "trace",
+                   "bytes_moved", "accuracy_delta")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput",
                     "efficiency", "tokens_per", "hit_rate")
