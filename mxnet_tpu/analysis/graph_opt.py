@@ -728,3 +728,18 @@ def _optimize_inner(symbol, shapes, dtypes, lvl, ctx, subject, passes,
 # pass infra above is complete
 from . import fusion  # noqa: E402,F401
 from . import quantize  # noqa: E402,F401
+
+
+# -- artifact-layer salt provider -------------------------------------------
+# the "graph_opt" contribution to CompiledArtifact fingerprints: call
+# sites declare the name; composition stays here with the pipeline
+
+def _salt_provider(ctx):
+    if not ctx.get("optimizable"):
+        return ("graph_opt", 0)
+    return fingerprint_salt(ctx.get("opt_level"))
+
+
+from ..artifact import salts as _artifact_salts  # noqa: E402
+
+_artifact_salts.register_salt_provider("graph_opt", _salt_provider)

@@ -551,3 +551,15 @@ def _register():
 
 
 _register()
+
+
+# -- artifact-layer salt provider -------------------------------------------
+
+def _salt_provider(ctx):
+    sig = ctx.get("graph_signature")
+    return fingerprint_salt(sig) if sig is not None else ()
+
+
+from ..artifact import salts as _artifact_salts  # noqa: E402
+
+_artifact_salts.register_salt_provider("quantize", _salt_provider)

@@ -1,0 +1,41 @@
+"""The CompiledArtifact subsystem: one layer from salt declaration to
+deployable AOT artifact.
+
+Round 20 extracts the three hand-rolled fingerprint/load-or-compile
+paths (serving buckets, the fused train-step, eager dispatch) into one
+abstraction — the TVM compile-and-deploy artifact model applied to
+every executable the framework AOT-compiles:
+
+- :mod:`.salts` — declarative fingerprint-salt providers: subsystems
+  whose state changes a lowering register a provider; call sites
+  declare provider names instead of concatenating salt tuples (the
+  graft_lint L1001 rule enforces this).
+- :mod:`.core` — :class:`CompiledArtifact`: canonical fingerprint →
+  local disk tier → remote tier → compile → persist, returning a
+  ``GuardedCompiled`` every time.
+- :mod:`.bundle` — deployment bundles: a model version's full artifact
+  set exported as one file; a bundle-warm replica serves its first
+  response with zero traces and zero compiles.
+- :mod:`.remote` — the fleet-shared remote cache tier (``file://`` or
+  ``http(s)://``), wrapped in the round-12 retry policy + circuit
+  breaker so a flaky cache host degrades to local compile.
+
+Counters ride the ``artifact`` telemetry family
+(:func:`artifact_stats`), rendered as ``mxnet_artifact_*`` gauges on
+the serving ``/metrics`` surface.
+"""
+from ._counters import artifact_stats, reset_artifact_counters
+from .salts import register_salt_provider, resolve_salts, salt_providers
+from .core import CompiledArtifact
+from .bundle import BUNDLE_FORMAT, export_bundle, import_bundle
+from .remote import (ArtifactCacheServer, fetch, publish, publish_path,
+                     remote_url, reset_remote_state)
+
+__all__ = [
+    "CompiledArtifact",
+    "register_salt_provider", "resolve_salts", "salt_providers",
+    "BUNDLE_FORMAT", "export_bundle", "import_bundle",
+    "ArtifactCacheServer", "fetch", "publish", "publish_path",
+    "remote_url", "reset_remote_state",
+    "artifact_stats", "reset_artifact_counters",
+]

@@ -1,0 +1,39 @@
+"""Counter family for the artifact subsystem (remote tier + bundles).
+
+One registry-owned family (round-18 discipline): the remote cache
+tier's hit/miss/error/bytes counters and the deployment-bundle
+export/import counters, rendered on the serving ``/metrics`` surface
+as ``mxnet_artifact_*`` gauges next to the ``compile_cache`` family
+they extend.
+"""
+from __future__ import annotations
+
+from ..telemetry import metrics as _telemetry
+
+__all__ = ["STATS", "artifact_stats", "reset_artifact_counters"]
+
+
+def _zero_stats():
+    return {
+        # remote tier (fetch side)
+        "remote_hits": 0, "remote_misses": 0, "remote_errors": 0,
+        "remote_corrupt": 0, "remote_skipped": 0, "fetch_bytes": 0,
+        # remote tier (publish side)
+        "remote_publishes": 0, "publish_errors": 0, "publish_bytes": 0,
+        # deployment bundles
+        "bundle_exports": 0, "bundle_imports": 0,
+        "bundle_entries_written": 0, "bundle_entries_skipped": 0,
+    }
+
+
+STATS = _telemetry.counter_family("artifact", _zero_stats())
+
+
+def artifact_stats():
+    """Remote-tier + bundle counters (the ``artifact`` family)."""
+    return STATS.snapshot()
+
+
+def reset_artifact_counters():
+    """Zero the counters (tests, benchmarks)."""
+    STATS.reset()

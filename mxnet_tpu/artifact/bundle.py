@@ -1,0 +1,98 @@
+"""Deployment bundles: a model version's full artifact set as ONE file.
+
+A replica warm-started from a bundle serves its first response with
+zero traces and zero XLA compiles: ``export_bundle`` packs the local
+``.mxc`` envelopes for a set of fingerprints (every bucket/occupancy
+executable a warmed ``InferenceSession`` resolved — fp32 or int8,
+sharded or not) into a single pickle file; ``import_bundle`` unpacks
+them into the importing process's compile-cache directory, where the
+normal ``disk_load`` path deserializes them at ``warmup()``.
+
+The bundle rides the local tier's envelope format verbatim and carries
+the exporter's compatibility salt (format version + jax/jaxlib/backend/
+framework versions). An importer with a different salt skips every
+entry up front — each would fail ``disk_load``'s per-entry check
+anyway — and reports ``stale=True`` so deploy tooling can fall back to
+compiling (or fetch a matching bundle).
+
+``ModelRepository.export_bundle`` is the fleet-facing wrapper: it warms
+the chosen model version and exports its fingerprints with a manifest.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from ..base import MXNetError
+from ..utils import compile_cache as _cc
+from ._counters import STATS
+
+__all__ = ["BUNDLE_FORMAT", "export_bundle", "import_bundle"]
+
+BUNDLE_FORMAT = 1
+
+
+def export_bundle(path, fingerprints, manifest=None):
+    """Pack the local cache entries for ``fingerprints`` into one
+    bundle file at ``path`` (atomic write). Entries missing locally
+    (never resolved, pruned, memory-only) are reported, not fatal.
+    Returns ``{"path", "entries", "missing", "bytes"}``."""
+    entries = {}
+    missing = []
+    for fp in sorted(set(f for f in fingerprints if f)):
+        try:
+            with open(_cc._entry_path(fp), "rb") as f:
+                entries[fp] = f.read()
+        except OSError:
+            missing.append(fp)
+    envelope = {"format": BUNDLE_FORMAT, "salt": _cc._salt(),
+                "manifest": dict(manifest or {}), "entries": entries}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(envelope, f)
+    os.replace(tmp, path)
+    STATS.add("bundle_exports")
+    return {"path": path, "entries": len(entries), "missing": missing,
+            "bytes": os.path.getsize(path)}
+
+
+def import_bundle(path):
+    """Unpack a bundle into the local compile-cache directory. Returns
+    ``{"written", "skipped", "manifest", "stale"}``; ``stale=True``
+    means the exporter's compatibility salt does not match this
+    process (nothing written). Raises ``MXNetError`` for a file that
+    is not a bundle."""
+    try:
+        with open(path, "rb") as f:
+            envelope = pickle.load(f)
+    except (OSError, pickle.UnpicklingError, EOFError) as e:
+        raise MXNetError(f"cannot read bundle {path!r}: {e}") from e
+    if not isinstance(envelope, dict) \
+            or envelope.get("format") != BUNDLE_FORMAT:
+        raise MXNetError(
+            f"{path!r} is not a format-{BUNDLE_FORMAT} artifact bundle")
+    entries = envelope.get("entries", {})
+    manifest = envelope.get("manifest", {})
+    if envelope.get("salt") != _cc._salt():
+        STATS.add("bundle_imports")
+        STATS.add("bundle_entries_skipped", len(entries))
+        return {"written": 0, "skipped": len(entries),
+                "manifest": manifest, "stale": True}
+    directory = _cc.cache_dir()
+    os.makedirs(directory, exist_ok=True)
+    written = skipped = 0
+    for fp, blob in entries.items():
+        dest = os.path.join(directory, fp + ".mxc")
+        tmp = f"{dest}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, dest)
+            written += 1
+        except OSError:
+            skipped += 1
+    STATS.add("bundle_imports")
+    STATS.add("bundle_entries_written", written)
+    STATS.add("bundle_entries_skipped", skipped)
+    return {"written": written, "skipped": skipped,
+            "manifest": manifest, "stale": False}

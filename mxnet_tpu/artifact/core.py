@@ -1,0 +1,149 @@
+"""CompiledArtifact: one abstraction from salt declaration to guarded
+executable.
+
+Every AOT artifact in the framework — a serving bucket executable, a
+fused train-step, an eager-dispatch executable — goes through the same
+lifecycle: compose a canonical fingerprint (cache key + declared salt
+providers + traced-body bytecode digests), probe the local disk tier,
+probe the remote tier, else trace/compile and persist back through
+both. Before this class each consumer hand-rolled that sequence
+against ``utils/compile_cache.py`` primitives; now a call site builds
+one ``CompiledArtifact`` and calls :meth:`resolve` (or the split
+:meth:`load`/:meth:`store` pair when compilation is deferred, the
+eager-dispatch first-hit pattern).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils import compile_cache as _cc
+from . import remote as _remote
+from . import salts as _salts
+from ._counters import STATS
+
+__all__ = ["CompiledArtifact"]
+
+
+def _adopt_blob(fp, blob):
+    """Write a remotely fetched envelope into the local cache dir
+    (atomic, like ``disk_store``); True on success."""
+    try:
+        directory = _cc.cache_dir()
+        os.makedirs(directory, exist_ok=True)
+        path = _cc._entry_path(fp)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+class CompiledArtifact:
+    """One compiled artifact: fingerprint + tiered load/store.
+
+    Parameters
+    ----------
+    kind : str
+        Namespace of the producing cache ('serving', 'fused_step',
+        'dispatch', ...) — artifacts of different kinds never collide.
+    key : hashable
+        The in-memory cache key (avals, config literals, versions).
+        ``None``-fingerprint behavior is inherited from
+        ``compile_cache.fingerprint``: a key with no process-stable
+        canonical form makes the artifact memory-only.
+    code_of : tuple of callables
+        Functions whose BODIES the executable is traced from; their
+        bytecode digests salt the fingerprint (editing an
+        implementation invalidates disk entries).
+    salts : tuple of str
+        Declared salt-provider names (``artifact.salts``), resolved in
+        order against ``salt_ctx`` and folded into the fingerprint.
+    salt_ctx : dict
+        Context the providers read (graph signature, shard declaration,
+        optimizability, ...).
+    """
+
+    __slots__ = ("kind", "key", "code_of", "salts", "salt_ctx",
+                 "_fp", "_fp_resolved")
+
+    def __init__(self, kind, key, code_of=(), salts=(), salt_ctx=None):
+        self.kind = kind
+        self.key = key
+        self.code_of = tuple(code_of)
+        self.salts = tuple(salts)
+        self.salt_ctx = dict(salt_ctx or {})
+        self._fp = None
+        self._fp_resolved = False
+
+    @property
+    def fingerprint(self):
+        """Hex fingerprint, or None (memory-only artifact). Computed
+        once per instance: provider tuples are folded in only when
+        salts are declared, so salt-free kinds ('dispatch',
+        'fused_step') keep their pre-artifact-layer fingerprints and
+        existing disk entries stay valid."""
+        if not self._fp_resolved:
+            if self.key is None:  # explicitly memory-only
+                self._fp = None
+            else:
+                salted = _salts.resolve_salts(self.salts, self.salt_ctx)
+                key = ((self.key, ("salts",) + salted) if salted
+                       else self.key)
+                self._fp = _cc.fingerprint(self.kind, key,
+                                           code_of=self.code_of)
+            self._fp_resolved = True
+        return self._fp
+
+    # -- tiered load/store --------------------------------------------
+
+    def load(self):
+        """(compiled, meta, source) from the nearest warm tier, or
+        None. ``source`` is 'disk' or 'remote'; a remote hit is
+        adopted into the local tier first and re-validated by
+        ``disk_load`` (format/salt check), so a stale remote entry is
+        removed and treated as a miss."""
+        fp = self.fingerprint
+        if fp is None:
+            return None
+        loaded = _cc.disk_load(fp)
+        if loaded is not None:
+            return loaded[0], loaded[1], "disk"
+        blob = _remote.fetch(fp)
+        if blob is None or not _adopt_blob(fp, blob):
+            return None
+        loaded = _cc.disk_load(fp)
+        if loaded is None:
+            STATS.add("remote_corrupt")
+            return None
+        return loaded[0], loaded[1], "remote"
+
+    def store(self, compiled, meta=None):
+        """Persist a compiled executable to the local tier and (when
+        configured) publish it to the remote store; True when the
+        local write completed."""
+        fp = self.fingerprint
+        ok = _cc.disk_store(fp, compiled, meta=meta)
+        if ok:
+            _remote.publish_path(fp, _cc._entry_path(fp))
+        return ok
+
+    def resolve(self, jitted, args, meta=None):
+        """The whole warm-start story: load from the nearest tier,
+        else AOT-compile ``jitted`` over ``args`` and persist. Returns
+        ``(fn, meta, source)`` — ``fn`` a ``GuardedCompiled`` (stale
+        artifacts degrade to the jit path), ``source`` in
+        {'disk', 'remote', 'compile'}. ``meta`` may be a dict or a
+        zero-arg callable evaluated after a fresh compile (metadata
+        known only post-trace rides the envelope for processes that
+        never trace)."""
+        loaded = self.load()
+        if loaded is not None:
+            compiled, m, source = loaded
+            return _cc.GuardedCompiled(compiled, jitted), m, source
+        compiled = _cc.aot_compile(jitted, *args)
+        m = dict(meta() if callable(meta) else (meta or {}))
+        self.store(compiled, meta=m)
+        return _cc.GuardedCompiled(compiled, jitted), m, "compile"

@@ -1,0 +1,311 @@
+"""Remote artifact-cache tier: a fleet-shared store behind the same
+fingerprints as the local disk tier.
+
+A fresh replica that misses its local ``.mxc`` cache consults the
+remote store before compiling; a compiling replica publishes what it
+built, so across a fleet each distinct fingerprint is compiled ONCE
+(the TVM compile-once/deploy-anywhere artifact model applied to the
+whole cache, not just explicit bundles).
+
+Two backends, selected by the ``MXNET_ARTIFACT_REMOTE`` URL scheme:
+
+- ``file:///shared/dir`` — a shared directory (NFS/FUSE object-store
+  mount). Writes are tmp + ``os.replace`` atomic, exactly like the
+  local tier.
+- ``http(s)://host[:port]`` — ``GET``/``PUT /artifacts/<fp>`` against
+  an artifact service (``ArtifactCacheServer`` below is a stdlib
+  reference implementation used by tests and the bundle benchmark).
+
+Resilience (round-12 seams, deliberately conservative): every remote
+round-trip runs under a bounded :class:`~..resilience.retry.RetryPolicy`
+and ONE module-level :class:`~..resilience.breaker.CircuitBreaker` —
+a flaky or down cache host degrades to local compile (a cache must
+never break the serving path), and once the breaker opens the replica
+stops paying connect timeouts per artifact. Counters ride the
+``artifact`` telemetry family (hits/misses/errors/bytes both ways).
+
+The blob protocol is the local tier's envelope, verbatim: fetched
+blobs are adopted into the local cache directory and re-validated by
+``disk_load`` (format + salt check), so a stale or corrupt remote
+entry is indistinguishable from a local corrupt file — removed and
+treated as a miss.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ._counters import STATS
+
+__all__ = ["remote_url", "fetch", "publish", "publish_path",
+           "reset_remote_state", "ArtifactCacheServer"]
+
+
+# ---------------------------------------------------------------------------
+# knobs
+
+def remote_url():
+    """MXNET_ARTIFACT_REMOTE: the remote store URL (``file://`` dir or
+    ``http(s)://`` service); unset/empty = no remote tier."""
+    from .. import env as _env
+
+    return _env.get_str("MXNET_ARTIFACT_REMOTE") or None
+
+
+def publish_enabled():
+    """MXNET_ARTIFACT_REMOTE_PUBLISH (default on): whether locally
+    compiled artifacts are pushed to the remote store. Read-only
+    replicas (canaries pinned to a blessed artifact set) turn it
+    off."""
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_ARTIFACT_REMOTE_PUBLISH", True)
+
+
+def _timeout_s():
+    from .. import env as _env
+
+    return _env.get_int("MXNET_ARTIFACT_REMOTE_TIMEOUT_MS", 2000) / 1e3
+
+
+def _policy():
+    from .. import env as _env
+    from ..resilience.retry import RetryPolicy
+
+    return RetryPolicy(
+        max_attempts=_env.get_int("MXNET_ARTIFACT_REMOTE_RETRIES", 2),
+        base_ms=25.0, max_ms=250.0, name="artifact_remote")
+
+
+# one breaker per configured URL: repointing the knob (tests, operator
+# failover) must not inherit the old host's failure streak
+_LOCK = threading.Lock()
+_STATE = {"breaker": None, "url": None}
+
+
+def _breaker():
+    from ..resilience.breaker import CircuitBreaker
+
+    url = remote_url()
+    with _LOCK:
+        if _STATE["breaker"] is None or _STATE["url"] != url:
+            _STATE["breaker"] = CircuitBreaker(name="artifact_remote")
+            _STATE["url"] = url
+        return _STATE["breaker"]
+
+
+def breaker_state():
+    """The remote-tier breaker state ('closed' | 'open' | 'half-open')."""
+    return _breaker().state
+
+
+def reset_remote_state():
+    """Forget the breaker and its failure streak (tests)."""
+    with _LOCK:
+        _STATE["breaker"] = None
+        _STATE["url"] = None
+
+
+# ---------------------------------------------------------------------------
+# backends (return None for a definitive miss; raise for transient
+# failures — only raises are retried/counted against the breaker)
+
+def _http_url(url, fp):
+    return url.rstrip("/") + "/artifacts/" + fp
+
+
+def _fetch_backend(url, fp):
+    if url.startswith("file://"):
+        path = os.path.join(url[len("file://"):], fp + ".mxc")
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(_http_url(url, fp)),
+                timeout=_timeout_s()) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def _publish_backend(url, fp, blob):
+    if url.startswith("file://"):
+        directory = url[len("file://"):]
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, fp + ".mxc")
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return
+    import urllib.request
+
+    req = urllib.request.Request(_http_url(url, fp), data=blob,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=_timeout_s()):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the guarded public seam
+
+def fetch(fp):
+    """The envelope blob for ``fp`` from the remote store, or None —
+    covering miss, no remote configured, breaker open, and transient
+    errors after retries (all of which degrade to local compile)."""
+    url = remote_url()
+    if url is None or fp is None:
+        return None
+    br = _breaker()
+    if not br.allow():
+        STATS.add("remote_skipped")
+        return None
+    try:
+        blob = _policy().run(_fetch_backend, url, fp)
+    except Exception:
+        br.record_failure()
+        STATS.add("remote_errors")
+        return None
+    br.record_success()
+    if blob is None:
+        STATS.add("remote_misses")
+        return None
+    STATS.add("remote_hits")
+    STATS.add("fetch_bytes", len(blob))
+    return blob
+
+
+def publish(fp, blob):
+    """Push an envelope blob under ``fp``; True on success. Best
+    effort with the same retry/breaker discipline as :func:`fetch` —
+    a failed publish never breaks the caller (the artifact is already
+    in the local tier)."""
+    url = remote_url()
+    if url is None or fp is None or not publish_enabled():
+        return False
+    br = _breaker()
+    if not br.allow():
+        STATS.add("remote_skipped")
+        return False
+    try:
+        _policy().run(_publish_backend, url, fp, blob)
+    except Exception:
+        br.record_failure()
+        STATS.add("publish_errors")
+        return False
+    br.record_success()
+    STATS.add("remote_publishes")
+    STATS.add("publish_bytes", len(blob))
+    return True
+
+
+def publish_path(fp, path):
+    """Publish the local cache entry at ``path`` (a ``.mxc`` file)."""
+    if remote_url() is None or fp is None or not publish_enabled():
+        return False
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return False
+    return publish(fp, blob)
+
+
+# ---------------------------------------------------------------------------
+# reference server (tests, benchmarks, single-host fleets)
+
+class ArtifactCacheServer:
+    """In-process artifact store speaking the HTTP backend protocol:
+    ``GET /artifacts/<fp>`` -> 200 blob | 404, ``PUT /artifacts/<fp>``
+    -> 201. Stdlib ``ThreadingHTTPServer`` on an ephemeral port.
+
+    ``fail_requests = N`` makes the next N requests answer 503 — the
+    flaky-host drill the retry/breaker seam is tested against."""
+
+    def __init__(self, host="127.0.0.1"):
+        import http.server
+
+        self.store = {}
+        self.fail_requests = 0
+        self.requests = 0
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                """Silence per-request stderr logging."""
+
+            def _fingerprint(self):
+                prefix = "/artifacts/"
+                return self.path[len(prefix):] \
+                    if self.path.startswith(prefix) else None
+
+            def _gate(self):
+                outer.requests += 1
+                if outer.fail_requests > 0:
+                    outer.fail_requests -= 1
+                    self.send_response(503)
+                    self.end_headers()
+                    return False
+                return True
+
+            def do_GET(self):
+                if not self._gate():
+                    return
+                blob = outer.store.get(self._fingerprint())
+                if blob is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_PUT(self):
+                if not self._gate():
+                    return
+                fp = self._fingerprint()
+                if fp is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                outer.store[fp] = self.rfile.read(n)
+                self.send_response(201)
+                self.end_headers()
+
+        self._httpd = http.server.ThreadingHTTPServer((host, 0),
+                                                      _Handler)
+        self._thread = None
+
+    @property
+    def url(self):
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="artifact-cache",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,102 @@
+"""Declarative fingerprint-salt providers.
+
+Before this subsystem existed, every cache-consuming layer assembled
+its own salt tuple inline — serving appended graph-opt + sharding +
+quantize salts in one order, the step fingerprint in another, and a new
+subsystem with lowering-relevant state had to find and edit every call
+site. Now the composition lives in ONE place:
+
+- a subsystem whose state changes what a traced program lowers to
+  **registers a salt provider** here (``register_salt_provider``) —
+  a callable ``provider(ctx) -> tuple`` returning a process-stable
+  tuple (empty when the subsystem contributes nothing for this
+  artifact);
+- a call site building a :class:`~.core.CompiledArtifact` **declares**
+  the provider names it depends on (``salts=("graph_opt", ...)``) plus
+  a context dict; the artifact layer resolves the providers in declared
+  order and folds their tuples into the canonical fingerprint.
+
+The ``graft_lint`` L1001 rule closes the loop: salt assembly (calls to
+``fingerprint_salt`` / raw ``compile_cache.fingerprint``) outside
+``mxnet_tpu/artifact/`` and outside provider-defining modules is a
+lint error, so fingerprint composition cannot quietly fork again.
+
+Built-in providers (registered by their owning modules at import):
+
+===========  ==========================  =================================
+name         registered by               context keys read
+===========  ==========================  =================================
+graph_opt    analysis/graph_opt.py       ``optimizable`` (bool),
+                                         ``opt_level`` (optional int)
+sharding     sharding/plan.py            ``shard`` (None or
+                                         ``{"plan", "mesh"}``)
+quantize     analysis/quantize.py        ``graph_signature`` (nnvm JSON
+                                         or None)
+===========  ==========================  =================================
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+
+from ..base import MXNetError
+
+__all__ = ["register_salt_provider", "salt_providers", "resolve_salts"]
+
+_LOCK = threading.Lock()
+_PROVIDERS = {}
+
+# lazy built-ins: the provider lives with its subsystem (which registers
+# it at import); resolving a declared-but-unregistered built-in imports
+# the owning module instead of failing on import order
+_BUILTIN_MODULES = {
+    "graph_opt": "mxnet_tpu.analysis.graph_opt",
+    "quantize": "mxnet_tpu.analysis.quantize",
+    "sharding": "mxnet_tpu.sharding.plan",
+}
+
+
+def register_salt_provider(name, provider, replace=False):
+    """Register ``provider(ctx) -> tuple`` under ``name``. Providers
+    must be pure and process-stable: same context, same tuple, in every
+    process — the tuple feeds the disk-artifact fingerprint. Re-binding
+    an existing name requires ``replace=True`` (two subsystems silently
+    fighting over one name would alias distinct lowerings)."""
+    if not callable(provider):
+        raise MXNetError(f"salt provider {name!r} is not callable")
+    with _LOCK:
+        if not replace and name in _PROVIDERS \
+                and _PROVIDERS[name] is not provider:
+            raise MXNetError(
+                f"salt provider {name!r} is already registered; pass "
+                "replace=True to rebind")
+        _PROVIDERS[name] = provider
+    return provider
+
+
+def salt_providers():
+    """Registered provider names, sorted."""
+    with _LOCK:
+        return sorted(_PROVIDERS)
+
+
+def _provider(name):
+    with _LOCK:
+        fn = _PROVIDERS.get(name)
+    if fn is None and name in _BUILTIN_MODULES:
+        importlib.import_module(_BUILTIN_MODULES[name])
+        with _LOCK:
+            fn = _PROVIDERS.get(name)
+    if fn is None:
+        raise MXNetError(
+            f"unknown salt provider {name!r} (registered: "
+            f"{salt_providers()})")
+    return fn
+
+
+def resolve_salts(names, ctx=None):
+    """Resolve declared provider names against ``ctx``, in declared
+    order; returns the tuple of per-provider salt tuples that the
+    :class:`~.core.CompiledArtifact` fingerprint folds in."""
+    ctx = ctx or {}
+    return tuple(tuple(_provider(name)(ctx)) for name in names)

@@ -1,0 +1,185 @@
+"""Deployment-bundle benchmark: first-response latency of a fresh
+serving replica under the four artifact-tier states.
+
+One scenario, four cache states, matching the round-20 acceptance
+criteria. A child process (fresh interpreter, fresh in-memory caches)
+builds a gluon MLP, wraps it in an ``InferenceSession`` (two buckets),
+and times the FIRST RESPONSE — ``warmup()`` (resolve every bucket
+executable) plus one real device-array request that exercises the
+fused pad/slice helpers. The parent runs that child once per state:
+
+``cold``         empty local cache, no remote — every executable pays
+                 trace + XLA compile. This run also PUBLISHES: it
+                 exports a deployment bundle and pushes every artifact
+                 to a ``file://`` fleet cache.
+``disk_warm``    same local cache dir as the cold run (the round-9
+                 warm-start baseline).
+``bundle_warm``  EMPTY local cache; ``artifact.import_bundle`` seeds it
+                 from the cold run's bundle before the session exists.
+``remote_warm``  EMPTY local cache; ``MXNET_ARTIFACT_REMOTE`` points at
+                 the fleet cache the cold run populated.
+
+Criteria: bundle-warm and remote-warm replicas serve their first
+response with ZERO traces and zero XLA compiles (the tentpole promise:
+a fresh replica never compiles), first-response latency within noise
+of disk-warm, and outputs bitwise-equal to the cold run's.
+
+Emits one JSON document (default ``BENCH_BUNDLE_r20.json``); also
+prints it.
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.bundle_bench [--smoke] [--out FILE]
+
+``--smoke`` shrinks the model for a CPU tier-1 time budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# child: one process lifetime = one cache-state data point
+
+def _child_main(hidden, bundle_in=None, bundle_out=None):
+    """One replica lifetime: (optional bundle import) -> build model ->
+    session -> timed warmup + first request -> (optional bundle +
+    remote export). Prints one JSON line."""
+    import hashlib
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import artifact, autograd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kernels import serving_fused as sf
+    from mxnet_tpu.utils import compile_cache as cc
+
+    nd = mx.nd
+    report = {}
+    if bundle_in is not None:
+        report["imported"] = artifact.import_bundle(bundle_in)
+
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(8))
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(nd.zeros((1, 16)))
+    sess = serving.InferenceSession(net, input_shapes=[(1, 16)],
+                                    buckets=[1, 8], warm=False)
+    # measure the serving path only: construction dispatches one-shot
+    # eager ops that are identical across all four cache states
+    cc.reset_compile_cache_counters()
+    x = nd.array(onp.random.RandomState(5).rand(5, 16).astype("float32"))
+    t0 = time.perf_counter()
+    warm = sess.warmup()
+    out = sess.predict(x).asnumpy()
+    report["first_response_ms"] = (time.perf_counter() - t0) * 1e3
+    report["warm"] = warm
+    report["retraces"] = cc.compile_cache_stats()["retraces"]
+    report["digest"] = hashlib.sha256(out.tobytes()).hexdigest()
+    report["artifact"] = artifact.artifact_stats()
+    if bundle_out is not None:
+        fps = (sess.artifact_fingerprints()
+               + sf.fusion_artifact_fingerprints())
+        report["export"] = artifact.export_bundle(
+            bundle_out, fps, manifest={"model": "bundle_bench"})
+    print(json.dumps(report))
+
+
+def _run_child(cache_dir, hidden, bundle_in=None, bundle_out=None,
+               remote=None, publish=False):
+    env = dict(os.environ, MXNET_COMPILE_CACHE_DIR=cache_dir,
+               MXNET_COMPILE_CACHE="1", JAX_PLATFORMS="cpu")
+    env.pop("MXNET_ARTIFACT_REMOTE", None)
+    if remote is not None:
+        env["MXNET_ARTIFACT_REMOTE"] = remote
+        env["MXNET_ARTIFACT_REMOTE_PUBLISH"] = "1" if publish else "0"
+    code = ("import sys; sys.path.insert(0, {root!r});\n"
+            "from _cpu_platform import force_cpu_platform;\n"
+            "force_cpu_platform();\n"
+            "from mxnet_tpu.benchmark.bundle_bench import _child_main;\n"
+            "_child_main({hidden}, bundle_in={bin!r}, "
+            "bundle_out={bout!r})").format(
+                root=_REPO, hidden=hidden, bin=bundle_in,
+                bout=bundle_out)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=_REPO, capture_output=True, text=True,
+                         timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench child failed:\n{out.stderr[-4000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+
+def run(smoke=False, out_path=None):
+    """Run the benchmark; returns the result dict (and writes it)."""
+    hidden = 32 if smoke else 256
+
+    with tempfile.TemporaryDirectory(prefix="mxbundle_") as root:
+        bundle = os.path.join(root, "model.bundle")
+        fleet = "file://" + os.path.join(root, "fleet")
+        cache_a = os.path.join(root, "cache_a")
+        cold = _run_child(cache_a, hidden, bundle_out=bundle,
+                          remote=fleet, publish=True)
+        disk_warm = _run_child(cache_a, hidden)
+        bundle_warm = _run_child(os.path.join(root, "cache_b"), hidden,
+                                 bundle_in=bundle)
+        remote_warm = _run_child(os.path.join(root, "cache_c"), hidden,
+                                 remote=fleet)
+
+    states = {"cold": cold, "disk_warm": disk_warm,
+              "bundle_warm": bundle_warm, "remote_warm": remote_warm}
+    doc = {
+        "benchmark": "bundle",
+        "smoke": bool(smoke),
+        "platform": __import__("jax").default_backend(),
+        "model": {"hidden": hidden, "buckets": [1, 8]},
+        "results": {
+            **{f"{k}_first_response_ms":
+               round(v["first_response_ms"], 1)
+               for k, v in states.items()},
+            **{f"{k}_retraces": v["retraces"]
+               for k, v in states.items()},
+            "cold_vs_bundle_speedup": round(
+                cold["first_response_ms"]
+                / bundle_warm["first_response_ms"], 2),
+        },
+        "bundle_entries": cold["export"]["entries"],
+        "bundle_imported": bundle_warm["imported"],
+        "remote_hits": remote_warm["artifact"]["remote_hits"],
+        "remote_publishes": cold["artifact"]["remote_publishes"],
+        "warm_counters": {k: v["warm"] for k, v in states.items()},
+        "bitwise_equal": all(v["digest"] == cold["digest"]
+                             for v in states.values()),
+    }
+    out_path = out_path or "BENCH_BUNDLE_r20.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small model; CPU tier-1 time budget")
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    doc = run(smoke=a.smoke, out_path=a.out)
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

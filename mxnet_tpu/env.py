@@ -178,6 +178,28 @@ KNOBS = {
         "size cap on the on-disk compile cache (default 1024); every "
         "32nd write prunes oldest-used .mxc entries down to 80% of the "
         "cap (load refreshes mtime). 0 = unbounded"),
+    "MXNET_ARTIFACT_REMOTE": (
+        "wired", "artifact.remote",
+        "fleet-shared remote artifact-cache URL (file:///shared/dir "
+        "or http(s)://host:port speaking GET/PUT /artifacts/<fp>); "
+        "replicas consult it behind the local disk tier before "
+        "compiling and publish what they compile, so each distinct "
+        "fingerprint compiles once per fleet. Unset (default) = no "
+        "remote tier"),
+    "MXNET_ARTIFACT_REMOTE_PUBLISH": (
+        "wired", "artifact.remote",
+        "push locally compiled artifacts to the remote store (default "
+        "1); 0 makes the replica read-only against the remote tier "
+        "(canaries pinned to a blessed artifact set)"),
+    "MXNET_ARTIFACT_REMOTE_TIMEOUT_MS": (
+        "wired", "artifact.remote",
+        "per-request timeout for the http(s) remote artifact backend "
+        "(default 2000)"),
+    "MXNET_ARTIFACT_REMOTE_RETRIES": (
+        "wired", "artifact.remote",
+        "attempts per remote artifact round-trip (default 2, via the "
+        "resilience RetryPolicy); repeated failures trip a circuit "
+        "breaker and the replica degrades to local compiles"),
     "MXNET_SHAPE_BUCKETS": (
         "wired", "ndarray.registry",
         "automatic batch-axis shape bucketing for eager dispatch: "

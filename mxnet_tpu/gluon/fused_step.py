@@ -217,12 +217,12 @@ class _FusedEntry:
     stale cache entry must never break (or permanently eagerize) the
     trainer's step loop."""
 
-    __slots__ = ("_jfn", "_call", "_fp")
+    __slots__ = ("_jfn", "_call", "_artifact")
 
-    def __init__(self, jfn, fp=None):
+    def __init__(self, jfn, artifact=None):
         self._jfn = jfn
         self._call = None
-        self._fp = fp
+        self._artifact = artifact
 
     def prepare(self, args):
         """Resolve without executing (``lower``/``compile`` only) —
@@ -236,10 +236,11 @@ class _FusedEntry:
             return self._resolve_inner(args, sp)
 
     def _resolve_inner(self, args, sp):
-        if self._fp is not None:
-            loaded = _cc.disk_load(self._fp)
+        art = self._artifact
+        if art is not None and art.fingerprint is not None:
+            loaded = art.load()
             if loaded is not None:
-                sp.set(source="disk")
+                sp.set(source=loaded[2])
                 self._call = _cc.GuardedCompiled(loaded[0], self._jfn)
                 return self._call
             try:
@@ -251,7 +252,7 @@ class _FusedEntry:
                 self._call = self._jfn
                 return self._call
             sp.set(source="compile")
-            _cc.disk_store(self._fp, compiled)
+            art.store(compiled)
             self._call = _cc.GuardedCompiled(compiled, self._jfn)
             return self._call
         sp.set(source="jit")
@@ -371,15 +372,17 @@ def build_executable(kernel, mp_flags, scaler_cfg, donate_params,
         jit_kwargs = dict(
             in_shardings=(pshard, pshard, sshard, srep, rep, rep, rep),
             out_shardings=(pshard, sshard, srep))
-    # fingerprint only when the disk tier is armed (MXNET_COMPILE_CACHE=0
+    # an artifact only when the disk tier is armed (MXNET_COMPILE_CACHE=0
     # must mean the plain jit path, not a no-op GuardedCompiled layer),
     # salted with the bytecode of the optimizer kernel AND this builder
     # so editing either invalidates disk entries instead of serving the
     # old update math
-    fp = _cc.fingerprint("fused_step", cache_key,
-                         code_of=(kernel, build_executable)) \
+    from ..artifact import CompiledArtifact
+
+    art = CompiledArtifact("fused_step", cache_key,
+                           code_of=(kernel, build_executable)) \
         if cache_key is not None and _cc.cache_enabled() else None
     return _FusedEntry(
         _cc.counting_jit(step, label="fused_step", donate_argnums=donate,
                          **jit_kwargs),
-        fp)
+        art)

@@ -13,6 +13,12 @@ The pad math replays ``compile_cache.pad_batch`` exactly (zero-fill
 concat) and the slice is ``[:n]`` per array — results are
 bit-identical to the unfused path. Gated by the ``serving`` entry in
 ``MXNET_FUSION_PATTERNS`` and the ``MXNET_FUSION`` kill switch.
+
+Round 20: both helpers resolve through the artifact layer (kinds
+``fusion_pad`` / ``fusion_slice``, keyed by bucket/true-rows + avals),
+so a bundle- or remote-warm replica's FIRST response pays zero traces
+even on the pad/slice side — previously these were per-process jits
+and the one cold trace a disk-warm replica still paid.
 """
 from __future__ import annotations
 
@@ -21,9 +27,16 @@ import threading
 from ..utils import compile_cache as cc
 from . import _count, enabled_patterns, fusion_enabled
 
+#: bumped when the pad/slice math changes — disk artifacts of older
+#: generations must not be served for a different computation
+_FUSED_VERSION = 1
+
 _LOCK = threading.Lock()
 _PAD_JITS = {}  # bucket -> jitted tuple-pad
 _SLICE_JITS = {}  # (bucket, true_rows) -> jitted tuple-slice
+_PAD_EXECS = {}  # (bucket, avals) -> resolved callable
+_SLICE_EXECS = {}  # (bucket, true_rows, avals) -> resolved callable
+_RESOLVED_FPS = set()  # fingerprints resolved this process (bundles)
 
 
 def serving_fusion_enabled():
@@ -67,6 +80,44 @@ def _slice_jit(bucket, true):
     return fn
 
 
+def _avals_key(arrs):
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+
+
+def _resolve(execs, exec_key, kind, art_key, code_of, jfn, args):
+    """Resolve a fused helper through the artifact layer: disk/remote
+    hit means a warm replica never traces it. Falls back to the plain
+    per-process jit when the cache is off or resolution fails."""
+    fn = execs.get(exec_key)
+    if fn is not None:
+        return fn
+    with _LOCK:
+        fn = execs.get(exec_key)
+        if fn is None:
+            fn = jfn
+            if cc.cache_enabled():
+                from ..artifact import CompiledArtifact
+
+                try:
+                    art = CompiledArtifact(kind, art_key, code_of=code_of)
+                    fn, _, _ = art.resolve(jfn, args)
+                    if art.fingerprint is not None:
+                        _RESOLVED_FPS.add(art.fingerprint)
+                except Exception:
+                    fn = jfn  # never let the cache tier break serving
+            execs[exec_key] = fn
+    return fn
+
+
+def fusion_artifact_fingerprints():
+    """Fingerprints of every fused pad/slice executable resolved in
+    this process — deployment bundles pack these alongside the session
+    executables so a bundle-warm replica's first response is genuinely
+    trace-free."""
+    with _LOCK:
+        return sorted(_RESOLVED_FPS)
+
+
 def pad_all(datas, bucket):
     """Pad every array in ``datas`` up to ``bucket`` rows in ONE
     dispatch. Arrays already at the boundary pass through inside the
@@ -74,7 +125,11 @@ def pad_all(datas, bucket):
     if all(d.shape[0] == bucket for d in datas):
         return list(datas)  # nothing to pad: no dispatch at all
     _count("serving_pad_fused")
-    return list(_pad_jit(bucket)(*datas))
+    avals = _avals_key(datas)
+    fn = _resolve(_PAD_EXECS, (bucket, avals), "fusion_pad",
+                  ("fusion_pad", _FUSED_VERSION, bucket, avals),
+                  (_pad_jit, cc.pad_batch), _pad_jit(bucket), datas)
+    return list(fn(*datas))
 
 
 def slice_all(outs, bucket, true):
@@ -83,4 +138,8 @@ def slice_all(outs, bucket, true):
     if bucket == true:
         return list(outs)
     _count("serving_slice_fused")
-    return list(_slice_jit(bucket, true)(*outs))
+    avals = _avals_key(outs)
+    fn = _resolve(_SLICE_EXECS, (bucket, true, avals), "fusion_slice",
+                  ("fusion_slice", _FUSED_VERSION, bucket, true, avals),
+                  (_slice_jit,), _slice_jit(bucket, true), outs)
+    return list(fn(*outs))

@@ -217,17 +217,17 @@ def _freeze(v):
 
 class _CacheEntry:
     __slots__ = ("jfn", "call", "normalized", "n_keys", "recording",
-                 "donate", "fp")
+                 "donate", "artifact")
 
     def __init__(self, jfn, normalized, n_keys, recording, donate,
-                 fp=None):
+                 artifact=None):
         self.jfn = jfn
         self.call = None  # resolved at first hit: disk load | AOT | jfn
         self.normalized = normalized
         self.n_keys = n_keys
         self.recording = recording
         self.donate = donate  # input slot whose buffer is donated, or None
-        self.fp = fp  # disk-tier fingerprint (None: memory-only entry)
+        self.artifact = artifact  # CompiledArtifact (None: memory-only)
 
 
 class _DispatchCache(CountedLRUCache):
@@ -336,11 +336,11 @@ def _dispatch_key(opdef, arg_template, kwargs, kw_arrays, datas, wrap_cls,
 
 def _resolve_entry_call(entry, keys, datas):
     """First hit: make the entry's executable concrete. With the disk
-    tier armed (``entry.fp``), AOT-compile — ``lower().compile()``, ONE
-    trace counted by counting_jit — so the ``Compiled`` handle can be
+    tier armed (``entry.artifact``), AOT-compile — ``lower().compile()``,
+    ONE trace counted by counting_jit — so the ``Compiled`` handle can be
     serialized for future processes; without it, the plain jit path
     (the C++ dispatch fastpath) compiles on this call as before."""
-    if entry.fp is not None:
+    if entry.artifact is not None:
         try:
             compiled = _cc.aot_compile(entry.jfn, tuple(keys), *datas)
         except Exception:
@@ -349,9 +349,9 @@ def _resolve_entry_call(entry, keys, datas):
             # either works or takes the uncached-fallback path
             entry.call = entry.jfn
             return entry.call
-        _cc.disk_store(entry.fp, compiled,
-                       meta={"n_keys": entry.n_keys,
-                             "donate": entry.donate})
+        entry.artifact.store(compiled,
+                             meta={"n_keys": entry.n_keys,
+                                   "donate": entry.donate})
         entry.call = _cc.GuardedCompiled(compiled, entry.jfn)
     else:
         entry.call = entry.jfn
@@ -455,19 +455,21 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
         # the op NAME in the key does not pin the op BODY — the
         # fingerprint folds in the body's bytecode digest so an edited
         # implementation invalidates its disk entries
-        fp = _cc.fingerprint("dispatch", key, code_of=(opdef.fn,)) \
+        from ..artifact import CompiledArtifact
+
+        art = CompiledArtifact("dispatch", key, code_of=(opdef.fn,)) \
             if not recording and _cc.cache_enabled() else None
-        if fp is not None:
-            loaded = _cc.disk_load(fp)
+        if art is not None and art.fingerprint is not None:
+            loaded = art.load()
             if loaded is not None:
-                compiled, meta = loaded
+                compiled, meta, _source = loaded
                 donate = meta.get("donate")
                 normalized = _normalize_output(pure_fn)
                 entry = _CacheEntry(
                     _build_jfn(normalized, False, donate,
                                label=opdef.name),
                     normalized, int(meta.get("n_keys", 0)), False, donate,
-                    fp)
+                    art)
                 entry.call = _cc.GuardedCompiled(compiled, entry.jfn)
                 _CACHE.insert(key, entry)
                 # fall through to the hit-serving path below
@@ -496,7 +498,7 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
         normalized = _normalize_output(pure_fn)
         _CACHE.insert(key, _CacheEntry(
             _build_jfn(normalized, recording, donate, label=opdef.name),
-            normalized, n_keys, recording, donate, fp))
+            normalized, n_keys, recording, donate, art))
         if plan is not None:
             result = _unbucket_result(result, plan, wrap)
         return True, result

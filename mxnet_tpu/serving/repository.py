@@ -334,6 +334,47 @@ class ModelRepository:
             vh = m.versions[m.active]
         vh.session.refresh_params()
 
+    def export_bundle(self, name, path, version=None):
+        """Export a deployment bundle for one model version: warm the
+        version's session (resolving every bucket/occupancy executable
+        into the local artifact cache), then pack those artifacts into
+        ONE file at ``path``. A replica that imports the bundle
+        (``artifact.import_bundle``) before construction serves its
+        first response with zero traces and zero XLA compiles. Returns
+        the export report (``{"path", "entries", "missing", "bytes"}``)
+        with the manifest's model/version attached."""
+        from .. import artifact as _artifact
+
+        m = self._model(name)
+        with m.lock:
+            ver = int(version) if version is not None else m.active
+            vh = m.versions.get(ver)
+            if vh is None:
+                raise MXNetError(
+                    f"model {name!r} has no version {ver} (deployed: "
+                    f"{sorted(m.versions)})")
+        sess = vh.session
+        sess.warmup()
+        fps = sess.artifact_fingerprints()
+        if not fps:
+            raise MXNetError(
+                f"model {name!r} v{ver} has no disk-cacheable artifacts "
+                "(no graph signature, or the compile cache is disabled)")
+        # fused pad/slice executables resolved by served traffic ride
+        # along (process-scoped: bundles are per-replica deployment
+        # sets, and a helper another model resolved still warms this
+        # replica's cache harmlessly)
+        from ..kernels import serving_fused as _sf
+
+        fps = list(fps) + _sf.fusion_artifact_fingerprints()
+        report = _artifact.export_bundle(
+            path, fps,
+            manifest={"model": name, "version": ver,
+                      "buckets": list(getattr(sess, "buckets", []))})
+        report["model"] = name
+        report["version"] = ver
+        return report
+
     def close(self):
         """Drain every batcher of every version (engine.close()
         order), then release session resources (a stateful session's

@@ -55,6 +55,7 @@ from .. import ndarray as nd
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import random as mxrandom
+from ..artifact import CompiledArtifact
 from ..utils import compile_cache as cc
 from .metrics import METRICS
 
@@ -185,6 +186,7 @@ class InferenceSession:
         self._entries = {}  # (bucket, amp_ver) -> _BucketEntry
         self._breakers = {}  # (bucket, amp_ver) -> CircuitBreaker
         self._demoted = set()  # (bucket, amp_ver) forced to the jit path
+        self._artifact_fps = set()  # fingerprints resolved this process
         self._num_outputs = None
         self._mutation_warned = False
         max_batch = int(max_batch or _env.get_int(
@@ -459,26 +461,17 @@ class InferenceSession:
                 bodies.append(opdef.fn)
         return bodies
 
-    def _fingerprint(self, bucket, amp_ver):
+    def _artifact(self, bucket, amp_ver):
+        """The :class:`CompiledArtifact` for a bucket executable. Salt
+        composition is declarative: graph-opt rewrites, a plan-sharded
+        snapshot (GSPMD collectives baked in), and int8 lowering all
+        change the lowered program without changing the source graph
+        signature, so their providers fold into the fingerprint. A
+        graph that cannot symbol-trace is memory-only (key None)."""
         if self._graph_sig is None:
-            return None
-        from ..analysis import graph_opt, quantize
+            return CompiledArtifact("serving", None)
         from ..gluon.block import SymbolBlock
 
-        # graph-opt rewrites change the lowered computation without
-        # changing the source graph signature: salt the key with the
-        # level + pipeline version so optimized and unoptimized AOT
-        # artifacts (and different pipeline generations) never collide
-        opt_salt = (graph_opt.fingerprint_salt()
-                    if isinstance(self._block, SymbolBlock)
-                    else ("graph_opt", 0))
-        # a plan-sharded session lowers a DIFFERENT program (GSPMD
-        # collectives baked in): salt with the plan + mesh identity
-        shard_salt = (self._shard["salt"] if self._shard is not None
-                      else ("sharding", 0))
-        # int8 graphs lower differently per MXNET_QUANTIZE_LOWERING;
-        # () for fp32 graphs so their keys never vary with the knob
-        quant_salt = quantize.fingerprint_salt(self._graph_sig)
         key = ("serving", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -486,10 +479,22 @@ class InferenceSession:
                   for v in self._param_vals),
             tuple((s.name, (bucket,) + s.row_shape, str(s.dtype))
                   for s in self._input_specs),
-            amp_ver, bucket, opt_salt, shard_salt, quant_salt)
+            amp_ver, bucket)
         code_of = [type(self)._pure, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
-        return cc.fingerprint("serving", key, code_of=tuple(code_of))
+        return CompiledArtifact(
+            "serving", key, code_of=tuple(code_of),
+            salts=("graph_opt", "sharding", "quantize"),
+            salt_ctx={
+                "optimizable": isinstance(self._block, SymbolBlock),
+                "shard": self._shard,
+                "graph_signature": self._graph_sig,
+            })
+
+    def _fingerprint(self, bucket, amp_ver):
+        """Hex fingerprint of the bucket executable's artifact; None
+        for a memory-only session (no graph signature)."""
+        return self._artifact(bucket, amp_ver).fingerprint
 
     def _avals(self, bucket):
         import jax
@@ -528,13 +533,16 @@ class InferenceSession:
             ent = self._entries.get((bucket, amp_ver))
             if ent is not None:
                 return ent
-            fp = self._fingerprint(bucket, amp_ver)
+            art = self._artifact(bucket, amp_ver)
             # meta is a callable: num_outputs is only known after the
             # trace runs (a warm process reads it from the envelope of
             # an executable it never traced)
-            fn, meta, from_disk = cc.load_or_compile(
-                fp, self._jitted_for(amp_ver), self._avals(bucket),
+            fn, meta, source = art.resolve(
+                self._jitted_for(amp_ver), self._avals(bucket),
                 meta=lambda: {"num_outputs": self._num_outputs})
+            from_disk = source != "compile"
+            if art.fingerprint is not None:
+                self._artifact_fps.add(art.fingerprint)
             if from_disk:
                 METRICS.bump("warm_disk_hits")
                 if self._num_outputs is None:
@@ -546,21 +554,17 @@ class InferenceSession:
             self._entries[(bucket, amp_ver)] = ent
             return ent
 
-    def _step_fingerprint(self, occupancy, amp_ver):
-        """The :meth:`_fingerprint` analog for step executables, kind
+    def _step_artifact(self, occupancy, amp_ver):
+        """The :meth:`_artifact` analog for step executables, kind
         ``serving_step`` with a **state-shape salt**: the same graph
         served stateless and stateful lowers different programs (state
         threading + donation), so their disk artifacts must never
-        collide."""
+        collide. No sharding provider — the step path is single-device
+        by construction (``shard_params`` rejects stateful sessions)."""
         if self._graph_sig is None:
-            return None
-        from ..analysis import graph_opt, quantize
+            return CompiledArtifact("serving_step", None)
         from ..gluon.block import SymbolBlock
 
-        opt_salt = (graph_opt.fingerprint_salt()
-                    if isinstance(self._block, SymbolBlock)
-                    else ("graph_opt", 0))
-        quant_salt = quantize.fingerprint_salt(self._graph_sig)
         key = ("serving_step", hashlib.sha256(
             self._graph_sig.encode()).hexdigest(),
             tuple(self._param_names),
@@ -571,11 +575,16 @@ class InferenceSession:
             ("state",) + tuple(
                 (s.name, (occupancy,) + s.row_shape, str(s.dtype))
                 for s in self._state_specs),
-            amp_ver, occupancy, opt_salt, quant_salt)
+            amp_ver, occupancy)
         code_of = [type(self)._pure_step, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
-        return cc.fingerprint("serving_step", key,
-                              code_of=tuple(code_of))
+        return CompiledArtifact(
+            "serving_step", key, code_of=tuple(code_of),
+            salts=("graph_opt", "quantize"),
+            salt_ctx={
+                "optimizable": isinstance(self._block, SymbolBlock),
+                "graph_signature": self._graph_sig,
+            })
 
     def _step_avals(self, occupancy):
         import jax
@@ -605,11 +614,14 @@ class InferenceSession:
             ent = self._step_entries.get((occupancy, amp_ver))
             if ent is not None:
                 return ent
-            fp = self._step_fingerprint(occupancy, amp_ver)
-            fn, meta, from_disk = cc.load_or_compile(
-                fp, self._step_jitted_for(amp_ver),
+            art = self._step_artifact(occupancy, amp_ver)
+            fn, meta, source = art.resolve(
+                self._step_jitted_for(amp_ver),
                 self._step_avals(occupancy),
                 meta=lambda: {"num_outputs": self._num_outputs})
+            from_disk = source != "compile"
+            if art.fingerprint is not None:
+                self._artifact_fps.add(art.fingerprint)
             if from_disk:
                 METRICS.bump("warm_disk_hits")
                 if self._num_outputs is None:
@@ -635,6 +647,13 @@ class InferenceSession:
             else:
                 compiles += 1
         return {"disk_hits": hits, "compiles": compiles}
+
+    def artifact_fingerprints(self):
+        """The fingerprints of every disk-cacheable executable this
+        session resolved (buckets and step occupancies, across AMP
+        versions) — the set a deployment bundle packs."""
+        with self._lock:
+            return sorted(self._artifact_fps)
 
     @property
     def warm(self):
@@ -724,7 +743,7 @@ class InferenceSession:
                 "mesh": mesh,
                 "shardings": shardings,
                 "rep": _sharding.replicated(mesh),
-                "salt": plan.fingerprint_salt(mesh),
+                "plan": plan,  # the "sharding" salt provider reads it
             }
             self._param_vals = self._place_param_vals(self._param_vals)
             # compiled-at-old-layout executables (and their demotions)

@@ -326,3 +326,19 @@ def place_params(params, plan=None, mesh=None):
         g = getattr(nd_obj, "_grad", None)
         if g is not None and getattr(g._data, "sharding", None) != sh:
             g._data = jax.device_put(g._data, sh)
+
+
+# -- artifact-layer salt provider -------------------------------------------
+# ctx["shard"] is the serving-session shard declaration ({"plan", "mesh"}
+# once shard_params ran, else None/absent)
+
+def _salt_provider(ctx):
+    shard = ctx.get("shard")
+    if not shard:
+        return ("sharding", 0)
+    return shard["plan"].fingerprint_salt(shard["mesh"])
+
+
+from ..artifact import salts as _artifact_salts  # noqa: E402
+
+_artifact_salts.register_salt_provider("sharding", _salt_provider)

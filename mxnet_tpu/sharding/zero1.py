@@ -152,7 +152,12 @@ def fused_shard_cfg(named_shapes, state_sigs):
         sshards.append(tree)
         any_zero1 = any_zero1 or used
     rep = NamedSharding(mesh, _to_pspec(()))
-    salt = plan.fingerprint_salt(mesh) + ("zero1", zero1)
+    # deliberate legacy site: this salt rides the fused-step cache KEY
+    # (FusedShardCfg travels through the trainer into cache_key), not
+    # a CompiledArtifact salts=() declaration — the "sharding" provider
+    # covers the serving path only
+    salt = plan.fingerprint_salt(mesh) + (  # graft-lint: allow(L1001)
+        "zero1", zero1)
     _count("fused_sharded_groups")
     if any_zero1:
         _count("zero1_groups")

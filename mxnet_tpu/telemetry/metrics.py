@@ -292,6 +292,7 @@ def _bootstrap_probes():
              "fused_step_stats"),
             ("compile_cache", "mxnet_tpu.utils.compile_cache",
              "compile_cache_stats"),
+            ("artifact", "mxnet_tpu.artifact", "artifact_stats"),
             ("serving", "mxnet_tpu.serving.metrics", "serving_stats"),
             ("pipeline", "mxnet_tpu.pipeline", "pipeline_counters"),
             ("resilience", "mxnet_tpu.resilience",

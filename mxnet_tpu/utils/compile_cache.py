@@ -72,7 +72,8 @@ FORMAT_VERSION = 1
 
 def _zero_stats():
     return {"disk_hits": 0, "disk_misses": 0, "disk_writes": 0,
-            "disk_corrupt": 0, "serialize_skips": 0, "retraces": 0,
+            "disk_corrupt": 0, "disk_evicted": 0, "prunes": 0,
+            "serialize_skips": 0, "retraces": 0,
             "bucketed_calls": 0, "padded_rows": 0, "true_rows": 0}
 
 
@@ -396,28 +397,34 @@ def _maybe_prune(directory):
     cap_mb = _env.get_int("MXNET_COMPILE_CACHE_MAX_MB", 1024)
     if cap_mb <= 0:
         return  # 0 = unbounded, explicitly
+    entries = []
     try:
-        entries = []
         with os.scandir(directory) as it:
             for e in it:
-                if e.name.endswith(".mxc"):
+                if not e.name.endswith(".mxc"):
+                    continue
+                try:
                     st = e.stat()
-                    entries.append((st.st_mtime, st.st_size, e.path))
-        total = sum(sz for _, sz, _ in entries)
-        cap = cap_mb * 1024 * 1024
-        if total <= cap:
-            return
-        entries.sort()  # oldest-used first
-        for _, sz, path in entries:
-            try:
-                os.remove(path)
-                total -= sz
-            except OSError:
-                pass
-            if total <= cap * 0.8:
-                break
+                except OSError:
+                    continue  # pruned/replaced by a concurrent process
+                entries.append((st.st_mtime, st.st_size, e.path))
     except OSError:
-        pass
+        return  # directory unreadable/gone: nothing to bound
+    total = sum(sz for _, sz, _ in entries)
+    cap = cap_mb * 1024 * 1024
+    if total <= cap:
+        return
+    _bump("prunes")
+    entries.sort()  # oldest-used first
+    for _, sz, path in entries:
+        try:
+            os.remove(path)
+        except OSError:
+            continue  # a concurrent pruner won the race for this one
+        _bump("disk_evicted")
+        total -= sz
+        if total <= cap * 0.8:
+            break
 
 
 # ---------------------------------------------------------------------------

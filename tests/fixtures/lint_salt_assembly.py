@@ -1,0 +1,45 @@
+"""Seeded graft_lint L1001 violation fixture (NOT imported by the
+package). graft-lint: scope(salt-providers)
+
+The marker comment above opts this file into the salt discipline that
+``mxnet_tpu/`` (outside ``artifact/``, ``utils/compile_cache.py`` and
+provider-defining files) gets automatically; the tier-1 lint test
+asserts every ad-hoc-assembly species below is flagged. Keep this
+file OUTSIDE mxnet_tpu/ so ``python -m tools.graft_lint mxnet_tpu``
+stays clean on the shipped tree.
+"""
+from mxnet_tpu.analysis.graph_opt import fingerprint_salt
+from mxnet_tpu.utils import compile_cache as cc
+from mxnet_tpu.utils.compile_cache import fingerprint as _fp
+
+
+def bad_method_salt(plan, mesh):
+    # L1001: folding a subsystem salt into a cache key by hand
+    return plan.fingerprint_salt(mesh) + ("zero1", True)
+
+
+def bad_name_salt(level):
+    # L1001: direct provider-function call at a consumer site
+    return ("graph", fingerprint_salt(level))
+
+
+def bad_raw_fingerprint(key):
+    # L1001: raw fingerprint composition (module-alias form)
+    return cc.fingerprint("dispatch", key)
+
+
+def bad_raw_fingerprint_from_import(key):
+    # L1001: raw fingerprint composition (from-import alias form)
+    return _fp("serving", key)
+
+
+def good_artifact(key):
+    # the sanctioned path: declarative salts resolved by the layer
+    from mxnet_tpu.artifact import CompiledArtifact
+
+    return CompiledArtifact("dispatch", key, salts=("graph_opt",))
+
+
+def whitelisted_legacy(plan, mesh):
+    # a deliberate legacy site carries the pragma
+    return plan.fingerprint_salt(mesh)  # graft-lint: allow(L1001)

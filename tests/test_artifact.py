@@ -1,0 +1,456 @@
+"""mxnet_tpu.artifact — the round-20 CompiledArtifact layer.
+
+Covers: declarative salt providers (registration, ordering, lazy
+built-ins), CompiledArtifact fingerprint compatibility (salt-free kinds
+keep their pre-artifact-layer fingerprints) and tiered resolve
+(compile -> disk -> remote), the remote cache tier over both backends
+(file:// shared dir and the reference HTTP server) with its
+retry/breaker degradation, deployment bundles (export/import, stale
+salt, repository wrapper), and the two-process acceptance paths: a
+bundle-warm replica and a remote-warm replica each serve their first
+response with zero traces, zero XLA compiles, bitwise-equal outputs.
+"""
+import hashlib
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import artifact, autograd, serving
+from mxnet_tpu.artifact import remote as art_remote
+from mxnet_tpu.artifact import salts as art_salts
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.utils import compile_cache as cc
+
+nd = mx.nd
+
+
+@pytest.fixture(autouse=True)
+def _fresh_artifact_state():
+    artifact.reset_artifact_counters()
+    artifact.reset_remote_state()
+    cc.reset_compile_cache_counters()
+    yield
+    artifact.reset_artifact_counters()
+    artifact.reset_remote_state()
+
+
+def _mlp(seed=3, out_dim=4):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+    net.initialize()
+    with autograd.pause(train_mode=False):
+        net(nd.zeros((1, 8)))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# salt providers
+
+def test_register_salt_provider_rejects_duplicates_and_noncallables():
+    name = "unit_test_salt_a"
+    art_salts.register_salt_provider(name, lambda ctx: ("a", 1))
+    assert name in artifact.salt_providers()
+    with pytest.raises(MXNetError, match="already registered"):
+        art_salts.register_salt_provider(name, lambda ctx: ("b",))
+    art_salts.register_salt_provider(name, lambda ctx: ("b", 2),
+                                     replace=True)
+    assert art_salts.resolve_salts((name,)) == (("b", 2),)
+    with pytest.raises(MXNetError, match="not callable"):
+        art_salts.register_salt_provider("unit_test_salt_bad", 7)
+
+
+def test_resolve_salts_order_and_context():
+    art_salts.register_salt_provider(
+        "unit_test_salt_x", lambda ctx: ("x", ctx.get("n", 0)),
+        replace=True)
+    art_salts.register_salt_provider(
+        "unit_test_salt_y", lambda ctx: (), replace=True)
+    got = art_salts.resolve_salts(
+        ("unit_test_salt_y", "unit_test_salt_x"), {"n": 9})
+    assert got == ((), ("x", 9))
+
+
+def test_unknown_salt_provider_raises():
+    with pytest.raises(MXNetError, match="unknown salt provider"):
+        art_salts.resolve_salts(("no_such_provider",))
+
+
+def test_builtin_providers_resolve():
+    # the built-ins live with their subsystems and register at import;
+    # resolving them must work regardless of import order (lazy import)
+    got = art_salts.resolve_salts(
+        ("graph_opt", "sharding", "quantize"),
+        {"optimizable": False, "shard": None, "graph_signature": None})
+    assert got == (("graph_opt", 0), ("sharding", 0), ())
+
+
+# ---------------------------------------------------------------------------
+# CompiledArtifact fingerprints
+
+def test_salt_free_fingerprint_matches_raw_compile_cache():
+    """Kinds that declare no salts ('dispatch', 'fused_step') must keep
+    their pre-artifact-layer fingerprints, so disk entries written by
+    earlier rounds stay valid."""
+    key = ("unit", 1, (2, 3))
+    art = artifact.CompiledArtifact("dispatch_compat", key)
+    assert art.fingerprint == cc.fingerprint("dispatch_compat", key)
+
+
+def test_none_key_is_memory_only():
+    art = artifact.CompiledArtifact("serving", None)
+    assert art.fingerprint is None
+    assert art.load() is None
+
+
+def test_declared_salts_fold_into_fingerprint():
+    art_salts.register_salt_provider(
+        "unit_test_salt_lvl", lambda ctx: ("lvl", ctx["lvl"]),
+        replace=True)
+
+    def fp(lvl):
+        return artifact.CompiledArtifact(
+            "unit_salted", ("k",), salts=("unit_test_salt_lvl",),
+            salt_ctx={"lvl": lvl}).fingerprint
+
+    assert fp(0) == fp(0)  # deterministic
+    assert fp(0) != fp(1)  # provider output differentiates artifacts
+    assert fp(0) != artifact.CompiledArtifact(
+        "unit_salted", ("k",)).fingerprint
+
+
+def test_artifact_resolve_compile_then_disk(monkeypatch, tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+
+    def f(x):
+        return jnp.sin(x) + 1.0
+
+    jfn = cc.counting_jit(f, label="artifact_unit")
+    aval = jax.ShapeDtypeStruct((4,), jnp.float32)
+    art = artifact.CompiledArtifact("unit_resolve", ("k1",),
+                                    code_of=(f,))
+    fn, meta, source = art.resolve(jfn, (aval,), meta={"n": 7})
+    assert source == "compile"
+    x = jnp.arange(4.0)
+    cold = onp.asarray(fn(x))
+
+    art2 = artifact.CompiledArtifact("unit_resolve", ("k1",),
+                                     code_of=(f,))
+    fn2, meta2, source2 = art2.resolve(jfn, (aval,))
+    assert source2 == "disk"
+    assert meta2 == {"n": 7}  # envelope meta rides to warm processes
+    assert onp.array_equal(onp.asarray(fn2(x)), cold)
+
+
+# ---------------------------------------------------------------------------
+# remote tier: file:// backend
+
+def test_remote_file_tier_fleet_roundtrip(monkeypatch, tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "l1"))
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE",
+                       "file://" + str(tmp_path / "shared"))
+
+    def f(x):
+        return x * 2.0 + 1.0
+
+    jfn = cc.counting_jit(f, label="artifact_remote_unit")
+    aval = jax.ShapeDtypeStruct((3,), jnp.float32)
+
+    def make():
+        return artifact.CompiledArtifact("unit_remote", ("k",),
+                                         code_of=(f,))
+
+    fn, _, source = make().resolve(jfn, (aval,))
+    assert source == "compile"
+    assert artifact.artifact_stats()["remote_publishes"] == 1
+
+    # a "fresh replica": empty local cache, same shared remote
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "l2"))
+    x = jnp.arange(3.0)
+    fn2, _, source2 = make().resolve(jfn, (aval,))
+    assert source2 == "remote"
+    st = artifact.artifact_stats()
+    assert st["remote_hits"] == 1 and st["fetch_bytes"] > 0
+    assert onp.array_equal(onp.asarray(fn2(x)), onp.asarray(fn(x)))
+
+    # the fetched blob was adopted locally: next resolve is a disk hit
+    _, _, source3 = make().resolve(jfn, (aval,))
+    assert source3 == "disk"
+
+
+def test_remote_publish_disabled_by_knob(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE",
+                       "file://" + str(tmp_path / "shared"))
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE_PUBLISH", "0")
+    assert not art_remote.publish("aa", b"blob")
+    assert artifact.artifact_stats()["remote_publishes"] == 0
+    assert not os.path.exists(str(tmp_path / "shared" / "aa.mxc"))
+
+
+# ---------------------------------------------------------------------------
+# remote tier: HTTP backend + resilience
+
+def test_remote_http_fetch_publish_and_miss(monkeypatch):
+    with artifact.ArtifactCacheServer() as srv:
+        monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", srv.url)
+        assert art_remote.fetch("deadbeef") is None  # 404: clean miss
+        assert artifact.artifact_stats()["remote_misses"] == 1
+        assert art_remote.publish("deadbeef", b"envelope-bytes")
+        assert srv.store["deadbeef"] == b"envelope-bytes"
+        assert art_remote.fetch("deadbeef") == b"envelope-bytes"
+        st = artifact.artifact_stats()
+        assert st["remote_hits"] == 1
+        assert st["publish_bytes"] == len(b"envelope-bytes")
+
+
+def test_remote_http_flaky_host_retries(monkeypatch):
+    with artifact.ArtifactCacheServer() as srv:
+        monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", srv.url)
+        srv.store["aa"] = b"blob"
+        srv.fail_requests = 1  # first attempt 503s, the retry lands
+        assert art_remote.fetch("aa") == b"blob"
+        assert srv.requests == 2
+        assert artifact.artifact_stats()["remote_errors"] == 0
+
+
+def test_remote_breaker_opens_and_degrades(monkeypatch):
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE_RETRIES", "1")
+    with artifact.ArtifactCacheServer() as srv:
+        monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", srv.url)
+        srv.fail_requests = 10 ** 6  # host is down for good
+        for _ in range(5):  # MXNET_BREAKER_THRESHOLD default
+            assert art_remote.fetch("aa") is None  # degrade, not raise
+        st = artifact.artifact_stats()
+        assert st["remote_errors"] == 5
+        assert art_remote.breaker_state() == "open"
+        served = srv.requests
+        assert art_remote.fetch("aa") is None  # skipped, no round-trip
+        assert srv.requests == served
+        assert artifact.artifact_stats()["remote_skipped"] >= 1
+    # repointing the knob must not inherit the dead host's streak
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", "file:///nowhere")
+    assert art_remote.breaker_state() == "closed"
+
+
+def test_no_remote_configured_is_free(monkeypatch):
+    monkeypatch.delenv("MXNET_ARTIFACT_REMOTE", raising=False)
+    assert art_remote.fetch("aa") is None
+    assert not art_remote.publish("aa", b"x")
+    st = artifact.artifact_stats()
+    assert st["remote_misses"] == 0 and st["remote_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# deployment bundles
+
+def _seed_cache_entries(d, entries):
+    os.makedirs(d, exist_ok=True)
+    for name, blob in entries.items():
+        with open(os.path.join(d, name + ".mxc"), "wb") as f:
+            f.write(blob)
+
+
+def test_bundle_export_import_roundtrip(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "c1"))
+    _seed_cache_entries(cc.cache_dir(), {"aa": b"A", "bb": b"BB"})
+    path = str(tmp_path / "m.bundle")
+    report = artifact.export_bundle(
+        path, ["bb", "aa", "aa", None, "gone"],
+        manifest={"model": "m", "version": 1})
+    assert report["entries"] == 2  # deduped, None dropped
+    assert report["missing"] == ["gone"]
+    assert report["bytes"] == os.path.getsize(path)
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "c2"))
+    res = artifact.import_bundle(path)
+    assert res == {"written": 2, "skipped": 0, "stale": False,
+                   "manifest": {"model": "m", "version": 1}}
+    for name, blob in (("aa", b"A"), ("bb", b"BB")):
+        with open(os.path.join(cc.cache_dir(), name + ".mxc"),
+                  "rb") as f:
+            assert f.read() == blob
+    st = artifact.artifact_stats()
+    assert st["bundle_exports"] == 1 and st["bundle_imports"] == 1
+    assert st["bundle_entries_written"] == 2
+
+
+def test_bundle_stale_salt_skips_everything(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "c1"))
+    _seed_cache_entries(cc.cache_dir(), {"aa": b"A"})
+    path = str(tmp_path / "m.bundle")
+    artifact.export_bundle(path, ["aa"])
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "c2"))
+    # the importer runs a different jax/backend/format generation
+    monkeypatch.setattr(cc, "_salt", lambda: ("other-generation",))
+    res = artifact.import_bundle(path)
+    assert res["stale"] and res["written"] == 0 and res["skipped"] == 1
+    assert not os.path.exists(os.path.join(cc.cache_dir(), "aa.mxc"))
+
+
+def test_import_bundle_rejects_non_bundles(tmp_path):
+    junk = tmp_path / "junk.bundle"
+    junk.write_bytes(b"not a pickle")
+    with pytest.raises(MXNetError, match="cannot read bundle"):
+        artifact.import_bundle(str(junk))
+    import pickle
+
+    notb = tmp_path / "notb.bundle"
+    notb.write_bytes(pickle.dumps({"something": "else"}))
+    with pytest.raises(MXNetError, match="not a format"):
+        artifact.import_bundle(str(notb))
+    with pytest.raises(MXNetError):
+        artifact.import_bundle(str(tmp_path / "absent.bundle"))
+
+
+def test_repository_export_bundle(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    net = _mlp(seed=21)
+    sess = serving.InferenceSession(net, input_shapes=[(1, 8)],
+                                    buckets=[1, 2])
+    with serving.ModelRepository() as repo:
+        repo.deploy("m", sess)
+        with pytest.raises(MXNetError, match="unknown model"):
+            repo.export_bundle("ghost", str(tmp_path / "g.bundle"))
+        with pytest.raises(MXNetError, match="no version"):
+            repo.export_bundle("m", str(tmp_path / "g.bundle"),
+                               version=9)
+        report = repo.export_bundle("m", str(tmp_path / "m.bundle"))
+    assert report["model"] == "m" and report["version"] == 1
+    assert report["entries"] == 2 and report["missing"] == []
+    # the bundle really carries both bucket executables
+    res = artifact.import_bundle(str(tmp_path / "m.bundle"))
+    assert res["manifest"] == {"model": "m", "version": 1,
+                               "buckets": [1, 2]}
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+
+def test_artifact_family_renders_in_prometheus(monkeypatch, tmp_path):
+    from mxnet_tpu import telemetry
+
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE",
+                       "file://" + str(tmp_path / "empty"))
+    assert art_remote.fetch("aa" * 8) is None  # one clean remote miss
+    text = telemetry.prometheus_text()
+    assert "mxnet_artifact_remote_misses 1" in text
+    assert "mxnet_artifact_remote_hits 0" in text
+    # satellite: the new compile-cache prune counters render too
+    assert "mxnet_compile_cache_disk_evicted" in text
+    assert "mxnet_compile_cache_prunes" in text
+
+
+# ---------------------------------------------------------------------------
+# two-process acceptance: bundle-warm and remote-warm replicas
+
+_CHILD_COMMON = """
+import hashlib, json, os
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import artifact, autograd, serving
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.utils import compile_cache as cc
+
+nd = mx.nd
+mx.random.seed(3)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+net.initialize()
+with autograd.pause(train_mode=False):
+    net(nd.zeros((1, 8)))
+sess = serving.InferenceSession(net, input_shapes=[(1, 8)],
+                                buckets=[1, 4], warm=False)
+# measure the SERVING path only: model construction above dispatches
+# one-shot eager ops whose executables never persist (the dispatch
+# tier stores on first in-process hit), and those are not what a
+# bundle/remote-warm replica is promising about
+cc.reset_compile_cache_counters()
+warm = sess.warmup()
+# a DEVICE-array request exercises the fused pad + slice helpers
+# (host inputs pad in numpy before upload)
+x = nd.array(onp.random.RandomState(5).rand(3, 8).astype("float32"))
+out = sess.predict(x).asnumpy()
+report = {
+    "warm": warm,
+    "retraces": cc.compile_cache_stats()["retraces"],
+    "digest": hashlib.sha256(out.tobytes()).hexdigest(),
+    "fps": sess.artifact_fingerprints(),
+    "artifact": artifact.artifact_stats(),
+}
+"""
+
+
+def test_bundle_warm_replica_zero_compiles(forced_device_subprocess,
+                                           tmp_path):
+    """Acceptance: process A warms + exports a bundle; process B — a
+    fresh replica with an EMPTY local cache — imports it and serves its
+    first response with zero traces, zero XLA compiles, bitwise-equal
+    outputs."""
+    bundle = str(tmp_path / "model.bundle")
+    a = forced_device_subprocess(
+        _CHILD_COMMON + f"""
+from mxnet_tpu.kernels import serving_fused as sf
+report["export"] = artifact.export_bundle(
+    {bundle!r},
+    sess.artifact_fingerprints() + sf.fusion_artifact_fingerprints(),
+    manifest={{"model": "m", "version": 1}})
+report["export"].pop("path")
+print(json.dumps(report))
+""", env={"MXNET_COMPILE_CACHE_DIR": str(tmp_path / "cache_a")})
+    assert a["warm"] == {"disk_hits": 0, "compiles": 2}
+    # 2 bucket executables + the fused pad and slice helpers the
+    # served request resolved
+    assert a["export"]["entries"] == 4 and not a["export"]["missing"]
+
+    b = forced_device_subprocess(
+        f"""
+import mxnet_tpu
+from mxnet_tpu import artifact
+imported = artifact.import_bundle({bundle!r})
+""" + _CHILD_COMMON + """
+report["imported"] = imported
+print(json.dumps(report))
+""", env={"MXNET_COMPILE_CACHE_DIR": str(tmp_path / "cache_b")})
+    assert b["imported"] == {"written": 4, "skipped": 0, "stale": False,
+                             "manifest": {"model": "m", "version": 1}}
+    assert b["warm"] == {"disk_hits": 2, "compiles": 0}
+    assert b["retraces"] == 0, "bundle-warm replica must never trace"
+    assert b["digest"] == a["digest"], "outputs must be bitwise equal"
+    assert b["fps"] == a["fps"]
+
+
+def test_remote_warm_replica_zero_compiles(forced_device_subprocess,
+                                           tmp_path):
+    """Acceptance: replica A compiles and PUBLISHES to the fleet cache;
+    replica B (empty local cache, same remote) warms entirely from the
+    remote tier — zero compiles, zero retraces, bitwise-equal
+    outputs."""
+    remote_env = {"MXNET_ARTIFACT_REMOTE":
+                  "file://" + str(tmp_path / "fleet")}
+    a = forced_device_subprocess(
+        _CHILD_COMMON + "print(json.dumps(report))",
+        env=dict(remote_env,
+                 MXNET_COMPILE_CACHE_DIR=str(tmp_path / "cache_a")))
+    assert a["warm"] == {"disk_hits": 0, "compiles": 2}
+    # 2 bucket executables + fused pad/slice, all pushed to the fleet
+    assert a["artifact"]["remote_publishes"] == 4
+
+    b = forced_device_subprocess(
+        _CHILD_COMMON + "print(json.dumps(report))",
+        env=dict(remote_env,
+                 MXNET_COMPILE_CACHE_DIR=str(tmp_path / "cache_b")))
+    assert b["warm"] == {"disk_hits": 2, "compiles": 0}
+    assert b["retraces"] == 0, "remote-warm replica must never trace"
+    assert b["artifact"]["remote_hits"] == 4
+    assert b["artifact"]["fetch_bytes"] > 0
+    assert b["digest"] == a["digest"], "outputs must be bitwise equal"

@@ -183,6 +183,27 @@ def test_fusion_bench_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_bundle_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import bundle_bench
+
+    out = str(tmp_path / "bundle.json")
+    doc = bundle_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    assert doc["bitwise_equal"]
+    # the tentpole promise holds at any scale: a bundle- or
+    # remote-warm replica's first response pays zero traces and zero
+    # XLA compiles (latency gates only on the committed full run)
+    assert doc["results"]["bundle_warm_retraces"] == 0
+    assert doc["results"]["remote_warm_retraces"] == 0
+    assert doc["warm_counters"]["bundle_warm"]["compiles"] == 0
+    assert doc["warm_counters"]["remote_warm"]["compiles"] == 0
+    assert doc["bundle_entries"] >= 2
+    assert doc["remote_hits"] >= 2
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "bundle"
+
+
+@pytest.mark.slow
 def test_sharding_bench_smoke(tmp_path):
     from mxnet_tpu.benchmark import sharding_bench
 

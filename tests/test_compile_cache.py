@@ -302,6 +302,62 @@ def test_disk_cache_prunes_to_size_cap(monkeypatch, tmp_path):
     assert any(not f.startswith("stale") for f in files)
 
 
+def test_prune_survives_concurrent_pruner(monkeypatch, tmp_path):
+    """Regression: two replicas sharing one cache dir prune
+    concurrently — entries the other pruner already deleted vanish
+    between scandir/stat and stat/remove. The sweep must tolerate the
+    per-entry races (not abort on the first ghost) and still enforce
+    the cap on what remains."""
+    import contextlib
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_MAX_MB", "1")
+    monkeypatch.setattr(cc, "_PRUNE_EVERY", 1)
+    d = str(tmp_path)
+    for i in range(12):
+        p = os.path.join(d, f"stale{i:02d}.mxc")
+        with open(p, "wb") as f:
+            f.write(b"x" * (256 * 1024))
+        os.utime(p, (1000 + i, 1000 + i))
+
+    real_scandir = os.scandir
+    # the "other pruner" takes these mid-sweep: two before our stat,
+    # one after our stat but before our remove
+    vanish = {"stale00.mxc": "pre-stat", "stale01.mxc": "pre-stat",
+              "stale02.mxc": "pre-remove"}
+
+    class _RacyEntry:
+        def __init__(self, e, race):
+            self._e, self._race = e, race
+            self.name, self.path = e.name, e.path
+
+        def stat(self):
+            if self._race == "pre-stat":
+                os.remove(self.path)
+                raise FileNotFoundError(self.path)
+            st = self._e.stat()
+            if self._race == "pre-remove":
+                os.remove(self.path)
+            return st
+
+    @contextlib.contextmanager
+    def racy_scandir(path):
+        with real_scandir(path) as it:
+            yield (_RacyEntry(e, vanish.get(e.name)) for e in it)
+
+    monkeypatch.setattr(cc.os, "scandir", racy_scandir)
+    before = cc.compile_cache_stats()
+    cc._maybe_prune(d)  # must not raise
+    monkeypatch.setattr(cc.os, "scandir", real_scandir)
+    stats = cc.compile_cache_stats()
+    assert stats["prunes"] - before["prunes"] == 1
+    assert stats["disk_evicted"] > before["disk_evicted"]
+    left = [f for f in os.listdir(d) if f.endswith(".mxc")]
+    total = sum(os.path.getsize(os.path.join(d, f)) for f in left)
+    assert total <= 1024 * 1024, (total, left)
+    # newest entries survived the sweep
+    assert "stale11.mxc" in left
+
+
 def test_bucketing_skips_recording(monkeypatch):
     monkeypatch.setenv("MXNET_SHAPE_BUCKETS", "pow2")
     x = nd.array(onp.ones((5, 4), dtype="float32"))
@@ -422,7 +478,7 @@ def test_knob_disables_fused_disk_layer(monkeypatch):
     net, tr = _make_net()
     _train(net, tr, steps=1)
     entry = next(iter(fs._CACHE._d.values()))
-    assert entry._fp is None
+    assert entry._artifact is None
     assert not isinstance(entry._call, cc.GuardedCompiled)
     assert _mxc_files() == []
 

@@ -26,6 +26,8 @@ PALLAS_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                               "lint_raw_pallas.py")
 CTR_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_raw_counter.py")
+SALT_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                            "lint_salt_assembly.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -291,6 +293,52 @@ def test_raw_pallas_scope_exempts_kernels_package(tmp_path):
     own.parent.mkdir(parents=True)
     own.write_text(src)
     assert graft_lint.lint_paths([str(own)], repo_root=REPO,
+                                 registry=False) == []
+
+
+def test_salt_assembly_fixture_triggers_l1001():
+    """L1001: every ad-hoc salt/fingerprint-assembly species in the
+    seeded fixture is flagged — method-form fingerprint_salt, bare
+    provider-function call, raw compile_cache.fingerprint via the
+    module alias and via the from-import alias — while the sanctioned
+    CompiledArtifact(salts=...) site and the allow(L1001) legacy site
+    are not."""
+    findings = graft_lint.lint_paths([SALT_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l1001 = [f for f in findings if f.code == "L1001"]
+    assert len(l1001) == 4, findings
+    msgs = "\n".join(f.message for f in l1001)
+    assert "register_salt_provider" in msgs
+    assert "CompiledArtifact(salts=...)" in msgs
+    assert {f.code for f in findings} == {"L1001"}, findings
+
+
+def test_salt_scope_exempts_artifact_and_providers(tmp_path):
+    """L1001 binds mxnet_tpu/ automatically but exempts the artifact
+    package (which owns fingerprint composition) and any file that
+    DEFINES a salt provider; outside the package it is opt-in via
+    scope(salt-providers)."""
+    src = ("def consume(plan, mesh):\n"
+           "    return plan.fingerprint_salt(mesh)\n")
+    free = tmp_path / "salt_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "gluon" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [f.code for f in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
+    assert codes == ["L1001"], codes
+    own = tmp_path / "mxnet_tpu" / "artifact" / "frag.py"
+    own.parent.mkdir(parents=True)
+    own.write_text(src)
+    assert graft_lint.lint_paths([str(own)], repo_root=REPO,
+                                 registry=False) == []
+    prov = tmp_path / "mxnet_tpu" / "gluon" / "prov.py"
+    prov.write_text(
+        src + "\n\ndef fingerprint_salt(x):\n    return (x,)\n")
+    assert graft_lint.lint_paths([str(prov)], repo_root=REPO,
                                  registry=False) == []
 
 

@@ -76,6 +76,26 @@ package:
                        against a module-level dict is invisible to
                        both. Legitimate seed/bootstrap sites carry
                        ``# graft-lint: allow(L901)``.
+``L1001 salt-assembly`` ad-hoc cache-salt/fingerprint assembly inside
+                       ``mxnet_tpu/`` but outside the artifact layer: a
+                       ``fingerprint_salt(...)`` call or a raw
+                       ``compile_cache.fingerprint(...)`` composition
+                       (alias-aware) anywhere except
+                       ``mxnet_tpu/artifact/`` and
+                       ``utils/compile_cache.py``. Round 20 moved
+                       fingerprint composition behind
+                       ``CompiledArtifact``: subsystems contribute salt
+                       material by REGISTERING a provider
+                       (``artifact.register_salt_provider``) and
+                       consumers name it in ``salts=(...)`` — a salt
+                       hand-folded into a cache key elsewhere is
+                       invisible to that composition and silently
+                       diverges from what the disk/remote tiers keyed.
+                       Files that DEFINE a provider (``def
+                       fingerprint_salt`` / ``register_salt_provider``
+                       sites) are the sanctioned sources and are
+                       exempt; a deliberate legacy site carries
+                       ``# graft-lint: allow(L1001)``.
 ``L501 bare-except``   a bare ``except:`` clause, or a broad handler
                        (``except Exception``/``BaseException``, alone
                        or in a tuple) whose body is ONLY ``pass``/
@@ -775,6 +795,79 @@ def check_raw_counter_mutation(path, tree, source, findings):
                  node.func.value.id)
 
 
+def _salt_discipline_scoped(path, source):
+    """Files the L1001 salt discipline applies to: all of
+    ``mxnet_tpu/`` EXCEPT the artifact package (which owns fingerprint
+    composition), ``utils/compile_cache.py`` (the digest primitive
+    itself), and any file that DEFINES a salt provider — providers are
+    the sanctioned way for a subsystem to contribute salt material.
+    Code outside the package opts in with a
+    ``# graft-lint: scope(salt-providers)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if "mxnet_tpu/artifact/" in norm or \
+            norm.endswith("mxnet_tpu/utils/compile_cache.py"):
+        return False
+    if "def fingerprint_salt" in source or \
+            "register_salt_provider" in source:
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(salt-providers)" in source
+
+
+def check_salt_assembly(path, tree, source, findings):
+    """L1001: ad-hoc salt/fingerprint assembly outside the artifact
+    layer. Round 20's contract is ONE fingerprint composition path
+    (``CompiledArtifact`` resolving declared salt providers): a
+    ``fingerprint_salt(...)`` call or raw ``compile_cache.
+    fingerprint(...)`` elsewhere folds key material the artifact layer
+    never sees, so the same executable fingerprints differently across
+    call sites and the disk/remote tiers silently miss."""
+    if not _salt_discipline_scoped(path, source):
+        return
+    fp_aliases = set()  # local names bound to compile_cache.fingerprint
+    cc_aliases = set()  # local names bound to the compile_cache module
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("compile_cache"):
+                for a in node.names:
+                    if a.name == "fingerprint":
+                        fp_aliases.add(a.asname or a.name)
+            elif mod.endswith("utils"):
+                for a in node.names:
+                    if a.name == "compile_cache":
+                        cc_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("compile_cache"):
+                    cc_aliases.add(a.asname or a.name)
+    pragmas = _Pragmas(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        what = None
+        if (isinstance(f, ast.Name) and f.id == "fingerprint_salt") or \
+                (isinstance(f, ast.Attribute)
+                 and f.attr == "fingerprint_salt"):
+            what = "fingerprint_salt(...) salt assembly"
+        elif isinstance(f, ast.Name) and f.id in fp_aliases:
+            what = "raw compile_cache.fingerprint(...) composition"
+        elif isinstance(f, ast.Attribute) and f.attr == "fingerprint" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in cc_aliases:
+            what = "raw compile_cache.fingerprint(...) composition"
+        if what is not None and not pragmas.allows(node.lineno, "L1001"):
+            findings.append(Finding(
+                "L1001", path, node.lineno,
+                f"{what} outside mxnet_tpu/artifact/ — register a salt "
+                "provider (artifact.register_salt_provider) and name it "
+                "in CompiledArtifact(salts=...) so fingerprint "
+                "composition stays in one layer; annotate a deliberate "
+                "legacy site with allow(L1001)"))
+
+
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
@@ -937,6 +1030,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_raw_sharding_construction(path, tree, source, findings)
         check_raw_pallas_import(path, tree, source, findings)
         check_raw_counter_mutation(path, tree, source, findings)
+        check_salt_assembly(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
