@@ -93,7 +93,7 @@ _TRIPLE_CONSUMERS = {"requantize", "dequantize"} | set(
 _COUNTERS = _telemetry.counter_family("quantize", {
     "graphs_quantized": 0, "nodes_quantized": 0, "islands_elided": 0,
     "nodes_calibrated": 0, "scales_folded": 0, "uint8_boundaries": 0,
-    "weight_bytes_saved": 0,
+    "weight_bytes_saved": 0, "kv_pages_quantized": 0,
 })
 
 
@@ -520,6 +520,45 @@ def _quantize_calibrate(graph, ctx):
 #: fold/cse/dce clean up orphaned fp32 islands and duplicate boundaries
 QUANTIZE_PIPELINE = ("quantize_insert", "quantize_elide",
                      "quantize_calibrate", "fold", "cse", "dce")
+
+
+def kv_page_codes(pages):
+    """Pure quantization math for :func:`quantize_kv_page` — traceable
+    (no counter side effects), so the paged state store can fuse it into
+    its jitted scatter kernel. Callers that trace this are responsible
+    for bumping ``kv_pages_quantized`` themselves, outside the trace."""
+    import jax.numpy as jnp
+
+    red = tuple(range(1, pages.ndim))
+    amax = jnp.max(jnp.abs(pages), axis=red)
+    scale = amax / 127.0
+    denom = jnp.where(scale > 0, scale, 1.0)
+    shape = scale.shape + (1,) * (pages.ndim - 1)
+    q = jnp.clip(jnp.round(pages / denom.reshape(shape)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_kv_page(pages):
+    """Symmetric per-page int8 quantization for paged KV-cache storage
+    (round 21): ``pages`` is a batch-first fp32 block ``(n, ...)``;
+    returns ``(int8 codes, fp32 per-page scales (n,))``. The lattice's
+    symmetric (-amax, +amax) convention — zero-point-free, so a page of
+    zeros round-trips to exact zeros and the attention mask's
+    guarantees survive quantization."""
+    q, scale = kv_page_codes(pages)
+    _count("kv_pages_quantized", int(pages.shape[0]))
+    return q, scale
+
+
+def dequantize_kv_pages(q, scales):
+    """Inverse of :func:`quantize_kv_page`, broadcasting per-page
+    scales over trailing axes (``q`` may carry extra leading batch
+    axes as long as ``scales`` matches them)."""
+    import jax.numpy as jnp
+
+    shape = scales.shape + (1,) * (q.ndim - scales.ndim)
+    return q.astype(jnp.float32) * scales.reshape(shape)
 
 
 def fingerprint_salt(graph_signature):

@@ -23,6 +23,8 @@ def _zero_stats():
         # deployment bundles
         "bundle_exports": 0, "bundle_imports": 0,
         "bundle_entries_written": 0, "bundle_entries_skipped": 0,
+        # remote-store GC (file:// pruner + ArtifactCacheServer LRU)
+        "gc_runs": 0, "gc_evicted": 0, "gc_bytes": 0,
     }
 
 

@@ -68,6 +68,17 @@ def _timeout_s():
     return _env.get_int("MXNET_ARTIFACT_REMOTE_TIMEOUT_MS", 2000) / 1e3
 
 
+def _max_bytes():
+    """MXNET_ARTIFACT_REMOTE_MAX_MB: byte bound on the remote store
+    (default 512 MB; 0 = unbounded). Enforced by whoever owns the
+    bytes: the publishing replica for a ``file://`` directory, the
+    server process for the HTTP store."""
+    from .. import env as _env
+
+    cap_mb = _env.get_int("MXNET_ARTIFACT_REMOTE_MAX_MB", 512)
+    return cap_mb * 1024 * 1024 if cap_mb > 0 else 0
+
+
 def _policy():
     from .. import env as _env
     from ..resilience.retry import RetryPolicy
@@ -136,6 +147,54 @@ def _fetch_backend(url, fp):
         raise
 
 
+_GC_EVERY = 32
+_gc_tick = [0]
+
+
+def _maybe_gc_file(directory):
+    """Bound a ``file://`` store the way the local tier bounds its
+    directory (``compile_cache._maybe_prune``): every ``_GC_EVERY``-th
+    publish, if the ``.mxc`` total exceeds MXNET_ARTIFACT_REMOTE_MAX_MB,
+    remove oldest-used entries (mtime) down to 80% of the cap. Every
+    step tolerates a concurrent pruner on another replica: a stat or
+    remove that loses the race is skipped, never raised — a shared
+    NFS mount has many writers and no coordinator."""
+    _gc_tick[0] += 1
+    if _GC_EVERY > 1 and _gc_tick[0] % _GC_EVERY != 1:
+        return
+    cap = _max_bytes()
+    if cap <= 0:
+        return  # 0 = unbounded, explicitly
+    entries = []
+    try:
+        with os.scandir(directory) as it:
+            for e in it:
+                if not e.name.endswith(".mxc"):
+                    continue
+                try:
+                    st = e.stat()
+                except OSError:
+                    continue  # pruned/replaced by a concurrent replica
+                entries.append((st.st_mtime, st.st_size, e.path))
+    except OSError:
+        return  # directory unreadable/gone: nothing to bound
+    total = sum(sz for _, sz, _ in entries)
+    if total <= cap:
+        return
+    STATS.add("gc_runs")
+    entries.sort()  # oldest-used first
+    for _, sz, path in entries:
+        try:
+            os.remove(path)
+        except OSError:
+            continue  # a concurrent pruner won the race for this one
+        STATS.add("gc_evicted")
+        STATS.add("gc_bytes", sz)
+        total -= sz
+        if total <= cap * 0.8:
+            break
+
+
 def _publish_backend(url, fp, blob):
     if url.startswith("file://"):
         directory = url[len("file://"):]
@@ -145,6 +204,7 @@ def _publish_backend(url, fp, blob):
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)
+        _maybe_gc_file(directory)
         return
     import urllib.request
 
@@ -228,12 +288,26 @@ class ArtifactCacheServer:
     -> 201. Stdlib ``ThreadingHTTPServer`` on an ephemeral port.
 
     ``fail_requests = N`` makes the next N requests answer 503 — the
-    flaky-host drill the retry/breaker seam is tested against."""
+    flaky-host drill the retry/breaker seam is tested against.
 
-    def __init__(self, host="127.0.0.1"):
+    The store is byte-bounded (``max_bytes``; default the
+    MXNET_ARTIFACT_REMOTE_MAX_MB knob, 0 = unbounded): a PUT that
+    pushes the total over the cap evicts least-recently-ACCESSED
+    entries first (a GET hit refreshes recency — the server-side
+    mirror of the mtime-refresh the ``file://`` pruner keys on), so a
+    long-lived fleet cache sheds artifacts nobody fetches anymore
+    instead of growing one blob per fingerprint forever."""
+
+    def __init__(self, host="127.0.0.1", max_bytes=None):
+        import collections
         import http.server
 
-        self.store = {}
+        self.store = collections.OrderedDict()  # fp -> blob, LRU order
+        self.max_bytes = _max_bytes() if max_bytes is None \
+            else int(max_bytes)
+        self.store_bytes = 0
+        self.gc_evicted = 0
+        self._store_lock = threading.Lock()
         self.fail_requests = 0
         self.requests = 0
         outer = self
@@ -259,7 +333,11 @@ class ArtifactCacheServer:
             def do_GET(self):
                 if not self._gate():
                     return
-                blob = outer.store.get(self._fingerprint())
+                fp = self._fingerprint()
+                with outer._store_lock:
+                    blob = outer.store.get(fp)
+                    if blob is not None:
+                        outer.store.move_to_end(fp)  # refresh recency
                 if blob is None:
                     self.send_response(404)
                     self.end_headers()
@@ -278,7 +356,27 @@ class ArtifactCacheServer:
                     self.end_headers()
                     return
                 n = int(self.headers.get("Content-Length") or 0)
-                outer.store[fp] = self.rfile.read(n)
+                blob = self.rfile.read(n)
+                with outer._store_lock:
+                    old = outer.store.pop(fp, None)
+                    if old is not None:
+                        outer.store_bytes -= len(old)
+                    outer.store[fp] = blob
+                    outer.store_bytes += len(blob)
+                    ran = False
+                    # evict coldest-accessed until back under the cap
+                    # (never the entry just written, however large)
+                    while (outer.max_bytes > 0 and
+                           outer.store_bytes > outer.max_bytes and
+                           len(outer.store) > 1):
+                        if not ran:
+                            ran = True
+                            STATS.add("gc_runs")
+                        _, ev = outer.store.popitem(last=False)
+                        outer.store_bytes -= len(ev)
+                        outer.gc_evicted += 1
+                        STATS.add("gc_evicted")
+                        STATS.add("gc_bytes", len(ev))
                 self.send_response(201)
                 self.end_headers()
 

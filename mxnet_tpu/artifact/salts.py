@@ -53,6 +53,7 @@ _BUILTIN_MODULES = {
     "graph_opt": "mxnet_tpu.analysis.graph_opt",
     "quantize": "mxnet_tpu.analysis.quantize",
     "sharding": "mxnet_tpu.sharding.plan",
+    "paged_state": "mxnet_tpu.serving.state",
 }
 
 

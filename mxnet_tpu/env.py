@@ -200,6 +200,13 @@ KNOBS = {
         "attempts per remote artifact round-trip (default 2, via the "
         "resilience RetryPolicy); repeated failures trip a circuit "
         "breaker and the replica degrades to local compiles"),
+    "MXNET_ARTIFACT_REMOTE_MAX_MB": (
+        "wired", "artifact.remote",
+        "byte bound on the remote artifact store (default 512, 0 = "
+        "unbounded): file:// publishers prune oldest-used .mxc entries "
+        "to 80% of the cap every 32nd publish (concurrent-pruner "
+        "tolerant), ArtifactCacheServer evicts least-recently-fetched "
+        "blobs on PUT; evictions land in mxnet_artifact_gc_* counters"),
     "MXNET_SHAPE_BUCKETS": (
         "wired", "ndarray.registry",
         "automatic batch-axis shape bucketing for eager dispatch: "
@@ -305,6 +312,19 @@ KNOBS = {
         "idle session time-to-live in seconds (default 600): a "
         "stream untouched this long is evicted before LRU kicks in; "
         "its next step gets a clean retryable SessionEvicted"),
+    "MXNET_SERVING_STATE_PAGE_TOKENS": (
+        "wired", "serving.state",
+        "KV-cache page size in tokens (default 0 = row-slot mode): "
+        "> 0 stores pageable state rows (state_row_pageable()) as "
+        "fixed-size pages with per-session page tables, so sessions "
+        "reserve pages for their live prefix instead of max-length "
+        "rows and the byte budget admits several x more streams"),
+    "MXNET_SERVING_STATE_KV_INT8": (
+        "wired", "serving.state",
+        "store fp32 KV pages as symmetric per-page int8 + one fp32 "
+        "scale (default 0): halves page bytes again; opt-in and "
+        "accuracy-gated by the caller — dequantized attention is "
+        "approximate, never bitwise"),
     "MXNET_DEVICE_PREFETCH": (
         "wired", "pipeline.DeviceFeed",
         "device-feed prefetch depth (default 2): batches staged onto "

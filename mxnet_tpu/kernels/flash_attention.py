@@ -134,6 +134,63 @@ def _pallas_forward(q, k, v, sm_scale, causal, interpret):
     return out[:, :, :S_q] if pq else out
 
 
+def _dec_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale):
+    """Decode-mode kernel, grid (B*H,): one query row against its whole
+    KV cache row in VMEM. Decode is a GEMV — the S² tiling of the
+    training kernel buys nothing at S_q=1, so the cache row (S, D)
+    streams in as one block (VMEM-bound: fine for serving prefix
+    lengths; S·D·4 bytes must fit VMEM) and the masked softmax runs
+    fused in fp32. Per-session visible lengths arrive as a prefetched
+    scalar vector — one compiled kernel serves every mixed-length
+    batch."""
+    b = pl.program_id(0)
+    n = len_ref[b]
+    q = q_ref[0].astype(jnp.float32)  # (1, D)
+    k = k_ref[0].astype(jnp.float32)  # (S, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    kid = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kid < n, s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)  # masked scores underflow to exact +0.0
+    o_ref[0] = (jnp.dot(p, v, preferred_element_type=jnp.float32)
+                / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True),
+                              1e-30)).astype(o_ref.dtype)
+
+
+def _decode_flash(q, k, v, lengths, sm_scale, interpret):
+    """One incremental decode step: q (B, H, D) attends against the
+    cache k/v (B, H, S, D) masked to per-row prefix ``lengths`` (B,)
+    int32. Returns (B, H, D). The Pallas path of the registered
+    ``_attention_decode`` op (documented-ulp vs the lax path: fused
+    fp32 softmax; the lax path is the bitwise oracle)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = k.shape
+    qr = q.reshape(B * H, 1, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    lens = jnp.repeat(lengths.astype(jnp.int32), H)  # (B*H,)
+    kern = functools.partial(_dec_kernel, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * H,),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda b, lens: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, lens: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, lens: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda b, lens: (b, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        interpret=interpret,
+    )(lens, qr, kr, vr)
+    return out.reshape(B, H, D)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, sm_scale, causal, impl):
     if impl == "xla":

@@ -6,6 +6,7 @@ python/mxnet/gluon/model_zoo/vision/) plus TPU-first training entry points.
 from ..gluon.model_zoo import vision, get_model
 from .transformer import TransformerLM, TransformerBlock, \
     MultiHeadSelfAttention
+from .decoder import DecoderBlockLM
 
 __all__ = ["vision", "get_model", "TransformerLM", "TransformerBlock",
-           "MultiHeadSelfAttention"]
+           "MultiHeadSelfAttention", "DecoderBlockLM"]

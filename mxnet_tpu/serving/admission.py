@@ -128,13 +128,21 @@ class AdmissionController:
 
     def _slot_headroom(self):
         """Free fraction of the session state pool (1.0 for stateless
-        batchers — no pool, nothing to protect)."""
+        batchers — no pool, nothing to protect). A paged store folds
+        in its KV page pool too: slots may be plentiful while every
+        page is spoken for, and a new stream needs at least one."""
         store = getattr(getattr(self._batcher, "session", None),
                         "state_store", None)
         if store is None:
             return 1.0
         slots = max(store.num_slots, 1)
-        return 1.0 - min(store.occupancy, slots) / slots
+        head = 1.0 - min(store.occupancy, slots) / slots
+        pages = getattr(store, "page_headroom", None)
+        if callable(pages):
+            ph = pages()
+            if ph is not None:
+                head = min(head, ph)
+        return head
 
     def headroom(self):
         """Live SLO headroom in [0, 1]: min(queue, latency) signals.

@@ -765,7 +765,9 @@ class DynamicBatcher:
                                  slo_class=r.slo_class,
                                  session=r.session_id)
         t0 = time.perf_counter()
-        slots = [rec.slot for rec in recs]
+        # slot RECORDS, not indices: a paged store routes gather/
+        # scatter through each record's page table
+        slots = recs
         try:
             with _telem.span("serving.decode_step", cat="serving",
                              trace_id=live[0].trace_id,

@@ -38,6 +38,11 @@ Round 16 adds the incremental-decode dimension: ``decode_steps``
 ``slot_occupancy`` gauge probed from live :class:`SessionStateStore`
 instances — all of which flow through ``serving_counters()``,
 ``profiler.dump()`` samples, and the Prometheus families for free.
+
+Round 21 adds the KV page-pool dimension for paged stores:
+``kv_pages_total`` / ``kv_pages_used`` / ``kv_pages_per_session_p50``
+/ ``_p99`` / ``kv_bytes`` gauges, probed from each paged
+``SessionStateStore`` at read time (same pattern as occupancy).
 """
 from __future__ import annotations
 
@@ -191,6 +196,7 @@ class ServingMetrics:
         self._depth_probes = {}  # token -> callable() -> int
         self._headroom_probes = {}  # token -> callable() -> float
         self._occupancy_probes = {}  # token -> callable() -> int
+        self._page_probes = {}  # token -> callable() -> dict
 
     def _reset_locked(self):
         self.counters = dict.fromkeys(_COUNTER_NAMES, 0)
@@ -367,6 +373,53 @@ class ServingMetrics:
                 pass
         return occ
 
+    def register_page_probe(self, probe):
+        """Register a KV page-pool sampler (a paged
+        ``SessionStateStore``); the callable returns a dict with
+        ``pages_total`` / ``pages_used`` / ``pages_per_session``
+        (per-live-session page counts) / ``kv_bytes``. Probed at read
+        time only. Returns a token for
+        :meth:`unregister_page_probe`."""
+        token = object()
+        with self._lock:
+            self._page_probes[token] = probe
+        return token
+
+    def unregister_page_probe(self, token):
+        with self._lock:
+            self._page_probes.pop(token, None)
+
+    def page_stats(self):
+        """Aggregated KV page-pool gauges across registered paged
+        stores: totals plus p50/p99 pages-per-live-session (0 with no
+        paged store or no live sessions)."""
+        with self._lock:
+            probes = list(self._page_probes.values())
+        total = used = kv_bytes = 0
+        per = []
+        for p in probes:
+            try:
+                st = p()
+                total += int(st.get("pages_total", 0))
+                used += int(st.get("pages_used", 0))
+                kv_bytes += int(st.get("kv_bytes", 0))
+                per.extend(int(v) for v in
+                           st.get("pages_per_session", ()))
+            except Exception:  # graft-lint: allow(L501)
+                pass
+        per.sort()
+
+        def pct(q):
+            if not per:
+                return 0
+            return per[min(int(q * (len(per) - 1) + 0.5),
+                           len(per) - 1)]
+
+        return {"kv_pages_total": total, "kv_pages_used": used,
+                "kv_pages_per_session_p50": pct(0.50),
+                "kv_pages_per_session_p99": pct(0.99),
+                "kv_bytes": kv_bytes}
+
     def slo_headroom(self):
         """Minimum live headroom across registered admission
         controllers, 0..1 (1.0 with none registered — no controller
@@ -421,6 +474,7 @@ class ServingMetrics:
         st["queue_depth"] = self.queue_depth()
         st["slo_headroom"] = round(self.slo_headroom(), 4)
         st["slot_occupancy"] = self.slot_occupancy()
+        st.update(self.page_stats())
         return st
 
     def reset(self):
@@ -483,6 +537,17 @@ class ServingMetrics:
         emit("mxnet_serving_slot_occupancy", self.slot_occupancy(),
              help_="live sessions holding server-side state slots",
              typ="gauge")
+        page_help = {
+            "kv_pages_total": "physical KV pages across paged stores",
+            "kv_pages_used": "allocated KV pages across paged stores",
+            "kv_pages_per_session_p50":
+                "median pages held per live session",
+            "kv_pages_per_session_p99":
+                "p99 pages held per live session",
+            "kv_bytes": "bytes held by allocated KV pages"}
+        for name, value in sorted(self.page_stats().items()):
+            emit(f"mxnet_serving_{name}", value,
+                 help_=page_help.get(name, name), typ="gauge")
         try:
             from ..kernels import counters as _fusion_counters
 

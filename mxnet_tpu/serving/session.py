@@ -219,8 +219,17 @@ class InferenceSession:
                 _InputSpec(f"state{i}", s, dt)
                 for i, (s, dt) in enumerate(zip(state_shapes, dts))]
             if self.state_store is None:
+                # blocks that declare KV-cache rows (state_row_pageable)
+                # opt those rows into paged storage — active only when
+                # MXNET_SERVING_STATE_PAGE_TOKENS is set
+                pageable = None
+                proto = getattr(block, "state_row_pageable", None)
+                if callable(proto):
+                    flags = list(proto())
+                    if len(flags) == len(state_shapes):
+                        pageable = flags
                 self.state_store = SessionStateStore(
-                    state_shapes, dts, label=label)
+                    state_shapes, dts, pageable=pageable, label=label)
                 self._owns_store = True
         self._ensure_initialized()
         self._param_list = [p for _, p in
@@ -578,12 +587,19 @@ class InferenceSession:
             amp_ver, occupancy)
         code_of = [type(self)._pure_step, type(self._block).forward]
         code_of.extend(self._graph_op_bodies())
+        store = self.state_store
         return CompiledArtifact(
             "serving_step", key, code_of=tuple(code_of),
-            salts=("graph_opt", "quantize"),
+            salts=("graph_opt", "quantize", "paged_state"),
             salt_ctx={
                 "optimizable": isinstance(self._block, SymbolBlock),
                 "graph_signature": self._graph_sig,
+                # paged-KV serving knobs re-key step artifacts; a
+                # row-slot store contributes the empty salt, keeping
+                # every pre-r21 fingerprint stable
+                "paged": bool(store is not None and store.paged),
+                "page_tokens": getattr(store, "page_tokens", 0),
+                "kv_int8": bool(getattr(store, "kv_int8", False)),
             })
 
     def _step_avals(self, occupancy):

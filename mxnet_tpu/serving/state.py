@@ -16,6 +16,28 @@ ONE compiled step executable, and scatters the new state back — so a
 single AOT program serves any batch membership, exactly the bucketing
 discipline the rest of the stack lives by.
 
+**Paged KV storage (round 21).** Worst-case-length slots are the wrong
+shape for transformer decode: a (max_len, embed) KV cache reserves
+max_len bytes the moment a stream opens, even while it sits at token 3.
+When ``MXNET_SERVING_STATE_PAGE_TOKENS`` is set (> 0) and the model
+marks cache rows *pageable* (``state_row_pageable()``), those rows are
+stored as fixed-size token pages in a shared page pool — the vLLM
+discipline: each session keeps a small page TABLE (logical page →
+physical page), pages are allocated lazily as the stream crosses page
+boundaries, and physical page 0 is the reserved **null page** (always
+zeros, never written), so unallocated table entries gather as zeros and
+the decode attention mask keeps them inert. The compiled step never
+changes shape — gather materializes the same dense
+``(occupancy, max_len, ...)`` block from pages, and scatter writes back
+only the ONE page the step touched (valid because the decode cache
+contract is append-only: ``_cache_append`` is an exact scatter at the
+step position, bitwise transparent to every other entry). The same
+``MXNET_SERVING_STATE_BUDGET_MB`` therefore admits several× more
+concurrent mixed-length streams. ``MXNET_SERVING_STATE_KV_INT8``
+additionally stores fp32 pages as symmetric per-page int8 (+ one fp32
+scale per page, via the round-19 quantize lattice helpers) — half the
+page bytes again, opt-in and accuracy-gated by the caller.
+
 Policies:
 
 - **Affinity** — a session's steps never interleave: the store marks a
@@ -27,18 +49,26 @@ Policies:
   ``MXNET_SERVING_STATE_BUDGET_MB``; opening a session when every slot
   is taken first reclaims idle-expired sessions
   (``MXNET_SERVING_STATE_TTL_S``), then the least-recently-stepped one.
-  An evicted session's next step raises :class:`SessionEvicted` — a
-  clean, retryable 503 telling exactly that one client to re-open.
+  Page exhaustion reclaims the same way — TTL first, then whole LRU
+  sessions (page granularity never splits a victim: evicting one
+  session frees ALL its pages and nothing of anyone else's, the
+  blast-radius contract). An evicted session's next step raises
+  :class:`SessionEvicted` — a clean, retryable 503 telling exactly
+  that one client to re-open.
 - **Checkpointable** — :meth:`export_state` / :meth:`restore_state`
   round-trip every live session as host arrays; the round-12
   ``CheckpointManager(session_state=store)`` rides them in its
   manifest-hashed payload, and a round-13 canary promote migrates live
   sessions into the new version's store instead of dropping them
-  (``resumed_sessions`` counts both paths).
+  (``resumed_sessions`` counts both paths). Payload states are always
+  DENSE rows regardless of page geometry, so a checkpoint taken under
+  one ``PAGE_TOKENS`` restores under another (or under row-slot mode)
+  unchanged.
 
 The ``session_state_evict`` fault seam fires in :meth:`acquire` —
 chaos drills can evict any session mid-stream and assert the blast
-radius is one client.
+radius is one client. Page allocation is wrapped in a
+``serving.page_alloc`` telemetry span.
 """
 from __future__ import annotations
 
@@ -60,6 +90,76 @@ __all__ = ["SessionStateStore", "SessionEvicted"]
 _TOMBSTONES = 4096
 
 
+# ---------------------------------------------------------------------------
+# fused paged-pool kernels
+#
+# The paged gather/scatter paths are on the per-step critical path: run
+# eagerly they cost ~5 dispatches per pageable tensor (reshape, arange
+# page-pick, quantize, indexed set, ...) vs the row-slot path's one,
+# which at high occupancy dominates the decode step itself. Each helper
+# fuses its whole read/write into a single jitted call, cached per pool
+# geometry (and retraced per occupancy, which is bounded by the
+# batcher's size ladder). None of these donate their pool operand:
+# gather() hands out pool references that are indexed OUTSIDE the store
+# lock, so an aliased update could race a concurrent reader.
+
+def _lru(fn):
+    import functools
+
+    return functools.lru_cache(maxsize=None)(fn)
+
+
+@_lru
+def _paged_gather_fn(seq, tail):
+    from ..utils.compile_cache import counting_jit
+
+    def fn(pool, tables):
+        return pool[tables].reshape((tables.shape[0], seq) + tail)
+
+    return counting_jit(fn, label="paged_gather")
+
+
+@_lru
+def _paged_gather_int8_fn(seq, tail):
+    from ..analysis.quantize import dequantize_kv_pages
+    from ..utils.compile_cache import counting_jit
+
+    def fn(pool, scales, tables):
+        pg = dequantize_kv_pages(pool[tables], scales[tables])
+        return pg.reshape((tables.shape[0], seq) + tail)
+
+    return counting_jit(fn, label="paged_gather_int8")
+
+
+@_lru
+def _paged_scatter_fn(ppr, page_tokens, tail):
+    import jax.numpy as jnp
+
+    from ..utils.compile_cache import counting_jit
+
+    def fn(pool, ns, pidx, dest):
+        nr = ns.reshape((ns.shape[0], ppr, page_tokens) + tail)
+        changed = nr[jnp.arange(ns.shape[0]), pidx]
+        return pool.at[dest].set(changed)
+
+    return counting_jit(fn, label="paged_scatter")
+
+
+@_lru
+def _paged_scatter_int8_fn(ppr, page_tokens, tail):
+    import jax.numpy as jnp
+
+    from ..analysis.quantize import kv_page_codes
+    from ..utils.compile_cache import counting_jit
+
+    def fn(pool, scales, ns, pidx, dest):
+        nr = ns.reshape((ns.shape[0], ppr, page_tokens) + tail)
+        q, sc = kv_page_codes(nr[jnp.arange(ns.shape[0]), pidx])
+        return pool.at[dest].set(q), scales.at[dest].set(sc)
+
+    return counting_jit(fn, label="paged_scatter_int8")
+
+
 class SessionEvicted(ServerBusy):
     """This session's server-side state slot was reclaimed (idle TTL,
     LRU pressure under the byte budget, or an injected fault) — the
@@ -70,10 +170,13 @@ class SessionEvicted(ServerBusy):
 
 
 class _Slot:
-    """One live session's bookkeeping (state lives in the pool)."""
+    """One live session's bookkeeping (state lives in the pool).
+    ``table`` (paged stores only) maps logical page index → physical
+    page, 0 = the null page; ``steps`` doubles as the token count for
+    page math — a decode step appends exactly one token."""
 
     __slots__ = ("sid", "slot", "created", "last_used", "steps",
-                 "in_flight")
+                 "in_flight", "table")
 
     def __init__(self, sid, slot, now):
         self.sid = sid
@@ -82,6 +185,7 @@ class _Slot:
         self.last_used = now
         self.steps = 0
         self.in_flight = False
+        self.table = None
 
 
 class SessionStateStore:
@@ -99,11 +203,19 @@ class SessionStateStore:
         count to fit (default ``MXNET_SERVING_STATE_BUDGET_MB`` MiB)
     ttl_s : float, optional — idle expiry (default
         ``MXNET_SERVING_STATE_TTL_S``); <= 0 disables
+    pageable : sequence of bool, optional — which state rows grow along
+        a leading token axis (``state_row_pageable()``); those are
+        stored as fixed-size pages when ``page_tokens`` > 0
+    page_tokens : int, optional — tokens per KV page (default
+        ``MXNET_SERVING_STATE_PAGE_TOKENS``); 0 = row-slot mode
+    kv_int8 : bool, optional — store fp32 pages as symmetric per-page
+        int8 (default ``MXNET_SERVING_STATE_KV_INT8``)
     label : str, optional — logging/debug tag
     """
 
     def __init__(self, state_shapes, state_dtypes=None, max_sessions=None,
-                 byte_budget=None, ttl_s=None, label=None):
+                 byte_budget=None, ttl_s=None, pageable=None,
+                 page_tokens=None, kv_int8=None, label=None):
         import jax.numpy as jnp
 
         from .. import env as _env
@@ -122,29 +234,115 @@ class SessionStateStore:
         self.bytes_per_session = int(sum(
             int(onp.prod(s or (1,))) * dt.itemsize
             for s, dt in zip(self.state_shapes, self.state_dtypes)))
+
+        # -- page geometry (round 21) ---------------------------------
+        self.page_tokens = int(
+            page_tokens if page_tokens is not None else
+            _env.get_int("MXNET_SERVING_STATE_PAGE_TOKENS", 0))
+        flags = tuple(bool(p) for p in pageable) if pageable else \
+            (False,) * len(self.state_shapes)
+        if len(flags) != len(self.state_shapes):
+            raise MXNetError("pageable length must match state_shapes")
+        self._pageable = flags if self.page_tokens > 0 else \
+            (False,) * len(self.state_shapes)
+        self.paged = any(self._pageable)
+        self.kv_int8 = bool(
+            kv_int8 if kv_int8 is not None else
+            _env.get_bool("MXNET_SERVING_STATE_KV_INT8", False)) \
+            and self.paged
+        if self.paged:
+            seqs = {self.state_shapes[i][0] if self.state_shapes[i]
+                    else 0 for i, p in enumerate(self._pageable) if p}
+            if len(seqs) != 1:
+                raise MXNetError(
+                    "pageable state rows must share one leading token "
+                    f"axis; got lengths {sorted(seqs)}")
+            self._seq = seqs.pop()
+            if self._seq <= 0 or self._seq % self.page_tokens:
+                raise MXNetError(
+                    f"pageable token axis {self._seq} must be a "
+                    f"positive multiple of page_tokens "
+                    f"{self.page_tokens}")
+            self._ppr = self._seq // self.page_tokens  # pages per row
+        else:
+            self._seq = 0
+            self._ppr = 0
+        # int8 page storage only applies to float32 pageable rows
+        self._int8 = tuple(
+            self.kv_int8 and p and dt == onp.dtype("float32")
+            for p, dt in zip(self._pageable, self.state_dtypes))
+
+        #: bytes one physical page costs across every pageable pool
+        #: (int8 pages carry one fp32 scale each)
+        self._page_bytes = int(sum(
+            self.page_tokens * int(onp.prod(s[1:] or (1,)))
+            * (1 if i8 else dt.itemsize) + (4 if i8 else 0)
+            for s, dt, p, i8 in zip(self.state_shapes, self.state_dtypes,
+                                    self._pageable, self._int8) if p))
+        #: bytes one slot costs in the non-pageable pools
+        self._slot_bytes = int(sum(
+            int(onp.prod(s or (1,))) * dt.itemsize
+            for s, dt, p in zip(self.state_shapes, self.state_dtypes,
+                                self._pageable) if not p))
+
         slots = int(max_sessions if max_sessions is not None else
                     _env.get_int("MXNET_SERVING_STATE_SLOTS", 64))
         budget = int(byte_budget if byte_budget is not None else
                      _env.get_int("MXNET_SERVING_STATE_BUDGET_MB", 64)
                      * 1024 * 1024)
         if budget > 0:
-            slots = min(slots, max(budget // self.bytes_per_session, 1))
+            if self.paged:
+                # a live stream costs its slot rows + at least one page
+                slots = min(slots, max(
+                    budget // max(self._slot_bytes + self._page_bytes, 1),
+                    1))
+            else:
+                slots = min(slots, max(budget // self.bytes_per_session,
+                                       1))
         self.num_slots = max(slots, 1)
+        if self.paged:
+            pages = ((budget - self.num_slots * self._slot_bytes)
+                     // max(self._page_bytes, 1)) if budget > 0 else \
+                self.num_slots * self._ppr
+            self.num_pages = max(min(int(pages),
+                                     self.num_slots * self._ppr), 1)
+        else:
+            self.num_pages = 0
         self.ttl_s = float(ttl_s if ttl_s is not None else
                            _env.get_float("MXNET_SERVING_STATE_TTL_S",
                                           600.0))
-        # the pool: ONE preallocated device array per state tensor —
-        # gather/scatter are XLA ops over it, never per-session uploads
-        self._pools = [jnp.zeros((self.num_slots,) + s, dtype=str(dt))
-                       for s, dt in zip(self.state_shapes,
-                                        self.state_dtypes)]
+        # the pools: ONE preallocated device array per state tensor —
+        # gather/scatter are XLA ops over it, never per-session
+        # uploads. Pageable tensors are page-indexed (physical page 0
+        # = the reserved null page, kept all-zeros); the rest are
+        # slot-indexed as before.
+        self._pools = []
+        self._scales = []
+        for i, (s, dt) in enumerate(zip(self.state_shapes,
+                                        self.state_dtypes)):
+            if self._pageable[i]:
+                pdt = "int8" if self._int8[i] else str(dt)
+                self._pools.append(jnp.zeros(
+                    (self.num_pages + 1, self.page_tokens) + s[1:],
+                    dtype=pdt))
+                self._scales.append(
+                    jnp.zeros((self.num_pages + 1,), dtype="float32")
+                    if self._int8[i] else None)
+            else:
+                self._pools.append(jnp.zeros((self.num_slots,) + s,
+                                             dtype=str(dt)))
+                self._scales.append(None)
         self._lock = threading.RLock()
         self._slots = OrderedDict()  # sid -> _Slot, LRU order
         self._free = list(range(self.num_slots - 1, -1, -1))
+        # physical pages 1..num_pages (0 is the null page)
+        self._free_pages = list(range(self.num_pages, 0, -1))
         self._evicted = OrderedDict()  # sid -> reason (tombstones)
         self.steps_total = 0
         self._occupancy_token = METRICS.register_occupancy_probe(
             lambda: len(self._slots))
+        self._page_token = METRICS.register_page_probe(
+            self._page_probe) if self.paged else None
 
     # -- introspection -------------------------------------------------
 
@@ -164,21 +362,52 @@ class SessionStateStore:
     def stats(self):
         """Flat description for /healthz and admission probes."""
         with self._lock:
-            return {"sessions": len(self._slots),
-                    "slots": self.num_slots,
-                    "bytes_per_session": self.bytes_per_session,
-                    "ttl_s": self.ttl_s,
-                    "steps_total": self.steps_total}
+            st = {"sessions": len(self._slots),
+                  "slots": self.num_slots,
+                  "bytes_per_session": self.bytes_per_session,
+                  "ttl_s": self.ttl_s,
+                  "steps_total": self.steps_total}
+            if self.paged:
+                st.update({
+                    "page_tokens": self.page_tokens,
+                    "pages_total": self.num_pages,
+                    "pages_free": len(self._free_pages),
+                    "pages_used": self.num_pages - len(self._free_pages),
+                    "page_bytes": self._page_bytes,
+                    "kv_int8": self.kv_int8})
+            return st
+
+    def page_headroom(self):
+        """Free fraction of the KV page pool, 0..1 (``None`` in
+        row-slot mode) — admission folds it like slot headroom."""
+        if not self.paged:
+            return None
+        with self._lock:
+            return len(self._free_pages) / max(self.num_pages, 1)
+
+    def _page_probe(self):
+        """Page-pool gauge sample for the metrics registry."""
+        with self._lock:
+            used = self.num_pages - len(self._free_pages)
+            per = [int(onp.count_nonzero(r.table))
+                   for r in self._slots.values() if r.table is not None]
+        return {"pages_total": self.num_pages, "pages_used": used,
+                "pages_per_session": per,
+                "kv_bytes": used * self._page_bytes}
 
     # -- lifecycle -----------------------------------------------------
 
-    def open(self, sid, init_states=None, _resumed=False):
+    def open(self, sid, init_states=None, _resumed=False, tokens=None):
         """Allocate (or return) the state slot for ``sid``. A fresh
         slot starts at zeros unless ``init_states`` (per-state ROW
-        arrays) seeds it. Reclaims TTL-expired then LRU slots when
-        full; raises :class:`ServerBusy` only when every slot is
-        pinned by an in-flight step batch. Idempotent for an already
-        open session (``init_states`` then rewrites its state)."""
+        arrays, always DENSE regardless of page geometry) seeds it.
+        ``tokens`` bounds how many leading positions of pageable rows
+        are live (restore passes the session's step count); ``None``
+        materializes every page — safe, never lossy. Reclaims
+        TTL-expired then LRU slots when full; raises
+        :class:`ServerBusy` only when every slot (or page) is pinned
+        by an in-flight step batch. Idempotent for an already open
+        session (``init_states`` then rewrites its state)."""
         import jax.numpy as jnp
 
         sid = str(sid)
@@ -192,28 +421,60 @@ class SessionStateStore:
                         f"no free session-state slot ({self.num_slots} "
                         "slots, all in flight); retry later")
                 rec = _Slot(sid, self._free.pop(), time.monotonic())
+                if self.paged:
+                    rec.table = onp.zeros(self._ppr, dtype=onp.int32)
                 self._slots[sid] = rec
                 self._evicted.pop(sid, None)
                 # a reused slot still holds the previous tenant's
                 # state: reset it (zeros) or seed it before anyone
-                # gathers
+                # gathers (pageable rows need nothing — a fresh table
+                # is all null pages, which gather as zeros)
                 if init_states is None:
                     for i, pool in enumerate(self._pools):
-                        self._pools[i] = pool.at[rec.slot].set(0)
+                        if not self._pageable[i]:
+                            self._pools[i] = pool.at[rec.slot].set(0)
             if init_states is not None:
                 if len(init_states) != len(self._pools):
                     raise MXNetError(
                         f"expected {len(self._pools)} state tensor(s), "
                         f"got {len(init_states)}")
-                for i, (pool, s) in enumerate(zip(self._pools,
-                                                  init_states)):
-                    row = jnp.asarray(onp.asarray(
-                        s, dtype=self.state_dtypes[i]))
+                rows = []
+                for i, s in enumerate(init_states):
+                    row = onp.asarray(s, dtype=self.state_dtypes[i])
                     if tuple(row.shape) != self.state_shapes[i]:
                         raise MXNetError(
                             f"state {i} row shape {tuple(row.shape)} "
                             f"!= expected {self.state_shapes[i]}")
-                    self._pools[i] = pool.at[rec.slot].set(row)
+                    rows.append(row)
+                if self.paged:
+                    t = self._seq if tokens is None else \
+                        max(0, min(int(tokens), self._seq))
+                    npages = -(-t // self.page_tokens) if t else 0
+                    self._release_pages_locked(rec)
+                    self._alloc_pages_locked(rec, npages)
+                for i, row in enumerate(rows):
+                    if self._pageable[i]:
+                        if npages:
+                            rr = row.reshape(
+                                (self._ppr, self.page_tokens)
+                                + self.state_shapes[i][1:])
+                            dest = jnp.asarray(
+                                rec.table[:npages].copy())
+                            pages = jnp.asarray(rr[:npages])
+                            if self._int8[i]:
+                                from ..analysis.quantize import \
+                                    quantize_kv_page
+                                q, sc = quantize_kv_page(pages)
+                                self._pools[i] = \
+                                    self._pools[i].at[dest].set(q)
+                                self._scales[i] = \
+                                    self._scales[i].at[dest].set(sc)
+                            else:
+                                self._pools[i] = \
+                                    self._pools[i].at[dest].set(pages)
+                    else:
+                        self._pools[i] = self._pools[i].at[
+                            rec.slot].set(jnp.asarray(row))
             if _resumed:
                 METRICS.bump("resumed_sessions")
             return rec.slot
@@ -251,9 +512,80 @@ class SessionStateStore:
                 self._evict_locked(sid, "LRU pressure (pool full)")
                 return
 
+    def _reclaim_pages_locked(self, needed, exclude=None):
+        """Refill ``_free_pages`` to ``needed``: TTL-expired sessions
+        first, then whole LRU sessions — page reclamation NEVER splits
+        a victim (evicting one session frees all of its pages and
+        touches nobody else, the blast-radius contract). In-flight
+        sessions and ``exclude`` (the allocating session itself) are
+        never victims."""
+        now = time.monotonic()
+        if self.ttl_s > 0:
+            for sid in [s for s, r in self._slots.items()
+                        if not r.in_flight and s != exclude and
+                        now - r.last_used > self.ttl_s]:
+                self._evict_locked(sid, "idle TTL expired")
+        while len(self._free_pages) < needed:
+            victim = next(
+                (s for s, r in self._slots.items()
+                 if not r.in_flight and s != exclude), None)
+            if victim is None:
+                return
+            self._evict_locked(victim, "LRU page pressure (pool full)")
+
+    def _release_pages_locked(self, rec):
+        """Return every physical page in ``rec``'s table to the free
+        list (content is zeroed lazily at the next allocation)."""
+        if rec.table is None:
+            return
+        for p in rec.table:
+            if p:
+                self._free_pages.append(int(p))
+        rec.table[:] = 0
+
+    def _alloc_pages_locked(self, rec, npages):
+        """Back logical pages ``0..npages-1`` of ``rec`` with physical
+        pages, reclaiming (TTL → whole LRU sessions) on exhaustion;
+        raises :class:`ServerBusy` when the pool genuinely cannot
+        supply them. Fresh pages are zeroed in every pageable pool —
+        a recycled page must never leak the previous tenant's KV."""
+        missing = [j for j in range(npages) if not rec.table[j]]
+        if not missing:
+            return
+        from ..telemetry import tracer as _telem
+
+        with _telem.span("serving.page_alloc", cat="serving",
+                         sid=rec.sid, pages=len(missing)):
+            with self._lock:
+                if len(self._free_pages) < len(missing):
+                    self._reclaim_pages_locked(len(missing),
+                                               exclude=rec.sid)
+                if len(self._free_pages) < len(missing):
+                    raise ServerBusy(
+                        f"no free KV pages ({self.num_pages} pages, "
+                        f"{len(self._free_pages)} free, "
+                        f"{len(missing)} needed; every other stream "
+                        "is in flight); retry later")
+                got = [self._free_pages.pop() for _ in missing]
+                for j, p in zip(missing, got):
+                    rec.table[j] = p
+                dest = None
+                for i, pool in enumerate(self._pools):
+                    if not self._pageable[i]:
+                        continue
+                    import jax.numpy as jnp
+
+                    if dest is None:
+                        dest = jnp.asarray(onp.asarray(got, onp.int32))
+                    self._pools[i] = pool.at[dest].set(0)
+                    if self._scales[i] is not None:
+                        self._scales[i] = \
+                            self._scales[i].at[dest].set(0.0)
+
     def _evict_locked(self, sid, reason):
         rec = self._slots.pop(sid)
         self._free.append(rec.slot)
+        self._release_pages_locked(rec)
         self._evicted[sid] = reason
         while len(self._evicted) > _TOMBSTONES:
             self._evicted.popitem(last=False)
@@ -274,7 +606,10 @@ class SessionStateStore:
         an injected fire evicts THIS session and raises
         :class:`SessionEvicted`, so chaos drills hit exactly one
         client. TTL expiry is also enforced here (the lazy half of
-        reclamation). Pair with :meth:`release`."""
+        reclamation), and a paged store ensures the page this step
+        writes into is backed — which may evict an idle LRU session,
+        or raise retryable :class:`ServerBusy` when the page pool is
+        truly pinned. Pair with :meth:`release`."""
         from ..resilience import faults as _faults
         from ..resilience.faults import InjectedFault
 
@@ -307,6 +642,11 @@ class SessionStateStore:
                     f"session {sid!r} state expired after "
                     f"{self.ttl_s:g}s idle; re-open the session and "
                     "retry")
+            if self.paged:
+                # this step appends token ``steps``: back its page
+                pidx = min(rec.steps // self.page_tokens,
+                           self._ppr - 1)
+                self._alloc_pages_locked(rec, pidx + 1)
             rec.in_flight = True
             rec.last_used = now
             self._slots.move_to_end(sid)
@@ -323,27 +663,124 @@ class SessionStateStore:
 
     # -- the device path: gather / scatter -----------------------------
 
+    def _resolve_locked(self, items):
+        """Normalize a gather/scatter membership list — slot records
+        (the batcher's currency) or raw slot indices (tests, the
+        row-slot legacy call shape) — to slot records."""
+        recs = []
+        by_slot = None
+        for it in items:
+            if isinstance(it, _Slot):
+                recs.append(it)
+                continue
+            if by_slot is None:
+                by_slot = {r.slot: r for r in self._slots.values()}
+            rec = by_slot.get(int(it))
+            if rec is None:
+                raise MXNetError(
+                    f"slot {int(it)} does not belong to a live "
+                    "session")
+            recs.append(rec)
+        return recs
+
     def gather(self, slots):
         """Dense ``(occupancy,) + row_shape`` block per state tensor
-        for the given slot indices — XLA gathers over the pool, so the
-        results are computation outputs (donation-safe into the step
-        executable without laundering)."""
+        for the given slot records (or indices) — XLA gathers over the
+        pool, so the results are computation outputs (donation-safe
+        into the step executable without laundering). Pageable tensors
+        materialize through each session's page table: unallocated
+        entries hit the null page and gather as exact zeros."""
         import jax.numpy as jnp
 
-        idx = jnp.asarray(onp.asarray(slots, onp.int32))
         with self._lock:
+            recs = self._resolve_locked(slots)
             pools = list(self._pools)
-        return [pool[idx] for pool in pools]
+            scales = list(self._scales)
+            idx = jnp.asarray(onp.asarray([r.slot for r in recs],
+                                          onp.int32))
+            tables = jnp.asarray(onp.stack(
+                [r.table for r in recs]).astype(onp.int32)) \
+                if self.paged else None
+        outs = []
+        for i, pool in enumerate(pools):
+            if self._pageable[i]:
+                tail = self.state_shapes[i][1:]
+                if self._int8[i]:
+                    outs.append(_paged_gather_int8_fn(self._seq, tail)(
+                        pool, scales[i], tables))
+                else:
+                    outs.append(_paged_gather_fn(self._seq, tail)(
+                        pool, tables))
+            else:
+                outs.append(pool[idx])
+        return outs
 
     def scatter(self, slots, new_states):
-        """Write a step's output states back into the pool rows."""
-        idx = onp.asarray(slots, onp.int32)
+        """Write a step's output states back into the pool rows. A
+        paged tensor writes back ONLY the page this step appended into
+        (``_cache_append`` is an exact scatter at the step position,
+        so every other page of the step's output is bitwise the page
+        content that was gathered — rewriting it would be a no-op, or
+        worse for int8, a fresh requantization of untouched data)."""
         import jax.numpy as jnp
 
-        jidx = jnp.asarray(idx)
         with self._lock:
+            recs = self._resolve_locked(slots)
+            jidx = jnp.asarray(onp.asarray([r.slot for r in recs],
+                                           onp.int32))
+            if self.paged:
+                pidx = onp.asarray(
+                    [min(r.steps // self.page_tokens, self._ppr - 1)
+                     for r in recs], onp.int32)
+                dest = onp.asarray(
+                    [int(r.table[p]) for r, p in zip(recs, pidx)],
+                    onp.int32)
+                if not dest.all():
+                    raise MXNetError(
+                        "scatter into an unbacked KV page (acquire() "
+                        "must precede the step that appends)")
+                jdest = jnp.asarray(dest)
+                jpidx = jnp.asarray(pidx)
             for i, ns in enumerate(new_states):
-                self._pools[i] = self._pools[i].at[jidx].set(ns)
+                if self._pageable[i]:
+                    ns = jnp.asarray(ns)
+                    tail = self.state_shapes[i][1:]
+                    if self._int8[i]:
+                        self._pools[i], self._scales[i] = \
+                            _paged_scatter_int8_fn(
+                                self._ppr, self.page_tokens, tail)(
+                                self._pools[i], self._scales[i],
+                                ns, jpidx, jdest)
+                        from ..analysis import quantize as _q
+
+                        _q._count("kv_pages_quantized", len(recs))
+                    else:
+                        self._pools[i] = _paged_scatter_fn(
+                            self._ppr, self.page_tokens, tail)(
+                            self._pools[i], ns, jpidx, jdest)
+                else:
+                    self._pools[i] = self._pools[i].at[jidx].set(ns)
+
+    def _dense_rows(self, rec, pools, scales):
+        """Host copies of one session's state rows, densified through
+        its page table (the read/export representation is ALWAYS the
+        dense row, whatever the storage geometry)."""
+        import jax.numpy as jnp
+
+        rows = []
+        for i, pool in enumerate(pools):
+            if self._pageable[i]:
+                t = jnp.asarray(rec.table.astype(onp.int32))
+                pg = pool[t]
+                if self._int8[i]:
+                    from ..analysis.quantize import dequantize_kv_pages
+
+                    pg = dequantize_kv_pages(pg, scales[i][t])
+                rows.append(onp.asarray(pg.reshape(
+                    (self._seq,) + self.state_shapes[i][1:])))
+            else:
+                rows.append(onp.asarray(pool[rec.slot]))
+        return rows
 
     def read(self, sid):
         """Host copies of one session's state rows (tests, export)."""
@@ -351,7 +788,9 @@ class SessionStateStore:
             rec = self._slots.get(sid)
             if rec is None:
                 raise MXNetError(f"unknown session {sid!r}")
-            return [onp.asarray(pool[rec.slot]) for pool in self._pools]
+            pools = list(self._pools)
+            scales = list(self._scales)
+        return self._dense_rows(rec, pools, scales)
 
     # -- checkpoint / migration ----------------------------------------
 
@@ -359,16 +798,18 @@ class SessionStateStore:
         """Host snapshot of every live session — the payload the
         round-12 ``CheckpointManager`` rides (``session_state=``) and
         a canary promote migrates. Pure host primitives, so it pickles
-        under the manifest's content hashes unchanged."""
+        under the manifest's content hashes unchanged. States are
+        DENSE rows whatever the page geometry, so the payload restores
+        across ``PAGE_TOKENS``/int8 flips and into row-slot stores."""
         with self._lock:
             recs = list(self._slots.values())
             pools = list(self._pools)
+            scales = list(self._scales)
         sessions = {}
         for rec in recs:
             sessions[rec.sid] = {
                 "steps": rec.steps,
-                "states": [onp.asarray(pool[rec.slot])
-                           for pool in pools]}
+                "states": self._dense_rows(rec, pools, scales)}
         return {"format": 1,
                 "state_shapes": [list(s) for s in self.state_shapes],
                 "state_dtypes": [str(dt) for dt in self.state_dtypes],
@@ -379,7 +820,10 @@ class SessionStateStore:
         (checkpoint restore, or live migration at canary promote).
         Returns the number of sessions resumed; each bumps the
         ``resumed_sessions`` counter. A shape/dtype mismatch raises —
-        resuming garbage into the pool would serve silent corruption."""
+        resuming garbage into the pool would serve silent corruption.
+        The session's step count bounds page materialization in a
+        paged store (a decode step is one token), so short streams
+        resume into few pages."""
         if payload is None:
             return 0
         shapes = tuple(tuple(s) for s in payload.get("state_shapes", ()))
@@ -398,18 +842,58 @@ class SessionStateStore:
                         "slots; %s (and later sessions) not resumed",
                         sid)
                     break
-                self.open(sid, init_states=ent["states"], _resumed=True)
+                try:
+                    self.open(sid, init_states=ent["states"],
+                              _resumed=True,
+                              tokens=ent.get("steps"))
+                except ServerBusy:
+                    logging.warning(
+                        "serving: session-state restore ran out of KV "
+                        "pages; %s (and later sessions) not resumed",
+                        sid)
+                    break
                 self._slots[sid].steps = int(ent.get("steps", 0))
             restored += 1
         return restored
 
     def close(self):
-        """Unregister the occupancy probe (the pool itself is freed by
-        refcount)."""
+        """Unregister the metrics probes (the pools themselves are
+        freed by refcount)."""
         METRICS.unregister_occupancy_probe(self._occupancy_token)
+        if self._page_token is not None:
+            METRICS.unregister_page_probe(self._page_token)
 
     def __repr__(self):
+        paged = (f", page_tokens={self.page_tokens}, "
+                 f"pages={self.num_pages}"
+                 + (", kv_int8" if self.kv_int8 else "")) \
+            if self.paged else ""
         return (f"SessionStateStore(slots={self.num_slots}, "
                 f"live={self.occupancy}, "
                 f"bytes_per_session={self.bytes_per_session}, "
-                f"ttl_s={self.ttl_s:g})")
+                f"ttl_s={self.ttl_s:g}{paged})")
+
+
+# -- artifact-layer salt provider -------------------------------------------
+
+def fingerprint_salt(ctx):
+    """Compile-cache salt for decode-step executables served out of a
+    PAGED state store: page geometry and int8-KV storage are serving-
+    tier knobs that must re-key bundled step artifacts (a fleet
+    replica resolving a bundle compiled under different KV plumbing
+    must miss, not collide). Row-slot sessions — and every stateless
+    artifact — contribute nothing, which keeps all pre-existing cache
+    keys stable."""
+    if not ctx.get("paged"):
+        return ()
+    return ("paged_state", int(ctx.get("page_tokens", 0)),
+            bool(ctx.get("kv_int8", False)))
+
+
+def _salt_provider(ctx):
+    return fingerprint_salt(ctx)
+
+
+from ..artifact import salts as _artifact_salts  # noqa: E402
+
+_artifact_salts.register_salt_provider("paged_state", _salt_provider)

@@ -119,7 +119,10 @@ class _TLState(threading.local):
         self.stack = []  # open span ids (lexical nesting)
         self.trace = []  # trace-id stack (trace_context scopes)
         self.tid = ident = threading.get_ident() % 100000
-        _THREADS.setdefault(ident, threading.current_thread().name)
+        # assignment, not setdefault: the OS reuses idents of exited
+        # threads, and the stale owner's name must not shadow the
+        # thread currently holding the ident
+        _THREADS[ident] = threading.current_thread().name
 
 
 _TLS = _TLState()

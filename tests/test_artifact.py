@@ -197,6 +197,84 @@ def test_remote_publish_disabled_by_knob(monkeypatch, tmp_path):
     assert not os.path.exists(str(tmp_path / "shared" / "aa.mxc"))
 
 
+def test_remote_file_gc_prunes_oldest(monkeypatch, tmp_path):
+    """A size-bounded file:// store sheds oldest-used entries down to
+    80% of MXNET_ARTIFACT_REMOTE_MAX_MB on publish — same contract as
+    the local tier's _maybe_prune; the fresh publish survives."""
+    shared = str(tmp_path / "shared")
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", "file://" + shared)
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE_MAX_MB", "1")
+    monkeypatch.setattr(art_remote, "_GC_EVERY", 1)
+    os.makedirs(shared)
+    # an overgrown fleet store: ~1.5 MB of stale artifacts, distinct
+    # mtimes so eviction order is deterministic
+    for i in range(12):
+        p = os.path.join(shared, f"stale{i:02d}.mxc")
+        with open(p, "wb") as f:
+            f.write(b"x" * (128 * 1024))
+        os.utime(p, (1000 + i, 1000 + i))
+    assert art_remote.publish("freshfp", b"y" * 1024)
+    files = [f for f in os.listdir(shared) if f.endswith(".mxc")]
+    total = sum(os.path.getsize(os.path.join(shared, f)) for f in files)
+    assert total <= 0.8 * 1024 * 1024, (total, files)
+    assert "freshfp.mxc" in files          # never the entry just pushed
+    assert "stale00.mxc" not in files      # oldest went first
+    assert "stale11.mxc" in files          # newest stale survived
+    st = artifact.artifact_stats()
+    assert st["gc_runs"] == 1 and st["gc_evicted"] >= 6
+    assert st["gc_bytes"] >= 6 * 128 * 1024
+
+
+def test_remote_file_gc_survives_concurrent_pruner(monkeypatch,
+                                                   tmp_path):
+    """Two replicas publishing into one shared dir GC concurrently —
+    entries the other pruner already removed vanish between
+    scandir/stat and stat/remove. The sweep tolerates every per-entry
+    race and still bounds what remains."""
+    import contextlib
+
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE_MAX_MB", "1")
+    monkeypatch.setattr(art_remote, "_GC_EVERY", 1)
+    d = str(tmp_path)
+    for i in range(12):
+        p = os.path.join(d, f"stale{i:02d}.mxc")
+        with open(p, "wb") as f:
+            f.write(b"x" * (256 * 1024))
+        os.utime(p, (1000 + i, 1000 + i))
+
+    real_scandir = os.scandir
+    vanish = {"stale00.mxc": "pre-stat", "stale01.mxc": "pre-stat",
+              "stale02.mxc": "pre-remove"}
+
+    class _RacyEntry:
+        def __init__(self, e, race):
+            self._e, self._race = e, race
+            self.name, self.path = e.name, e.path
+
+        def stat(self):
+            if self._race == "pre-stat":
+                os.remove(self.path)
+                raise FileNotFoundError(self.path)
+            st = self._e.stat()
+            if self._race == "pre-remove":
+                os.remove(self.path)
+            return st
+
+    @contextlib.contextmanager
+    def racy_scandir(path):
+        with real_scandir(path) as it:
+            yield (_RacyEntry(e, vanish.get(e.name)) for e in it)
+
+    monkeypatch.setattr(art_remote.os, "scandir", racy_scandir)
+    art_remote._maybe_gc_file(d)  # must not raise
+    monkeypatch.setattr(art_remote.os, "scandir", real_scandir)
+    st = artifact.artifact_stats()
+    assert st["gc_runs"] == 1 and st["gc_evicted"] > 0
+    left = [f for f in os.listdir(d) if f.endswith(".mxc")]
+    total = sum(os.path.getsize(os.path.join(d, f)) for f in left)
+    assert total <= 1024 * 1024, (total, left)
+
+
 # ---------------------------------------------------------------------------
 # remote tier: HTTP backend + resilience
 
@@ -211,6 +289,26 @@ def test_remote_http_fetch_publish_and_miss(monkeypatch):
         st = artifact.artifact_stats()
         assert st["remote_hits"] == 1
         assert st["publish_bytes"] == len(b"envelope-bytes")
+
+
+def test_artifact_server_evicts_least_recently_fetched(monkeypatch):
+    """The reference server is byte-bounded: a PUT over the cap evicts
+    the least-recently-ACCESSED blob (a GET refreshes recency), never
+    the blob just written; an evicted fingerprint is a clean 404."""
+    with artifact.ArtifactCacheServer(max_bytes=300) as srv:
+        monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", srv.url)
+        assert art_remote.publish("aa", b"a" * 100)
+        assert art_remote.publish("bb", b"b" * 100)
+        assert art_remote.fetch("aa") == b"a" * 100   # aa is now warm
+        assert art_remote.publish("cc", b"c" * 100)   # exactly at cap
+        assert srv.gc_evicted == 0
+        assert art_remote.publish("dd", b"d" * 100)   # over: bb coldest
+        assert set(srv.store) == {"aa", "cc", "dd"}
+        assert srv.gc_evicted == 1 and srv.store_bytes == 300
+        st = artifact.artifact_stats()
+        assert st["gc_runs"] == 1 and st["gc_evicted"] == 1
+        assert st["gc_bytes"] == 100
+        assert art_remote.fetch("bb") is None  # evicted = clean miss
 
 
 def test_remote_http_flaky_host_retries(monkeypatch):

@@ -249,6 +249,29 @@ def test_decode_bench_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_paged_decode_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import decode_bench
+
+    out = str(tmp_path / "paged.json")
+    doc = decode_bench.run_paged(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    # the structural contracts hold at any scale: paged packs >= 3x
+    # the sessions of row-slot at one byte budget (int8 more still),
+    # and the batcher-served streams are bitwise against the
+    # explicit-state unroll. The 0.9x throughput and step-flatness
+    # gates are timing properties only enforced on the committed full
+    # run (BENCH_PAGED_r21.json)
+    assert doc["capacity"]["max_sessions_x"] >= 3.0
+    assert doc["capacity"]["int8_sessions_x"] > \
+        doc["capacity"]["max_sessions_x"]
+    assert doc["throughput"]["bitwise_vs_offline_unroll"]
+    assert doc["results"]["paged_tokens_per_s"] > 0
+    assert doc["results"]["step_flat_ratio"] > 0
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "paged_decode"
+
+
+@pytest.mark.slow
 def test_telemetry_bench_smoke(tmp_path):
     from mxnet_tpu.benchmark import telemetry_bench
 
@@ -321,6 +344,36 @@ def test_bench_compare_decode_metrics():
     assert rows["results.incremental_tokens_per_s"][4]
     assert not rows["results.continuous_tokens_per_s"][4]
     assert "results.decode_steps" not in rows  # not a perf direction
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
+def test_bench_compare_paged_metrics():
+    """BENCH_PAGED_r21.json names: session capacities/ratios and
+    tokens/s are higher-is-better, step_flat_ratio lower-is-better,
+    the byte budget untracked (a config fact, not a speed)."""
+    base = {"capacity": {"byte_budget": 8388608,
+                         "paged_max_sessions": 255,
+                         "max_sessions_x": 8.0},
+            "results": {"paged_tokens_per_s": 900.0,
+                        "paged_vs_rowslot_throughput_x": 0.95,
+                        "step_flat_ratio": 1.02}}
+    worse = {"capacity": {"byte_budget": 8388608,
+                          "paged_max_sessions": 40,
+                          "max_sessions_x": 1.2},
+             "results": {"paged_tokens_per_s": 300.0,
+                         "paged_vs_rowslot_throughput_x": 0.5,
+                         "step_flat_ratio": 3.0}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "capacity.paged_max_sessions") == "higher"
+    assert bench_compare._direction(
+        "results.step_flat_ratio") == "lower"
+    assert rows["capacity.paged_max_sessions"][4]  # packing collapsed
+    assert rows["capacity.max_sessions_x"][4]
+    assert rows["results.paged_tokens_per_s"][4]
+    assert rows["results.paged_vs_rowslot_throughput_x"][4]
+    assert rows["results.step_flat_ratio"][4]  # O(prefix) crept back
+    assert "capacity.byte_budget" not in rows  # not a perf direction
     assert not any(r[4] for r in bench_compare.compare(base, base))
 
 

@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 def test_train_imagenet_rec_example_runs():
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
@@ -62,6 +64,7 @@ def test_device_prefetch_iter_overlap(tmp_path):
     onp.testing.assert_allclose(again[0], X[:4])
 
 
+@pytest.mark.slow  # same example as the _runs test above, +overlap JSON
 def test_train_imagenet_rec_overlap_report(tmp_path):
     import json
     import subprocess

@@ -138,7 +138,9 @@ def test_gluon_switch_moe_layer_trains(tmp_path):
     tr = gluon.Trainer(moe.collect_params(), "adam",
                        {"learning_rate": 5e-3})
     first = None
-    for _ in range(12):
+    # eager mesh dispatch costs ~4s/step on one core; 4 steps are
+    # enough to show the loss moving under the Trainer
+    for _ in range(4):
         with autograd.record():
             o, aux = moe(x)
             loss = nd.mean((x + o - y) ** 2) + 0.01 * aux

@@ -44,7 +44,12 @@ inferred from the leaf name:
   ``*hit_rate*`` (BENCH_FUSION_r17.json model-zoo cluster hit rate —
   the fraction of fusion-pass decision points that formed a cluster;
   a drop means a matcher or the cost model stopped firing on graphs
-  it used to fuse)
+  it used to fuse), ``*sessions*`` (BENCH_PAGED_r21.json KV-cache
+  capacity — max concurrent sessions resident at a fixed byte budget
+  and the paged/row-slot ratios; a drop means paged storage stopped
+  packing short prefixes densely). ``*flat_ratio*`` is lower-is-better
+  (BENCH_PAGED_r21.json late-prefix over early-prefix step cost —
+  growth means decode stopped being O(1) in prefix depth)
 
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
@@ -63,10 +68,11 @@ import sys
 LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
                    "p50", "p95", "p99", "epoch_s", "idle", "stall",
                    "overhead", "shed", "nodes", "trace",
-                   "bytes_moved", "accuracy_delta")
+                   "bytes_moved", "accuracy_delta", "flat_ratio")
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput",
-                    "efficiency", "tokens_per", "hit_rate")
+                    "efficiency", "tokens_per", "hit_rate",
+                    "sessions")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)
