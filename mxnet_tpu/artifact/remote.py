@@ -35,6 +35,7 @@ from __future__ import annotations
 import os
 import threading
 
+from ..utils import locks as _locks
 from ._counters import STATS
 
 __all__ = ["remote_url", "fetch", "publish", "publish_path",
@@ -90,7 +91,8 @@ def _policy():
 
 # one breaker per configured URL: repointing the knob (tests, operator
 # failover) must not inherit the old host's failure streak
-_LOCK = threading.Lock()
+# guards: _STATE
+_LOCK = _locks.RankedLock("artifact.remote.breakers")
 _STATE = {"breaker": None, "url": None}
 
 
@@ -307,7 +309,8 @@ class ArtifactCacheServer:
             else int(max_bytes)
         self.store_bytes = 0
         self.gc_evicted = 0
-        self._store_lock = threading.Lock()
+        # guards: store, store_bytes, gc_evicted
+        self._store_lock = _locks.RankedLock("artifact.server.store")
         self.fail_requests = 0
         self.requests = 0
         outer = self

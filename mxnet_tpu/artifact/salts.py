@@ -37,13 +37,14 @@ quantize     analysis/quantize.py        ``graph_signature`` (nnvm JSON
 from __future__ import annotations
 
 import importlib
-import threading
 
 from ..base import MXNetError
+from ..utils import locks as _locks
 
 __all__ = ["register_salt_provider", "salt_providers", "resolve_salts"]
 
-_LOCK = threading.Lock()
+# guards: _PROVIDERS
+_LOCK = _locks.RankedLock("artifact.salts")
 _PROVIDERS = {}
 
 # lazy built-ins: the provider lives with its subsystem (which registers

@@ -1,0 +1,227 @@
+"""Ranked-lock witness overhead benchmark (round 22).
+
+``utils/locks.py`` claims level 0 (``MXNET_LOCK_CHECK=0``, the
+production default) is ONE env read at construction plus raw
+passthrough — the factories hand back ``threading.Lock``/``RLock``/
+``Condition`` objects, so a converted call site pays nothing at
+acquire time. This bench prices that claim, plus the enabled cost the
+claim is traded against:
+
+**Passthrough overhead.** An uncontended ``with lock: pass``
+micro-loop over a hand-built raw ``threading.Lock`` (the unwrapped
+baseline) vs a ``RankedLock`` constructed at level 0. Both halves use
+adjacent alternating pairs (the telemetry-bench methodology: each half
+is the min of ``reps`` windows, overhead is the MEDIAN of per-pair
+ratios, so CPU-frequency and scheduler drift cancels in the ratio
+instead of billing whichever side ran second). Criterion (full mode):
+``passthrough_overhead_pct < 1``.
+
+**Checked-mode acquire cost.** The same loop against a ``RankedLock``
+constructed under ``warn`` — the held-stack push/pop plus the
+(dedup-hit) order-graph edge probe. Reported as
+``checked_acquire_us`` per acquire/release round trip: the number an
+operator weighs when leaving the witness on outside tests.
+
+**Serving-drain overhead, witness armed.** A warmed ``DynamicBatcher``
+drain (duck-typed echo session, queue sized to swallow the request
+set) with every lock the batcher stack constructs — batcher close
+lock, class-lane condition, metrics lock — built at level 0 vs under
+``warn``. Objects are REBUILT per measurement half (mode binds at
+lock construction), same paired-median discipline. This is the armed
+witness priced on the hottest multi-threaded path in the tree, where
+every request crosses the lane condition twice. Reported as
+``serving_warn_overhead_pct`` (informational: the gate for the
+production default is the passthrough one).
+
+Emits one JSON document (default ``BENCH_LOCKCHECK_r22.json``); also
+prints it. ``*_overhead_pct`` leaves are lower-is-better under
+``tools/bench_compare.py`` (the ``overhead`` name tag).
+
+Usage::
+
+    python -m mxnet_tpu.benchmark.lockcheck_bench [--smoke] [--out FILE]
+
+``--smoke`` shrinks the loops for a CPU tier-1 time budget (structural
+checks only — the sub-percent passthrough gate needs the full loop
+lengths).
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import statistics
+import threading
+import time
+
+import numpy as onp
+
+
+def _paired_overhead(measure_base, measure_test, pairs, reps=1):
+    """Median of per-pair (test / base) ratios over adjacent
+    alternating pairs; each half is the min of ``reps`` windows.
+    Returns (best_base, best_test, overhead_pct)."""
+    best = {"base": float("inf"), "test": float("inf")}
+    ratios = []
+    for i in range(pairs):
+        order = ("test", "base") if i % 2 == 0 else ("base", "test")
+        got = {}
+        for side in order:
+            fn = measure_base if side == "base" else measure_test
+            got[side] = min(fn() for _ in range(reps))
+            best[side] = min(best[side], got[side])
+        ratios.append(got["test"] / got["base"])
+    overhead = (statistics.median(ratios) - 1.0) * 100
+    return best["base"], best["test"], overhead
+
+
+# ---------------------------------------------------------------------------
+# phase 1: uncontended acquire/release micro-loop
+
+def _acquire_loop(lock, n):
+    gc.collect()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with lock:
+            pass
+    return time.perf_counter() - t0
+
+
+def _micro_phase(smoke):
+    from mxnet_tpu.utils import locks
+
+    n = 20_000 if smoke else 200_000
+    pairs = 3 if smoke else 40
+    reps = 1 if smoke else 2
+
+    # the baseline MUST be an unranked stdlib lock — it is the thing the
+    # level-0 factory is priced against
+    raw = threading.Lock()  # graft-lint: allow(L1101)
+    prev = locks.set_check_mode("0")
+    try:
+        level0 = locks.RankedLock("profiler")
+    finally:
+        locks.set_check_mode(prev)
+    assert type(level0) is type(raw), "level 0 must be raw passthrough"
+
+    base_s, test_s, overhead = _paired_overhead(
+        lambda: _acquire_loop(raw, n),
+        lambda: _acquire_loop(level0, n), pairs, reps)
+
+    # enabled cost, same loop: held-stack push/pop + dedup-hit edge
+    # probe per acquire (measured absolute — the ratio against a
+    # ~60ns baseline exaggerates a cost that is small in real terms)
+    prev = locks.set_check_mode("warn")
+    try:
+        checked = locks.RankedLock("profiler")
+    finally:
+        locks.set_check_mode(prev)
+    warm = _acquire_loop(checked, n // 10)  # first-touch thread state
+    del warm
+    checked_s = min(_acquire_loop(checked, n)
+                    for _ in range(2 if smoke else 6))
+
+    return {
+        "acquires": n, "pairs": pairs, "reps_per_half": reps,
+        "raw_acquire_us": round(base_s / n * 1e6, 4),
+        "level0_acquire_us": round(test_s / n * 1e6, 4),
+        "passthrough_overhead_pct": round(overhead, 2),
+        "checked_acquire_us": round(checked_s / n * 1e6, 4),
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 2: serving drain, witness level 0 vs armed (warn)
+
+class _EchoSession:
+    """Duck-typed session: pure-Python echo so the window prices the
+    batcher's lock traffic, not XLA."""
+
+    max_batch = 64
+
+    def validate(self, *inputs):
+        arr = onp.asarray(inputs[0], dtype="float32")
+        return [arr], arr.shape[0]
+
+    def predict(self, x):
+        return x * 2.0
+
+
+def _serving_phase(smoke):
+    from mxnet_tpu import serving
+    from mxnet_tpu.utils import locks
+
+    n_requests = 64 if smoke else 512
+    pairs = 2 if smoke else 12
+    reps = 1 if smoke else 2
+    xs = [onp.full((1, 2), float(i), dtype="float32")
+          for i in range(n_requests)]
+
+    def drain(mode):
+        # the mode binds at lock CONSTRUCTION: rebuild the whole
+        # batcher stack (close lock, lane condition, metrics lock)
+        # inside the measured half's mode
+        prev = locks.set_check_mode(mode)
+        try:
+            bat = serving.DynamicBatcher(
+                _EchoSession(), max_batch_size=64, max_latency_ms=1.0,
+                max_queue=n_requests, num_workers=1,
+                timeout_ms=300_000)
+        finally:
+            locks.set_check_mode(prev)
+        try:
+            # untimed warm burst: worker start + first-batch paths
+            for f in [bat.submit(x, block=True) for x in xs[:16]]:
+                f.result(timeout=60)
+            gc.collect()
+            t0 = time.perf_counter()
+            futs = [bat.submit(x, block=True) for x in xs]
+            for f in futs:
+                f.result(timeout=60)
+            return time.perf_counter() - t0
+        finally:
+            bat.close()
+
+    base_s, test_s, overhead = _paired_overhead(
+        lambda: drain("0"), lambda: drain("warn"), pairs, reps)
+    return {
+        "requests": n_requests, "pairs": pairs, "reps_per_half": reps,
+        "level0_drain_ms": round(base_s * 1e3, 3),
+        "warn_drain_ms": round(test_s * 1e3, 3),
+        "serving_warn_overhead_pct": round(overhead, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def run(smoke=False):
+    doc = {
+        "bench": "lockcheck_r22",
+        "smoke": bool(smoke),
+        "uncontended_acquire": _micro_phase(smoke),
+        "serving_drain": _serving_phase(smoke),
+    }
+    if not smoke:
+        pct = doc["uncontended_acquire"]["passthrough_overhead_pct"]
+        assert pct < 1.0, (
+            f"level-0 passthrough overhead {pct}% >= 1% — the factory "
+            "stopped being a raw passthrough")
+    return doc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="shrunk loops for the tier-1 time budget")
+    ap.add_argument("--out", default="BENCH_LOCKCHECK_r22.json")
+    args = ap.parse_args(argv)
+    doc = run(smoke=args.smoke)
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    print(text)
+    with open(args.out, "w") as fh:
+        fh.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

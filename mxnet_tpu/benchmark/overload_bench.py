@@ -150,7 +150,9 @@ class _OpenLoop:
         self.n_clients = n_clients
         self._ramp_until = 0.0  # set by run()
         self.ramp_ok = 0
-        self.lock = threading.Lock()
+        # deliberately unranked: bench-harness aggregation lock,
+        # outside the production lock order by design
+        self.lock = threading.Lock()  # graft-lint: allow(L1101)
         self.lat = {}       # class -> [post-ramp ok latency s]
         self.late = {}      # class -> requests finished past deadline
         self.shed_us = []   # ShedLoad decision times

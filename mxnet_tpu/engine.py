@@ -23,6 +23,7 @@ import threading
 # and the disarmed fault seam must cost one global read, not an import
 # lookup per call (resilience.faults has no imports back into engine)
 from .resilience import faults as _faults
+from .utils import locks as _locks
 
 __all__ = ["Engine", "NaiveEngine", "get", "var", "push", "wait_for_var",
            "wait_all", "LANE_COMPUTE", "LANE_IO"]
@@ -61,14 +62,15 @@ class Engine:
         nthreads = nthreads or _env.get_int(
             "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4)
         nlanes = nlanes or _env.get_int("MXNET_ENGINE_NUM_LANES", 2)
-        self._lock = threading.Lock()
+        # guards: _active, _var_poison, _exceptions, _live_cbs, _h
+        self._lock = _locks.RankedLock("engine.waiters")
         # close() coordination: _active counts threads inside a native
         # call on the handle (close must not destroy it under them);
         # _drained flips once close() has fully drained + destroyed, so
         # post-close callers can order themselves after every pre-close
         # op (a wait_for_var racing close() must NOT return before the
         # op writing its slot ran — that silently loses the write)
-        self._cond = threading.Condition(self._lock)
+        self._cond = _locks.RankedCondition(lock=self._lock)
         self._active = 0
         self._drained = threading.Event()
         self._var_poison = {}  # var id -> exception, frozen at close()
@@ -109,9 +111,13 @@ class Engine:
         # registered fault point: a failed host-task schedule (raises
         # synchronously in the pusher, like a dead worker pool)
         _faults.maybe_fail("engine_push")
-        if self._h is None:  # closed (atexit shutdown): run inline,
-            # but only after the drain — an in-flight pre-close op may
-            # write the same vars this fn depends on
+        # deliberate unlocked read: close() only transitions _h to None
+        # once, at atexit, and a push that loses the race blocks on the
+        # drain event below — locking here would tax every op push
+        if self._h is None:  # graft-lint: allow(L1102)
+            # closed (atexit shutdown): run inline, but only after the
+            # drain — an in-flight pre-close op may write the same
+            # vars this fn depends on
             self._drained.wait()
             fn()
             return -1
@@ -174,7 +180,9 @@ class Engine:
         h = self._reserve()
         if h is None:
             self._drained.wait()
-            exc = self._var_poison.get(v.id)
+            # post-drain read: the worker pool has quiesced, nothing
+            # writes poison any more
+            exc = self._var_poison.get(v.id)  # graft-lint: allow(L1102)
             if exc is not None:
                 raise exc
             return
@@ -300,7 +308,10 @@ class Engine:
 
     def __del__(self):
         try:
-            self.close()
+            # finalizers interleave arbitrarily; this instance is
+            # unreachable so its locks cannot be held elsewhere
+            with _locks.exempt("gc finalizer on unreachable engine"):
+                self.close()
         except Exception:  # graft-lint: allow(L501)
             pass
 
@@ -360,7 +371,8 @@ class NaiveEngine:
 
 
 _engine = None
-_engine_lock = threading.Lock()
+# guards: _engine
+_engine_lock = _locks.RankedLock("engine.singleton")
 
 
 def get():

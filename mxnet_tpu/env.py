@@ -30,6 +30,12 @@ KNOBS = {
     "MXNET_CPU_MEM_POOL_DISABLE": (
         "wired", "storage", "disable the pooled host allocator"),
     "MXNET_HOME": ("wired", "model_store/base", "cache directory"),
+    "MXNET_LOCK_CHECK": (
+        "wired", "utils.locks",
+        "ranked-lock witness: 0 (off, raw passthrough) / warn (count "
+        "out-of-rank and cycle violations) / error (raise "
+        "LockOrderError at the violating acquire); read once at lock "
+        "construction"),
     "MXNET_GLUON_REPO": (
         "wired", "model_store", "pretrained-weight repo URL"),
     "MXNET_SEED": (

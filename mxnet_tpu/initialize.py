@@ -57,10 +57,10 @@ def _reinit_child():
     # fork-safe). Plain assignment is atomic enough for one thread;
     # the lock itself is replaced too, else the child's first
     # engine.get() would block on the orphaned held lock.
-    import threading
+    from .utils import locks as _locks
 
     _engine._engine = None
-    _engine._engine_lock = threading.Lock()
+    _engine._engine_lock = _locks.RankedLock("engine.singleton")
     # the native pool's mutex/freelist were COW-snapshotted mid-flight;
     # the child must not touch the parent's pool
     _storage._storage = None

@@ -761,8 +761,11 @@ def save(fname, data):
             if e is eng:  # vars from a replaced engine mean nothing here
                 v = cand
                 break
-        if v is None:
-            v = eng.new_variable()
+    if v is None:
+        # outside the pool lock: allocating a var is a native engine
+        # call (takes the rank-0 engine lock), and the pool lock is a
+        # leaf — the lock-order witness flags engine-under-leaf
+        v = eng.new_variable()
     eng.push(lambda: _write_ref_params(fname, names, arrays),
              mutable_vars=(v,), lane=engine.LANE_IO)
     eng.wait_for_var(v)  # a failure leaves the poisoned var un-pooled
@@ -770,9 +773,10 @@ def save(fname, data):
         _SAVE_POOL.append((eng, v))
 
 
-import threading as _threading  # noqa: E402
+from ..utils import locks as _locks  # noqa: E402
 
-_SAVE_POOL_LOCK = _threading.Lock()
+# guards: _SAVE_POOL
+_SAVE_POOL_LOCK = _locks.RankedLock("ndarray.save_pool")
 _SAVE_POOL = []
 
 

@@ -316,7 +316,13 @@ class DeviceFeed:
 
     def __del__(self):
         try:
-            self.close()
+            from ..utils import locks as _locks
+
+            # finalizers interleave arbitrarily; the witness must not
+            # attribute the engine waits in close() to whatever locks
+            # the interrupted thread happened to hold
+            with _locks.exempt("gc finalizer on unreachable feed"):
+                self.close()
         except Exception:  # graft-lint: allow(L501)
             pass
 

@@ -15,12 +15,15 @@ import threading
 import time
 from collections import defaultdict
 
+from .utils import locks as _locks
+
 _config = {"filename": "profile.json", "profile_all": False,
            "profile_symbolic": False, "profile_imperative": False,
            "profile_memory": False, "profile_api": False,
            "aggregate_stats": False, "continuous_dump": False}
 _state = {"running": False, "jax_trace": False}
-_lock = threading.Lock()
+# guards: _agg, _events
+_lock = _locks.RankedLock("profiler")
 _agg = defaultdict(lambda: {"count": 0, "total": 0.0, "min": float("inf"),
                             "max": 0.0})
 _events = []  # chrome-trace event dicts
@@ -197,6 +200,16 @@ def quantize_counters():
     offline weight bytes saved), live from mxnet_tpu.analysis.quantize.
     Zeros before the first ``quantize_symbol``/``quantize_model``."""
     return _family("quantize")
+
+
+def lock_check_counters():
+    """Ranked-lock witness counters (out-of-rank acquires, lock-order
+    cycles, order-graph edges, self-deadlocks, dropped violation
+    records), live from mxnet_tpu.utils.locks. All-zero when
+    ``MXNET_LOCK_CHECK`` is off or nothing fired."""
+    from .utils import locks as _locks
+
+    return _locks.lock_check_counters()
 
 
 def sharding_counters():

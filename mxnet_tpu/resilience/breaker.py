@@ -18,10 +18,10 @@ both states so a load balancer / operator sees the degradation.
 """
 from __future__ import annotations
 
-import threading
 import time
 
 from ..base import MXNetError
+from ..utils import locks as _locks
 
 __all__ = ["CircuitBreaker", "CircuitOpen"]
 
@@ -53,7 +53,8 @@ class CircuitBreaker:
             _env.get_float("MXNET_BREAKER_COOLDOWN_MS", 30000.0)) / 1e3
         self.name = name
         self._clock = clock if clock is not None else time.monotonic
-        self._lock = threading.Lock()
+        # guards: _failures, _opened_at, _probing
+        self._lock = _locks.RankedLock("resilience.breaker")
         self._failures = 0      # consecutive, while closed/half-open
         self._opened_at = None  # monotonic stamp, while open
         self._probing = False   # one half-open probe in flight

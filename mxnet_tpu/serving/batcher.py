@@ -65,6 +65,7 @@ from concurrent.futures import Future
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..utils import locks as _locks
 from ..telemetry import tracer as _telem
 from .metrics import METRICS, SLO_CLASSES
 
@@ -128,9 +129,10 @@ class _ClassQueues:
         self._order = {c: i for i, c in enumerate(classes)}
         self._lanes = [deque() for _ in classes]
         self._ctrl = deque()
-        self._cond = threading.Condition()
+        # guards: _lanes, _ctrl
+        self._cond = _locks.RankedCondition("batcher.queue")
 
-    def _lane(self, item):
+    def _lane_locked(self, item):
         cls = getattr(item, "slo_class", "standard")
         return self._lanes[self._order.get(cls, 1)]
 
@@ -142,7 +144,7 @@ class _ClassQueues:
                 self._ctrl.append(item)
                 self._cond.notify_all()
                 return
-            lane = self._lane(item)
+            lane = self._lane_locked(item)
             deadline = None if timeout is None else \
                 time.monotonic() + timeout
             while len(lane) >= self.maxsize:
@@ -194,7 +196,9 @@ class _ClassQueues:
                     for c, i in self._order.items()}
 
     def capacity(self):
-        return self.maxsize * len(self._lanes)
+        # lane list is built once in __init__ and never reassigned;
+        # len() of it needs no lock
+        return self.maxsize * len(self._lanes)  # graft-lint: allow(L1102)
 
 
 class DynamicBatcher:
@@ -250,7 +254,8 @@ class DynamicBatcher:
         depth = int(max_queue or _env.get_int(
             "MXNET_SERVING_QUEUE_DEPTH", 256))
         self._queue = _ClassQueues(depth)
-        self._lock = threading.Lock()
+        # guards: _closed
+        self._lock = _locks.RankedLock("batcher")
         self._closed = False
         self._pass_through = not serving_enabled()
         self._admission = None
@@ -898,6 +903,11 @@ class DynamicBatcher:
 
     def __del__(self):
         try:
-            self.close()
+            # the GC runs this at an arbitrary allocation point, under
+            # whatever locks the interrupted thread holds — but this
+            # instance is unreachable, so no live thread can hold its
+            # locks; the inverted-looking order is witness-exempt
+            with _locks.exempt("gc finalizer on unreachable batcher"):
+                self.close()
         except Exception:  # graft-lint: allow(L501)
             pass

@@ -51,6 +51,8 @@ import threading
 import time
 from collections import deque
 
+from ..utils import locks as _locks
+
 __all__ = ["LatencyHistogram", "RollingHistogram", "ServingMetrics",
            "METRICS", "SLO_CLASSES", "serving_stats",
            "reset_serving_counters", "prometheus_text"]
@@ -191,7 +193,8 @@ class ServingMetrics:
     path)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # guards: _depth_probes, _headroom_probes, _occupancy_probes, _page_probes
+        self._lock = _locks.RankedLock("serving.metrics")
         self._reset_locked()
         self._depth_probes = {}  # token -> callable() -> int
         self._headroom_probes = {}  # token -> callable() -> float

@@ -61,6 +61,7 @@ import time
 
 from ..base import MXNetError
 from ..resilience import faults as _faults
+from ..utils import locks as _locks
 from ..resilience.breaker import CircuitBreaker
 from .batcher import DynamicBatcher
 from .metrics import METRICS, SLO_CLASSES
@@ -111,7 +112,8 @@ class _Model:
 
     def __init__(self, name):
         self.name = name
-        self.lock = threading.RLock()
+        # guards: versions, active, canary, canary_fraction, canary_breaker, canary_successes
+        self.lock = _locks.RankedRLock("repository.model")
         self.versions = {}  # version -> _Version
         self.active = None
         self.canary = None
@@ -139,7 +141,8 @@ class ModelRepository:
                  **batcher_kwargs):
         from .. import env as _env
 
-        self._lock = threading.Lock()
+        # guards: _models, _default, _closed
+        self._lock = _locks.RankedLock("repository")
         self._models = {}
         self._default = None
         self._closed = False
@@ -170,7 +173,8 @@ class ModelRepository:
 
     @property
     def default_model(self):
-        return self._default
+        with self._lock:
+            return self._default
 
     def models(self):
         with self._lock:
@@ -179,10 +183,11 @@ class ModelRepository:
     def _model(self, name):
         with self._lock:
             m = self._models.get(name)
+            deployed = sorted(self._models)
         if m is None:
             raise MXNetError(
                 f"unknown model {name!r} (deployed: "
-                f"{', '.join(sorted(self._models)) or 'none'})")
+                f"{', '.join(deployed) or 'none'})")
         return m
 
     def deploy(self, name, session, version=None, canary_fraction=None):
@@ -190,12 +195,33 @@ class ModelRepository:
         first version of ``name`` activates immediately (atomic, via
         the ``model_swap`` seam); later versions start as a canary
         taking ``canary_fraction`` of non-critical traffic."""
-        if self._closed:
-            raise MXNetError("repository is closed")
         with self._lock:
+            if self._closed:
+                raise MXNetError("repository is closed")
             m = self._models.setdefault(name, _Model(name))
             if self._default is None:
                 self._default = name
+        try:
+            return self._deploy_under_model_lock(
+                m, name, session, version, canary_fraction)
+        except Exception:
+            # a failed FIRST activation (model_swap fault, batcher
+            # construction) must not leave a half-registered model
+            # behind. Reacquire in the declared repository -> model
+            # order — the pre-r22 cleanup took the repository lock
+            # while still holding the model lock, the one true
+            # lock-order inversion the witness found in the tree.
+            with self._lock:
+                with m.lock:
+                    if not m.versions:
+                        self._models.pop(name, None)
+                        if self._default == name:
+                            self._default = next(
+                                iter(sorted(self._models)), None)
+            raise
+
+    def _deploy_under_model_lock(self, m, name, session, version,
+                                 canary_fraction):
         with m.lock:
             ver = int(version) if version is not None else \
                 (max(m.versions) + 1 if m.versions else 1)
@@ -212,19 +238,11 @@ class ModelRepository:
             vh = _Version(ver, session,
                           DynamicBatcher(session, **self._batcher_kwargs))
             if m.active is None:
-                # first version: activate or die — a failed swap here
-                # (model_swap fault) must not leave a half-registered
-                # model behind
+                # first version: activate or die
                 try:
                     self._activate_locked(m, ver, {ver: vh})
                 except Exception:
                     vh.batcher.close()
-                    with self._lock:
-                        if not m.versions:
-                            self._models.pop(name, None)
-                            if self._default == name:
-                                self._default = next(
-                                    iter(sorted(self._models)), None)
                     raise
                 m.versions[ver] = vh
                 m.state = "serving"

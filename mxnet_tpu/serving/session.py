@@ -57,6 +57,7 @@ from ..ndarray import NDArray
 from .. import random as mxrandom
 from ..artifact import CompiledArtifact
 from ..utils import compile_cache as cc
+from ..utils import locks as _locks
 from .metrics import METRICS
 
 __all__ = ["InferenceSession", "parse_buckets"]
@@ -182,7 +183,8 @@ class InferenceSession:
         # ModelRepository passes "name@vN" so operators can tell WHICH
         # model's bucket degraded)
         self.label = label
-        self._lock = threading.Lock()
+        # guards: _entries, _breakers, _demoted, _artifact_fps, _num_outputs
+        self._lock = _locks.RankedLock("serving.session")
         self._entries = {}  # (bucket, amp_ver) -> _BucketEntry
         self._breakers = {}  # (bucket, amp_ver) -> CircuitBreaker
         self._demoted = set()  # (bucket, amp_ver) forced to the jit path
@@ -359,7 +361,8 @@ class InferenceSession:
                 flat = [outs]
             else:
                 flat = [o for o in outs]
-            self._num_outputs = len(flat)
+            # runs only while tracing, which _entry does under _lock
+            self._num_outputs = len(flat)  # graft-lint: allow(L1102)
             if not self._mutation_warned and any(
                     p._data is not v
                     for p, v in zip(pnds, param_vals)):
@@ -398,7 +401,8 @@ class InferenceSession:
                     f"stateful forward returned {len(flat)} value(s); "
                     f"expected outputs followed by {n_states} new "
                     "state(s)")
-            self._num_outputs = len(flat) - n_states
+            # runs only while tracing, under _step_entry's lock
+            self._num_outputs = len(flat) - n_states  # graft-lint: allow(L1102)
             return tuple(o.data for o in flat)
         finally:
             for p, v in zip(pnds, saved):
@@ -535,7 +539,8 @@ class InferenceSession:
         policy (an ``amp.init()``/``disable()`` between calls re-resolves
         — AMP casts are baked into the trace, like CachedOp)."""
         amp_ver = self._amp_version()
-        ent = self._entries.get((bucket, amp_ver))
+        # double-checked: lock-free hit, miss re-checks under _lock
+        ent = self._entries.get((bucket, amp_ver))  # graft-lint: allow(L1102)
         if ent is not None:
             return ent
         with self._lock:
@@ -548,7 +553,10 @@ class InferenceSession:
             # an executable it never traced)
             fn, meta, source = art.resolve(
                 self._jitted_for(amp_ver), self._avals(bucket),
-                meta=lambda: {"num_outputs": self._num_outputs})
+                # the meta lambda runs inside art.resolve, i.e.
+                # under the _lock block that encloses this call
+                meta=lambda: {"num_outputs":
+                              self._num_outputs})  # graft-lint: allow(L1102)
             from_disk = source != "compile"
             if art.fingerprint is not None:
                 self._artifact_fps.add(art.fingerprint)
@@ -634,7 +642,10 @@ class InferenceSession:
             fn, meta, source = art.resolve(
                 self._step_jitted_for(amp_ver),
                 self._step_avals(occupancy),
-                meta=lambda: {"num_outputs": self._num_outputs})
+                # the meta lambda runs inside art.resolve, i.e.
+                # under the _lock block that encloses this call
+                meta=lambda: {"num_outputs":
+                              self._num_outputs})  # graft-lint: allow(L1102)
             from_disk = source != "compile"
             if art.fingerprint is not None:
                 self._artifact_fps.add(art.fingerprint)
@@ -676,8 +687,10 @@ class InferenceSession:
         """True when every configured bucket is resolved under the
         current AMP policy."""
         amp_ver = self._amp_version()
+        # observability snapshot; dict membership is atomic under the
+        # GIL and a racing resolve only flips this False -> True
         entries = self._step_entries if self._state_specs \
-            else self._entries
+            else self._entries  # graft-lint: allow(L1102)
         return all((b, amp_ver) in entries for b in self.buckets)
 
     # -- the request path ---------------------------------------------
@@ -688,7 +701,9 @@ class InferenceSession:
 
     @property
     def num_outputs(self):
-        return self._num_outputs
+        # write-once value (set at first trace/envelope read); a racy
+        # read sees None or the final count, never garbage
+        return self._num_outputs  # graft-lint: allow(L1102)
 
     @property
     def max_batch(self):
@@ -834,7 +849,9 @@ class InferenceSession:
         the executable, so its failure history starts clean too."""
         from ..resilience.breaker import CircuitBreaker
 
-        br = self._breakers.get((bucket, amp_ver))
+        # double-checked: lock-free hit, miss goes through the locked
+        # setdefault below
+        br = self._breakers.get((bucket, amp_ver))  # graft-lint: allow(L1102)
         if br is None:
             who = f"serving {self.label} " if self.label else "serving "
             with self._lock:
@@ -856,7 +873,9 @@ class InferenceSession:
         br = self._breaker(bucket, amp_ver)
         br.record_failure()
         key = (bucket, amp_ver)
-        if key not in self._demoted and br.failures >= 2:
+        # double-checked: the demotion branch re-tests membership under
+        # _lock before mutating
+        if key not in self._demoted and br.failures >= 2:  # graft-lint: allow(L1102)
             with self._lock:
                 ent = self._entries.get(key)
                 if ent is not None and key not in self._demoted:
@@ -903,7 +922,10 @@ class InferenceSession:
 
         bucket = self._bucket_for(n)
         amp_ver = self._amp_version()
-        br = self._breakers.get((bucket, amp_ver))
+        # lock-free fast read on the request path; a miss just means
+        # the breaker isn't born yet (first failure creates it under
+        # _lock in _breaker)
+        br = self._breakers.get((bucket, amp_ver))  # graft-lint: allow(L1102)
         if br is not None:
             br.check()  # open circuit: fail fast (HTTP 503)
         # EVERY failure past the check must reach the breaker — entry

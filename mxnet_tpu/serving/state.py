@@ -80,6 +80,7 @@ from collections import OrderedDict, deque
 import numpy as onp
 
 from ..base import MXNetError
+from ..utils import locks as _locks
 from .batcher import ServerBusy
 from .metrics import METRICS
 
@@ -332,7 +333,8 @@ class SessionStateStore:
                 self._pools.append(jnp.zeros((self.num_slots,) + s,
                                              dtype=str(dt)))
                 self._scales.append(None)
-        self._lock = threading.RLock()
+        # guards: _slots, _free, _free_pages, _evicted, steps_total
+        self._lock = _locks.RankedRLock("serving.store")
         self._slots = OrderedDict()  # sid -> _Slot, LRU order
         self._free = list(range(self.num_slots - 1, -1, -1))
         # physical pages 1..num_pages (0 is the null page)

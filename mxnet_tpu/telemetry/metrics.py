@@ -30,7 +30,7 @@ gauges, scrapeable for the first time.
 """
 from __future__ import annotations
 
-import threading
+from ..utils import locks as _locks
 
 __all__ = ["CounterFamily", "MetricsRegistry", "REGISTRY",
            "counter_family", "register_family", "register_exposition",
@@ -50,7 +50,8 @@ class CounterFamily:
 
     def __init__(self, name, zeros=None):
         self.name = name
-        self._lock = threading.Lock()
+        # guards: _data
+        self._lock = _locks.RankedLock("telemetry.counters")
         self._zeros = dict(zeros) if zeros else {}
         self._data = dict(self._zeros)
 
@@ -114,7 +115,8 @@ class MetricsRegistry:
     before (and without) any of them."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # guards: _owned, _probes, _expositions
+        self._lock = _locks.RankedLock("telemetry.registry")
         self._owned = {}        # name -> CounterFamily
         self._probes = {}       # name -> callable() -> flat dict
         self._expositions = []  # (name, callable() -> prometheus text)
@@ -260,7 +262,8 @@ def prometheus_text():
 
 # -- probe bootstrap --------------------------------------------------------
 
-_BOOT_LOCK = threading.Lock()
+# guards: _BOOTED
+_BOOT_LOCK = _locks.RankedLock("telemetry.boot")
 _BOOTED = False
 
 

@@ -7,8 +7,9 @@ and the fused train-step cache (gluon/fused_step.py, PR 2). Thread-safe;
 """
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
+
+from . import locks as _locks
 
 __all__ = ["CountedLRUCache"]
 
@@ -17,7 +18,8 @@ class CountedLRUCache:
     def __init__(self, maxsize):
         self.maxsize = maxsize
         self._d = OrderedDict()
-        self._lock = threading.Lock()
+        # guards: _d, hits, misses, evictions, bypasses, fallbacks
+        self._lock = _locks.RankedLock("utils.lru")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

@@ -15,6 +15,11 @@ from _cpu_platform import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(num_devices=8)
 
+# Lock-discipline witness ON for the whole suite (before any mxnet_tpu
+# import constructs a lock): every test doubles as a lock-order test,
+# and the autouse gate below fails the test that produced a violation.
+os.environ.setdefault("MXNET_LOCK_CHECK", "warn")
+
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
 
@@ -113,6 +118,25 @@ def _seed():
     mx.random.seed(0)
     onp.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _lock_check_gate():
+    """Fail THE TEST that produced a lock-order violation (out-of-rank
+    acquire, order-graph cycle, self-deadlock) under the suite-wide
+    MXNET_LOCK_CHECK=warn. Witness tests that provoke violations on
+    purpose wrap them in locks.capture_violations(), which removes
+    them from the global record before this gate reads it."""
+    from mxnet_tpu.utils import locks
+
+    before = len(locks.violations())
+    yield
+    new = locks.violations()[before:]
+    assert not new, (
+        "lock_check violations during this test (see "
+        "docs/CONCURRENCY.md):\n" +
+        "\n".join(f"  [{v['kind']}] {v['message']} "
+                  f"(thread={v['thread']})" for v in new))
 
 
 @pytest.fixture(scope="session", autouse=True)

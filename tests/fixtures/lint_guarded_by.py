@@ -1,0 +1,69 @@
+"""Seeded graft_lint L1102 violation fixture (NOT imported by the
+package). graft-lint: scope(ranked-locks)
+
+A ``# guards: a, b`` annotation on a ranked-lock assignment is a
+machine-checked contract: every access to a guarded attribute outside
+the lock is flagged. The tier-1 lint test asserts each violation
+species below fires while the sanctioned holding idioms — ``with``
+block, acquire/release, ``lock = getattr(self, "_lock", ...)`` alias,
+``*_locked`` helper, ``__init__``, shared-lock condition — stay clean.
+"""
+from mxnet_tpu.utils.locks import RankedCondition, RankedLock, RankedRLock
+
+# guards: _REGISTRY
+_MODULE_LOCK = RankedLock("artifact.salts")
+_REGISTRY = {}
+
+
+def bad_module_read(name):
+    return _REGISTRY.get(name)  # L1102: module-global read, no lock
+
+
+def good_module_write(name, value):
+    with _MODULE_LOCK:
+        _REGISTRY[name] = value
+
+
+class Store:
+    def __init__(self):
+        # guards: _slots, _closed
+        self._lock = RankedRLock("serving.store")
+        self._cond = RankedCondition(lock=self._lock)
+        self._slots = {}   # __init__ is exempt: no concurrency yet
+        self._closed = False
+
+    def bad_unlocked_read(self, sid):
+        return self._slots.get(sid)  # L1102: guarded attr, no lock
+
+    def bad_unlocked_write(self):
+        self._closed = True  # L1102: guarded attr, no lock
+
+    def good_with_lock(self, sid, slot):
+        with self._lock:
+            self._slots[sid] = slot
+
+    def good_with_shared_condition(self):
+        # the condition was built over self._lock: holding it IS
+        # holding the lock
+        with self._cond:
+            return len(self._slots)
+
+    def good_acquire_release(self):
+        self._lock.acquire()
+        try:
+            return dict(self._slots)
+        finally:
+            self._lock.release()
+
+    def good_alias_via_getattr(self):
+        lock = getattr(self, "_lock", None)
+        with lock:
+            return self._closed
+
+    def _evict_locked(self, sid):
+        # *_locked suffix: caller holds the lock by convention
+        self._slots.pop(sid, None)
+
+    def good_whitelisted_fast_path(self):
+        # a deliberate unlocked read carries the pragma and a reason
+        return self._closed  # graft-lint: allow(L1102)

@@ -1,0 +1,65 @@
+"""Seeded graft_lint L1101/L1103 violation fixture (NOT imported by
+the package). graft-lint: scope(ranked-locks)
+
+The marker comment above opts this file into the ranked-lock
+discipline that ``mxnet_tpu/`` (outside ``utils/locks.py``) gets
+automatically; the tier-1 lint test asserts every raw-construction
+species and every blocking-under-lock species below is flagged. Keep
+this file OUTSIDE mxnet_tpu/ so ``python -m tools.graft_lint
+mxnet_tpu`` stays clean on the shipped tree.
+"""
+import threading
+import threading as _t
+import time
+from threading import Condition, RLock
+from urllib.request import urlopen
+
+from mxnet_tpu.utils.locks import RankedLock
+from mxnet_tpu.resilience.retry import RetryPolicy
+
+# -- L1101: raw lock construction ----------------------------------------
+
+_BAD_LOCK = threading.Lock()          # L1101: module-attr Lock
+_BAD_RLOCK = RLock()                  # L1101: from-imported RLock
+_BAD_COND = Condition()               # L1101: from-imported Condition
+_BAD_ALIASED = _t.Lock()              # L1101: aliased module attr
+
+
+def bad_local_condition():
+    # L1101: raw Condition over a raw lock, inside a function
+    return threading.Condition(threading.Lock())
+
+
+# a deliberately unranked site carries the pragma and a reason
+_HARNESS_LOCK = threading.Lock()  # graft-lint: allow(L1101) — bench harness
+
+# the ranked factory is the sanctioned form
+_GOOD_LOCK = RankedLock("profiler")
+
+# -- L1103: blocking calls inside a ranked-lock body ---------------------
+
+
+def bad_blocking_under_lock(arr, retry):
+    with _GOOD_LOCK:
+        arr.asnumpy()                         # L1103: host sync
+        time.sleep(0.1)                       # L1103: sleep
+        fh = open("/tmp/x")                   # L1103: file IO
+        urlopen("http://example.com")         # L1103: HTTP
+        RetryPolicy(max_attempts=3)           # L1103: retry machinery
+        retry.run(lambda: None)               # L1103: retry loop
+    return fh
+
+
+def good_blocking_outside_lock(arr):
+    # the same calls OUTSIDE the locked region are fine
+    host = arr.asnumpy()
+    time.sleep(0.0)
+    with _GOOD_LOCK:
+        n = len(host)  # pure in-memory work under the lock is fine
+    return n
+
+
+def whitelisted_block_under_lock():
+    with _GOOD_LOCK:
+        # a deliberate site (cold path, documented) carries the pragma
+        time.sleep(0.0)  # graft-lint: allow(L1103)

@@ -377,6 +377,51 @@ def test_bench_compare_paged_metrics():
     assert not any(r[4] for r in bench_compare.compare(base, base))
 
 
+@pytest.mark.slow
+def test_lockcheck_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import lockcheck_bench
+
+    doc = lockcheck_bench.run(smoke=True)
+    assert doc["smoke"] is True
+    micro = doc["uncontended_acquire"]
+    # structural contracts at any scale: the level-0 factory handed
+    # back a raw primitive (asserted inside the bench) and both sides
+    # timed something real. The <1% passthrough gate is only enforced
+    # on the committed full run (BENCH_LOCKCHECK_r22.json) — smoke
+    # pair counts are noise-dominated.
+    assert micro["raw_acquire_us"] > 0
+    assert micro["level0_acquire_us"] > 0
+    # an armed acquire costs more than a raw one, by construction
+    assert micro["checked_acquire_us"] > micro["level0_acquire_us"]
+    drain = doc["serving_drain"]
+    assert drain["level0_drain_ms"] > 0 and drain["warn_drain_ms"] > 0
+
+
+def test_bench_compare_lockcheck_metrics():
+    """BENCH_LOCKCHECK_r22.json names: the passthrough/warn overhead
+    percentages are lower-is-better (the 'overhead' tag), per-acquire
+    and drain times lower-is-better; pair counts untracked."""
+    base = {"uncontended_acquire": {"passthrough_overhead_pct": 0.4,
+                                    "checked_acquire_us": 1.5,
+                                    "pairs": 40},
+            "serving_drain": {"serving_warn_overhead_pct": 30.0,
+                              "level0_drain_ms": 24.0}}
+    worse = {"uncontended_acquire": {"passthrough_overhead_pct": 5.0,
+                                     "checked_acquire_us": 9.0,
+                                     "pairs": 40},
+             "serving_drain": {"serving_warn_overhead_pct": 80.0,
+                               "level0_drain_ms": 60.0}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "uncontended_acquire.passthrough_overhead_pct") == "lower"
+    assert rows["uncontended_acquire.passthrough_overhead_pct"][4]
+    assert rows["uncontended_acquire.checked_acquire_us"][4]
+    assert rows["serving_drain.serving_warn_overhead_pct"][4]
+    assert rows["serving_drain.level0_drain_ms"][4]
+    assert "uncontended_acquire.pairs" not in rows
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_sharding_metrics():
     """BENCH_SHARD_r15.json names: efficiency and the plan-vs-replicated
     speedup are higher-is-better, update/step ms lower-is-better, the

@@ -28,6 +28,10 @@ CTR_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                            "lint_raw_counter.py")
 SALT_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                             "lint_salt_assembly.py")
+LOCK_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                            "lint_raw_lock.py")
+GUARD_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                             "lint_guarded_by.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -339,6 +343,79 @@ def test_salt_scope_exempts_artifact_and_providers(tmp_path):
     prov.write_text(
         src + "\n\ndef fingerprint_salt(x):\n    return (x,)\n")
     assert graft_lint.lint_paths([str(prov)], repo_root=REPO,
+                                 registry=False) == []
+
+
+def test_raw_lock_fixture_triggers_l1101_and_l1103():
+    """L1101: every raw-construction species in the seeded fixture is
+    flagged — module-attr Lock, from-imported RLock/Condition, aliased
+    module, in-function construction — while the RankedLock factory
+    and the allow(L1101) harness site are not. L1103: every blocking
+    species inside the ``with <ranked-lock>`` body fires — host sync,
+    sleep, file IO, HTTP, retry machinery — while the same calls
+    outside the lock and the allow(L1103) site stay clean."""
+    findings = graft_lint.lint_paths([LOCK_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    l1101 = [f for f in findings if f.code == "L1101"]
+    l1103 = [f for f in findings if f.code == "L1103"]
+    assert len(l1101) == 6, findings
+    assert len(l1103) == 6, findings
+    msgs = "\n".join(f.message for f in l1101)
+    assert "RankedLock" in msgs and "RankedCondition" in msgs
+    blocked = "\n".join(f.message for f in l1103)
+    for species in ("host sync", "sleep", "file IO", "HTTP",
+                    "RetryPolicy", "retry loop"):
+        assert species in blocked, (species, blocked)
+    # every L1103 lands inside bad_blocking_under_lock, none in the
+    # outside-the-lock twin or the pragma'd site
+    src = open(LOCK_FIXTURE).read().splitlines()
+    bad = next(i for i, ln in enumerate(src, 1)
+               if "def bad_blocking_under_lock" in ln)
+    good = next(i for i, ln in enumerate(src, 1)
+                if "def good_blocking_outside_lock" in ln)
+    assert all(bad < f.line < good for f in l1103), l1103
+    assert {f.code for f in findings} == {"L1101", "L1103"}, findings
+
+
+def test_guarded_by_fixture_triggers_l1102():
+    """L1102: unlocked access to a ``# guards:`` attribute fires for
+    both the module-global and the instance-attr form, while every
+    sanctioned holding idiom in the fixture — with-block, shared-lock
+    condition, acquire/release, getattr alias, *_locked helper,
+    __init__, allow(L1102) — stays clean."""
+    findings = graft_lint.lint_paths([GUARD_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    assert {f.code for f in findings} == {"L1102"}, findings
+    assert len(findings) == 3, findings
+    src = open(GUARD_FIXTURE).read().splitlines()
+    flagged = {src[f.line - 1].strip().split("#")[0].strip()
+               for f in findings}
+    assert flagged == {"return _REGISTRY.get(name)",
+                       "return self._slots.get(sid)",
+                       "self._closed = True"}, flagged
+
+
+def test_ranked_lock_scope_exempts_locks_module(tmp_path):
+    """The lock discipline binds mxnet_tpu/ automatically but exempts
+    utils/locks.py (which owns the primitive and the witness's raw
+    internals); outside the package it is opt-in via
+    scope(ranked-locks)."""
+    src = ("import threading\n"
+           "_L = threading.Lock()\n")
+    free = tmp_path / "lock_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    pkg = tmp_path / "mxnet_tpu" / "serving" / "frag.py"
+    pkg.parent.mkdir(parents=True)
+    pkg.write_text(src)
+    codes = [f.code for f in graft_lint.lint_paths(
+        [str(pkg)], repo_root=REPO, registry=False)]
+    assert codes == ["L1101"], codes
+    own = tmp_path / "mxnet_tpu" / "utils" / "locks.py"
+    own.parent.mkdir(parents=True)
+    own.write_text(src)
+    assert graft_lint.lint_paths([str(own)], repo_root=REPO,
                                  registry=False) == []
 
 

@@ -18,7 +18,11 @@ inferred from the leaf name:
   gates from BENCH_TELEM_r18.json: ``fused_step_overhead_pct`` /
   ``serving_overhead_pct`` price ``MXNET_TELEMETRY=1`` against ``0``
   on the fused-step loop and serving drain throughput, so growth
-  there means instrumentation crept into a hot path), ``*nodes*`` /
+  there means instrumentation crept into a hot path; likewise the
+  lock-witness gate from BENCH_LOCKCHECK_r22.json:
+  ``passthrough_overhead_pct`` prices a level-0 ranked lock against a
+  raw ``threading.Lock``, so growth there means the factory stopped
+  being a passthrough), ``*nodes*`` /
   ``*trace*``
   (graph-opt metrics from BENCH_GRAPHOPT_r14.json — a like-for-like
   graph lowering to MORE nodes or a longer trace+compile means a

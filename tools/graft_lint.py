@@ -144,6 +144,45 @@ package:
                        cache. Deliberate bypasses (one-shot equivalence
                        checks, raw-jit benchmarks) carry
                        ``# graft-lint: allow(jit-nocache)``.
+``L1101 raw-lock``     a ``threading.Lock/RLock/Condition(...)``
+                       construction inside ``mxnet_tpu/`` but outside
+                       ``utils/locks.py`` (alias-aware: ``import
+                       threading as _t`` and ``from threading import
+                       Lock as L`` are tracked). Round 22 moved every
+                       lock onto the ranked-lock registry
+                       (``utils.locks.RankedLock/RankedRLock/
+                       RankedCondition``) so the lock-order witness
+                       sees it; a raw lock is invisible to the
+                       deadlock witness and has no declared rank. The
+                       handful of deliberately unranked sites
+                       (benchmark harnesses, the witness's own
+                       internals) carry ``# graft-lint: allow(L1101)``.
+``L1102 guarded-by``   an attribute declared in a ``# guards: _a, _b``
+                       comment on a ranked-lock assignment, accessed
+                       in a method/function of the same scope that
+                       does not hold that lock (``with self._lock:``
+                       blocks, ``lock = self._lock`` /
+                       ``getattr(self, "_lock", ...)`` aliases and
+                       ``.acquire()``-style methods are recognized;
+                       ``__init__`` and ``*_locked``-suffix
+                       methods — the store's caller-holds-the-lock
+                       convention — are exempt). A deliberate
+                       unlocked fast path (documented racy read,
+                       atomic-len probe) carries
+                       ``# graft-lint: allow(L1102)`` with a reason.
+``L1103 block-under-lock`` a blocking call lexically inside a ``with
+                       <ranked-lock>:`` body: host syncs
+                       (``.asnumpy()/.asscalar()/.wait_to_read()/
+                       .block_until_ready()``), ``time.sleep``,
+                       ``open(...)``/``urlopen(...)`` file/HTTP IO, or
+                       a ``RetryPolicy`` construction/run. One sleep
+                       or device sync under a hot-path lock convoys
+                       every thread behind it (the r21 paged-store
+                       rule "pool operands are indexed OUTSIDE the
+                       store lock", now machine-checked). A site
+                       where the block is the point (a condition
+                       wait's timeout loop) carries
+                       ``# graft-lint: allow(L1103)``.
 ``R301/R302/R303``     registry checks (``--registry``): every
                        registered op carries a docstring; every op named
                        in the dtype-rule tables of ``symbol/infer.py``
@@ -995,6 +1034,352 @@ def registry_checks(findings):
 
 
 # ---------------------------------------------------------------------------
+# L1101/L1102/L1103 — lock discipline (round 22)
+
+_RANKED_CTORS = {"RankedLock", "RankedRLock", "RankedCondition"}
+
+_BLOCKING_ATTRS = {"asnumpy", "asscalar", "wait_to_read",
+                   "block_until_ready"}
+
+
+def _ranked_lock_scoped(path, source):
+    """Files the lock discipline applies to: all of ``mxnet_tpu/``
+    except ``utils/locks.py`` (which owns the primitive and the
+    witness's own raw internals). Code outside the package opts in
+    with a ``# graft-lint: scope(ranked-locks)`` marker (fixtures)."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith("mxnet_tpu/utils/locks.py"):
+        return False
+    if "mxnet_tpu/" in norm:
+        return True
+    return "graft-lint: scope(ranked-locks)" in source
+
+
+def check_raw_lock_construction(path, tree, source, findings):
+    """L1101: a raw ``threading.Lock/RLock/Condition(...)`` call.
+    Every lock must come from the ranked-lock factories in
+    ``utils/locks.py`` so it carries a name and a place in the single
+    declared lock order — a raw lock is invisible to the runtime
+    deadlock witness."""
+    if not _ranked_lock_scoped(path, source):
+        return
+    mod_aliases = set()  # names bound to the threading module
+    fn_aliases = {}      # local name -> Lock/RLock/Condition
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "threading":
+                    mod_aliases.add(a.asname or "threading")
+        elif isinstance(node, ast.ImportFrom) and \
+                node.module == "threading":
+            for a in node.names:
+                if a.name in ("Lock", "RLock", "Condition"):
+                    fn_aliases[a.asname or a.name] = a.name
+    pragmas = _Pragmas(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        kind = None
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ("Lock", "RLock", "Condition") and \
+                isinstance(f.value, ast.Name) and f.value.id in mod_aliases:
+            kind = f.attr
+        elif isinstance(f, ast.Name) and f.id in fn_aliases:
+            kind = fn_aliases[f.id]
+        if kind is None or pragmas.allows(node.lineno, "L1101"):
+            continue
+        findings.append(Finding(
+            "L1101", path, node.lineno,
+            f"raw threading.{kind}() — construct locks through "
+            f"utils.locks.Ranked{'Condition' if kind == 'Condition' else kind}"
+            f"(name) so the deadlock witness sees them; a deliberately "
+            f"unranked site carries allow(L1101)"))
+
+
+def _guards_comment(source_lines, lineno):
+    """The ``# guards: a, b`` attr set for the assignment at 1-based
+    ``lineno`` — from the same line's trailing comment or the line
+    immediately above."""
+    for text in (source_lines[lineno - 1],
+                 source_lines[lineno - 2] if lineno >= 2 else ""):
+        if "# guards:" in text:
+            frag = text.split("# guards:", 1)[1]
+            names = {n.strip() for n in frag.split(",")}
+            return {n for n in names if n and n.isidentifier()}
+    return None
+
+
+def _ranked_ctor_name(value):
+    """'RankedLock'/'RankedRLock'/'RankedCondition' when ``value`` is a
+    ranked-factory call (possibly dotted: _locks.RankedLock), else
+    None."""
+    if not isinstance(value, ast.Call):
+        return None
+    dn = _dotted(value.func) or ""
+    last = dn.split(".")[-1]
+    return last if last in _RANKED_CTORS else None
+
+
+class _LockDecl:
+    """One ranked-lock declaration site: the holder expressions that
+    count as 'holding it' and the attrs/globals it guards."""
+
+    def __init__(self, expr, guards):
+        self.exprs = {expr}   # dotted holder exprs ("self._lock", "_LOCK")
+        self.guards = guards or set()
+
+
+def _collect_lock_decls(tree, source):
+    """(class_decls, module_decls, holder_exprs): lock declarations by
+    class and at module level, plus every dotted expr that denotes a
+    ranked lock in this file (for L1103's with-body scan). Conditions
+    built over an existing lock (``RankedCondition(lock=self._lock)``)
+    alias that lock's declaration."""
+    lines = source.splitlines()
+    class_decls = {}   # ClassDef -> {attr_name: _LockDecl}
+    module_decls = {}  # global name -> _LockDecl
+    holder_exprs = set()
+
+    def scan_assign(node, bucket, expr_of):
+        ctor = _ranked_ctor_name(node.value)
+        if ctor is None:
+            return
+        for t in node.targets:
+            key = expr_of(t)
+            if key is None:
+                continue
+            guards = _guards_comment(lines, node.lineno)
+            # RankedCondition(lock=self._lock) shares the lock's
+            # identity: holding the condition IS holding the lock
+            shared = None
+            for kw in node.value.keywords:
+                if kw.arg == "lock":
+                    shared = _dotted(kw.value)
+            if shared is not None and shared.startswith("self."):
+                shared = shared[len("self."):]
+            if shared is not None and shared in bucket:
+                decl = bucket[shared]
+                decl.exprs.add(_holder_expr(key, expr_of))
+                if guards:
+                    decl.guards |= guards
+            else:
+                bucket[key] = _LockDecl(_holder_expr(key, expr_of),
+                                        guards)
+
+    def _holder_expr(key, expr_of):
+        return ("self." + key) if expr_of is _self_attr else key
+
+    def _self_attr(t):
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            return t.attr
+        return None
+
+    def _global_name(t):
+        return t.id if isinstance(t, ast.Name) else None
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            scan_assign(node, module_decls, _global_name)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        bucket = class_decls.setdefault(cls, {})
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                scan_assign(node, bucket, _self_attr)
+    for bucket in class_decls.values():
+        for decl in bucket.values():
+            holder_exprs |= decl.exprs
+    for decl in module_decls.values():
+        holder_exprs |= decl.exprs
+    return class_decls, module_decls, holder_exprs
+
+
+def _with_holds(node, holder_exprs, aliases):
+    """Holder exprs this With statement acquires."""
+    held = set()
+    for item in node.items:
+        dn = _dotted(item.context_expr)
+        if dn is None:
+            continue
+        if dn in holder_exprs or dn in aliases:
+            held.add(dn)
+    return held
+
+
+def _lock_alias_target(value):
+    """'self._lock'-style dotted expr when ``value`` re-binds a lock
+    (``lock = self._lock`` / ``lock = getattr(self, "_lock", None)``),
+    else None."""
+    dn = _dotted(value)
+    if dn is not None:
+        return dn
+    if isinstance(value, ast.Call) and \
+            isinstance(value.func, ast.Name) and \
+            value.func.id == "getattr" and len(value.args) >= 2 and \
+            isinstance(value.args[0], ast.Name) and \
+            value.args[0].id == "self" and \
+            isinstance(value.args[1], ast.Constant):
+        return "self." + str(value.args[1].value)
+    return None
+
+
+def _scan_guarded(fn, decl, access_hits):
+    """Walk one function; call ``access_hits(node, held)`` for each
+    guarded-attr access with whether a holder lock is lexically held.
+    Nested defs/lambdas run later, so they restart unheld (a nested
+    ``*_locked`` helper is exempt, like its method-level namesake)."""
+    aliases = set()
+    acquire_style = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = _lock_alias_target(node.value)
+            if tgt is not None and tgt in decl.exprs:
+                aliases.add(node.targets[0].id)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            dn = _dotted(node.func.value)
+            if dn in decl.exprs or dn in aliases:
+                acquire_style = True
+
+    def walk(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            if node.name.endswith("_locked"):
+                return
+            held = False
+        elif isinstance(node, ast.Lambda):
+            held = False
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if _with_holds(node, decl.exprs, aliases):
+                for item in node.items:
+                    walk(item, held)
+                for child in node.body:
+                    walk(child, True)
+                return
+        access_hits(node, held or acquire_style)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+
+
+def check_guarded_by(path, tree, source, findings):
+    """L1102: an attr named in a ``# guards:`` annotation accessed
+    without its lock. The annotation is the contract; this check makes
+    it machine-checked instead of a comment."""
+    if not _ranked_lock_scoped(path, source):
+        return
+    class_decls, module_decls, _ = _collect_lock_decls(tree, source)
+    pragmas = _Pragmas(source)
+
+    def flag(node, attr, lockname):
+        if pragmas.allows(node.lineno, "L1102"):
+            return
+        findings.append(Finding(
+            "L1102", path, node.lineno,
+            f"'{attr}' is guarded by {lockname} (per its # guards: "
+            f"annotation) but accessed without holding it; take the "
+            f"lock, use a *_locked helper, or annotate a deliberate "
+            f"unlocked read with allow(L1102)"))
+
+    def check_fn(fn, decl, is_method):
+        if fn.name == "__init__" or fn.name.endswith("_locked"):
+            return
+        lockname = sorted(decl.exprs)[0]
+
+        def hits(node, held):
+            if held:
+                return
+            if is_method:
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self" and \
+                        node.attr in decl.guards:
+                    flag(node, "self." + node.attr, lockname)
+            else:
+                if isinstance(node, ast.Name) and node.id in decl.guards:
+                    flag(node, node.id, lockname)
+
+        _scan_guarded(fn, decl, hits)
+
+    for cls, bucket in class_decls.items():
+        for decl in bucket.values():
+            if not decl.guards:
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    check_fn(fn, decl, True)
+    for decl in module_decls.values():
+        if not decl.guards:
+            continue
+        for fn in tree.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_fn(fn, decl, False)
+
+
+def check_blocking_under_lock(path, tree, source, findings):
+    """L1103: a blocking call lexically inside a ``with <ranked-lock>``
+    body — host sync, sleep, file/HTTP IO, retry machinery. The lock
+    convoys every contending thread behind the block."""
+    if not _ranked_lock_scoped(path, source):
+        return
+    _, _, holder_exprs = _collect_lock_decls(tree, source)
+    if not holder_exprs:
+        return
+    pragmas = _Pragmas(source)
+
+    def blocking_reason(node):
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+            return f".{f.attr}() host sync"
+        dn = _dotted(f) or ""
+        last = dn.split(".")[-1]
+        if last == "sleep":
+            return f"{dn}() sleep"
+        if dn == "open":
+            return "open() file IO"
+        if last == "urlopen":
+            return f"{dn}() HTTP"
+        if last == "RetryPolicy":
+            return "RetryPolicy (backoff sleeps)"
+        if isinstance(f, ast.Attribute) and f.attr == "run" and \
+                "retry" in (_dotted(f.value) or "").lower():
+            return f"{_dotted(f)}() retry loop"
+        return None
+
+    def walk(node, held, lockname):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            held, lockname = False, None
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            holds = _with_holds(node, holder_exprs, ())
+            if holds:
+                held, lockname = True, sorted(holds)[0]
+        elif held:
+            reason = blocking_reason(node)
+            if reason is not None and \
+                    not pragmas.allows(node.lineno, "L1103"):
+                findings.append(Finding(
+                    "L1103", path, node.lineno,
+                    f"{reason} inside `with {lockname}:` — hoist the "
+                    f"blocking call out of the locked region (or "
+                    f"annotate a deliberate site with allow(L1103))"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, lockname)
+
+    for stmt in tree.body:
+        walk(stmt, False, None)
+
+
+# ---------------------------------------------------------------------------
 
 def iter_py_files(paths):
     for p in paths:
@@ -1033,6 +1418,9 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_salt_assembly(path, tree, source, findings)
         check_swallowed_exceptions(path, tree, source, findings)
         check_op_docstrings(path, tree, source, findings)
+        check_raw_lock_construction(path, tree, source, findings)
+        check_guarded_by(path, tree, source, findings)
+        check_blocking_under_lock(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
             want_registry = True
     if registry and want_registry:
