@@ -27,7 +27,9 @@ the serving ``/metrics`` surface.
 from ._counters import artifact_stats, reset_artifact_counters
 from .salts import register_salt_provider, resolve_salts, salt_providers
 from .core import CompiledArtifact
-from .bundle import BUNDLE_FORMAT, export_bundle, import_bundle
+from .bundle import (BUNDLE_FORMAT, export_bundle, import_bundle,
+                     protected_fingerprints,
+                     reset_protected_fingerprints)
 from .remote import (ArtifactCacheServer, fetch, publish, publish_path,
                      remote_url, reset_remote_state)
 
@@ -35,6 +37,7 @@ __all__ = [
     "CompiledArtifact",
     "register_salt_provider", "resolve_salts", "salt_providers",
     "BUNDLE_FORMAT", "export_bundle", "import_bundle",
+    "protected_fingerprints", "reset_protected_fingerprints",
     "ArtifactCacheServer", "fetch", "publish", "publish_path",
     "remote_url", "reset_remote_state",
     "artifact_stats", "reset_artifact_counters",

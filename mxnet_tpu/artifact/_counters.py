@@ -25,6 +25,8 @@ def _zero_stats():
         "bundle_entries_written": 0, "bundle_entries_skipped": 0,
         # remote-store GC (file:// pruner + ArtifactCacheServer LRU)
         "gc_runs": 0, "gc_evicted": 0, "gc_bytes": 0,
+        # round 23: age-bounded eviction + live-bundle protection
+        "gc_age_evicted": 0, "gc_protected": 0,
     }
 
 

@@ -25,11 +25,85 @@ import pickle
 
 from ..base import MXNetError
 from ..utils import compile_cache as _cc
+from ..utils import locks as _locks
 from ._counters import STATS
 
-__all__ = ["BUNDLE_FORMAT", "export_bundle", "import_bundle"]
+__all__ = ["BUNDLE_FORMAT", "export_bundle", "import_bundle",
+           "protected_fingerprints", "reset_protected_fingerprints"]
 
 BUNDLE_FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# live-bundle protection (round 23): fingerprints referenced by a
+# bundle manifest this process exported or imported are pinned against
+# remote-store GC — a fleet whose deploy path is "import the bundle,
+# fall through to the remote cache" must never have the cache evict
+# the exact entries the live bundle names.
+
+# guards: _PROTECTED, _PROTECT_FILES
+_PROT_LOCK = _locks.RankedLock("artifact.bundle.protected")
+_PROTECTED = set()
+_PROTECT_FILES = {}  # path -> (mtime, size, frozenset(fps))
+
+
+def _knob_bundle_paths():
+    """MXNET_ARTIFACT_GC_PROTECT: ``os.pathsep``-separated bundle file
+    paths whose manifests pin their fingerprints (for GC run by a
+    process that never itself imported the bundle — e.g. a publishing
+    replica pruning a shared ``file://`` mount)."""
+    from .. import env as _env
+
+    raw = _env.get_str("MXNET_ARTIFACT_GC_PROTECT") or ""
+    return [p for p in raw.split(os.pathsep) if p]
+
+
+def _bundle_fps(path):
+    """The fingerprint set a bundle file references, (mtime, size)
+    cached so repeated GC sweeps do not re-unpickle an unchanged
+    bundle. Unreadable/garbage files protect nothing (GC must not
+    break on a half-written bundle)."""
+    try:
+        st = os.stat(path)
+        key = (st.st_mtime, st.st_size)
+    except OSError:
+        return frozenset()
+    with _PROT_LOCK:
+        cached = _PROTECT_FILES.get(path)
+        if cached is not None and cached[:2] == key:
+            return cached[2]
+    try:
+        with open(path, "rb") as f:
+            envelope = pickle.load(f)
+        fps = frozenset(envelope.get("entries", {}))
+    except Exception:
+        fps = frozenset()
+    with _PROT_LOCK:
+        _PROTECT_FILES[path] = key + (fps,)
+    return fps
+
+
+def protected_fingerprints():
+    """Every fingerprint pinned against remote-store GC: the union of
+    bundles this process exported/imported plus the manifests of the
+    bundle files named by ``MXNET_ARTIFACT_GC_PROTECT``."""
+    with _PROT_LOCK:
+        out = set(_PROTECTED)
+    for path in _knob_bundle_paths():
+        out |= _bundle_fps(path)
+    return out
+
+
+def _register_protected(fps):
+    with _PROT_LOCK:
+        _PROTECTED.update(fps)
+
+
+def reset_protected_fingerprints():
+    """Forget every in-process pin and the knob-file cache (tests)."""
+    with _PROT_LOCK:
+        _PROTECTED.clear()
+        _PROTECT_FILES.clear()
 
 
 def export_bundle(path, fingerprints, manifest=None):
@@ -52,6 +126,7 @@ def export_bundle(path, fingerprints, manifest=None):
         pickle.dump(envelope, f)
     os.replace(tmp, path)
     STATS.add("bundle_exports")
+    _register_protected(entries)  # a live manifest pins its artifacts
     return {"path": path, "entries": len(entries), "missing": missing,
             "bytes": os.path.getsize(path)}
 
@@ -80,6 +155,7 @@ def import_bundle(path):
                 "manifest": manifest, "stale": True}
     directory = _cc.cache_dir()
     os.makedirs(directory, exist_ok=True)
+    _register_protected(entries)  # this replica serves FROM this set
     written = skipped = 0
     for fp, blob in entries.items():
         dest = os.path.join(directory, fp + ".mxc")

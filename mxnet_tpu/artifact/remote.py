@@ -80,6 +80,22 @@ def _max_bytes():
     return cap_mb * 1024 * 1024 if cap_mb > 0 else 0
 
 
+def _gc_max_age_s():
+    """MXNET_ARTIFACT_GC_MAX_AGE_S: age bound on remote-store entries
+    (default 0 = no age bound). A dead fingerprint on a shared mount
+    is never re-published, so only age — not the byte cap — can
+    reclaim it once the fleet stops fetching it."""
+    from .. import env as _env
+
+    return _env.get_int("MXNET_ARTIFACT_GC_MAX_AGE_S", 0)
+
+
+def _protected_fps():
+    from . import bundle as _bundle
+
+    return _bundle.protected_fingerprints()
+
+
 def _policy():
     from .. import env as _env
     from ..resilience.retry import RetryPolicy
@@ -156,16 +172,23 @@ _gc_tick = [0]
 def _maybe_gc_file(directory):
     """Bound a ``file://`` store the way the local tier bounds its
     directory (``compile_cache._maybe_prune``): every ``_GC_EVERY``-th
-    publish, if the ``.mxc`` total exceeds MXNET_ARTIFACT_REMOTE_MAX_MB,
-    remove oldest-used entries (mtime) down to 80% of the cap. Every
-    step tolerates a concurrent pruner on another replica: a stat or
-    remove that loses the race is skipped, never raised — a shared
-    NFS mount has many writers and no coordinator."""
+    publish, (1) entries older than MXNET_ARTIFACT_GC_MAX_AGE_S are
+    removed whatever the byte total, then (2) if the ``.mxc`` total
+    still exceeds MXNET_ARTIFACT_REMOTE_MAX_MB, oldest-used entries
+    (mtime) go down to 80% of the cap. Fingerprints referenced by a
+    live bundle manifest (``bundle.protected_fingerprints``) are never
+    evicted by either pass. Every step tolerates a concurrent pruner
+    on another replica: a stat or remove that loses the race is
+    skipped, never raised — a shared NFS mount has many writers and no
+    coordinator."""
+    import time
+
     _gc_tick[0] += 1
     if _GC_EVERY > 1 and _gc_tick[0] % _GC_EVERY != 1:
         return
     cap = _max_bytes()
-    if cap <= 0:
+    max_age = _gc_max_age_s()
+    if cap <= 0 and max_age <= 0:
         return  # 0 = unbounded, explicitly
     entries = []
     try:
@@ -177,22 +200,53 @@ def _maybe_gc_file(directory):
                     st = e.stat()
                 except OSError:
                     continue  # pruned/replaced by a concurrent replica
-                entries.append((st.st_mtime, st.st_size, e.path))
+                entries.append((st.st_mtime, st.st_size, e.path,
+                                e.name[:-len(".mxc")]))
     except OSError:
         return  # directory unreadable/gone: nothing to bound
-    total = sum(sz for _, sz, _ in entries)
-    if total <= cap:
-        return
-    STATS.add("gc_runs")
-    entries.sort()  # oldest-used first
-    for _, sz, path in entries:
+    total = sum(sz for _, sz, _, _ in entries)
+    protected = None  # resolved lazily: most sweeps evict nothing
+    ran = [False]
+
+    def _evict(sz, path, age_pass):
+        if not ran[0]:
+            ran[0] = True
+            STATS.add("gc_runs")
         try:
             os.remove(path)
         except OSError:
-            continue  # a concurrent pruner won the race for this one
+            return False  # a concurrent pruner won the race
         STATS.add("gc_evicted")
+        if age_pass:
+            STATS.add("gc_age_evicted")
         STATS.add("gc_bytes", sz)
-        total -= sz
+        return True
+
+    entries.sort()  # oldest-used first
+    if max_age > 0:
+        cutoff = time.time() - max_age
+        protected = _protected_fps()
+        survivors = []
+        for mtime, sz, path, fp in entries:
+            if mtime >= cutoff:
+                survivors.append((mtime, sz, path, fp))
+            elif fp in protected:
+                STATS.add("gc_protected")
+                survivors.append((mtime, sz, path, fp))
+            elif _evict(sz, path, age_pass=True):
+                total -= sz
+            # a lost remove race: the entry is gone either way
+        entries = survivors
+    if cap <= 0 or total <= cap:
+        return
+    if protected is None:
+        protected = _protected_fps()
+    for _, sz, path, fp in entries:
+        if fp in protected:
+            STATS.add("gc_protected")
+            continue
+        if _evict(sz, path, age_pass=False):
+            total -= sz
         if total <= cap * 0.8:
             break
 
@@ -298,18 +352,29 @@ class ArtifactCacheServer:
     entries first (a GET hit refreshes recency — the server-side
     mirror of the mtime-refresh the ``file://`` pruner keys on), so a
     long-lived fleet cache sheds artifacts nobody fetches anymore
-    instead of growing one blob per fingerprint forever."""
+    instead of growing one blob per fingerprint forever. Round 23
+    mirrors the ``file://`` pruner's other two rules: entries
+    untouched for ``max_age_s`` (default the
+    MXNET_ARTIFACT_GC_MAX_AGE_S knob) are dropped on the next PUT
+    whatever the byte total, and fingerprints referenced by a live
+    bundle manifest are never evicted by either pass."""
 
-    def __init__(self, host="127.0.0.1", max_bytes=None):
+    def __init__(self, host="127.0.0.1", max_bytes=None,
+                 max_age_s=None, clock=None):
         import collections
         import http.server
+        import time
 
         self.store = collections.OrderedDict()  # fp -> blob, LRU order
         self.max_bytes = _max_bytes() if max_bytes is None \
             else int(max_bytes)
+        self.max_age_s = _gc_max_age_s() if max_age_s is None \
+            else int(max_age_s)
+        self._clock = clock or time.monotonic
+        self._stamps = {}  # fp -> last-access clock reading
         self.store_bytes = 0
         self.gc_evicted = 0
-        # guards: store, store_bytes, gc_evicted
+        # guards: store, store_bytes, gc_evicted, _stamps
         self._store_lock = _locks.RankedLock("artifact.server.store")
         self.fail_requests = 0
         self.requests = 0
@@ -341,6 +406,7 @@ class ArtifactCacheServer:
                     blob = outer.store.get(fp)
                     if blob is not None:
                         outer.store.move_to_end(fp)  # refresh recency
+                        outer._stamps[fp] = outer._clock()
                 if blob is None:
                     self.send_response(404)
                     self.end_headers()
@@ -360,26 +426,60 @@ class ArtifactCacheServer:
                     return
                 n = int(self.headers.get("Content-Length") or 0)
                 blob = self.rfile.read(n)
+                # resolved OUTSIDE the store lock: may read bundle
+                # files from disk (L1103)
+                protected = _protected_fps() \
+                    if outer.max_age_s > 0 or outer.max_bytes > 0 \
+                    else frozenset()
                 with outer._store_lock:
                     old = outer.store.pop(fp, None)
                     if old is not None:
                         outer.store_bytes -= len(old)
                     outer.store[fp] = blob
                     outer.store_bytes += len(blob)
-                    ran = False
-                    # evict coldest-accessed until back under the cap
-                    # (never the entry just written, however large)
-                    while (outer.max_bytes > 0 and
-                           outer.store_bytes > outer.max_bytes and
-                           len(outer.store) > 1):
-                        if not ran:
-                            ran = True
+                    outer._stamps[fp] = outer._clock()
+                    ran = [False]
+
+                    def evict(victim, age_pass):
+                        if not ran[0]:
+                            ran[0] = True
                             STATS.add("gc_runs")
-                        _, ev = outer.store.popitem(last=False)
+                        ev = outer.store.pop(victim)
+                        outer._stamps.pop(victim, None)
                         outer.store_bytes -= len(ev)
                         outer.gc_evicted += 1
                         STATS.add("gc_evicted")
+                        if age_pass:
+                            STATS.add("gc_age_evicted")
                         STATS.add("gc_bytes", len(ev))
+
+                    # age pass: drop entries nobody touched within the
+                    # bound, whatever the byte total (never the entry
+                    # just written, never a live-bundle fingerprint)
+                    if outer.max_age_s > 0:
+                        cutoff = outer._clock() - outer.max_age_s
+                        for victim in [k for k, t in
+                                       outer._stamps.items()
+                                       if t < cutoff and k != fp]:
+                            if victim in protected:
+                                STATS.add("gc_protected")
+                                continue
+                            evict(victim, age_pass=True)
+                    # size pass: evict coldest-accessed until back
+                    # under the cap (never the entry just written or a
+                    # protected fingerprint, however large)
+                    if outer.max_bytes > 0 and \
+                            outer.store_bytes > outer.max_bytes:
+                        for victim in list(outer.store):
+                            if outer.store_bytes <= outer.max_bytes \
+                                    or len(outer.store) <= 1:
+                                break
+                            if victim == fp:
+                                continue
+                            if victim in protected:
+                                STATS.add("gc_protected")
+                                continue
+                            evict(victim, age_pass=False)
                 self.send_response(201)
                 self.end_headers()
 

@@ -86,6 +86,15 @@ KNOBS = {
         "executable when out aliases an input (in-place update "
         "pattern). Donation deletes the old buffer on TPU — only "
         "enable when no detach()/copyto snapshot still references it"),
+    "MXNET_DISPATCH_EAGER_PERSIST": (
+        "wired", "ndarray.registry",
+        "AOT-compile + persist dispatch executables at first-compile "
+        "time instead of on the first in-process hit (default 0): a "
+        "one-shot construction op never hits twice, so without this "
+        "its executable never reaches the disk/remote tier and every "
+        "bundle-warm replica re-traces it. Set on bundle-exporting / "
+        "remote-publishing replicas; off elsewhere (eager AOT adds "
+        "one trace+compile per unique dispatch)"),
     "MXNET_KVSTORE_GAP_TOLERANCE": (
         "wired", "kvstore_ps",
         "dist_async: seconds rank 0 waits on a missing gradient seq "
@@ -213,6 +222,21 @@ KNOBS = {
         "to 80% of the cap every 32nd publish (concurrent-pruner "
         "tolerant), ArtifactCacheServer evicts least-recently-fetched "
         "blobs on PUT; evictions land in mxnet_artifact_gc_* counters"),
+    "MXNET_ARTIFACT_GC_MAX_AGE_S": (
+        "wired", "artifact.remote",
+        "age bound in seconds on remote artifact-store entries "
+        "(default 0 = no age bound): file:// publishers and "
+        "ArtifactCacheServer drop entries untouched for longer, "
+        "whatever the byte total — only age can reclaim a dead "
+        "fingerprint nobody re-publishes (mxnet_artifact_gc_age_"
+        "evicted counts them)"),
+    "MXNET_ARTIFACT_GC_PROTECT": (
+        "wired", "artifact.bundle",
+        "os.pathsep-separated deployment-bundle paths whose manifests "
+        "pin their fingerprints against remote-store GC (salt-"
+        "agnostic; cached by mtime+size). Bundles this process "
+        "exported or imported are pinned automatically — skipped "
+        "victims land in mxnet_artifact_gc_protected"),
     "MXNET_SHAPE_BUCKETS": (
         "wired", "ndarray.registry",
         "automatic batch-axis shape bucketing for eager dispatch: "
@@ -288,7 +312,34 @@ KNOBS = {
         "wired", "serving.repository",
         "slice of non-critical traffic routed to a canary version "
         "(default 0.1), deterministic counter-based routing; "
-        "critical-class requests never ride a canary"),
+        "critical-class requests never ride a canary; the fleet "
+        "router reuses it for replica-level canary shadow pairs"),
+    "MXNET_FLEET_VNODES": (
+        "wired", "serving.fleet",
+        "virtual nodes per replica on the consistent-hash ring "
+        "(default 64): more vnodes smooth session placement at the "
+        "cost of a larger ring"),
+    "MXNET_FLEET_PROBE_MS": (
+        "wired", "serving.fleet",
+        "fleet router health-gossip interval in ms (default 100): "
+        "each round GETs every replica's /healthz, feeds the "
+        "per-replica ejection breaker, and refreshes queue-depth "
+        "gossip for least-loaded routing and fleet-wide admission"),
+    "MXNET_FLEET_TIMEOUT_MS": (
+        "wired", "serving.fleet",
+        "router->replica HTTP timeout in ms (default 30000) for "
+        "forwarded requests, health probes, and drain transfers; a "
+        "timeout counts as a transport failure (breaker + retry)"),
+    "MXNET_FLEET_DRAIN_TIMEOUT_MS": (
+        "wired", "serving.fleet",
+        "drain budget in ms (default 10000): bounds the queue-empty "
+        "wait during FleetRouter.drain and how long a request for a "
+        "mid-drain session parks before its 503"),
+    "MXNET_FLEET_RETRIES": (
+        "wired", "serving.fleet",
+        "cross-replica retries for STATELESS requests after a "
+        "transport failure (default 2); stateful requests never "
+        "retry across replicas — their state lives on exactly one"),
     "MXNET_SERVING_CANARY_MIN_REQUESTS": (
         "wired", "serving.repository",
         "clean canary completions required before auto-promote "

@@ -261,6 +261,21 @@ def eager_jit_enabled():
     return _env.get_bool("MXNET_EAGER_JIT", True)
 
 
+def _eager_persist_enabled():
+    # round 23 (fleet): AOT-compile + persist a dispatch executable AT
+    # first-compile time instead of on the first in-process HIT. A
+    # one-shot construction op (weight init, a preprocessing reshape)
+    # never hits twice in its compiling process, so its executable
+    # never reached the disk/remote tier and every bundle-warm replica
+    # re-traced it. Default OFF: eager AOT adds one trace+compile per
+    # unique dispatch, which only pays off when another process will
+    # consume the artifact (set it on bundle-exporting/publishing
+    # replicas).
+    from .. import env as _env
+
+    return _env.get_bool("MXNET_DISPATCH_EAGER_PERSIST", False)
+
+
 def _donate_enabled():
     # OPT-IN: donation deletes the out= buffer on backends that honor it
     # (TPU), which breaks any other NDArray still aliasing that jax.Array
@@ -496,9 +511,20 @@ def _dispatch_cached(opdef, pure_fn, arr_args, out, wrap, wrap_cls,
             if out._data.shape == src.shape and out._data.dtype == src.dtype:
                 donate = donate_slot
         normalized = _normalize_output(pure_fn)
-        _CACHE.insert(key, _CacheEntry(
+        new_entry = _CacheEntry(
             _build_jfn(normalized, recording, donate, label=opdef.name),
-            normalized, n_keys, recording, donate, art))
+            normalized, n_keys, recording, donate, art)
+        _CACHE.insert(key, new_entry)
+        if art is not None and not recording \
+                and _eager_persist_enabled():
+            # persist NOW (one AOT compile of the body just traced)
+            # rather than on a first hit that a one-shot op never
+            # takes; the stored envelope also rides the remote publish
+            # path, so a bundle-warm replica truly starts at zero
+            # compiles. The key values are stand-ins — only their
+            # shape/dtype reach the lowering
+            _resolve_entry_call(
+                new_entry, tuple(klog.keys or ()), datas)
         if plan is not None:
             result = _unbucket_result(result, plan, wrap)
         return True, result

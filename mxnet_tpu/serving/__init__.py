@@ -67,6 +67,8 @@ __all__ = ["InferenceSession", "DynamicBatcher", "ModelServer",
            "ModelRepository", "AdmissionController", "ShedLoad",
            "ServerBusy", "RequestTimeout", "SLO_CLASSES",
            "SessionStateStore", "SessionEvicted",
+           "FleetRouter", "Replica", "ReplicaProcess", "spawn_replica",
+           "fleet_counters", "reset_fleet_counters",
            "parse_buckets", "serving_enabled", "serving_stats",
            "reset_serving_counters", "prometheus_text", "METRICS"]
 
@@ -89,3 +91,6 @@ from .batcher import DynamicBatcher, RequestTimeout, ServerBusy  # noqa: E402
 from .admission import AdmissionController, ShedLoad  # noqa: E402
 from .repository import ModelRepository  # noqa: E402
 from .server import ModelServer  # noqa: E402
+from .fleet import (FleetRouter, Replica, ReplicaProcess,  # noqa: E402
+                    fleet_counters, reset_fleet_counters,
+                    spawn_replica)

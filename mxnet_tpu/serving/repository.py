@@ -676,16 +676,21 @@ class ModelRepository:
                 vh = m.versions.get(m.active)
             if vh is not None:
                 sess = vh.session
-                info["warm"] = bool(getattr(sess, "warm", True))
+                # one consistent warm/degraded/breaker view under the
+                # session's ranked lock (round 23) instead of three
+                # independently-raced reads
+                if hasattr(sess, "health_snapshot"):
+                    snap = sess.health_snapshot()
+                else:
+                    snap = {"warm": True, "degraded_buckets": [],
+                            "open_buckets": []}
+                info["warm"] = bool(snap["warm"])
                 store = getattr(sess, "state_store", None)
                 if store is not None:
                     info["session_state"] = store.stats()
                 info["degraded_buckets"] = list(
-                    getattr(sess, "degraded", []))
-                info["open_buckets"] = sorted(
-                    b for b, s in getattr(sess, "breaker_states",
-                                          dict)().items()
-                    if s != "closed")
+                    snap["degraded_buckets"])
+                info["open_buckets"] = list(snap["open_buckets"])
             out[name] = info
         return out
 

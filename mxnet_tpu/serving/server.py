@@ -44,6 +44,7 @@ from __future__ import annotations
 import io
 import json
 import logging
+import pickle
 import threading
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -205,18 +206,22 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 self._reply(200 if doc["warm"] else 503, doc)
                 return
             session = srv.session
-            warm = bool(getattr(session, "warm", True))
             # resilience state rides along: buckets demoted to the jit
             # path and open circuit breakers (serving/session.py). A
             # degraded-but-warm replica still answers 200 — it serves,
             # just slower — so the LB keeps it while operators see the
-            # "degraded" status and act on it
-            degraded = list(getattr(session, "degraded", []))
-            states = getattr(session, "breaker_states", dict)()
-            open_buckets = sorted(b for b, s in states.items()
-                                  if s != "closed")
+            # "degraded" status and act on it. ONE consistent snapshot
+            # under the session lock (round 23) — the old per-field
+            # reads could stitch a bucket both warm and demoted
+            if hasattr(session, "health_snapshot"):
+                snap = session.health_snapshot()
+            else:
+                snap = {"warm": True, "buckets": [],
+                        "degraded_buckets": [], "open_buckets": []}
+            warm = bool(snap["warm"])
             status = "ok" if warm else "warming"
-            if warm and (degraded or open_buckets):
+            if warm and (snap["degraded_buckets"]
+                         or snap["open_buckets"]):
                 status = "degraded"
             adm = getattr(srv.batcher, "admission", None)
             store = getattr(session, "state_store", None)
@@ -225,16 +230,37 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._reply(200 if warm else 503, {
                 "status": status,
                 "warm": warm,
-                "buckets": list(getattr(session, "buckets", [])),
-                "degraded_buckets": degraded,
-                "open_buckets": open_buckets,
+                "buckets": list(snap["buckets"]),
+                "degraded_buckets": snap["degraded_buckets"],
+                "open_buckets": snap["open_buckets"],
                 "queue_depth": srv.batcher.qsize(),
+                # round 23: capacity rides along so a fleet router can
+                # aggregate gossiped depth/capacity into its own
+                # admission ladder without a second endpoint
+                "queue_capacity": srv.batcher.queue_capacity(),
                 # the ROADMAP "budget signal": how much SLO headroom is
                 # left (1.0 idle .. 0.0 blown) and who is shedding
                 "queue_depths": srv.batcher.qsize_by_class(),
                 "slo": adm.snapshot() if adm is not None else None,
                 # stateful serving: live session-state pool occupancy
                 "state": store.stats() if store is not None else None})
+        elif self.path == "/admin/export_state":
+            # fleet drain (round 23): hand this replica's live decode
+            # state to the router, which repartitions it onto peers.
+            # Dense-row export (round 16/21) crosses paging geometries,
+            # so the receiving replica may run different PAGE_TOKENS /
+            # KV quantization. Internal surface — pickle, like bundles.
+            store = getattr(srv.session, "state_store", None) \
+                if srv.session is not None else None
+            if store is None:
+                self._error(409, "no session state store behind this "
+                                 "server (stateless or repository "
+                                 "mode)")
+                return
+            payload = pickle.dumps(store.export_state(),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            self._reply(200, payload,
+                        content_type="application/octet-stream")
         elif self.path == "/models":
             if srv.repository is None:
                 self._error(404, "no model repository behind this "
@@ -290,7 +316,40 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 self._do_post()
                 sp.set(status=self._status)
 
+    def _restore_state(self):
+        """POST /admin/restore_state — fleet drain receive side: a
+        pickled ``export_state`` payload (possibly a repartitioned
+        subset) lands in this replica's state pool. Replies with the
+        number of sessions restored."""
+        srv = self.model_server
+        store = getattr(srv.session, "state_store", None) \
+            if srv.session is not None else None
+        if store is None:
+            self._error(409, "no session state store behind this "
+                             "server (stateless or repository mode)")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._error(400, "bad Content-Length")
+            return
+        if length <= 0 or length > _MAX_BODY:
+            self._error(400, f"body length {length} out of bounds "
+                             f"(max {_MAX_BODY})")
+            return
+        try:
+            payload = pickle.loads(self.rfile.read(length))
+            restored = store.restore_state(payload)
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            self._error(400, f"unrestorable state payload: "
+                             f"{type(e).__name__}: {e}")
+            return
+        self._reply(200, {"restored": int(restored)})
+
     def _do_post(self):
+        if self.path == "/admin/restore_state":
+            self._restore_state()
+            return
         try:
             model = self._route_model()
         except LookupError as e:

@@ -685,13 +685,9 @@ class InferenceSession:
     @property
     def warm(self):
         """True when every configured bucket is resolved under the
-        current AMP policy."""
-        amp_ver = self._amp_version()
-        # observability snapshot; dict membership is atomic under the
-        # GIL and a racing resolve only flips this False -> True
-        entries = self._step_entries if self._state_specs \
-            else self._entries  # graft-lint: allow(L1102)
-        return all((b, amp_ver) in entries for b in self.buckets)
+        current AMP policy (consistent read under the session lock —
+        see :meth:`health_snapshot`)."""
+        return self.health_snapshot()["warm"]
 
     # -- the request path ---------------------------------------------
 
@@ -909,6 +905,35 @@ class InferenceSession:
             breakers = dict(self._breakers)
         return {b: br.state for (b, v), br in breakers.items()
                 if v == amp_ver}
+
+    def health_snapshot(self):
+        """One CONSISTENT health view for /healthz probes: warmth,
+        demoted buckets, and breaker states read under a single
+        acquisition of the session lock. The pre-round-23 surface
+        stitched three independent reads (``warm`` / ``degraded`` /
+        ``breaker_states``) together, so a probe racing a resolve or a
+        demotion could report a bucket simultaneously warm and
+        demoted; the L1102 guards audit flagged the lock-free reads as
+        allow-pragma'd. Returns ``{"warm", "buckets",
+        "degraded_buckets", "breaker_states", "open_buckets"}``."""
+        amp_ver = self._amp_version()
+        with self._lock:
+            entries = self._step_entries if self._state_specs \
+                else self._entries
+            warm = all((b, amp_ver) in entries for b in self.buckets)
+            demoted = set(self._demoted)
+            breakers = dict(self._breakers)
+        states = {b: br.state for (b, v), br in breakers.items()
+                  if v == amp_ver}
+        return {
+            "warm": warm,
+            "buckets": list(self.buckets),
+            "degraded_buckets": sorted(
+                b for b, v in demoted if v == amp_ver),
+            "breaker_states": states,
+            "open_buckets": sorted(
+                b for b, s in states.items() if s != "closed"),
+        }
 
     def _run_bucket(self, arrs, n):
         """Execute one <=max_batch slice through its bucket executable;

@@ -32,7 +32,8 @@ from __future__ import annotations
 
 from ..utils import locks as _locks
 
-__all__ = ["CounterFamily", "MetricsRegistry", "REGISTRY",
+__all__ = ["labeled_lines",
+           "CounterFamily", "MetricsRegistry", "REGISTRY",
            "counter_family", "register_family", "register_exposition",
            "family_snapshot", "snapshot", "prometheus_text"]
 
@@ -258,6 +259,35 @@ def prometheus_text():
     round 18): serving histograms + every training-side family."""
     _bootstrap_probes()
     return REGISTRY.prometheus_text()
+
+
+def labeled_lines(metric, rows, help_text=None):
+    """Render one LABELED gauge metric as Prometheus text lines (round
+    23: the fleet router's per-replica series). ``rows`` is an
+    iterable of ``(labels_dict, value)``; returns ``[]`` when empty so
+    an exposition block can concatenate unconditionally. Label values
+    are escaped per the text-format rules (backslash, quote,
+    newline); non-numeric values are skipped like the gauge pass."""
+    rows = list(rows)
+    if not rows:
+        return []
+    san = MetricsRegistry._sanitize
+    name = f"mxnet_{san(metric)}"
+    lines = [f"# HELP {name} {help_text or metric}",
+             f"# TYPE {name} gauge"]
+    for labels, value in rows:
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            continue
+        lab = ",".join(
+            '{}="{}"'.format(
+                san(str(k)),
+                str(v).replace("\\", r"\\").replace('"', r'\"')
+                .replace("\n", r"\n"))
+            for k, v in sorted(labels.items()))
+        lines.append(f"{name}{{{lab}}} {value}")
+    return lines
 
 
 # -- probe bootstrap --------------------------------------------------------

@@ -76,6 +76,7 @@ LOCK_RANKS = {
     "engine.waiters": 0,
     "engine.singleton": 5,      # get()/fork re-init guard; never nests
     # serving control plane (outer -> inner along the request path)
+    "serving.fleet": 8,         # FleetRouter replica table + hash ring
     "repository": 10,           # ModelRepository registration dict
     "repository.model": 20,     # per-_Model deploy/promote/rollback
     "batcher": 30,              # DynamicBatcher _closed flag
@@ -87,6 +88,7 @@ LOCK_RANKS = {
     "artifact.salts": 70,       # salt-provider registry
     "artifact.remote.breakers": 72,  # per-URL breaker table
     "artifact.server.store": 74,     # ArtifactCacheServer object store
+    "artifact.bundle.protected": 75,  # live-bundle fingerprint pins
     "kernels.serving_fused": 76,     # pad/slice jit caches
     # leaf utilities: callable from under any of the above
     "resilience.faults": 78,    # fault-injection plan + fire counts

@@ -32,10 +32,12 @@ nd = mx.nd
 def _fresh_artifact_state():
     artifact.reset_artifact_counters()
     artifact.reset_remote_state()
+    artifact.reset_protected_fingerprints()
     cc.reset_compile_cache_counters()
     yield
     artifact.reset_artifact_counters()
     artifact.reset_remote_state()
+    artifact.reset_protected_fingerprints()
 
 
 def _mlp(seed=3, out_dim=4):
@@ -275,6 +277,44 @@ def test_remote_file_gc_survives_concurrent_pruner(monkeypatch,
     assert total <= 1024 * 1024, (total, left)
 
 
+def test_remote_file_gc_age_bound_and_bundle_protection(monkeypatch,
+                                                        tmp_path):
+    """Round 23: entries older than MXNET_ARTIFACT_GC_MAX_AGE_S are
+    reclaimed even while the store is under its byte cap — only age
+    can collect a dead fingerprint nobody re-publishes — and
+    fingerprints named by a live bundle manifest (here via the
+    MXNET_ARTIFACT_GC_PROTECT knob) survive the sweep."""
+    import pickle
+    import time
+
+    shared = str(tmp_path / "shared")
+    monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", "file://" + shared)
+    monkeypatch.setenv("MXNET_ARTIFACT_GC_MAX_AGE_S", "3600")
+    monkeypatch.setattr(art_remote, "_GC_EVERY", 1)
+    os.makedirs(shared)
+    now = time.time()
+    for name, age in (("old0", 7200.0), ("old1", 7200.0),
+                      ("fresh0", 10.0)):
+        p = os.path.join(shared, name + ".mxc")
+        with open(p, "wb") as f:
+            f.write(b"x" * 64)
+        os.utime(p, (now - age, now - age))
+    # a live bundle manifest pins old1 (the knob path is deliberately
+    # salt-agnostic: a shared mount serves replicas of every salt)
+    bp = str(tmp_path / "pin.bundle")
+    with open(bp, "wb") as f:
+        pickle.dump({"format": artifact.BUNDLE_FORMAT, "salt": "any",
+                     "manifest": {}, "entries": {"old1": b""}}, f)
+    monkeypatch.setenv("MXNET_ARTIFACT_GC_PROTECT", bp)
+    assert art_remote.publish("freshfp", b"z" * 16)
+    left = {f[:-4] for f in os.listdir(shared) if f.endswith(".mxc")}
+    assert left == {"old1", "fresh0", "freshfp"}
+    st = artifact.artifact_stats()
+    assert st["gc_runs"] == 1
+    assert st["gc_evicted"] == 1 and st["gc_age_evicted"] == 1
+    assert st["gc_protected"] == 1
+
+
 # ---------------------------------------------------------------------------
 # remote tier: HTTP backend + resilience
 
@@ -309,6 +349,39 @@ def test_artifact_server_evicts_least_recently_fetched(monkeypatch):
         assert st["gc_runs"] == 1 and st["gc_evicted"] == 1
         assert st["gc_bytes"] == 100
         assert art_remote.fetch("bb") is None  # evicted = clean miss
+
+
+def test_artifact_server_age_eviction_skips_live_bundle(monkeypatch,
+                                                        tmp_path):
+    """The reference server mirrors the file:// pruner's round-23
+    rules: a PUT drops entries untouched for max_age_s whatever the
+    byte total, but a fingerprint a live (imported) bundle references
+    is pinned."""
+    import pickle
+
+    # importing a salt-matching bundle registers its fingerprints as
+    # protected in-process
+    bp = str(tmp_path / "pin.bundle")
+    with open(bp, "wb") as f:
+        pickle.dump({"format": artifact.BUNDLE_FORMAT,
+                     "salt": cc._salt(), "manifest": {},
+                     "entries": {"bb": b"pinned-blob"}}, f)
+    assert artifact.import_bundle(bp)["stale"] is False
+    assert "bb" in artifact.protected_fingerprints()
+
+    clock = [0.0]
+    with artifact.ArtifactCacheServer(max_bytes=0, max_age_s=100,
+                                      clock=lambda: clock[0]) as srv:
+        monkeypatch.setenv("MXNET_ARTIFACT_REMOTE", srv.url)
+        assert art_remote.publish("aa", b"a" * 10)
+        assert art_remote.publish("bb", b"b" * 10)
+        clock[0] = 200.0  # both aa and bb are now past the age bound
+        assert art_remote.publish("cc", b"c" * 10)
+        assert set(srv.store) == {"bb", "cc"}
+        st = artifact.artifact_stats()
+        assert st["gc_age_evicted"] == 1 and srv.gc_evicted == 1
+        assert st["gc_protected"] == 1
+        assert art_remote.fetch("aa") is None
 
 
 def test_remote_http_flaky_host_retries(monkeypatch):

@@ -638,6 +638,79 @@ def test_bench_compare_quant_metrics():
     assert not any(r[4] for r in bench_compare.compare(base, base))
 
 
+@pytest.mark.slow
+def test_fleet_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import fleet_bench
+
+    out = str(tmp_path / "fleet.json")
+    doc = fleet_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    r = doc["results"]
+    # correctness gates hold at any scale: zero dropped requests and
+    # zero corrupted sessions through a live drain (bitwise vs the
+    # offline unroll), the joining replica warm at zero compiles, the
+    # canary rolled back with zero client-visible failures
+    assert r["drain_dropped_requests"] == 0
+    assert r["drain_corrupted_sessions"] == 0
+    assert r["drain_migrated_sessions"] >= 1
+    assert r["replicas_after_drain"] == ["b", "c"]
+    assert r["join_compiles_must_be_zero"] == 0
+    assert r["join_retraces_must_be_zero"] == 0
+    assert r["join_disk_hits"] > 0
+    assert r["canary_failures_must_be_zero"] == 0
+    assert r["canary_wrong_answers_must_be_zero"] == 0
+    assert r["canary_rolled_back"]
+    assert r["canary_shadow_mismatches"] >= 1
+    # the 2.5x aggregate-throughput floor is a compute fan-out claim —
+    # only a host with cores to spare can express it; a core-bound box
+    # still must not collapse behind the router
+    assert r["single_replica_rps"] > 0
+    if r["scale_floor_applies"]:
+        assert r["fleet_scale_speedup"] >= doc["scale_floor_x"], r
+    else:
+        assert r["fleet_scale_speedup"] > 0.5, r
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "fleet"
+
+
+def test_bench_compare_fleet_metrics():
+    """BENCH_FLEET_r23.json names: rps/speedup leaves directional,
+    dropped/corrupted/_must_be_zero leaves gated EXACTLY (nonzero
+    candidate regresses even against a zero baseline), cpu_count
+    untracked."""
+    base = {"results": {"single_replica_rps": 40.0,
+                        "fleet3_aggregate_rps": 110.0,
+                        "fleet_scale_speedup": 2.75,
+                        "drain_dropped_requests": 0,
+                        "drain_corrupted_sessions": 0,
+                        "join_compiles_must_be_zero": 0,
+                        "canary_failures_must_be_zero": 0,
+                        "cpu_count": 8}}
+    worse = {"results": {"single_replica_rps": 40.0,
+                         "fleet3_aggregate_rps": 50.0,
+                         "fleet_scale_speedup": 1.2,
+                         "drain_dropped_requests": 3,
+                         "drain_corrupted_sessions": 1,
+                         "join_compiles_must_be_zero": 2,
+                         "canary_failures_must_be_zero": 0,
+                         "cpu_count": 8}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "results.fleet3_aggregate_rps") == "higher"
+    assert bench_compare._exact_zero("results.drain_dropped_requests")
+    assert bench_compare._exact_zero("results.join_compiles_must_be_zero")
+    assert not bench_compare._exact_zero("results.fleet_scale_speedup")
+    assert rows["results.fleet3_aggregate_rps"][4]  # fan-out collapsed
+    assert rows["results.fleet_scale_speedup"][4]
+    # exact gates: ANY nonzero regresses, zero baseline notwithstanding
+    assert rows["results.drain_dropped_requests"][4]
+    assert rows["results.drain_corrupted_sessions"][4]
+    assert rows["results.join_compiles_must_be_zero"][4]
+    assert not rows["results.canary_failures_must_be_zero"][4]
+    assert "results.cpu_count" not in rows  # a host fact, not a speed
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_cli_exit_codes(tmp_path):
     base, new_ok, new_bad = (str(tmp_path / n) for n in
                              ("base.json", "ok.json", "bad.json"))

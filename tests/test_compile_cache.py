@@ -100,6 +100,36 @@ def test_dispatch_warm_start_skips_retrace():
     assert registry.dispatch_cache_stats()["hits"] >= 1
 
 
+def test_dispatch_eager_persist_stores_at_compile_time(monkeypatch):
+    """MXNET_DISPATCH_EAGER_PERSIST=1 (round 23, fleet replicas): the
+    dispatch executable is AOT-compiled and written to the disk tier
+    on the very first call — a one-shot construction op that never
+    hits again in its process still leaves an artifact, so a
+    bundle-warm replica truly starts at zero compiles."""
+    x = nd.ones((4, 8))
+    w = nd.ones((8, 8))
+    cc.reset_compile_cache_counters()
+    monkeypatch.setenv("MXNET_DISPATCH_EAGER_PERSIST", "1")
+    r_cold = nd.dot(x, w)  # ONE call — no in-process hit ever happens
+    s = cc.compile_cache_stats()
+    assert s["disk_writes"] == 1, s
+    assert _mxc_files(), "eager persist left no disk entry"
+    # simulated restart: the single warm call serves from disk
+    registry.reset_dispatch_cache()
+    cc.reset_compile_cache_counters()
+    r_warm = nd.dot(x, w)
+    s = cc.compile_cache_stats()
+    assert s["disk_hits"] == 1 and s["retraces"] == 0, s
+    assert onp.array_equal(r_cold.asnumpy(), r_warm.asnumpy())
+    # default (off): a single call persists nothing — eager AOT is an
+    # exporting-replica tax the common path must not pay
+    monkeypatch.delenv("MXNET_DISPATCH_EAGER_PERSIST")
+    registry.reset_dispatch_cache()
+    cc.reset_compile_cache_counters()
+    nd.tanh(x)
+    assert cc.compile_cache_stats()["disk_writes"] == 0
+
+
 def test_recording_entries_are_not_persisted():
     """vjp pullbacks carry live functions in their output pytree — they
     cannot serialize and must count as serialize_skips, not break."""

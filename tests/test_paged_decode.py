@@ -13,6 +13,8 @@ re-keying per geometry while row-slot keys stay byte-stable, warm
 process start resolving the paged step executable with zero retraces,
 and int8 KV pages (accuracy bound + counters + the unbacked-page
 scatter guard)."""
+import pickle
+
 import numpy as onp
 import pytest
 
@@ -256,6 +258,93 @@ def _oracle_fresh(net, toks):
         return _oracle(sess, toks)
     finally:
         sess.close()
+
+
+def test_fleet_migration_page16_restores_into_page64_int8():
+    """Round-23 fleet drain wire form: a session exported from a
+    replica paging KV at PAGE_TOKENS=16 restores onto a replica
+    running page size 64 with int8 KV pages on. The payload is dense
+    rows, so the 16 -> 64 crossing itself is bitwise: an fp32
+    destination reads back the exported rows byte-for-byte and
+    continues bitwise vs the offline oracle; the int8 destination
+    keeps every NON-pageable row bitwise and its KV pages inside the
+    documented quantization bound (its own storage choice, not a
+    migration loss)."""
+    mx.random.seed(23)
+    net64 = DecoderBlockLM(VOCAB, embed_dim=EMBED, num_layers=LAYERS,
+                           num_heads=HEADS, max_len=64, impl="lax")
+    net64.initialize()
+    with autograd.pause(train_mode=False):
+        net64(nd.zeros((1, 1), dtype="int32"), *_zero_states(net64))
+    toks = _toks(47, 12)
+    sess = _session(net64, _store(net64, page_tokens=16))
+    bat = serving.DynamicBatcher(sess, max_batch_size=2,
+                                 max_latency_ms=2.0,
+                                 timeout_ms=120000.0, admission=False)
+    try:
+        for x in toks[:6]:
+            bat.submit(x, session_id="u", block=True).result(timeout=120)
+    finally:
+        bat.close()
+    # the exact bytes a FleetRouter drain moves between replicas
+    wire = pickle.dumps(sess.state_store.export_state(),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    sess.close()
+    payload = pickle.loads(wire)
+    assert list(payload["sessions"]) == ["u"]
+    src_rows = payload["sessions"]["u"]["states"]
+    pageable = net64.state_row_pageable()
+    ref_o, _ = _oracle_fresh(net64, toks)
+
+    # fp32 page-64 destination: dense rows land bitwise, decode
+    # continues bitwise
+    sess64 = _session(net64, _store(net64, page_tokens=64))
+    bat64 = serving.DynamicBatcher(sess64, max_batch_size=2,
+                                   max_latency_ms=2.0,
+                                   timeout_ms=120000.0, admission=False)
+    try:
+        assert sess64.state_store.restore_state(
+            pickle.loads(wire)) == 1
+        for got, want in zip(sess64.state_store.read("u"), src_rows):
+            assert onp.array_equal(onp.asarray(got), onp.asarray(want))
+        for x in toks[6:]:
+            out = onp.asarray(bat64.submit(
+                x, session_id="u", block=True).result(timeout=120))
+        assert onp.array_equal(out, ref_o), \
+            "page 16 -> 64 migration not bitwise"
+    finally:
+        bat64.close()
+        sess64.close()
+
+    # page-64 + int8-KV destination: non-pageable rows stay bitwise,
+    # KV pages and the continued decode stay inside the int8 bound
+    quantize.reset_counters()
+    sess8 = _session(net64, _store(net64, page_tokens=64,
+                                   kv_int8=True))
+    bat8 = serving.DynamicBatcher(sess8, max_batch_size=2,
+                                  max_latency_ms=2.0,
+                                  timeout_ms=120000.0, admission=False)
+    try:
+        assert sess8.state_store.restore_state(
+            pickle.loads(wire)) == 1
+        assert quantize.counters()["kv_pages_quantized"] > 0
+        for got, want, paged in zip(sess8.state_store.read("u"),
+                                    src_rows, pageable):
+            got, want = onp.asarray(got), onp.asarray(want)
+            if paged:
+                denom = max(float(onp.abs(want).max()), 1e-6)
+                assert float(onp.abs(got - want).max()) / denom < 0.1
+            else:
+                assert onp.array_equal(got, want)
+        for x in toks[6:]:
+            out8 = onp.asarray(bat8.submit(
+                x, session_id="u", block=True).result(timeout=120))
+        denom = max(float(onp.abs(ref_o).max()), 1e-6)
+        assert float(onp.abs(out8 - ref_o).max()) / denom < 0.1, \
+            "int8 destination drifted past the KV accuracy bound"
+    finally:
+        bat8.close()
+        sess8.close()
 
 
 # ---------------------------------------------------------------------------

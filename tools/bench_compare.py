@@ -55,6 +55,12 @@ inferred from the leaf name:
   (BENCH_PAGED_r21.json late-prefix over early-prefix step cost —
   growth means decode stopped being O(1) in prefix depth)
 
+Correctness leaves are gated EXACTLY rather than relatively:
+``*dropped*`` / ``*corrupted*`` / ``*_must_be_zero`` (fleet
+drain/canary gates from BENCH_FLEET_r23.json — a dropped request or a
+corrupted migrated session regresses at ANY nonzero value, including
+against a zero baseline).
+
 Other numeric leaves (shapes, iteration counts, counters) are ignored.
 Exits nonzero when any tracked metric regresses by more than the
 threshold (default 20%), so CI can pin benchmark results against a
@@ -80,6 +86,18 @@ HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)
+# exact-zero correctness gates (BENCH_FLEET_r23.json): a dropped
+# request or a corrupted migrated session is a correctness failure,
+# not a performance delta — any nonzero candidate value regresses,
+# even against a zero baseline the relative rules would skip
+EXACT_ZERO = ("dropped", "corrupted")
+EXACT_ZERO_SUFFIXES = ("_must_be_zero",)
+
+
+def _exact_zero(path):
+    leaf = path.rsplit(".", 1)[-1].lower()
+    return (any(tag in leaf for tag in EXACT_ZERO)
+            or leaf.endswith(EXACT_ZERO_SUFFIXES))
 
 
 def _direction(path):
@@ -115,6 +133,13 @@ def compare(base_doc, new_doc, threshold=0.2):
     new = numeric_leaves(new_doc)
     rows = []
     for path in sorted(set(base) & set(new)):
+        if _exact_zero(path):
+            # exact gate: regressed iff the candidate is nonzero; the
+            # baseline value is reported but never excuses a failure
+            rel = new[path]
+            rows.append((path, base[path], new[path], rel,
+                         new[path] != 0))
+            continue
         direction = _direction(path)
         if direction is None or base[path] == 0:
             continue
