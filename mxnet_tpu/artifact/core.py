@@ -84,12 +84,19 @@ class CompiledArtifact:
         once per instance: provider tuples are folded in only when
         salts are declared, so salt-free kinds ('dispatch',
         'fused_step') keep their pre-artifact-layer fingerprints and
-        existing disk entries stay valid."""
+        existing disk entries stay valid. Empty contributions are
+        dropped before folding — a declared-but-inactive provider
+        (fp32 graph under the quantize salt, no active tuning record
+        under the autotune salt) leaves the key exactly as it would be
+        without the declaration, so adding a provider to a
+        declaration never cold-starts the caches of artifacts it
+        doesn't affect."""
         if not self._fp_resolved:
             if self.key is None:  # explicitly memory-only
                 self._fp = None
             else:
-                salted = _salts.resolve_salts(self.salts, self.salt_ctx)
+                salted = tuple(t for t in _salts.resolve_salts(
+                    self.salts, self.salt_ctx) if t)
                 key = ((self.key, ("salts",) + salted) if salted
                        else self.key)
                 self._fp = _cc.fingerprint(self.kind, key,

@@ -32,6 +32,8 @@ sharding     sharding/plan.py            ``shard`` (None or
                                          ``{"plan", "mesh"}``)
 quantize     analysis/quantize.py        ``graph_signature`` (nnvm JSON
                                          or None)
+autotune     autotune/records.py         (none — salt is the active
+                                         TuningRecord set)
 ===========  ==========================  =================================
 """
 from __future__ import annotations
@@ -55,6 +57,7 @@ _BUILTIN_MODULES = {
     "quantize": "mxnet_tpu.analysis.quantize",
     "sharding": "mxnet_tpu.sharding.plan",
     "paged_state": "mxnet_tpu.serving.state",
+    "autotune": "mxnet_tpu.autotune",
 }
 
 

@@ -50,29 +50,16 @@ from __future__ import annotations
 import argparse
 import gc
 import json
-import statistics
 import threading
 import time
 
 import numpy as onp
 
 
-def _paired_overhead(measure_base, measure_test, pairs, reps=1):
-    """Median of per-pair (test / base) ratios over adjacent
-    alternating pairs; each half is the min of ``reps`` windows.
-    Returns (best_base, best_test, overhead_pct)."""
-    best = {"base": float("inf"), "test": float("inf")}
-    ratios = []
-    for i in range(pairs):
-        order = ("test", "base") if i % 2 == 0 else ("base", "test")
-        got = {}
-        for side in order:
-            fn = measure_base if side == "base" else measure_test
-            got[side] = min(fn() for _ in range(reps))
-            best[side] = min(best[side], got[side])
-        ratios.append(got["test"] / got["base"])
-    overhead = (statistics.median(ratios) - 1.0) * 100
-    return best["base"], best["test"], overhead
+# round 24: the paired-median implementation moved to the shared
+# helper (benchmark/_measure.py); this bench, telemetry_bench and the
+# autotuner all measure through the one copy
+from ._measure import paired_overhead as _paired_overhead
 
 
 # ---------------------------------------------------------------------------

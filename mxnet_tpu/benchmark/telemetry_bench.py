@@ -58,7 +58,6 @@ import argparse
 import gc
 import json
 import os
-import statistics
 import time
 
 import numpy as onp
@@ -72,29 +71,21 @@ _LIFECYCLE = {"serving.admission", "serving.queue_wait",
 # phase 1: fused-step loop, telemetry off vs on
 
 def _paired_overhead(measure, pairs, reps=1):
-    """Measure back-to-back (telem1, telem0) pairs and take the MEDIAN
-    of the per-pair ratios. CPU-frequency/scheduler drift moves on a
-    scale of seconds, so it hits both halves of an adjacent pair
-    equally and cancels in the ratio — where best-of-independent-runs
-    would credit whichever side happened to land on the quiet
-    interval. Pair order alternates so within-pair drift cancels in
-    the median too; each half takes the min of ``reps`` calls, which
-    filters one-sided preemption spikes (a slow patch landing on one
-    half of a pair skews that ratio by far more than the effect being
-    measured). ``measure`` returns seconds-like cost (lower is
-    better); returns (best0, best1, overhead_pct)."""
-    best = {0: float("inf"), 1: float("inf")}
-    ratios = []
-    for i in range(pairs):
-        order = (1, 0) if i % 2 == 0 else (0, 1)
-        got = {}
-        for lvl in order:
-            os.environ["MXNET_TELEMETRY"] = str(lvl)
-            got[lvl] = min(measure() for _ in range(reps))
-            best[lvl] = min(best[lvl], got[lvl])
-        ratios.append(got[1] / got[0])
-    overhead = (statistics.median(ratios) - 1.0) * 100
-    return best[0], best[1], overhead
+    """Measure back-to-back (telem1, telem0) pairs through the shared
+    paired-median helper (``benchmark/_measure.py`` — the round-18
+    methodology, extracted in round 24): each half of an adjacent
+    alternating pair flips ``MXNET_TELEMETRY`` before calling
+    ``measure`` (seconds-like cost, lower is better); returns
+    (best0, best1, overhead_pct)."""
+    from ._measure import paired_overhead
+
+    def _at_level(lvl):
+        def m():
+            os.environ["MXNET_TELEMETRY"] = lvl
+            return measure()
+        return m
+
+    return paired_overhead(_at_level("0"), _at_level("1"), pairs, reps)
 
 
 def _fused_step_phase(smoke):

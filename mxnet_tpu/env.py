@@ -237,6 +237,26 @@ KNOBS = {
         "agnostic; cached by mtime+size). Bundles this process "
         "exported or imported are pinned automatically — skipped "
         "victims land in mxnet_artifact_gc_protected"),
+    "MXNET_AUTOTUNE": (
+        "wired", "autotune",
+        "empirical-autotuning mode: 0 (off — consults return the "
+        "hand-written heuristics, the autotune salt contributes "
+        "nothing) / consult (default — cost models read persisted "
+        "TuningRecords, never measure online) / tune (additionally "
+        "allow autotune.tune() sweeps; offline tuning jobs and "
+        "benchmarks only, never a serving replica)"),
+    "MXNET_AUTOTUNE_DIR": (
+        "wired", "autotune.records",
+        "directory for persisted TuningRecords (default "
+        "$MXNET_HOME/autotune); one <fingerprint>.atr JSON file per "
+        "measured decision, written tmp+rename atomic. Records also "
+        "ride the MXNET_ARTIFACT_REMOTE store, so one replica's "
+        "measurement serves the fleet"),
+    "MXNET_AUTOTUNE_BUDGET_MS": (
+        "wired", "autotune.tuner",
+        "wall-clock budget for one autotune.tune() sweep (default "
+        "60000, 0 = unbounded); checked between candidates — the "
+        "sweep stops early keeping the best so far"),
     "MXNET_SHAPE_BUCKETS": (
         "wired", "ndarray.registry",
         "automatic batch-axis shape bucketing for eager dispatch: "

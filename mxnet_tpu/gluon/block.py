@@ -708,19 +708,22 @@ class SymbolBlock(HybridBlock):
 
     def _optimized_outputs(self):
         """MXNET_GRAPH_OPT-gated rewrite of the output graph, cached per
-        (level, pipeline version, fusion salt) so toggling the fusion
-        knobs re-optimizes. Every forward — eager, under the
-        hybridized CachedOp trace, and the serving session's ``_pure``
-        — evaluates this graph, so one rewrite covers all three."""
+        (level, pipeline version, fusion salt, autotune salt) so
+        toggling the fusion knobs — or a tuning record/trial landing —
+        re-optimizes. Every forward — eager, under the hybridized
+        CachedOp trace, and the serving session's ``_pure`` — evaluates
+        this graph, so one rewrite covers all three."""
         from ..analysis import graph_opt
 
         level = graph_opt.opt_level()
         if level <= 0:
             return self._outputs
+        from .. import autotune as _autotune
         from .. import kernels
 
         tag = (level, graph_opt.PIPELINE_VERSION,
-               kernels.fusion_salt())
+               kernels.fusion_salt(),
+               _autotune.autotune_salt())
         cached = getattr(self, "_graph_opt_cache", None)
         if cached is None or cached[0] != tag:
             opt, _ = graph_opt.optimize_symbol(

@@ -9,18 +9,33 @@ non-trivial. Pallas is only ever *selected* on TPU and only when the
 shapes meet the fp32 tile floor — everywhere else the kernel would run
 interpreted (orders of magnitude slower), so the model never picks it
 off-TPU (tests force it via ``impl=`` for parity checks).
+
+Round 24: the policy THRESHOLDS here are declared autotune decision
+points — ``declare_decision`` returns the heuristic default, so the
+constant and its candidate space live on one line, and ``decide``
+consults ``autotune.lookup`` before each threshold (a measured record
+beats the hand-written value; a miss falls back to it). graft_lint
+L1201 enforces the shape: a bare numeric policy literal in this file
+is a lint error unless it went through ``declare_decision`` or carries
+an ``allow(L1201)`` pragma (the tile floor below is hardware geometry,
+not tunable policy).
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..autotune import declare_decision, lookup as _lookup
+
 #: fp32 minimum tile (sublane, lane) a Pallas TPU kernel wants aligned
-_TILE_ROWS = 8
-_TILE_COLS = 128
+#: — hardware geometry, not a tunable policy
+_TILE_ROWS = 8  # graft-lint: allow(L1201)
+_TILE_COLS = 128  # graft-lint: allow(L1201)
 
 #: a fused elementwise cluster must absorb at least this many ops —
 #: below it there is no dispatch to save
-MIN_CLUSTER = 2
+MIN_CLUSTER = declare_decision(
+    "fusion.min_cluster", candidates=(2, 3, 4), default=2,
+    key_doc="(backend,)")
 
 
 @dataclass(frozen=True)
@@ -48,8 +63,33 @@ def _pallas_viable(pattern, out_shape):
 #: sequence length at which a lax attention cluster goes compute-bound:
 #: BENCH_FUSION_r17 measured the fused lax replay at 0.92x of the 1:1
 #: lowering once both score dims reach 64 — the QK^T/PV matmuls dominate
-#: and the fused executable only denies XLA its own gemm scheduling
-_ATTN_COMPUTE_BOUND_SEQ = 64
+#: and the fused executable only denies XLA its own gemm scheduling.
+#: r17 also measured 1.74x at seq 16: the crossover is really a function
+#: of feature width (narrow heads stay dispatch-dominated far past
+#: seq 64), which is why the consult key carries a feat bucket — the
+#: candidate 4096 effectively means "never compute-bound".
+_ATTN_COMPUTE_BOUND_SEQ = declare_decision(
+    "fusion.attn_compute_bound_seq",
+    candidates=(16, 32, 64, 128, 4096), default=64,
+    key_doc="(backend, pow2-bucket of cluster output feature dim)")
+
+#: past 2**this elements an elementwise chain is bandwidth-bound and
+#: XLA's own loop fusion already covers it; the fused dispatch saves
+#: nothing but costs a fresh executable
+_ELEMENTWISE_BANDWIDTH_LOG2 = declare_decision(
+    "fusion.elementwise_bandwidth_log2",
+    candidates=(20, 22, 24), default=22,
+    key_doc="(backend,)")
+
+
+def _bucket_pow2(n):
+    """Power-of-two ceiling bucket for a consult-key dimension (0 for
+    unknown): records generalize across nearby widths instead of
+    fragmenting per exact shape."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    return 1 << (n - 1).bit_length()
 
 
 def decide(pattern, n_nodes, out_shape=None, backend="cpu",
@@ -62,6 +102,10 @@ def decide(pattern, n_nodes, out_shape=None, backend="cpu",
     the ``MXNET_FUSION_COST_MODEL`` knob. For ``attention`` clusters,
     ``score_shape`` is the (..., seq_q, seq_k) shape of the QK^T score
     tensor when known.
+
+    Each threshold consults the autotune record store first
+    (``MXNET_AUTOTUNE=0`` turns that into a constant-time no-op) and
+    falls back to the declared heuristic default on miss.
     """
     if mode == "never":
         return Decision(False, reason="cost_model_never")
@@ -69,21 +113,29 @@ def decide(pattern, n_nodes, out_shape=None, backend="cpu",
             and _pallas_viable(pattern, out_shape) else "lax")
     if mode == "always":
         return Decision(True, impl=impl)
-    if n_nodes < MIN_CLUSTER:
+    min_cluster = _lookup("fusion.min_cluster", (backend,))
+    if min_cluster is None:
+        min_cluster = MIN_CLUSTER
+    if n_nodes < min_cluster:
         # a 1-op "cluster" saves zero dispatches and costs a retrace
         return Decision(False, reason="too_small")
     if (pattern == "attention" and impl == "lax"
-            and score_shape is not None and len(score_shape) >= 2
-            and score_shape[-2] >= _ATTN_COMPUTE_BOUND_SEQ
-            and score_shape[-1] >= _ATTN_COMPUTE_BOUND_SEQ):
-        return Decision(False, reason="compute_bound_attention")
+            and score_shape is not None and len(score_shape) >= 2):
+        feat = out_shape[-1] if out_shape else 0
+        bound = _lookup("fusion.attn_compute_bound_seq",
+                        (backend, _bucket_pow2(feat)))
+        if bound is None:
+            bound = _ATTN_COMPUTE_BOUND_SEQ
+        if score_shape[-2] >= bound and score_shape[-1] >= bound:
+            return Decision(False, reason="compute_bound_attention")
     if pattern == "elementwise" and out_shape is not None:
         size = 1
         for d in out_shape:
             size *= int(d)
-        if size > (1 << 22):
-            # past ~4M elements the chain is bandwidth-bound and XLA's
-            # own loop fusion already covers it; the fused dispatch
-            # saves nothing but costs a fresh executable
+        log2_cap = _lookup("fusion.elementwise_bandwidth_log2",
+                           (backend,))
+        if log2_cap is None:
+            log2_cap = _ELEMENTWISE_BANDWIDTH_LOG2
+        if size > (1 << log2_cap):
             return Decision(False, reason="bandwidth_bound")
     return Decision(True, impl=impl)

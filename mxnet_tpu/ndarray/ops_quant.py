@@ -9,7 +9,15 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..autotune import declare_decision, lookup as _at_lookup
 from .registry import register
+
+#: heuristic default for the ``auto`` lowering choice — declared as an
+#: autotune decision point so a measured record (keyed per backend) can
+#: override the backend guess; explicit env values always win
+_LOWERING_DEFAULT = declare_decision(
+    "quantize.lowering", candidates=("native", "dequant"),
+    default="auto", key_doc="(backend,)")
 
 
 def lowering():
@@ -23,7 +31,9 @@ def lowering():
       fp32, rounded back onto the int32 lattice. CPU XLA has no native
       int8 contraction kernels (int8 dots/convs run 6-30x slower than
       fp32 there), so this is the fast path everywhere without an MXU.
-    - ``auto`` (default): native on TPU, dequant elsewhere.
+    - ``auto`` (default): a tuned record for ``quantize.lowering``
+      (keyed per backend) when one exists, else native on TPU, dequant
+      elsewhere.
 
     The elementwise quantized ops (quantize/dequantize/requantize,
     act/pool/add/concat/bn) are lowering-independent. Serving salts
@@ -41,7 +51,11 @@ def lowering():
         return mode
     import jax
 
-    return "native" if jax.default_backend() == "tpu" else "dequant"
+    backend = jax.default_backend()
+    tuned = _at_lookup("quantize.lowering", (backend,))
+    if tuned in ("native", "dequant"):
+        return tuned
+    return "native" if backend == "tpu" else "dequant"
 
 
 def _acc_cast(x):

@@ -115,6 +115,9 @@ FAULT_POINTS = {
                            "decode path (a fire evicts the acquiring "
                            "session, surfacing SessionEvicted to "
                            "exactly that one client)",
+    "autotune_measure": "autotune candidate measurement (a fire skips "
+                        "that candidate; the sweep degrades to the "
+                        "remaining ones instead of crashing)",
 }
 
 _EXC_BY_NAME = {

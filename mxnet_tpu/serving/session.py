@@ -497,7 +497,7 @@ class InferenceSession:
         code_of.extend(self._graph_op_bodies())
         return CompiledArtifact(
             "serving", key, code_of=tuple(code_of),
-            salts=("graph_opt", "sharding", "quantize"),
+            salts=("graph_opt", "sharding", "quantize", "autotune"),
             salt_ctx={
                 "optimizable": isinstance(self._block, SymbolBlock),
                 "shard": self._shard,
@@ -598,7 +598,7 @@ class InferenceSession:
         store = self.state_store
         return CompiledArtifact(
             "serving_step", key, code_of=tuple(code_of),
-            salts=("graph_opt", "quantize", "paged_state"),
+            salts=("graph_opt", "quantize", "paged_state", "autotune"),
             salt_ctx={
                 "optimizable": isinstance(self._block, SymbolBlock),
                 "graph_signature": self._graph_sig,

@@ -84,6 +84,11 @@ LOCK_RANKS = {
     "serving.session": 40,      # InferenceSession AOT-entry tables
     "serving.store": 50,        # SessionStateStore slots + page pool
     "serving.metrics": 60,      # ServingMetrics counters/histograms
+    # autotune tier: consulted from graph optimization (which may run
+    # under serving.session) and salt resolution; nothing but telemetry
+    # counters is ever acquired under these
+    "autotune.registry": 66,    # DecisionPoint table
+    "autotune.records": 68,     # TuningRecord cache + trial overrides
     # artifact tier (session/store call down into it)
     "artifact.salts": 70,       # salt-provider registry
     "artifact.remote.breakers": 72,  # per-URL breaker table

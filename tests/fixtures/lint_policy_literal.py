@@ -1,0 +1,52 @@
+"""Seeded graft_lint L1201 violation fixture (NOT imported by the
+package). graft-lint: scope(policy-literal)
+
+The marker comment above opts this file into the decision-point
+discipline the fusion cost-model files (``kernels/cost_model.py``,
+``analysis/fusion.py``) get automatically; the tier-1 lint test
+asserts every policy-literal species below is flagged. Keep this file
+OUTSIDE mxnet_tpu/ so ``python -m tools.graft_lint mxnet_tpu`` stays
+clean on the shipped tree.
+"""
+from mxnet_tpu.autotune import declare_decision, lookup
+
+# -- species 1: module-constant numeric policy literals -------------------
+
+_BAD_THRESHOLD = 64                  # L1201: bare numeric constant
+BAD_BYTES_CAP = 1 << 22              # L1201: literal shift expression
+_BAD_NEGATIVE = -4                   # L1201: unary-minus literal
+_BAD_PRODUCT = 4 * 1024              # L1201: literal product
+
+# hardware geometry is not tunable policy: the pragma is the exit
+_TILE_FLOOR = 128  # graft-lint: allow(L1201)
+
+# the sanctioned form: the constant IS the registry declaration
+_GOOD_THRESHOLD = declare_decision(
+    "fixture.threshold", candidates=(16, 64, 4096), default=64)
+
+# non-numeric and non-constant bindings are out of scope
+_NAME = "attention"
+_ALIAS = _GOOD_THRESHOLD
+lowercase_number = 9999  # not a module CONSTANT: no finding
+
+
+# -- species 2: inline comparisons against policy literals ----------------
+
+def bad_inline_compare(seq, size):
+    if seq >= 64:                    # L1201: inline threshold
+        return False
+    return size > (1 << 22)          # L1201: literal-shift comparator
+
+
+def good_structural_compares(shape, n_nodes):
+    # small structural constants stay exempt (|n| <= 8)
+    if len(shape) >= 2 and n_nodes != 0 and shape[-1] % 8 == 0:
+        tuned = lookup("fixture.threshold", ("cpu",))
+        bound = tuned if tuned is not None else _GOOD_THRESHOLD
+        return shape[-2] >= bound    # named threshold: no finding
+    return False
+
+
+def whitelisted_inline(size):
+    # a deliberate non-policy constant carries the pragma
+    return size > 65535  # graft-lint: allow(L1201) — wire-format bound

@@ -711,6 +711,66 @@ def test_bench_compare_fleet_metrics():
     assert not any(r[4] for r in bench_compare.compare(base, base))
 
 
+@pytest.mark.slow
+def test_autotune_bench_smoke(tmp_path):
+    from mxnet_tpu.benchmark import autotune_bench
+
+    out = str(tmp_path / "autotune.json")
+    doc = autotune_bench.run(smoke=True, out_path=out)
+    assert doc["smoke"] is True
+    # structural contracts at any scale: both families swept, each
+    # sweep persisted a record that consults back to the stored choice
+    # (asserted inside the bench), and the tuner actually measured.
+    # The >=1.0 / >1.05 tuned_vs_default gates are timing properties
+    # only enforced on the committed full run (BENCH_AUTOTUNE_r24.json)
+    # — smoke shapes have no bandwidth cliff to find.
+    assert set(doc["families"]) == {"elementwise_bandwidth",
+                                    "attn_compute_bound"}
+    for row in doc["families"].values():
+        assert row["sweep"], row  # at least one candidate measured
+        assert row["tuned_vs_default"] > 0
+        point_candidates = [m["choice"] for m in row["sweep"]]
+        assert row["choice"] in point_candidates \
+            or row["choice"] == row["default_choice"]
+    assert doc["counters"]["measurements"] >= 2
+    assert doc["counters"]["record_store"] == 2
+    with open(out) as f:
+        assert json.load(f)["benchmark"] == "autotune"
+
+
+def test_bench_compare_autotune_metrics():
+    """BENCH_AUTOTUNE_r24.json names: tuned_vs_default is
+    higher-is-better (below 1.0 means a persisted record made the
+    workload SLOWER than the heuristic), tune_ms lower-is-better,
+    choices/counters untracked (a config fact, not a speed)."""
+    base = {"families": {"elementwise_bandwidth": {
+                "choice": 24, "tuned_vs_default": 4.9,
+                "tune_ms": 21540.0},
+            "attn_compute_bound": {
+                "choice": 64, "tuned_vs_default": 1.0,
+                "tune_ms": 830.0}},
+            "counters": {"measurements": 8}}
+    worse = {"families": {"elementwise_bandwidth": {
+                "choice": 24, "tuned_vs_default": 0.8,
+                "tune_ms": 60000.0},
+            "attn_compute_bound": {
+                "choice": 64, "tuned_vs_default": 1.0,
+                "tune_ms": 830.0}},
+             "counters": {"measurements": 8}}
+    rows = {r[0]: r for r in bench_compare.compare(base, worse)}
+    assert bench_compare._direction(
+        "families.elementwise_bandwidth.tuned_vs_default") == "higher"
+    assert bench_compare._direction(
+        "families.elementwise_bandwidth.tune_ms") == "lower"
+    # a record that used to win 4.9x now LOSES to the default: REGRESSED
+    assert rows["families.elementwise_bandwidth.tuned_vs_default"][4]
+    assert rows["families.elementwise_bandwidth.tune_ms"][4]
+    assert not rows["families.attn_compute_bound.tuned_vs_default"][4]
+    assert "families.elementwise_bandwidth.choice" not in rows
+    assert "counters.measurements" not in rows
+    assert not any(r[4] for r in bench_compare.compare(base, base))
+
+
 def test_bench_compare_cli_exit_codes(tmp_path):
     base, new_ok, new_bad = (str(tmp_path / n) for n in
                              ("base.json", "ok.json", "bad.json"))

@@ -32,6 +32,8 @@ LOCK_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                             "lint_raw_lock.py")
 GUARD_FIXTURE = os.path.join(REPO, "tests", "fixtures",
                              "lint_guarded_by.py")
+POLICY_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                              "lint_policy_literal.py")
 
 
 def test_shipped_tree_lints_clean():
@@ -393,6 +395,53 @@ def test_guarded_by_fixture_triggers_l1102():
     assert flagged == {"return _REGISTRY.get(name)",
                        "return self._slots.get(sid)",
                        "self._closed = True"}, flagged
+
+
+def test_policy_literal_fixture_triggers_l1201():
+    """L1201: every policy-literal species in the seeded fixture is
+    flagged — bare module constant, literal shift, unary minus,
+    literal product, and both inline-comparison forms — while the
+    ``declare_decision`` result, the lowercase binding, the structural
+    small constants (len >= 2, != 0, % 8 == 0), the lookup-backed
+    named threshold, and both allow(L1201) sites stay clean."""
+    findings = graft_lint.lint_paths([POLICY_FIXTURE], repo_root=REPO,
+                                     registry=False)
+    assert {f.code for f in findings} == {"L1201"}, findings
+    l1201 = [f for f in findings if f.code == "L1201"]
+    assert len(l1201) == 6, l1201
+    msgs = "\n".join(f.message for f in l1201)
+    for constant in ("_BAD_THRESHOLD", "BAD_BYTES_CAP",
+                     "_BAD_NEGATIVE", "_BAD_PRODUCT"):
+        assert constant in msgs, (constant, msgs)
+    # the literal-shift comparator is reported by VALUE (1 << 22)
+    assert "4194304" in msgs, msgs
+    # every inline finding lands inside bad_inline_compare, none in
+    # the structural twin or the pragma'd site
+    src = open(POLICY_FIXTURE).read().splitlines()
+    bad = next(i for i, ln in enumerate(src, 1)
+               if "def bad_inline_compare" in ln)
+    good = next(i for i, ln in enumerate(src, 1)
+                if "def good_structural_compares" in ln)
+    inline = [f for f in l1201 if "inline comparison" in f.message]
+    assert len(inline) == 2 and \
+        all(bad < f.line < good for f in inline), inline
+
+
+def test_policy_literal_scope_binds_cost_model_only(tmp_path):
+    """The decision-point discipline binds the fusion cost-model pair
+    automatically and is opt-in elsewhere: the same bare threshold in
+    a free-standing file (or any other mxnet_tpu file) is not
+    flagged."""
+    src = "_THRESHOLD = 64\n"
+    free = tmp_path / "policy_frag.py"
+    free.write_text(src)
+    assert graft_lint.lint_paths([str(free)], repo_root=REPO,
+                                 registry=False) == []
+    scoped = tmp_path / "policy_scoped.py"
+    scoped.write_text("# graft-lint: scope(policy-literal)\n" + src)
+    got = graft_lint.lint_paths([str(scoped)], repo_root=REPO,
+                                registry=False)
+    assert [f.code for f in got] == ["L1201"], got
 
 
 def test_ranked_lock_scope_exempts_locks_module(tmp_path):

@@ -51,7 +51,11 @@ inferred from the leaf name:
   it used to fuse), ``*sessions*`` (BENCH_PAGED_r21.json KV-cache
   capacity — max concurrent sessions resident at a fixed byte budget
   and the paged/row-slot ratios; a drop means paged storage stopped
-  packing short prefixes densely). ``*flat_ratio*`` is lower-is-better
+  packing short prefixes densely), ``*tuned_vs_default*``
+  (BENCH_AUTOTUNE_r24.json measured-config over heuristic-default
+  cost ratio per decision family — below 1.0 means a persisted
+  TuningRecord made a workload SLOWER than the hand-written heuristic
+  it replaced). ``*flat_ratio*`` is lower-is-better
   (BENCH_PAGED_r21.json late-prefix over early-prefix step cost —
   growth means decode stopped being O(1) in prefix depth)
 
@@ -82,7 +86,7 @@ LOWER_IS_BETTER = ("_us", "_ms", "latency", "_sec", "retrace",
 HIGHER_IS_BETTER = ("speedup", "throughput", "per_sec",
                     "items_per", "_rps", "overlap", "goodput",
                     "efficiency", "tokens_per", "hit_rate",
-                    "sessions")
+                    "sessions", "tuned_vs_default")
 # end-anchored: 'steps_per_s' is throughput but 'fused_ms_per_step'
 # must stay latency — a bare 'per_s' substring would match both
 HIGHER_SUFFIXES = ("per_s",)

@@ -183,6 +183,22 @@ package:
                        where the block is the point (a condition
                        wait's timeout loop) carries
                        ``# graft-lint: allow(L1103)``.
+``L1201 policy-literal`` a numeric performance-policy threshold in the
+                       fusion cost-model files (``kernels/
+                       cost_model.py``, ``analysis/fusion.py``) that
+                       did not go through the autotune DecisionPoint
+                       registry: a module-level ALL-CAPS constant
+                       assigned a bare numeric-literal expression
+                       (``1 << 22`` counts) instead of a
+                       ``declare_decision(...)`` result, or an inline
+                       comparison against a numeric literal above the
+                       structural range (|n| > 8 — ``len(x) >= 2`` and
+                       ``== 0`` stay exempt). Round 24 made measured
+                       records beat hand-written thresholds; a bare
+                       literal is invisible to the tuner and to
+                       ``docs/AUTOTUNE.md``'s decision-point table.
+                       Hardware geometry (tile floors) carries
+                       ``# graft-lint: allow(L1201)``.
 ``R301/R302/R303``     registry checks (``--registry``): every
                        registered op carries a docstring; every op named
                        in the dtype-rule tables of ``symbol/infer.py``
@@ -1097,6 +1113,113 @@ def check_raw_lock_construction(path, tree, source, findings):
             f"unranked site carries allow(L1101)"))
 
 
+_POLICY_LITERAL_FILES = ("mxnet_tpu/kernels/cost_model.py",
+                         "mxnet_tpu/analysis/fusion.py")
+
+
+def _policy_literal_scoped(path, source):
+    """Files the decision-point discipline applies to: the fusion
+    cost-model pair (where round 24 moved every threshold behind
+    ``declare_decision``). Fixtures opt in with a
+    ``# graft-lint: scope(policy-literal)`` marker."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith(_POLICY_LITERAL_FILES):
+        return True
+    return "graft-lint: scope(policy-literal)" in source
+
+
+def _literal_num(node):
+    """The numeric value of a pure-literal expression (``8``,
+    ``1 << 22``, ``-4``, ``4 * 1024``), or None when any operand is a
+    name/call — a named threshold is exactly what the rule wants."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return v
+        return None
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, (ast.USub, ast.UAdd)):
+        v = _literal_num(node.operand)
+        if v is None:
+            return None
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.BinOp):
+        lv, rv = _literal_num(node.left), _literal_num(node.right)
+        if lv is None or rv is None:
+            return None
+        try:
+            if isinstance(node.op, ast.LShift):
+                return lv << rv
+            if isinstance(node.op, ast.Mult):
+                return lv * rv
+            if isinstance(node.op, ast.Add):
+                return lv + rv
+            if isinstance(node.op, ast.Sub):
+                return lv - rv
+            if isinstance(node.op, ast.Pow):
+                return lv ** rv
+        except (TypeError, ValueError, OverflowError):
+            return None
+    return None
+
+
+def _is_declare_decision(node):
+    """True for ``declare_decision(...)`` / ``x.declare_decision(...)``
+    call values — the sanctioned way a policy constant is born."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Name) and f.id == "declare_decision") or \
+        (isinstance(f, ast.Attribute) and f.attr == "declare_decision")
+
+
+def check_policy_literal(path, tree, source, findings):
+    """L1201: a performance-policy threshold that bypassed the
+    DecisionPoint registry. Two species:
+
+    - a module-level ALL-CAPS constant assigned a numeric-literal
+      expression instead of a ``declare_decision(...)`` result;
+    - a comparison against an inline numeric literal past the
+      structural range (|n| > 8) — a threshold hidden where even a
+      constant-name grep cannot find it.
+    """
+    if not _policy_literal_scoped(path, source):
+        return
+    pragmas = _Pragmas(source)
+
+    def emit(node, msg):
+        if not pragmas.allows(node.lineno, "L1201"):
+            findings.append(Finding("L1201", path, node.lineno, msg))
+
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value:
+            target, value = node.target.id, node.value
+        if target is None or not target.isupper() \
+                or _is_declare_decision(value):
+            continue
+        if _literal_num(value) is not None:
+            emit(node, f"numeric policy literal bound to {target!r} — "
+                 "declare it with autotune.declare_decision(name, "
+                 "candidates, default) so measured records can beat "
+                 "it; hardware geometry carries allow(L1201)")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for comparator in node.comparators:
+            v = _literal_num(comparator)
+            if v is not None and abs(v) > 8:
+                emit(node, f"inline comparison against numeric policy "
+                     f"literal {v!r} — route the threshold through a "
+                     "declared DecisionPoint (autotune."
+                     "declare_decision) and consult autotune.lookup; "
+                     "a non-policy constant carries allow(L1201)")
+
+
 def _guards_comment(source_lines, lineno):
     """The ``# guards: a, b`` attr set for the assignment at 1-based
     ``lineno`` — from the same line's trailing comment or the line
@@ -1421,6 +1544,7 @@ def lint_paths(paths, repo_root=None, registry=True):
         check_raw_lock_construction(path, tree, source, findings)
         check_guarded_by(path, tree, source, findings)
         check_blocking_under_lock(path, tree, source, findings)
+        check_policy_literal(path, tree, source, findings)
         if os.path.basename(path) == "registry.py":
             want_registry = True
     if registry and want_registry:
